@@ -1,0 +1,91 @@
+"""paddle_tpu — a TPU-native deep learning framework with a
+PaddlePaddle-shaped API, built on JAX/XLA/Pallas.
+
+Architecture (see SURVEY.md §7): eager ops execute through jnp on XLA with a
+define-by-run tape for dygraph autograd; the performance path compiles whole
+train steps with jax.jit/jax.grad over jax.sharding meshes. There are no
+per-op device kernels — XLA is the kernel library; Pallas supplies the few
+hot kernels XLA can't fuse (flash attention, MoE dispatch).
+"""
+from __future__ import annotations
+
+import jax as _jax
+
+# paddle semantics need int64/float64 available; defaults remain fp32/int64
+_jax.config.update("jax_enable_x64", True)
+
+from .core import dtype as _dtype_mod  # noqa: E402
+from .core.dtype import (  # noqa: F401,E402
+    bfloat16, bool_, complex128, complex64, dtype, finfo, float16, float32,
+    float64, float8_e4m3fn, float8_e5m2, get_default_dtype, iinfo, int16,
+    int32, int64, int8, promote_types, set_default_dtype, uint8,
+)
+bool = bool_  # noqa: A001 (paddle.bool)
+
+from .core.place import (  # noqa: F401,E402
+    CPUPlace, CUDAPinnedPlace, CUDAPlace, CustomPlace, Place, TPUPlace,
+    XPUPlace, get_device, is_compiled_with_cuda, is_compiled_with_tpu,
+    is_compiled_with_xpu, set_device,
+)
+from .core.tensor import Tensor, to_tensor  # noqa: F401,E402
+from .core.random import seed, get_rng_state, set_rng_state  # noqa: F401,E402
+from .core.flags import get_flags, set_flags  # noqa: F401,E402
+from .core import flags as flags  # noqa: F401,E402
+
+from . import ops  # noqa: F401,E402  (patches Tensor methods)
+from .ops.creation import *  # noqa: F401,F403,E402
+from .ops.math import *  # noqa: F401,F403,E402
+from .ops.manipulation import *  # noqa: F401,F403,E402
+from .ops.logic import *  # noqa: F401,F403,E402
+from .ops.search import *  # noqa: F401,F403,E402
+from .ops.stat import *  # noqa: F401,F403,E402
+from .ops import linalg  # noqa: F401,E402
+from .ops.linalg import norm, einsum  # noqa: F401,E402
+from .ops.math import matmul, mm, bmm, mv, dot, pow  # noqa: F401,E402
+
+from .core.tape import no_grad_guard as no_grad  # noqa: F401,E402
+from .core.tape import enable_grad_guard as enable_grad  # noqa: F401,E402
+from .core.tape import is_grad_enabled  # noqa: F401,E402
+from .autograd.functional import grad  # noqa: F401,E402
+from . import autograd  # noqa: F401,E402
+from . import amp  # noqa: F401,E402
+from . import nn  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from . import device  # noqa: F401,E402
+from .framework.io import save, load  # noqa: F401,E402
+from . import framework  # noqa: F401,E402
+from . import jit  # noqa: F401,E402
+from . import static  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+from .hapi.model import Model  # noqa: F401,E402
+from .hapi import summary  # noqa: F401,E402
+from . import vision  # noqa: F401,E402
+from . import distributed  # noqa: F401,E402
+from . import incubate  # noqa: F401,E402
+from . import profiler  # noqa: F401,E402
+from . import utils  # noqa: F401,E402
+from . import sparse  # noqa: F401,E402
+from . import distribution  # noqa: F401,E402
+from . import version  # noqa: F401,E402
+
+disable_static = lambda place=None: None  # dygraph is the default mode
+enable_static = None  # replaced by static module hook below
+
+
+def enable_static():  # noqa: F811
+    from . import static as _static
+    _static._enable_static()
+
+
+def in_dynamic_mode():
+    from . import static as _static
+    return not _static._static_mode_enabled()
+
+
+def is_grad_enabled_():
+    from .core import tape
+    return tape.is_grad_enabled()
+
+
+__version__ = version.full_version

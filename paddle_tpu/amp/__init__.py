@@ -1,0 +1,14 @@
+"""paddle_tpu.amp — automatic mixed precision (see auto_cast.py)."""
+from .auto_cast import (  # noqa: F401
+    auto_cast, amp_guard, amp_state, WHITE_LIST, BLACK_LIST,
+)
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
+from . import debugging  # noqa: F401
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+def is_float16_supported(device=None):
+    return True

@@ -1,0 +1,101 @@
+"""Automatic mixed precision (bf16-first for TPU).
+
+Rebuild of the reference AMP (/root/reference/python/paddle/amp/auto_cast.py:1029
+and the C++ enforcement in generated ad_funcs via AmpLevel,
+paddle/fluid/imperative/amp_auto_cast.h:29). On TPU the preferred low dtype is
+bfloat16 (same exponent range as fp32 — no loss scaling needed); fp16 is kept
+for API parity. O1 casts white-listed ops' inputs down and black-listed ops'
+inputs up; O2 ("pure") casts everything except blacklist.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+# Op lists (reference: python/paddle/amp/amp_lists.py:20-103). Names are our
+# op-registry names.
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "einsum", "conv2d", "conv1d", "conv3d",
+    "conv2d_transpose", "linear", "addmm", "attention", "flash_attention",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "logsumexp", "softmax",
+    "log_softmax", "cross_entropy", "softmax_with_cross_entropy", "erf",
+    "erfinv", "pow", "square", "reciprocal", "rsqrt", "sum", "mean", "norm",
+    "cumsum", "cumprod", "var", "std", "renorm", "prod", "sigmoid_cross_entropy_with_logits",
+    "binary_cross_entropy", "nll_loss", "kl_div", "cosine_similarity",
+    "layer_norm", "batch_norm", "instance_norm", "group_norm",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.level = "O1"
+        self.dtype = "bfloat16"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_amp_state = _AmpState()
+
+
+def amp_state():
+    return _amp_state
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """paddle.amp.auto_cast context manager."""
+    if level not in ("O0", "O1", "O2", "OD"):
+        raise ValueError(f"level should be O0/OD/O1/O2, got {level}")
+    st = _amp_state
+    prev = (st.enabled, st.level, st.dtype, st.custom_white, st.custom_black)
+    st.enabled = bool(enable) and level != "O0"
+    st.level = level
+    st.dtype = dtype
+    st.custom_white = set(custom_white_list or ())
+    st.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (st.enabled, st.level, st.dtype, st.custom_white,
+         st.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def _low_dtype():
+    return jnp.bfloat16 if _amp_state.dtype == "bfloat16" else jnp.float16
+
+
+def autocast_inputs(op_name, tensor_args):
+    """Called from core.dispatch.run_op when AMP is active."""
+    from ..core.tensor import Tensor
+    st = _amp_state
+    white = (WHITE_LIST | st.custom_white) - st.custom_black
+    black = (BLACK_LIST | st.custom_black) - st.custom_white
+    if st.level == "O2":
+        to_low = op_name not in black
+    elif st.level == "OD":
+        to_low = op_name in white
+    else:  # O1
+        to_low = op_name in white
+    if not to_low and op_name not in black:
+        return tensor_args
+    target = _low_dtype() if to_low else jnp.float32
+
+    def cast_one(x):
+        if isinstance(x, Tensor) and jnp.issubdtype(x._data.dtype,
+                                                    jnp.floating):
+            if x._data.dtype != target and x._data.dtype in (
+                    jnp.float32, jnp.bfloat16, jnp.float16):
+                from ..ops import manipulation
+                return manipulation.cast(x, jnp.dtype(target).name)
+        return x
+
+    return [cast_one(x) for x in tensor_args]

@@ -1,0 +1,98 @@
+"""Numerical debugging (reference: python/paddle/amp/debugging.py:173,361,481).
+
+check_numerics scans a tensor for NaN/Inf; TensorCheckerConfig +
+enable_tensor_checker turn on per-op output scanning via
+FLAGS_check_nan_inf (see core/dispatch.py); collect_operator_stats counts the
+ops executed per dtype while enabled.
+"""
+from __future__ import annotations
+
+import contextlib
+from enum import Enum
+
+import jax.numpy as jnp
+
+from ..core import flags
+from ..core.dispatch import unwrap, wrap
+
+
+class DebugMode(Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 4
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+        self.debug_step = debug_step
+        self.stack_height_limit = stack_height_limit
+
+
+def enable_tensor_checker(config: TensorCheckerConfig):
+    flags.set_flags({
+        "check_nan_inf": config.enable,
+        "check_nan_inf_level":
+            0 if config.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT else 1,
+    })
+
+
+def disable_tensor_checker():
+    flags.set_flags({"check_nan_inf": False})
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    a = unwrap(tensor)
+    num_nan = jnp.sum(jnp.isnan(a))
+    num_inf = jnp.sum(jnp.isinf(a))
+    num_zero = jnp.sum(a == 0)
+    if int(num_nan) or int(num_inf):
+        msg = (f"[check_numerics] op={op_type} var={var_name}: "
+               f"{int(num_nan)} nan, {int(num_inf)} inf")
+        if debug_mode in (None, DebugMode.CHECK_NAN_INF_AND_ABORT):
+            raise FloatingPointError(msg)
+        print("WARNING:", msg)
+    return wrap(num_nan.astype(jnp.int64)), wrap(num_inf.astype(jnp.int64)), \
+        wrap(num_zero.astype(jnp.int64))
+
+
+_op_stats = {}
+_collecting = False
+
+
+def _record_op(name, dtype):
+    if _collecting:
+        key = (name, str(dtype))
+        _op_stats[key] = _op_stats.get(key, 0) + 1
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    global _collecting
+    _op_stats.clear()
+    _collecting = True
+    try:
+        yield
+    finally:
+        _collecting = False
+        by_dtype = {}
+        for (name, dt), cnt in sorted(_op_stats.items()):
+            by_dtype.setdefault(dt, []).append((name, cnt))
+        print("<------------------- op list ------------------->")
+        for dt, entries in by_dtype.items():
+            print(f"dtype: {dt}")
+            for name, cnt in entries:
+                print(f"  {name}: {cnt}")
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    raise NotImplementedError(
+        "compare_accuracy requires dump files produced by the reference; "
+        "use check_numerics/enable_tensor_checker on TPU")

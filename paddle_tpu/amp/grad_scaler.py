@@ -1,0 +1,145 @@
+"""Dynamic loss scaling (reference: python/paddle/amp/grad_scaler.py:657).
+
+On TPU the default training dtype is bfloat16 whose exponent range equals
+fp32, so loss scaling is a no-op by default (enable=False semantics) — but the
+full fp16-style dynamic scaler is implemented for API/behavior parity: scale
+the loss, unscale grads before step, skip steps on inf/nan, grow/shrink the
+scale on a schedule.
+"""
+from __future__ import annotations
+
+from enum import Enum
+
+import jax.numpy as jnp
+
+from ..core.dispatch import unwrap, wrap
+from ..core.tensor import Tensor
+
+
+class OptimizerState(Enum):
+    INIT = 0
+    UNSCALED = 1
+    STEPPED = 2
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._use_dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._opt_states = {}
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._use_dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        from ..ops import math as math_ops
+        return math_ops.multiply(var, wrap(jnp.asarray(
+            self._scale, unwrap(var).dtype)))
+
+    def _collect_params(self, optimizer):
+        params = []
+        for p in optimizer._parameter_list or []:
+            if isinstance(p, dict):
+                params.extend(p.get("params", []))
+            else:
+                params.append(p)
+        return params
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        if self._opt_states.get(id(optimizer)) == OptimizerState.UNSCALED:
+            return
+        found = False
+        inv = 1.0 / self._scale
+        for p in self._collect_params(optimizer):
+            if p.grad is not None:
+                g = p.grad._data
+                finite = bool(jnp.all(jnp.isfinite(g)))
+                if not finite:
+                    found = True
+                p.grad._data = (g * inv).astype(g.dtype)
+        self._found_inf = found
+        self._opt_states[id(optimizer)] = OptimizerState.UNSCALED
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if self._opt_states.get(id(optimizer)) != OptimizerState.UNSCALED:
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._opt_states[id(optimizer)] = OptimizerState.STEPPED
+
+    def update(self):
+        if not self._enable or not self._use_dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n_nan_or_inf:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._opt_states.clear()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def state_dict(self):
+        return {
+            "scale": self._scale,
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every_n_steps,
+            "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf,
+            "good_steps": self._good_steps,
+            "bad_steps": self._bad_steps,
+            "use_dynamic_loss_scaling": self._use_dynamic,
+        } if self._enable else {}
+
+    def load_state_dict(self, state):
+        if not self._enable or not state:
+            return
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+    # fleet compat getters
+    def get_incr_ratio(self):
+        return self._incr_ratio
+
+    def get_decr_ratio(self):
+        return self._decr_ratio
+
+
+AmpScaler = GradScaler

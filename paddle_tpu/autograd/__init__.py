@@ -1,0 +1,23 @@
+"""paddle_tpu.autograd (reference: python/paddle/autograd)."""
+from .backward_mode import backward  # noqa: F401
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+from .functional import grad, jacobian, hessian, vjp, jvp  # noqa: F401
+from ..core.tape import no_grad_guard as no_grad  # noqa: F401
+from ..core.tape import enable_grad_guard as enable_grad  # noqa: F401
+from ..core.tape import is_grad_enabled  # noqa: F401
+
+
+class set_grad_enabled:
+    def __init__(self, mode: bool):
+        from ..core import tape
+        self._mode = mode
+        self._prev = tape._state.grad_enabled
+        tape._state.grad_enabled = mode
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        from ..core import tape
+        tape._state.grad_enabled = self._prev
+        return False

@@ -1,0 +1,6 @@
+"""paddle.autograd.backward (reference: python/paddle/autograd/backward_mode.py)."""
+from ..core import tape
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    tape.backward(tensors, grad_tensors, retain_graph=retain_graph)

@@ -1,0 +1,131 @@
+"""Functional autograd: grad, vjp, jvp, Jacobian, Hessian.
+
+Reference: python/paddle/incubate/autograd/functional.py:50,124,214,308 and
+paddle.grad. On TPU these map directly onto jax.vjp/jvp/jacobian — the
+framework's functional transforms are jax's, exposed with paddle signatures.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import tape as tape_mod
+from ..core.dispatch import unwrap, wrap
+from ..core.tensor import Tensor
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad — tape-based partial derivative query."""
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    # save/restore existing leaf grads so paddle.grad doesn't pollute .grad
+    saved = [t.grad for t in inputs]
+    for t in inputs:
+        t.grad = None
+    tape_mod.backward(list(outputs), grad_outputs,
+                      retain_graph=True if retain_graph is None
+                      else retain_graph)
+    results = []
+    for t, old in zip(inputs, saved):
+        g = t.grad
+        if g is None and not allow_unused:
+            g = Tensor._from_array(jnp.zeros_like(t._data))
+        t.grad = old
+        results.append(g)
+    return results
+
+
+def _as_fn_and_arrays(func, xs):
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [unwrap(x) for x in xs]
+
+    def fn(*arrs):
+        with tape_mod.no_grad_guard():
+            ins = [Tensor._from_array(a) for a in arrs]
+            out = func(*ins)
+        if isinstance(out, (list, tuple)):
+            return tuple(unwrap(o) for o in out)
+        return unwrap(out)
+    return fn, arrays
+
+
+def vjp(func, xs, v=None):
+    fn, arrays = _as_fn_and_arrays(func, xs)
+    out, vjp_fn = jax.vjp(fn, *arrays)
+    if v is None:
+        v = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        v = jax.tree_util.tree_map(unwrap, v,
+                                   is_leaf=lambda x: isinstance(x, Tensor))
+    grads = vjp_fn(v)
+    wrap_t = lambda tr: jax.tree_util.tree_map(wrap, tr)
+    grads = grads[0] if len(grads) == 1 else list(grads)
+    return wrap_t(out), wrap_t(grads)
+
+
+def jvp(func, xs, v=None):
+    fn, arrays = _as_fn_and_arrays(func, xs)
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrays]
+    else:
+        v = v if isinstance(v, (list, tuple)) else [v]
+        tangents = [unwrap(t) for t in v]
+    out, jv = jax.jvp(fn, tuple(arrays), tuple(tangents))
+    wrap_t = lambda tr: jax.tree_util.tree_map(wrap, tr)
+    return wrap_t(out), wrap_t(jv)
+
+
+class Jacobian:
+    """Lazy Jacobian (reference: incubate/autograd/functional.py:214)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        fn, arrays = _as_fn_and_arrays(func, xs)
+        jac = jax.jacrev(fn, argnums=tuple(range(len(arrays))))(*arrays)
+        if len(arrays) == 1 and not isinstance(jac, tuple):
+            jac = (jac,)
+        if isinstance(jac, tuple) and len(jac) == 1:
+            self._value = wrap(jnp.asarray(jac[0]))
+        else:
+            self._value = [wrap(jnp.asarray(j)) for j in jac]
+        self.is_batched = is_batched
+
+    def __getitem__(self, idx):
+        v = self._value if isinstance(self._value, Tensor) else \
+            self._value[0]
+        return v[idx]
+
+    @property
+    def shape(self):
+        v = self._value if isinstance(self._value, Tensor) else \
+            self._value[0]
+        return v.shape
+
+
+class Hessian:
+    def __init__(self, func, xs, is_batched=False):
+        fn, arrays = _as_fn_and_arrays(func, xs)
+        hess = jax.hessian(fn)(*arrays)
+        self._value = wrap(jnp.asarray(hess))
+        self.is_batched = is_batched
+
+    def __getitem__(self, idx):
+        return self._value[idx]
+
+    @property
+    def shape(self):
+        return self._value.shape
+
+
+def jacobian(func, xs, is_batched=False):
+    return Jacobian(func, xs, is_batched)
+
+
+def hessian(func, xs, is_batched=False):
+    return Hessian(func, xs, is_batched)
+
+
+def forward_grad(func, xs, v=None):
+    """Forward-mode AD (reference: incubate/autograd/primapi.py:36)."""
+    return jvp(func, xs, v)[1]

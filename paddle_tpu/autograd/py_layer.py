@@ -1,0 +1,105 @@
+"""PyLayer — user-defined VJP in Python.
+
+Reference: python/paddle/autograd/py_layer.py. Rebuilt on the tape: forward
+runs under no_grad, then a TapeNode is installed whose vjp calls the user's
+static backward().
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import tape as tape_mod
+from ..core.dispatch import unwrap, wrap
+from ..core.tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def set_materialize_grads(self, value: bool):
+        self.materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with tape_mod.no_grad_guard():
+            outs = cls.forward(ctx, *args, **kwargs)
+
+        single = not isinstance(outs, (tuple, list))
+        out_list = [outs] if single else list(outs)
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        diff_inputs = [t for t in tensor_inputs
+                       if not t.stop_gradient
+                       and jnp.issubdtype(t._data.dtype, jnp.inexact)]
+        if tape_mod.is_grad_enabled() and diff_inputs:
+            out_tensors = [o for o in out_list if isinstance(o, Tensor)]
+
+            def vjp_fn(cotangents):
+                if not isinstance(cotangents, (tuple, list)):
+                    cotangents = (cotangents,)
+                grads_in = [wrap(c) if c is not None else None
+                            for c in cotangents]
+                grads_out = cls.backward(
+                    ctx, *(grads_in if len(grads_in) > 1 else grads_in))
+                if not isinstance(grads_out, (tuple, list)):
+                    grads_out = (grads_out,)
+                return tuple(unwrap(g) if g is not None else None
+                             for g in grads_out)
+
+            # adapt: tape passes flat tuple of cotangents
+            def adapted(flat_cts):
+                res = vjp_fn(flat_cts)
+                return res
+
+            def adapted_single(ct):
+                return vjp_fn((ct,))
+
+            n_out = len(out_tensors)
+            node = tape_mod.TapeNode(
+                cls.__name__,
+                adapted_single if n_out == 1 else adapted,
+                [t._ensure_meta() for t in diff_inputs],
+                list(diff_inputs),
+                [(o._data.shape, o._data.dtype) for o in out_tensors])
+            for k, o in enumerate(out_tensors):
+                o.stop_gradient = False
+                m = o._ensure_meta()
+                m.node = node
+                m.output_index = k
+                o.is_leaf_ = False
+        return outs
+
+
+LegacyPyLayer = PyLayer
+
+
+def once_differentiable(fn):
+    return fn

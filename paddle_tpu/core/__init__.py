@@ -1,0 +1,3 @@
+"""Core runtime: Tensor, dtype, place, tape autograd, dispatch, flags, RNG."""
+from . import dtype, flags, place, random, tape  # noqa: F401
+from .tensor import Tensor, to_tensor  # noqa: F401

@@ -1,0 +1,142 @@
+"""Eager op dispatch.
+
+Rebuild of the reference's generated ad_func layer
+(/root/reference/paddle/fluid/eager/auto_code_generator/generator/eager_gen.py:365
+FORWARD_FUNCTION_TEMPLATE): every op runs fixed stages — AMP autocast, input
+unwrap, forward compute via the jnp implementation, NaN check, tape-node
+creation (via jax.vjp) when any input requires grad.
+
+There is no per-op CUDA kernel to select: XLA compiles and caches one
+executable per (op, shapes, dtypes) signature; eager calls hit that cache.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import tape as tape_mod
+from .flags import get_flag
+from .tensor import Tensor
+
+
+def _is_inexact(arr) -> bool:
+    return jnp.issubdtype(arr.dtype, jnp.inexact)
+
+
+def unwrap(x):
+    """Tensor -> jax array; pass through scalars/arrays/None."""
+    if isinstance(x, Tensor):
+        return x._data
+    return x
+
+
+def wrap(arr, stop_gradient=True) -> Tensor:
+    return Tensor._from_array(arr, stop_gradient=stop_gradient)
+
+
+def _check_nan_inf(name, arrays):
+    for a in arrays:
+        if isinstance(a, jax.Array) and _is_inexact(a):
+            bad = bool(jnp.any(~jnp.isfinite(a)))
+            if bad:
+                msg = f"Op {name} output contains NaN/Inf"
+                if get_flag("check_nan_inf_level") == 0:
+                    raise FloatingPointError(msg)
+                print("WARNING:", msg)
+
+
+def run_op(name: str, fn: Callable, tensor_args: Sequence[Any], **attrs):
+    """Execute op `fn(*arrays, **attrs)` eagerly, recording the tape.
+
+    tensor_args: positional inputs that may be Tensors (differentiable if
+    floating point and not stop_gradient). attrs: static keyword attributes.
+    Returns Tensor or tuple of Tensors mirroring fn's output structure.
+    """
+    from ..amp import auto_cast as amp_mod
+    if amp_mod._amp_state.enabled:
+        tensor_args = amp_mod.autocast_inputs(name, tensor_args)
+
+    arrays = [unwrap(x) for x in tensor_args]
+
+    record = tape_mod.is_grad_enabled()
+    diff_idx = []
+    if record:
+        for i, (orig, arr) in enumerate(zip(tensor_args, arrays)):
+            if (isinstance(orig, Tensor) and not orig.stop_gradient
+                    and isinstance(arr, jax.Array) and _is_inexact(arr)):
+                diff_idx.append(i)
+        record = bool(diff_idx)
+
+    if not record:
+        out = fn(*arrays, **attrs)
+        if get_flag("check_nan_inf"):
+            _check_nan_inf(name, jax.tree_util.tree_leaves(out))
+        return jax.tree_util.tree_map(
+            lambda a: wrap(a, stop_gradient=True), out,
+            is_leaf=lambda x: isinstance(x, (jax.Array, np.ndarray)))
+
+    def closed(*diff_arrays):
+        full = list(arrays)
+        for i, a in zip(diff_idx, diff_arrays):
+            full[i] = a
+        return fn(*full, **attrs)
+
+    primals = [arrays[i] for i in diff_idx]
+    out, vjp_fn = jax.vjp(closed, *primals)
+
+    flat_out, treedef = jax.tree_util.tree_flatten(out)
+    if get_flag("check_nan_inf"):
+        _check_nan_inf(name, flat_out)
+
+    # Multi-output vjp takes the full output structure as cotangent; we store
+    # a flat view plus the treedef to rebuild it.
+    if treedef.num_leaves == 1 and isinstance(out, jax.Array):
+        adapted_vjp = vjp_fn
+    else:
+        def adapted_vjp(flat_cts, _vjp=vjp_fn, _td=treedef):
+            return _vjp(jax.tree_util.tree_unflatten(_td, list(flat_cts)))
+
+    input_metas, input_tensors = [], []
+    for i in diff_idx:
+        t = tensor_args[i]
+        input_metas.append(t._ensure_meta())
+        input_tensors.append(t)
+
+    node = tape_mod.TapeNode(
+        name, adapted_vjp, input_metas, input_tensors,
+        [(a.shape, a.dtype) for a in flat_out])
+
+    out_tensors = []
+    for k, a in enumerate(flat_out):
+        t = wrap(a, stop_gradient=not _is_inexact(a))
+        if not t.stop_gradient:
+            m = t._ensure_meta()
+            m.node = node
+            m.output_index = k
+            t.is_leaf_ = False
+        out_tensors.append(t)
+    return jax.tree_util.tree_unflatten(treedef, out_tensors)
+
+
+def run_op_nodiff(name: str, fn: Callable, tensor_args: Sequence[Any],
+                  **attrs):
+    """Execute a non-differentiable op (comparisons, argmax, ...)."""
+    arrays = [unwrap(x) for x in tensor_args]
+    out = fn(*arrays, **attrs)
+    return jax.tree_util.tree_map(
+        lambda a: wrap(a, stop_gradient=True), out,
+        is_leaf=lambda x: isinstance(x, (jax.Array, np.ndarray)))
+
+
+def defop(name: str, fn: Callable, differentiable=True):
+    """Make a Tensor-level op out of a pure jnp function."""
+    runner = run_op if differentiable else run_op_nodiff
+
+    def op(*args, name_=name, **kwargs):
+        return runner(name_, fn, args, **kwargs)
+
+    op.__name__ = name
+    return op

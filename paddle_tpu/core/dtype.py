@@ -1,0 +1,148 @@
+"""Data types for paddle_tpu.
+
+Mirrors the reference's ``phi::DataType`` surface
+(/root/reference/paddle/phi/common/data_type.h) as thin wrappers over numpy/jax
+dtypes. Low-precision TPU types (bfloat16, float8) come from ml_dtypes via jax.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+class DType:
+    """A framework dtype: canonical name + numpy dtype object."""
+
+    __slots__ = ("name", "np_dtype")
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            try:
+                return self.name == dtype(other).name
+            except (TypeError, ValueError):
+                return False
+        try:
+            return self.np_dtype == np.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.name)
+
+    @property
+    def is_floating_point(self):
+        return jnp.issubdtype(self.np_dtype, np.floating)
+
+    @property
+    def is_integer(self):
+        return jnp.issubdtype(self.np_dtype, np.integer)
+
+    @property
+    def is_complex(self):
+        return jnp.issubdtype(self.np_dtype, np.complexfloating)
+
+    @property
+    def itemsize(self):
+        return self.np_dtype.itemsize
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", jnp.bfloat16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+try:  # fp8 types (TPU v5+): present in modern ml_dtypes
+    float8_e4m3fn = DType("float8_e4m3fn", jnp.float8_e4m3fn)
+    float8_e5m2 = DType("float8_e5m2", jnp.float8_e5m2)
+except AttributeError:  # pragma: no cover
+    float8_e4m3fn = None
+    float8_e5m2 = None
+
+_ALL = [
+    bool_, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128,
+] + [d for d in (float8_e4m3fn, float8_e5m2) if d is not None]
+
+_BY_NAME = {d.name: d for d in _ALL}
+_BY_NAME["bool_"] = bool_
+_BY_NAME["float"] = float32
+_BY_NAME["int"] = int32
+_BY_NAME["half"] = float16
+_BY_NAME["double"] = float64
+
+
+def dtype(d) -> DType:
+    """Coerce anything dtype-like (DType, str, numpy dtype, python type) to DType."""
+    if isinstance(d, DType):
+        return d
+    if isinstance(d, str):
+        if d in _BY_NAME:
+            return _BY_NAME[d]
+        # allow 'paddle.float32'-style or numpy names
+        short = d.split(".")[-1]
+        if short in _BY_NAME:
+            return _BY_NAME[short]
+        return DType(str(np.dtype(d)), np.dtype(d))
+    if d is bool:
+        return bool_
+    if d is int:
+        return int64
+    if d is float:
+        return float32
+    npd = np.dtype(d)
+    name = npd.name
+    if name in _BY_NAME:
+        return _BY_NAME[name]
+    return DType(name, npd)
+
+
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = dtype(d)
+    if not (d.is_floating_point or d.is_complex):
+        raise TypeError(
+            f"set_default_dtype only supports floating point dtypes, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype() -> str:
+    return _default_dtype.name
+
+
+def default_float_dtype() -> DType:
+    return _default_dtype
+
+
+def is_floating_point_dtype(d) -> bool:
+    return dtype(d).is_floating_point
+
+
+def promote_types(a, b) -> DType:
+    return dtype(jnp.promote_types(dtype(a).np_dtype, dtype(b).np_dtype))
+
+
+def iinfo(d):
+    return np.iinfo(dtype(d).np_dtype)
+
+
+def finfo(d):
+    return jnp.finfo(dtype(d).np_dtype)

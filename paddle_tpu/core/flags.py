@@ -1,0 +1,100 @@
+"""Runtime flag registry.
+
+TPU-native rebuild of the reference's gflags-compatible flag system
+(/root/reference/paddle/common/flags.cc, flags_native.cc): flags are defined in
+Python, override-able via FLAGS_* environment variables, and read/written via
+paddle_tpu.set_flags / get_flags.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterable
+
+
+class _Flag:
+    __slots__ = ("name", "default", "value", "type", "help")
+
+    def __init__(self, name, default, help=""):
+        self.name = name
+        self.default = default
+        self.type = type(default)
+        self.help = help
+        self.value = self._from_env(default)
+
+    def _from_env(self, default):
+        env = os.environ.get(f"FLAGS_{self.name}")
+        if env is None:
+            return default
+        return _parse(env, self.type)
+
+
+def _parse(s: str, ty):
+    if ty is bool:
+        return s.lower() in ("1", "true", "yes", "on")
+    if ty is int:
+        return int(s)
+    if ty is float:
+        return float(s)
+    return s
+
+
+_registry: Dict[str, _Flag] = {}
+
+
+def define_flag(name: str, default, help: str = ""):
+    if name not in _registry:
+        _registry[name] = _Flag(name, default, help)
+    return _registry[name]
+
+
+def get_flags(flags) -> Dict[str, Any]:
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for f in flags:
+        key = f[6:] if f.startswith("FLAGS_") else f
+        if key not in _registry:
+            raise ValueError(f"Flag {f} is not registered")
+        out[f] = _registry[key].value
+    return out
+
+
+def set_flags(flags: Dict[str, Any]):
+    for k, v in flags.items():
+        key = k[6:] if k.startswith("FLAGS_") else k
+        if key not in _registry:
+            raise ValueError(f"Flag {k} is not registered")
+        fl = _registry[key]
+        fl.value = _parse(v, fl.type) if isinstance(v, str) else fl.type(v)
+
+
+def get_flag(name: str):
+    return _registry[name].value
+
+
+def all_flags() -> Iterable[str]:
+    return list(_registry)
+
+
+# --- Core flags (subset of /root/reference/paddle/common/flags.cc relevant on TPU) ---
+define_flag("check_nan_inf", False, "Scan op outputs for NaN/Inf in eager mode")
+define_flag("check_nan_inf_level", 0, "0: raise on nan/inf; >0: log only")
+define_flag("benchmark", False, "Synchronize after each op and log timing")
+define_flag("eager_delete_tensor_gb", 0.0, "Compat no-op: XLA manages memory")
+define_flag("allocator_strategy", "auto_growth", "Compat: XLA/PJRT owns allocation")
+define_flag("fraction_of_gpu_memory_to_use", 0.92, "Compat alias of TPU HBM fraction")
+define_flag("use_stride_kernel", True, "Views are free under XLA; compat flag")
+define_flag("embedding_deterministic", 1, "TPU scatter-add is deterministic")
+define_flag("cudnn_deterministic", True, "Compat: XLA is deterministic by default")
+define_flag("enable_pir_api", True, "Compat: the compiled (jit) path is default")
+define_flag("use_cinn", True, "Compat: XLA fusion is always on")
+define_flag("nccl_blocking_wait", False, "Compat: collectives are compiled")
+define_flag("enable_async_trace", False, "Enable comm watchdog trace dumps")
+define_flag("distributed_heartbeat_timeout_s", 300, "Coordinator heartbeat timeout")
+define_flag("tpu_matmul_precision", "default", "jax matmul precision: default|high|highest")
+define_flag("amp_dtype", "bfloat16", "Preferred autocast low precision dtype on TPU")
+define_flag("log_memory_stats", False, "Log live buffer stats after each step")
+define_flag("dataloader_use_shared_memory", True, "Use shm for worker result transport")
+define_flag("tensor_fusion_buffer_mb", 128, "Gradient fusion buffer size (compat knob)")
+define_flag("flash_attention_version", 2, "Pallas flash attention kernel version")
+define_flag("use_pallas_kernels", True, "Use Pallas kernels for hot ops on TPU")

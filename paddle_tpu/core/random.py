@@ -1,0 +1,84 @@
+"""Stateful RNG over JAX functional PRNG.
+
+Rebuild of the reference's per-device ``phi::Generator``
+(/root/reference/paddle/phi/core/generator.h:32): a global seed + offset pair.
+Here the state is a jax PRNG key that is split on every draw, giving the same
+"stateful seed, reproducible stream" semantics while staying jit-friendly
+(jitted code should take keys explicitly; eager ops draw from this generator).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+import numpy as np
+
+
+class Generator:
+    def __init__(self, seed: int | None = None):
+        self._lock = threading.Lock()
+        self.manual_seed(seed if seed is not None
+                         else (time.time_ns() & 0xFFFFFFFF))
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._key = jax.random.key(self._seed)
+        self._offset = 0
+        return self
+
+    def seed(self, seed=None):
+        self.manual_seed(seed if seed is not None
+                         else (time.time_ns() & 0xFFFFFFFF))
+        return self._seed
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def get_state(self):
+        return (self._seed, self._offset)
+
+    def set_state(self, state):
+        seed, offset = state
+        self.manual_seed(seed)
+        # replay the offset so the stream position is restored
+        self._key, self._offset = _advance(jax.random.key(seed), offset), offset
+        return self
+
+    def next_key(self):
+        """Return a fresh PRNG key, advancing the stream."""
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            self._offset += 1
+            return sub
+
+
+def _advance(key, n):
+    for _ in range(n):
+        key, _ = jax.random.split(key)
+    return key
+
+
+_default_generator = Generator(0)
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(s: int) -> Generator:
+    """paddle.seed — reseed the global generator."""
+    np.random.seed(s & 0xFFFFFFFF)
+    return _default_generator.manual_seed(s)
+
+
+def get_rng_state():
+    return [_default_generator.get_state()]
+
+
+def set_rng_state(state):
+    _default_generator.set_state(state[0] if isinstance(state, list) else state)
+
+
+def next_key():
+    return _default_generator.next_key()

@@ -1,0 +1,223 @@
+"""Define-by-run autograd tape.
+
+TPU-native rebuild of the reference eager autograd engine
+(/root/reference/paddle/fluid/eager/backward.cc:439 `egr::Backward`,
+grad_node_info.h:197 `GradNodeBase`): every eager op records a TapeNode whose
+backward function is the `jax.vjp` closure of the op's jnp implementation;
+`backward()` runs a dependency-counted reverse-topological sweep, accumulating
+leaf gradients into `Tensor.grad`.
+
+The compiled training path (`to_static`, `Model.fit`, fleet wrappers) does NOT
+use this tape — it differentiates whole step functions with `jax.grad` under
+`jax.jit`, which is the idiomatic XLA design. The tape exists to give paddle
+dygraph semantics (per-op eager execution, `loss.backward()`, hooks,
+`stop_gradient`) for debugging and API parity.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, List, Optional
+
+import jax
+import numpy as np
+
+
+class AutogradMeta:
+    """Per-tensor autograd state (reference: fluid/eager/autograd_meta.h:61)."""
+
+    __slots__ = ("node", "output_index", "hooks", "__weakref__")
+
+    def __init__(self):
+        self.node: Optional[TapeNode] = None
+        self.output_index: int = 0
+        self.hooks: List[Callable] = []
+
+
+class TapeNode:
+    """One recorded op (reference: GradNodeBase, grad_node_info.h:197)."""
+
+    __slots__ = ("name", "vjp_fn", "input_metas", "input_tensors",
+                 "out_avals", "grad_buffer", "pending", "visited")
+
+    def __init__(self, name, vjp_fn, input_metas, input_tensors, out_avals):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        # metas of the differentiable inputs, aligned with vjp results
+        self.input_metas = input_metas
+        # strong refs to leaf tensors so .grad survives
+        self.input_tensors = input_tensors
+        self.out_avals = out_avals  # [(shape, dtype)] per output
+        self.grad_buffer: List[Any] = [None] * len(out_avals)
+        self.pending = 0
+        self.visited = False
+
+    def add_grad(self, index, grad):
+        cur = self.grad_buffer[index]
+        self.grad_buffer[index] = grad if cur is None else cur + grad
+
+
+class _TapeState(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+
+
+_state = _TapeState()
+
+
+def is_grad_enabled() -> bool:
+    return _state.grad_enabled
+
+
+@contextlib.contextmanager
+def no_grad_guard():
+    prev = _state.grad_enabled
+    _state.grad_enabled = False
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad_guard():
+    prev = _state.grad_enabled
+    _state.grad_enabled = True
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+def _zeros_cotangent(shape, dt):
+    import jax.numpy as jnp
+    if np.issubdtype(np.dtype(dt), np.inexact):
+        return jnp.zeros(shape, dt)
+    # non-differentiable output: jax expects a float0 cotangent
+    return np.zeros(shape, dtype=jax.dtypes.float0)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """Run reverse-mode AD from `tensors` (reference: backward.cc:439).
+
+    Accumulates into each reachable leaf tensor's ``.grad``.
+    """
+    import jax.numpy as jnp
+    from .tensor import Tensor
+
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    # Seed gradients.
+    roots = []  # (node, output_index, seed) or leaf tensors
+    leaf_seeds = []
+    for t, g in zip(tensors, grad_tensors):
+        if t._meta is None or (t._meta.node is None and t.stop_gradient):
+            raise RuntimeError(
+                f"Tensor {t.name or ''} has stop_gradient=True and no grad "
+                "history; backward() from it is meaningless")
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad must be provided for non-scalar backward root "
+                    f"(shape {t.shape})")
+            seed = jnp.ones_like(t._data)
+        else:
+            seed = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        if t._meta.node is None:
+            leaf_seeds.append((t, seed))
+        else:
+            roots.append((t._meta.node, t._meta.output_index, seed))
+
+    # Discover the reachable graph and count consumers per node.
+    visited = set()
+    stack = [n for (n, _, _) in roots]
+    topo_nodes = []
+    while stack:
+        node = stack.pop()
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        topo_nodes.append(node)
+        for meta in node.input_metas:
+            if meta is not None and meta.node is not None:
+                stack.append(meta.node)
+    pending = {}
+    for node in topo_nodes:
+        for meta in node.input_metas:
+            if meta is not None and meta.node is not None:
+                pending[id(meta.node)] = pending.get(id(meta.node), 0) + 1
+
+    for node, idx, seed in roots:
+        node.add_grad(idx, seed)
+    for t, seed in leaf_seeds:
+        _accumulate_leaf(t, seed)
+
+    ready = [n for (n, _, _) in roots if pending.get(id(n), 0) == 0]
+    # de-dup ready list
+    seen_ready = set(id(n) for n in ready)
+    done = set()
+
+    while ready:
+        node = ready.pop()
+        if id(node) in done:
+            continue
+        done.add(id(node))
+        cotangents = tuple(
+            g if g is not None else _zeros_cotangent(shape, dt)
+            for g, (shape, dt) in zip(node.grad_buffer, node.out_avals))
+        if len(cotangents) == 1:
+            in_grads = node.vjp_fn(cotangents[0])
+        else:
+            in_grads = node.vjp_fn(cotangents)
+        if not isinstance(in_grads, tuple):
+            in_grads = (in_grads,)
+        for meta, tensor, g in zip(node.input_metas, node.input_tensors,
+                                   in_grads):
+            if meta is None or g is None:
+                continue
+            if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
+                continue
+            for hook in meta.hooks:
+                out = hook(_wrap_grad(g))
+                if out is not None:
+                    g = out._data if isinstance(out, Tensor) else out
+            if meta.node is None:
+                if tensor is not None:
+                    _accumulate_leaf(tensor, g)
+            else:
+                meta.node.add_grad(meta.output_index, g)
+                cnt = pending.get(id(meta.node), 0) - 1
+                pending[id(meta.node)] = cnt
+                if cnt <= 0 and id(meta.node) not in seen_ready:
+                    seen_ready.add(id(meta.node))
+                    ready.append(meta.node)
+        if not retain_graph:
+            node.vjp_fn = _used_vjp
+            node.grad_buffer = [None] * len(node.out_avals)
+
+
+def _used_vjp(*_a, **_k):
+    raise RuntimeError(
+        "Trying to backward through the graph a second time. Pass "
+        "retain_graph=True to backward() if you need to.")
+
+
+def _wrap_grad(g):
+    from .tensor import Tensor
+    return Tensor._from_array(g, stop_gradient=True)
+
+
+def _accumulate_leaf(tensor, g):
+    from .tensor import Tensor
+    import jax.numpy as jnp
+    if tensor.grad is None:
+        tensor.grad = Tensor._from_array(jnp.asarray(g),
+                                         stop_gradient=True)
+        tensor.grad.name = (tensor.name or "") + "@GRAD"
+    else:
+        tensor.grad._data = tensor.grad._data + g

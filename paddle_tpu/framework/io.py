@@ -1,0 +1,80 @@
+"""paddle.save / paddle.load.
+
+Reference: python/paddle/framework/io.py:773 (save), :1020 (load) — pickled
+state_dicts. Here tensors are serialized as numpy arrays inside a pickle
+stream; bfloat16 is round-tripped via a uint16 view (numpy has no bf16).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+_BF16_TAG = "__bf16__"
+_MAGIC = b"PTPU1\n"
+
+
+def _to_serializable(obj):
+    if isinstance(obj, Tensor):
+        a = np.asarray(obj._data)
+        if a.dtype == jnp.bfloat16:
+            return {_BF16_TAG: True, "data": a.view(np.uint16),
+                    "stop_gradient": obj.stop_gradient}
+        return {"__tensor__": True, "data": a,
+                "stop_gradient": obj.stop_gradient}
+    if isinstance(obj, dict):
+        return {k: _to_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        ty = type(obj)
+        return ty(_to_serializable(v) for v in obj)
+    return obj
+
+
+def _from_serializable(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get(_BF16_TAG):
+            arr = obj["data"].view(jnp.bfloat16)
+            if return_numpy:
+                return arr
+            return Tensor._from_array(jnp.asarray(arr),
+                                      stop_gradient=obj.get("stop_gradient",
+                                                            True))
+        if obj.get("__tensor__"):
+            if return_numpy:
+                return obj["data"]
+            return Tensor._from_array(jnp.asarray(obj["data"]),
+                                      stop_gradient=obj.get("stop_gradient",
+                                                            True))
+        return {k: _from_serializable(v, return_numpy)
+                for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_serializable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    if hasattr(path, "write"):
+        pickle.dump(_to_serializable(obj), path, protocol=protocol)
+        return
+    path = str(path)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        pickle.dump(_to_serializable(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    if hasattr(path, "read"):
+        return _from_serializable(pickle.load(path), return_numpy)
+    with open(str(path), "rb") as f:
+        head = f.read(len(_MAGIC))
+        if head != _MAGIC:
+            f.seek(0)
+        return _from_serializable(pickle.load(f), return_numpy)

@@ -1,0 +1,201 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import run_op, unwrap
+
+
+def _unary(name, fn):
+    def op(x, name=None):
+        return run_op(name, fn, [x])
+    op.__name__ = name
+    return op
+
+
+relu = _unary("relu", jax.nn.relu)
+relu6 = _unary("relu6", jax.nn.relu6)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+tanh = _unary("tanh", jnp.tanh)
+silu = _unary("silu", jax.nn.silu)
+swish = silu
+mish = _unary("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)))
+tanhshrink = _unary("tanhshrink", lambda a: a - jnp.tanh(a))
+softsign = _unary("softsign", jax.nn.soft_sign)
+log_sigmoid = _unary("log_sigmoid", jax.nn.log_sigmoid)
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._data, x._meta, x.stop_gradient = out._data, out._meta, \
+        out.stop_gradient
+    return x
+
+
+def gelu(x, approximate=False, name=None):
+    return run_op("gelu",
+                  lambda a: jax.nn.gelu(a, approximate=approximate), [x])
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return run_op("leaky_relu",
+                  lambda a: jax.nn.leaky_relu(a, negative_slope), [x])
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(a, w):
+        if w.size > 1:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(a >= 0, a, w * a)
+    return run_op("prelu", fn, [x, weight])
+
+
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=True, name=None):
+    from ...core import random as random_mod
+    if training:
+        key = random_mod.next_key()
+        def fn(a):
+            slope = jax.random.uniform(key, a.shape, a.dtype, lower, upper)
+            return jnp.where(a >= 0, a, slope * a)
+        return run_op("rrelu", fn, [x])
+    mid = (lower + upper) / 2.0
+    return leaky_relu(x, mid)
+
+
+def elu(x, alpha=1.0, name=None):
+    return run_op("elu", lambda a: jax.nn.elu(a, alpha), [x])
+
+
+def elu_(x, alpha=1.0, name=None):
+    out = elu(x, alpha)
+    x._data, x._meta, x.stop_gradient = out._data, out._meta, \
+        out.stop_gradient
+    return x
+
+
+def celu(x, alpha=1.0, name=None):
+    return run_op("celu", lambda a: jax.nn.celu(a, alpha), [x])
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return run_op("selu",
+                  lambda a: scale * jnp.where(a > 0, a,
+                                              alpha * jnp.expm1(a)), [x])
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return run_op("hardshrink",
+                  lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), [x])
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return run_op(
+        "softshrink",
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)),
+        [x])
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return run_op("hardsigmoid",
+                  lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), [x])
+
+
+def hardswish(x, name=None):
+    return run_op("hardswish", jax.nn.hard_swish, [x])
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return run_op("hardtanh", lambda a: jnp.clip(a, min, max), [x])
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    def fn(a):
+        scaled = beta * a
+        return jnp.where(scaled > threshold, a,
+                         jax.nn.softplus(scaled) / beta)
+    return run_op("softplus", fn, [x])
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(a):
+        ax = axis % a.ndim
+        ch = a.shape[ax]
+        new_shape = (a.shape[:ax] + (ch // groups, groups) +
+                     a.shape[ax + 1:])
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return run_op("maxout", fn, [x])
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def fn(a):
+        if dtype is not None:
+            from ...core import dtype as dtype_mod
+            a = a.astype(dtype_mod.dtype(dtype).np_dtype)
+        return jax.nn.softmax(a, axis=axis)
+    return run_op("softmax", fn, [x])
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    out = softmax(x, axis, dtype)
+    x._data, x._meta, x.stop_gradient = out._data, out._meta, \
+        out.stop_gradient
+    return x
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def fn(a):
+        if dtype is not None:
+            from ...core import dtype as dtype_mod
+            a = a.astype(dtype_mod.dtype(dtype).np_dtype)
+        return jax.nn.log_softmax(a, axis=axis)
+    return run_op("log_softmax", fn, [x])
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import random as random_mod
+    key = random_mod.next_key()
+
+    def fn(a):
+        g = -jnp.log(-jnp.log(
+            jax.random.uniform(key, a.shape, a.dtype, 1e-10, 1.0)))
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(
+                y_hard, idx, 1.0, axis=axis, inplace=False) if hasattr(
+                jnp, "put_along_axis") else \
+                y_hard.at[..., 0].set(0)  # fallback below
+            oh = (jnp.arange(a.shape[axis]) ==
+                  jnp.moveaxis(idx, axis, -1)).astype(a.dtype)
+            y_hard = jnp.moveaxis(oh, -1, axis)
+            return y_hard + (y - jax.lax.stop_gradient(y))
+        return y
+    return run_op("gumbel_softmax", fn, [x])
+
+
+def glu(x, axis=-1, name=None):
+    def fn(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+    return run_op("glu", fn, [x])
+
+
+def swiglu(x, y=None, name=None):
+    """Fused SwiGLU (reference: incubate/nn/functional/swiglu.py)."""
+    if y is not None:
+        return run_op("swiglu", lambda a, b: jax.nn.silu(a) * b, [x, y])
+    def fn(a):
+        a1, a2 = jnp.split(a, 2, axis=-1)
+        return jax.nn.silu(a1) * a2
+    return run_op("swiglu", fn, [x])
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return run_op("thresholded_relu",
+                  lambda a: jnp.where(a > threshold, a, value), [x])

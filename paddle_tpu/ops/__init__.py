@@ -1,0 +1,210 @@
+"""Op library assembly + Tensor method patching.
+
+Reference analog: python/paddle/tensor/__init__.py and
+python/paddle/base/dygraph/tensor_patch_methods.py — every functional op is
+also attached as a Tensor method, and python operators are wired to ops.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import creation, linalg, logic, manipulation, math, search, stat
+from ..core.dispatch import run_op, unwrap, wrap
+from ..core.tensor import Tensor
+
+# modules whose ops become Tensor methods (creation ops are free functions)
+_MODULES = [math, manipulation, logic, search, stat, linalg]
+
+# ops exposed as Tensor methods (name -> function); first module wins
+_METHOD_EXCLUDE = {
+    "to_tensor", "builtins_sum", "meshgrid", "broadcast_shape",
+    "is_tensor", "wrap", "unwrap", "run_op", "run_op_nodiff",
+}
+
+
+def _patch_methods():
+    for mod in reversed(_MODULES):
+        for name in dir(mod):
+            if name.startswith("_") or name in _METHOD_EXCLUDE:
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn) or isinstance(fn, type):
+                continue
+            setattr(Tensor, name, fn)
+    # fix names that collide with builtins / properties
+    Tensor.pow = math.pow_
+    Tensor.add = math.add
+    Tensor.subtract = math.subtract
+    Tensor.multiply = math.multiply
+    Tensor.divide = math.divide
+    Tensor.mod = math.mod
+    Tensor.floor_divide = math.floor_divide
+    Tensor.matmul = math.matmul
+    Tensor.dot = math.dot
+    Tensor.norm = linalg.norm
+    Tensor.cast = manipulation.cast
+    Tensor.astype = manipulation.cast
+    Tensor.reshape = manipulation.reshape
+    Tensor.transpose = manipulation.transpose
+    Tensor.sum = math.sum
+    Tensor.mean = math.mean
+    Tensor.max = math.max
+    Tensor.min = math.min
+    Tensor.prod = math.prod
+    Tensor.all = logic.all
+    Tensor.any = logic.any
+    Tensor.abs = math.abs
+    Tensor.clip = math.clip
+    Tensor.sqrt = math.sqrt
+    Tensor.exp = math.exp
+    Tensor.log = math.log
+    Tensor.tanh = math.tanh
+    Tensor.sigmoid = math.sigmoid
+    Tensor.argmax = search.argmax
+    Tensor.argmin = search.argmin
+    Tensor.argsort = search.argsort
+    Tensor.sort = search.sort
+    Tensor.topk = search.topk
+    Tensor.unique = manipulation.unique
+    # *_like creation ops are also tensor methods in paddle
+    Tensor.zeros_like = creation.zeros_like
+    Tensor.ones_like = creation.ones_like
+    Tensor.full_like = creation.full_like
+    Tensor.bernoulli = creation.bernoulli
+    Tensor.multinomial = creation.multinomial
+
+
+def _make_inplace(opname, fn2):
+    def inplace(self, *args, **kwargs):
+        out = fn2(self, *args, **kwargs)
+        self._data = out._data
+        self._meta = out._meta
+        self.stop_gradient = out.stop_gradient
+        return self
+    inplace.__name__ = opname
+    return inplace
+
+
+def _patch_inplace():
+    pairs = {
+        "add_": math.add, "subtract_": math.subtract,
+        "multiply_": math.multiply, "divide_": math.divide,
+        "scale_": math.scale, "clip_": math.clip, "exp_": math.exp,
+        "sqrt_": math.sqrt, "rsqrt_": math.rsqrt, "floor_": math.floor,
+        "ceil_": math.ceil, "round_": math.round, "abs_": math.abs,
+        "tanh_": math.tanh, "sigmoid_": math.sigmoid, "neg_": math.neg,
+        "reciprocal_": math.reciprocal, "cast_": manipulation.cast,
+        "pow_": math.pow_, "remainder_": math.remainder,
+        "mod_": math.mod, "lerp_": math.lerp,
+        "subtract__": None,
+    }
+    for name, fn in pairs.items():
+        if fn is None:
+            continue
+        setattr(Tensor, name, _make_inplace(name, fn))
+    # uniform_/normal_ random in-place
+    def uniform_(self, min=-1.0, max=1.0, seed=0, name=None):
+        out = creation.uniform(self.shape, self.dtype, min, max, seed)
+        self._data = out._data
+        return self
+
+    def normal_(self, mean=0.0, std=1.0, name=None):
+        out = creation.randn(self.shape, self.dtype)
+        self._data = out._data * std + mean
+        return self
+
+    def exponential_(self, lam=1.0, name=None):
+        from ..core import random as random_mod
+        import jax
+        key = random_mod.next_key()
+        self._data = jax.random.exponential(
+            key, self._data.shape, self._data.dtype) / lam
+        return self
+
+    Tensor.uniform_ = uniform_
+    Tensor.normal_ = normal_
+    Tensor.exponential_ = exponential_
+
+
+def _patch_operators():
+    def _wrap_other(self, other):
+        if isinstance(other, Tensor):
+            return other
+        return other  # scalars handled by jnp broadcasting
+
+    Tensor.__add__ = lambda s, o: math.add(s, o)
+    Tensor.__radd__ = lambda s, o: math.add(s, o)
+    Tensor.__sub__ = lambda s, o: math.subtract(s, o)
+    Tensor.__rsub__ = lambda s, o: math.subtract(o, s) if isinstance(
+        o, Tensor) else run_op("rsub", lambda a: o - a, [s])
+    Tensor.__mul__ = lambda s, o: math.multiply(s, o)
+    Tensor.__rmul__ = lambda s, o: math.multiply(s, o)
+    Tensor.__truediv__ = lambda s, o: math.divide(s, o)
+    Tensor.__rtruediv__ = lambda s, o: math.divide(o, s) if isinstance(
+        o, Tensor) else run_op("rdiv", lambda a: o / a, [s])
+    Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    Tensor.__mod__ = lambda s, o: math.remainder(s, o)
+    Tensor.__pow__ = lambda s, o: math.pow_(s, o)
+    Tensor.__rpow__ = lambda s, o: run_op("rpow", lambda a: o ** a, [s])
+    Tensor.__neg__ = lambda s: math.neg(s)
+    Tensor.__abs__ = lambda s: math.abs(s)
+    Tensor.__matmul__ = lambda s, o: math.matmul(s, o)
+    Tensor.__rmatmul__ = lambda s, o: math.matmul(o, s) if isinstance(
+        o, Tensor) else run_op("rmatmul", lambda a: jnp.matmul(o, a), [s])
+    Tensor.__eq__ = lambda s, o: logic.equal(s, o)
+    Tensor.__ne__ = lambda s, o: logic.not_equal(s, o)
+    Tensor.__lt__ = lambda s, o: logic.less_than(s, o)
+    Tensor.__le__ = lambda s, o: logic.less_equal(s, o)
+    Tensor.__gt__ = lambda s, o: logic.greater_than(s, o)
+    Tensor.__ge__ = lambda s, o: logic.greater_equal(s, o)
+    Tensor.__and__ = lambda s, o: logic.bitwise_and(s, o)
+    Tensor.__or__ = lambda s, o: logic.bitwise_or(s, o)
+    Tensor.__xor__ = lambda s, o: logic.bitwise_xor(s, o)
+    Tensor.__invert__ = lambda s: logic.bitwise_not(s)
+    Tensor.__iadd__ = lambda s, o: s.add_(o)
+    Tensor.__isub__ = lambda s, o: s.subtract_(o)
+    Tensor.__imul__ = lambda s, o: s.multiply_(o)
+    Tensor.__itruediv__ = lambda s, o: s.divide_(o)
+
+
+def _getitem(self, idx):
+    def conv(i):
+        if isinstance(i, Tensor):
+            return i._data
+        if isinstance(i, (list,)):
+            return jnp.asarray(i)
+        return i
+    if isinstance(idx, tuple):
+        jidx = tuple(conv(i) for i in idx)
+    else:
+        jidx = conv(idx)
+    return run_op("getitem", lambda a: a[jidx], [self])
+
+
+def _setitem(self, idx, value):
+    def conv(i):
+        if isinstance(i, Tensor):
+            return i._data
+        if isinstance(i, list):
+            return jnp.asarray(i)
+        return i
+    jidx = tuple(conv(i) for i in idx) if isinstance(idx, tuple) \
+        else conv(idx)
+    v = unwrap(value)
+    if hasattr(v, "dtype") and v.dtype != self._data.dtype and \
+            jnp.issubdtype(self._data.dtype, jnp.inexact):
+        v = v.astype(self._data.dtype)
+    out = run_op("setitem", lambda a, vv: a.at[jidx].set(vv),
+                 [self, value if isinstance(value, Tensor) else v])
+    self._data = out._data
+    self._meta = out._meta
+    self.stop_gradient = out.stop_gradient
+    return self
+
+
+Tensor.__getitem__ = _getitem
+Tensor.__setitem__ = _setitem
+
+_patch_methods()
+_patch_inplace()
+_patch_operators()

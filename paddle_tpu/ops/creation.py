@@ -1,0 +1,267 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core import random as random_mod
+from ..core.dispatch import unwrap, wrap
+from ..core.tensor import Tensor, to_tensor  # noqa: F401  (re-export)
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        if default is None:
+            return dtype_mod.default_float_dtype().np_dtype
+        return np.dtype(default)
+    return dtype_mod.dtype(dtype).np_dtype
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().reshape(-1))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s)) if not isinstance(s, (int, np.integer))
+                 else int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return wrap(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return wrap(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fill = unwrap(fill_value)
+    if dtype is None and isinstance(fill, (bool, int, float)):
+        if isinstance(fill, bool):
+            d = np.bool_
+        elif isinstance(fill, int):
+            d = np.int64
+        else:
+            d = dtype_mod.default_float_dtype().np_dtype
+        return wrap(jnp.full(_shape(shape), fill, d))
+    return wrap(jnp.full(_shape(shape), fill, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype, name)
+
+
+def zeros_like(x, dtype=None, name=None):
+    a = unwrap(x)
+    return wrap(jnp.zeros(a.shape, _dt(dtype, a.dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    a = unwrap(x)
+    return wrap(jnp.ones(a.shape, _dt(dtype, a.dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    a = unwrap(x)
+    return wrap(jnp.full(a.shape, unwrap(fill_value), _dt(dtype, a.dtype)))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype, name)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if any(isinstance(v, float) or (hasattr(v, "dtype") and
+               jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating))
+               for v in (start, end, step)):
+            dtype = dtype_mod.default_float_dtype().np_dtype
+        else:
+            dtype = np.int64
+    else:
+        dtype = _dt(dtype)
+    return wrap(jnp.arange(start, end, step, dtype=dtype))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return wrap(jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                             dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return wrap(jnp.logspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                             base=unwrap(base), dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return wrap(jnp.eye(int(num_rows),
+                        int(num_columns) if num_columns is not None else None,
+                        dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    a = unwrap(x)
+    if a.ndim == 1 and padding_value != 0:
+        base = jnp.full((a.shape[0] + abs(offset),) * 2, padding_value,
+                        a.dtype)
+        return wrap(base + jnp.diag(a - padding_value, k=offset)
+                    + jnp.diag(jnp.full(a.shape, padding_value, a.dtype),
+                               k=offset) - padding_value *
+                    (jnp.diag(jnp.ones(a.shape, a.dtype), k=offset)))
+    return wrap(jnp.diag(a, k=offset))
+
+
+def diagflat(x, offset=0, name=None):
+    return wrap(jnp.diagflat(unwrap(x), k=offset))
+
+
+def tril(x, diagonal=0, name=None):
+    from ..core.dispatch import run_op
+    return run_op("tril", lambda a: jnp.tril(a, k=diagonal), [x])
+
+
+def triu(x, diagonal=0, name=None):
+    from ..core.dispatch import run_op
+    return run_op("triu", lambda a: jnp.triu(a, k=diagonal), [x])
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return wrap(jnp.stack([r, c]).astype(_dt(dtype, np.int64)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = jnp.triu_indices(row, k=offset, m=col)
+    return wrap(jnp.stack([r, c]).astype(_dt(dtype, np.int64)))
+
+
+def meshgrid(*args, name=None):
+    arrays = [unwrap(a) for a in (args[0] if len(args) == 1 and
+              isinstance(args[0], (list, tuple)) else args)]
+    return [wrap(m) for m in jnp.meshgrid(*arrays, indexing="ij")]
+
+
+def assign(x, output=None):
+    a = unwrap(x)
+    if not isinstance(a, jax.Array):
+        a = jnp.asarray(np.asarray(a))
+        if a.dtype == jnp.float64:
+            a = a.astype(dtype_mod.default_float_dtype().np_dtype)
+    if output is not None:
+        output._data = a
+        return output
+    return wrap(a)
+
+
+def clone(x, name=None):
+    from ..core.dispatch import run_op
+    return run_op("clone", lambda a: a + 0 if jnp.issubdtype(
+        a.dtype, jnp.inexact) else jnp.array(a), [x])
+
+
+def complex(real, imag, name=None):
+    from ..core.dispatch import run_op
+    return run_op("complex", jax.lax.complex, [real, imag])
+
+
+def polar(abs, angle, name=None):
+    from ..core.dispatch import run_op
+    return run_op("polar",
+                  lambda r, t: jax.lax.complex(r * jnp.cos(t),
+                                               r * jnp.sin(t)),
+                  [abs, angle])
+
+
+def clone_detached(x):
+    return wrap(unwrap(x))
+
+
+# ---- random creation (stateful generator; reference phi::Generator) --------
+
+def rand(shape, dtype=None, name=None):
+    key = random_mod.next_key()
+    return wrap(jax.random.uniform(key, _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    key = random_mod.next_key()
+    return wrap(jax.random.normal(key, _shape(shape), _dt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype, name)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    key = random_mod.next_key()
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m, s = jnp.asarray(unwrap(mean)), jnp.asarray(unwrap(std))
+        shp = jnp.broadcast_shapes(m.shape, s.shape)
+        return wrap(m + s * jax.random.normal(key, shp,
+                                              m.dtype if jnp.issubdtype(
+                                                  m.dtype, jnp.floating)
+                                              else jnp.float32))
+    return wrap(mean + std * jax.random.normal(key, _shape(shape or [1]),
+                                               _dt(None)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else random_mod.next_key()
+    return wrap(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                   minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    key = random_mod.next_key()
+    return wrap(jax.random.randint(key, _shape(shape), low, high,
+                                   dtype=_dt(dtype, np.int64)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    a = unwrap(x)
+    if high is None:
+        low, high = 0, low
+    key = random_mod.next_key()
+    return wrap(jax.random.randint(key, a.shape, low, high,
+                                   dtype=_dt(dtype, a.dtype)))
+
+
+def randperm(n, dtype="int64", name=None):
+    key = random_mod.next_key()
+    return wrap(jax.random.permutation(key, n).astype(_dt(dtype, np.int64)))
+
+
+def bernoulli(x, name=None):
+    key = random_mod.next_key()
+    a = unwrap(x)
+    return wrap(jax.random.bernoulli(key, a).astype(a.dtype))
+
+
+def poisson(x, name=None):
+    key = random_mod.next_key()
+    a = unwrap(x)
+    return wrap(jax.random.poisson(key, a).astype(a.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = random_mod.next_key()
+    a = unwrap(x)
+    p = a / jnp.sum(a, axis=-1, keepdims=True)
+    if a.ndim == 1:
+        out = jax.random.choice(key, a.shape[0], (num_samples,),
+                                replace=replacement, p=p)
+    else:
+        keys = jax.random.split(key, a.shape[0])
+        out = jnp.stack([
+            jax.random.choice(k, a.shape[-1], (num_samples,),
+                              replace=replacement, p=p[i])
+            for i, k in enumerate(keys)])
+    return wrap(out.astype(np.int64))

@@ -1,0 +1,260 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py,
+python/paddle/linalg.py namespace)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import run_op, run_op_nodiff, unwrap, wrap
+from .math import matmul, mm, bmm, mv, dot  # noqa: F401  (re-export)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def fn(a):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p is None or p == "fro":
+            if ax is None:
+                return jnp.sqrt(jnp.sum(jnp.square(a)))
+            return jnp.linalg.norm(a, ord=None, axis=ax, keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(a, ord="nuc", axis=ax, keepdims=keepdim)
+        if p == np.inf or p == float("inf"):
+            if ax is None:
+                return jnp.max(jnp.abs(a))
+            return jnp.linalg.norm(a, ord=np.inf, axis=ax, keepdims=keepdim)
+        if p == -np.inf or p == float("-inf"):
+            if ax is None:
+                return jnp.min(jnp.abs(a))
+            return jnp.linalg.norm(a, ord=-np.inf, axis=ax, keepdims=keepdim)
+        if ax is None:
+            return jnp.sum(jnp.abs(a) ** p) ** (1.0 / p)
+        if isinstance(ax, tuple) and len(ax) == 2:
+            return jnp.linalg.norm(a, ord=p, axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=ax,
+                       keepdims=keepdim) ** (1.0 / p)
+    return run_op("norm", fn, [x])
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    def fn(a):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        return jnp.linalg.vector_norm(a, ord=p, axis=ax, keepdims=keepdim)
+    return run_op("vector_norm", fn, [x])
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    def fn(a):
+        return jnp.linalg.matrix_norm(a, ord=p, keepdims=keepdim)
+    return run_op("matrix_norm", fn, [x])
+
+
+def cond(x, p=None, name=None):
+    return run_op("cond", lambda a: jnp.linalg.cond(a, p=p), [x])
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return run_op_nodiff(
+        "matrix_rank",
+        lambda a: jnp.linalg.matrix_rank(a, rtol=tol).astype(jnp.int64) if tol
+        else jnp.linalg.matrix_rank(a).astype(jnp.int64), [x])
+
+
+def matrix_transpose(x, name=None):
+    return run_op("matrix_transpose",
+                  lambda a: jnp.swapaxes(a, -1, -2), [x])
+
+
+def matrix_power(x, n, name=None):
+    return run_op("matrix_power",
+                  lambda a: jnp.linalg.matrix_power(a, n), [x])
+
+
+def det(x, name=None):
+    return run_op("det", jnp.linalg.det, [x])
+
+
+def slogdet(x, name=None):
+    def fn(a):
+        sign, logabs = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logabs])
+    return run_op("slogdet", fn, [x])
+
+
+def inv(x, name=None):
+    return run_op("inv", jnp.linalg.inv, [x])
+
+
+def inverse(x, name=None):
+    return inv(x, name)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return run_op("pinv",
+                  lambda a: jnp.linalg.pinv(a, rtol=rcond,
+                                            hermitian=hermitian), [x])
+
+
+def solve(x, y, name=None):
+    return run_op("solve", jnp.linalg.solve, [x, y])
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return run_op("triangular_solve", fn, [x, y])
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+    return run_op("cholesky_solve", fn, [x, y])
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    a, b = unwrap(x), unwrap(y)
+    sol, res, rank_, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+    return (wrap(sol), wrap(res), wrap(jnp.asarray(rank_)), wrap(sv))
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+    return run_op("cholesky", fn, [x])
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    def fn(L):
+        n = L.shape[-1]
+        eye = jnp.eye(n, dtype=L.dtype)
+        return jax.scipy.linalg.cho_solve((L, not upper), eye)
+    return run_op("cholesky_inverse", fn, [x])
+
+
+def qr(x, mode="reduced", name=None):
+    def fn(a):
+        return jnp.linalg.qr(a, mode=mode)
+    q, r = run_op("qr", fn, [x])
+    return q, r
+
+
+def svd(x, full_matrices=False, name=None):
+    def fn(a):
+        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2).conj()
+    return run_op("svd", fn, [x])
+
+
+def svdvals(x, name=None):
+    return run_op("svdvals",
+                  lambda a: jnp.linalg.svd(a, compute_uv=False), [x])
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    u, s, v = svd(x)
+    from .manipulation import slice as slice_op
+    k = min(q, unwrap(x).shape[-1], unwrap(x).shape[-2])
+    return (slice_op(u, [u.ndim - 1], [0], [k]),
+            slice_op(s, [s.ndim - 1], [0], [k]),
+            slice_op(v, [v.ndim - 1], [0], [k]))
+
+
+def eig(x, name=None):
+    a = unwrap(x)
+    w, v = np.linalg.eig(np.asarray(a))
+    return wrap(jnp.asarray(w)), wrap(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    a = unwrap(x)
+    return wrap(jnp.asarray(np.linalg.eigvals(np.asarray(a))))
+
+
+def eigh(x, UPLO="L", name=None):
+    def fn(a):
+        return jnp.linalg.eigh(a, UPLO=UPLO)
+    return run_op("eigh", fn, [x])
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return run_op("eigvalsh",
+                  lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), [x])
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    a = unwrap(x)
+    lu_, piv = jax.scipy.linalg.lu_factor(a)
+    info = jnp.zeros((), jnp.int32)
+    if get_infos:
+        return wrap(lu_), wrap(piv.astype(jnp.int32) + 1), wrap(info)
+    return wrap(lu_), wrap(piv.astype(jnp.int32) + 1)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    lu_, piv = unwrap(x), unwrap(y)
+    n = lu_.shape[-2]
+    L = jnp.tril(lu_, -1) + jnp.eye(n, lu_.shape[-1], dtype=lu_.dtype)
+    U = jnp.triu(lu_)
+    perm = np.arange(n)
+    pv = np.asarray(piv) - 1
+    for i, p in enumerate(pv.reshape(-1)[:n]):
+        perm[i], perm[p] = perm[p], perm[i]
+    P = jnp.eye(n, dtype=lu_.dtype)[perm].T
+    return wrap(P), wrap(L[..., :n, :min(n, lu_.shape[-1])]), wrap(U)
+
+
+def multi_dot(x, name=None):
+    arrs = [unwrap(a) for a in x]
+    return run_op("multi_dot", lambda *xs: jnp.linalg.multi_dot(xs), list(x))
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    a = unwrap(input)
+    rng = (min, max) if (min != 0 or max != 0) else None
+    return wrap(jnp.histogram_bin_edges(a, bins=bins, range=rng))
+
+
+def householder_product(x, tau, name=None):
+    def fn(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        Q = eye
+        for i in range(n):
+            v = jnp.concatenate([jnp.zeros((i,), a.dtype),
+                                 jnp.ones((1,), a.dtype), a[..., i + 1:, i]])
+            H = eye - t[..., i] * jnp.outer(v, v)
+            Q = Q @ H
+        return Q[..., :, :n]
+    return run_op("householder_product", fn, [x, tau])
+
+
+def corrcoef(x, rowvar=True, name=None):
+    from .stat import corrcoef as _c
+    return _c(x, rowvar, name)
+
+
+def cross(x, y, axis=9, name=None):
+    from .math import cross as _c
+    return _c(x, y, axis, name)
+
+
+def einsum(equation, *operands):
+    ops_list = list(operands[0]) if len(operands) == 1 and isinstance(
+        operands[0], (list, tuple)) else list(operands)
+    return run_op("einsum",
+                  lambda *arrs: jnp.einsum(equation, *arrs), ops_list)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    a = unwrap(x)
+    m, n = a.shape[-2], a.shape[-1]
+    q = q if q is not None else min(6, m, n)
+    if center:
+        a = a - jnp.mean(a, axis=-2, keepdims=True)
+    u, s, vh = jnp.linalg.svd(a, full_matrices=False)
+    return (wrap(u[..., :q]), wrap(s[..., :q]),
+            wrap(jnp.swapaxes(vh, -1, -2)[..., :q]))

@@ -1,0 +1,78 @@
+"""Comparison / logical ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import run_op_nodiff, unwrap, wrap
+
+
+def _cmp(name, fn):
+    def op(x, y, name=None):
+        return run_op_nodiff(name, fn, [x, y])
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+bitwise_left_shift = _cmp("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = _cmp("bitwise_right_shift", jnp.right_shift)
+
+
+def logical_not(x, name=None):
+    return run_op_nodiff("logical_not", jnp.logical_not, [x])
+
+
+def bitwise_not(x, name=None):
+    return run_op_nodiff("bitwise_not", jnp.bitwise_not, [x])
+
+
+def bitwise_invert(x, name=None):
+    return bitwise_not(x, name)
+
+
+def equal_all(x, y, name=None):
+    return wrap(jnp.array_equal(unwrap(x), unwrap(y)))
+
+
+def is_same_shape(x, y):
+    return tuple(unwrap(x).shape) == tuple(unwrap(y).shape)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return wrap(jnp.allclose(unwrap(x), unwrap(y), rtol=float(rtol),
+                             atol=float(atol), equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return run_op_nodiff(
+        "isclose",
+        lambda a, b: jnp.isclose(a, b, rtol=float(rtol), atol=float(atol),
+                                 equal_nan=equal_nan), [x, y])
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return run_op_nodiff("all",
+                         lambda a: jnp.all(a, axis=ax, keepdims=keepdim), [x])
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return run_op_nodiff("any",
+                         lambda a: jnp.any(a, axis=ax, keepdims=keepdim), [x])
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return run_op_nodiff(
+        "isin", lambda a, b: jnp.isin(a, b, invert=invert), [x, test_x])

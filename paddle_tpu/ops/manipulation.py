@@ -1,0 +1,662 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.dispatch import run_op, run_op_nodiff, unwrap, wrap
+from ..core.tensor import Tensor
+
+
+def _ints(v):
+    if isinstance(v, Tensor):
+        v = v.tolist()
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    return [int(unwrap(s)) if not isinstance(s, (int, np.integer)) else int(s)
+            for s in v]
+
+
+def cast(x, dtype, name=None):
+    want = dtype_mod.dtype(dtype).np_dtype
+    a = unwrap(x)
+    if jnp.issubdtype(want, jnp.inexact) and jnp.issubdtype(a.dtype,
+                                                            jnp.inexact):
+        return run_op("cast", lambda b: b.astype(want), [x])
+    return run_op_nodiff("cast", lambda b: b.astype(want), [x])
+
+
+def reshape(x, shape, name=None):
+    shp = _ints(shape)
+    return run_op("reshape", lambda a: jnp.reshape(a, shp), [x])
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    return _rebind(x, out)
+
+
+def _rebind(x, out):
+    """In-place rebinding: x adopts out's data+grad history."""
+    x._data = out._data
+    x._meta = out._meta
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def fn(a):
+        nd = a.ndim
+        if nd == 0:
+            return a.reshape(1)
+        s0 = start_axis % nd if start_axis < 0 else start_axis
+        s1 = stop_axis % nd if stop_axis < 0 else stop_axis
+        new_shape = (a.shape[:s0] + (-1,) + a.shape[s1 + 1:])
+        return a.reshape(new_shape)
+    return run_op("flatten", fn, [x])
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return _rebind(x, flatten(x, start_axis, stop_axis))
+
+
+def squeeze(x, axis=None, name=None):
+    def fn(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(ax % a.ndim for ax in axes if a.shape[ax % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+    return run_op("squeeze", fn, [x])
+
+
+def squeeze_(x, axis=None, name=None):
+    return _rebind(x, squeeze(x, axis))
+
+
+def unsqueeze(x, axis, name=None):
+    axes = _ints(axis)
+    def fn(a):
+        axs = axes if isinstance(axes, list) else [axes]
+        out = a
+        for ax in sorted([ax % (out.ndim + 1) if ax < 0 else ax
+                          for ax in axs]):
+            out = jnp.expand_dims(out, ax)
+        return out
+    return run_op("unsqueeze", fn, [x])
+
+
+def unsqueeze_(x, axis, name=None):
+    return _rebind(x, unsqueeze(x, axis))
+
+
+def transpose(x, perm, name=None):
+    perm = _ints(perm)
+    return run_op("transpose", lambda a: jnp.transpose(a, perm), [x])
+
+
+def t(x, name=None):
+    def fn(a):
+        if a.ndim < 2:
+            return a
+        return a.T
+    return run_op("t", fn, [x])
+
+
+def moveaxis(x, source, destination, name=None):
+    return run_op("moveaxis",
+                  lambda a: jnp.moveaxis(a, source, destination), [x])
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return run_op("swapaxes", lambda a: jnp.swapaxes(a, axis1, axis2), [x])
+
+
+def concat(x, axis=0, name=None):
+    tensors = list(x)
+    ax = int(unwrap(axis)) if not isinstance(axis, int) else axis
+    return run_op("concat", lambda *arrs: jnp.concatenate(arrs, axis=ax),
+                  tensors)
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return run_op("stack", lambda *arrs: jnp.stack(arrs, axis=axis), tensors)
+
+
+def hstack(x, name=None):
+    return run_op("hstack", lambda *arrs: jnp.hstack(arrs), list(x))
+
+
+def vstack(x, name=None):
+    return run_op("vstack", lambda *arrs: jnp.vstack(arrs), list(x))
+
+
+def dstack(x, name=None):
+    return run_op("dstack", lambda *arrs: jnp.dstack(arrs), list(x))
+
+
+def row_stack(x, name=None):
+    return vstack(x, name)
+
+
+def column_stack(x, name=None):
+    return run_op("column_stack", lambda *arrs: jnp.column_stack(arrs),
+                  list(x))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(unwrap(axis)) if not isinstance(axis, int) else axis
+    a_shape = unwrap(x).shape
+    dim = a_shape[ax]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = _ints(num_or_sections)
+        neg = [i for i, s in enumerate(sizes) if s == -1]
+        if neg:
+            known = builtins_sum(s for s in sizes if s != -1)
+            sizes[neg[0]] = dim - known
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+
+    def fn(a):
+        return tuple(jax.lax.slice_in_dim(a, off, off + sz, axis=ax)
+                     for off, sz in zip(offsets, sizes))
+    return list(run_op("split", fn, [x]))
+
+
+def builtins_sum(it):
+    tot = 0
+    for v in it:
+        tot += v
+    return tot
+
+
+def chunk(x, chunks, axis=0, name=None):
+    ax = axis
+    dim = unwrap(x).shape[ax]
+    base = (dim + chunks - 1) // chunks
+    sizes = []
+    left = dim
+    while left > 0:
+        sizes.append(min(base, left))
+        left -= base
+    return split(x, sizes, axis)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    a = unwrap(x)
+    parts = jnp.array_split(a, num_or_indices if isinstance(
+        num_or_indices, int) else _ints(num_or_indices), axis=axis)
+    sizes = [p.shape[axis] for p in parts]
+    return split(x, sizes, axis)
+
+
+def unbind(input, axis=0, name=None):
+    n = unwrap(input).shape[axis]
+    def fn(a):
+        return tuple(jnp.squeeze(s, axis=axis)
+                     for s in jnp.split(a, n, axis=axis))
+    return list(run_op("unbind", fn, [input]))
+
+
+def tile(x, repeat_times, name=None):
+    reps = _ints(repeat_times)
+    return run_op("tile", lambda a: jnp.tile(a, reps), [x])
+
+
+def expand(x, shape, name=None):
+    shp = _ints(shape)
+    def fn(a):
+        target = list(shp)
+        # -1 means keep original dim
+        offset = len(target) - a.ndim
+        for i in range(len(target)):
+            if target[i] == -1:
+                target[i] = a.shape[i - offset]
+        return jnp.broadcast_to(a, target)
+    return run_op("expand", fn, [x])
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape, name)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, list(unwrap(y).shape), name)
+
+
+def broadcast_tensors(inputs, name=None):
+    arrs = [unwrap(i) for i in inputs]
+    shp = jnp.broadcast_shapes(*[a.shape for a in arrs])
+    return [expand(i, list(shp)) for i in inputs]
+
+
+def flip(x, axis, name=None):
+    axes = _ints(axis)
+    return run_op("flip", lambda a: jnp.flip(a, axis=axes), [x])
+
+
+def fliplr(x):
+    return run_op("fliplr", jnp.fliplr, [x])
+
+
+def flipud(x):
+    return run_op("flipud", jnp.flipud, [x])
+
+
+def roll(x, shifts, axis=None, name=None):
+    return run_op("roll",
+                  lambda a: jnp.roll(a, _ints(shifts),
+                                     axis=_ints(axis) if axis is not None
+                                     else None), [x])
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(unwrap(axis)) if not isinstance(axis, int) else axis
+    return run_op("gather", lambda a, i: jnp.take(a, i, axis=ax), [x, index])
+
+
+def gather_nd(x, index, name=None):
+    def fn(a, idx):
+        k = idx.shape[-1]
+        return a[tuple(jnp.moveaxis(idx, -1, 0))] if k > 0 else a
+    return run_op("gather_nd", fn, [x, index])
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    def fn(a, idx):
+        if broadcast:
+            shp = list(a.shape)
+            shp[axis] = idx.shape[axis]
+            idx = jnp.broadcast_to(idx, shp)
+        return jnp.take_along_axis(a, idx, axis=axis)
+    return run_op("take_along_axis", fn, [arr, indices])
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    def fn(a, idx, v):
+        v = jnp.broadcast_to(v, idx.shape) if v.ndim < idx.ndim or \
+            v.shape != idx.shape else v
+        mode_map = {"assign": "set", "add": "add", "mul": "multiply",
+                    "multiply": "multiply", "amin": "min", "amax": "max",
+                    "mean": "add"}
+        red = mode_map.get(reduce, "set")
+        dim_idx = [jnp.arange(s).reshape(
+            [-1 if i == d else 1 for i in range(a.ndim)])
+            for d, s in enumerate(idx.shape)]
+        dim_idx[axis] = idx
+        at = a.at[tuple(dim_idx)]
+        return getattr(at, red)(v)
+    return run_op("put_along_axis", fn, [arr, indices, values])
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def fn(a, idx, upd):
+        if overwrite:
+            return a.at[idx].set(upd)
+        return a.at[idx].set(0).at[idx].add(upd)
+    return run_op("scatter", fn, [x, index, updates])
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return _rebind(x, scatter(x, index, updates, overwrite))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def fn(a, idx, upd):
+        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+    return run_op("scatter_nd_add", fn, [x, index, updates])
+
+
+def scatter_nd(index, updates, shape, name=None):
+    def fn(idx, upd):
+        return jnp.zeros(tuple(_ints(shape)),
+                         upd.dtype).at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+    return run_op("scatter_nd", fn, [index, updates])
+
+
+def index_select(x, index, axis=0, name=None):
+    return run_op("index_select",
+                  lambda a, i: jnp.take(a, i, axis=axis), [x, index])
+
+
+def index_sample(x, index):
+    def fn(a, idx):
+        return jnp.take_along_axis(a, idx, axis=1)
+    return run_op("index_sample", fn, [x, index])
+
+
+def index_add(x, index, axis, value, name=None):
+    def fn(a, i, v):
+        idx = [slice(None)] * a.ndim
+        idx[axis] = i
+        return a.at[tuple(idx)].add(v)
+    return run_op("index_add", fn, [x, index, value])
+
+
+def index_add_(x, index, axis, value, name=None):
+    return _rebind(x, index_add(x, index, axis, value))
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def fn(a, v, *idx):
+        at = a.at[tuple(idx)]
+        return at.add(v) if accumulate else at.set(v)
+    return run_op("index_put", fn, [x, value] + list(indices))
+
+
+def index_put_(x, indices, value, accumulate=False, name=None):
+    return _rebind(x, index_put(x, indices, value, accumulate))
+
+
+def index_fill(x, index, axis, value, name=None):
+    def fn(a, i):
+        idx = [slice(None)] * a.ndim
+        idx[axis] = i
+        return a.at[tuple(idx)].set(value)
+    return run_op("index_fill", fn, [x, index])
+
+
+def masked_select(x, mask, name=None):
+    a, m = unwrap(x), unwrap(mask)
+    return wrap(a[np.asarray(m)])  # dynamic shape -> host sync (eager only)
+
+
+def masked_fill(x, mask, value, name=None):
+    def fn(a, m):
+        return jnp.where(m, jnp.asarray(unwrap(value), a.dtype), a)
+    return run_op("masked_fill", fn, [x, mask])
+
+
+def masked_fill_(x, mask, value, name=None):
+    return _rebind(x, masked_fill(x, mask, value))
+
+
+def masked_scatter(x, mask, value, name=None):
+    a, m, v = unwrap(x), np.asarray(unwrap(mask)), unwrap(value)
+    flat_v = v.reshape(-1)[: int(m.sum())]
+    out = np.array(a)
+    out[m] = np.asarray(flat_v)
+    return wrap(jnp.asarray(out))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return run_op("where", lambda c, a, b: jnp.where(c, a, b),
+                  [condition, x, y])
+
+
+def where_(condition, x, y, name=None):
+    return _rebind(x, where(condition, x, y))
+
+
+def nonzero(x, as_tuple=False):
+    a = np.asarray(unwrap(x))
+    nz = np.nonzero(a)
+    if as_tuple:
+        return tuple(wrap(jnp.asarray(i.astype(np.int64))) for i in nz)
+    return wrap(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    pad_list = _ints(pad)
+
+    def fn(a):
+        nd = a.ndim
+        if len(pad_list) == 2 * nd:
+            pairs = [(pad_list[2 * i], pad_list[2 * i + 1])
+                     for i in range(nd)]
+        else:
+            # paddle semantics: pad applies to last len(pad)//2 dims
+            # (images: NCHW -> pad W then H)
+            k = len(pad_list) // 2
+            pairs = [(0, 0)] * (nd - k)
+            tail = []
+            for i in range(k):
+                tail.append((pad_list[2 * i], pad_list[2 * i + 1]))
+            pairs = pairs + tail[::-1]
+            if data_format in ("NHWC", "NDHWC", "NLC") and nd > 2:
+                # channel-last: padded dims sit before the channel dim
+                pairs = ([(0, 0)] + pairs[2:] + [(0, 0)])[:nd]
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, pairs, mode=jmode, constant_values=value)
+        return jnp.pad(a, pairs, mode=jmode)
+    return run_op("pad", fn, [x])
+
+
+def slice(input, axes, starts, ends):  # noqa: A001
+    axes_, starts_, ends_ = _ints(axes), _ints(starts), _ints(ends)
+
+    def fn(a):
+        out = a
+        for ax, st, en in zip(axes_, starts_, ends_):
+            size = a.shape[ax]
+            st2 = max(st + size, 0) if st < 0 else min(st, size)
+            en2 = max(en + size, 0) if en < 0 else min(en, size)
+            out = jax.lax.slice_in_dim(out, st2, en2, axis=ax)
+        return out
+    return run_op("slice", fn, [input])
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes_, st_, en_, sd_ = map(_ints, (axes, starts, ends, strides))
+
+    def fn(a):
+        idx = [builtins_slice(None)] * a.ndim
+        for ax, s, e, d in zip(axes_, st_, en_, sd_):
+            idx[ax] = builtins_slice(s, e, d)
+        return a[tuple(idx)]
+    return run_op("strided_slice", fn, [x])
+
+
+import builtins as _builtins  # noqa: E402
+
+builtins_slice = _builtins.slice
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shp = _ints(shape)
+    offs = _ints(offsets) if offsets is not None else [0] * len(shp)
+
+    def fn(a):
+        out = a
+        for ax, (off, sz) in enumerate(zip(offs, shp)):
+            sz2 = a.shape[ax] - off if sz == -1 else sz
+            out = jax.lax.slice_in_dim(out, off, off + sz2, axis=ax)
+        return out
+    return run_op("crop", fn, [x])
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    a = np.asarray(unwrap(x))
+    out = np.lib.stride_tricks.as_strided(
+        a.reshape(-1)[offset:], shape=tuple(shape),
+        strides=tuple(s * a.itemsize for s in stride))
+    return wrap(jnp.asarray(out))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return wrap(unwrap(x).view(dtype_mod.dtype(shape_or_dtype).np_dtype))
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def unfold(x, axis, size, step, name=None):
+    def fn(a):
+        dim = a.shape[axis]
+        n = (dim - size) // step + 1
+        idx = jnp.arange(n)[:, None] * step + jnp.arange(size)[None, :]
+        out = jnp.take(a, idx.reshape(-1), axis=axis)
+        new_shape = (a.shape[:axis] + (n, size) + a.shape[axis + 1:])
+        out = out.reshape(new_shape)
+        return jnp.moveaxis(out, axis + 1, -1)
+    return run_op("unfold", fn, [x])
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    def fn(a, *r):
+        rep = r[0] if r else repeats
+        return jnp.repeat(a, rep, axis=axis,
+                          total_repeat_length=None if not r else None)
+    if isinstance(repeats, Tensor):
+        a = unwrap(x)
+        rep = np.asarray(unwrap(repeats))
+        return wrap(jnp.asarray(np.repeat(np.asarray(a), rep, axis=axis)))
+    return run_op("repeat_interleave", fn, [x])
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def fn(a):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo, hi = shard_id * shard_size, (shard_id + 1) * shard_size
+        in_shard = (a >= lo) & (a < hi)
+        return jnp.where(in_shard, a - lo, ignore_value)
+    return run_op_nodiff("shard_index", fn, [input])
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    a = np.asarray(unwrap(x))
+    out = np.unique(a, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(out, tuple):
+        return wrap(jnp.asarray(out))
+    return tuple(wrap(jnp.asarray(o)) for o in out)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    a = np.asarray(unwrap(x))
+    if axis is None:
+        a = a.reshape(-1)
+        ax = 0
+    else:
+        ax = axis
+    if a.size == 0:
+        outs = [wrap(jnp.asarray(a))]
+    else:
+        sl = [np.s_[:]] * a.ndim
+        sl[ax] = np.s_[1:]
+        sl0 = [np.s_[:]] * a.ndim
+        sl0[ax] = np.s_[:-1]
+        neq = np.any(a[tuple(sl)] != a[tuple(sl0)],
+                     axis=tuple(i for i in range(a.ndim) if i != ax)) \
+            if a.ndim > 1 else a[1:] != a[:-1]
+        keep = np.concatenate([[True], neq])
+        idx = np.nonzero(keep)[0]
+        taken = np.take(a, idx, axis=ax)
+        outs = [wrap(jnp.asarray(taken))]
+        if return_inverse:
+            inv = np.cumsum(keep) - 1
+            outs.append(wrap(jnp.asarray(inv.astype(np.int64))))
+        if return_counts:
+            counts = np.diff(np.concatenate([idx, [a.shape[ax]]]))
+            outs.append(wrap(jnp.asarray(counts.astype(np.int64))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None):
+    from ..core import random as random_mod
+    a, p = unwrap(x), unwrap(ps)
+    key = jax.random.key(seed) if seed else random_mod.next_key()
+    sorted_idx = jnp.argsort(-a, axis=-1)
+    sorted_probs = jnp.take_along_axis(a, sorted_idx, axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    keep = cum - sorted_probs <= p[..., None]
+    masked = jnp.where(keep, sorted_probs, 0.0)
+    masked = masked / jnp.sum(masked, axis=-1, keepdims=True)
+    choice = jax.random.categorical(key, jnp.log(masked + 1e-12), axis=-1)
+    picked = jnp.take_along_axis(sorted_idx, choice[..., None], axis=-1)
+    val = jnp.take_along_axis(a, picked, axis=-1)
+    return wrap(val), wrap(picked.astype(np.int64))
+
+
+def numel(x, name=None):
+    return wrap(jnp.asarray(int(np.prod(unwrap(x).shape)), dtype=jnp.int64))
+
+
+def rank(x):
+    return wrap(jnp.asarray(unwrap(x).ndim, dtype=jnp.int32))
+
+
+def shape(x):
+    return wrap(jnp.asarray(unwrap(x).shape, dtype=jnp.int32))
+
+
+def is_empty(x, name=None):
+    return wrap(jnp.asarray(unwrap(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_complex(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.complexfloating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.integer)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.floating)
+
+
+def real(x, name=None):
+    return run_op("real", jnp.real, [x])
+
+
+def imag(x, name=None):
+    return run_op("imag", jnp.imag, [x])
+
+
+def as_complex(x, name=None):
+    def fn(a):
+        return jax.lax.complex(a[..., 0], a[..., 1])
+    return run_op("as_complex", fn, [x])
+
+
+def as_real(x, name=None):
+    def fn(a):
+        return jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1)
+    return run_op("as_real", fn, [x])
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = ax.tolist()
+    return run_op("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax),
+                  [x, y])
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [run_op("atleast_1d", jnp.atleast_1d, [x]) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [run_op("atleast_2d", jnp.atleast_2d, [x]) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [run_op("atleast_3d", jnp.atleast_3d, [x]) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs

@@ -1,0 +1,543 @@
+"""Math ops (reference: python/paddle/tensor/math.py, ops.yaml entries)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import run_op, run_op_nodiff, unwrap, wrap
+from ..core.tensor import Tensor
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+# ---- binary elementwise ----------------------------------------------------
+
+def _binary(name, fn):
+    def op(x, y, name=None):
+        return run_op(name, fn, [x, y])
+    op.__name__ = name
+    return op
+
+
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", lambda x, y: jnp.true_divide(x, y))
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+remainder = _binary("remainder", jnp.remainder)
+mod = remainder
+floor_mod = remainder
+fmod = _binary("fmod", jnp.fmod)
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+pow_ = _binary("pow", jnp.power)
+atan2 = _binary("atan2", jnp.arctan2)
+hypot = _binary("hypot", jnp.hypot)
+copysign = _binary("copysign", jnp.copysign)
+nextafter = _binary("nextafter", jnp.nextafter)
+logaddexp = _binary("logaddexp", jnp.logaddexp)
+heaviside = _binary("heaviside", jnp.heaviside)
+gcd = _binary("gcd", jnp.gcd)
+lcm = _binary("lcm", jnp.lcm)
+ldexp = _binary("ldexp", jnp.ldexp)
+
+
+def pow(x, y, name=None):  # noqa: A001 (paddle name)
+    return pow_(x, y)
+
+
+def divide_int_to_float(x, y):
+    return divide(x, y)
+
+
+def multiply_no_nan(x, y, name=None):
+    return run_op("multiply_no_nan",
+                  lambda a, b: jnp.where(b == 0, 0.0, a * b), [x, y])
+
+
+# ---- unary elementwise -----------------------------------------------------
+
+def _unary(name, fn):
+    def op(x, name=None):
+        return run_op(name, fn, [x])
+    op.__name__ = name
+    return op
+
+
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+abs = _unary("abs", jnp.abs)  # noqa: A001
+absolute = abs
+neg = _unary("neg", jnp.negative)
+negative = neg
+sign = _unary("sign", jnp.sign)
+sgn = sign
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+arcsin, arccos, arctan = asin, acos, atan
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)  # noqa: A001
+trunc = _unary("trunc", jnp.trunc)
+frac = _unary("frac", lambda a: a - jnp.trunc(a))
+square = _unary("square", jnp.square)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+gammaln = lgamma
+i0 = _unary("i0", jax.scipy.special.i0)
+i0e = _unary("i0e", jax.scipy.special.i0e)
+i1 = _unary("i1", jax.scipy.special.i1)
+i1e = _unary("i1e", jax.scipy.special.i1e)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+exponent = _unary("exponent", lambda a: jnp.frexp(a)[1].astype(a.dtype))
+
+
+def logit(x, eps=None, name=None):
+    def fn(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(a / (1.0 - a))
+    return run_op("logit", fn, [x])
+
+
+def polygamma(x, n, name=None):
+    return run_op("polygamma",
+                  lambda a: jax.scipy.special.polygamma(n, a), [x])
+
+
+def gammainc(x, y, name=None):
+    return run_op("gammainc", jax.scipy.special.gammainc, [x, y])
+
+
+def gammaincc(x, y, name=None):
+    return run_op("gammaincc", jax.scipy.special.gammaincc, [x, y])
+
+
+def isnan(x, name=None):
+    return run_op_nodiff("isnan", jnp.isnan, [x])
+
+
+def isinf(x, name=None):
+    return run_op_nodiff("isinf", jnp.isinf, [x])
+
+
+def isfinite(x, name=None):
+    return run_op_nodiff("isfinite", jnp.isfinite, [x])
+
+
+def isreal(x, name=None):
+    return run_op_nodiff("isreal", jnp.isreal, [x])
+
+
+def isneginf(x, name=None):
+    return run_op_nodiff("isneginf", jnp.isneginf, [x])
+
+
+def isposinf(x, name=None):
+    return run_op_nodiff("isposinf", jnp.isposinf, [x])
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return run_op("nan_to_num",
+                  lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf,
+                                           neginf=neginf), [x])
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = unwrap(min) if min is not None else None
+    hi = unwrap(max) if max is not None else None
+    return run_op("clip", lambda a: jnp.clip(a, lo, hi), [x])
+
+
+def lerp(x, y, weight, name=None):
+    return run_op("lerp", lambda a, b, w: a + w * (b - a), [x, y, weight])
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return run_op("stanh",
+                  lambda a: scale_b * jnp.tanh(scale_a * a), [x])
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def fn(a, s, b):
+        out = a * s + b if bias_after_scale else (a + b) * s
+        return out.astype(a.dtype)
+    return run_op("scale", fn, [x, unwrap(scale), unwrap(bias)])
+
+
+def increment(x, value=1.0, name=None):
+    x._data = x._data + jnp.asarray(value, x._data.dtype)
+    return x
+
+
+# ---- reductions ------------------------------------------------------------
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    def fn(a):
+        out = jnp.sum(a, axis=_axis(axis), keepdims=keepdim)
+        if dtype is not None:
+            from ..core import dtype as dtype_mod
+            out = out.astype(dtype_mod.dtype(dtype).np_dtype)
+        elif jnp.issubdtype(a.dtype, jnp.bool_):
+            out = out.astype(jnp.int64)
+        return out
+    return run_op("sum", fn, [x])
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return run_op("nansum",
+                  lambda a: jnp.nansum(a, axis=_axis(axis), keepdims=keepdim),
+                  [x])
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return run_op("mean",
+                  lambda a: jnp.mean(a, axis=_axis(axis), keepdims=keepdim),
+                  [x])
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return run_op("nanmean",
+                  lambda a: jnp.nanmean(a, axis=_axis(axis), keepdims=keepdim),
+                  [x])
+
+
+def max(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return run_op("max",
+                  lambda a: jnp.max(a, axis=_axis(axis), keepdims=keepdim),
+                  [x])
+
+
+def min(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return run_op("min",
+                  lambda a: jnp.min(a, axis=_axis(axis), keepdims=keepdim),
+                  [x])
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim, name)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim, name)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return run_op("prod",
+                  lambda a: jnp.prod(a, axis=_axis(axis), keepdims=keepdim),
+                  [x])
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return run_op("logsumexp",
+                  lambda a: jax.scipy.special.logsumexp(
+                      a, axis=_axis(axis), keepdims=keepdim), [x])
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def fn(a):
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.cumsum(a)
+        return jnp.cumsum(a, axis=_axis(axis))
+    return run_op("cumsum", fn, [x])
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return run_op("cumprod", lambda a: jnp.cumprod(a, axis=_axis(dim)), [x])
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    a = unwrap(x)
+    ax = _axis(axis) if axis is not None else 0
+    if axis is None:
+        a = a.reshape(-1)
+    vals = jax.lax.associative_scan(jnp.maximum, a, axis=ax)
+    idx = jnp.argmax(
+        (a[..., None] if False else a) == vals, axis=ax) if False else None
+    out_vals = run_op("cummax",
+                      lambda b: jax.lax.associative_scan(
+                          jnp.maximum,
+                          b.reshape(-1) if axis is None else b, axis=ax), [x])
+    indices = _cum_arg(a, vals, ax)
+    return out_vals, wrap(indices)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    a = unwrap(x)
+    ax = _axis(axis) if axis is not None else 0
+    if axis is None:
+        a = a.reshape(-1)
+    vals = jax.lax.associative_scan(jnp.minimum, a, axis=ax)
+    out_vals = run_op("cummin",
+                      lambda b: jax.lax.associative_scan(
+                          jnp.minimum,
+                          b.reshape(-1) if axis is None else b, axis=ax), [x])
+    indices = _cum_arg(a, vals, ax)
+    return out_vals, wrap(indices)
+
+
+def _cum_arg(a, vals, ax):
+    n = a.shape[ax]
+    pos = jnp.arange(n).reshape([-1 if i == ax else 1
+                                 for i in range(a.ndim)])
+    hit = (a == vals)
+    return jnp.max(jnp.where(hit, pos, -1), axis=ax, keepdims=False)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def fn(a):
+        if axis is None:
+            b = a.reshape(-1)
+            ax = 0
+        else:
+            b, ax = a, _axis(axis)
+        m = jax.lax.associative_scan(jnp.maximum, b, axis=ax)
+        return m + jnp.log(jnp.cumsum(jnp.exp(b - m), axis=ax))
+    # numerically-safe version via logaddexp scan
+    def fn2(a):
+        if axis is None:
+            b = a.reshape(-1)
+            ax = 0
+        else:
+            b, ax = a, _axis(axis)
+        return jax.lax.associative_scan(jnp.logaddexp, b, axis=ax)
+    return run_op("logcumsumexp", fn2, [x])
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return run_op("trace",
+                  lambda a: jnp.trace(a, offset=offset, axis1=axis1,
+                                      axis2=axis2), [x])
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return run_op("diagonal",
+                  lambda a: jnp.diagonal(a, offset=offset, axis1=axis1,
+                                         axis2=axis2), [x])
+
+
+def kron(x, y, name=None):
+    return run_op("kron", jnp.kron, [x, y])
+
+
+def inner(x, y, name=None):
+    return run_op("inner", jnp.inner, [x, y])
+
+
+def outer(x, y, name=None):
+    return run_op("outer", jnp.outer, [x, y])
+
+
+def dot(x, y, name=None):
+    def fn(a, b):
+        if a.ndim == 2:
+            return jnp.sum(a * b, axis=-1)
+        return jnp.dot(a, b)
+    return run_op("dot", fn, [x, y])
+
+
+def cross(x, y, axis=9, name=None):
+    def fn(a, b):
+        ax = axis
+        if ax == 9:
+            ax = next((i for i, s in enumerate(a.shape) if s == 3), -1)
+        return jnp.cross(a, b, axis=ax)
+    return run_op("cross", fn, [x, y])
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return run_op("addmm",
+                  lambda i, a, b: beta * i + alpha * (a @ b), [input, x, y])
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return run_op("matmul", fn, [x, y])
+
+
+def mm(input, mat2, name=None):
+    return run_op("matmul", jnp.matmul, [input, mat2])
+
+
+def bmm(x, y, name=None):
+    return run_op("bmm", jnp.matmul, [x, y])
+
+
+def mv(x, vec, name=None):
+    return run_op("mv", jnp.matmul, [x, vec])
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return run_op_nodiff(
+        "count_nonzero",
+        lambda a: jnp.count_nonzero(a, axis=_axis(axis), keepdims=keepdim)
+        .astype(jnp.int64), [x])
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    tensors = [x]
+    def fn(a, *extra):
+        pre = extra[0] if prepend is not None else None
+        app = (extra[1] if prepend is not None else extra[0]) \
+            if append is not None else None
+        return jnp.diff(a, n=n, axis=axis, prepend=pre, append=app)
+    if prepend is not None:
+        tensors.append(prepend)
+    if append is not None:
+        tensors.append(append)
+    return run_op("diff", fn, tensors)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return run_op("trapezoid",
+                      lambda yy, xx: jax.scipy.integrate.trapezoid(
+                          yy, xx, axis=axis), [y, x])
+    return run_op("trapezoid",
+                  lambda yy: jax.scipy.integrate.trapezoid(
+                      yy, dx=dx or 1.0, axis=axis), [y])
+
+
+cumulative_trapezoid = None  # filled below
+
+
+def _cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    dx = 1.0 if dx is None else dx
+
+    def fn(yy, *rest):
+        d = jnp.diff(rest[0], axis=axis) if rest else dx
+        s1 = jax.lax.slice_in_dim(yy, 1, yy.shape[axis], axis=axis)
+        s0 = jax.lax.slice_in_dim(yy, 0, yy.shape[axis] - 1, axis=axis)
+        return jnp.cumsum((s0 + s1) * d / 2.0, axis=axis)
+    return run_op("cumulative_trapezoid", fn, [y] + ([x] if x is not None
+                                                     else []))
+
+
+cumulative_trapezoid = _cumulative_trapezoid
+
+
+def take(x, index, mode="raise", name=None):
+    def fn(a, idx):
+        flat = a.reshape(-1)
+        n = flat.shape[0]
+        if mode == "wrap":
+            idx = idx % n
+        elif mode == "clip":
+            idx = jnp.clip(idx, 0, n - 1)
+        else:
+            idx = jnp.where(idx < 0, idx + n, idx)
+        return flat[idx]
+    return run_op("take", fn, [x, index])
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def fn(a):
+        dims = [i for i in range(a.ndim) if i != axis]
+        norms = jnp.sum(jnp.abs(a) ** p, axis=dims, keepdims=True) ** (1. / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return a * factor
+    return run_op("renorm", fn, [x])
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return run_op("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), [x])
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    a = unwrap(input)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (None, None)
+    hist, _ = jnp.histogram(a, bins=bins,
+                            range=(lo, hi) if lo is not None else None)
+    return wrap(hist.astype(jnp.int64))
+
+
+def histogramdd(sample, bins=10, ranges=None, weights=None, density=False):
+    a = unwrap(sample)
+    hist, edges = jnp.histogramdd(a, bins=bins, range=ranges,
+                                  weights=unwrap(weights), density=density)
+    return wrap(hist), [wrap(e) for e in edges]
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    a = unwrap(x)
+    w = unwrap(weights) if weights is not None else None
+    return wrap(jnp.bincount(a, w, minlength=minlength))
+
+
+def frexp(x, name=None):
+    a = unwrap(x)
+    m, e = jnp.frexp(a)
+    return wrap(m), wrap(e.astype(jnp.int32))
+
+
+def signbit(x, name=None):
+    return run_op_nodiff("signbit", jnp.signbit, [x])
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+    a = unwrap(x)
+    n = a.shape[0]
+    it = (itertools.combinations_with_replacement(range(n), r)
+          if with_replacement else itertools.combinations(range(n), r))
+    idx = np.array(list(it))
+    if idx.size == 0:
+        return wrap(jnp.zeros((0, r), a.dtype))
+    return wrap(a[idx])
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return run_op("vander",
+                  lambda a: jnp.vander(a, N=n, increasing=increasing), [x])
+
+
+def log_normalize(x, axis=-1):
+    return run_op("log_normalize",
+                  lambda a: a - jax.scipy.special.logsumexp(
+                      a, axis=axis, keepdims=True), [x])

@@ -1,0 +1,132 @@
+"""Search/sort ops (reference: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import run_op, run_op_nodiff, unwrap, wrap
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def fn(a):
+        if axis is None:
+            out = jnp.argmax(a.reshape(-1))
+            return out.reshape((1,) * a.ndim) if keepdim else out
+        out = jnp.argmax(a, axis=axis, keepdims=keepdim)
+        return out
+    out = run_op_nodiff("argmax", fn, [x])
+    return out.astype(dtype) if dtype != "int64" else out
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def fn(a):
+        if axis is None:
+            out = jnp.argmin(a.reshape(-1))
+            return out.reshape((1,) * a.ndim) if keepdim else out
+        return jnp.argmin(a, axis=axis, keepdims=keepdim)
+    out = run_op_nodiff("argmin", fn, [x])
+    return out.astype(dtype) if dtype != "int64" else out
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(a):
+        idx = jnp.argsort(a, axis=axis, stable=stable,
+                          descending=descending)
+        return idx.astype(jnp.int64)
+    return run_op_nodiff("argsort", fn, [x])
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(a):
+        out = jnp.sort(a, axis=axis, stable=stable, descending=descending)
+        return out
+    return run_op("sort", fn, [x])
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):  # noqa: A001
+    kk = int(unwrap(k)) if not isinstance(k, int) else k
+
+    def fn(a):
+        ax = axis if axis is not None else a.ndim - 1
+        moved = jnp.moveaxis(a, ax, -1)
+        src = moved if largest else -moved
+        vals, idx = jax.lax.top_k(src, kk)
+        if not largest:
+            vals = -vals
+        vals = jnp.moveaxis(vals, -1, ax)
+        idx = jnp.moveaxis(idx, -1, ax)
+        return vals, idx.astype(jnp.int64)
+    vals, idx = run_op("topk", fn, [x])
+    return vals, idx
+
+
+import jax  # noqa: E402
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def fn(a):
+        srt = jnp.sort(a, axis=axis)
+        idx = jnp.argsort(a, axis=axis, stable=True)
+        vals = jnp.take(srt, k - 1, axis=axis)
+        inds = jnp.take(idx, k - 1, axis=axis)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            inds = jnp.expand_dims(inds, axis)
+        return vals, inds.astype(jnp.int64)
+    return run_op("kthvalue", fn, [x])
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    a = np.asarray(unwrap(x))
+    moved = np.moveaxis(a, axis, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals, inds = [], []
+    for row in flat:
+        uniq, counts = np.unique(row, return_counts=True)
+        # ties resolve to the largest value, matching the reference kernel
+        best = uniq[len(counts) - 1 - np.argmax(counts[::-1])]
+        vals.append(best)
+        inds.append(np.max(np.nonzero(row == best)[0]))
+    vals = np.array(vals).reshape(moved.shape[:-1])
+    inds = np.array(inds).reshape(moved.shape[:-1])
+    if keepdim:
+        vals = np.expand_dims(vals, axis)
+        inds = np.expand_dims(inds, axis)
+    return wrap(jnp.asarray(vals)), wrap(jnp.asarray(inds.astype(np.int64)))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    def fn(s, v):
+        side = "right" if right else "left"
+        if s.ndim == 1:
+            out = jnp.searchsorted(s, v, side=side)
+        else:
+            out = jnp.stack([jnp.searchsorted(s[i], v[i], side=side)
+                             for i in range(s.shape[0])])
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return run_op_nodiff("searchsorted", fn, [sorted_sequence, values])
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right, name)
+
+
+def index_sample(x, index):
+    from .manipulation import index_sample as _is
+    return _is(x, index)
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+    return _ms(x, mask, name)
+
+
+def where(condition, x=None, y=None, name=None):
+    from .manipulation import where as _w
+    return _w(condition, x, y, name)
+
+
+def nonzero(x, as_tuple=False):
+    from .manipulation import nonzero as _nz
+    return _nz(x, as_tuple)

@@ -1,0 +1,82 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import run_op, unwrap, wrap
+
+
+def _axis(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return axis
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return run_op("var",
+                  lambda a: jnp.var(a, axis=_axis(axis),
+                                    ddof=1 if unbiased else 0,
+                                    keepdims=keepdim), [x])
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return run_op("std",
+                  lambda a: jnp.std(a, axis=_axis(axis),
+                                    ddof=1 if unbiased else 0,
+                                    keepdims=keepdim), [x])
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def fn(a):
+        if mode == "avg":
+            return jnp.median(a, axis=_axis(axis), keepdims=keepdim)
+        # 'min' mode: lower of the two middle values
+        ax = _axis(axis)
+        if ax is None:
+            flat = jnp.sort(a.reshape(-1))
+            out = flat[(flat.shape[0] - 1) // 2]
+            return out.reshape((1,) * a.ndim) if keepdim else out
+        srt = jnp.sort(a, axis=ax)
+        idx = (a.shape[ax] - 1) // 2
+        out = jnp.take(srt, idx, axis=ax)
+        return jnp.expand_dims(out, ax) if keepdim else out
+    return run_op("median", fn, [x])
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return run_op("nanmedian",
+                  lambda a: jnp.nanmedian(a, axis=_axis(axis),
+                                          keepdims=keepdim), [x])
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
+             name=None):
+    qv = unwrap(q)
+    return run_op("quantile",
+                  lambda a: jnp.quantile(a, jnp.asarray(qv), axis=_axis(axis),
+                                         keepdims=keepdim,
+                                         method=interpolation), [x])
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    qv = unwrap(q)
+    return run_op("nanquantile",
+                  lambda a: jnp.nanquantile(a, jnp.asarray(qv),
+                                            axis=_axis(axis),
+                                            keepdims=keepdim,
+                                            method=interpolation), [x])
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return run_op("corrcoef",
+                  lambda a: jnp.corrcoef(a, rowvar=rowvar), [x])
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return run_op("cov",
+                  lambda a: jnp.cov(a, rowvar=rowvar,
+                                    ddof=1 if ddof else 0,
+                                    fweights=unwrap(fweights),
+                                    aweights=unwrap(aweights)), [x])
