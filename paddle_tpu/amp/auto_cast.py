@@ -73,9 +73,21 @@ def _low_dtype():
     return jnp.bfloat16 if _amp_state.dtype == "bfloat16" else jnp.float16
 
 
+# dtype-preserving / bookkeeping ops that must never be auto-cast — `cast`
+# in particular would recurse: autocast_inputs -> cast -> run_op("cast") ->
+# autocast_inputs -> ...
+_AMP_EXEMPT = {
+    "cast", "assign", "getitem", "setitem", "clone", "reshape", "transpose",
+    "concat", "stack", "split", "squeeze", "unsqueeze", "expand", "tile",
+    "shape", "numel",
+}
+
+
 def autocast_inputs(op_name, tensor_args):
     """Called from core.dispatch.run_op when AMP is active."""
     from ..core.tensor import Tensor
+    if op_name in _AMP_EXEMPT:
+        return tensor_args
     st = _amp_state
     white = (WHITE_LIST | st.custom_white) - st.custom_black
     black = (BLACK_LIST | st.custom_black) - st.custom_white

@@ -22,12 +22,12 @@ class PyLayerContext:
     def save_for_backward(self, *tensors):
         self._saved = tensors
 
-    @property
     def saved_tensor(self):
+        """paddle API: a method, not a property
+        (python/paddle/autograd/py_layer.py PyLayerContext.saved_tensor)."""
         return self._saved
 
-    def saved_tensors(self):
-        return self._saved
+    saved_tensors = saved_tensor
 
     def set_materialize_grads(self, value: bool):
         self.materialize_grads = bool(value)

@@ -26,6 +26,22 @@ def _is_inexact(arr) -> bool:
     return jnp.issubdtype(arr.dtype, jnp.inexact)
 
 
+_amp = None
+
+
+def _amp_module():
+    """Lazily import amp.auto_cast once (circular at module load).
+
+    Must go through importlib: amp/__init__.py re-exports the auto_cast
+    *function* over the submodule attribute, so `from ..amp import auto_cast`
+    would bind the function (the round-1 crash on every op call)."""
+    global _amp
+    if _amp is None:
+        import importlib
+        _amp = importlib.import_module("paddle_tpu.amp.auto_cast")
+    return _amp
+
+
 def unwrap(x):
     """Tensor -> jax array; pass through scalars/arrays/None."""
     if isinstance(x, Tensor):
@@ -55,9 +71,9 @@ def run_op(name: str, fn: Callable, tensor_args: Sequence[Any], **attrs):
     floating point and not stop_gradient). attrs: static keyword attributes.
     Returns Tensor or tuple of Tensors mirroring fn's output structure.
     """
-    from ..amp import auto_cast as amp_mod
-    if amp_mod._amp_state.enabled:
-        tensor_args = amp_mod.autocast_inputs(name, tensor_args)
+    amp = _amp_module()
+    if amp._amp_state.enabled:
+        tensor_args = amp.autocast_inputs(name, tensor_args)
 
     arrays = [unwrap(x) for x in tensor_args]
 

@@ -196,9 +196,17 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
                 if cnt <= 0 and id(meta.node) not in seen_ready:
                     seen_ready.add(id(meta.node))
                     ready.append(meta.node)
+        # Buffers always reset so a later pass (retain_graph=True) seeds
+        # from zero rather than accumulating stale cotangents.
+        node.grad_buffer = [None] * len(node.out_avals)
         if not retain_graph:
+            # Drop every strong ref the node holds (vjp residuals, input
+            # tensors) so activation memory dies with backward — the
+            # reference releases TensorWrappers the same way
+            # (paddle/fluid/eager/tensor_wrapper.h).
             node.vjp_fn = _used_vjp
-            node.grad_buffer = [None] * len(node.out_avals)
+            node.input_tensors = [None] * len(node.input_tensors)
+            node.input_metas = [None] * len(node.input_metas)
 
 
 def _used_vjp(*_a, **_k):

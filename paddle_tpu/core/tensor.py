@@ -209,7 +209,9 @@ class Tensor:
                 t = Tensor._from_array(jax.device_put(t._data, a.jax_device()),
                                        t.stop_gradient, t.name)
             elif isinstance(a, str):
-                p = place_mod.set_device.__wrapped__(a) if False else _parse_place(a)
+                # device strings: "cpu", "tpu:0"; "gpu:N"/"cuda:N" map to the
+                # TPU chip for reference-script compatibility (_parse_place).
+                p = _parse_place(a)
                 t = Tensor._from_array(jax.device_put(t._data, p.jax_device()),
                                        t.stop_gradient, t.name)
         return t

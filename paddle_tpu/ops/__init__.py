@@ -23,15 +23,24 @@ _METHOD_EXCLUDE = {
 
 
 def _patch_methods():
+    # Names defined on the Tensor class itself (properties like `shape`,
+    # methods like `tolist`/`numpy`) must never be clobbered by op functions
+    # (ADVICE r1: manipulation.shape over the property broke repr/uniform_).
+    protected = set(vars(Tensor))
     for mod in reversed(_MODULES):
         for name in dir(mod):
-            if name.startswith("_") or name in _METHOD_EXCLUDE:
+            if name.startswith("_") or name in _METHOD_EXCLUDE \
+                    or name in protected:
                 continue
             fn = getattr(mod, name)
-            if not callable(fn) or isinstance(fn, type):
+            if not callable(fn) or isinstance(fn, type) \
+                    or not getattr(fn, "__module__", "").startswith(
+                        "paddle_tpu"):
                 continue
             setattr(Tensor, name, fn)
     # fix names that collide with builtins / properties
+    # paddle convention: Tensor.numel() returns a 0-D int64 Tensor, not int
+    Tensor.numel = manipulation.numel
     Tensor.pow = math.pow_
     Tensor.add = math.add
     Tensor.subtract = math.subtract

@@ -7,7 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.dispatch import run_op, run_op_nodiff, unwrap, wrap
-from .math import matmul, mm, bmm, mv, dot  # noqa: F401  (re-export)
+from .math import matmul, mm, bmm, mv, dot, conj  # noqa: F401  (re-export)
 
 
 def norm(x, p=None, axis=None, keepdim=False, name=None):
@@ -143,10 +143,11 @@ def qr(x, mode="reduced", name=None):
 
 
 def svd(x, full_matrices=False, name=None):
-    def fn(a):
-        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
-        return u, s, jnp.swapaxes(vh, -1, -2).conj()
-    return run_op("svd", fn, [x])
+    # paddle returns (U, S, VH) with X = U @ diag(S) @ VH
+    # (/root/reference/python/paddle/tensor/linalg.py:2869) — same as jnp.
+    return run_op("svd",
+                  lambda a: jnp.linalg.svd(a, full_matrices=full_matrices),
+                  [x])
 
 
 def svdvals(x, name=None):
@@ -155,7 +156,9 @@ def svdvals(x, name=None):
 
 
 def svd_lowrank(x, q=6, niter=2, M=None, name=None):
-    u, s, v = svd(x)
+    u, s, vh = svd(x)
+    # paddle svd_lowrank returns V, not VH (conjugate for complex inputs)
+    v = conj(matrix_transpose(vh))
     from .manipulation import slice as slice_op
     k = min(q, unwrap(x).shape[-1], unwrap(x).shape[-2])
     return (slice_op(u, [u.ndim - 1], [0], [k]),

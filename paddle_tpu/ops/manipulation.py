@@ -152,6 +152,10 @@ def split(x, num_or_sections, axis=0, name=None):
     a_shape = unwrap(x).shape
     dim = a_shape[ax]
     if isinstance(num_or_sections, int):
+        if num_or_sections <= 0 or dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: axis dim {dim} is not divisible by "
+                f"num_or_sections {num_or_sections}")
         sizes = [dim // num_or_sections] * num_or_sections
     else:
         sizes = _ints(num_or_sections)

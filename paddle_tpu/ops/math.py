@@ -283,42 +283,58 @@ def cumprod(x, dim=None, dtype=None, name=None):
     return run_op("cumprod", lambda a: jnp.cumprod(a, axis=_axis(dim)), [x])
 
 
+def _cum_with_indices(a, ax, idx_dtype, is_max):
+    """Running max/min with running argmax/argmin via one associative scan
+    over (value, index) pairs. Ties keep the LATER index, matching the
+    reference kernel's >= / <= comparators
+    (/root/reference/paddle/phi/kernels/cpu/cum_maxmin_kernel.cc:156,172)."""
+    n = a.shape[ax]
+    pos = jnp.arange(n, dtype=jnp.dtype(idx_dtype)).reshape(
+        [-1 if i == ax else 1 for i in range(a.ndim)])
+    pos = jnp.broadcast_to(pos, a.shape)
+
+    def combine(left, right):
+        lv, li = left
+        rv, ri = right
+        take_right = (rv >= lv) if is_max else (rv <= lv)
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            take_right = take_right | jnp.isnan(rv)
+        return (jnp.where(take_right, rv, lv),
+                jnp.where(take_right, ri, li))
+
+    return jax.lax.associative_scan(combine, (a, pos), axis=ax)
+
+
+def _check_cum_index_dtype(dtype):
+    if str(dtype) not in ("int32", "int64"):
+        raise ValueError(
+            f"cummax/cummin indices dtype must be int32 or int64, got {dtype}")
+
+
 def cummax(x, axis=None, dtype="int64", name=None):
-    a = unwrap(x)
+    _check_cum_index_dtype(dtype)
     ax = _axis(axis) if axis is not None else 0
-    if axis is None:
-        a = a.reshape(-1)
-    vals = jax.lax.associative_scan(jnp.maximum, a, axis=ax)
-    idx = jnp.argmax(
-        (a[..., None] if False else a) == vals, axis=ax) if False else None
-    out_vals = run_op("cummax",
-                      lambda b: jax.lax.associative_scan(
-                          jnp.maximum,
-                          b.reshape(-1) if axis is None else b, axis=ax), [x])
-    indices = _cum_arg(a, vals, ax)
-    return out_vals, wrap(indices)
+
+    def fn(a):
+        if axis is None:
+            a = a.reshape(-1)
+        return _cum_with_indices(a, ax, dtype, is_max=True)
+
+    vals, indices = run_op("cummax", fn, [x])
+    return vals, indices
 
 
 def cummin(x, axis=None, dtype="int64", name=None):
-    a = unwrap(x)
+    _check_cum_index_dtype(dtype)
     ax = _axis(axis) if axis is not None else 0
-    if axis is None:
-        a = a.reshape(-1)
-    vals = jax.lax.associative_scan(jnp.minimum, a, axis=ax)
-    out_vals = run_op("cummin",
-                      lambda b: jax.lax.associative_scan(
-                          jnp.minimum,
-                          b.reshape(-1) if axis is None else b, axis=ax), [x])
-    indices = _cum_arg(a, vals, ax)
-    return out_vals, wrap(indices)
 
+    def fn(a):
+        if axis is None:
+            a = a.reshape(-1)
+        return _cum_with_indices(a, ax, dtype, is_max=False)
 
-def _cum_arg(a, vals, ax):
-    n = a.shape[ax]
-    pos = jnp.arange(n).reshape([-1 if i == ax else 1
-                                 for i in range(a.ndim)])
-    hit = (a == vals)
-    return jnp.max(jnp.where(hit, pos, -1), axis=ax, keepdims=False)
+    vals, indices = run_op("cummin", fn, [x])
+    return vals, indices
 
 
 def logcumsumexp(x, axis=None, name=None):

@@ -1,0 +1,29 @@
+"""Test bootstrap: force the XLA CPU backend with 8 virtual devices.
+
+This is the JAX analog of the reference's `custom_cpu` fake-accelerator trick
+(/root/reference/test/custom_runtime — a CPU-backed plugin used to exercise
+the whole device + collective runtime with no hardware): every distributed
+test runs against a real 8-device `jax.sharding.Mesh`, just backed by host
+cores. Must run before jax is imported anywhere.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon site package re-pins JAX_PLATFORMS=axon; the explicit config
+# update wins over the env var and must happen before backend init.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,118 @@
+"""OpTest-style harness (model: /root/reference/test/legacy_test/op_test.py:418).
+
+`check_output`: run the paddle_tpu op on given numpy inputs and compare with a
+numpy reference function. `check_grad`: analytic gradients from the dygraph
+tape vs central-difference numeric gradients, the same analytic-vs-numeric
+check the reference does (op_test.py:3081).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def _to_tensors(np_inputs, stop_gradient=True):
+    out = []
+    for a in np_inputs:
+        if isinstance(a, np.ndarray):
+            out.append(paddle.to_tensor(a, stop_gradient=stop_gradient))
+        else:
+            out.append(a)
+    return out
+
+
+def _result_arrays(res):
+    if isinstance(res, Tensor):
+        return [res.numpy()]
+    if isinstance(res, (list, tuple)):
+        flat = []
+        for r in res:
+            flat.extend(_result_arrays(r))
+        return flat
+    return [np.asarray(res)]
+
+
+def check_output(op_fn, np_fn, np_inputs, attrs=None, rtol=1e-5, atol=1e-6):
+    attrs = attrs or {}
+    got = _result_arrays(op_fn(*_to_tensors(np_inputs), **attrs))
+    want = np_fn(*np_inputs, **attrs)
+    if not isinstance(want, (list, tuple)):
+        want = [want]
+    assert len(got) == len(want), f"output arity {len(got)} != {len(want)}"
+    for g, w in zip(got, want):
+        w = np.asarray(w)
+        assert g.shape == w.shape, f"shape {g.shape} != {w.shape}"
+        np.testing.assert_allclose(g, w, rtol=rtol, atol=atol)
+
+
+def check_grad(op_fn, np_inputs, attrs=None, eps=1e-4, rtol=2e-3, atol=1e-4,
+               grad_inputs=None):
+    """Compare tape gradients against numeric central differences.
+
+    Inputs are cast to float64 so the finite-difference reference is accurate.
+    The scalar objective is sum(op(x) * w) for a fixed random w, which makes
+    every output element contribute a distinct cotangent.
+    """
+    attrs = attrs or {}
+    np_inputs = [a.astype(np.float64) if isinstance(a, np.ndarray)
+                 and np.issubdtype(a.dtype, np.floating) else a
+                 for a in np_inputs]
+    diff_idx = grad_inputs if grad_inputs is not None else [
+        i for i, a in enumerate(np_inputs)
+        if isinstance(a, np.ndarray) and np.issubdtype(a.dtype, np.floating)]
+
+    rng = np.random.default_rng(7)
+    weights = None
+
+    def objective(arrays):
+        nonlocal weights
+        ts = []
+        for i, a in enumerate(arrays):
+            if isinstance(a, np.ndarray):
+                # pin the dtype: to_tensor's paddle default-dtype rule would
+                # silently downcast float64 -> float32 and ruin the
+                # finite-difference reference
+                dt = str(a.dtype) if np.issubdtype(a.dtype, np.floating) \
+                    else None
+                ts.append(paddle.to_tensor(a, dtype=dt,
+                                           stop_gradient=i not in diff_idx))
+            else:
+                ts.append(a)
+        res = op_fn(*ts, **attrs)
+        outs = res if isinstance(res, (list, tuple)) else [res]
+        outs = [o for o in outs if isinstance(o, Tensor)
+                and np.issubdtype(np.dtype(o.dtype.np_dtype), np.floating)]
+        if weights is None:
+            weights = [rng.standard_normal(o.shape) for o in outs]
+        total = None
+        for o, w in zip(outs, weights):
+            term = (o * paddle.to_tensor(w.astype(np.float64))).sum()
+            total = term if total is None else total + term
+        return total, ts
+
+    # analytic
+    loss, ts = objective(np_inputs)
+    loss.backward()
+    analytic = {i: ts[i].grad.numpy() for i in diff_idx}
+
+    # numeric
+    for i in diff_idx:
+        base = np_inputs[i]
+        num = np.zeros_like(base)
+        flat = base.reshape(-1)
+        nflat = num.reshape(-1)
+        for k in range(flat.size):
+            orig = flat[k]
+            flat[k] = orig + eps
+            with paddle.no_grad():
+                lp = float(objective(np_inputs)[0].numpy())
+            flat[k] = orig - eps
+            with paddle.no_grad():
+                lm = float(objective(np_inputs)[0].numpy())
+            flat[k] = orig
+            nflat[k] = (lp - lm) / (2 * eps)
+        np.testing.assert_allclose(
+            analytic[i], num, rtol=rtol, atol=atol,
+            err_msg=f"gradient mismatch for input {i}")

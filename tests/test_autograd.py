@@ -1,0 +1,126 @@
+"""Dygraph tape autograd semantics (reference: fluid/eager/backward.cc)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def a(*shape, seed=0):
+    return np.random.default_rng(seed).standard_normal(shape).astype(
+        np.float32)
+
+
+def test_simple_chain():
+    x = paddle.to_tensor(a(3, 4), stop_gradient=False)
+    y = (x * 2 + 1).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * np.ones((3, 4)))
+
+
+def test_broadcast_grad_reduces():
+    x = paddle.to_tensor(a(3, 4), stop_gradient=False)
+    b = paddle.to_tensor(a(4, seed=1), stop_gradient=False)
+    (x + b).sum().backward()
+    np.testing.assert_allclose(b.grad.numpy(), 3 * np.ones(4))
+
+
+def test_grad_accumulation_and_clear():
+    x = paddle.to_tensor(a(2, 2), stop_gradient=False)
+    (x * 3).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), 6 * np.ones((2, 2)))
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor(a(2, 2), stop_gradient=False)
+    y = paddle.to_tensor(a(2, 2), stop_gradient=True)
+    (x * y).sum().backward()
+    assert x.grad is not None and y.grad is None
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor(a(2, 2), stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+
+def test_double_backward_raises_without_retain():
+    x = paddle.to_tensor(a(2, 2), stop_gradient=False)
+    loss = (x * x).sum()
+    loss.backward()
+    with pytest.raises(RuntimeError):
+        loss.backward()
+
+
+def test_retain_graph():
+    x = paddle.to_tensor(a(2, 2), stop_gradient=False)
+    loss = (x * 2).sum()
+    loss.backward(retain_graph=True)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 4 * np.ones((2, 2)))
+
+
+def test_register_hook():
+    x = paddle.to_tensor(a(2, 2), stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    h = x.register_hook(hook)
+    (x * 1.0).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(x.grad.numpy(), 2 * np.ones((2, 2)))
+    h.remove()
+
+
+def test_diamond_graph():
+    x = paddle.to_tensor(a(3), stop_gradient=False)
+    y = x * 2
+    z = (y + y * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 + 8 * x.numpy(), rtol=1e-5)
+
+
+def test_functional_grad():
+    x = paddle.to_tensor(a(3), stop_gradient=False)
+    y = (x ** 2).sum()
+    (gx,) = paddle.autograd.functional.grad([y], [x])
+    np.testing.assert_allclose(gx.numpy(), 2 * x.numpy(), rtol=1e-5)
+
+
+def test_pylayer():
+    from paddle_tpu.autograd import PyLayer
+
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, g):
+            (x,) = ctx.saved_tensor()
+            return g * 2
+
+    x = paddle.to_tensor(a(3), stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * np.ones(3))
+
+
+def test_matmul_chain_grad_matches_jax():
+    import jax
+    import jax.numpy as jnp
+    w = a(4, 5, seed=3)
+    x = a(2, 4, seed=4)
+    tw = paddle.to_tensor(w, stop_gradient=False)
+    tx = paddle.to_tensor(x, stop_gradient=True)
+    loss = paddle.tanh(paddle.matmul(tx, tw)).sum()
+    loss.backward()
+    ref = jax.grad(lambda W: jnp.tanh(x @ W).sum())(w)
+    np.testing.assert_allclose(tw.grad.numpy(), np.asarray(ref), rtol=1e-5)

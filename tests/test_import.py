@@ -1,0 +1,28 @@
+"""The round-1 failure mode: the package never imported. Keep this first."""
+import numpy as np
+
+
+def test_import_and_basic_op():
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(np.ones((2, 3), np.float32))
+    y = (x + 1).numpy()
+    np.testing.assert_allclose(y, 2 * np.ones((2, 3)))
+
+
+def test_tensor_properties_not_clobbered():
+    import paddle_tpu as paddle
+    t = paddle.to_tensor(np.zeros((4, 5), np.float32))
+    assert t.shape == [4, 5]          # property, not a bound method
+    assert isinstance(t.tolist(), list)
+    assert t.numel() == 20
+    repr(t)                            # must not recurse
+
+
+def test_amp_state_reachable_from_dispatch():
+    import paddle_tpu as paddle
+    with paddle.amp.auto_cast(level="O1"):
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        y = paddle.matmul(x, x)
+        assert y.dtype.name == "bfloat16"
+    y = paddle.matmul(x, x)
+    assert y.dtype.name == "float32"

@@ -1,0 +1,178 @@
+"""Manipulation / creation / logic / search / stat / linalg op checks."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_output, check_grad
+
+
+def a(*shape, seed=0):
+    return np.random.default_rng(seed).standard_normal(shape).astype(
+        np.float32)
+
+
+def test_reshape_transpose_flatten():
+    x = a(2, 3, 4)
+    check_output(lambda t: paddle.reshape(t, [4, 6]),
+                 lambda v: v.reshape(4, 6), [x])
+    check_output(lambda t: paddle.transpose(t, [2, 0, 1]),
+                 lambda v: v.transpose(2, 0, 1), [x])
+    check_output(lambda t: paddle.flatten(t, 1, 2),
+                 lambda v: v.reshape(2, 12), [x])
+    check_grad(lambda t: paddle.reshape(t, [6, 4]), [x])
+
+
+def test_concat_stack_split():
+    x, y = a(2, 3, seed=1), a(2, 3, seed=2)
+    check_output(lambda s, t: paddle.concat([s, t], axis=0),
+                 lambda s, t: np.concatenate([s, t], 0), [x, y])
+    check_output(lambda s, t: paddle.stack([s, t], axis=1),
+                 lambda s, t: np.stack([s, t], 1), [x, y])
+    parts = paddle.split(paddle.to_tensor(a(6, 3)), 3, axis=0)
+    assert len(parts) == 3 and parts[0].shape == [2, 3]
+    parts = paddle.split(paddle.to_tensor(a(7, 3)), [2, 5], axis=0)
+    assert parts[1].shape == [5, 3]
+    with pytest.raises(ValueError):
+        paddle.split(paddle.to_tensor(a(7, 3)), 2, axis=0)
+
+
+def test_squeeze_expand_tile():
+    x = a(2, 1, 3)
+    check_output(lambda t: paddle.squeeze(t, axis=1),
+                 lambda v: v.squeeze(1), [x])
+    check_output(lambda t: paddle.unsqueeze(t, axis=0),
+                 lambda v: v[None], [x])
+    check_output(lambda t: paddle.expand(t, [2, 4, 3]),
+                 lambda v: np.broadcast_to(v, (2, 4, 3)), [x])
+    check_output(lambda t: paddle.tile(t, [2, 1, 1]),
+                 lambda v: np.tile(v, (2, 1, 1)), [x])
+
+
+def test_gather_scatter_where():
+    x = a(5, 3)
+    idx = np.array([0, 2, 4])
+    check_output(lambda t: paddle.gather(t, paddle.to_tensor(idx), axis=0),
+                 lambda v: v[idx], [x])
+    cond = a(3, 4, seed=5) > 0
+    u, v = a(3, 4, seed=6), a(3, 4, seed=7)
+    got = paddle.where(paddle.to_tensor(cond), paddle.to_tensor(u),
+                       paddle.to_tensor(v))
+    np.testing.assert_allclose(got.numpy(), np.where(cond, u, v))
+    check_grad(lambda s: paddle.gather(s, paddle.to_tensor(idx), axis=0), [x])
+
+
+def test_getitem_setitem_grad():
+    x = a(4, 5)
+    check_output(lambda t: t[1:3, ::2], lambda v: v[1:3, ::2], [x])
+    check_grad(lambda t: t[1:3], [x])
+    t = paddle.to_tensor(x.copy())
+    t[0] = 7.0
+    assert np.allclose(t.numpy()[0], 7.0)
+
+
+def test_pad_roll_flip():
+    x = a(2, 3)
+    # len(pad) == 2*ndim pads from the FIRST dimension (reference
+    # nn/functional/common.py:1690 pad_from_left_axis=True default)
+    check_output(lambda t: paddle.pad(t, [1, 1, 2, 0]),
+                 lambda v: np.pad(v, [(1, 1), (2, 0)]), [x])
+    check_output(lambda t: paddle.roll(t, 1, axis=0),
+                 lambda v: np.roll(v, 1, axis=0), [x])
+    check_output(lambda t: paddle.flip(t, axis=[1]),
+                 lambda v: v[:, ::-1], [x])
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).numpy().sum() == 0
+    assert paddle.ones([2, 3], dtype="int32").dtype.name == "int32"
+    np.testing.assert_array_equal(paddle.arange(0, 10, 2).numpy(),
+                                  np.arange(0, 10, 2))
+    np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                               np.linspace(0, 1, 5), rtol=1e-6)
+    e = paddle.eye(3).numpy()
+    np.testing.assert_array_equal(e, np.eye(3))
+    f = paddle.full([2, 2], 7.5)
+    assert f.numpy().flatten().tolist() == [7.5] * 4
+    t = paddle.tril(paddle.to_tensor(a(4, 4)))
+    assert np.allclose(np.triu(t.numpy(), 1), 0)
+    r = paddle.rand([100])
+    assert 0 <= r.numpy().min() and r.numpy().max() < 1
+    assert paddle.randperm(10).numpy().sum() == 45
+
+
+def test_logic_ops():
+    x, y = a(3, 4, seed=1), a(3, 4, seed=2)
+    check_output(paddle.equal, np.equal, [x, x.copy()])
+    check_output(paddle.not_equal, np.not_equal, [x, y])
+    check_output(paddle.less_than, np.less, [x, y])
+    check_output(paddle.greater_equal, np.greater_equal, [x, y])
+    bx = x > 0
+    by = y > 0
+    check_output(paddle.logical_and, np.logical_and, [bx, by])
+    check_output(paddle.logical_or, np.logical_or, [bx, by])
+    check_output(paddle.logical_not, np.logical_not, [bx])
+    assert bool(paddle.allclose(paddle.to_tensor(x),
+                                paddle.to_tensor(x + 1e-9)))
+    ix = np.array([[1, 2], [3, 4]], np.int32)
+    check_output(paddle.bitwise_and, np.bitwise_and, [ix, ix + 1])
+
+
+def test_search_ops():
+    x = a(3, 5, seed=9)
+    check_output(lambda t: paddle.argmax(t, axis=1),
+                 lambda v: np.argmax(v, 1), [x])
+    check_output(lambda t: paddle.argmin(t, axis=0),
+                 lambda v: np.argmin(v, 0), [x])
+    check_output(lambda t: paddle.argsort(t, axis=1),
+                 lambda v: np.argsort(v, 1), [x])
+    vals, idx = paddle.topk(paddle.to_tensor(x), 2, axis=1)
+    ref = np.sort(x, axis=1)[:, ::-1][:, :2]
+    np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+    s = paddle.sort(paddle.to_tensor(x), axis=1)
+    np.testing.assert_allclose(s.numpy(), np.sort(x, 1), rtol=1e-6)
+    nz = paddle.nonzero(paddle.to_tensor((x > 0).astype(np.float32)))
+    assert nz.numpy().shape[1] == 2
+
+
+def test_stat_ops():
+    x = a(4, 5, seed=11)
+    check_output(lambda t: paddle.var(t, axis=1),
+                 lambda v: np.var(v, 1, ddof=1), [x], rtol=1e-4)
+    check_output(lambda t: paddle.std(t, axis=0),
+                 lambda v: np.std(v, 0, ddof=1), [x], rtol=1e-4)
+    check_output(paddle.median, lambda v: np.median(v), [a(3, 5)])
+    check_output(lambda t: paddle.quantile(t, 0.5, axis=1),
+                 lambda v: np.quantile(v, 0.5, axis=1), [x], rtol=1e-4)
+
+
+def test_linalg_ops():
+    x = a(4, 4, seed=13)
+    spd = x @ x.T + 4 * np.eye(4, dtype=np.float32)
+    check_output(paddle.linalg.inv, np.linalg.inv, [spd], rtol=1e-3)
+    check_output(lambda t: paddle.linalg.det(t),
+                 lambda v: np.linalg.det(v), [spd], rtol=1e-3)
+    c = paddle.linalg.cholesky(paddle.to_tensor(spd))
+    np.testing.assert_allclose(c.numpy() @ c.numpy().T, spd, rtol=1e-3,
+                               atol=1e-3)
+    q, r = paddle.linalg.qr(paddle.to_tensor(x))
+    np.testing.assert_allclose(q.numpy() @ r.numpy(), x, rtol=1e-3, atol=1e-4)
+    u, s, vt = paddle.linalg.svd(paddle.to_tensor(x))
+    np.testing.assert_allclose(
+        (u.numpy() * s.numpy()) @ vt.numpy(), x, rtol=1e-3, atol=1e-4)
+    n = paddle.norm(paddle.to_tensor(x))
+    np.testing.assert_allclose(float(n.numpy()), np.linalg.norm(x), rtol=1e-5)
+    y = a(4, 3, seed=14)
+    sol = paddle.linalg.solve(paddle.to_tensor(spd), paddle.to_tensor(y))
+    np.testing.assert_allclose(spd @ sol.numpy(), y, rtol=1e-3, atol=1e-3)
+    check_output(paddle.einsum_np_compat
+                 if hasattr(paddle, 'einsum_np_compat') else
+                 (lambda s, t: paddle.einsum("ij,jk->ik", s, t)),
+                 lambda s, t: np.einsum("ij,jk->ik", s, t), [x, y])
+
+
+def test_cast_and_dtype_promotion():
+    x = paddle.to_tensor(np.array([1.5, 2.5], np.float32))
+    assert paddle.cast(x, "int32").numpy().dtype == np.int32
+    assert (x.astype("float64") + x).dtype.name == "float64"
+    i = paddle.to_tensor(np.array([1, 2], np.int32))
+    assert (x + i).dtype.name == "float32"

@@ -1,0 +1,187 @@
+"""Math op forward + gradient checks against NumPy references."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_output, check_grad
+
+
+def a(*shape, seed=0):
+    return np.random.default_rng(seed).standard_normal(shape).astype(
+        np.float32)
+
+
+def pos(*shape, seed=0):
+    return np.abs(a(*shape, seed=seed)) + 0.5
+
+
+UNARY = [
+    (paddle.exp, np.exp, a(3, 4)),
+    (paddle.log, np.log, pos(3, 4)),
+    (paddle.sqrt, np.sqrt, pos(3, 4)),
+    (paddle.rsqrt, lambda x: 1 / np.sqrt(x), pos(3, 4)),
+    (paddle.abs, np.abs, a(3, 4)),
+    (paddle.sin, np.sin, a(3, 4)),
+    (paddle.cos, np.cos, a(3, 4)),
+    (paddle.tan, np.tan, a(2, 3) * 0.3),
+    (paddle.tanh, np.tanh, a(3, 4)),
+    (paddle.sigmoid, lambda x: 1 / (1 + np.exp(-x)), a(3, 4)),
+    (paddle.floor, np.floor, a(3, 4)),
+    (paddle.ceil, np.ceil, a(3, 4)),
+    (paddle.round, np.round, a(3, 4)),
+    (paddle.square, np.square, a(3, 4)),
+    (paddle.reciprocal, lambda x: 1 / x, pos(3, 4)),
+    (paddle.neg, np.negative, a(3, 4)),
+    (paddle.sign, np.sign, a(3, 4)),
+    (paddle.log2, np.log2, pos(3, 4)),
+    (paddle.log10, np.log10, pos(3, 4)),
+    (paddle.log1p, np.log1p, pos(3, 4)),
+    (paddle.expm1, np.expm1, a(3, 4)),
+    (paddle.erf, None, a(3, 4)),
+    (paddle.asin, np.arcsin, a(3, 4) * 0.4),
+    (paddle.acos, np.arccos, a(3, 4) * 0.4),
+    (paddle.atan, np.arctan, a(3, 4)),
+    (paddle.sinh, np.sinh, a(3, 4)),
+    (paddle.cosh, np.cosh, a(3, 4)),
+    (paddle.trunc, np.trunc, a(3, 4)),
+]
+
+
+@pytest.mark.parametrize("op,ref,x", UNARY,
+                         ids=[u[0].__name__ for u in UNARY])
+def test_unary_forward(op, ref, x):
+    if ref is None:
+        import scipy.special as sp  # erf
+        ref = sp.erf
+    check_output(op, ref, [x])
+
+
+SMOOTH_UNARY = ["exp", "log", "sqrt", "tanh", "sigmoid", "square",
+                "reciprocal", "sin", "cos", "atan", "log1p", "expm1"]
+
+
+@pytest.mark.parametrize("name", SMOOTH_UNARY)
+def test_unary_grad(name):
+    op = getattr(paddle, name)
+    x = pos(2, 3) if name in ("log", "sqrt", "reciprocal", "log1p") else a(2, 3)
+    check_grad(op, [x])
+
+
+BINARY = [
+    (paddle.add, np.add),
+    (paddle.subtract, np.subtract),
+    (paddle.multiply, np.multiply),
+    (paddle.divide, np.divide),
+    (paddle.maximum, np.maximum),
+    (paddle.minimum, np.minimum),
+    (paddle.atan2, np.arctan2),
+    (paddle.fmax, np.fmax),
+    (paddle.fmin, np.fmin),
+    (paddle.logaddexp, np.logaddexp),
+]
+
+
+@pytest.mark.parametrize("op,ref", BINARY, ids=[b[0].__name__ for b in BINARY])
+def test_binary_forward(op, ref):
+    x, y = a(3, 4, seed=1), pos(3, 4, seed=2)
+    check_output(op, ref, [x, y])
+
+
+@pytest.mark.parametrize("name", ["add", "subtract", "multiply", "divide"])
+def test_binary_grad_broadcast(name):
+    op = getattr(paddle, name)
+    x, y = a(3, 4, seed=1), pos(4, seed=2)  # broadcast over rows
+    check_grad(op, [x, y])
+
+
+def test_matmul_forward_grad():
+    x, y = a(3, 4, seed=1), a(4, 5, seed=2)
+    check_output(paddle.matmul, np.matmul, [x, y])
+    check_grad(paddle.matmul, [x, y])
+
+
+def test_bmm():
+    x, y = a(2, 3, 4, seed=1), a(2, 4, 5, seed=2)
+    check_output(paddle.bmm, np.matmul, [x, y])
+    check_grad(paddle.bmm, [x, y])
+
+
+def test_reductions():
+    x = a(3, 4, seed=3)
+    check_output(paddle.sum, lambda v: np.sum(v), [x])
+    check_output(lambda t: paddle.sum(t, axis=1),
+                 lambda v: np.sum(v, axis=1), [x])
+    check_output(lambda t: paddle.mean(t, axis=0, keepdim=True),
+                 lambda v: np.mean(v, axis=0, keepdims=True), [x])
+    check_output(paddle.max, lambda v: np.max(v), [x])
+    check_output(paddle.min, lambda v: np.min(v), [x])
+    check_output(paddle.prod, lambda v: np.prod(v), [x])
+    check_grad(paddle.sum, [x])
+    check_grad(lambda t: paddle.mean(t, axis=1), [x])
+
+
+def test_cumsum_cumprod():
+    x = pos(3, 4)
+    check_output(lambda t: paddle.cumsum(t, axis=1),
+                 lambda v: np.cumsum(v, axis=1), [x])
+    check_output(lambda t: paddle.cumprod(t, dim=0),
+                 lambda v: np.cumprod(v, axis=0), [x])
+    check_grad(lambda t: paddle.cumsum(t, axis=0), [x])
+
+
+def test_cummax_cummin_indices():
+    x = np.array([[3., 1., 4., 4., 5.], [2., 2., 1., 7., 0.]], np.float32)
+    v, i = paddle.cummax(x := paddle.to_tensor(x), axis=1)
+    np.testing.assert_allclose(v.numpy(),
+                               [[3, 3, 4, 4, 5], [2, 2, 2, 7, 7]])
+    # ties keep the later index (reference cum_maxmin_kernel.cc uses >=)
+    np.testing.assert_array_equal(i.numpy(),
+                                  [[0, 0, 2, 3, 4], [0, 1, 1, 3, 3]])
+    assert i.numpy().shape == (2, 5)
+    v, i = paddle.cummin(x, axis=1)
+    np.testing.assert_allclose(v.numpy(),
+                               [[3, 1, 1, 1, 1], [2, 2, 1, 1, 0]])
+    np.testing.assert_array_equal(i.numpy(),
+                                  [[0, 1, 1, 1, 1], [0, 1, 2, 2, 4]])
+
+
+def test_clip_scale_pow():
+    x = a(3, 4)
+    check_output(lambda t: paddle.clip(t, -0.5, 0.5),
+                 lambda v: np.clip(v, -0.5, 0.5), [x])
+    check_output(lambda t: paddle.scale(t, scale=2.0, bias=1.0),
+                 lambda v: 2.0 * v + 1.0, [x])
+    check_output(lambda t: paddle.pow(t, 2.0), lambda v: v ** 2.0, [x])
+    check_grad(lambda t: paddle.pow(t, 3.0), [pos(2, 3)])
+
+
+def test_lerp_outer_cross():
+    x, y = a(3, 4, seed=1), a(3, 4, seed=2)
+    check_output(lambda s, t: paddle.lerp(s, t, 0.3),
+                 lambda s, t: s + 0.3 * (t - s), [x, y])
+    u, v = a(3, seed=1), a(4, seed=2)
+    check_output(paddle.outer, np.outer, [u, v])
+    # paddle.cross defaults to the first axis of length 3, not the last
+    c1, c2 = a(3, 3, seed=4), a(3, 3, seed=5)
+    check_output(paddle.cross, lambda p, q: np.cross(p, q, axis=0), [c1, c2])
+
+
+def test_logsumexp_nan_ops():
+    x = a(3, 4)
+    from scipy.special import logsumexp as np_lse
+    check_output(lambda t: paddle.logsumexp(t, axis=1),
+                 lambda v: np_lse(v, axis=1), [x])
+    xn = x.copy()
+    xn[0, 0] = np.nan
+    check_output(paddle.nansum, lambda v: np.nansum(v), [xn])
+    check_output(paddle.nanmean, lambda v: np.nanmean(v), [xn])
+    check_output(lambda t: paddle.nan_to_num(t),
+                 lambda v: np.nan_to_num(v), [xn])
+
+
+def test_trace_diagonal_kron():
+    x = a(4, 4)
+    check_output(paddle.trace, lambda v: np.trace(v), [x])
+    check_output(paddle.diagonal, lambda v: np.diagonal(v), [x])
+    u, v = a(2, 2, seed=1), a(3, 3, seed=2)
+    check_output(paddle.kron, np.kron, [u, v])
