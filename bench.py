@@ -1,0 +1,75 @@
+"""Benchmark driver — prints ONE JSON line.
+
+BASELINE.md config 1: LeNet/MNIST under Model.fit-style training, compiled
+train step on the real chip. Metric: training steps/sec (batch 256).
+vs_baseline compares against the reference's published number — none exists
+in-tree (BASELINE.md: "published": {}), so vs_baseline is reported against
+the eager per-op dygraph path of THIS framework (the analog of reference
+dygraph), i.e. the compiled-path speedup.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    batch = 256
+    x = np.random.default_rng(0).standard_normal(
+        (batch, 1, 28, 28)).astype(np.float32)
+    y = np.random.default_rng(1).integers(0, 10, batch)
+    xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+
+    net = LeNet()
+    loss_fn = nn.CrossEntropyLoss()
+    opt = paddle.optimizer.Adam(1e-3, parameters=net.parameters())
+    step = paddle.jit.TrainStep(net, loss_fn, opt)
+
+    # compile + warmup
+    step(xt, yt)
+    l = step(xt, yt)
+    float(l.numpy())
+
+    n = 200
+    t0 = time.perf_counter()
+    for _ in range(n):
+        l = step(xt, yt)
+    float(l.numpy())  # sync
+    dt = time.perf_counter() - t0
+    steps_per_sec = n / dt
+
+    # eager dygraph path (reference-analog baseline): per-op dispatch + tape
+    net2 = LeNet()
+    opt2 = paddle.optimizer.Adam(1e-3, parameters=net2.parameters())
+    out = loss_fn(net2(xt), yt)
+    out.backward()
+    opt2.step()
+    opt2.clear_grad()
+    n2 = 10
+    t0 = time.perf_counter()
+    for _ in range(n2):
+        loss = loss_fn(net2(xt), yt)
+        loss.backward()
+        opt2.step()
+        opt2.clear_grad()
+    float(loss.numpy())
+    dt2 = time.perf_counter() - t0
+    eager_sps = n2 / dt2
+
+    print(json.dumps({
+        "metric": "lenet_mnist_train_steps_per_sec_b256",
+        "value": round(steps_per_sec, 2),
+        "unit": "steps/sec",
+        "vs_baseline": round(steps_per_sec / eager_sps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -56,6 +56,18 @@ from . import version  # noqa: F401,E402
 
 # Subpackages below are built out incrementally; each line is enabled the
 # moment the module lands (tests/test_import.py asserts the package imports).
+from . import nn  # noqa: F401,E402
+from .framework.param_attr import ParamAttr  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402
+from . import regularizer  # noqa: F401,E402
+from . import jit  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+from .hapi.model import Model  # noqa: F401,E402
+from .hapi import summary  # noqa: F401,E402
+from . import hapi  # noqa: F401,E402
+from . import vision  # noqa: F401,E402
+from . import distributed  # noqa: F401,E402
 
 
 def disable_static(place=None):

@@ -80,5 +80,35 @@ def set_rng_state(state):
     _default_generator.set_state(state[0] if isinstance(state, list) else state)
 
 
+class _TracedKeyState(threading.local):
+    def __init__(self):
+        self.key = None
+
+
+_traced = _TracedKeyState()
+
+
+class traced_key_scope:
+    """While tracing a step under jax.jit, eager random draws must come from
+    a TRACED key (a concrete key would bake one dropout mask into the
+    compiled executable). paddle_tpu.jit installs this scope around the
+    traced forward; next_key() then splits from the traced key."""
+
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        self._prev = _traced.key
+        _traced.key = self._key
+        return self
+
+    def __exit__(self, *exc):
+        _traced.key = self._prev
+        return False
+
+
 def next_key():
+    if _traced.key is not None:
+        _traced.key, sub = jax.random.split(_traced.key)
+        return sub
     return _default_generator.next_key()

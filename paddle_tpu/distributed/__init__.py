@@ -1,0 +1,13 @@
+"""paddle_tpu.distributed — mesh-first distributed training.
+
+Reference: python/paddle/distributed (152 K LoC: fleet, auto_parallel,
+communication, launch...). TPU-native architecture: ONE device mesh
+(jax.sharding.Mesh) with named axes ['pp','dp','sharding','mp','sep'],
+NamedSharding placements instead of DistTensor, and compiled XLA
+collectives instead of eager NCCL calls (SURVEY.md §7.1). The fleet/
+auto_parallel surfaces are kept paddle-shaped on top.
+"""
+from . import env  # noqa: F401
+from .env import (  # noqa: F401
+    get_rank, get_world_size, init_parallel_env, is_initialized,
+)

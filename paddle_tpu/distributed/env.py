@@ -1,0 +1,64 @@
+"""Process-level distributed environment.
+
+Reference: python/paddle/distributed/parallel.py (get_rank/get_world_size
+reading PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM set by the launcher). On
+TPU the process world is the JAX distributed runtime: one process per host,
+all chips visible; rank == jax.process_index().
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.rank
+    env = os.environ.get("PADDLE_TRAINER_ID")
+    if env is not None:
+        return int(env)
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.world_size
+    env = os.environ.get("PADDLE_TRAINERS_NUM")
+    if env is not None:
+        return int(env)
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def is_initialized():
+    return _initialized
+
+
+def init_parallel_env(coordinator_address=None, num_processes=None,
+                      process_id=None):
+    """Reference: parallel.py:978 init_parallel_env. Maps to
+    jax.distributed.initialize: coordinator (TCPStore analog) + PJRT does
+    the rest. No-op single-process."""
+    global _initialized
+    if _initialized:
+        return
+    coord = coordinator_address or os.environ.get(
+        "PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+    nproc = num_processes or (
+        int(os.environ["PADDLE_TRAINERS_NUM"])
+        if "PADDLE_TRAINERS_NUM" in os.environ else None)
+    pid = process_id or (
+        int(os.environ["PADDLE_TRAINER_ID"])
+        if "PADDLE_TRAINER_ID" in os.environ else None)
+    if coord and nproc and nproc > 1:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nproc, process_id=pid)
+    _initialized = True

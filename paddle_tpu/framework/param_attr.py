@@ -1,0 +1,79 @@
+"""Parameter + ParamAttr.
+
+Reference: python/paddle/base/param_attr.py (ParamAttr) and the pybind
+EagerParamBase. Here a Parameter is just a Tensor flagged trainable whose
+array is produced by an initializer; there is no block/program machinery —
+the jit path lifts parameters into jax pytree leaves instead.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..core.tensor import Tensor
+
+
+class ParamAttr:
+    """Configuration bundle for a parameter (name, initializer, lr, regularizer,
+    trainable). Reference: python/paddle/base/param_attr.py:40."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if attr is False:
+            return False
+        if isinstance(attr, dict):
+            return ParamAttr(**attr)
+        # an Initializer instance
+        return ParamAttr(initializer=attr)
+
+
+class Parameter(Tensor):
+    """A trainable Tensor (stop_gradient=False by default)."""
+
+    def __init__(self, data, trainable=True, name=None, optimize_attr=None,
+                 regularizer=None, need_clip=True, learning_rate=1.0):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.trainable = trainable
+        self.optimize_attr = optimize_attr or {"learning_rate": learning_rate}
+        self.regularizer = regularizer
+        self.need_clip = need_clip
+        self.is_distributed = False
+
+    @classmethod
+    def _from_tensor(cls, t: Tensor, trainable=True, name=None, **kw):
+        p = cls.__new__(cls)
+        p._data = t._data
+        p.stop_gradient = not trainable
+        p.grad = None
+        p.name = name or t.name
+        p.persistable = True
+        p._meta = None
+        p.is_leaf_ = True
+        p.trainable = trainable
+        p.optimize_attr = kw.get("optimize_attr") or {"learning_rate": 1.0}
+        p.regularizer = kw.get("regularizer")
+        p.need_clip = kw.get("need_clip", True)
+        p.is_distributed = False
+        return p
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+    __str__ = __repr__

@@ -1,0 +1,7 @@
+"""paddle_tpu.hapi (reference: python/paddle/hapi)."""
+from . import callbacks  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+)
+from .model import Model  # noqa: F401
+from .summary import summary  # noqa: F401

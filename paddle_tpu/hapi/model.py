@@ -1,0 +1,257 @@
+"""hapi — paddle.Model high-level training API.
+
+Reference: python/paddle/hapi/model.py:1472 (Model), fit :2200. The
+reference picks between a DynamicGraphAdapter and a StaticGraphAdapter; on
+TPU there is one adapter: the compiled TrainStep (paddle_tpu.jit), with an
+eager fallback when the model/loss isn't jit-traceable.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io import DataLoader
+from ..jit.api import TrainStep
+from ..metric import Metric
+from .callbacks import CallbackList, ProgBarLogger
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._loss = None
+        self._optimizer = None
+        self._metrics: List[Metric] = []
+        self._train_step: Optional[TrainStep] = None
+        self._compiled_mode = True
+        self.stop_training = False
+
+    # -- setup ---------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+                else [metrics]
+            self._metrics = list(self._metrics)
+        amp_dtype = None
+        if amp_configs:
+            level = amp_configs.get("level", "O0") \
+                if isinstance(amp_configs, dict) else str(amp_configs)
+            if level in ("O1", "O2"):
+                import jax.numpy as jnp
+                amp_dtype = jnp.bfloat16
+        if optimizer is not None and loss is not None:
+            try:
+                self._train_step = TrainStep(self.network, loss,
+                                             optimizer, amp_dtype=amp_dtype)
+            except Exception:
+                self._train_step = None
+
+    # -- single-batch APIs ---------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        if self._train_step is not None:
+            loss = self._train_step(tuple(inputs), labels)
+            return [float(loss.numpy())]
+        out = self.network(*inputs)
+        loss = self._loss(out, labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        return [float(loss.numpy())]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+        out = self.network(*inputs)
+        metrics_out = []
+        if self._loss is not None and labels is not None:
+            loss = self._loss(out, labels)
+            metrics_out.append(float(loss.numpy()))
+        for m in self._metrics:
+            c = m.compute(out, labels)
+            m.update(c)
+        return metrics_out
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        out = self.network(*inputs)
+        return out
+
+    # -- loops ---------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        train_loader = self._to_loader(train_data, batch_size, shuffle,
+                                       drop_last, num_workers)
+        eval_loader = self._to_loader(eval_data, batch_size, False, False,
+                                      num_workers) if eval_data is not None \
+            else None
+        cbks = CallbackList(callbacks, model=self, verbose=verbose,
+                            epochs=epochs,
+                            steps=self._safe_len(train_loader),
+                            metrics=self._metric_names())
+        cbks.on_begin("train")
+        iters_done = 0
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            self.network.train()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_batch_begin("train", step, logs)
+                ins, labs = self._split_batch(batch)
+                losses = self.train_batch(ins, labs)
+                logs = {"loss": losses[0], "step": step}
+                if self._lr_scheduler() is not None:
+                    self._lr_scheduler().step()
+                cbks.on_batch_end("train", step, logs)
+                iters_done += 1
+                if num_iters is not None and iters_done >= num_iters:
+                    break
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0,
+                                          _inner=True)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/{epoch}")
+        cbks.on_end("train", logs)
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None,
+                 _inner=False):
+        loader = self._to_loader(eval_data, batch_size, False, False,
+                                 num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        self.network.eval()
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+        for batch in loader:
+            ins, labs = self._split_batch(batch)
+            out = self.network(*(ins if isinstance(ins, (list, tuple))
+                                 else [ins]))
+            if self._loss is not None and labs is not None:
+                losses.append(float(self._loss(out, labs).numpy()))
+            for m in self._metrics:
+                c = m.compute(out, labs)
+                m.update(c)
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, (list, tuple)) else [vals]
+            for n, v in zip(names, vals):
+                logs[n] = v
+        if verbose and not _inner:
+            print("Eval:", " - ".join(f"{k}: {v:.4f}"
+                                      for k, v in logs.items()))
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        loader = self._to_loader(test_data, batch_size, False, False,
+                                 num_workers)
+        outputs = []
+        self.network.eval()
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+        for batch in loader:
+            ins, _ = self._split_batch(batch)
+            out = self.predict_batch(ins)
+            outputs.append(out)
+        if stack_outputs and outputs:
+            import jax.numpy as jnp
+            flat = [o.numpy() if isinstance(o, Tensor) else o
+                    for o in outputs]
+            return [np.concatenate(flat, axis=0)]
+        return outputs
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io import save as fsave
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as fload
+        sd = fload(path + ".pdparams")
+        self.network.set_state_dict(sd)
+        if not reset_optimizer and self._optimizer is not None:
+            try:
+                osd = fload(path + ".pdopt")
+                self._optimizer.set_state_dict(osd)
+            except FileNotFoundError:
+                pass
+        if self._train_step is not None:
+            self._train_step.sync_from_model()
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+        return _summary(self.network, input_size, dtypes=dtype)
+
+    # -- helpers -------------------------------------------------------------
+    def _lr_scheduler(self):
+        if self._optimizer is None:
+            return None
+        return getattr(self._optimizer, "_lr_scheduler", None)
+
+    def _metric_names(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, list) else [n])
+        return names
+
+    @staticmethod
+    def _safe_len(loader):
+        try:
+            return len(loader)
+        except TypeError:
+            return None
+
+    @staticmethod
+    def _to_loader(data, batch_size, shuffle, drop_last, num_workers):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+
+    @staticmethod
+    def _split_batch(batch):
+        if isinstance(batch, (list, tuple)):
+            if len(batch) == 1:
+                return [batch[0]], None
+            ins = batch[:-1]
+            return list(ins), batch[-1]
+        return [batch], None

@@ -1,0 +1,32 @@
+"""Model summary (reference: python/paddle/hapi/model_summary.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Print a per-layer table; returns {'total_params', 'trainable_params'}."""
+    rows = []
+    total, trainable = 0, 0
+    for name, layer in net.named_sublayers(include_self=True):
+        own = [p for p in layer._parameters.values() if p is not None]
+        n = int(sum(np.prod(p.shape) if p.shape else 1 for p in own))
+        t = int(sum(np.prod(p.shape) if p.shape else 1
+                    for p in own if not p.stop_gradient))
+        if n:
+            rows.append((name or type(layer).__name__,
+                         type(layer).__name__, n))
+        total += n
+        trainable += t
+    width = max([len(r[0]) for r in rows], default=10) + 2
+    print(f"{'Layer':<{width}}{'Type':<24}{'Params':>12}")
+    print("-" * (width + 36))
+    for name, tname, n in rows:
+        print(f"{name:<{width}}{tname:<24}{n:>12,}")
+    print("-" * (width + 36))
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,17 @@
+"""paddle_tpu.io — Dataset / Sampler / DataLoader.
+
+Reference: python/paddle/io/reader.py:262 (DataLoader),
+io/dataloader/dataset.py, batch_sampler.py, dataloader_iter.py:154,368.
+The host-side pipeline stays Python (multiprocess workers feeding numpy
+batches); device transfer happens on first op touch (XLA) or explicitly in
+hapi/fleet with mesh-aware sharding.
+"""
+from .dataset import (  # noqa: F401
+    ChainDataset, ComposeDataset, ConcatDataset, Dataset, IterableDataset,
+    Subset, TensorDataset, random_split,
+)
+from .sampler import (  # noqa: F401
+    BatchSampler, DistributedBatchSampler, RandomSampler, Sampler,
+    SequenceSampler, SubsetRandomSampler, WeightedRandomSampler,
+)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401

@@ -1,0 +1,149 @@
+"""DataLoader with optional multiprocess workers.
+
+Reference: python/paddle/io/reader.py:262 (DataLoader),
+dataloader/dataloader_iter.py:154 (single-process), :368 (multiprocess
+workers + shared memory). Here the multiprocess path uses
+multiprocessing.Pool imap over index batches — workers return numpy
+batches, the parent converts to Tensors (XLA moves them to device on first
+use); a small prefetch window overlaps host IO with device compute.
+"""
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset=None):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info: Optional[WorkerInfo] = None
+
+
+def get_worker_info():
+    return _worker_info
+
+
+def default_collate_fn(batch):
+    """Stack samples into batch arrays (reference:
+    io/dataloader/collate.py default_collate_fn)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(s.numpy()) for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (list, tuple)):
+        transposed = zip(*batch)
+        return [default_collate_fn(list(items)) for items in transposed]
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    raise TypeError(f"cannot collate type {type(sample)}")
+
+
+_POOL_DATASET = None
+
+
+def _pool_init(dataset, worker_id_counter, num_workers):
+    global _POOL_DATASET, _worker_info
+    _POOL_DATASET = dataset
+    with worker_id_counter.get_lock():
+        wid = worker_id_counter.value
+        worker_id_counter.value += 1
+    _worker_info = WorkerInfo(wid, num_workers, dataset)
+
+
+def _pool_fetch(indices):
+    return [_POOL_DATASET[i] for i in indices]
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = int(num_workers)
+        self.prefetch_factor = max(int(prefetch_factor), 1)
+        self.worker_init_fn = worker_init_fn
+        self._iterable_ds = isinstance(dataset, IterableDataset)
+        if self._iterable_ds:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = batch_sampler.batch_size
+        else:
+            if batch_size is None:
+                # batch_size None = no auto-batching
+                self.batch_sampler = None
+                self.batch_size = None
+            else:
+                self.batch_sampler = BatchSampler(
+                    dataset, shuffle=shuffle, batch_size=batch_size,
+                    drop_last=drop_last)
+                self.batch_size = batch_size
+
+    def __len__(self):
+        if self._iterable_ds:
+            raise TypeError("IterableDataset has no length")
+        if self.batch_sampler is None:
+            return len(self.dataset)
+        return len(self.batch_sampler)
+
+    def _iter_iterable(self):
+        buf = []
+        for sample in self.dataset:
+            buf.append(sample)
+            if len(buf) == self.batch_size:
+                yield self.collate_fn(buf)
+                buf = []
+        if buf and not self.drop_last:
+            yield self.collate_fn(buf)
+
+    def _iter_single(self):
+        if self.batch_sampler is None:
+            for i in range(len(self.dataset)):
+                yield self.dataset[i]
+            return
+        for indices in self.batch_sampler:
+            yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def _iter_workers(self):
+        counter = mp.Value("i", 0)
+        ctx = mp.get_context("fork")
+        with ctx.Pool(self.num_workers, initializer=_pool_init,
+                      initargs=(self.dataset, counter,
+                                self.num_workers)) as pool:
+            batches = pool.imap(
+                _pool_fetch, iter(self.batch_sampler),
+                chunksize=1)
+            for samples in batches:
+                yield self.collate_fn(samples)
+
+    def __iter__(self):
+        if self._iterable_ds:
+            return self._iter_iterable()
+        if self.num_workers > 0 and self.batch_sampler is not None:
+            return self._iter_workers()
+        return self._iter_single()
+
+    def __call__(self):
+        return self.__iter__()

@@ -1,0 +1,10 @@
+"""paddle_tpu.jit (reference: python/paddle/jit — SOT + dy2static + save).
+
+jax.jit replaces the reference's entire compilation stack; see api.py.
+"""
+from .api import (  # noqa: F401
+    InputSpec, StaticFunction, TrainStep, compile_train_step,
+    enable_to_static, not_to_static, to_static,
+)
+from .functional import functional_call, get_buffers, get_params  # noqa: F401
+from .serialization import load, save  # noqa: F401

@@ -1,0 +1,289 @@
+"""paddle_tpu.jit — to_static and the compiled TrainStep.
+
+Reference: python/paddle/jit/api.py:197 (to_static). The reference needs a
+bytecode JIT (SOT) + AST rewriting + a static IR + its own executor; on TPU
+jax.jit IS that entire stack: to_static wraps a function/Layer so calls
+trace once per input signature and run the cached XLA executable.
+
+TrainStep is the performance path (SURVEY.md §7.2 stage 3): one jax.jit
+containing forward + loss + backward (jax.grad) + optimizer update +
+buffer updates, with donated argnums so parameter/optimizer-state memory is
+reused in place on TPU.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as random_mod
+from ..core import tape as tape_mod
+from ..core.dispatch import run_op, unwrap, wrap
+from ..core.tensor import Tensor
+from .functional import (functional_call, get_buffers, get_frozen,
+                         get_params, write_back)
+
+
+class InputSpec:
+    """Shape/dtype spec (reference: paddle.static.InputSpec)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def _sig_of(args, kwargs):
+    parts = []
+    for a in args:
+        if isinstance(a, Tensor):
+            parts.append(("T", tuple(a._data.shape), str(a._data.dtype)))
+        elif isinstance(a, (jnp.ndarray, jax.Array, np.ndarray)):
+            parts.append(("A", tuple(a.shape), str(a.dtype)))
+        else:
+            parts.append(("S", repr(a)))
+    for k in sorted(kwargs):
+        v = kwargs[k]
+        if isinstance(v, Tensor):
+            parts.append((k, tuple(v._data.shape), str(v._data.dtype)))
+        else:
+            parts.append((k, repr(v)))
+    return tuple(parts)
+
+
+class StaticFunction:
+    """A function compiled per input signature; Tensor-in/Tensor-out and
+    differentiable through the dygraph tape (the compiled forward is one
+    tape op whose vjp is the compiled backward)."""
+
+    def __init__(self, fn, input_spec=None, build_strategy=None,
+                 backend=None, full_graph=True):
+        self._fn = fn
+        self._layer = None
+        if hasattr(fn, "forward") and hasattr(fn, "named_parameters"):
+            self._layer = fn
+            self._fn = fn.forward
+        self._input_spec = input_spec
+        self._cache = {}
+        functools.update_wrapper(self, self._fn)
+
+    @property
+    def layer(self):
+        return self._layer
+
+    def concrete_program(self):
+        return None  # no program world on TPU
+
+    def _pure(self, static_kwargs):
+        layer = self._layer
+        fn = self._fn
+
+        if layer is None:
+            def pure(*arrays):
+                with tape_mod.no_grad_guard():
+                    targs = [Tensor._from_array(a) for a in arrays]
+                    out = fn(*targs, **static_kwargs)
+                return jax.tree_util.tree_map(
+                    lambda t: t._data if isinstance(t, Tensor) else t, out,
+                    is_leaf=lambda t: isinstance(t, Tensor))
+            return pure
+
+        def pure(params, buffers, frozen, key, *arrays):
+            out, new_buf = functional_call(
+                layer, params, buffers, arrays, static_kwargs,
+                frozen=frozen, rng_key=key)
+            return out, new_buf
+        return pure
+
+    def __call__(self, *args, **kwargs):
+        tensor_args = []
+        static_kwargs = {}
+        for a in args:
+            tensor_args.append(a)
+        for k, v in kwargs.items():
+            if isinstance(v, Tensor):
+                tensor_args.append(v)  # rare; treat as positional tail
+            else:
+                static_kwargs[k] = v
+        sig = _sig_of(tensor_args, static_kwargs)
+        entry = self._cache.get(sig)
+        if self._layer is None:
+            if entry is None:
+                entry = jax.jit(self._pure(static_kwargs))
+                self._cache[sig] = entry
+            # run as ONE tape op: compiled forward, vjp = compiled backward
+            return run_op("jit_fn", entry, tensor_args)
+
+        layer = self._layer
+        params = get_params(layer)
+        buffers = get_buffers(layer)
+        frozen = get_frozen(layer)
+        if entry is None:
+            entry = jax.jit(self._pure(static_kwargs))
+            self._cache[sig] = entry
+        key = random_mod.next_key()
+        arrays = [unwrap(a) for a in tensor_args]
+        out_arrays, new_buf = entry(params, buffers, frozen, key, *arrays)
+        write_back(layer, {}, new_buf)
+        return jax.tree_util.tree_map(
+            lambda a: wrap(a), out_arrays,
+            is_leaf=lambda a: isinstance(a, (jax.Array, np.ndarray)))
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """Decorator/wrapper compiling a function or Layer's forward."""
+    def wrap_fn(fn):
+        return StaticFunction(fn, input_spec, build_strategy, backend)
+    if function is None:
+        return wrap_fn
+    return wrap_fn(function)
+
+
+def not_to_static(fn=None):
+    if fn is None:
+        return lambda f: f
+    return fn
+
+
+def enable_to_static(flag=True):
+    pass
+
+
+class TrainStep:
+    """Whole-train-step compilation:
+
+        loss = step(inputs, labels)
+
+    runs forward + loss + jax.grad + optimizer update + buffer update as a
+    single donated jax.jit executable and syncs results back into the
+    Layer/Optimizer objects so eager code (hooks, prints, checkpoints)
+    sees fresh state.
+    """
+
+    def __init__(self, model, loss_fn, optimizer, amp_dtype=None,
+                 donate=True):
+        self._model = model
+        self._loss_fn = loss_fn
+        self._opt = optimizer
+        self._amp_dtype = amp_dtype
+        self._params = get_params(model)
+        self._frozen = get_frozen(model)
+        self._buffers = get_buffers(model)
+        self._opt_state = optimizer.init_state_pytree(self._params)
+        self._compiled = {}
+        self._donate = donate
+
+    def _make_step(self):
+        model, loss_fn, opt = self._model, self._loss_fn, self._opt
+        amp_dtype = self._amp_dtype
+
+        def loss_of(params, buffers, frozen, key, inputs, labels):
+            if amp_dtype is not None:
+                cast_params = jax.tree_util.tree_map(
+                    lambda a: a.astype(amp_dtype)
+                    if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
+            else:
+                cast_params = params
+            out, new_buf = functional_call(
+                model, cast_params, buffers, inputs, {},
+                frozen=frozen, rng_key=key, training=True)
+            with tape_mod.no_grad_guard():
+                out_t = jax.tree_util.tree_map(
+                    lambda a: Tensor._from_array(a), out,
+                    is_leaf=lambda a: isinstance(a, jax.Array))
+                lab_t = jax.tree_util.tree_map(
+                    lambda a: Tensor._from_array(a), labels,
+                    is_leaf=lambda a: isinstance(a, jax.Array))
+                if isinstance(out_t, (list, tuple)) or \
+                        isinstance(lab_t, (list, tuple)):
+                    loss = loss_fn(out_t, lab_t)
+                else:
+                    loss = loss_fn(out_t, lab_t)
+            return unwrap(loss).astype(jnp.float32), new_buf
+
+        def step(params, buffers, frozen, opt_state, key, lr, inputs,
+                 labels):
+            (loss, new_buf), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params, buffers, frozen, key, inputs,
+                                       labels)
+            if opt._grad_clip is not None:
+                grads = _clip_pytree(grads, opt._grad_clip)
+            new_params, new_opt_state = opt.apply_gradients_pytree(
+                params, grads, opt_state, lr)
+            return new_params, new_buf, new_opt_state, loss
+
+        donate = (0, 1, 3) if self._donate else ()
+        return jax.jit(step, donate_argnums=donate)
+
+    def __call__(self, inputs, labels):
+        if not isinstance(inputs, (list, tuple)):
+            inputs = (inputs,)
+        in_arrays = tuple(unwrap(x) for x in inputs)
+        lab_arrays = jax.tree_util.tree_map(
+            lambda t: unwrap(t), labels,
+            is_leaf=lambda t: isinstance(t, Tensor))
+        sig = tuple((a.shape, str(a.dtype)) for a in in_arrays)
+        fn = self._compiled.get(sig)
+        if fn is None:
+            fn = self._make_step()
+            self._compiled[sig] = fn
+        key = random_mod.next_key()
+        lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+        self._params, self._buffers, self._opt_state, loss = fn(
+            self._params, self._buffers, self._frozen, self._opt_state, key,
+            lr, in_arrays, lab_arrays)
+        return wrap(loss)
+
+    def sync_to_model(self):
+        """Write compiled-side state back into Layer/Optimizer tensors."""
+        write_back(self._model, self._params, self._buffers)
+        name_of = {name: p for name, p in self._model.named_parameters()}
+        for name, state in self._opt_state.items():
+            p = name_of.get(name)
+            if p is not None:
+                self._opt._accumulators[id(p)] = dict(state)
+
+    def sync_from_model(self):
+        self._params = get_params(self._model)
+        self._frozen = get_frozen(self._model)
+        self._buffers = get_buffers(self._model)
+
+    @property
+    def loss_scale(self):
+        return 1.0
+
+
+def _clip_pytree(grads, clip):
+    """Apply a nn.Clip* object to a {name: array} pytree inside jit."""
+    from ..nn.clip import (ClipGradByGlobalNorm, ClipGradByNorm,
+                           ClipGradByValue)
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    if isinstance(clip, ClipGradByValue):
+        leaves = [jnp.clip(g, clip.min, clip.max) for g in leaves]
+    elif isinstance(clip, ClipGradByNorm):
+        out = []
+        for g in leaves:
+            n = jnp.sqrt(jnp.sum(jnp.square(g)))
+            s = jnp.where(n > clip.clip_norm,
+                          clip.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+            out.append(g * s)
+        leaves = out
+    elif isinstance(clip, ClipGradByGlobalNorm):
+        total = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in leaves)
+        gn = jnp.sqrt(total)
+        scale = clip.clip_norm / jnp.maximum(gn, clip.clip_norm)
+        leaves = [(g.astype(jnp.float32) * scale).astype(g.dtype)
+                  for g in leaves]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def compile_train_step(model, loss_fn, optimizer, **kw):
+    return TrainStep(model, loss_fn, optimizer, **kw)

@@ -1,0 +1,106 @@
+"""Layer functionalization — the bridge between dygraph Layers and jax.jit.
+
+The reference compiles dygraph code by capturing Python bytecode (SOT,
+python/paddle/jit/sot) or rewriting ASTs (dy2static). On TPU neither is
+needed: jax traces the *same eager op calls* the tape sees, so compiling a
+Layer is just (1) lift its parameters/buffers into a pytree, (2) re-bind
+them to traced arrays, (3) run forward under the tracer. This file
+implements that re-binding.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import tape as tape_mod
+from ..core.dispatch import unwrap, wrap
+from ..core.tensor import Tensor
+
+
+def get_params(layer) -> Dict[str, jnp.ndarray]:
+    """{structured_name: array} for trainable parameters."""
+    return {name: p._data for name, p in layer.named_parameters()
+            if not p.stop_gradient}
+
+def get_frozen(layer) -> Dict[str, jnp.ndarray]:
+    return {name: p._data for name, p in layer.named_parameters()
+            if p.stop_gradient}
+
+
+def get_buffers(layer) -> Dict[str, jnp.ndarray]:
+    return {name: b._data for name, b in layer.named_buffers()}
+
+
+def _tensor_registry(layer):
+    reg = {}
+    for name, p in layer.named_parameters():
+        reg[name] = p
+    for name, b in layer.named_buffers():
+        reg[name] = b
+    return reg
+
+
+@contextlib.contextmanager
+def bind_state(layer, *state_dicts):
+    """Temporarily swap the arrays inside the layer's Tensors for the given
+    (possibly traced) arrays; restore on exit. Mutated buffer values are
+    visible on the swapped Tensors when the context exits — callers read
+    them before restore via the yielded registry."""
+    reg = _tensor_registry(layer)
+    saved = {name: t._data for name, t in reg.items()}
+    try:
+        for sd in state_dicts:
+            for name, arr in sd.items():
+                if name in reg:
+                    reg[name]._data = arr
+        yield reg
+    finally:
+        for name, t in reg.items():
+            t._data = saved[name]
+
+
+def functional_call(layer, params, buffers, args, kwargs=None,
+                    frozen=None, rng_key=None, training=None):
+    """Run layer.forward with params/buffers taken from pytrees.
+
+    Returns (outputs_pytree_of_arrays, new_buffers). Runs with the dygraph
+    tape disabled — differentiation happens at the whole-step level via
+    jax.grad, the idiomatic XLA design (SURVEY.md §7.1).
+    """
+    from ..core import random as random_mod
+    kwargs = kwargs or {}
+    was_training = layer.training
+    if training is not None:
+        layer.train() if training else layer.eval()
+    key_scope = random_mod.traced_key_scope(rng_key) if rng_key is not None \
+        else contextlib.nullcontext()
+    try:
+        with bind_state(layer, params, buffers, frozen or {}) as reg, \
+                tape_mod.no_grad_guard(), key_scope:
+            targs = [Tensor._from_array(a) if isinstance(
+                a, (jnp.ndarray, jax.Array)) else a for a in args]
+            out = layer(*targs, **kwargs)
+            buf_names = set(buffers)
+            new_buffers = {n: reg[n]._data for n in buf_names}
+            out_arrays = jax.tree_util.tree_map(
+                lambda t: t._data if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor))
+    finally:
+        if training is not None:
+            layer.train() if was_training else layer.eval()
+    return out_arrays, new_buffers
+
+
+def write_back(layer, params, buffers=None):
+    """Push updated arrays back into the layer's Tensors (post-step sync)."""
+    reg = _tensor_registry(layer)
+    for name, arr in params.items():
+        if name in reg:
+            reg[name]._data = arr
+    if buffers:
+        for name, arr in buffers.items():
+            if name in reg:
+                reg[name]._data = arr

@@ -1,0 +1,98 @@
+"""jit.save / jit.load — AOT export of compiled functions.
+
+Reference: python/paddle/jit/api.py:1788 (save TranslatedLayer),
+paddle/fluid/jit (C++ loader). TPU-native: the portable artifact is a
+serialized StableHLO module (jax.export) plus a parameter archive; load
+returns a callable running the deserialized executable — the analog of the
+reference's inference Program + params files.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import unwrap, wrap
+from ..core.tensor import Tensor
+
+
+def save(layer, path, input_spec=None, **config):
+    """Serialize layer.forward (traced at input_spec shapes) + params."""
+    from .api import InputSpec, StaticFunction
+    from .functional import functional_call, get_buffers, get_frozen, \
+        get_params
+
+    if input_spec is None:
+        raise ValueError("jit.save on TPU requires input_spec (static "
+                         "shapes are what make AOT export possible)")
+    params = get_params(layer)
+    frozen = get_frozen(layer)
+    buffers = get_buffers(layer)
+
+    def infer(params_and_frozen, *arrays):
+        p, f = params_and_frozen
+        out, _ = functional_call(layer, p, buffers, arrays, {}, frozen=f,
+                                 training=False)
+        return out
+
+    specs = []
+    for s in input_spec:
+        shape = s.shape if isinstance(s, InputSpec) else list(s)
+        dtype = s.dtype if isinstance(s, InputSpec) else "float32"
+        specs.append(jax.ShapeDtypeStruct(
+            [1 if d is None or d == -1 else d for d in shape],
+            jnp.dtype(dtype) if not hasattr(dtype, "np_dtype")
+            else dtype.np_dtype))
+
+    from jax import export as jax_export
+    exported = jax_export.export(jax.jit(infer))(
+        (params, frozen),
+        *specs)
+    blob = exported.serialize()
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(blob)
+    state = {k: np.asarray(v) for k, v in params.items()}
+    state.update({k: np.asarray(v) for k, v in frozen.items()})
+    state["@buffers"] = {k: np.asarray(v) for k, v in buffers.items()}
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump({"params": {k: np.asarray(v) for k, v in params.items()},
+                     "frozen": {k: np.asarray(v) for k, v in frozen.items()},
+                     "buffers": {k: np.asarray(v)
+                                 for k, v in buffers.items()}}, f)
+
+
+class TranslatedLayer:
+    """Loaded AOT artifact; callable like the original layer (inference)."""
+
+    def __init__(self, exported, params, frozen):
+        self._exported = exported
+        self._params = {k: jnp.asarray(v) for k, v in params.items()}
+        self._frozen = {k: jnp.asarray(v) for k, v in frozen.items()}
+
+    def __call__(self, *args):
+        arrays = [unwrap(a) for a in args]
+        out = self._exported.call((self._params, self._frozen), *arrays)
+        return jax.tree_util.tree_map(
+            lambda a: wrap(a), out,
+            is_leaf=lambda a: isinstance(a, (jax.Array, np.ndarray)))
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("a loaded inference artifact cannot be trained; "
+                           "load the state_dict into a Layer instead")
+
+
+def load(path, **config):
+    from jax import export as jax_export
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(path + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    return TranslatedLayer(exported, state["params"], state["frozen"])

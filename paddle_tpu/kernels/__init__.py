@@ -1,0 +1,7 @@
+"""Pallas TPU kernels — the hot ops XLA won't fuse optimally.
+
+Reference analog: paddle/phi/kernels/fusion/ (fused_attention,
+flash_attn_kernel.cu, fused MoE dispatch). Here the kernel library is tiny
+by design: XLA is the kernel library for everything else (SURVEY.md §7.1).
+"""
+from . import flash_attention  # noqa: F401

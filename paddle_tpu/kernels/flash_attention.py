@@ -1,0 +1,158 @@
+"""Flash attention: Pallas TPU kernel with XLA fallback.
+
+Reference capability: paddle/phi/kernels/gpu/flash_attn_kernel.cu (dynloaded
+flash-attn v2 lib). TPU-native design: a blocked online-softmax kernel in
+Pallas that streams K/V tiles through VMEM so the S×S score matrix never
+materializes in HBM. Falls back to an XLA einsum+softmax (which XLA fuses
+reasonably) for shapes that don't tile, and on non-TPU backends runs the
+kernel in interpret mode only under tests.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import run_op
+
+# block sizes chosen for v5e: last dim 128 lanes; bf16 sublane 16
+BLOCK_Q = 128
+BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, scale, block_k,
+                  seq_k):
+    """One (batch*head, q_block) program: stream K/V tiles, online softmax.
+
+    Refs are VMEM tiles: q [BQ, D], k/v [S_k, D] (full K/V rows for this
+    head), o [BQ, D].
+    """
+    from jax.experimental import pallas as pl
+
+    # pin every python-float constant to f32: x64 is enabled globally, so
+    # weak f64 constants otherwise reach Mosaic and fail to lower
+    q = q_ref[...].astype(jnp.float32) * jnp.float32(scale)
+    bq, d = q.shape
+    q_idx = pl.program_id(1)
+    neg_inf = jnp.float32(NEG_INF)
+
+    # online-softmax stats kept 2-D (bq, 1): Mosaic legalizes 2-D
+    # vectors; 1-D carries fail ('func.return' legalization)
+    m = jnp.full((bq, 1), neg_inf, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+    acc = jnp.zeros((bq, d), jnp.float32)
+
+    nblocks = seq_k // block_k
+
+    def body(i, carry):
+        m_prev, l_prev, acc_prev = carry
+        k_tile = k_ref[pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v_tile = v_ref[pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_tile, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, block_k]
+        if causal:
+            q_pos = q_idx.astype(jnp.int32) * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, neg_inf)
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_cur)
+        alpha = jnp.exp(m_prev - m_cur)
+        l_cur = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_cur = acc_prev * alpha + jax.lax.dot_general(
+            p, v_tile, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_cur, l_cur, acc_cur
+
+    if causal:
+        # only iterate k blocks that intersect the causal triangle.
+        # NB: keep all loop-bound math in int32 — the package enables x64
+        # globally and Mosaic cannot lower int64 (its convert helper
+        # recurses).
+        hi = jnp.minimum(
+            jnp.int32(nblocks),
+            (q_idx.astype(jnp.int32) + 1) * jnp.int32(bq)
+            // jnp.int32(block_k) + 1).astype(jnp.int32)
+    else:
+        hi = jnp.int32(nblocks)
+    m, l, acc = jax.lax.fori_loop(jnp.int32(0), hi, body, (m, l, acc))
+    o_ref[...] = (acc / jnp.maximum(l, jnp.float32(1e-30))
+                  ).astype(o_ref.dtype)
+
+
+def _flash_pallas(q, k, v, causal, scale, interpret=False):
+    """q/k/v: [B, H, S, D] → out [B, H, S, D]."""
+    from jax.experimental import pallas as pl
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = min(BLOCK_Q, sq)
+    bk = min(BLOCK_K, sk)
+    qr = q.reshape(b * h, sq, d)
+    kr = k.reshape(b * h, sk, d)
+    vr = v.reshape(b * h, sk, d)
+
+    kernel = functools.partial(_flash_kernel, causal=causal, scale=scale,
+                               block_k=bk, seq_k=sk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // bq),
+        in_specs=[
+            # None squeezes the batch*head dim so refs are [S, D] tiles
+            pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, sq, d)
+
+
+def _flash_xla(q, k, v, causal, scale):
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(mask, logits, NEG_INF)
+    p = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _tileable(sq, sk, d):
+    return (sq % min(BLOCK_Q, sq) == 0 and sk % min(BLOCK_K, sk) == 0
+            and d % 128 == 0 and sq >= 128 and sk >= 128)
+
+
+def flash_attention_arrays(q, k, v, causal=False, scale=None,
+                           force_pallas=False, interpret=False):
+    """Array-level entry (paddle layout [B, S, H, D])."""
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    on_tpu = qt.devices() and next(iter(qt.devices())).platform in (
+        "tpu", "axon") if hasattr(qt, "devices") else False
+    use_pallas = force_pallas or (
+        on_tpu and _tileable(qt.shape[2], kt.shape[2], qt.shape[3]))
+    if use_pallas:
+        try:
+            out = _flash_pallas(qt, kt, vt, causal, s, interpret=interpret)
+        except Exception:
+            out = _flash_xla(qt, kt, vt, causal, s)
+    else:
+        out = _flash_xla(qt, kt, vt, causal, s)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def flash_attention(query, key, value, causal=False, scale=None):
+    """Tensor-level entry used by nn.functional.flash_attention."""
+    def fn(q, k, v):
+        return flash_attention_arrays(q, k, v, causal=causal, scale=scale)
+    return run_op("flash_attention", fn, [query, key, value])

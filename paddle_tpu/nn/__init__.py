@@ -1,0 +1,14 @@
+"""paddle_tpu.nn — layers, functional ops, initializers.
+
+Reference: python/paddle/nn/__init__.py (the same public surface, minus
+GPU-only fused layers, which live behind paddle_tpu.incubate).
+"""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from ..framework.param_attr import Parameter, ParamAttr  # noqa: F401
+from .layer import *  # noqa: F401,F403
+from .layer import Layer  # noqa: F401
+from . import utils  # noqa: F401
+from .clip import (  # noqa: F401
+    ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
+)

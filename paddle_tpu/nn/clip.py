@@ -1,0 +1,111 @@
+"""Gradient clipping strategies.
+
+Reference: python/paddle/nn/clip.py (ClipGradByValue :153, ClipGradByNorm
+:232, ClipGradByGlobalNorm :373). A clip object is callable on a list of
+(param, grad) pairs and returns new pairs; optimizers apply it before the
+update. All arithmetic is jnp so the jit path traces straight through it.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import unwrap, wrap
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+    def _clip_arrays(self, grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            out.append((p, wrap(jnp.clip(unwrap(g), self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            a = unwrap(g)
+            norm = jnp.sqrt(jnp.sum(jnp.square(a)))
+            scale = jnp.where(norm > self.clip_norm,
+                              self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, wrap(a * scale)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Reference: nn/clip.py:373. In hybrid-parallel training the global
+    norm additionally reduces across model-parallel groups — see
+    distributed.fleet.HybridParallelClipGrad."""
+
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+        self.auto_skip_clip = auto_skip_clip
+
+    def __call__(self, params_grads):
+        sq_sum = None
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                continue
+            a = unwrap(g)
+            s = jnp.sum(jnp.square(a.astype(jnp.float32)))
+            sq_sum = s if sq_sum is None else sq_sum + s
+        if sq_sum is None:
+            return params_grads
+        global_norm = jnp.sqrt(sq_sum)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or (hasattr(p, "need_clip") and not p.need_clip):
+                out.append((p, g))
+                continue
+            a = unwrap(g)
+            out.append((p, wrap((a.astype(jnp.float32) * scale)
+                                .astype(a.dtype))))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """torch-style helper also exposed by paddle.nn.utils."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return wrap(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(unwrap(g))) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(unwrap(g)) ** norm_type) for g in grads])) \
+            ** (1.0 / norm_type)
+    scale = max_norm / jnp.maximum(total, 1e-6)
+    scale = jnp.minimum(scale, 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._data = p.grad._data * scale
+    return wrap(total)

@@ -1,0 +1,79 @@
+"""Attention functionals.
+
+Reference: python/paddle/nn/functional/flash_attention.py:195
+(flash_attention), :976 (scaled_dot_product_attention). On TPU the fused
+path is XLA's fused attention or a Pallas flash kernel
+(paddle_tpu.kernels.flash_attention); this module exposes the paddle API
+and routes to the best available implementation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import run_op, unwrap
+
+
+def _sdpa_core(q, k, v, mask=None, dropout=0.0, causal=False, scale=None):
+    # q/k/v: [B, S, H, D] (paddle layout)
+    d = q.shape[-1]
+    s = scale if scale is not None else (d ** -0.5)
+    qt = jnp.swapaxes(q, 1, 2)  # [B,H,S,D]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * s
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cmask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(cmask, logits, -jnp.inf)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -jnp.inf)
+        else:
+            logits = logits + mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    probs = probs.astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)  # back to [B,S,H,D]
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """paddle layout [batch, seq, heads, head_dim]
+    (reference flash_attention.py:976)."""
+    def fn(q, k, v, *rest):
+        m = rest[0] if rest else None
+        return _sdpa_core(q, k, v, m, dropout_p, is_causal)
+    args = [query, key, value] + (
+        [attn_mask] if attn_mask is not None else [])
+    return run_op("scaled_dot_product_attention", fn, args)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """Reference flash_attention.py:195. Routes to the Pallas flash kernel
+    on TPU when shapes allow, else the XLA-fused softmax-attention above.
+    Returns (out, softmax) like paddle; softmax is None unless requested."""
+    from ...kernels import flash_attention as kernel_mod
+    out = kernel_mod.flash_attention(query, key, value, causal=causal)
+    if return_softmax:
+        sm = scaled_dot_product_attention(query, key, value,
+                                          is_causal=causal)
+        return out, sm
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False, name=None):
+    raise NotImplementedError(
+        "varlen flash attention: pack to dense + mask instead on TPU")
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    def fn(ln):
+        m = maxlen if maxlen is not None else int(jnp.max(ln))
+        return (jnp.arange(m)[None, :] < ln[:, None]).astype(dtype)
+    return run_op("sequence_mask", fn, [lengths])

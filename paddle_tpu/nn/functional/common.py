@@ -1,0 +1,278 @@
+"""Common functionals: linear, dropout, embedding, pad, interpolate, etc.
+
+Reference: python/paddle/nn/functional/common.py, input.py. All compute is
+jnp/lax so XLA fuses it; dropout keys come from the global generator.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import random as random_mod
+from ...core.dispatch import run_op, run_op_nodiff, unwrap, wrap
+from ...ops.manipulation import pad  # noqa: F401  (re-export, paddle parity)
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with paddle's [in, out] weight layout
+    (reference: nn/functional/common.py linear)."""
+    if bias is None:
+        return run_op("linear", lambda a, w: a @ w, [x, weight])
+    return run_op("linear", lambda a, w, b: a @ w + b, [x, weight, bias])
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    """Reference: nn/functional/common.py dropout. upscale_in_train scales
+    kept values by 1/(1-p) at train time; downscale_in_infer scales by (1-p)
+    at eval time."""
+    if isinstance(p, (int, float)) and (p < 0 or p > 1):
+        raise ValueError(f"dropout p must be in [0, 1], got {p}")
+    if not training:
+        if mode == "downscale_in_infer":
+            return run_op("dropout", lambda a: a * (1.0 - p), [x])
+        return x
+    if p == 0.0:
+        return x
+    if p == 1.0:
+        return run_op("dropout", jnp.zeros_like, [x])
+    key = random_mod.next_key()
+    shape = unwrap(x).shape
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        mask_shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+    else:
+        mask_shape = shape
+    mask = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+
+    def fn(a):
+        if mode == "upscale_in_train":
+            return jnp.where(mask, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(mask, a, 0.0).astype(a.dtype)
+    return run_op("dropout", fn, [x])
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    """SELU-preserving dropout (reference common.py alpha_dropout)."""
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = random_mod.next_key()
+    mask = jax.random.bernoulli(key, 1.0 - p, unwrap(x).shape)
+    a_coef = ((1 - p) * (1 + p * alpha_p ** 2)) ** -0.5
+    b_coef = -a_coef * p * alpha_p
+
+    def fn(v):
+        return (a_coef * jnp.where(mask, v, alpha_p) + b_coef).astype(v.dtype)
+    return run_op("alpha_dropout", fn, [x])
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None,
+              max_norm=None, norm_type=2.0, scale_grad_by_freq=False):
+    """Reference: nn/functional/input.py embedding. padding_idx rows produce
+    zero gradient (implemented by zeroing that row's contribution)."""
+    def fn(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            pi = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
+            out = jnp.where((ids == pi)[..., None], 0.0, out)
+        return out
+    return run_op("embedding", fn, [x, weight])
+
+
+def one_hot(x, num_classes, name=None):
+    return run_op_nodiff(
+        "one_hot",
+        lambda a: jax.nn.one_hot(a, num_classes, dtype=jnp.float32), [x])
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(lab, *rest):
+        n = lab.shape[-1]
+        if rest:
+            return (1 - epsilon) * lab + epsilon * rest[0]
+        return (1 - epsilon) * lab + epsilon / n
+    args = [label] if prior_dist is None else [label, prior_dist]
+    return run_op("label_smooth", fn, args)
+
+
+def _interp_size(shape_sp, size, scale_factor):
+    if size is not None:
+        return [int(s) for s in size]
+    if isinstance(scale_factor, (int, float)):
+        scale_factor = [scale_factor] * len(shape_sp)
+    return [int(np.floor(s * f)) for s, f in zip(shape_sp, scale_factor)]
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    """Reference: nn/functional/common.py interpolate — nearest/bilinear/
+    bicubic/trilinear/area via jax.image.resize."""
+    if size is None and scale_factor is None:
+        raise ValueError("one of size / scale_factor must be set")
+    a = unwrap(x)
+    channel_last = data_format in ("NHWC", "NDHWC", "NWC")
+    nd = a.ndim - 2
+    sp_axes = list(range(1, 1 + nd)) if channel_last \
+        else list(range(2, 2 + nd))
+    out_sp = _interp_size([a.shape[i] for i in sp_axes], size, scale_factor)
+    out_shape = list(a.shape)
+    for ax, s in zip(sp_axes, out_sp):
+        out_shape[ax] = s
+    method = {"nearest": "nearest", "bilinear": "linear", "area": "linear",
+              "bicubic": "cubic", "trilinear": "linear",
+              "linear": "linear"}[mode]
+
+    def fn(v):
+        return jax.image.resize(v, out_shape, method=method).astype(v.dtype)
+    return run_op("interpolate", fn, [x])
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference common.py unfold): NCHW -> [N, C*kh*kw, L]."""
+    def to2(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    kh, kw = to2(kernel_sizes)
+    sh, sw = to2(strides)
+    dh, dw = to2(dilations)
+    p = paddings
+    if isinstance(p, int):
+        pt = pb = pl = pr = p
+    elif len(p) == 2:
+        pt = pb = p[0]
+        pl = pr = p[1]
+    else:
+        pt, pl, pb, pr = p
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, [(0, 0), (0, 0), (pt, pb), (pl, pr)])
+        patches = jax.lax.conv_general_dilated_patches(
+            a, (kh, kw), (sh, sw), padding="VALID",
+            rhs_dilation=(dh, dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # [N, C*kh*kw, OH, OW] -> [N, C*kh*kw, L]
+        return patches.reshape(n, c * kh * kw, -1)
+    return run_op("unfold", fn, [x])
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im — adjoint of unfold (reference common.py fold)."""
+    def to2(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    oh, ow = to2(output_sizes)
+    kh, kw = to2(kernel_sizes)
+    sh, sw = to2(strides)
+    dh, dw = to2(dilations)
+    p = paddings
+    if isinstance(p, int):
+        pt = pb = pl = pr = p
+    elif len(p) == 2:
+        pt = pb = p[0]
+        pl = pr = p[1]
+    else:
+        pt, pl, pb, pr = p
+
+    def fn(cols):
+        n, ckk, L = cols.shape
+        c = ckk // (kh * kw)
+        hp, wp = oh + pt + pb, ow + pl + pr
+        ncols = cols.reshape(n, c, kh, kw, L)
+        out = jnp.zeros((n, c, hp, wp), cols.dtype)
+        l_h = (hp - (kh - 1) * dh - 1) // sh + 1
+        l_w = (wp - (kw - 1) * dw - 1) // sw + 1
+        idx = 0
+        # scatter-add each kernel offset's strided window (static loops -> XLA)
+        for i in range(kh):
+            for j in range(kw):
+                patch = ncols[:, :, i, j, :].reshape(n, c, l_h, l_w)
+                out = out.at[:, :,
+                             i * dh:i * dh + l_h * sh:sh,
+                             j * dw:j * dw + l_w * sw:sw].add(patch)
+        return out[:, :, pt:hp - pb if pb else hp, pl:wp - pr if pr else wp]
+    return run_op("fold", fn, [x])
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def fn(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+    return run_op("cosine_similarity", fn, [x1, x2])
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """Reference common.py bilinear: out[n,o] = x1[n,i] W[o,i,j] x2[n,j]."""
+    def fn(a, b, w, *rest):
+        out = jnp.einsum("ni,oij,nj->no", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    args = [x1, x2, weight] + ([bias] if bias is not None else [])
+    return run_op("bilinear", fn, args)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, c // (r * r))
+    return run_op("pixel_shuffle", fn, [x])
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h // r, w // r, c * r * r)
+    return run_op("pixel_unshuffle", fn, [x])
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, groups, c // groups, h, w)
+            return a.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, groups, c // groups)
+        return a.transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+    return run_op("channel_shuffle", fn, [x])

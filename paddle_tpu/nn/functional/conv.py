@@ -1,0 +1,175 @@
+"""Convolution functionals on lax.conv_general_dilated.
+
+Reference: python/paddle/nn/functional/conv.py (conv1d/2d/3d + transpose).
+Weight layout follows paddle: [out_c, in_c/groups, *kernel]; data layouts
+NCHW (default) or NHWC — on TPU, XLA tiles either onto the MXU, so no
+explicit layout transform is done here.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import run_op, unwrap
+
+
+def _tuple(v, n):
+    if isinstance(v, (int, float)):
+        return (int(v),) * n
+    v = tuple(int(i) for i in v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _padding(padding, nd, strides, dilations, ksize):
+    """Normalize paddle padding (int | list | 'SAME'/'VALID') to lax pairs."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    padding = list(padding)
+    if len(padding) == nd and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * nd:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(nd)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        return [tuple(p) for p in padding]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _dn(nd, channel_last):
+    sp = "DHW"[-nd:] if nd <= 3 else None
+    lhs = ("N" + sp + "C") if channel_last else ("NC" + sp)
+    rhs = "OI" + sp
+    return (lhs, rhs, lhs)
+
+
+def _conv(name, x, weight, bias, stride, padding, dilation, groups,
+          channel_last, nd):
+    strides = _tuple(stride, nd)
+    dilations = _tuple(dilation, nd)
+    ksize = unwrap(weight).shape[2:]
+    pad = _padding(padding, nd, strides, dilations, ksize)
+    dn = _dn(nd, channel_last)
+
+    def fn(a, w, *rest):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad,
+            rhs_dilation=dilations, feature_group_count=groups,
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                a.shape, w.shape, dn))
+        if rest:
+            b = rest[0]
+            bshape = [1] * out.ndim
+            bshape[-1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(bshape)
+        return out
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return run_op(name, fn, args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv("conv1d", x, weight, bias, stride, padding, dilation,
+                 groups, data_format == "NLC", 1)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv("conv2d", x, weight, bias, stride, padding, dilation,
+                 groups, data_format == "NHWC", 2)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv("conv3d", x, weight, bias, stride, padding, dilation,
+                 groups, data_format == "NDHWC", 3)
+
+
+def _conv_transpose(name, x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, channel_last, nd, output_size=None):
+    """paddle conv_transpose: weight layout [in_c, out_c/groups, *k].
+
+    Implemented as the gradient convolution: lax.conv_transpose handles the
+    fractional stride; paddle 'padding' reduces the output on each side."""
+    strides = _tuple(stride, nd)
+    dilations = _tuple(dilation, nd)
+    pads = _padding(padding, nd, strides, dilations,
+                    unwrap(weight).shape[2:])
+    if isinstance(pads, str):
+        pad_pairs = None
+    else:
+        pad_pairs = pads
+    opad = _tuple(output_padding, nd)
+    dn = _dn(nd, channel_last)
+
+    def fn(a, w, *rest):
+        k = w.shape[2:]
+        # transpose conv via input dilation: insert (s-1) zeros between
+        # input elements then run a regular conv with flipped kernel.
+        if groups > 1:
+            ws = jnp.split(w, groups, axis=0)
+            wg = jnp.concatenate(
+                [jnp.flip(t, axis=tuple(range(2, 2 + nd))).swapaxes(0, 1)
+                 for t in ws], axis=0)
+        else:
+            wg = jnp.flip(w, axis=tuple(range(2, 2 + nd))).swapaxes(0, 1)
+        if pad_pairs is None:
+            base = [(0, 0)] * nd
+        else:
+            base = pad_pairs
+        conv_pad = []
+        for i in range(nd):
+            eff_k = (k[i] - 1) * dilations[i]
+            lo = eff_k - base[i][0]
+            hi = eff_k - base[i][1] + opad[i]
+            conv_pad.append((lo, hi))
+        out = jax.lax.conv_general_dilated(
+            a, wg, window_strides=(1,) * nd, padding=conv_pad,
+            lhs_dilation=strides, rhs_dilation=dilations,
+            feature_group_count=groups,
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                a.shape, wg.shape, dn))
+        if rest:
+            b = rest[0]
+            bshape = [1] * out.ndim
+            bshape[-1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(bshape)
+        return out
+    args = [x, weight] + ([bias] if bias is not None else [])
+    out = run_op(name, fn, args)
+    if output_size is not None:
+        got = unwrap(out).shape
+        sp = got[1:1 + nd] if channel_last else got[2:2 + nd]
+        want = _tuple(output_size, nd)
+        if tuple(sp) != tuple(want):
+            raise ValueError(
+                f"{name}: computed spatial size {tuple(sp)} != "
+                f"requested output_size {tuple(want)}; adjust output_padding")
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    return _conv_transpose("conv1d_transpose", x, weight, bias, stride,
+                           padding, output_padding, dilation, groups,
+                           data_format == "NLC", 1, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose("conv2d_transpose", x, weight, bias, stride,
+                           padding, output_padding, dilation, groups,
+                           data_format == "NHWC", 2, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose("conv3d_transpose", x, weight, bias, stride,
+                           padding, output_padding, dilation, groups,
+                           data_format == "NDHWC", 3, output_size)

@@ -1,0 +1,286 @@
+"""Loss functionals.
+
+Reference: python/paddle/nn/functional/loss.py. cross_entropy follows the
+paddle contract: integer labels (sparse) or soft labels, ignore_index,
+class weights, reduction modes, axis.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import run_op, unwrap
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if reduction == "none":
+        return loss
+    raise ValueError(f"reduction must be mean/sum/none, got {reduction}")
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    """Reference: nn/functional/loss.py cross_entropy."""
+    def fn(logits, lab, *rest):
+        lp = jax.nn.log_softmax(logits, axis=axis) if use_softmax \
+            else jnp.log(jnp.clip(logits, 1e-15, 1.0))
+        nclass = logits.shape[axis]
+        if soft_label or (lab.ndim == logits.ndim
+                          and lab.shape == logits.shape
+                          and jnp.issubdtype(lab.dtype, jnp.floating)):
+            soft = lab
+            if label_smoothing > 0.0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / nclass
+            loss = -jnp.sum(soft * lp, axis=axis)
+            if rest:
+                w = rest[0]
+                loss = loss * jnp.sum(soft * w, axis=axis)
+            return _reduce(loss, reduction)
+        lab_i = lab
+        if lab_i.ndim == logits.ndim:
+            lab_i = jnp.squeeze(lab_i, axis=axis)
+        lab_i = lab_i.astype(jnp.int32)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        picked = jnp.take_along_axis(
+            lp, jnp.expand_dims(safe, axis), axis=axis)
+        loss = -jnp.squeeze(picked, axis=axis)
+        if label_smoothing > 0.0:
+            smooth = -jnp.mean(lp, axis=axis)
+            loss = (1 - label_smoothing) * loss + label_smoothing * smooth
+        if rest:
+            w = rest[0]
+            wsel = jnp.take(w, safe)
+            loss = loss * wsel
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(
+                    jnp.sum(jnp.where(valid, wsel, 0.0)), 1e-12)
+            return _reduce(loss, reduction)
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return _reduce(loss, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return run_op("cross_entropy", fn, args)
+
+
+softmax_with_cross_entropy = cross_entropy
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    def fn(lp, lab, *rest):
+        lab_i = lab.astype(jnp.int32)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        picked = jnp.take_along_axis(lp, jnp.expand_dims(safe, 1), axis=1)
+        loss = -jnp.squeeze(picked, axis=1)
+        if rest:
+            wsel = jnp.take(rest[0], safe)
+            loss = loss * wsel
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(
+                    jnp.sum(jnp.where(valid, wsel, 0.0)), 1e-12)
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return _reduce(loss, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return run_op("nll_loss", fn, args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return run_op("mse_loss",
+                  lambda a, b: _reduce(jnp.square(a - b), reduction),
+                  [input, label])
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return run_op("l1_loss",
+                  lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                  [input, label])
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss, reduction)
+    return run_op("smooth_l1_loss", fn, [input, label])
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    def fn(p, y, *rest):
+        p = jnp.clip(p, 1e-12, 1 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if rest:
+            loss = loss * rest[0]
+        return _reduce(loss, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return run_op("binary_cross_entropy", fn, args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def fn(z, y, *rest):
+        it = iter(rest)
+        # numerically stable: max(z,0) - z*y + log(1+exp(-|z|))
+        loss = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if pos_weight is not None:
+            pw = next(it)
+            log_sig = jax.nn.log_sigmoid(z)
+            log_sig_neg = jax.nn.log_sigmoid(-z)
+            loss = -(pw * y * log_sig + (1 - y) * log_sig_neg)
+        if weight is not None:
+            loss = loss * next(it)
+        return _reduce(loss, reduction)
+    args = [logit, label] + [t for t in (pos_weight, weight)
+                             if t is not None]
+    return run_op("bce_with_logits", fn, args)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def fn(lp, y):
+        if log_target:
+            loss = jnp.exp(y) * (y - lp)
+        else:
+            loss = y * (jnp.log(jnp.clip(y, 1e-12)) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / lp.shape[0]
+        return _reduce(loss, reduction)
+    return run_op("kl_div", fn, [input, label])
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    def fn(a, y):
+        loss = jnp.where(y == 1., a, jnp.maximum(0., margin - a))
+        return _reduce(loss, reduction)
+    return run_op("hinge_embedding_loss", fn, [input, label])
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean",
+                          name=None):
+    def fn(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0., cos - margin))
+        return _reduce(loss, reduction)
+    return run_op("cosine_embedding_loss", fn, [input1, input2, label])
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def fn(a, b, y):
+        return _reduce(jnp.maximum(0., -y * (a - b) + margin), reduction)
+    return run_op("margin_ranking_loss", fn, [input, other, label])
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    def fn(a, pos, neg):
+        def dist(u, v):
+            return jnp.sum(jnp.abs(u - v + epsilon) ** p, -1) ** (1 / p)
+        d_ap = dist(a, pos)
+        d_an = dist(a, neg)
+        if swap:
+            d_pn = dist(pos, neg)
+            d_an = jnp.minimum(d_an, d_pn)
+        return _reduce(jnp.maximum(0., d_ap - d_an + margin), reduction)
+    return run_op("triplet_margin_loss", fn, [input, positive, negative])
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def fn(z, y, *rest):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        mod = (1 - p_t) ** gamma
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * mod * ce
+        if rest:
+            loss = loss / rest[0]
+        return _reduce(loss, reduction)
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return run_op("sigmoid_focal_loss", fn, args)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def fn(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+    return run_op("log_loss", fn, [input, label])
+
+
+def square_error_cost(input, label):
+    return run_op("square_error_cost",
+                  lambda a, b: jnp.square(a - b), [input, label])
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard forward algorithm in log space
+    (reference: nn/functional/loss.py ctc_loss, warpctc kernel)."""
+    def fn(lp, lab, in_len, lab_len):
+        # lp: [T, B, C] log probs (paddle convention: logits [T,B,C])
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        T, B, C = lp.shape
+        S = lab.shape[1]
+        ext = jnp.full((B, 2 * S + 1), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        L = 2 * lab_len.astype(jnp.int32) + 1
+        neg_inf = jnp.float32(-1e30)
+        alpha = jnp.full((B, 2 * S + 1), neg_inf)
+        alpha = alpha.at[:, 0].set(lp[0, :, blank])
+        has1 = (L > 1)
+        alpha = alpha.at[:, 1].set(
+            jnp.where(has1,
+                      jnp.take_along_axis(lp[0], ext[:, 1:2], 1)[:, 0],
+                      neg_inf))
+
+        same = jnp.concatenate(
+            [jnp.ones((B, 2), bool),
+             ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha_prev, lp_t):
+            a0 = alpha_prev
+            a1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha_prev[:, :-1]], 1)
+            a2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha_prev[:, :-2]], 1)
+            a2 = jnp.where(same, neg_inf, a2)
+            merged = jnp.logaddexp(jnp.logaddexp(a0, a1), a2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, None
+
+        def scan_body(carry, lp_t_and_t):
+            lp_t, t = lp_t_and_t
+            new, _ = step(carry, lp_t)
+            keep = (t < in_len)[:, None]
+            return jnp.where(keep, new, carry), None
+
+        ts = jnp.arange(1, T)
+        alpha, _ = jax.lax.scan(scan_body, alpha, (lp[1:], ts))
+        idx_last = (L - 1)[:, None]
+        idx_prev = jnp.maximum(L - 2, 0)[:, None]
+        a_last = jnp.take_along_axis(alpha, idx_last, 1)[:, 0]
+        a_prev = jnp.take_along_axis(alpha, idx_prev, 1)[:, 0]
+        ll = jnp.logaddexp(a_last, jnp.where(L > 1, a_prev, neg_inf))
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len, 1))
+        return _reduce(loss, reduction)
+    return run_op("ctc_loss", fn,
+                  [log_probs, labels, input_lengths, label_lengths])

@@ -1,0 +1,170 @@
+"""Normalization functionals.
+
+Reference: python/paddle/nn/functional/norm.py. batch_norm takes running
+mean/var tensors and (at train time) returns updated statistics by mutating
+the passed buffers — mirroring the reference's in-place stat update — while
+the arithmetic itself stays pure for the jit path.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.dispatch import run_op, unwrap, wrap
+from ...core.tensor import Tensor
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(a):
+        if p == 2:
+            n = jnp.sqrt(jnp.sum(a * a, axis=axis, keepdims=True))
+        else:
+            n = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1. / p)
+        return a / jnp.maximum(n, epsilon)
+    return run_op("normalize", fn, [x])
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    ndim = len(normalized_shape)
+
+    def fn(a, *rest):
+        axes = tuple(range(a.ndim - ndim, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) / jnp.sqrt(var + epsilon)
+        it = iter(rest)
+        if weight is not None:
+            out = out * next(it)
+        if bias is not None:
+            out = out + next(it)
+        return out.astype(a.dtype)
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return run_op("layer_norm", fn, args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (no reference equivalent op; used by fused_rms_norm in
+    incubate and the LLaMA family)."""
+    def fn(a, *rest):
+        ms = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1,
+                      keepdims=True)
+        out = a * jnp.reciprocal(jnp.sqrt(ms + epsilon)).astype(a.dtype)
+        if rest:
+            out = out * rest[0]
+        return out
+    args = [x] + ([weight] if weight is not None else [])
+    return run_op("rms_norm", fn, args)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """Reference: nn/functional/norm.py batch_norm. In training mode the
+    running stats buffers are updated in place:
+    running = momentum * running + (1 - momentum) * batch_stat."""
+    channel_axis = 1
+    if data_format in ("NHWC", "NDHWC", "NLC"):
+        channel_axis = unwrap(x).ndim - 1
+    use_batch_stats = training and not use_global_stats
+
+    a = unwrap(x)
+    axes = tuple(i for i in range(a.ndim) if i != channel_axis)
+
+    if use_batch_stats:
+        batch_mean = jnp.mean(a, axis=axes)
+        batch_var = jnp.var(a, axis=axes)
+        n = 1
+        for i in axes:
+            n *= a.shape[i]
+        unbiased = batch_var * (n / max(n - 1, 1))
+        running_mean._data = (momentum * running_mean._data +
+                              (1 - momentum) * batch_mean.astype(
+                                  running_mean._data.dtype))
+        running_var._data = (momentum * running_var._data +
+                             (1 - momentum) * unbiased.astype(
+                                 running_var._data.dtype))
+        mean_t = wrap(batch_mean)
+        var_t = wrap(batch_var)
+    else:
+        mean_t, var_t = running_mean, running_var
+
+    def fn(v, m, s, *rest):
+        shape = [1] * v.ndim
+        shape[channel_axis] = v.shape[channel_axis]
+        out = (v - m.reshape(shape)) / jnp.sqrt(s.reshape(shape) + epsilon)
+        it = iter(rest)
+        if weight is not None:
+            out = out * next(it).reshape(shape)
+        if bias is not None:
+            out = out + next(it).reshape(shape)
+        return out.astype(v.dtype)
+    args = [x, mean_t, var_t] + [t for t in (weight, bias) if t is not None]
+    return run_op("batch_norm", fn, args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    def fn(a, *rest):
+        axes = tuple(range(2, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) / jnp.sqrt(var + eps)
+        it = iter(rest)
+        if weight is not None:
+            w = next(it)
+            out = out * w.reshape((1, -1) + (1,) * (a.ndim - 2))
+        if bias is not None:
+            b = next(it)
+            out = out + b.reshape((1, -1) + (1,) * (a.ndim - 2))
+        return out.astype(a.dtype)
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return run_op("instance_norm", fn, args)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    channel_last = data_format in ("NHWC", "NDHWC", "NLC")
+
+    def fn(a, *rest):
+        if channel_last:
+            a_t = jnp.moveaxis(a, -1, 1)
+        else:
+            a_t = a
+        n, c = a_t.shape[:2]
+        sp = a_t.shape[2:]
+        g = a_t.reshape(n, num_groups, c // num_groups, *sp)
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) / jnp.sqrt(var + epsilon)).reshape(a_t.shape)
+        it = iter(rest)
+        shape = (1, c) + (1,) * len(sp)
+        if weight is not None:
+            out = out * next(it).reshape(shape)
+        if bias is not None:
+            out = out + next(it).reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out.astype(a.dtype)
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return run_op("group_norm", fn, args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def fn(a):
+        ch_ax = 1 if data_format.startswith("NC") else a.ndim - 1
+        sq = jnp.square(a)
+        half = size // 2
+        pads = [(0, 0)] * a.ndim
+        pads[ch_ax] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        win = sum(
+            jnp.take(padded,
+                     jnp.arange(i, i + a.shape[ch_ax]), axis=ch_ax)
+            for i in range(size))
+        return a / ((k + alpha * win) ** beta)
+    return run_op("local_response_norm", fn, [x])

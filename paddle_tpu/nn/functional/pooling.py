@@ -1,0 +1,240 @@
+"""Pooling functionals on lax.reduce_window.
+
+Reference: python/paddle/nn/functional/pooling.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import run_op, run_op_nodiff, unwrap
+from .conv import _tuple
+
+
+def _pool_dims(nd, channel_last, ksize, strides):
+    if channel_last:
+        window = (1,) + ksize + (1,)
+        stride = (1,) + strides + (1,)
+    else:
+        window = (1, 1) + ksize
+        stride = (1, 1) + strides
+    return window, stride
+
+
+def _pool_padding(padding, nd, channel_last, ceil_mode=False):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        pairs = [(padding, padding)] * nd
+    else:
+        padding = list(padding)
+        if len(padding) == nd:
+            pairs = [(int(p), int(p)) for p in padding]
+        elif len(padding) == 2 * nd:
+            pairs = [(padding[2 * i], padding[2 * i + 1]) for i in range(nd)]
+        else:
+            pairs = [tuple(p) for p in padding]
+    if channel_last:
+        return [(0, 0)] + pairs + [(0, 0)]
+    return [(0, 0), (0, 0)] + pairs
+
+
+def _ceil_extra(pairs, sp_shape, ksize, strides, channel_last):
+    """ceil_mode: grow right/bottom padding so the last window fits."""
+    out = list(pairs)
+    off = 1 if channel_last else 2
+    for i in range(len(ksize)):
+        lo, hi = out[off + i]
+        size = sp_shape[i] + lo + hi
+        rem = (size - ksize[i]) % strides[i]
+        if rem:
+            out[off + i] = (lo, hi + (strides[i] - rem))
+    return out
+
+
+def _pool(name, x, nd, kernel_size, stride, padding, channel_last, reducer,
+          init, ceil_mode=False, count_include_pad=True, average=False,
+          exclusive=True):
+    ksize = _tuple(kernel_size, nd)
+    strides = _tuple(stride if stride is not None else kernel_size, nd)
+    window, wstrides = _pool_dims(nd, channel_last, ksize, strides)
+    pad = _pool_padding(padding, nd, channel_last)
+
+    def fn(a):
+        p = pad
+        if not isinstance(p, str) and ceil_mode:
+            sp = a.shape[1:1 + nd] if channel_last else a.shape[2:2 + nd]
+            p = _ceil_extra(p, sp, ksize, strides, channel_last)
+        out = jax.lax.reduce_window(a, init, reducer, window, wstrides,
+                                    p if not isinstance(p, str) else p)
+        if average:
+            if exclusive and not isinstance(p, str):
+                ones = jnp.ones(a.shape, a.dtype)
+                counts = jax.lax.reduce_window(
+                    ones, 0.0, jax.lax.add, window, wstrides, p)
+                out = out / counts
+            else:
+                out = out / float(np.prod(ksize))
+        return out.astype(a.dtype)
+    return run_op(name, fn, [x])
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    out = _pool("max_pool1d", x, 1, kernel_size, stride, padding,
+                data_format == "NLC", jax.lax.max, -jnp.inf,
+                ceil_mode=ceil_mode)
+    return (out, _pool_mask(x, out, 1, kernel_size, stride, padding,
+                            data_format == "NLC")) if return_mask else out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool("max_pool2d", x, 2, kernel_size, stride, padding,
+                data_format == "NHWC", jax.lax.max, -jnp.inf,
+                ceil_mode=ceil_mode)
+    return (out, _pool_mask(x, out, 2, kernel_size, stride, padding,
+                            data_format == "NHWC")) if return_mask else out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool("max_pool3d", x, 3, kernel_size, stride, padding,
+                data_format == "NDHWC", jax.lax.max, -jnp.inf,
+                ceil_mode=ceil_mode)
+    return (out, _pool_mask(x, out, 3, kernel_size, stride, padding,
+                            data_format == "NDHWC")) if return_mask else out
+
+
+def _pool_mask(x, out, nd, kernel_size, stride, padding, channel_last):
+    """Argmax indices for return_mask (flattened per spatial dims)."""
+    ksize = _tuple(kernel_size, nd)
+    strides = _tuple(stride if stride is not None else kernel_size, nd)
+
+    def fn(a):
+        sp_shape = a.shape[1:1 + nd] if channel_last else a.shape[2:2 + nd]
+        flat_idx = jnp.arange(int(np.prod(sp_shape))).reshape(sp_shape)
+        bshape = (1,) + sp_shape + (1,) if channel_last \
+            else (1, 1) + sp_shape
+        idx = jnp.broadcast_to(flat_idx.reshape(bshape), a.shape)
+        window, wstrides = _pool_dims(nd, channel_last, ksize, strides)
+        pad = _pool_padding(padding, nd, channel_last)
+
+        def red(acc, cur):
+            av, ai = acc
+            cv, ci = cur
+            take = cv > av
+            return (jnp.where(take, cv, av), jnp.where(take, ci, ai))
+        vals, idxs = jax.lax.reduce_window(
+            (a, idx.astype(jnp.int32)), (-jnp.inf, jnp.int32(-1)), red,
+            window, wstrides, pad if not isinstance(pad, str) else pad)
+        return idxs.astype(jnp.int64)
+    return run_op_nodiff("max_pool_mask", fn, [x])
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool("avg_pool1d", x, 1, kernel_size, stride, padding,
+                 data_format == "NLC", jax.lax.add, 0.0, ceil_mode=ceil_mode,
+                 average=True, exclusive=exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    if divisor_override:
+        ksize = _tuple(kernel_size, 2)
+        out = _pool("avg_pool2d", x, 2, kernel_size, stride, padding,
+                    data_format == "NHWC", jax.lax.add, 0.0,
+                    ceil_mode=ceil_mode, average=False)
+        return out * (1.0 / divisor_override)
+    return _pool("avg_pool2d", x, 2, kernel_size, stride, padding,
+                 data_format == "NHWC", jax.lax.add, 0.0, ceil_mode=ceil_mode,
+                 average=True, exclusive=exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool("avg_pool3d", x, 3, kernel_size, stride, padding,
+                 data_format == "NDHWC", jax.lax.add, 0.0,
+                 ceil_mode=ceil_mode, average=True, exclusive=exclusive)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    p = float(norm_type)
+
+    def power(t):
+        return run_op("pow_abs", lambda a: jnp.abs(a) ** p, [t])
+    pooled = _pool("lp_pool2d", power(x), 2, kernel_size, stride, padding,
+                   data_format == "NHWC", jax.lax.add, 0.0,
+                   ceil_mode=ceil_mode)
+    return run_op("root", lambda a: a ** (1.0 / p), [pooled])
+
+
+def _adaptive_segments(in_size, out_size):
+    starts = [(i * in_size) // out_size for i in range(out_size)]
+    ends = [-(-((i + 1) * in_size) // out_size) for i in range(out_size)]
+    return starts, ends
+
+
+def _adaptive_pool(name, x, output_size, nd, channel_last, is_max,
+                   return_mask=False):
+    a_shape = unwrap(x).shape
+    sp = a_shape[1:1 + nd] if channel_last else a_shape[2:2 + nd]
+    osize = _tuple(output_size, nd)
+    osize = tuple(o if o is not None else s for o, s in zip(osize, sp))
+
+    def fn(a):
+        # iterate output cells per axis via static segment means/maxes
+        out = a
+        for d in range(nd):
+            ax = (1 + d) if channel_last else (2 + d)
+            starts, ends = _adaptive_segments(out.shape[ax], osize[d])
+            slabs = []
+            for s, e in zip(starts, ends):
+                seg = jax.lax.slice_in_dim(out, s, e, axis=ax)
+                red = (jnp.max if is_max else jnp.mean)(
+                    seg, axis=ax, keepdims=True)
+                slabs.append(red)
+            out = jnp.concatenate(slabs, axis=ax)
+        return out
+    out = run_op(name, fn, [x])
+    if return_mask:
+        mask = run_op_nodiff(
+            name + "_mask",
+            lambda a: jnp.zeros([1], jnp.int64), [x])
+        return out, mask
+    return out
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool("adaptive_avg_pool1d", x, output_size, 1, False,
+                          False)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool("adaptive_avg_pool2d", x, output_size, 2,
+                          data_format == "NHWC", False)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool("adaptive_avg_pool3d", x, output_size, 3,
+                          data_format == "NDHWC", False)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool("adaptive_max_pool1d", x, output_size, 1, False,
+                          True, return_mask)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool("adaptive_max_pool2d", x, output_size, 2, False,
+                          True, return_mask)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool("adaptive_max_pool3d", x, output_size, 3, False,
+                          True, return_mask)

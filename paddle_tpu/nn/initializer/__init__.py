@@ -1,0 +1,242 @@
+"""Weight initializers.
+
+Reference: python/paddle/nn/initializer/ (constant.py, normal.py, uniform.py,
+xavier.py, kaiming.py, assign.py, orthogonal.py, dirac.py). An Initializer is
+a callable that fills a Parameter's array in place using the global generator
+(core/random.py) — there is no program/block; sampling happens through
+jax.random with explicitly split keys so it is reproducible under seed().
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import random as random_mod
+from ...core.tensor import Tensor
+
+
+def calculate_gain(nonlinearity, param=None):
+    """Reference: python/paddle/nn/initializer/initializer.py calculate_gain."""
+    gains = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+        "conv3d": 1.0, "conv1d_transpose": 1.0, "conv2d_transpose": 1.0,
+        "conv3d_transpose": 1.0, "tanh": 5.0 / 3.0,
+        "relu": math.sqrt(2.0), "selu": 3.0 / 4.0,
+    }
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity in gains:
+        return gains[nonlinearity]
+    raise ValueError(f"unsupported nonlinearity: {nonlinearity}")
+
+
+class Initializer:
+    def __call__(self, param: Tensor, block=None):
+        raise NotImplementedError
+
+    def _set(self, param, arr):
+        param._data = jnp.asarray(arr, dtype=param._data.dtype)
+        return param
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, param, block=None):
+        return self._set(param, jnp.full(param._data.shape, self.value))
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def __call__(self, param, block=None):
+        key = random_mod.next_key()
+        sample = jax.random.normal(key, param._data.shape, jnp.float32)
+        return self._set(param, sample * self.std + self.mean)
+
+
+class TruncatedNormal(Initializer):
+    """Truncated at [mean - a*std, mean + b*std] (reference default 2 std)."""
+
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0, name=None):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, param, block=None):
+        key = random_mod.next_key()
+        sample = jax.random.truncated_normal(
+            key, self.a, self.b, param._data.shape, jnp.float32)
+        return self._set(param, sample * self.std + self.mean)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0, name=None):
+        self.low, self.high = low, high
+
+    def __call__(self, param, block=None):
+        key = random_mod.next_key()
+        sample = jax.random.uniform(key, param._data.shape, jnp.float32,
+                                    self.low, self.high)
+        return self._set(param, sample)
+
+
+def _fan_in_out(shape):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        # paddle Linear weight layout is [in, out]
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    # conv weight [out_c, in_c/groups, *k]
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, param, block=None):
+        fi, fo = _fan_in_out(param._data.shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        key = random_mod.next_key()
+        return self._set(param, jax.random.normal(
+            key, param._data.shape, jnp.float32) * std)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, param, block=None):
+        fi, fo = _fan_in_out(param._data.shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        key = random_mod.next_key()
+        return self._set(param, jax.random.uniform(
+            key, param._data.shape, jnp.float32, -limit, limit))
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0,
+                 nonlinearity="relu", name=None):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, param, block=None):
+        fi, _ = _fan_in_out(param._data.shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope) \
+            if self.nonlinearity == "leaky_relu" else \
+            calculate_gain(self.nonlinearity)
+        std = gain / math.sqrt(fi)
+        key = random_mod.next_key()
+        return self._set(param, jax.random.normal(
+            key, param._data.shape, jnp.float32) * std)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0,
+                 nonlinearity="relu", name=None):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, param, block=None):
+        fi, _ = _fan_in_out(param._data.shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope) \
+            if self.nonlinearity == "leaky_relu" else \
+            calculate_gain(self.nonlinearity)
+        limit = gain * math.sqrt(3.0 / fi)
+        key = random_mod.next_key()
+        return self._set(param, jax.random.uniform(
+            key, param._data.shape, jnp.float32, -limit, limit))
+
+
+class Assign(Initializer):
+    def __init__(self, value, name=None):
+        self.value = value
+
+    def __call__(self, param, block=None):
+        arr = self.value
+        if isinstance(arr, Tensor):
+            arr = arr._data
+        arr = jnp.asarray(np.asarray(arr))
+        if tuple(arr.shape) != tuple(param._data.shape):
+            raise ValueError(
+                f"Assign initializer shape {arr.shape} does not match "
+                f"parameter shape {param._data.shape}")
+        return self._set(param, arr)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0, name=None):
+        self.gain = gain
+
+    def __call__(self, param, block=None):
+        shape = param._data.shape
+        if len(shape) < 2:
+            raise ValueError("Orthogonal init needs >= 2 dims")
+        rows = shape[0]
+        cols = int(np.prod(shape[1:]))
+        key = random_mod.next_key()
+        flat = jax.random.orthogonal(key, max(rows, cols))[:rows, :cols]
+        return self._set(param, self.gain * flat.reshape(shape))
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv init (reference nn/initializer/dirac.py)."""
+
+    def __init__(self, groups=1, name=None):
+        self.groups = groups
+
+    def __call__(self, param, block=None):
+        shape = param._data.shape
+        if len(shape) not in (3, 4, 5):
+            raise ValueError("Dirac init expects conv weight (3/4/5-D)")
+        arr = np.zeros(shape, np.float32)
+        out_per_group = shape[0] // self.groups
+        mid = [k // 2 for k in shape[2:]]
+        for g in range(self.groups):
+            for i in range(min(out_per_group, shape[1])):
+                idx = (g * out_per_group + i, i, *mid)
+                arr[idx] = 1.0
+        return self._set(param, arr)
+
+
+# paddle re-exports under these names too
+ConstantInitializer = Constant
+NormalInitializer = Normal
+UniformInitializer = Uniform
+XavierInitializer = XavierNormal
+MSRAInitializer = KaimingNormal
+NumpyArrayInitializer = Assign
+
+_global_weight_initializer = None
+_global_bias_initializer = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Reference: nn/initializer/__init__.py set_global_initializer."""
+    global _global_weight_initializer, _global_bias_initializer
+    _global_weight_initializer = weight_init
+    _global_bias_initializer = bias_init
+
+
+def global_weight_initializer():
+    return _global_weight_initializer
+
+
+def global_bias_initializer():
+    return _global_bias_initializer

@@ -1,0 +1,88 @@
+"""nn.utils (reference: python/paddle/nn/utils)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import unwrap, wrap
+from ...core.tensor import Tensor
+from ..clip import clip_grad_norm_  # noqa: F401
+
+
+def parameters_to_vector(parameters, name=None):
+    arrs = [unwrap(p).reshape(-1) for p in parameters]
+    return wrap(jnp.concatenate(arrs))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    a = unwrap(vec)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p._data.shape)) if p._data.shape else 1
+        p._data = a[off:off + n].reshape(p._data.shape).astype(p._data.dtype)
+        off += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize weight = g * v / ||v|| (reference nn/utils/weight_norm_hook.py)."""
+    from ...framework.param_attr import Parameter
+    w = getattr(layer, name)
+    arr = unwrap(w)
+    axes = tuple(i for i in range(arr.ndim) if i != dim)
+    g = jnp.sqrt(jnp.sum(jnp.square(arr), axis=axes, keepdims=False))
+    layer.add_parameter(name + "_g", Parameter(np.asarray(g)))
+    layer.add_parameter(name + "_v", Parameter(np.asarray(arr)))
+    del layer._parameters[name]
+
+    def hook(l, inputs):
+        v = unwrap(getattr(l, name + "_v"))
+        gg = unwrap(getattr(l, name + "_g"))
+        norm = jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True))
+        shape = [1] * v.ndim
+        shape[dim] = -1
+        wt = v / jnp.maximum(norm, 1e-12) * gg.reshape(shape)
+        object.__setattr__(l, "_wn_cached", wrap(wt))
+        l._parameters[name] = None  # looked up via __getattr__ below
+        object.__setattr__(l, name, l._wn_cached)
+    layer.register_forward_pre_hook(hook)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    v = getattr(layer, name + "_v")
+    layer._parameters.pop(name + "_g", None)
+    layer._parameters.pop(name + "_v", None)
+    layer._parameters[name] = v
+    layer._forward_pre_hooks.clear()
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Power-iteration spectral normalization applied as a pre-hook."""
+    if dim is None:
+        dim = 0
+    w = getattr(layer, name)
+    arr = unwrap(w)
+    h = arr.shape[dim]
+    rng = np.random.default_rng(0)
+    u = rng.standard_normal(h).astype(np.float32)
+    state = {"u": jnp.asarray(u / np.linalg.norm(u))}
+
+    def hook(l, inputs):
+        wt = unwrap(l._parameters[name])
+        mat = jnp.moveaxis(wt, dim, 0).reshape(wt.shape[dim], -1)
+        u_ = state["u"]
+        for _ in range(n_power_iterations):
+            v_ = mat.T @ u_
+            v_ = v_ / jnp.maximum(jnp.linalg.norm(v_), eps)
+            u_ = mat @ v_
+            u_ = u_ / jnp.maximum(jnp.linalg.norm(u_), eps)
+        state["u"] = u_
+        sigma = u_ @ mat @ v_
+        object.__setattr__(l, name + "_orig", l._parameters[name])
+        normalized = wrap(wt / jnp.maximum(sigma, eps))
+        object.__setattr__(l, name, normalized)
+        l._parameters[name] = None
+    layer.register_forward_pre_hook(hook)
+    return layer

@@ -1,0 +1,6 @@
+"""paddle_tpu.optimizer (reference: python/paddle/optimizer)."""
+from . import lr  # noqa: F401
+from .optimizer import Optimizer  # noqa: F401
+from .optimizers import (  # noqa: F401
+    SGD, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, RMSProp,
+)

