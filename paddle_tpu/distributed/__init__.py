@@ -2,12 +2,62 @@
 
 Reference: python/paddle/distributed (152 K LoC: fleet, auto_parallel,
 communication, launch...). TPU-native architecture: ONE device mesh
-(jax.sharding.Mesh) with named axes ['pp','dp','sharding','mp','sep'],
+(jax.sharding.Mesh) with named axes ['pp','dp','sharding','sep','mp'],
 NamedSharding placements instead of DistTensor, and compiled XLA
 collectives instead of eager NCCL calls (SURVEY.md §7.1). The fleet/
 auto_parallel surfaces are kept paddle-shaped on top.
 """
+from . import auto_parallel  # noqa: F401
+from . import communication  # noqa: F401
 from . import env  # noqa: F401
+from . import fleet  # noqa: F401
+from . import mesh  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    Partial, ProcessMesh, Replicate, Shard, ShardingStage1, ShardingStage2,
+    ShardingStage3, dtensor_from_fn, reshard, shard_layer, shard_optimizer,
+    shard_tensor, unshard_dtensor,
+)
+from .communication import (  # noqa: F401
+    P2POp, ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all,
+    alltoall, alltoall_single, barrier, batch_isend_irecv, broadcast,
+    destroy_process_group, get_backend, get_group, irecv, is_available,
+    isend, new_group, recv, reduce, reduce_scatter, scatter, send, wait,
+)
+from .communication.group import Group  # noqa: F401
 from .env import (  # noqa: F401
     get_rank, get_world_size, init_parallel_env, is_initialized,
 )
+from .mesh import (  # noqa: F401
+    build_mesh, get_mesh, set_mesh,
+)
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """Reference: distributed/spawn.py. On TPU a single controller owns
+    all local chips, so spawn degenerates to calling func once; true
+    multi-host launch goes through paddle_tpu.distributed.launch."""
+    func(*args)
+
+
+class ParallelEnv:
+    """Reference: parallel.py ParallelEnv (env-var view)."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def local_rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def nranks(self):
+        return get_world_size()

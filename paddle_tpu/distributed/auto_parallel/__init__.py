@@ -1,0 +1,16 @@
+"""Semi-auto (DTensor-style) parallel API. Reference:
+python/paddle/distributed/auto_parallel/ (55 K LoC) — collapsed to
+NamedSharding + GSPMD on TPU."""
+from .api import (  # noqa: F401
+    ShardingStage0, ShardingStage1, ShardingStage2, ShardingStage3,
+    dtensor_from_fn, get_placement_of, is_dist_tensor, reshard, shard_layer,
+    shard_optimizer, shard_tensor, unshard_dtensor,
+)
+from .placement import (  # noqa: F401
+    Partial, Placement, Replicate, Shard, placements_to_spec,
+    spec_to_placements,
+)
+from .process_mesh import (  # noqa: F401
+    ProcessMesh, auto_process_mesh, get_global_process_mesh,
+    set_global_process_mesh,
+)

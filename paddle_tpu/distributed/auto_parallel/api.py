@@ -1,0 +1,174 @@
+"""Semi-auto parallel (DTensor) API: shard_tensor / reshard / shard_layer /
+shard_optimizer.
+
+Reference: python/paddle/distributed/auto_parallel/api.py:220 (shard_tensor),
+:693 (dtensor_from_fn), :733 (reshard), :844 (shard_layer), :1670
+(shard_optimizer). The reference needs DistTensor + 57 C++ SPMD rules +
+partition/reshard compiler passes; on TPU the entire machinery is
+jax.sharding.NamedSharding + GSPMD propagation (SURVEY.md §7.1):
+
+* shard_tensor  = jax.device_put(x, NamedSharding(mesh, spec))
+* reshard       = jax.device_put to the new sharding (eager) or
+                  with_sharding_constraint (traced)
+* SPMD rules    = GSPMD propagation, free at compile time
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...core.tensor import Tensor
+from .placement import (Partial, Placement, Replicate, Shard,
+                        placements_to_spec, spec_to_placements)
+from .process_mesh import ProcessMesh
+
+
+def _as_process_mesh(mesh) -> ProcessMesh:
+    if isinstance(mesh, ProcessMesh):
+        return mesh
+    from jax.sharding import Mesh
+    if isinstance(mesh, Mesh):
+        return ProcessMesh(mesh)
+    raise TypeError(f"expected ProcessMesh/Mesh, got {type(mesh)}")
+
+
+def _named_sharding(mesh: ProcessMesh, placements, ndim) -> NamedSharding:
+    spec = placements_to_spec(placements, mesh.dim_names, ndim)
+    return NamedSharding(mesh.jax_mesh, spec)
+
+
+def _in_trace(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def shard_tensor(data, mesh, placements, dtype=None, place=None,
+                 stop_gradient=None):
+    """Create a distributed Tensor placed on `mesh` per `placements`.
+
+    Reference api.py:220. Eager: commits the array to the NamedSharding
+    (data actually moves). Traced: a sharding constraint (GSPMD hint).
+    """
+    mesh = _as_process_mesh(mesh)
+    t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
+    arr = t._data
+    sharding = _named_sharding(mesh, placements, arr.ndim)
+    if _in_trace(arr):
+        new = jax.lax.with_sharding_constraint(arr, sharding)
+    else:
+        new = jax.device_put(arr, sharding)
+    out = Tensor._from_array(new, stop_gradient=t.stop_gradient
+                             if stop_gradient is None else stop_gradient,
+                             name=t.name)
+    out.process_mesh = mesh
+    out.placements = list(placements)
+    out.grad = t.grad
+    return out
+
+
+def dtensor_from_fn(fn: Callable, mesh, placements, *args, **kwargs):
+    """Reference api.py:693 — build then shard (XLA may fuse the fill with
+    the placement so replicated init never materialises fully)."""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(dist_tensor, mesh, placements):
+    """Change placements (reference api.py:733). All 15 reference reshard
+    function pairs (r_to_s, s_to_r, p_to_r, cross-mesh...) collapse into
+    one device_put — XLA emits the collective."""
+    return shard_tensor(dist_tensor, mesh, placements)
+
+
+def shard_layer(layer, process_mesh, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """Shard every parameter of `layer` (reference api.py:844).
+
+    shard_fn(name, layer, mesh) should call shard_tensor on the layer's
+    params; default replicates everything on the mesh.
+    """
+    mesh = _as_process_mesh(process_mesh)
+
+    def _default_shard(name, sublayer, mesh):
+        for pname, p in list(sublayer._parameters.items()):
+            nd = p._data.ndim
+            sublayer._parameters[pname] = shard_tensor(
+                p, mesh, [Replicate() for _ in mesh.dim_names],
+                stop_gradient=p.stop_gradient)
+
+    fn = shard_fn or _default_shard
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda lyr, inp: input_fn(inp, mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda lyr, inp, out: output_fn(out, mesh))
+    return layer
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """Shard optimizer states like their parameters (ZeRO-style if the
+    params are sharded). Reference api.py:1670. In the compiled TrainStep
+    path optimizer states are created inside jit and inherit the param
+    sharding automatically; this marks the optimizer so state pytrees get
+    explicit placements."""
+    optimizer._shard_fn = shard_fn
+    optimizer._sharded = True
+    return optimizer
+
+
+class ShardingStage0:
+    """Pure DP (no sharding)."""
+
+    def __init__(self, mesh=None):
+        self.mesh = mesh
+
+
+class ShardingStage1:
+    """Shard optimizer states over the data axis (reference api.py:1365)."""
+
+    def __init__(self, sharding_mesh_dim="dp", mesh=None):
+        self.sharding_mesh_dim = sharding_mesh_dim
+        self.mesh = mesh
+
+
+class ShardingStage2(ShardingStage1):
+    """+ shard gradients (reduce-scatter instead of all-reduce)."""
+
+
+class ShardingStage3(ShardingStage1):
+    """+ shard parameters (FSDP; all-gather around use)."""
+
+
+def get_placement_of(t) -> Optional[List[Placement]]:
+    pl = getattr(t, "placements", None)
+    if pl is not None:
+        return pl
+    arr = getattr(t, "_data", t)
+    sh = getattr(arr, "sharding", None)
+    if isinstance(sh, NamedSharding):
+        return spec_to_placements(sh.spec, sh.mesh.axis_names, arr.ndim)
+    return None
+
+
+def unshard_dtensor(dist_tensor):
+    """Gather a distributed tensor to a fully-replicated dense tensor
+    (reference api.py unshard_dtensor)."""
+    t = dist_tensor if isinstance(dist_tensor, Tensor) else \
+        Tensor(dist_tensor)
+    arr = t._data
+    sh = getattr(arr, "sharding", None)
+    if isinstance(sh, NamedSharding):
+        out = jax.device_put(
+            arr, NamedSharding(sh.mesh, PartitionSpec()))
+    else:
+        out = arr
+    res = Tensor._from_array(out, stop_gradient=t.stop_gradient)
+    return res
+
+
+def is_dist_tensor(t) -> bool:
+    return getattr(t, "placements", None) is not None

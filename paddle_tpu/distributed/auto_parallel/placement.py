@@ -1,0 +1,119 @@
+"""Placement types: Shard / Replicate / Partial.
+
+Reference: paddle/phi/core/distributed/auto_parallel/placement_types.h and
+python/paddle/distributed/auto_parallel/placement_type.py. A placements
+list has one entry per MESH dimension describing what that mesh dim does
+to the tensor. On TPU these translate directly to a
+jax.sharding.PartitionSpec (one entry per TENSOR dim naming mesh axes) —
+GSPMD's native vocabulary; Partial marks pending cross-axis reductions
+(XLA tracks these automatically inside compiled code).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Replicate(Placement):
+    def is_replicated(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def get_dim(self):
+        return self.dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("Shard", self.dim))
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and \
+            other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("Partial", self.reduce_type))
+
+
+def placements_to_spec(placements: Sequence[Placement],
+                       axis_names: Sequence[str],
+                       ndim: Optional[int] = None) -> PartitionSpec:
+    """[per-mesh-dim placement] → PartitionSpec (per-tensor-dim axis names).
+
+    Reference analog: placement_type.py to_dim_map. Multiple mesh dims
+    sharding the same tensor dim become a tuple entry (major-to-minor in
+    mesh-dim order, matching DistTensor semantics).
+    """
+    entries: List = [None] * (ndim if ndim is not None else 0)
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            d = pl.dim
+            if d >= len(entries):
+                entries.extend([None] * (d + 1 - len(entries)))
+            ax = axis_names[mesh_dim]
+            if entries[d] is None:
+                entries[d] = ax
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (ax,)
+            else:
+                entries[d] = (entries[d], ax)
+    while entries and entries[-1] is None:
+        entries.pop()
+    return PartitionSpec(*entries)
+
+
+def spec_to_placements(spec: PartitionSpec, axis_names: Sequence[str],
+                       ndim: int) -> List[Placement]:
+    """PartitionSpec → per-mesh-dim placements list."""
+    out: List[Placement] = [Replicate() for _ in axis_names]
+    entries = list(spec) if spec is not None else []
+    for tdim, entry in enumerate(entries):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        for ax in axes:
+            out[list(axis_names).index(ax)] = Shard(tdim)
+    return out

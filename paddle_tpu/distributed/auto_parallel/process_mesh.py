@@ -1,0 +1,114 @@
+"""ProcessMesh — the user-facing mesh handle.
+
+Reference: python/paddle/distributed/auto_parallel/process_mesh.py:85
+(ProcessMesh holds a numpy array of ranks + dim_names; used by
+shard_tensor/reshard). TPU-native: wraps jax.sharding.Mesh directly; the
+"process ids" are device indices.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+class ProcessMesh:
+    def __init__(self, mesh=None, dim_names: Optional[Sequence[str]] = None,
+                 shape=None, process_ids=None):
+        if isinstance(mesh, Mesh):
+            self._jax_mesh = mesh
+            self._shape = list(mesh.devices.shape)
+            self._dim_names = list(mesh.axis_names)
+            self._process_ids = list(range(mesh.devices.size))
+            return
+        if mesh is None and shape is not None:
+            ids = np.asarray(process_ids if process_ids is not None
+                             else np.arange(int(np.prod(shape))))
+            mesh = ids.reshape(shape)
+        arr = np.asarray(mesh)
+        self._shape = list(arr.shape)
+        self._process_ids = [int(i) for i in arr.flatten()]
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._dim_names = list(dim_names)
+        devs = jax.devices()
+        dev_arr = np.empty(arr.shape, dtype=object)
+        flat = dev_arr.reshape(-1)
+        for i, pid in enumerate(self._process_ids):
+            flat[i] = devs[pid % len(devs)]
+        self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._shape)
+
+    @property
+    def ndim(self) -> int:
+        return len(self._shape)
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def mesh(self):
+        return np.asarray(self._process_ids).reshape(self._shape)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return list(self._process_ids)
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self._shape[self._dim_names.index(dim_name)]
+
+    def get_mesh_with_dim(self, dim_name: str, index=None):
+        """Sub-mesh view along one axis (reference process_mesh.py)."""
+        axis = self._dim_names.index(dim_name)
+        arr = self.mesh
+        moved = np.moveaxis(arr, axis, 0)
+        names = [dim_name] + [n for n in self._dim_names if n != dim_name]
+        pm = ProcessMesh(moved, names)
+        if index is not None:
+            sub = moved[index]
+            return ProcessMesh(sub, names[1:])
+        return pm
+
+    def __eq__(self, other):
+        return isinstance(other, ProcessMesh) and \
+            self._shape == other._shape and \
+            self._dim_names == other._dim_names and \
+            self._process_ids == other._process_ids
+
+    def __hash__(self):
+        return hash((tuple(self._shape), tuple(self._dim_names),
+                     tuple(self._process_ids)))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self._shape}, "
+                f"dim_names={self._dim_names})")
+
+
+_global_process_mesh: Optional[ProcessMesh] = None
+
+
+def set_global_process_mesh(pm: ProcessMesh):
+    global _global_process_mesh
+    _global_process_mesh = pm
+
+
+def get_global_process_mesh() -> Optional[ProcessMesh]:
+    return _global_process_mesh
+
+
+def auto_process_mesh(dim_names=("dp",), shape=None) -> ProcessMesh:
+    """Build a ProcessMesh over all visible devices."""
+    n = len(jax.devices())
+    if shape is None:
+        shape = [n] + [1] * (len(dim_names) - 1)
+    return ProcessMesh(shape=shape, dim_names=list(dim_names))

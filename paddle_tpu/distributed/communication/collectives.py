@@ -1,0 +1,287 @@
+"""Collective communication primitives.
+
+Reference: python/paddle/distributed/communication/{all_reduce,all_gather,
+broadcast,reduce,scatter,reduce_scatter,all_to_all,...}.py — eager calls
+into ProcessGroupNCCL (stream/all_reduce.py:49) or `_C_ops` collective ops
+in static graphs.
+
+TPU-native: collectives are COMPILED, not eager (SURVEY.md §5.8). Each
+function has two behaviours:
+
+* Inside `shard_map`/`pjit` tracing where the group's mesh axis is bound:
+  lowers to the XLA collective (`lax.psum`, `lax.all_gather`,
+  `lax.ppermute`, `lax.all_to_all`) on ICI.
+* Eager: a single-controller JAX process owns every chip, so the eager
+  process world has size jax.process_count(); with one process the
+  collective is the identity (paddle's own world_size==1 fast path).
+  Multi-host eager falls back to jax.experimental.multihost_utils.
+
+Ops accept Tensor or jax.Array; Tensor inputs are updated in place to
+match paddle's in-place eager convention.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import Tensor
+from .group import Group, _resolve
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+def _axes_bound(axes) -> bool:
+    """True when every axis name is bound in the current trace context."""
+    if not axes:
+        return False
+    for ax in axes:
+        try:
+            lax.axis_index(ax)
+        except NameError:
+            return False
+        except TypeError:
+            return False
+    return True
+
+
+def _data(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _ret(orig, new):
+    if isinstance(orig, Tensor):
+        orig._data = new
+        return orig
+    return new
+
+
+def _multi_process() -> bool:
+    return jax.process_count() > 1
+
+
+def _reduce_traced(data, op, axes):
+    name = axes if len(axes) > 1 else axes[0]
+    if op == ReduceOp.SUM:
+        return lax.psum(data, name)
+    if op == ReduceOp.MAX:
+        return lax.pmax(data, name)
+    if op == ReduceOp.MIN:
+        return lax.pmin(data, name)
+    if op == ReduceOp.AVG:
+        return lax.pmean(data, name)
+    if op == ReduceOp.PROD:
+        return jnp.exp(lax.psum(jnp.log(data.astype(jnp.float32)), name)
+                       ).astype(data.dtype)
+    raise ValueError(f"unknown ReduceOp {op}")
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Reference: communication/all_reduce.py. Traced → lax.psum family."""
+    g = _resolve(group)
+    data = _data(tensor)
+    axes = g.axis_names
+    if _axes_bound(axes):
+        return _ret(tensor, _reduce_traced(data, op, axes))
+    if _multi_process():
+        from jax.experimental import multihost_utils
+        if op != ReduceOp.SUM:
+            raise NotImplementedError(
+                "multi-host eager all_reduce supports SUM only")
+        out = multihost_utils.process_allgather(data)
+        return _ret(tensor, jnp.sum(out, axis=0))
+    return _ret(tensor, data)  # world_size==1 identity
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """All ranks compute, dst keeps the value; SPMD keeps it everywhere
+    (replication is free correctness-wise on a single controller)."""
+    return all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    """Reference: communication/all_gather.py — gathers into tensor_list.
+
+    Traced: returns the lax.all_gather result (stacked on a new leading
+    axis) and also extends tensor_list when one is supplied.
+    """
+    g = _resolve(group)
+    data = _data(tensor)
+    axes = g.axis_names
+    if _axes_bound(axes):
+        name = axes if len(axes) > 1 else axes[0]
+        out = lax.all_gather(data, name)
+        if tensor_list is not None:
+            tensor_list.extend(
+                Tensor._from_array(out[i]) for i in range(out.shape[0]))
+        return out
+    if _multi_process():
+        from jax.experimental import multihost_utils
+        out = multihost_utils.process_allgather(data)
+    else:
+        out = jnp.expand_dims(data, 0)
+    if tensor_list is not None:
+        tensor_list.extend(
+            Tensor._from_array(out[i]) for i in range(out.shape[0]))
+    return out
+
+
+def all_gather_object(object_list, obj, group=None):
+    """Single controller: every 'rank' holds the same object, so the
+    gathered list is nranks copies (matches paddle's contract that
+    object_list has one entry per group rank)."""
+    g = _resolve(group)
+    if _multi_process():
+        raise NotImplementedError("multi-host all_gather_object")
+    object_list.extend([obj] * max(1, g.nranks))
+    return object_list
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """Traced: take rank-src's shard via all_gather+index (XLA folds this
+    to a broadcast). Eager single-controller: identity."""
+    g = _resolve(group)
+    data = _data(tensor)
+    axes = g.axis_names
+    if _axes_bound(axes):
+        name = axes if len(axes) > 1 else axes[0]
+        # paddle's src is a GLOBAL rank: convert to the group-local index
+        out = lax.all_gather(data, name)[g.global_rank_to_group_rank(src)]
+        return _ret(tensor, out)
+    if _multi_process():
+        from jax.experimental import multihost_utils
+        return _ret(tensor, multihost_utils.broadcast_one_to_all(data))
+    return _ret(tensor, data)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = _resolve(group)
+    data = _data(tensor)
+    axes = g.axis_names
+    if _axes_bound(axes):
+        name = axes if len(axes) > 1 else axes[0]
+        idx = lax.axis_index(name)
+        stacked = jnp.stack([_data(t) for t in tensor_list], 0) \
+            if tensor_list else data
+        src_all = lax.all_gather(stacked, name)[
+            g.global_rank_to_group_rank(src)]
+        return _ret(tensor, src_all[idx])
+    if tensor_list:
+        return _ret(tensor, _data(tensor_list[0]))
+    return _ret(tensor, data)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
+                   group=None, sync_op=True):
+    """Reference: communication/reduce_scatter.py. Traced → lax.psum_scatter."""
+    g = _resolve(group)
+    axes = g.axis_names
+    inp = tensor_or_tensor_list
+    if isinstance(inp, (list, tuple)):
+        data = jnp.concatenate([_data(t) for t in inp], axis=0)
+    else:
+        data = _data(inp)
+    if _axes_bound(axes):
+        name = axes if len(axes) > 1 else axes[0]
+        if op == ReduceOp.AVG:
+            out = lax.psum_scatter(data, name, tiled=True) / g.nranks
+        elif op == ReduceOp.SUM:
+            out = lax.psum_scatter(data, name, tiled=True)
+        else:
+            raise NotImplementedError("reduce_scatter supports SUM/AVG")
+        return _ret(tensor, out)
+    if _multi_process():
+        raise NotImplementedError("multi-host eager reduce_scatter")
+    return _ret(tensor, data)
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """Reference: communication/all_to_all.py. Traced: lax.all_to_all on a
+    stacked leading axis."""
+    g = _resolve(group)
+    axes = g.axis_names
+    if isinstance(in_tensor_list, (list, tuple)):
+        data = jnp.stack([_data(t) for t in in_tensor_list], 0)
+    else:
+        data = _data(in_tensor_list)
+    if _axes_bound(axes):
+        name = axes if len(axes) > 1 else axes[0]
+        out = lax.all_to_all(data, name, split_axis=0, concat_axis=0,
+                             tiled=False)
+        if out_tensor_list is not None:
+            out_tensor_list.extend(
+                Tensor._from_array(out[i]) for i in range(out.shape[0]))
+        return out
+    if _multi_process():
+        raise NotImplementedError("multi-host eager all_to_all")
+    if out_tensor_list is not None and \
+            isinstance(in_tensor_list, (list, tuple)):
+        out_tensor_list.extend(in_tensor_list)
+    return data
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    return all_to_all(out_tensor_list, in_tensor_list, group, sync_op)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """Even-split all-to-all on dim 0 (reference alltoall_single)."""
+    g = _resolve(group)
+    data = _data(in_tensor)
+    axes = g.axis_names
+    if _axes_bound(axes):
+        name = axes if len(axes) > 1 else axes[0]
+        out = lax.all_to_all(data, name, split_axis=0, concat_axis=0,
+                             tiled=True)
+        return _ret(out_tensor, out)
+    return _ret(out_tensor, data)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """Point-to-point send. Traced: expressed jointly with recv as a
+    ppermute by the pipeline runtime (p2p_communication); eager p2p has no
+    meaning on a single controller."""
+    g = _resolve(group)
+    if _axes_bound(g.axis_names):
+        raise RuntimeError(
+            "send/recv inside traced code must go through "
+            "paddle_tpu.distributed.fleet.meta_parallel p2p (ppermute)")
+    return None
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    return None
+
+
+isend = send
+irecv = recv
+
+
+def p2p_shift(data, axis_name: str, shift: int = 1):
+    """ppermute helper: every rank sends its value to rank+shift (ring).
+
+    This is the TPU p2p primitive the pipeline/ring-attention runtimes use
+    instead of NCCL send/recv pairs (reference:
+    fleet/meta_parallel/pp_utils/p2p_communication.py:573)."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(data, axis_name, perm)
+
+
+def batch_isend_irecv(p2p_op_list):
+    raise NotImplementedError(
+        "use compiled pipeline schedules (ppermute) on TPU")
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op, self.tensor, self.peer, self.group = op, tensor, peer, group

@@ -1,0 +1,180 @@
+"""Process groups as mesh-axis views.
+
+Reference: python/paddle/distributed/communication/group.py (Group holds a
+ProcessGroup communicator + rank list). TPU-native: a Group names one or
+more mesh axes of the global `jax.sharding.Mesh`; collectives over the
+group compile to XLA collectives on those axes (SURVEY.md §5.8). There is
+no communicator object to create — "new_group" is a view.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+
+from .. import mesh as mesh_mod
+
+
+class Group:
+    """A collective group = a (tuple of) mesh axis name(s).
+
+    axis_name=None means the world group (all mesh axes).
+    """
+
+    _next_id = 0
+
+    def __init__(self, axis_name: Union[None, str, Sequence[str]] = None,
+                 ranks: Optional[List[int]] = None, name: str = ""):
+        if axis_name is None or isinstance(axis_name, str):
+            self._axes: Optional[Tuple[str, ...]] = (
+                None if axis_name is None else (axis_name,))
+        else:
+            self._axes = tuple(axis_name)
+        self._ranks = ranks
+        self.name = name or f"group_{Group._next_id}"
+        Group._next_id += 1
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        if self._axes is not None:
+            return self._axes
+        mesh = mesh_mod.get_mesh()
+        return tuple(mesh.axis_names) if mesh is not None else ()
+
+    # single-axis accessor used by the lax lowering
+    @property
+    def axis_name(self):
+        axes = self.axis_names
+        return axes[0] if len(axes) == 1 else tuple(axes)
+
+    @property
+    def nranks(self) -> int:
+        if self._ranks is not None:
+            return len(self._ranks)
+        axes = self.axis_names
+        if not axes:
+            return 1
+        return math.prod(mesh_mod.axis_degree(a) for a in axes)
+
+    world_size = nranks
+
+    @property
+    def rank(self) -> int:
+        from .. import env
+        if self.nranks <= 1:
+            return 0
+        if self._ranks is not None:
+            r = env.get_rank()
+            return self._ranks.index(r) if r in self._ranks else 0
+        return self.global_rank_to_group_rank(env.get_rank())
+
+    def global_rank_to_group_rank(self, global_rank: int) -> int:
+        """Decode the coordinate of `global_rank` on this group's axes
+        (mixed-radix over the global topology, innermost-last)."""
+        axes = self.axis_names
+        topo = mesh_mod.CommunicateTopology()
+        if topo.world_size() <= 1:
+            return 0
+        coord = topo.get_coord(global_rank % topo.world_size())
+        rank = 0
+        for ax in topo.get_hybrid_group_names():
+            if ax in axes:
+                rank = rank * topo.get_dim(ax) + coord[ax]
+        return rank
+
+    @property
+    def ranks(self) -> List[int]:
+        return self._ranks if self._ranks is not None \
+            else list(range(self.nranks))
+
+    def get_group_rank(self, rank: int) -> int:
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def process_group(self):  # compat: no C++ ProcessGroup on TPU
+        return self
+
+    @property
+    def id(self):
+        return self.name
+
+    def __repr__(self):
+        return f"Group(axes={self.axis_names}, nranks={self.nranks})"
+
+
+_world_group: Optional[Group] = None
+_named_groups = {}
+
+
+def _get_global_group() -> Group:
+    global _world_group
+    if _world_group is None:
+        _world_group = Group(axis_name=None, name="world")
+    return _world_group
+
+
+def _resolve(group) -> Group:
+    if group is None:
+        return _get_global_group()
+    if isinstance(group, str):
+        return Group(axis_name=group)
+    return group
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
+    """Create a group view. On TPU, groups must correspond to mesh axes;
+    a ranks-list matching one axis of the global mesh resolves to it."""
+    if axis_name is not None:
+        return Group(axis_name=axis_name, ranks=ranks)
+    if ranks is None:
+        return _get_global_group()
+    # recognise the ranks list as one axis of the global mesh by size
+    mesh = mesh_mod.get_mesh()
+    if mesh is not None:
+        for ax in mesh.axis_names:
+            deg = mesh_mod.axis_degree(ax)
+            if deg == len(ranks):
+                return Group(axis_name=ax, ranks=list(ranks))
+    return Group(axis_name=None, ranks=list(ranks))
+
+
+def get_group(gid=None):
+    return _get_global_group()
+
+
+def is_available() -> bool:
+    return True
+
+
+def is_initialized() -> bool:
+    return mesh_mod.get_mesh() is not None or jax.process_count() >= 1
+
+
+def destroy_process_group(group=None):
+    global _world_group
+    _world_group = None
+
+
+def get_backend(group=None) -> str:
+    return "xla"
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Collectives are compiled and ordered by XLA; block_until_ready for
+    eager parity with paddle's stream-wait semantics."""
+    data = getattr(tensor, "_data", tensor)
+    try:
+        data.block_until_ready()
+    except AttributeError:
+        pass
+    return tensor
+
+
+def barrier(group=None):
+    """Reference: communication/batch_isend_irecv-adjacent barrier op. In a
+    single controller there is nothing to order between processes; for
+    multi-process (multi-host) worlds sync through the coordinator."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu.barrier")

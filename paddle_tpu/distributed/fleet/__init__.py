@@ -1,0 +1,103 @@
+"""fleet — the manual hybrid-parallel facade.
+
+Reference: python/paddle/distributed/fleet/fleet.py:218 (fleet.init →
+RoleMaker + init_parallel_env + _init_hybrid_parallel_env building the
+5-D CommunicateTopology and a process group per axis), model.py:32
+(distributed_model picks the meta-parallel wrapper), fleet.py:1427
+(distributed_optimizer → HybridParallelOptimizer).
+
+TPU-native: fleet.init builds ONE jax.sharding.Mesh with the configured
+axis degrees — that mesh replaces every process group. distributed_model
+returns the wrapper that commits input/param shardings; training then
+compiles through paddle_tpu.jit.TrainStep / DistributedTrainStep where
+GSPMD emits all collectives.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from .. import env as env_mod
+from .. import mesh as mesh_mod
+from . import base  # noqa: F401
+from . import layers  # noqa: F401
+from . import meta_optimizers  # noqa: F401
+from . import meta_parallel  # noqa: F401
+from .base.distributed_strategy import DistributedStrategy  # noqa: F401
+from .base.role_maker import PaddleCloudRoleMaker  # noqa: F401
+from .base.topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup,
+    get_hybrid_communicate_group, set_hybrid_communicate_group,
+)
+from .meta_optimizers import (DygraphShardingOptimizer,  # noqa: F401
+                              HybridParallelOptimizer)
+from .model import distributed_model  # noqa: F401
+from .optimizer import distributed_optimizer  # noqa: F401
+
+_fleet_initialized = False
+_strategy: Optional[DistributedStrategy] = None
+_role_maker = None
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level=None):
+    """Reference fleet.py:218. Builds the global hybrid mesh."""
+    global _fleet_initialized, _strategy, _role_maker
+    _strategy = strategy or DistributedStrategy()
+    _role_maker = role_maker or PaddleCloudRoleMaker(
+        is_collective=is_collective)
+    env_mod.init_parallel_env()
+    degrees = _strategy.hybrid_degrees()
+    n_need = 1
+    for v in degrees.values():
+        n_need *= v
+    n_dev = len(jax.devices())
+    if n_need <= 1:
+        # pure DP over every visible device
+        degrees = dict(degrees)
+        degrees["dp"] = n_dev
+    elif n_need < n_dev and n_dev % n_need == 0:
+        degrees = dict(degrees)
+        degrees["dp"] = degrees.get("dp", 1) * (n_dev // n_need)
+    mesh_mod.set_mesh(mesh_mod.build_mesh(degrees), degrees)
+    mesh_mod.set_hybrid_communicate_group(
+        mesh_mod.HybridCommunicateGroup())
+    _fleet_initialized = True
+    return None
+
+
+def is_initialized() -> bool:
+    return _fleet_initialized
+
+
+def get_strategy() -> Optional[DistributedStrategy]:
+    return _strategy
+
+
+def worker_index() -> int:
+    return env_mod.get_rank()
+
+
+def worker_num() -> int:
+    return env_mod.get_world_size()
+
+
+def is_first_worker() -> bool:
+    return worker_index() == 0
+
+
+def worker_endpoints(to_string=False):
+    eps = [f"127.0.0.1:{8600 + i}" for i in range(worker_num())]
+    return ",".join(eps) if to_string else eps
+
+
+def barrier_worker():
+    from ..communication.group import barrier
+    barrier()
+
+
+def stop_worker():
+    pass
+
+
+utils = None  # populated lazily by fleet.utils import

@@ -1,0 +1,61 @@
+"""Role makers — who am I in the job?
+
+Reference: python/paddle/distributed/fleet/base/role_maker.py:548
+(PaddleCloudRoleMaker reads PADDLE_TRAINER_ID/PADDLE_TRAINER_ENDPOINTS).
+TPU: one controller process per host; role == jax process index.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class PaddleCloudRoleMaker:
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+
+    def _worker_index(self):
+        env = os.environ.get("PADDLE_TRAINER_ID")
+        if env is not None:
+            return int(env)
+        try:
+            return jax.process_index()
+        except Exception:
+            return 0
+
+    def _worker_num(self):
+        env = os.environ.get("PADDLE_TRAINERS_NUM")
+        if env is not None:
+            return int(env)
+        try:
+            return jax.process_count()
+        except Exception:
+            return 1
+
+    def _is_first_worker(self):
+        return self._worker_index() == 0
+
+    def _role(self):
+        return Role.WORKER
+
+    worker_index = _worker_index
+    worker_num = _worker_num
+    is_first_worker = _is_first_worker
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+
+UserDefinedRoleMaker = PaddleCloudRoleMaker

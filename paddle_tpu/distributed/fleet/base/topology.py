@@ -1,0 +1,9 @@
+"""Hybrid-parallel topology. Reference: fleet/base/topology.py:70,189.
+The real implementation lives in paddle_tpu.distributed.mesh — the global
+jax.sharding.Mesh IS the topology; this module keeps the fleet import
+path alive."""
+from ...mesh import (  # noqa: F401
+    HYBRID_AXES, CommunicateTopology, HybridCommunicateGroup, axis_degree,
+    build_mesh, ensure_mesh, get_hybrid_communicate_group, get_mesh,
+    set_hybrid_communicate_group, set_mesh,
+)

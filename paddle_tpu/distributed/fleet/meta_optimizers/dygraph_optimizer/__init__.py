@@ -1,0 +1,4 @@
+from .hybrid_parallel_optimizer import (  # noqa: F401
+    DygraphShardingOptimizer, DygraphShardingOptimizerV2,
+    HybridParallelGradScaler, HybridParallelOptimizer,
+)

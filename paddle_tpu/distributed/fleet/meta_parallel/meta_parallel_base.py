@@ -1,0 +1,51 @@
+"""Meta-parallel wrapper base.
+
+Reference: fleet/meta_parallel/meta_parallel_base.py (MetaParallelBase
+wraps a Layer, broadcasts params at init, delegates forward). On a single
+controller there is nothing to broadcast — parameters are global arrays —
+so init reduces to committing shardings; wrappers stay thin delegates.
+"""
+from __future__ import annotations
+
+from ....nn.layer.layers import Layer
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers, hcg=None, strategy=None, **kwargs):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._prepare_for_model()
+
+    def _prepare_for_model(self):
+        pass
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    # delegate the Layer surface to the wrapped model
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        return self._layers.named_buffers(prefix, include_sublayers)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def train(self):
+        super().train()
+        self._layers.train()
+        return self
+
+    def eval(self):
+        super().eval()
+        self._layers.eval()
+        return self

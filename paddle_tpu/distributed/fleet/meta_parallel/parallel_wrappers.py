@@ -1,0 +1,118 @@
+"""TensorParallel / ShardingParallel / DataParallel wrappers.
+
+Reference: fleet/meta_parallel/tensor_parallel.py:28 (broadcast mp params
+at init), sharding_parallel.py, and python/paddle/distributed/parallel.py:219
+(DataParallel → EagerReducer grad buckets). TPU-native: a wrapper's whole
+job is to commit shardings — XLA's latency-hiding scheduler already
+buckets/overlaps grad reductions, and parameters are global so there is
+nothing to broadcast (SURVEY.md §7.1 "EagerReducer → knobs only").
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ....core.dispatch import unwrap, wrap
+from ....core.tensor import Tensor
+from ... import mesh as mesh_mod
+from ...auto_parallel import Replicate, Shard, shard_tensor
+from ...auto_parallel.process_mesh import ProcessMesh
+from ..layers.mpu.mp_ops import mark_sharding
+from .meta_parallel_base import MetaParallelBase
+
+
+def _shard_batch(x, axes):
+    """Shard arg dim 0 over the data axes."""
+    if not isinstance(x, Tensor):
+        return x
+    entry = tuple(axes) if len(axes) > 1 else axes[0]
+    return mark_sharding(x, entry, *([None] * (len(x.shape) - 1)))
+
+
+class DataParallel(MetaParallelBase):
+    """Reference: parallel.py:219. Inputs are sharded over the data axes;
+    gradient averaging is GSPMD's reduce over those axes inside the
+    compiled step (no reducer object needed)."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None, **kwargs):
+        super().__init__(layers, strategy=strategy)
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        axes = mesh_mod.data_axes()
+        inputs = tuple(_shard_batch(x, axes) for x in inputs)
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss  # grads are averaged, not summed
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        yield
+
+    @property
+    def _layers_inner(self):
+        return self._layers
+
+
+class TensorParallel(MetaParallelBase):
+    """Reference: meta_parallel/tensor_parallel.py:28. mpu layers already
+    committed their 'mp' shardings at construction; nothing to broadcast."""
+
+
+class ShardingParallel(MetaParallelBase):
+    """Reference: meta_parallel/sharding_parallel.py. Param FSDP placement
+    happens in the sharded optimizer / TrainStep shardings."""
+
+
+class SegmentParallel(MetaParallelBase):
+    """Reference: meta_parallel/segment_parallel.py:26 — sequence dim
+    sharded over 'sep'. Inputs get their sequence dim (dim 1) constrained."""
+
+    def forward(self, *inputs, **kwargs):
+        outs = []
+        for x in inputs:
+            if isinstance(x, Tensor) and len(x.shape) >= 2 and \
+                    mesh_mod.axis_degree("sep") > 1:
+                x = mark_sharding(x, None, "sep",
+                                  *([None] * (len(x.shape) - 2)))
+            outs.append(x)
+        return self._layers(*outs, **kwargs)
+
+
+def shard_parameters_fsdp(layer, axis="sharding"):
+    """Commit every parameter to Shard(0) over the FSDP axis when its
+    dim-0 length divides evenly; others stay replicated (ZeRO-3 layout,
+    reference group_sharded_stage3.py:85)."""
+    deg = mesh_mod.axis_degree(axis)
+    if deg <= 1:
+        return layer
+    mesh = ProcessMesh(mesh_mod.ensure_mesh())
+    ax_idx = mesh.dim_names.index(axis)
+    for name, sub in layer.named_sublayers(include_self=True):
+        for pname, p in list(sub._parameters.items()):
+            if p is None:
+                continue
+            placements = [Replicate() for _ in mesh.dim_names]
+            # keep any existing mp placement
+            existing = getattr(p, "placements", None)
+            if existing is not None:
+                placements = list(existing)
+            shard_dim = None
+            for d, size in enumerate(p.shape):
+                if size % deg == 0 and not any(
+                        isinstance(pl, Shard) and pl.dim == d
+                        for pl in placements):
+                    shard_dim = d
+                    break
+            if shard_dim is None:
+                continue
+            placements[ax_idx] = Shard(shard_dim)
+            newp = shard_tensor(p, mesh, placements,
+                                stop_gradient=p.stop_gradient)
+            newp.is_distributed = True
+            sub._parameters[pname] = newp
+    return layer

@@ -1,0 +1,36 @@
+"""distributed_model — pick the meta-parallel wrapper.
+
+Reference: fleet/model.py:32 — PipelineParallel if pp>1, else
+TensorParallel / ShardingParallel / DataParallel; the wrapper also
+broadcasts initial parameters inside each group (a no-op here: params
+are global arrays on a single controller).
+"""
+from __future__ import annotations
+
+from .. import mesh as mesh_mod
+from .meta_parallel import (DataParallel, ShardingParallel, TensorParallel,
+                            shard_parameters_fsdp)
+
+
+def distributed_model(model):
+    pp = mesh_mod.axis_degree("pp")
+    mp = mesh_mod.axis_degree("mp")
+    sharding = mesh_mod.axis_degree("sharding")
+    from . import get_strategy
+    strategy = get_strategy()
+    stage = int(strategy.sharding_configs.get("stage", 1)) \
+        if strategy is not None else 1
+    if sharding > 1 and stage >= 3:
+        shard_parameters_fsdp(model, axis="sharding")
+    if pp > 1:
+        try:
+            from .meta_parallel.pipeline_parallel import PipelineParallel
+        except ImportError as e:
+            raise NotImplementedError(
+                "pipeline parallel wrapper not available") from e
+        return PipelineParallel(model, strategy=strategy)
+    if mp > 1:
+        return TensorParallel(model, strategy=strategy)
+    if sharding > 1:
+        return ShardingParallel(model, strategy=strategy)
+    return DataParallel(model, strategy=strategy)

@@ -1,0 +1,24 @@
+"""distributed_optimizer — wrap the user optimizer for hybrid parallel.
+
+Reference: fleet/fleet.py:1427 → HybridParallelOptimizer (+ sharding
+optimizers when sharding_degree > 1).
+"""
+from __future__ import annotations
+
+from .. import mesh as mesh_mod
+from .meta_optimizers import (DygraphShardingOptimizer,
+                              DygraphShardingOptimizerV2,
+                              HybridParallelOptimizer)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    from . import get_strategy
+    strategy = strategy or get_strategy()
+    hcg = mesh_mod.get_hybrid_communicate_group()
+    if mesh_mod.axis_degree("sharding") > 1 and strategy is not None:
+        stage = int(strategy.sharding_configs.get("stage", 1))
+        if stage == 2:
+            return DygraphShardingOptimizerV2(optimizer, hcg, strategy)
+        if stage == 1:
+            return DygraphShardingOptimizer(optimizer, hcg, strategy)
+    return HybridParallelOptimizer(optimizer, hcg, strategy)

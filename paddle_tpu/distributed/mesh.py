@@ -1,0 +1,235 @@
+"""Global device-mesh topology for hybrid parallelism.
+
+Reference: python/paddle/distributed/fleet/base/topology.py:70
+(CommunicateTopology — cartesian rank coordinates over the axis order
+[pp, mp(=tp), sep, sharding, dp]) and fleet.py:674 (_init_hybrid_parallel_env,
+which news a process group per axis).
+
+TPU-native design: there are no process groups — ONE `jax.sharding.Mesh`
+with named axes carries the whole topology, and every "group collective"
+is a compiled XLA collective over one (or more) mesh axis names
+(SURVEY.md §7.1). This module owns the global mesh: axis order is
+outermost-first ('pp', 'dp', 'sharding', 'sep', 'mp') so that tensor
+parallelism (highest-bandwidth traffic) lands on the innermost, fastest
+ICI dimension, and pipeline stages (lowest traffic) on the outermost.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Axis order, outermost first. 'mp' is tensor parallel (paddle naming);
+# 'sharding' is the FSDP/ZeRO axis; 'sep' is the sequence/segment axis
+# (also used for expert parallel via the same slot when configured).
+HYBRID_AXES = ("pp", "dp", "sharding", "sep", "mp")
+
+_global_mesh: Optional[Mesh] = None
+_global_degrees: Dict[str, int] = {}
+
+
+def build_mesh(degrees: Dict[str, int], devices=None,
+               axis_order: Sequence[str] = HYBRID_AXES) -> Mesh:
+    """Build a Mesh from per-axis degrees (missing axes default to 1).
+
+    Axes with degree 1 are still materialised so sharding specs can always
+    name every axis regardless of the configured topology.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    full = {ax: int(degrees.get(ax, 1)) for ax in axis_order}
+    extra = [k for k in degrees if k not in full]
+    axis_names = tuple(axis_order) + tuple(extra)
+    for k in extra:
+        full[k] = int(degrees[k])
+    n = math.prod(full.values())
+    if n > len(devices):
+        raise ValueError(
+            f"mesh degrees {full} need {n} devices, have {len(devices)}")
+    shape = tuple(full[ax] for ax in axis_names)
+    arr = np.asarray(devices[:n], dtype=object).reshape(shape)
+    return Mesh(arr, axis_names)
+
+
+def set_mesh(mesh: Mesh, degrees: Optional[Dict[str, int]] = None) -> None:
+    global _global_mesh, _global_degrees
+    _global_mesh = mesh
+    _global_degrees = dict(degrees or
+                           {ax: int(s) for ax, s in
+                            zip(mesh.axis_names, mesh.devices.shape)})
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _global_mesh
+
+
+def ensure_mesh() -> Mesh:
+    """Return the global mesh, building a pure-DP one if none was set."""
+    global _global_mesh
+    if _global_mesh is None:
+        set_mesh(build_mesh({"dp": len(jax.devices())}))
+    return _global_mesh
+
+
+def axis_degree(name: str) -> int:
+    mesh = get_mesh()
+    if mesh is None or name not in mesh.axis_names:
+        return 1
+    return int(mesh.devices.shape[mesh.axis_names.index(name)])
+
+
+def data_axes(mesh: Optional[Mesh] = None) -> List[str]:
+    """Axes the global batch is sharded over (dp + sharding)."""
+    mesh = mesh or ensure_mesh()
+    return [ax for ax in ("dp", "sharding") if ax in mesh.axis_names
+            and mesh.devices.shape[mesh.axis_names.index(ax)] > 1] or ["dp"]
+
+
+class CommunicateTopology:
+    """Cartesian rank-coordinate helper, reference topology.py:70.
+
+    On TPU ranks are device indices in the global mesh; this exists for
+    API parity and for the launcher/debug tooling.
+    """
+
+    def __init__(self, hybrid_group_names=None, dims=None):
+        self._axes = list(hybrid_group_names or HYBRID_AXES)
+        self._dims = list(dims or [axis_degree(a) for a in self._axes])
+
+    def get_hybrid_group_names(self):
+        return list(self._axes)
+
+    def get_dim(self, axis_name):
+        return self._dims[self._axes.index(axis_name)]
+
+    def world_size(self):
+        return math.prod(self._dims)
+
+    def get_rank(self, **coords) -> int:
+        assert sorted(coords) == sorted(self._axes)
+        rank = 0
+        for ax, dim in zip(self._axes, self._dims):
+            rank = rank * dim + coords[ax]
+        return rank
+
+    def get_coord(self, rank: int):
+        coords = []
+        for dim in reversed(self._dims):
+            coords.append(rank % dim)
+            rank //= dim
+        return dict(zip(self._axes, reversed(coords)))
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        """All global ranks whose coordinate on `axis_name` == index."""
+        out = []
+        for r in range(self.world_size()):
+            if self.get_coord(r)[axis_name] == index:
+                out.append(r)
+        return out
+
+
+class HybridCommunicateGroup:
+    """Paddle-shaped view of the hybrid topology
+    (reference: fleet/base/topology.py:189).
+
+    Exposes the same *_rank / *_world_size / *_group accessors fleet users
+    call; "groups" are mesh axis names rather than NCCL communicators.
+    """
+
+    def __init__(self, topology: Optional[CommunicateTopology] = None):
+        self._topo = topology or CommunicateTopology()
+        self._mesh = ensure_mesh()
+        # single-controller JAX: this process sees all devices; logical
+        # rank-0 view unless a launcher set a per-process rank.
+        from . import env
+        self._global_rank = env.get_rank()
+
+    # --- degrees -------------------------------------------------------
+    def get_data_parallel_world_size(self):
+        return axis_degree("dp")
+
+    def get_model_parallel_world_size(self):
+        return axis_degree("mp")
+
+    def get_pipe_parallel_world_size(self):
+        return axis_degree("pp")
+
+    def get_sharding_parallel_world_size(self):
+        return axis_degree("sharding")
+
+    def get_sep_parallel_world_size(self):
+        return axis_degree("sep")
+
+    # --- ranks ---------------------------------------------------------
+    def _coord(self):
+        return self._topo.get_coord(
+            self._global_rank % self._topo.world_size())
+
+    def get_data_parallel_rank(self):
+        return self._coord()["dp"]
+
+    def get_model_parallel_rank(self):
+        return self._coord()["mp"]
+
+    def get_stage_id(self):
+        return self._coord()["pp"]
+
+    def get_sharding_parallel_rank(self):
+        return self._coord()["sharding"]
+
+    def get_sep_parallel_rank(self):
+        return self._coord()["sep"]
+
+    # --- groups (mesh axis names stand in for communicators) -----------
+    def get_data_parallel_group(self):
+        from .communication.group import Group
+        return Group(axis_name="dp")
+
+    def get_model_parallel_group(self):
+        from .communication.group import Group
+        return Group(axis_name="mp")
+
+    def get_pipe_parallel_group(self):
+        from .communication.group import Group
+        return Group(axis_name="pp")
+
+    def get_sharding_parallel_group(self):
+        from .communication.group import Group
+        return Group(axis_name="sharding")
+
+    def get_sep_parallel_group(self):
+        from .communication.group import Group
+        return Group(axis_name="sep")
+
+    def get_check_parallel_group(self, *a, **k):
+        from .communication.group import Group
+        return Group(axis_name=None)
+
+    def topology(self):
+        return self._topo
+
+    def get_parallel_mode(self):
+        if axis_degree("pp") > 1:
+            return "pipeline"
+        if axis_degree("sharding") > 1:
+            return "sharding"
+        if axis_degree("mp") > 1:
+            return "model"
+        return "data"
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg) -> None:
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    global _hcg
+    if _hcg is None:
+        _hcg = HybridCommunicateGroup()
+    return _hcg

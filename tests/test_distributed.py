@@ -1,0 +1,220 @@
+"""Distributed stack tests on the 8-device virtual CPU mesh (conftest
+forces XLA_FLAGS=--xla_force_host_platform_device_count=8, the JAX analog
+of the reference's custom_cpu fake-accelerator trick)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet, mesh as mesh_mod
+
+
+@pytest.fixture
+def hybrid_mesh():
+    """dp=2 x sharding=2 x mp=2 global mesh; restores previous on exit."""
+    prev = mesh_mod.get_mesh()
+    m = mesh_mod.build_mesh({"dp": 2, "sharding": 2, "mp": 2})
+    mesh_mod.set_mesh(m)
+    yield m
+    mesh_mod._global_mesh = prev
+
+
+def test_build_mesh_degrees(hybrid_mesh):
+    assert mesh_mod.axis_degree("dp") == 2
+    assert mesh_mod.axis_degree("mp") == 2
+    assert mesh_mod.axis_degree("pp") == 1
+    assert hybrid_mesh.devices.size == 8
+
+
+def test_topology_coords():
+    topo = mesh_mod.CommunicateTopology(["dp", "mp"], [2, 4])
+    assert topo.world_size() == 8
+    assert topo.get_rank(dp=1, mp=2) == 6
+    assert topo.get_coord(6) == {"dp": 1, "mp": 2}
+    assert topo.get_axis_list("dp", 0) == [0, 1, 2, 3]
+
+
+def test_placements_spec_roundtrip():
+    from paddle_tpu.distributed.auto_parallel.placement import (
+        placements_to_spec, spec_to_placements)
+    axes = ["dp", "mp"]
+    pls = [dist.Shard(0), dist.Shard(1)]
+    spec = placements_to_spec(pls, axes, ndim=2)
+    assert spec == P("dp", "mp")
+    back = spec_to_placements(spec, axes, 2)
+    assert back == pls
+    # replicated
+    spec2 = placements_to_spec([dist.Replicate(), dist.Replicate()], axes, 2)
+    assert spec2 == P()
+
+
+def test_shard_tensor_values_preserved(hybrid_mesh):
+    pm = dist.ProcessMesh(hybrid_mesh)
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    t = paddle.to_tensor(x)
+    pl = [dist.Replicate()] * len(pm.dim_names)
+    pl[pm.dim_names.index("mp")] = dist.Shard(0)
+    st = dist.shard_tensor(t, pm, pl)
+    np.testing.assert_array_equal(np.asarray(st._data), x)
+    # reshard to a different placement keeps values
+    pl2 = [dist.Replicate()] * len(pm.dim_names)
+    pl2[pm.dim_names.index("dp")] = dist.Shard(1)
+    rt = dist.reshard(st, pm, pl2)
+    np.testing.assert_array_equal(np.asarray(rt._data), x)
+    # unshard gives a replicated tensor
+    full = dist.unshard_dtensor(rt)
+    np.testing.assert_array_equal(full.numpy(), x)
+
+
+def test_collectives_inside_shard_map(hybrid_mesh):
+    from paddle_tpu.distributed.communication import collectives as C
+    g = dist.Group(axis_name="mp")
+
+    def body(x):
+        s = C.all_reduce(x, op=dist.ReduceOp.SUM, group=g)
+        m = C.all_reduce(x, op=dist.ReduceOp.MAX, group=g)
+        gath = C.all_gather(None, x, group=g)
+        rs = C.reduce_scatter(x, x, group=g)
+        return s, m, gath, rs
+
+    f = shard_map(body, mesh=hybrid_mesh,
+                  in_specs=P(None, "mp"),
+                  out_specs=(P(None, "mp"), P(None, "mp"),
+                             P(None, None, "mp"), P(None, "mp")))
+    x = jnp.arange(8.0).reshape(2, 4)
+    s, m, gath, rs = f(x)
+    # all_reduce sum over mp (2 shards, each [2,2]): every shard holds the
+    # sum of both shards; global view = [sum0, sum1] per column block
+    col_sums = x[:, :2] + x[:, 2:]
+    np.testing.assert_allclose(np.asarray(s)[:, :2], col_sums)
+    np.testing.assert_allclose(np.asarray(s)[:, 2:], col_sums)
+    np.testing.assert_allclose(
+        np.asarray(m)[:, :2], np.maximum(x[:, :2], x[:, 2:]))
+    assert gath.shape == (2, 2, 4)
+
+
+def test_p2p_shift_ring(hybrid_mesh):
+    from paddle_tpu.distributed.communication.collectives import p2p_shift
+
+    def body(x):
+        return p2p_shift(x, "mp", 1)
+
+    f = shard_map(body, mesh=hybrid_mesh, in_specs=P("mp"),
+                  out_specs=P("mp"))
+    x = jnp.arange(2.0)
+    out = np.asarray(f(x))
+    np.testing.assert_allclose(out, [1.0, 0.0])
+
+
+def test_eager_collectives_single_process(hybrid_mesh):
+    t = paddle.to_tensor(np.ones((2, 2), np.float32))
+    dist.all_reduce(t)
+    np.testing.assert_array_equal(t.numpy(), np.ones((2, 2)))
+    dist.broadcast(t, src=0)
+    dist.barrier()
+    out = []
+    dist.all_gather(out, t)
+    assert len(out) == 1
+
+
+def test_fleet_init_and_groups():
+    prev = mesh_mod.get_mesh()
+    try:
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                            "sharding_degree": 2}
+        fleet.init(is_collective=True, strategy=s)
+        hcg = fleet.get_hybrid_communicate_group()
+        assert hcg.get_model_parallel_world_size() == 2
+        assert hcg.get_data_parallel_world_size() == 2
+        assert hcg.get_sharding_parallel_world_size() == 2
+        assert hcg.get_pipe_parallel_world_size() == 1
+        g = hcg.get_model_parallel_group()
+        assert g.nranks == 2
+    finally:
+        mesh_mod._global_mesh = prev
+
+
+def test_tp_matches_single_device(hybrid_mesh):
+    """Column+Row parallel MLP must equal the plain Linear MLP, weights
+    copied (reference test analog: mp loss == single-device loss)."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        ColumnParallelLinear, RowParallelLinear)
+    paddle.seed(42)
+    col = ColumnParallelLinear(16, 32, gather_output=False)
+    row = RowParallelLinear(32, 16, input_is_parallel=True)
+    lin1 = nn.Linear(16, 32)
+    lin2 = nn.Linear(32, 16)
+    lin1.weight.set_value(col.weight.numpy())
+    lin1.bias.set_value(col.bias.numpy())
+    lin2.weight.set_value(row.weight.numpy())
+    lin2.bias.set_value(row.bias.numpy())
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((4, 16)).astype(np.float32))
+    ref = lin2(paddle.nn.functional.relu(lin1(x)))
+    tp = row(paddle.nn.functional.relu(col(x)))
+    np.testing.assert_allclose(tp.numpy(), ref.numpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_vocab_parallel_embedding_and_ce(hybrid_mesh):
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        ParallelCrossEntropy, VocabParallelEmbedding)
+    import paddle_tpu.nn as nn
+    paddle.seed(7)
+    emb = VocabParallelEmbedding(32, 8)
+    ref = nn.Embedding(32, 8)
+    ref.weight.set_value(emb.weight.numpy())
+    ids = paddle.to_tensor(np.array([[1, 5, 31], [0, 2, 7]], np.int64))
+    np.testing.assert_allclose(emb(ids).numpy(), ref(ids).numpy(),
+                               rtol=1e-6)
+    logits = paddle.to_tensor(
+        np.random.default_rng(1).standard_normal((2, 3, 32))
+        .astype(np.float32))
+    labels = paddle.to_tensor(np.array([[1, 5, 31], [0, 2, 7]], np.int64))
+    pce = ParallelCrossEntropy()(logits, labels)
+    refce = nn.functional.cross_entropy(
+        logits.reshape([-1, 32]), labels.reshape([-1]), reduction="none")
+    np.testing.assert_allclose(pce.numpy().reshape(-1),
+                               refce.numpy().reshape(-1), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_distributed_train_step_matches_single(hybrid_mesh):
+    """DP+sharded step numerics == single-device TrainStep numerics."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.parallel_step import DistributedTrainStep
+
+    def build():
+        paddle.seed(123)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        opt = paddle.optimizer.AdamW(1e-2, parameters=net.parameters())
+        return net, opt
+
+    loss_fn = nn.CrossEntropyLoss()
+    x = np.random.default_rng(0).standard_normal((8, 8)).astype(np.float32)
+    y = np.random.default_rng(1).integers(0, 4, 8)
+
+    net1, opt1 = build()
+    ref_step = paddle.jit.TrainStep(net1, loss_fn, opt1)
+    ref_losses = [float(ref_step(paddle.to_tensor(x),
+                                 paddle.to_tensor(y)).numpy())
+                  for _ in range(3)]
+
+    net2, opt2 = build()
+    dstep = DistributedTrainStep(net2, loss_fn, opt2, sharding_stage=1)
+    d_losses = [float(dstep(paddle.to_tensor(x),
+                            paddle.to_tensor(y)).numpy())
+                for _ in range(3)]
+    np.testing.assert_allclose(d_losses, ref_losses, rtol=1e-4, atol=1e-5)
+
+
+def test_dryrun_multichip_8():
+    from paddle_tpu.distributed.dryrun import run_dryrun
+    run_dryrun(8)
