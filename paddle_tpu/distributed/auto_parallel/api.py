@@ -92,7 +92,6 @@ def shard_layer(layer, process_mesh, shard_fn=None,
 
     def _default_shard(name, sublayer, mesh):
         for pname, p in list(sublayer._parameters.items()):
-            nd = p._data.ndim
             sublayer._parameters[pname] = shard_tensor(
                 p, mesh, [Replicate() for _ in mesh.dim_names],
                 stop_gradient=p.stop_gradient)

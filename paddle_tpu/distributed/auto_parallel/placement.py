@@ -89,6 +89,15 @@ def placements_to_spec(placements: Sequence[Placement],
     """
     entries: List = [None] * (ndim if ndim is not None else 0)
     for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Partial):
+            # A PartitionSpec cannot express "distinct pending partial sums
+            # per device" for an eager global array; silently mapping it to
+            # Replicate would drop the pending reduction. Partial exists
+            # only inside compiled code, where XLA tracks it.
+            raise NotImplementedError(
+                "Partial placements are not materialisable on an eager "
+                "tensor; reduce first (all_reduce) or keep the value "
+                "inside a compiled region where GSPMD tracks partials")
         if isinstance(pl, Shard):
             d = pl.dim
             if d >= len(entries):

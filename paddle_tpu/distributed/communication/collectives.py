@@ -79,8 +79,9 @@ def _reduce_traced(data, op, axes):
     if op == ReduceOp.AVG:
         return lax.pmean(data, name)
     if op == ReduceOp.PROD:
-        return jnp.exp(lax.psum(jnp.log(data.astype(jnp.float32)), name)
-                       ).astype(data.dtype)
+        # No psum-prod primitive: gather-then-prod is exact (correct sign,
+        # zeros, int dtypes), unlike an exp(psum(log)) trick.
+        return jnp.prod(lax.all_gather(data, name), axis=0)
     raise ValueError(f"unknown ReduceOp {op}")
 
 
@@ -127,7 +128,11 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
         from jax.experimental import multihost_utils
         out = multihost_utils.process_allgather(data)
     else:
-        out = jnp.expand_dims(data, 0)
+        # single controller: every group "rank" holds the same value, so
+        # the gathered list has nranks identical entries (paddle contract:
+        # one entry per group rank — matches all_gather_object below).
+        out = jnp.broadcast_to(jnp.expand_dims(data, 0),
+                               (max(1, g.nranks),) + data.shape)
     if tensor_list is not None:
         tensor_list.extend(
             Tensor._from_array(out[i]) for i in range(out.shape[0]))
@@ -238,6 +243,11 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
     g = _resolve(group)
     data = _data(in_tensor)
     axes = g.axis_names
+    for sizes in (in_split_sizes, out_split_sizes):
+        if sizes and len(set(sizes)) > 1:
+            raise NotImplementedError(
+                "alltoall_single supports even splits only on TPU "
+                f"(got split sizes {list(sizes)}); lax.all_to_all is tiled")
     if _axes_bound(axes):
         name = axes if len(axes) > 1 else axes[0]
         out = lax.all_to_all(data, name, split_axis=0, concat_axis=0,

@@ -129,13 +129,25 @@ def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
         return Group(axis_name=axis_name, ranks=ranks)
     if ranks is None:
         return _get_global_group()
-    # recognise the ranks list as one axis of the global mesh by size
+    # Recognise the ranks list as one axis-group of the global mesh: the
+    # set of ranks sharing all coordinates except on one axis. Matching by
+    # size alone is ambiguous (two axes of equal degree), so reconstruct
+    # the candidate axis-group from the first rank's coordinate and demand
+    # exact equality.
     mesh = mesh_mod.get_mesh()
     if mesh is not None:
-        for ax in mesh.axis_names:
-            deg = mesh_mod.axis_degree(ax)
-            if deg == len(ranks):
-                return Group(axis_name=ax, ranks=list(ranks))
+        topo = mesh_mod.CommunicateTopology()
+        want = sorted(int(r) for r in ranks)
+        if want and 0 <= want[0] and want[-1] < topo.world_size():
+            coord = topo.get_coord(want[0])
+            for ax in topo.get_hybrid_group_names():
+                dim = topo.get_dim(ax)
+                if dim != len(want):
+                    continue
+                axis_ranks = sorted(
+                    topo.get_rank(**{**coord, ax: i}) for i in range(dim))
+                if axis_ranks == want:
+                    return Group(axis_name=ax, ranks=list(ranks))
     return Group(axis_name=None, ranks=list(ranks))
 
 

@@ -30,8 +30,35 @@ def _factor_degrees(n: int):
     return degs
 
 
-def run_dryrun(n_devices: int) -> None:
+def _ensure_devices(n_devices: int):
+    """Get an n-device jax backend, forcing the virtual-CPU platform if the
+    ambient one (e.g. a single real TPU chip, or a site-pinned PJRT plugin
+    that overrides JAX_PLATFORMS=cpu) is too small. Must run before any
+    other jax backend use in this process to take effect."""
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+
+    # Both the env var and the explicit config update are needed: plugin
+    # registration (a site-baked PJRT plugin) out-prioritises either alone,
+    # and they only take effect before backend init.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
     import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    return jax
+
+
+def run_dryrun(n_devices: int) -> None:
+    jax = _ensure_devices(n_devices)
 
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn

@@ -59,7 +59,7 @@ def init(role_maker=None, is_collective=True, strategy=None, log_level=None):
     elif n_need < n_dev and n_dev % n_need == 0:
         degrees = dict(degrees)
         degrees["dp"] = degrees.get("dp", 1) * (n_dev // n_need)
-    mesh_mod.set_mesh(mesh_mod.build_mesh(degrees), degrees)
+    mesh_mod.set_mesh(mesh_mod.build_mesh(degrees))
     mesh_mod.set_hybrid_communicate_group(
         mesh_mod.HybridCommunicateGroup())
     _fleet_initialized = True

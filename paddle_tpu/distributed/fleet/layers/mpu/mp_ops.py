@@ -102,7 +102,9 @@ def _c_softmax_with_cross_entropy(logits, label, group=None,
                                axis=-1)
     mask = (lb_idx != ignore_index)[..., None]
     nll = jnp.where(mask, nll, 0.0)
-    loss = nll if not squeeze else nll
+    # loss shape mirrors the label's: [..., 1] labels keep the trailing
+    # dim (nll already has it); bare [...] labels get it squeezed away.
+    loss = nll if squeeze else nll[..., 0]
     loss_t = wrap(loss) if isinstance(logits, Tensor) else loss
     if return_softmax:
         sm = jnp.exp(log_probs)

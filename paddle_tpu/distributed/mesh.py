@@ -28,7 +28,6 @@ from jax.sharding import Mesh
 HYBRID_AXES = ("pp", "dp", "sharding", "sep", "mp")
 
 _global_mesh: Optional[Mesh] = None
-_global_degrees: Dict[str, int] = {}
 
 
 def build_mesh(degrees: Dict[str, int], devices=None,
@@ -53,12 +52,9 @@ def build_mesh(degrees: Dict[str, int], devices=None,
     return Mesh(arr, axis_names)
 
 
-def set_mesh(mesh: Mesh, degrees: Optional[Dict[str, int]] = None) -> None:
-    global _global_mesh, _global_degrees
+def set_mesh(mesh: Mesh) -> None:
+    global _global_mesh
     _global_mesh = mesh
-    _global_degrees = dict(degrees or
-                           {ax: int(s) for ax, s in
-                            zip(mesh.axis_names, mesh.devices.shape)})
 
 
 def get_mesh() -> Optional[Mesh]:

@@ -119,7 +119,10 @@ def test_eager_collectives_single_process(hybrid_mesh):
     dist.barrier()
     out = []
     dist.all_gather(out, t)
-    assert len(out) == 1
+    # paddle contract: one entry per group rank (world group on the 8-dev
+    # mesh → 8 identical entries under a single controller)
+    assert len(out) == 8
+    np.testing.assert_array_equal(out[3].numpy(), t.numpy())
 
 
 def test_fleet_init_and_groups():
