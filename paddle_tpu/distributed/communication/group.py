@@ -25,18 +25,28 @@ class Group:
     _next_id = 0
 
     def __init__(self, axis_name: Union[None, str, Sequence[str]] = None,
-                 ranks: Optional[List[int]] = None, name: str = ""):
+                 ranks: Optional[List[int]] = None, name: str = "",
+                 unaligned: bool = False):
         if axis_name is None or isinstance(axis_name, str):
             self._axes: Optional[Tuple[str, ...]] = (
                 None if axis_name is None else (axis_name,))
         else:
             self._axes = tuple(axis_name)
         self._ranks = ranks
+        # unaligned: an explicit ranks list that matches no mesh axis —
+        # collectives over it cannot lower to a mesh-axis reduction
+        self._unaligned = bool(unaligned)
         self.name = name or f"group_{Group._next_id}"
         Group._next_id += 1
 
     @property
     def axis_names(self) -> Tuple[str, ...]:
+        if self._unaligned:
+            raise ValueError(
+                f"group {self.name} was built from ranks={self._ranks} "
+                "which match no axis-group of the global mesh; compiled "
+                "collectives require axis-aligned groups (build the mesh "
+                "so the group is one axis, or pass axis_name=)")
         if self._axes is not None:
             return self._axes
         mesh = mesh_mod.get_mesh()
@@ -148,7 +158,7 @@ def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
                     topo.get_rank(**{**coord, ax: i}) for i in range(dim))
                 if axis_ranks == want:
                     return Group(axis_name=ax, ranks=list(ranks))
-    return Group(axis_name=None, ranks=list(ranks))
+    return Group(axis_name=None, ranks=list(ranks), unaligned=True)
 
 
 def get_group(gid=None):

@@ -36,12 +36,16 @@ def _ensure_devices(n_devices: int):
     that overrides JAX_PLATFORMS=cpu) is too small. Must run before any
     other jax backend use in this process to take effect."""
     import os
+    import re
 
     flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n_devices}"
-        ).strip()
+    # replace any pre-existing count (a smaller ambient value would
+    # otherwise win and leave us short of devices)
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   flags).strip()
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
 
     # Both the env var and the explicit config update are needed: plugin
     # registration (a site-baked PJRT plugin) out-prioritises either alone,
@@ -131,3 +135,52 @@ def run_dryrun(n_devices: int) -> None:
     assert np.isfinite(loss2)
     assert loss2 < val + 1.0, "loss diverged after one step"
     print(f"dryrun ok: mesh={degrees} loss0={val:.4f} loss1={loss2:.4f}")
+
+    _dryrun_pipeline(jax, n_devices)
+
+
+def _dryrun_pipeline(jax, n_devices: int) -> None:
+    """Phase 2: compiled GPipe over a pp x dp mesh (PipelineParallel)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        LayerDesc, PipelineLayer, PipelineParallel)
+
+    pp = 4 if n_devices % 4 == 0 else (2 if n_devices % 2 == 0 else 1)
+    if pp == 1:
+        print("dryrun pp: skipped (n_devices not divisible)")
+        return
+    dp = n_devices // pp
+    mesh_mod.set_mesh(mesh_mod.build_mesh({"pp": pp, "dp": dp}))
+
+    hidden, batch = 16, 8 * dp
+    paddle.seed(0)
+
+    class Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(hidden, hidden)
+
+        def forward(self, x):
+            return paddle.tanh(self.fc(x))
+
+    pl = PipelineLayer(
+        layers=[LayerDesc(Block) for _ in range(2 * pp)],
+        num_stages=pp, loss_fn=nn.MSELoss())
+    strategy = fleet.DistributedStrategy()
+    strategy.pipeline_configs["accumulate_steps"] = pp
+    model = PipelineParallel(pl, strategy=strategy)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=pl.parameters())
+
+    rng = np.random.default_rng(1)
+    x = paddle.to_tensor(rng.standard_normal((batch, hidden)).astype(
+        np.float32))
+    y = paddle.to_tensor(rng.standard_normal((batch, hidden)).astype(
+        np.float32))
+    with jax.set_mesh(mesh_mod.get_mesh()):
+        l0 = float(model.train_batch((x, y), opt).numpy())
+        l1 = float(model.train_batch((x, y), opt).numpy())
+    assert np.isfinite(l0) and np.isfinite(l1), (l0, l1)
+    print(f"dryrun pp ok: pp={pp} dp={dp} loss0={l0:.4f} loss1={l1:.4f}")

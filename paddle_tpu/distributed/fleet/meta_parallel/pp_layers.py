@@ -1,0 +1,200 @@
+"""PipelineLayer — pipeline model description + segmentation.
+
+Reference: fleet/meta_parallel/parallel_layers/pp_layers.py:258
+(PipelineLayer), :57 (LayerDesc), :77 (SharedLayerDesc). There, each pp
+rank *builds only its own stage's sublayers* and a runtime exchanges
+activations. TPU-native: the model is built once on the single controller
+(parameters are global jax arrays whose *sharding* puts each stage's
+slice on its pp ranks), segmentation is metadata, and the compiled
+schedule (``paddle_tpu.distributed.pipeline``) turns it into program
+structure. ``forward`` stays a plain sequential run so single-device
+numerics / eager debugging always work.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, List, Optional, Sequence
+
+from ....nn.layer.layers import Layer
+from ... import mesh as mesh_mod
+
+
+class LayerDesc:
+    """Lazy description of one pipeline sublayer (built at PipelineLayer
+    construction; reference pp_layers.py:57 delays building so each rank
+    can skip other stages' layers — here building is cheap and global)."""
+
+    def __init__(self, layer_func: Callable, *inputs, **kwargs):
+        if isinstance(layer_func, type):
+            if not issubclass(layer_func, Layer):
+                raise TypeError("LayerDesc expects a Layer subclass")
+        elif not callable(layer_func):
+            raise TypeError("LayerDesc expects a Layer subclass or callable")
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({getattr(self.layer_func, '__name__', '?')})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """A layer whose weight is shared across stages (reference
+    pp_layers.py:77 — e.g. tied embedding/output head). The compiled SPMD
+    program shares the weight naturally: both occurrences reference the
+    same Parameter object, so tying is exact and the reference's
+    allreduce of shared-weight grads is just XLA's summed cotangent."""
+
+    def __init__(self, key, layer_func, *inputs, forward_func=None,
+                 shared_weight_attr="weight", **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Sequence-of-layers model with stage segmentation metadata.
+
+    Args (reference-shaped):
+        layers: list of Layer | LayerDesc | plain callables.
+        num_stages: pp degree (default: mesh 'pp' axis degree).
+        loss_fn: optional loss layer appended logically after the model.
+        seg_method: "uniform" | "layer:ClassName" (boundary before each
+            occurrence of ClassName) | explicit list of stage sizes.
+        recompute_interval: >0 enables remat in the compiled schedule.
+    """
+
+    def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
+                 topology=None, loss_fn=None, seg_method="uniform",
+                 recompute_interval: int = 0, recompute_ctx=None,
+                 num_virtual_pipeline_stages: Optional[int] = None, **kw):
+        super().__init__()
+        if num_stages is None:
+            num_stages = max(mesh_mod.axis_degree("pp"), 1)
+        self._num_stages = int(num_stages)
+        self._loss_fn = loss_fn
+        self._seg_method = seg_method
+        self._recompute_interval = int(recompute_interval)
+        self._shared_layers = {}
+
+        built: List[Any] = []
+        for desc in layers:
+            if isinstance(desc, SharedLayerDesc):
+                if desc.layer_name in self._shared_layers:
+                    lyr = self._shared_layers[desc.layer_name]
+                    built.append((lyr, desc.forward_func))
+                else:
+                    lyr = desc.build_layer()
+                    self._shared_layers[desc.layer_name] = lyr
+                    built.append((lyr, desc.forward_func))
+            elif isinstance(desc, LayerDesc):
+                built.append(desc.build_layer())
+            else:
+                built.append(desc)  # Layer instance or plain callable
+
+        self._items: List[Any] = built
+        for i, item in enumerate(built):
+            lyr = item[0] if isinstance(item, tuple) else item
+            if isinstance(lyr, Layer):
+                # register each exactly once for state_dict naming
+                if lyr not in self._sub_layers.values():
+                    self.add_sublayer(str(i), lyr)
+        self._stage_bounds = self._segment()
+
+    # -- segmentation --------------------------------------------------------
+    def _segment(self) -> List[int]:
+        n, s = len(self._items), self._num_stages
+        if n < s:
+            raise ValueError(f"{n} layers cannot fill {s} stages")
+        method = self._seg_method
+        if isinstance(method, (list, tuple)):
+            sizes = list(method)
+            if sum(sizes) != n or len(sizes) != s:
+                raise ValueError("explicit segment sizes must cover layers")
+            bounds = [0]
+            for sz in sizes:
+                bounds.append(bounds[-1] + sz)
+            return bounds
+        if isinstance(method, str) and method.startswith("layer:"):
+            cls_name = method[len("layer:"):]
+            marks = [i for i, it in enumerate(self._items)
+                     if type(it[0] if isinstance(it, tuple) else it).__name__
+                     == cls_name]
+            if len(marks) < s:
+                raise ValueError(
+                    f"only {len(marks)} '{cls_name}' layers for {s} stages")
+            # split the marked layers uniformly; boundary = first mark of
+            # each chunk (prefix joins stage 0, suffix joins last stage)
+            per = len(marks) // s
+            rem = len(marks) % s
+            bounds = [0]
+            idx = 0
+            for st in range(s - 1):
+                idx += per + (1 if st < rem else 0)
+                bounds.append(marks[idx])
+            bounds.append(n)
+            return bounds
+        # uniform by layer count
+        per, rem = divmod(n, s)
+        bounds = [0]
+        for st in range(s):
+            bounds.append(bounds[-1] + per + (1 if st < rem else 0))
+        return bounds
+
+    @property
+    def segment_parts(self) -> List[int]:
+        return list(self._stage_bounds)
+
+    def stage_items(self, stage: int) -> List[Any]:
+        lo, hi = self._stage_bounds[stage], self._stage_bounds[stage + 1]
+        return self._items[lo:hi]
+
+    def get_num_stages(self) -> int:
+        return self._num_stages
+
+    # -- execution -----------------------------------------------------------
+    @staticmethod
+    def _apply(item, x):
+        if isinstance(item, tuple):  # (shared layer, forward_func)
+            lyr, ffn = item
+            return ffn(lyr, x) if ffn is not None else lyr(x)
+        return item(x)
+
+    def forward(self, x):
+        for item in self._items:
+            x = self._apply(item, x)
+        return x
+
+    def allreduce_shared_weight_gradients(self):
+        """No-op: tied weights are one Parameter in the compiled program,
+        so their gradient is already the sum over use sites."""
+
+    def pipelinable_run(self):
+        """Find the longest contiguous run of same-class Layer items with
+        identical parameter structure — the region the compiled schedule
+        overlaps. Returns (start, end) indices into the item list."""
+        items = self._items
+        best = (0, 0)
+        i = 0
+        while i < len(items):
+            it = items[i]
+            if not isinstance(it, Layer):
+                i += 1
+                continue
+            names_i = sorted(n for n, p in it.named_parameters())
+            j = i + 1
+            while j < len(items):
+                jt = items[j]
+                if not isinstance(jt, Layer) or type(jt) is not type(it):
+                    break
+                if sorted(n for n, p in jt.named_parameters()) != names_i:
+                    break
+                j += 1
+            if j - i > best[1] - best[0]:
+                best = (i, j)
+            i = j if j > i + 1 else i + 1
+        return best

@@ -1,0 +1,130 @@
+"""Compiled pipeline-parallel schedule over the 'pp' mesh axis.
+
+Reference: python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:255
+(PipelineParallel 1F1B — a Python runtime loop issuing per-microbatch
+forward/backward with batched isend/irecv between stage processes,
+pp_utils/p2p_communication.py:573).
+
+TPU-native design (SURVEY.md §7.1/§7.3): the schedule is *program
+structure*, not a runtime. One `jax.lax.scan` over schedule ticks runs
+inside `jax.shard_map` manual over the 'pp' axis; stage-to-stage
+activation transfer is a single `lax.ppermute` per tick (XLA lowers it to
+an ICI collective-permute); every other mesh axis (dp/sharding/mp) stays
+in GSPMD-auto mode so tensor-parallel constraints inside the stage body
+still apply. Backward is NOT hand-scheduled: `jax.grad` differentiates
+through scan+ppermute, producing the reversed pipeline automatically, and
+XLA's latency-hiding scheduler overlaps the resulting compute/transfer —
+the role 1F1B plays in the reference. Memory is bounded with
+`jax.checkpoint` per stage call (remat ≡ reference recompute_interval).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _ring_perm(n):
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def _varying(tree, axis):
+    """Mark a pytree of arrays as varying over the manual axis (scan carries
+    must have a loop-invariant varying-manual-axes type)."""
+    pcast = getattr(lax, "pcast", None)
+    if pcast is not None:
+        return jax.tree_util.tree_map(
+            lambda a: pcast(a, axis, to="varying"), tree)
+    return jax.tree_util.tree_map(lambda a: lax.pvary(a, axis), tree)
+
+
+def gpipe_local(block_fn: Callable, n_stages: int, n_micro: int,
+                axis: str = "pp", remat: bool = True):
+    """Build the per-device schedule body (to be wrapped in shard_map).
+
+    block_fn(stage_params, x, key, tick) -> y must map activations to
+    activations OF THE SAME SHAPE (homogeneous stages — the same
+    requirement the reference's uniform LayerDesc segmentation satisfies
+    for transformer stacks).
+
+    Returns local_fn(stacked_params_local, xs, key) where
+    stacked_params_local leaves have leading dim 1 (this device's stage
+    slice) and xs is the [n_micro, micro_batch, ...] replicated-over-pp
+    microbatch stack.
+    """
+    S, M = n_stages, n_micro
+    fn = jax.checkpoint(block_fn, static_argnums=()) if remat else block_fn
+
+    def local_fn(stacked_local, xs, key):
+        params = jax.tree_util.tree_map(lambda a: a[0], stacked_local)
+        stage = lax.axis_index(axis)
+        T = M + S - 1
+        y0 = _varying(jnp.zeros_like(xs[0]), axis)
+        outs0 = _varying(jnp.zeros_like(xs), axis)
+
+        def tick(carry, t):
+            prev_y, outs = carry
+            recv = lax.ppermute(prev_y, axis, _ring_perm(S))
+            x_first = lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+            x_in = jnp.where(stage == 0, x_first, recv)
+            y = fn(params, x_in, key, t)
+            valid = (t >= stage) & ((t - stage) < M)
+            y = jnp.where(valid, y, jnp.zeros_like(y))
+            idx = jnp.clip(t - (S - 1), 0, M - 1)
+            collect = valid & (stage == S - 1)
+            cur = lax.dynamic_index_in_dim(outs, idx, 0, keepdims=False)
+            outs = lax.dynamic_update_index_in_dim(
+                outs, jnp.where(collect, y, cur), idx, 0)
+            return (y, outs), None
+
+        (_, outs), _ = lax.scan(tick, (y0, outs0), jnp.arange(T))
+        # Broadcast the last stage's collected outputs to every pp rank
+        # (transpose: scatter of the output cotangent back to last stage).
+        outs = lax.psum(
+            jnp.where(stage == S - 1, outs, jnp.zeros_like(outs)), axis)
+        return outs
+
+    return local_fn
+
+
+def pipeline_apply(block_fn: Callable, stacked_params: Any, xs: jnp.ndarray,
+                   key, mesh: Optional[Mesh] = None, axis: str = "pp",
+                   n_micro: Optional[int] = None, remat: bool = True):
+    """Run the compiled GPipe schedule.
+
+    stacked_params: pytree whose leaves have leading dim n_stages.
+    xs: [n_micro, micro_batch, ...] microbatch stack, replicated over pp.
+    Differentiable in stacked_params and xs. Other mesh axes stay
+    GSPMD-auto (partial-manual shard_map), so dp batch sharding and mp
+    constraints inside block_fn still work.
+    """
+    from . import mesh as mesh_mod
+    mesh = mesh or mesh_mod.ensure_mesh()
+    S = mesh.shape[axis]
+    M = int(n_micro if n_micro is not None else xs.shape[0])
+    local = gpipe_local(block_fn, S, M, axis=axis, remat=remat)
+    spec_params = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(spec_params, P(), P()),
+        out_specs=P(),
+        axis_names={axis})
+    return fn(stacked_params, xs, key)
+
+
+def split_microbatches(x: jnp.ndarray, n_micro: int) -> jnp.ndarray:
+    """[B, ...] -> [n_micro, B // n_micro, ...]."""
+    b = x.shape[0]
+    if b % n_micro != 0:
+        raise ValueError(
+            f"batch size {b} not divisible by accumulate_steps {n_micro}")
+    return x.reshape((n_micro, b // n_micro) + x.shape[1:])
+
+
+def merge_microbatches(ys: jnp.ndarray) -> jnp.ndarray:
+    return ys.reshape((-1,) + ys.shape[2:])
