@@ -1,0 +1,21 @@
+"""Shared helpers for shard_map-manual collective code (pipeline, ring
+attention): ring permutations and varying-manual-axes casts."""
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def ring_perm(n):
+    """[(0,1), (1,2), ..., (n-1,0)] — rotate one hop around the ring."""
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def varying(tree, axis):
+    """Mark a pytree of arrays as varying over the manual axis `axis`
+    (scan carries must have a loop-invariant varying-manual-axes type)."""
+    pcast = getattr(lax, "pcast", None)
+    if pcast is not None:
+        return jax.tree_util.tree_map(
+            lambda a: pcast(a, axis, to="varying"), tree)
+    return jax.tree_util.tree_map(lambda a: lax.pvary(a, axis), tree)

@@ -148,6 +148,8 @@ def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
     if mesh is not None:
         topo = mesh_mod.CommunicateTopology()
         want = sorted(int(r) for r in ranks)
+        if want == list(range(topo.world_size())):
+            return _get_global_group()
         if want and 0 <= want[0] and want[-1] < topo.world_size():
             coord = topo.get_coord(want[0])
             for ax in topo.get_hybrid_group_names():

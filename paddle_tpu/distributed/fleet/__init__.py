@@ -24,6 +24,9 @@ from . import base  # noqa: F401
 from . import layers  # noqa: F401
 from . import meta_optimizers  # noqa: F401
 from . import meta_parallel  # noqa: F401
+from . import recompute as recompute_pkg  # noqa: F401
+from . import utils  # noqa: F401
+from .recompute import recompute  # noqa: F401
 from .base.distributed_strategy import DistributedStrategy  # noqa: F401
 from .base.role_maker import PaddleCloudRoleMaker  # noqa: F401
 from .base.topology import (  # noqa: F401

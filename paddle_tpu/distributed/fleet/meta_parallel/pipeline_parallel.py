@@ -60,6 +60,11 @@ class PipelineParallel(MetaParallelBase):
         super().__init__(layers, strategy=strategy)
         self._mesh = mesh_mod.ensure_mesh()
         self._pp = mesh_mod.axis_degree("pp")
+        if self._pp > 1 and layers.get_num_stages() != self._pp:
+            raise ValueError(
+                f"PipelineLayer was built for {layers.get_num_stages()} "
+                f"stages but the mesh 'pp' axis has degree {self._pp}; "
+                "make them match (num_stages defaults to the mesh degree)")
         cfg = getattr(strategy, "pipeline_configs", None) or {}
         self.accumulate_steps = int(cfg.get("accumulate_steps", 1) or 1)
         if self.accumulate_steps < self._pp:
@@ -183,6 +188,11 @@ class PipelineParallel(MetaParallelBase):
 
     # -- forward (eval / debugging) -----------------------------------------
     def forward(self, *inputs, **kwargs):
+        # train_batch donates the param buffers the Layer's Tensors still
+        # point at; re-sync before any eager read of the model
+        if getattr(self, "_stale_model", False):
+            self.sync_to_model()
+            self._stale_model = False
         return self._layers(*inputs, **kwargs)
 
     # -- the compiled train step --------------------------------------------
@@ -328,12 +338,16 @@ class PipelineParallel(MetaParallelBase):
         sig = (tuple((a.shape, str(a.dtype)) for a in in_arrays),
                id(opt), id(loss_fn))
 
-        entry = self._compiled.get(sig)
-        if entry is None:
+        # cache holds strong refs to opt/loss_fn so their id()s can never
+        # be recycled by a differently-configured object
+        cached = self._compiled.get(sig)
+        if cached is None:
             entry = self._make_step(opt, loss_fn)
-            self._compiled[sig] = entry
+            self._compiled[sig] = (entry, opt, loss_fn)
             if not hasattr(self, "_opt_state"):
                 self._opt_state = opt.init_state_pytree(self._flat_params())
+        else:
+            entry = cached[0]
         pre_p, stacked, post_p, frozen, meta = self._ensure_state()
         key = random_mod.next_key()
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
@@ -341,6 +355,7 @@ class PipelineParallel(MetaParallelBase):
             pre_p, stacked, post_p, self._opt_state, key, lr, in_arrays,
             lab)
         self._state = (pre_p, stacked, post_p, frozen, meta)
+        self._stale_model = True  # Layer tensors now hold donated buffers
         if lr_scheduler is not None:
             lr_scheduler.step()
         return wrap(loss)
@@ -357,6 +372,7 @@ class PipelineParallel(MetaParallelBase):
 
     def eval_batch(self, data, compute_loss=True):
         self.sync_to_model()
+        self._stale_model = False
         inputs, labels = data
         with tape_mod.no_grad_guard():
             out = self._layers(*(inputs if isinstance(inputs, (list, tuple))

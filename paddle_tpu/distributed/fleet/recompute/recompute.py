@@ -1,0 +1,143 @@
+"""Activation checkpointing.
+
+Reference: python/paddle/distributed/fleet/recompute/recompute.py — a
+PyLayer that stows RNG state + inputs in forward and reruns the function
+under the original RNG in backward.
+
+TPU-native: `jax.checkpoint` IS that mechanism inside XLA — it marks the
+wrapped subcomputation for rematerialization, so the compiled backward
+recomputes activations instead of storing them (and the RNG key is part
+of the traced computation, so dropout masks replay exactly). This wrapper
+additionally makes it work from *eager* dygraph: the checkpointed
+function runs as one tape op whose inputs include the layer's parameters,
+so `loss.backward()` still reaches them.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax
+
+from ....core import random as random_mod
+from ....core import tape as tape_mod
+from ....core.dispatch import run_op, unwrap, wrap
+from ....core.tensor import Tensor
+from ....jit.functional import bind_state
+from ....nn.layer.layers import Layer
+
+
+def _owning_layers(function):
+    """Every Layer the callable can reach: itself, its __self__, closure
+    cells, and functools.partial members. Their parameters must become
+    explicit tape inputs — run_op only differentiates listed args, so a
+    param hidden in a closure would silently get no gradient."""
+    found = []
+
+    def add(obj):
+        if isinstance(obj, Layer) and all(obj is not f for f in found):
+            found.append(obj)
+
+    add(function)
+    add(getattr(function, "__self__", None))
+    for cell in getattr(function, "__closure__", None) or ():
+        try:
+            add(cell.cell_contents)
+        except ValueError:
+            pass
+    if isinstance(function, functools.partial):
+        add(function.func)
+        add(getattr(function.func, "__self__", None))
+        for a in function.args:
+            add(a)
+        for a in function.keywords.values():
+            add(a)
+    return found
+
+
+def recompute(function, *args, **kwargs):
+    """Run `function(*args)` with activation rematerialization.
+
+    preserve_rng_state (default True): dropout inside the function replays
+    the same mask in the recomputation — automatic here, because the RNG
+    key is an input of the checkpointed computation.
+    use_reentrant: accepted for API parity; both modes map to
+    jax.checkpoint.
+    """
+    kwargs.pop("preserve_rng_state", True)
+    kwargs.pop("use_reentrant", True)
+    for v in kwargs.values():
+        if isinstance(v, Tensor):
+            raise ValueError(
+                "recompute: pass Tensors positionally (keyword tensors "
+                "would be invisible to the tape)")
+
+    layers = _owning_layers(function)
+    n_args = len(args)
+    key = random_mod.next_key()
+
+    # (layer index, local name, Parameter) for every trainable param the
+    # callable can reach — all become explicit tape inputs
+    named = [(li, n, p) for li, lyr in enumerate(layers)
+             for n, p in lyr.named_parameters() if not p.stop_gradient]
+    frozen = [{n: p._data for n, p in lyr.named_parameters()
+               if p.stop_gradient} for lyr in layers]
+    buffers = [{n: b._data for n, b in lyr.named_buffers()}
+               for lyr in layers]
+
+    def pure(*arrays):
+        arg_arrays = arrays[:n_args]
+        param_arrays = arrays[n_args:]
+        with contextlib.ExitStack() as stack:
+            for li, lyr in enumerate(layers):
+                params = {n: arr for (lj, n, _), arr
+                          in zip(named, param_arrays) if lj == li}
+                stack.enter_context(
+                    bind_state(lyr, params, buffers[li], frozen[li]))
+            stack.enter_context(tape_mod.no_grad_guard())
+            stack.enter_context(random_mod.traced_key_scope(key))
+            targs = [wrap(a) for a in arg_arrays]
+            out = function(*targs, **kwargs)
+        return jax.tree_util.tree_map(
+            lambda t: unwrap(t), out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    inputs = list(args) + [p for _, _, p in named]
+    return run_op("recompute", jax.checkpoint(pure), inputs)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Checkpoint a Sequential in `segments` chunks (reference
+    fleet/recompute/recompute_sequential.py)."""
+    segments = int((ctx or {}).get("segments", 1))
+    if isinstance(functions, Layer):
+        functions = list(functions.children()) if hasattr(
+            functions, "children") else [functions]
+    functions = list(functions)
+    per = max(1, len(functions) // max(1, segments))
+
+    x = args[0] if len(args) == 1 else args
+    i = 0
+    while i < len(functions):
+        chunk = functions[i:i + per]
+        holder = _ChunkLayer(chunk)
+        x = recompute(holder, x, **kwargs)
+        i += per
+    return x
+
+
+class _ChunkLayer(Layer):
+    """Wraps a list of layers so recompute() sees one owning Layer whose
+    parameters cover the whole chunk."""
+
+    def __init__(self, chunk):
+        super().__init__()
+        self._chunk = chunk
+        for j, lyr in enumerate(chunk):
+            if isinstance(lyr, Layer):
+                self.add_sublayer(str(j), lyr)
+
+    def forward(self, x):
+        for f in self._chunk:
+            x = f(x)
+        return x

@@ -1,0 +1,157 @@
+"""Megatron-style sequence parallelism over the tensor-parallel axis.
+
+Reference: fleet/utils/sequence_parallel_utils.py — ScatterOp/GatherOp/
+AllGatherOp/ReduceScatterOp autograd functions (:42-137) and
+ColumnSequenceParallelLinear (:429) / RowSequenceParallelLinear (:564),
+which keep activations sharded along the *sequence* dim across the mp
+group between the TP matmuls (halving activation memory and turning the
+TP allreduce into allgather + reduce-scatter).
+
+TPU-native: each "op" is a sharding constraint on the 'mp' axis at the
+right program point; GSPMD materialises exactly the allgather /
+reduce-scatter pairs the reference hand-codes, and their transposes in
+backward. Layout convention matches the reference: sequence-parallel
+activations are [batch, seq, hidden] sharded on dim 1 over 'mp'.
+"""
+from __future__ import annotations
+
+from ....nn import functional as F
+from ....nn.layer.layers import Layer
+from ... import mesh as mesh_mod
+from ..layers.mpu.mp_layers import _shard_param
+from ..layers.mpu.mp_ops import mark_sharding
+
+_SEQ_DIM = 1
+
+
+def _seq_entries(ndim, entry):
+    entries = [None] * ndim
+    entries[_SEQ_DIM] = entry
+    return entries
+
+
+def scatter(x):
+    """Split the sequence dim over 'mp' (reference ScatterOp: forward
+    scatter, backward allgather)."""
+    if mesh_mod.axis_degree("mp") <= 1:
+        return x
+    return mark_sharding(x, *_seq_entries(len(x.shape), "mp"))
+
+
+def all_gather(x):
+    """Gather the sequence dim from 'mp' (reference GatherOp/AllGatherOp:
+    forward allgather, backward scatter/reduce-scatter)."""
+    if mesh_mod.axis_degree("mp") <= 1:
+        return x
+    return mark_sharding(x, *_seq_entries(len(x.shape), None))
+
+
+def reduce_scatter(x):
+    """Combine partial sums over 'mp' AND shard the result's sequence dim
+    (reference ReduceScatterOp). In GSPMD the partial-sum reduce comes
+    from the producing matmul; constraining the output seq-sharded makes
+    XLA emit one reduce-scatter instead of allreduce."""
+    return scatter(x)
+
+
+class ScatterOp:
+    """Reference-shaped static .apply (sequence_parallel_utils.py:42)."""
+
+    @staticmethod
+    def apply(x):
+        return scatter(x)
+
+
+class GatherOp:
+    @staticmethod
+    def apply(x):
+        return all_gather(x)
+
+
+class AllGatherOp:
+    @staticmethod
+    def apply(x):
+        return all_gather(x)
+
+
+class ReduceScatterOp:
+    @staticmethod
+    def apply(x):
+        return reduce_scatter(x)
+
+
+def mark_as_sequence_parallel_parameter(param):
+    """Reference marks params whose grads need the mp allreduce; with
+    global params + GSPMD the gradient reduction is automatic, so this is
+    metadata only."""
+    param.sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(model, *a, **kw):
+    """No-op (reference :192): sequence-parallel parameter grads are
+    already reduced by the compiled step's GSPMD partitioning."""
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """Sequence-parallel input [b, s/mp, h] -> allgather s -> column-
+    parallel matmul -> output [b, s, out/mp] (reference :429)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr)
+        _shard_param(self, "weight", 1)
+        if has_bias:
+            self.bias = self.create_parameter([out_features], attr=None,
+                                              is_bias=True)
+            _shard_param(self, "bias", 0)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        x = all_gather(x)  # [b, s, h] replicated on seq
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            out = mark_sharding(out, *([None] * len(out.shape)))
+        else:
+            entries = [None] * (len(out.shape) - 1) + ["mp"]
+            out = mark_sharding(out, *entries)
+        return out
+
+
+class RowSequenceParallelLinear(Layer):
+    """Feature-parallel input [b, s, in/mp] -> row-parallel matmul ->
+    reduce-scatter to sequence-parallel output [b, s/mp, out]
+    (reference :564)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, mp_group=None,
+                 name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr)
+        _shard_param(self, "weight", 0)
+        if has_bias:
+            self.bias = self.create_parameter([out_features], attr=None,
+                                              is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, None)
+        out = reduce_scatter(out)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+def create_fused_allreduce_gradient_hooks(*a, **kw):
+    """No-op: XLA's latency-hiding scheduler fuses/overlaps grad
+    reductions (SURVEY.md §7.1 'EagerReducer -> knobs only')."""

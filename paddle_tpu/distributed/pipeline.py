@@ -28,18 +28,8 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def _ring_perm(n):
-    return [(i, (i + 1) % n) for i in range(n)]
-
-
-def _varying(tree, axis):
-    """Mark a pytree of arrays as varying over the manual axis (scan carries
-    must have a loop-invariant varying-manual-axes type)."""
-    pcast = getattr(lax, "pcast", None)
-    if pcast is not None:
-        return jax.tree_util.tree_map(
-            lambda a: pcast(a, axis, to="varying"), tree)
-    return jax.tree_util.tree_map(lambda a: lax.pvary(a, axis), tree)
+from .collective_utils import ring_perm as _ring_perm
+from .collective_utils import varying as _varying
 
 
 def gpipe_local(block_fn: Callable, n_stages: int, n_micro: int,

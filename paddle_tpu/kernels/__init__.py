@@ -5,3 +5,4 @@ flash_attn_kernel.cu, fused MoE dispatch). Here the kernel library is tiny
 by design: XLA is the kernel library for everything else (SURVEY.md §7.1).
 """
 from . import flash_attention  # noqa: F401
+from . import ring_attention  # noqa: F401

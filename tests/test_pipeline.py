@@ -152,3 +152,34 @@ def test_microbatch_split_merge():
                                   np.asarray(x))
     with pytest.raises(ValueError):
         split_microbatches(x, 5)
+
+
+def test_pipeline_forward_after_train_batch(pp_mesh):
+    """Eager forward after train_batch must re-sync donated params
+    (review regression: deleted-buffer error)."""
+    D = 16
+    rng = np.random.default_rng(3)
+    x = paddle.to_tensor(rng.standard_normal((16, D)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((16, D)).astype(np.float32))
+    pl = _build_pp_model(D, 8, seed=11)
+    strategy = fleet.DistributedStrategy()
+    strategy.pipeline_configs["accumulate_steps"] = 4
+    model = PipelineParallel(pl, strategy=strategy)
+    opt = paddle.optimizer.SGD(0.05, parameters=pl.parameters())
+    with jax.set_mesh(pp_mesh):
+        model.train_batch((x, y), opt)
+        out = model(x)  # must not touch donated buffers
+    assert np.all(np.isfinite(np.asarray(out.numpy())))
+
+
+def test_pipeline_num_stages_mismatch_raises(pp_mesh):
+    pl = PipelineLayer(layers=[nn.Linear(4, 4) for _ in range(8)],
+                       num_stages=2)
+    with pytest.raises(ValueError, match="pp"):
+        PipelineParallel(pl, strategy=fleet.DistributedStrategy())
+
+
+def test_new_group_world_ranks(pp_mesh):
+    import paddle_tpu.distributed as dist
+    g = dist.new_group(list(range(8)))
+    assert set(g.axis_names) == set(pp_mesh.axis_names)

@@ -1,0 +1,228 @@
+"""Recompute, sequence parallelism, and ring attention (CP) tests."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.fleet.recompute import (recompute,
+                                                    recompute_sequential)
+from paddle_tpu.distributed.fleet.utils.sequence_parallel_utils import (
+    ColumnSequenceParallelLinear, GatherOp, ReduceScatterOp,
+    RowSequenceParallelLinear, ScatterOp)
+from paddle_tpu.kernels.ring_attention import (ring_attention_arrays,
+                                               ring_flash_attention)
+
+
+# --- recompute ------------------------------------------------------------
+
+class MLP(nn.Layer):
+    def __init__(self, d):
+        super().__init__()
+        self.fc1 = nn.Linear(d, 2 * d)
+        self.fc2 = nn.Linear(2 * d, d)
+
+    def forward(self, x):
+        return self.fc2(paddle.tanh(self.fc1(x)))
+
+
+def test_recompute_matches_plain_eager():
+    paddle.seed(0)
+    net = MLP(8)
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((4, 8)).astype(np.float32))
+    x.stop_gradient = False
+
+    y = net(x)
+    loss = y.sum()
+    loss.backward()
+    ref_gx = np.asarray(x.grad.numpy())
+    ref_gw = np.asarray(net.fc1.weight.grad.numpy())
+    x.clear_grad()
+    for p in net.parameters():
+        p.clear_grad()
+
+    y2 = recompute(net, x)
+    loss2 = y2.sum()
+    loss2.backward()
+    np.testing.assert_allclose(np.asarray(x.grad.numpy()), ref_gx,
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(net.fc1.weight.grad.numpy()),
+                               ref_gw, rtol=1e-5)
+
+
+def test_recompute_under_trainstep():
+    paddle.seed(1)
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.blk = MLP(8)
+            self.head = nn.Linear(8, 4)
+
+        def forward(self, x):
+            h = recompute(self.blk, x)
+            return self.head(h)
+
+    net = Net()
+    rng = np.random.default_rng(1)
+    x = paddle.to_tensor(rng.standard_normal((8, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 4, 8))
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    step = paddle.jit.TrainStep(net, nn.CrossEntropyLoss(), opt)
+    l0 = float(step(x, y).numpy())
+    l2 = float(step(x, y).numpy())
+    assert np.isfinite(l0) and l2 < l0
+
+
+def test_recompute_sequential():
+    paddle.seed(2)
+    seq = nn.Sequential(nn.Linear(8, 8), nn.Tanh(), nn.Linear(8, 8))
+    x = paddle.to_tensor(
+        np.random.default_rng(2).standard_normal((4, 8)).astype(np.float32))
+    ref = seq(x)
+    out = recompute_sequential({"segments": 2}, list(seq), x)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(ref.numpy()), rtol=1e-6)
+
+
+# --- sequence parallel ----------------------------------------------------
+
+@pytest.fixture
+def mp_mesh():
+    prev = mesh_mod.get_mesh()
+    m = mesh_mod.build_mesh({"dp": 2, "mp": 4})
+    mesh_mod.set_mesh(m)
+    yield m
+    mesh_mod._global_mesh = prev
+
+
+def test_sequence_parallel_linears_match_dense(mp_mesh):
+    paddle.seed(3)
+    b, s, h = 2, 8, 16
+    col = ColumnSequenceParallelLinear(h, 4 * h, has_bias=True)
+    row = RowSequenceParallelLinear(4 * h, h, has_bias=True)
+    x = paddle.to_tensor(np.random.default_rng(3).standard_normal(
+        (b, s, h)).astype(np.float32))
+
+    with jax.set_mesh(mp_mesh):
+        xs = ScatterOp.apply(x)
+        out = row(col(xs))
+        out = GatherOp.apply(out)
+        got = np.asarray(out.numpy())
+
+    # dense reference with the same global weights
+    xn = np.asarray(x.numpy())
+    w1 = np.asarray(col.weight.numpy())
+    b1 = np.asarray(col.bias.numpy())
+    w2 = np.asarray(row.weight.numpy())
+    b2 = np.asarray(row.bias.numpy())
+    want = (xn @ w1 + b1) @ w2 + b2
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_scatter_gather_roundtrip(mp_mesh):
+    x = paddle.to_tensor(np.arange(64, dtype=np.float32).reshape(2, 8, 4))
+    with jax.set_mesh(mp_mesh):
+        y = GatherOp.apply(ScatterOp.apply(x))
+        np.testing.assert_array_equal(np.asarray(y.numpy()),
+                                      np.asarray(x.numpy()))
+        z = ReduceScatterOp.apply(x)
+        assert list(z.shape) == [2, 8, 4]  # global logical shape unchanged
+
+
+# --- ring attention -------------------------------------------------------
+
+@pytest.fixture
+def sep_mesh():
+    prev = mesh_mod.get_mesh()
+    m = mesh_mod.build_mesh({"dp": 2, "sep": 4})
+    mesh_mod.set_mesh(m)
+    yield m
+    mesh_mod._global_mesh = prev
+
+
+def _dense_attention(q, k, v, causal, scale):
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        sq = q.shape[2]
+        mask = np.tril(np.ones((sq, sq), bool))
+        s = np.where(mask[None, None], s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(sep_mesh, causal):
+    b, h, s, d = 2, 2, 16, 8
+    rng = np.random.default_rng(4)
+    q = rng.standard_normal((b, h, s, d)).astype(np.float32)
+    k = rng.standard_normal((b, h, s, d)).astype(np.float32)
+    v = rng.standard_normal((b, h, s, d)).astype(np.float32)
+    scale = d ** -0.5
+    with jax.set_mesh(sep_mesh):
+        out = np.asarray(ring_attention_arrays(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            mesh=sep_mesh, causal=causal))
+    want = _dense_attention(q, k, v, causal, scale)
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_ring_attention_grad_matches_dense(sep_mesh):
+    b, h, s, d = 1, 2, 8, 4
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+
+    def ring_loss(q, k, v):
+        return jnp.sum(ring_attention_arrays(
+            q, k, v, mesh=sep_mesh, causal=True) ** 2)
+
+    def dense_loss(q, k, v):
+        scale = d ** -0.5
+        s_ = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        s_ = jnp.where(mask[None, None], s_, -1e30)
+        p = jax.nn.softmax(s_, axis=-1)
+        return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", p, v) ** 2)
+
+    with jax.set_mesh(sep_mesh):
+        g_ring = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    g_dense = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for gr, gd in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gd),
+                                   rtol=1e-3, atol=1e-5)
+
+
+def test_ring_flash_attention_tensor_api(sep_mesh):
+    b, s, h, d = 2, 16, 2, 8
+    rng = np.random.default_rng(6)
+    q = paddle.to_tensor(rng.standard_normal((b, s, h, d)).astype(
+        np.float32))
+    with jax.set_mesh(sep_mesh):
+        out = ring_flash_attention(q, q, q, causal=True)
+    assert list(out.shape) == [b, s, h, d]
+    want = _dense_attention(
+        np.swapaxes(np.asarray(q.numpy()), 1, 2),
+        np.swapaxes(np.asarray(q.numpy()), 1, 2),
+        np.swapaxes(np.asarray(q.numpy()), 1, 2), True, d ** -0.5)
+    np.testing.assert_allclose(np.swapaxes(np.asarray(out.numpy()), 1, 2),
+                               want, rtol=1e-4, atol=1e-5)
+
+
+def test_recompute_lambda_closure_params_get_grads():
+    """Params reached only through a lambda's closure must still train
+    (review regression: closure params were silently dropped)."""
+    paddle.seed(9)
+    net = MLP(8)
+    x = paddle.to_tensor(
+        np.random.default_rng(9).standard_normal((4, 8)).astype(np.float32))
+    y = recompute(lambda t: net(t) * 2.0, x)
+    y.sum().backward()
+    assert net.fc1.weight.grad is not None
+    assert float(abs(net.fc1.weight.grad.numpy()).sum()) > 0
