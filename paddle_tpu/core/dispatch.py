@@ -113,6 +113,10 @@ def run_op(name: str, fn: Callable, tensor_args: Sequence[Any], **attrs):
         adapted_vjp = vjp_fn
     else:
         def adapted_vjp(flat_cts, _vjp=vjp_fn, _td=treedef):
+            # the tape passes a bare array when there is exactly one flat
+            # output, a list otherwise
+            if not isinstance(flat_cts, (list, tuple)):
+                flat_cts = [flat_cts]
             return _vjp(jax.tree_util.tree_unflatten(_td, list(flat_cts)))
 
     input_metas, input_tensors = [], []

@@ -184,3 +184,52 @@ def _dryrun_pipeline(jax, n_devices: int) -> None:
         l1 = float(model.train_batch((x, y), opt).numpy())
     assert np.isfinite(l0) and np.isfinite(l1), (l0, l1)
     print(f"dryrun pp ok: pp={pp} dp={dp} loss0={l0:.4f} loss1={l1:.4f}")
+
+    _dryrun_moe(jax, n_devices)
+
+
+def _dryrun_moe(jax, n_devices: int) -> None:
+    """Phase 3: expert parallelism — MoE dispatch/combine all-to-all over
+    an ep x dp mesh."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+    ep = 4 if n_devices % 4 == 0 else (2 if n_devices % 2 == 0 else 1)
+    if ep == 1:
+        print("dryrun ep: skipped (n_devices not divisible)")
+        return
+    dp = n_devices // ep
+    mesh_mod.set_mesh(mesh_mod.build_mesh({"dp": dp, "ep": ep}))
+
+    hidden, batch, seq = 16, 4 * dp, 8
+    paddle.seed(0)
+
+    class MoENet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.moe = MoELayer(d_model=hidden, d_hidden=2 * hidden,
+                                num_experts=ep, gate="gshard")
+            self.head = nn.Linear(hidden, 8)
+
+        def forward(self, x):
+            return self.head(self.moe(x))
+
+    net = MoENet()
+    ce = nn.CrossEntropyLoss()
+
+    def loss_fn(out, labels):
+        return ce(out, labels) + 0.01 * net.moe.l_aux
+
+    opt = paddle.optimizer.AdamW(1e-3, parameters=net.parameters())
+    step = paddle.jit.TrainStep(net, loss_fn, opt)
+    rng = np.random.default_rng(2)
+    x = paddle.to_tensor(rng.standard_normal(
+        (batch, seq, hidden)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 8, (batch, seq)))
+    with jax.set_mesh(mesh_mod.get_mesh()):
+        l0 = float(step(x, y).numpy())
+        l1 = float(step(x, y).numpy())
+    assert np.isfinite(l0) and np.isfinite(l1), (l0, l1)
+    print(f"dryrun ep ok: ep={ep} dp={dp} loss0={l0:.4f} loss1={l1:.4f}")

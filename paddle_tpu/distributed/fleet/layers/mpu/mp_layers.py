@@ -15,7 +15,7 @@ from .....nn.layer.layers import Layer
 from ....auto_parallel import Replicate, Shard, shard_tensor
 from ....auto_parallel.process_mesh import ProcessMesh
 from ....mesh import axis_degree, ensure_mesh
-from .mp_ops import _c_softmax_with_cross_entropy, mark_sharding
+from .mp_ops import UNSET, _c_softmax_with_cross_entropy, mark_sharding
 
 
 def _mp_mesh() -> ProcessMesh:
@@ -84,11 +84,11 @@ class ColumnParallelLinear(Layer):
     def forward(self, x):
         out = F.linear(x, self.weight, self.bias)
         if self.gather_output:
-            out = mark_sharding(out, *([None] * len(out.shape)))
+            # replicate only the feature dim; batch dims keep dp sharding
+            entries = [UNSET] * (len(out.shape) - 1) + [None]
         else:
-            entries = [None] * (len(out.shape) - 1) + ["mp"]
-            out = mark_sharding(out, *entries)
-        return out
+            entries = [UNSET] * (len(out.shape) - 1) + ["mp"]
+        return mark_sharding(out, *entries)
 
     def extra_repr(self):
         return (f"in={self._in_features}, out={self._out_features}, "
@@ -119,11 +119,11 @@ class RowParallelLinear(Layer):
 
     def forward(self, x):
         if self.input_is_parallel:
-            entries = [None] * (len(x.shape) - 1) + ["mp"]
+            entries = [UNSET] * (len(x.shape) - 1) + ["mp"]
             x = mark_sharding(x, *entries)
         out = F.linear(x, self.weight, self.bias)
-        out = mark_sharding(out, *([None] * len(out.shape)))
-        return out
+        entries = [UNSET] * (len(out.shape) - 1) + [None]
+        return mark_sharding(out, *entries)
 
     def extra_repr(self):
         return (f"in={self._in_features}, out={self._out_features}, "

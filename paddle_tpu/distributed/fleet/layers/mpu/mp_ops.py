@@ -18,25 +18,40 @@ from .....core.tensor import Tensor
 from .... import mesh as mesh_mod
 
 
+# sentinel: leave this tensor dim's sharding to GSPMD (don't force
+# replication); eager device_put treats it as replicated
+UNSET = PartitionSpec.UNCONSTRAINED
+
+
+def _norm_entry(e, mesh):
+    if e is UNSET or e is None or isinstance(e, tuple):
+        return e
+    return e if e in mesh.axis_names else UNSET
+
+
 def _constrain(arr, *entries):
     """Apply a PartitionSpec constraint (traced) or device_put (eager)."""
     mesh = mesh_mod.get_mesh()
     if mesh is None:
         return arr
-    entries = list(entries)[:arr.ndim]
-    entries = [e if e is None or e in mesh.axis_names or
-               isinstance(e, tuple) else None for e in entries]
-    sharding = NamedSharding(mesh, PartitionSpec(*entries))
+    entries = [_norm_entry(e, mesh) for e in list(entries)[:arr.ndim]]
     if isinstance(arr, jax.core.Tracer):
+        sharding = NamedSharding(mesh, PartitionSpec(*entries))
         return jax.lax.with_sharding_constraint(arr, sharding)
-    return jax.device_put(arr, sharding)
+    # device_put can't take UNCONSTRAINED: replicate those dims eagerly
+    entries = [None if e is UNSET else e for e in entries]
+    return jax.device_put(arr, NamedSharding(mesh, PartitionSpec(*entries)))
 
 
 def mark_sharding(x, *entries):
     """Public helper: constrain tensor x's layout (per-tensor-dim mesh
-    axis names, None = replicated on that dim)."""
+    axis names, None = replicated on that dim). Runs as a tape op so
+    eager autograd flows through the constraint (its vjp is the
+    transposed constraint)."""
     if isinstance(x, Tensor):
-        return wrap(_constrain(unwrap(x), *entries))
+        from .....core.dispatch import run_op
+        return run_op("mark_sharding",
+                      lambda a: _constrain(a, *entries), [x])
     return _constrain(x, *entries)
 
 

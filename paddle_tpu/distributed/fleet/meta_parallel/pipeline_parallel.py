@@ -187,13 +187,33 @@ class PipelineParallel(MetaParallelBase):
                             reg[full]._data = v[st]
 
     # -- forward (eval / debugging) -----------------------------------------
-    def forward(self, *inputs, **kwargs):
+    def _resync_if_stale(self):
         # train_batch donates the param buffers the Layer's Tensors still
         # point at; re-sync before any eager read of the model
         if getattr(self, "_stale_model", False):
             self.sync_to_model()
             self._stale_model = False
+
+    def forward(self, *inputs, **kwargs):
+        self._resync_if_stale()
         return self._layers(*inputs, **kwargs)
+
+    # every delegated read of the wrapped model goes through the resync
+    def named_parameters(self, *a, **kw):
+        self._resync_if_stale()
+        return super().named_parameters(*a, **kw)
+
+    def parameters(self, *a, **kw):
+        self._resync_if_stale()
+        return super().parameters(*a, **kw)
+
+    def named_buffers(self, *a, **kw):
+        self._resync_if_stale()
+        return super().named_buffers(*a, **kw)
+
+    def state_dict(self, *a, **kw):
+        self._resync_if_stale()
+        return super().state_dict(*a, **kw)
 
     # -- the compiled train step --------------------------------------------
     def _make_step(self, optimizer, loss_fn):
@@ -371,8 +391,7 @@ class PipelineParallel(MetaParallelBase):
         self._write_back_state(pre_p, stacked, post_p)
 
     def eval_batch(self, data, compute_loss=True):
-        self.sync_to_model()
-        self._stale_model = False
+        self._resync_if_stale()
         inputs, labels = data
         with tape_mod.no_grad_guard():
             out = self._layers(*(inputs if isinstance(inputs, (list, tuple))

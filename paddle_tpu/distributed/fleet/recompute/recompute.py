@@ -85,25 +85,35 @@ def recompute(function, *args, **kwargs):
     buffers = [{n: b._data for n, b in lyr.named_buffers()}
                for lyr in layers]
 
+    buf_keys = [(li, n) for li, d in enumerate(buffers) for n in d]
+
     def pure(*arrays):
         arg_arrays = arrays[:n_args]
         param_arrays = arrays[n_args:]
         with contextlib.ExitStack() as stack:
+            regs = []
             for li, lyr in enumerate(layers):
                 params = {n: arr for (lj, n, _), arr
                           in zip(named, param_arrays) if lj == li}
-                stack.enter_context(
-                    bind_state(lyr, params, buffers[li], frozen[li]))
+                regs.append(stack.enter_context(
+                    bind_state(lyr, params, buffers[li], frozen[li])))
             stack.enter_context(tape_mod.no_grad_guard())
             stack.enter_context(random_mod.traced_key_scope(key))
             targs = [wrap(a) for a in arg_arrays]
             out = function(*targs, **kwargs)
-        return jax.tree_util.tree_map(
+            # mutated buffer values (BatchNorm stats) read before restore
+            new_bufs = tuple(regs[li][n]._data for li, n in buf_keys)
+        out_arrays = jax.tree_util.tree_map(
             lambda t: unwrap(t), out,
             is_leaf=lambda t: isinstance(t, Tensor))
+        return out_arrays, new_bufs
 
     inputs = list(args) + [p for _, _, p in named]
-    return run_op("recompute", jax.checkpoint(pure), inputs)
+    out, new_bufs = run_op("recompute", jax.checkpoint(pure), inputs)
+    for (li, n), t in zip(buf_keys, new_bufs):
+        reg = {bn: b for bn, b in layers[li].named_buffers()}
+        reg[n]._data = unwrap(t)
+    return out
 
 
 def recompute_sequential(ctx, functions, *args, **kwargs):
@@ -116,14 +126,16 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
     functions = list(functions)
     per = max(1, len(functions) // max(1, segments))
 
-    x = args[0] if len(args) == 1 else args
+    out = args
     i = 0
     while i < len(functions):
         chunk = functions[i:i + per]
         holder = _ChunkLayer(chunk)
-        x = recompute(holder, x, **kwargs)
+        out = recompute(holder, *out, **kwargs)
+        if not isinstance(out, tuple):
+            out = (out,)
         i += per
-    return x
+    return out[0] if len(out) == 1 else out
 
 
 class _ChunkLayer(Layer):
@@ -137,7 +149,8 @@ class _ChunkLayer(Layer):
             if isinstance(lyr, Layer):
                 self.add_sublayer(str(j), lyr)
 
-    def forward(self, x):
-        for f in self._chunk:
+    def forward(self, *xs):
+        x = self._chunk[0](*xs)
+        for f in self._chunk[1:]:
             x = f(x)
         return x

@@ -19,13 +19,15 @@ from ....nn import functional as F
 from ....nn.layer.layers import Layer
 from ... import mesh as mesh_mod
 from ..layers.mpu.mp_layers import _shard_param
-from ..layers.mpu.mp_ops import mark_sharding
+from ..layers.mpu.mp_ops import UNSET, mark_sharding
 
 _SEQ_DIM = 1
 
 
 def _seq_entries(ndim, entry):
-    entries = [None] * ndim
+    # only the sequence dim is constrained; batch/feature dims keep
+    # whatever sharding (e.g. dp on batch) GSPMD propagates
+    entries = [UNSET] * ndim
     entries[_SEQ_DIM] = entry
     return entries
 
@@ -117,11 +119,10 @@ class ColumnSequenceParallelLinear(Layer):
         x = all_gather(x)  # [b, s, h] replicated on seq
         out = F.linear(x, self.weight, self.bias)
         if self.gather_output:
-            out = mark_sharding(out, *([None] * len(out.shape)))
+            entries = [UNSET] * (len(out.shape) - 1) + [None]
         else:
-            entries = [None] * (len(out.shape) - 1) + ["mp"]
-            out = mark_sharding(out, *entries)
-        return out
+            entries = [UNSET] * (len(out.shape) - 1) + ["mp"]
+        return mark_sharding(out, *entries)
 
 
 class RowSequenceParallelLinear(Layer):

@@ -1,0 +1,3 @@
+"""paddle.incubate parity surface (reference python/paddle/incubate)."""
+from . import distributed  # noqa: F401
+from . import nn  # noqa: F401

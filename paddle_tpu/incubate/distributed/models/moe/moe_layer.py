@@ -1,0 +1,165 @@
+"""MoELayer — mixture-of-experts with expert parallelism over 'ep'.
+
+Reference: python/paddle/incubate/distributed/models/moe/moe_layer.py:263
+(MoELayer routing through global_scatter/global_gather AllToAll kernels,
+moe_utils.py:20,153) with gates in gate/.
+
+TPU-native: experts live as STACKED parameters [E, ...] sharded over the
+'ep' mesh axis; dispatch/combine are dense einsums against the gate's
+one-hot tensors, so GSPMD lowers the token movement to exactly one
+all-to-all each way over ICI (SURVEY.md §7.2 stage 7) and the per-expert
+FFN to a grouped GEMM on the MXU. Static capacity keeps shapes fixed
+across steps (XLA requirement); overflow tokens are dropped like the
+reference's limit_by_capacity.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .....core.dispatch import run_op, unwrap, wrap
+from .....core import random as random_mod
+from .....distributed import mesh as mesh_mod
+from .....distributed.auto_parallel import Replicate, Shard, shard_tensor
+from .....distributed.auto_parallel.process_mesh import ProcessMesh
+from .....distributed.fleet.layers.mpu.mp_ops import mark_sharding
+from .....nn.layer.layers import Layer
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+
+def _shard_expert_param(layer: Layer, name: str, axis: str = "ep"):
+    """Commit layer.<name> (leading dim = experts) to Shard(0) on `axis`
+    (skipped when the expert count doesn't divide the axis degree)."""
+    p = getattr(layer, name)
+    mesh = ProcessMesh(mesh_mod.ensure_mesh())
+    placements = [Replicate() for _ in mesh.dim_names]
+    deg = mesh_mod.axis_degree(axis)
+    if axis in mesh.dim_names and deg > 1 and p.shape[0] % deg == 0:
+        placements[mesh.dim_names.index(axis)] = Shard(0)
+    sharded = shard_tensor(p, mesh, placements,
+                           stop_gradient=p.stop_gradient)
+    layer._parameters[name] = sharded
+    return sharded
+
+
+class GroupedExpertsFFN(Layer):
+    """E parallel FFN experts as stacked weights [E, h, dff] / [E, dff, h]
+    — the grouped-GEMM formulation of the reference's cutlass fused MoE
+    kernel (paddle/phi/kernels/fusion/cutlass/fused_moe_kernel.cu)."""
+
+    def __init__(self, num_experts: int, d_model: int, d_hidden: int,
+                 activation="gelu", ep_axis: str = "ep"):
+        super().__init__()
+        self.num_experts = num_experts
+        self.w1 = self.create_parameter([num_experts, d_model, d_hidden])
+        self.b1 = self.create_parameter([num_experts, 1, d_hidden],
+                                        is_bias=True)
+        self.w2 = self.create_parameter([num_experts, d_hidden, d_model])
+        self.b2 = self.create_parameter([num_experts, 1, d_model],
+                                        is_bias=True)
+        for n in ("w1", "b1", "w2", "b2"):
+            _shard_expert_param(self, n, ep_axis)
+        self._act = activation
+
+    def forward(self, x):
+        """x: [E, C, h] -> [E, C, h] (batched per-expert GEMMs)."""
+        def fn(x, w1, b1, w2, b2):
+            import jax
+            h = jnp.einsum("ech,ehf->ecf", x, w1) + b1
+            h = jax.nn.gelu(h) if self._act == "gelu" else jnp.maximum(h, 0)
+            return jnp.einsum("ecf,efh->ech", h, w2) + b2
+
+        return run_op("grouped_experts_ffn", fn,
+                      [x, self.w1, self.b1, self.w2, self.b2])
+
+
+class MoELayer(Layer):
+    """Mixture of experts (reference moe_layer.py:263).
+
+    Args:
+        d_model: token hidden size.
+        d_hidden: expert FFN hidden size.
+        num_experts: global expert count (sharded over 'ep').
+        gate: "gshard" | "switch" | "naive" | a BaseGate instance
+            (reference accepts a gate config dict the same way).
+        top_k / capacity_factor: routing config for the named gates.
+        experts: optional custom GroupedExpertsFFN-like Layer taking
+            [E, C, h] -> [E, C, h].
+
+    After forward, `self.l_aux` holds the load-balancing auxiliary loss
+    (add `layer.l_aux * coeff` to the training loss, as the reference's
+    examples do).
+    """
+
+    def __init__(self, d_model: int, d_hidden: int, num_experts: int,
+                 gate="gshard", top_k: Optional[int] = None,
+                 capacity_factor: Optional[float] = None,
+                 experts: Optional[Layer] = None, moe_group=None,
+                 ep_axis: str = "ep", name=None):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.gate_weight = self.create_parameter([d_model, num_experts])
+        if isinstance(gate, BaseGate):
+            self.gate = gate
+        elif gate == "switch":
+            self.gate = SwitchGate(num_experts,
+                                   capacity_factor or 1.25)
+        elif gate == "naive":
+            self.gate = NaiveGate(num_experts, top_k or 2,
+                                  capacity_factor or 1.25)
+        elif gate == "gshard":
+            self.gate = GShardGate(num_experts, capacity_factor or 2.0)
+        else:
+            raise ValueError(
+                f"unknown gate {gate!r}: expected 'gshard', 'switch', "
+                "'naive', or a BaseGate instance")
+        if top_k is not None:
+            self.gate.top_k = top_k
+        self.experts = experts if experts is not None else \
+            GroupedExpertsFFN(num_experts, d_model, d_hidden,
+                              ep_axis=ep_axis)
+        self._ep_axis = ep_axis
+        self.l_aux = None
+
+    def forward(self, x):
+        """x: [batch, seq, h] or [N, h]."""
+        orig_shape = list(x.shape)
+        h = orig_shape[-1]
+        tokens = x.reshape([-1, h])
+        n = tokens.shape[0]
+        cap = self.gate.capacity(int(n))
+        top_k = self.gate.top_k
+        jitter = getattr(self.gate, "jitter", 0.0)
+        training = self.training
+        key = random_mod.next_key() if (jitter and training) else None
+
+        def gating(tok, wg):
+            from .gate import topk_gating
+            logits = tok @ wg
+            return topk_gating(logits, top_k, cap, train=training,
+                               key=key, switch_jitter=jitter)
+
+        dispatch, combine, aux = run_op(
+            "moe_gate", gating, [tokens, self.gate_weight])
+        self.l_aux = aux
+
+        def dispatch_fn(tok, d):
+            return jnp.einsum("nh,nec->ech", tok, d)
+
+        expert_in = run_op("moe_dispatch", dispatch_fn, [tokens, dispatch])
+        # commit the all-to-all: expert dim sharded over 'ep' (only when
+        # the expert count divides the axis degree)
+        deg = mesh_mod.axis_degree(self._ep_axis)
+        ep_entry = self._ep_axis if (
+            deg > 1 and self.num_experts % deg == 0) else None
+        expert_in = mark_sharding(expert_in, ep_entry, None, None)
+        expert_out = self.experts(expert_in)
+        expert_out = mark_sharding(expert_out, ep_entry, None, None)
+
+        def combine_fn(eo, c):
+            return jnp.einsum("ech,nec->nh", eo, c)
+
+        out = run_op("moe_combine", combine_fn, [expert_out, combine])
+        return out.reshape(orig_shape)

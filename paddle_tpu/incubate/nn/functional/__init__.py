@@ -1,0 +1,86 @@
+"""Fused-op API parity (reference python/paddle/incubate/nn/functional).
+
+On TPU the 'fused' ops are XLA fusions of the plain implementations —
+these wrappers provide the reference names with matching semantics.
+"""
+from ....nn import functional as _F
+from ....ops import math as _math
+
+
+def fused_moe(x, gate_weight, *args, **kwargs):
+    raise NotImplementedError(
+        "use paddle_tpu.incubate.distributed.models.moe.MoELayer — the "
+        "grouped-GEMM dispatch is the fused path on TPU")
+
+
+def swiglu(x, y=None):
+    """swiglu(x) = silu(x1) * x2 (reference incubate/nn/functional/swiglu)."""
+    from ....core.dispatch import run_op
+    import jax
+    import jax.numpy as jnp
+
+    if y is not None:
+        return run_op("swiglu", lambda a, b: jax.nn.silu(a) * b, [x, y])
+
+    def fn(a):
+        a1, a2 = jnp.split(a, 2, axis=-1)
+        return jax.nn.silu(a1) * a2
+    return run_op("swiglu", fn, [x])
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1):
+    from ....core.dispatch import run_op
+    import jax.numpy as jnp
+
+    def fn(a, w, b):
+        var = jnp.mean(jnp.square(a), axis=-1, keepdims=True)
+        out = a * jnp.reciprocal(jnp.sqrt(var + epsilon)) * w
+        return out + b if b is not None else out
+
+    args = [x, norm_weight, norm_bias] if norm_bias is not None else \
+        [x, norm_weight]
+    if norm_bias is None:
+        return run_op("fused_rms_norm", lambda a, w: fn(a, w, None), args)
+    return run_op("fused_rms_norm", fn, args)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True):
+    """RoPE (reference incubate/nn/functional/fused_rotary_position_embedding)."""
+    from ....core.dispatch import run_op
+    import jax.numpy as jnp
+
+    def rope_one(t, sin_a, cos_a):
+        # t: [b, s, h, d]
+        if use_neox_rotary_style:
+            d = t.shape[-1]
+            t1, t2 = t[..., : d // 2], t[..., d // 2:]
+            rot = jnp.concatenate([-t2, t1], axis=-1)
+        else:
+            t1 = t[..., 0::2]
+            t2 = t[..., 1::2]
+            rot = jnp.stack([-t2, t1], axis=-1).reshape(t.shape)
+        return t * cos_a + rot * sin_a
+
+    def make(t):
+        if t is None:
+            return None
+        def fn(a, s, c):
+            return rope_one(a, s, c)
+        if sin is None or cos is None:
+            import jax.numpy as jnp
+            d = t.shape[-1]
+            s_len = t.shape[1]
+            inv = 1.0 / (10000.0 ** (jnp.arange(0, d, 2) / d))
+            pos = jnp.arange(s_len)[:, None] * inv[None, :]
+            # [s, d/2] -> [1, s, 1, d] neox layout
+            s_a = jnp.concatenate([jnp.sin(pos), jnp.sin(pos)], axis=-1)
+            c_a = jnp.concatenate([jnp.cos(pos), jnp.cos(pos)], axis=-1)
+            s_a = s_a[None, :, None, :]
+            c_a = c_a[None, :, None, :]
+            return run_op("fused_rope", lambda a: rope_one(a, s_a, c_a), [t])
+        return run_op("fused_rope", fn, [t, sin, cos])
+
+    outs = tuple(make(t) for t in (q, k, v))
+    return outs

@@ -91,8 +91,13 @@ def ring_attention_arrays(q, k, v, mesh=None, axis: str = "sep",
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if n <= 1:
+        # flash_attention_arrays takes paddle layout [B, S, H, D]; we are
+        # [B, H, S, D] here
         from .flash_attention import flash_attention_arrays
-        return flash_attention_arrays(q, k, v, causal=causal, scale=scale)
+        out = flash_attention_arrays(
+            jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+            jnp.swapaxes(v, 1, 2), causal=causal, scale=scale)
+        return jnp.swapaxes(out, 1, 2)
     if q.shape[2] % n:
         raise ValueError(
             f"seq len {q.shape[2]} not divisible by {axis} degree {n}")

@@ -226,3 +226,55 @@ def test_recompute_lambda_closure_params_get_grads():
     y.sum().backward()
     assert net.fc1.weight.grad is not None
     assert float(abs(net.fc1.weight.grad.numpy()).sum()) > 0
+
+
+def test_ring_attention_single_axis_fallback_layout():
+    """n<=1 fallback must keep [b,h,s,d] layout (review regression:
+    heads/seq were swapped into flash_attention)."""
+    prev = mesh_mod.get_mesh()
+    mesh_mod.set_mesh(mesh_mod.build_mesh({"dp": 8}))
+    try:
+        b, h, s, d = 1, 2, 8, 4
+        rng = np.random.default_rng(7)
+        q = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+        out = np.asarray(ring_attention_arrays(q, k, v, causal=True))
+        want = _dense_attention(np.asarray(q), np.asarray(k),
+                                np.asarray(v), True, d ** -0.5)
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+    finally:
+        mesh_mod._global_mesh = prev
+
+
+def test_recompute_updates_buffers():
+    """BatchNorm running stats must update through recompute (review
+    regression: mutations were dropped)."""
+    paddle.seed(10)
+    bn = nn.BatchNorm1D(8)
+    x = paddle.to_tensor(np.random.default_rng(10).standard_normal(
+        (16, 8)).astype(np.float32) * 3 + 1)
+    before = np.asarray(bn._mean.numpy()).copy()
+    recompute(bn, x)
+    after = np.asarray(bn._mean.numpy())
+    assert not np.allclose(before, after)
+
+
+def test_recompute_sequential_multi_arg():
+    class TwoIn(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8)
+
+        def forward(self, a, b):
+            return self.fc(a) + b
+
+    paddle.seed(11)
+    rng = np.random.default_rng(11)
+    a = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    b = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    two = TwoIn()
+    out = recompute_sequential({"segments": 1}, [two], a, b)
+    ref = two(a, b)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(ref.numpy()), rtol=1e-5)
