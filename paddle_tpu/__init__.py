@@ -68,6 +68,8 @@ from .hapi import summary  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
+from . import incubate  # noqa: F401,E402
+from . import profiler  # noqa: F401,E402
 
 
 def disable_static(place=None):

@@ -63,24 +63,23 @@ def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
 
 
 _op_stats = {}
-_collecting = False
 
 
-def _record_op(name, dtype):
-    if _collecting:
-        key = (name, str(dtype))
+def _stats_observer(name, leaves):
+    for a in leaves:
+        key = (name, str(a.dtype))
         _op_stats[key] = _op_stats.get(key, 0) + 1
 
 
 @contextlib.contextmanager
 def collect_operator_stats():
-    global _collecting
+    from ..core import dispatch
     _op_stats.clear()
-    _collecting = True
+    dispatch.OP_OBSERVERS.append(_stats_observer)
     try:
         yield
     finally:
-        _collecting = False
+        dispatch.OP_OBSERVERS.remove(_stats_observer)
         by_dtype = {}
         for (name, dt), cnt in sorted(_op_stats.items()):
             by_dtype.setdefault(dt, []).append((name, cnt))
@@ -91,8 +90,115 @@ def collect_operator_stats():
                 print(f"  {name}: {cnt}")
 
 
+def enable_operator_stats_collection():
+    """Function-style start (reference debugging.py
+    enable_operator_stats_collection); pair with
+    disable_operator_stats_collection."""
+    from ..core import dispatch
+    _op_stats.clear()
+    if _stats_observer not in dispatch.OP_OBSERVERS:
+        dispatch.OP_OBSERVERS.append(_stats_observer)
+
+
+def disable_operator_stats_collection():
+    from ..core import dispatch
+    if _stats_observer in dispatch.OP_OBSERVERS:
+        dispatch.OP_OBSERVERS.remove(_stats_observer)
+    by_dtype = {}
+    for (name, dt), cnt in sorted(_op_stats.items()):
+        by_dtype.setdefault(dt, []).append((name, cnt))
+    print("<------------------- op list ------------------->")
+    for dt, entries in by_dtype.items():
+        print(f"dtype: {dt}")
+        for name, cnt in entries:
+            print(f"  {name}: {cnt}")
+
+
+@contextlib.contextmanager
+def dump_tensor_stats(path):
+    """Record per-op output statistics to a JSONL dump for
+    compare_accuracy (our native replacement for the reference's
+    FLAGS_check_nan_inf dump files)."""
+    import json
+
+    from ..core import dispatch
+
+    f = open(path, "w")
+    seq = {"i": 0}
+
+    def obs(name, leaves):
+        import jax
+
+        for k, a in enumerate(leaves):
+            if not jnp.issubdtype(a.dtype, jnp.inexact):
+                continue
+            if isinstance(a, jax.core.Tracer):
+                # ops running under a trace (TrainStep / recompute) have
+                # no concrete values to dump; compare eager runs instead
+                continue
+            a32 = a.astype(jnp.float32)
+            rec = {
+                "seq": seq["i"], "op": name, "out": k,
+                "dtype": str(a.dtype), "shape": list(a.shape),
+                "mean": float(jnp.mean(a32)),
+                "absmax": float(jnp.max(jnp.abs(a32))),
+                "nan": int(jnp.sum(jnp.isnan(a32))),
+                "inf": int(jnp.sum(jnp.isinf(a32))),
+            }
+            f.write(json.dumps(rec) + "\n")
+            seq["i"] += 1
+
+    dispatch.OP_OBSERVERS.append(obs)
+    try:
+        yield
+    finally:
+        dispatch.OP_OBSERVERS.remove(obs)
+        f.close()
+
+
 def compare_accuracy(dump_path, another_dump_path, output_filename,
                      loss_scale=1, dump_all_tensors=False):
-    raise NotImplementedError(
-        "compare_accuracy requires dump files produced by the reference; "
-        "use check_numerics/enable_tensor_checker on TPU")
+    """Cross-run numerical comparison (reference amp/debugging.py:173
+    compare_accuracy over FLAGS dump files).
+
+    Reads two dump_tensor_stats JSONL files (e.g. an fp32 run and an amp
+    run), aligns records by (op, output index, occurrence), and writes a
+    CSV of mean/absmax relative differences plus nan/inf flags. Returns
+    the list of row dicts (worst first)."""
+    import csv
+    import json
+
+    def load(p):
+        recs = {}
+        with open(p) as f:
+            for line in f:
+                r = json.loads(line)
+                key = (r["op"], r["out"])
+                recs.setdefault(key, []).append(r)
+        return recs
+
+    a, b = load(dump_path), load(another_dump_path)
+    rows = []
+    for key in sorted(set(a) & set(b)):
+        for occ, (ra, rb) in enumerate(zip(a[key], b[key])):
+            denom = max(abs(ra["mean"]), abs(rb["mean"]), 1e-10)
+            mean_rel = abs(ra["mean"] - rb["mean"] * (1.0 / loss_scale
+                           if loss_scale != 1 else 1.0)) / denom
+            dmax = max(ra["absmax"], rb["absmax"], 1e-10)
+            max_rel = abs(ra["absmax"] - rb["absmax"]) / dmax
+            rows.append({
+                "op": key[0], "out": key[1], "occurrence": occ,
+                "dtype_a": ra["dtype"], "dtype_b": rb["dtype"],
+                "mean_a": ra["mean"], "mean_b": rb["mean"],
+                "mean_rel_diff": mean_rel, "absmax_rel_diff": max_rel,
+                "nan_a": ra["nan"], "nan_b": rb["nan"],
+                "inf_a": ra["inf"], "inf_b": rb["inf"],
+            })
+    rows.sort(key=lambda r: -(r["mean_rel_diff"] + r["absmax_rel_diff"]
+                              + 10 * (r["nan_b"] + r["inf_b"])))
+    if rows:
+        with open(output_filename, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+            w.writeheader()
+            w.writerows(rows)
+    return rows

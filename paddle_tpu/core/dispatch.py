@@ -64,6 +64,19 @@ def _check_nan_inf(name, arrays):
                 print("WARNING:", msg)
 
 
+# observers called as obs(op_name, flat_output_arrays) after every op —
+# registered by amp.debugging (op stats collection, accuracy dumps)
+OP_OBSERVERS = []
+
+
+def _notify(name, out):
+    if OP_OBSERVERS:
+        leaves = [a for a in jax.tree_util.tree_leaves(out)
+                  if isinstance(a, jax.Array)]
+        for obs in list(OP_OBSERVERS):
+            obs(name, leaves)
+
+
 def run_op(name: str, fn: Callable, tensor_args: Sequence[Any], **attrs):
     """Execute op `fn(*arrays, **attrs)` eagerly, recording the tape.
 
@@ -90,6 +103,7 @@ def run_op(name: str, fn: Callable, tensor_args: Sequence[Any], **attrs):
         out = fn(*arrays, **attrs)
         if get_flag("check_nan_inf"):
             _check_nan_inf(name, jax.tree_util.tree_leaves(out))
+        _notify(name, out)
         return jax.tree_util.tree_map(
             lambda a: wrap(a, stop_gradient=True), out,
             is_leaf=lambda x: isinstance(x, (jax.Array, np.ndarray)))
@@ -106,6 +120,7 @@ def run_op(name: str, fn: Callable, tensor_args: Sequence[Any], **attrs):
     flat_out, treedef = jax.tree_util.tree_flatten(out)
     if get_flag("check_nan_inf"):
         _check_nan_inf(name, flat_out)
+    _notify(name, flat_out)
 
     # Multi-output vjp takes the full output structure as cotangent; we store
     # a flat view plus the treedef to rebuild it.
@@ -146,6 +161,7 @@ def run_op_nodiff(name: str, fn: Callable, tensor_args: Sequence[Any],
     """Execute a non-differentiable op (comparisons, argmax, ...)."""
     arrays = [unwrap(x) for x in tensor_args]
     out = fn(*arrays, **attrs)
+    _notify(name, out)
     return jax.tree_util.tree_map(
         lambda a: wrap(a, stop_gradient=True), out,
         is_leaf=lambda x: isinstance(x, (jax.Array, np.ndarray)))

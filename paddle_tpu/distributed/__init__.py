@@ -8,6 +8,7 @@ collectives instead of eager NCCL calls (SURVEY.md §7.1). The fleet/
 auto_parallel surfaces are kept paddle-shaped on top.
 """
 from . import auto_parallel  # noqa: F401
+from . import checkpoint  # noqa: F401
 from . import communication  # noqa: F401
 from . import env  # noqa: F401
 from . import fleet  # noqa: F401

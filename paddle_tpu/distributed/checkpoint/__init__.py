@@ -1,0 +1,4 @@
+from .load_state_dict import load_state_dict  # noqa: F401
+from .metadata import (LocalTensorIndex, LocalTensorMetadata,  # noqa: F401
+                       Metadata)
+from .save_state_dict import save_state_dict  # noqa: F401

@@ -1,0 +1,77 @@
+"""Checkpoint metadata — global-tensor → shard-file mapping.
+
+Reference: python/paddle/distributed/checkpoint/metadata.py:20-41
+(LocalTensorMetadata {local_shape, global_offset}, LocalTensorIndex,
+Metadata {state_dict_metadata, storage_metadata}). Same schema, JSON
+serialised so checkpoints are inspectable and portable.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Tuple
+
+
+@dataclasses.dataclass
+class LocalTensorMetadata:
+    """One saved shard of a global tensor."""
+    global_offset: Tuple[int, ...]
+    local_shape: Tuple[int, ...]
+    dtype: str
+
+    def to_json(self):
+        return {"global_offset": list(self.global_offset),
+                "local_shape": list(self.local_shape),
+                "dtype": self.dtype}
+
+    @staticmethod
+    def from_json(d):
+        return LocalTensorMetadata(tuple(d["global_offset"]),
+                                   tuple(d["local_shape"]), d["dtype"])
+
+
+@dataclasses.dataclass
+class LocalTensorIndex:
+    """Identity of a shard: (tensor key, global offset)."""
+    tensor_key: str
+    global_offset: Tuple[int, ...]
+
+    def storage_key(self) -> str:
+        return f"{self.tensor_key}|{','.join(map(str, self.global_offset))}"
+
+
+@dataclasses.dataclass
+class Metadata:
+    """state_dict_metadata: key -> shard list; storage_metadata: shard
+    storage_key -> file; global_shapes: key -> full shape."""
+    state_dict_metadata: Dict[str, List[LocalTensorMetadata]] = \
+        dataclasses.field(default_factory=dict)
+    storage_metadata: Dict[str, str] = dataclasses.field(
+        default_factory=dict)
+    global_shapes: Dict[str, Tuple[int, ...]] = dataclasses.field(
+        default_factory=dict)
+
+    def save(self, path):
+        data = {
+            "state_dict_metadata": {
+                k: [m.to_json() for m in v]
+                for k, v in self.state_dict_metadata.items()},
+            "storage_metadata": self.storage_metadata,
+            "global_shapes": {k: list(v)
+                              for k, v in self.global_shapes.items()},
+        }
+        with open(path, "w") as f:
+            json.dump(data, f, indent=1)
+
+    @staticmethod
+    def load(path) -> "Metadata":
+        with open(path) as f:
+            data = json.load(f)
+        return Metadata(
+            state_dict_metadata={
+                k: [LocalTensorMetadata.from_json(m) for m in v]
+                for k, v in data["state_dict_metadata"].items()},
+            storage_metadata=dict(data["storage_metadata"]),
+            global_shapes={k: tuple(v)
+                           for k, v in data["global_shapes"].items()},
+        )

@@ -1,0 +1,142 @@
+"""Sharded checkpoint save.
+
+Reference: python/paddle/distributed/checkpoint/save_state_dict.py:145 —
+each rank writes its DistTensor's local shard to `<rank>_<i>.distcp` and
+rank 0 writes a global Metadata file.
+
+TPU-native (single controller, multi-device): every value is a global
+jax.Array whose NamedSharding partitions it across devices; we save each
+UNIQUE shard once (replica_id==0), keyed by (tensor, global_offset), into
+one .npz per host process, plus `metadata.json`. Loading reshards freely
+(load_state_dict) because the metadata records every block's offset.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+import jax
+import numpy as np
+
+from ...core.tensor import Tensor
+from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
+
+
+def _to_array(v):
+    if isinstance(v, Tensor):
+        return v._data
+    return v
+
+
+_STD_DTYPES = {"bool", "int8", "int16", "int32", "int64", "uint8",
+               "uint16", "uint32", "uint64", "float16", "float32",
+               "float64", "complex64", "complex128"}
+
+
+def _pack(data: np.ndarray) -> np.ndarray:
+    """npz drops ml_dtypes (bfloat16/fp8) info; store those as raw bytes.
+    The true dtype+shape live in the shard metadata."""
+    if str(data.dtype) in _STD_DTYPES:
+        return data
+    return np.ascontiguousarray(data).reshape(-1).view(np.uint8)
+
+
+def _offset_of(index, shape):
+    """Convert an addressable-shard index (tuple of slices) to offsets."""
+    off = []
+    for sl, dim in zip(index, shape):
+        start = sl.start if sl.start is not None else 0
+        off.append(int(start))
+    # scalar/0-d: index may be shorter than ndim
+    while len(off) < len(shape):
+        off.append(0)
+    return tuple(off)
+
+
+def save_state_dict(state_dict: Dict, path: str,
+                    process_group=None, coordinator_rank: int = 0,
+                    unique_id=None) -> None:
+    """Write a sharded checkpoint under `path`.
+
+    state_dict values may be Tensor / jax.Array / np.ndarray; nested
+    dicts (optimizer accumulators) are flattened with '.'-joined keys.
+    """
+    os.makedirs(path, exist_ok=True)
+    flat = _flatten(state_dict)
+    meta = Metadata()
+    rank = jax.process_index()
+    blocks = {}
+    for key, val in flat.items():
+        arr = _to_array(val)
+        if arr is None:
+            continue
+        if isinstance(arr, (int, float)):
+            arr = np.asarray(arr)
+        shards_meta = []
+        if isinstance(arr, jax.Array) and hasattr(arr, "addressable_shards"):
+            shards = arr.addressable_shards
+        else:
+            shards = None
+        if shards:
+            for sh in shards:
+                if sh.replica_id != 0:
+                    continue  # save each unique block once
+                data = np.asarray(sh.data)
+                off = _offset_of(sh.index, arr.shape)
+                idx = LocalTensorIndex(key, off)
+                blocks[idx.storage_key()] = _pack(data)
+                shards_meta.append(LocalTensorMetadata(
+                    off, tuple(data.shape), str(data.dtype)))
+        else:
+            data = np.asarray(arr)
+            off = tuple([0] * data.ndim)
+            idx = LocalTensorIndex(key, off)
+            blocks[idx.storage_key()] = _pack(data)
+            shards_meta.append(LocalTensorMetadata(
+                off, tuple(data.shape), str(data.dtype)))
+        meta.state_dict_metadata[key] = shards_meta
+        meta.global_shapes[key] = tuple(
+            int(s) for s in np.shape(np.asarray(arr) if not isinstance(
+                arr, jax.Array) else arr))
+
+    fname = f"{rank}_0.distcp.npz"
+    # npz entry names can't contain '/'; escape
+    np.savez(os.path.join(path, fname),
+             **{k.replace("/", "\\"): v for k, v in blocks.items()})
+    for k in blocks:
+        meta.storage_metadata[k] = fname
+    # per-rank manifest: in multi-host runs each rank sees only its own
+    # addressable shards, so the coordinator must merge every manifest
+    meta.save(os.path.join(path, f"meta_shards_{rank}.json"))
+    if rank == coordinator_rank:
+        _merge_manifests(path)
+
+
+def _merge_manifests(path: str) -> None:
+    """Merge every rank's meta_shards_*.json (on the shared checkpoint
+    filesystem) into the global metadata.json. Multi-host callers must
+    barrier between ranks' saves and the coordinator's merge."""
+    import glob
+
+    merged = Metadata()
+    for p in sorted(glob.glob(os.path.join(path, "meta_shards_*.json"))):
+        m = Metadata.load(p)
+        for k, shards in m.state_dict_metadata.items():
+            have = merged.state_dict_metadata.setdefault(k, [])
+            seen = {tuple(s.global_offset) for s in have}
+            have.extend(s for s in shards
+                        if tuple(s.global_offset) not in seen)
+        merged.storage_metadata.update(m.storage_metadata)
+        merged.global_shapes.update(m.global_shapes)
+    merged.save(os.path.join(path, "metadata.json"))
+
+
+def _flatten(d, prefix=""):
+    out = {}
+    for k, v in d.items():
+        kk = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten(v, kk))
+        else:
+            out[kk] = v
+    return out

@@ -137,6 +137,7 @@ def run_dryrun(n_devices: int) -> None:
     print(f"dryrun ok: mesh={degrees} loss0={val:.4f} loss1={loss2:.4f}")
 
     _dryrun_pipeline(jax, n_devices)
+    _dryrun_moe(jax, n_devices)
 
 
 def _dryrun_pipeline(jax, n_devices: int) -> None:
@@ -184,8 +185,6 @@ def _dryrun_pipeline(jax, n_devices: int) -> None:
         l1 = float(model.train_batch((x, y), opt).numpy())
     assert np.isfinite(l0) and np.isfinite(l1), (l0, l1)
     print(f"dryrun pp ok: pp={pp} dp={dp} loss0={l0:.4f} loss1={l1:.4f}")
-
-    _dryrun_moe(jax, n_devices)
 
 
 def _dryrun_moe(jax, n_devices: int) -> None:

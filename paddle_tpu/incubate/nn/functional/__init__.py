@@ -1,10 +1,14 @@
 """Fused-op API parity (reference python/paddle/incubate/nn/functional).
 
 On TPU the 'fused' ops are XLA fusions of the plain implementations —
-these wrappers provide the reference names with matching semantics.
+these wrappers provide the reference names, delegating to the canonical
+implementations in paddle_tpu.nn.functional where they exist.
 """
-from ....nn import functional as _F
-from ....ops import math as _math
+import jax.numpy as jnp
+
+from ....core.dispatch import run_op
+from ....nn.functional.activation import swiglu  # noqa: F401
+from ....nn.functional.norm import rms_norm
 
 
 def fused_moe(x, gate_weight, *args, **kwargs):
@@ -13,46 +17,37 @@ def fused_moe(x, gate_weight, *args, **kwargs):
         "grouped-GEMM dispatch is the fused path on TPU")
 
 
-def swiglu(x, y=None):
-    """swiglu(x) = silu(x1) * x2 (reference incubate/nn/functional/swiglu)."""
-    from ....core.dispatch import run_op
-    import jax
-    import jax.numpy as jnp
-
-    if y is not None:
-        return run_op("swiglu", lambda a, b: jax.nn.silu(a) * b, [x, y])
-
-    def fn(a):
-        a1, a2 = jnp.split(a, 2, axis=-1)
-        return jax.nn.silu(a1) * a2
-    return run_op("swiglu", fn, [x])
-
-
 def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
                    begin_norm_axis=-1):
-    from ....core.dispatch import run_op
-    import jax.numpy as jnp
+    """RMS norm over dims [begin_norm_axis:] (reference
+    incubate/nn/functional/fused_rms_norm)."""
+    ndim = len(x.shape)
+    ax = begin_norm_axis % ndim
+    if ax == ndim - 1:
+        out = rms_norm(x, norm_weight, epsilon=epsilon)
+        return out + norm_bias if norm_bias is not None else out
 
-    def fn(a, w, b):
-        var = jnp.mean(jnp.square(a), axis=-1, keepdims=True)
-        out = a * jnp.reciprocal(jnp.sqrt(var + epsilon)) * w
-        return out + b if b is not None else out
+    axes = tuple(range(ax, ndim))
 
-    args = [x, norm_weight, norm_bias] if norm_bias is not None else \
-        [x, norm_weight]
-    if norm_bias is None:
-        return run_op("fused_rms_norm", lambda a, w: fn(a, w, None), args)
+    def fn(a, w, *rest):
+        ms = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=axes,
+                      keepdims=True)
+        out = a * jnp.reciprocal(jnp.sqrt(ms + epsilon)).astype(a.dtype)
+        out = out * w
+        return out + rest[0] if rest else out
+
+    args = [x, norm_weight] + ([norm_bias] if norm_bias is not None
+                               else [])
     return run_op("fused_rms_norm", fn, args)
 
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
-                                    position_ids=None, use_neox_rotary_style=True):
-    """RoPE (reference incubate/nn/functional/fused_rotary_position_embedding)."""
-    from ....core.dispatch import run_op
-    import jax.numpy as jnp
+                                    position_ids=None,
+                                    use_neox_rotary_style=True):
+    """RoPE on [b, s, h, d] tensors (reference
+    incubate/nn/functional/fused_rotary_position_embedding)."""
 
     def rope_one(t, sin_a, cos_a):
-        # t: [b, s, h, d]
         if use_neox_rotary_style:
             d = t.shape[-1]
             t1, t2 = t[..., : d // 2], t[..., d // 2:]
@@ -63,24 +58,35 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
             rot = jnp.stack([-t2, t1], axis=-1).reshape(t.shape)
         return t * cos_a + rot * sin_a
 
+    def angles_for(t):
+        """[s, d] sin/cos tables in the layout matching the rotary style:
+        neox = [θ0..θd/2-1, θ0..θd/2-1], interleaved = [θ0,θ0,θ1,θ1,…]."""
+        d = t.shape[-1]
+        s_len = t.shape[1]
+        inv = 1.0 / (10000.0 ** (jnp.arange(0, d, 2) / d))
+        if position_ids is not None:
+            from ....core.dispatch import unwrap
+            pos_idx = jnp.asarray(unwrap(position_ids))  # [b?, s] or [s]
+            if pos_idx.ndim == 2:
+                pos_idx = pos_idx[0]
+        else:
+            pos_idx = jnp.arange(s_len)
+        pos = pos_idx[:, None] * inv[None, :]  # [s, d/2]
+        if use_neox_rotary_style:
+            s_a = jnp.concatenate([jnp.sin(pos), jnp.sin(pos)], axis=-1)
+            c_a = jnp.concatenate([jnp.cos(pos), jnp.cos(pos)], axis=-1)
+        else:
+            s_a = jnp.repeat(jnp.sin(pos), 2, axis=-1)
+            c_a = jnp.repeat(jnp.cos(pos), 2, axis=-1)
+        return s_a[None, :, None, :], c_a[None, :, None, :]
+
     def make(t):
         if t is None:
             return None
-        def fn(a, s, c):
-            return rope_one(a, s, c)
-        if sin is None or cos is None:
-            import jax.numpy as jnp
-            d = t.shape[-1]
-            s_len = t.shape[1]
-            inv = 1.0 / (10000.0 ** (jnp.arange(0, d, 2) / d))
-            pos = jnp.arange(s_len)[:, None] * inv[None, :]
-            # [s, d/2] -> [1, s, 1, d] neox layout
-            s_a = jnp.concatenate([jnp.sin(pos), jnp.sin(pos)], axis=-1)
-            c_a = jnp.concatenate([jnp.cos(pos), jnp.cos(pos)], axis=-1)
-            s_a = s_a[None, :, None, :]
-            c_a = c_a[None, :, None, :]
-            return run_op("fused_rope", lambda a: rope_one(a, s_a, c_a), [t])
-        return run_op("fused_rope", fn, [t, sin, cos])
+        if sin is not None and cos is not None:
+            return run_op("fused_rope", rope_one, [t, sin, cos])
+        s_a, c_a = angles_for(t)
+        return run_op("fused_rope",
+                      lambda a: rope_one(a, s_a, c_a), [t])
 
-    outs = tuple(make(t) for t in (q, k, v))
-    return outs
+    return tuple(make(t) for t in (q, k, v))

@@ -239,6 +239,11 @@ class TrainStep:
         self._params, self._buffers, self._opt_state, loss = fn(
             self._params, self._buffers, self._frozen, self._opt_state, key,
             lr, in_arrays, lab_arrays)
+        # re-point the Layer's tensors at the fresh outputs (reference
+        # swap, no copies) — the donated inputs they held are now deleted,
+        # and any eager read (state_dict/checkpoint/print) must see live
+        # arrays without an explicit sync_to_model call
+        write_back(self._model, self._params, self._buffers)
         return wrap(loss)
 
     def sync_to_model(self):

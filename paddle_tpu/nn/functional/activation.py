@@ -7,10 +7,11 @@ import jax.numpy as jnp
 from ...core.dispatch import run_op, unwrap
 
 
-def _unary(name, fn):
+def _unary(op_name, fn):
     def op(x, name=None):
-        return run_op(name, fn, [x])
-    op.__name__ = name
+        # the paddle-compat `name` kwarg must not shadow the op name
+        return run_op(op_name, fn, [x])
+    op.__name__ = op_name
     return op
 
 

@@ -6,10 +6,11 @@ import jax.numpy as jnp
 from ..core.dispatch import run_op_nodiff, unwrap, wrap
 
 
-def _cmp(name, fn):
+def _cmp(op_name, fn):
     def op(x, y, name=None):
-        return run_op_nodiff(name, fn, [x, y])
-    op.__name__ = name
+        # the paddle-compat `name` kwarg must not shadow the op name
+        return run_op_nodiff(op_name, fn, [x, y])
+    op.__name__ = op_name
     return op
 
 

@@ -21,10 +21,11 @@ def _axis(axis):
 
 # ---- binary elementwise ----------------------------------------------------
 
-def _binary(name, fn):
+def _binary(op_name, fn):
     def op(x, y, name=None):
-        return run_op(name, fn, [x, y])
-    op.__name__ = name
+        # the paddle-compat `name` kwarg must not shadow the op name
+        return run_op(op_name, fn, [x, y])
+    op.__name__ = op_name
     return op
 
 
@@ -68,10 +69,11 @@ def multiply_no_nan(x, y, name=None):
 
 # ---- unary elementwise -----------------------------------------------------
 
-def _unary(name, fn):
+def _unary(op_name, fn):
     def op(x, name=None):
-        return run_op(name, fn, [x])
-    op.__name__ = name
+        # the paddle-compat `name` kwarg must not shadow the op name
+        return run_op(op_name, fn, [x])
+    op.__name__ = op_name
     return op
 
 

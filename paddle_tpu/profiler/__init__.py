@@ -1,0 +1,18 @@
+"""paddle.profiler parity surface over jax.profiler.
+
+Reference: python/paddle/profiler/profiler.py:358 (Profiler with
+scheduler states CLOSED/READY/RECORD/RECORD_AND_RETURN), utils.py:47
+(RecordEvent), profiler.py:227 (export_chrome_tracing), timer.py
+(throughput benchmark hooked into hapi).
+
+TPU-native: the device tracer is jax.profiler (XPlane → TensorBoard/
+perfetto); RecordEvent maps to jax.profiler.TraceAnnotation so user
+annotations appear on the device timeline; host-side durations are also
+aggregated in-process so `summary()` works without TensorBoard
+(reference profiler_statistic.py role).
+"""
+from .profiler import (Profiler, ProfilerState, ProfilerTarget,  # noqa: F401
+                       RecordEvent, export_chrome_tracing,
+                       export_protobuf, make_scheduler)
+from .timer import benchmark  # noqa: F401
+from .profiler_statistic import SortedKeys, StatisticData  # noqa: F401

@@ -1,0 +1,234 @@
+"""Profiler core (reference python/paddle/profiler/profiler.py:358)."""
+from __future__ import annotations
+
+import enum
+import os
+import time
+from collections import defaultdict
+from typing import Callable, Iterable, Optional
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Step-keyed state schedule (reference profiler.py make_scheduler)."""
+    period = closed + ready + record
+
+    def schedule(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+def _default_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+class _HostEventStore:
+    """In-process host event aggregation (reference host_tracer role)."""
+
+    def __init__(self):
+        self.events = []  # (name, start, end)
+
+    def add(self, name, start, end):
+        self.events.append((name, start, end))
+
+    def aggregate(self):
+        agg = defaultdict(lambda: [0, 0.0, float("inf"), 0.0])
+        for name, s, e in self.events:
+            d = (e - s) * 1e3  # ms
+            a = agg[name]
+            a[0] += 1
+            a[1] += d
+            a[2] = min(a[2], d)
+            a[3] = max(a[3], d)
+        return {k: dict(calls=v[0], total_ms=v[1], min_ms=v[2],
+                        max_ms=v[3], avg_ms=v[1] / max(v[0], 1))
+                for k, v in agg.items()}
+
+
+_current_store: Optional[_HostEventStore] = None
+
+
+class RecordEvent:
+    """User annotation (reference utils.py:47): shows on the device trace
+    via jax.profiler.TraceAnnotation and in host summaries."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ann = None
+        self._start = None
+
+    def begin(self):
+        self.__enter__()
+
+    def end(self):
+        self.__exit__(None, None, None)
+
+    def __enter__(self):
+        import jax
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._ann is not None:
+            self._ann.__exit__(*(exc or (None, None, None)))
+            self._ann = None
+        if _current_store is not None and self._start is not None:
+            _current_store.add(self.name, self._start, time.perf_counter())
+        return False
+
+
+class Profiler:
+    """Reference-shaped Profiler.
+
+        with paddle.profiler.Profiler(on_trace_ready=...) as p:
+            for batch in loader:
+                train_step(...)
+                p.step()
+    """
+
+    def __init__(self, *, targets: Optional[Iterable] = None,
+                 scheduler=None, on_trace_ready=None, record_shapes=False,
+                 profile_memory=False, timer_only=False, log_dir=None,
+                 **kw):
+        if callable(scheduler):
+            self._scheduler = scheduler
+        elif isinstance(scheduler, (tuple, list)) and len(scheduler) == 2:
+            lo, hi = scheduler
+            self._scheduler = make_scheduler(
+                closed=max(lo, 0), ready=0, record=hi - lo, repeat=1)
+        else:
+            self._scheduler = _default_scheduler
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._log_dir = log_dir or os.environ.get(
+            "PADDLE_PROFILER_LOG_DIR", "./profiler_log")
+        self.step_num = 0
+        self._state = ProfilerState.CLOSED
+        self._tracing = False
+        self._store = _HostEventStore()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        global _current_store
+        _current_store = self._store
+        self._state = self._scheduler(self.step_num)
+        self._transit()
+
+    def stop(self):
+        global _current_store
+        if self._tracing:
+            self._stop_trace()
+        if self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+        _current_store = None
+
+    def step(self, num_samples: Optional[int] = None):
+        self.step_num += 1
+        new_state = self._scheduler(self.step_num)
+        if new_state != self._state:
+            self._state = new_state
+            self._transit()
+
+    def _transit(self):
+        want_trace = self._state in (ProfilerState.RECORD,
+                                     ProfilerState.RECORD_AND_RETURN) \
+            and not self._timer_only
+        if want_trace and not self._tracing:
+            self._start_trace()
+        elif not want_trace and self._tracing:
+            self._stop_trace()
+
+    def _start_trace(self):
+        import jax
+        os.makedirs(self._log_dir, exist_ok=True)
+        try:
+            jax.profiler.start_trace(self._log_dir)
+            self._tracing = True
+        except Exception:
+            self._tracing = False  # tracing unavailable (e.g. nested)
+
+    def _stop_trace(self):
+        import jax
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            self._tracing = False
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- reporting -----------------------------------------------------------
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        """Host-side event table (reference profiler_statistic report)."""
+        agg = self._store.aggregate()
+        if not agg:
+            return "no host events recorded (wrap code in RecordEvent)"
+        lines = [f"{'name':<40}{'calls':>8}{'total(ms)':>12}"
+                 f"{'avg(ms)':>12}{'max(ms)':>12}"]
+        for name, st in sorted(agg.items(), key=lambda kv:
+                               -kv[1]["total_ms"]):
+            lines.append(f"{name:<40}{st['calls']:>8}"
+                         f"{st['total_ms']:>12.3f}{st['avg_ms']:>12.3f}"
+                         f"{st['max_ms']:>12.3f}")
+        return "\n".join(lines)
+
+    @property
+    def profiler_result_dir(self):
+        return self._log_dir
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready factory (reference profiler.py:227). The XPlane
+    files jax.profiler writes under log_dir are viewable in
+    TensorBoard/perfetto; this callback records where they landed."""
+    def handler(prof: Profiler):
+        os.makedirs(dir_name, exist_ok=True)
+        marker = os.path.join(dir_name, "TRACE_LOCATION.txt")
+        with open(marker, "w") as f:
+            f.write(prof.profiler_result_dir + "\n")
+    return handler
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+def load_profiler_result(path):
+    raise NotImplementedError(
+        "use TensorBoard/perfetto on the XPlane files under log_dir")

@@ -1,0 +1,28 @@
+"""Summary statistics types (reference profiler_statistic.py)."""
+from __future__ import annotations
+
+import enum
+
+
+class SortedKeys(enum.Enum):
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class StatisticData:
+    """Aggregated view over a Profiler's host events."""
+
+    def __init__(self, profiler):
+        self._agg = profiler._store.aggregate()
+
+    def items(self):
+        return self._agg.items()
+
+    def __getitem__(self, name):
+        return self._agg[name]

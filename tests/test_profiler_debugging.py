@@ -1,0 +1,134 @@
+"""Profiler + amp.debugging tests."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.amp.debugging import (collect_operator_stats,
+                                      compare_accuracy, dump_tensor_stats)
+from paddle_tpu.profiler import (Profiler, ProfilerState, RecordEvent,
+                                 benchmark, make_scheduler)
+
+
+def test_make_scheduler_states():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=1,
+                           skip_first=1)
+    states = [sched(i) for i in range(6)]
+    assert states[0] == ProfilerState.CLOSED        # skip_first
+    assert states[1] == ProfilerState.CLOSED
+    assert states[2] == ProfilerState.READY
+    assert states[3] == ProfilerState.RECORD
+    assert states[4] == ProfilerState.RECORD_AND_RETURN
+    assert states[5] == ProfilerState.CLOSED        # repeat exhausted
+
+
+def test_profiler_summary_and_trace(tmp_path):
+    paddle.seed(0)
+    net = nn.Linear(16, 16)
+    x = paddle.to_tensor(np.ones((4, 16), np.float32))
+    with Profiler(log_dir=str(tmp_path / "trace"),
+                  timer_only=True) as prof:
+        for _ in range(3):
+            with RecordEvent("fwd"):
+                net(x)
+            prof.step()
+    s = prof.summary()
+    assert "fwd" in s and "calls" in s
+
+
+def test_record_event_begin_end():
+    ev = RecordEvent("manual")
+    ev.begin()
+    ev.end()
+
+
+def test_benchmark_ips():
+    b = benchmark()
+    b.enable()
+    b._warmup = 0
+    for _ in range(3):
+        b.begin()
+        b.step(num_samples=32)
+    assert b.ips > 0
+    assert b.report()["avg_batch_sec"] >= 0
+    b.disable()
+
+
+def test_collect_operator_stats(capsys):
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    with collect_operator_stats():
+        _ = x + x
+        _ = paddle.matmul(x, x)
+    out = capsys.readouterr().out
+    assert "op list" in out
+    assert "float32" in out
+
+
+def test_dump_and_compare_accuracy(tmp_path):
+    x = paddle.to_tensor(np.full((4, 4), 2.0, np.float32))
+
+    with dump_tensor_stats(str(tmp_path / "a.jsonl")):
+        _ = paddle.matmul(x, x) + 1.0
+    with dump_tensor_stats(str(tmp_path / "b.jsonl")):
+        _ = paddle.matmul(x * 1.001, x) + 1.0
+
+    out_csv = str(tmp_path / "cmp.csv")
+    rows = compare_accuracy(str(tmp_path / "a.jsonl"),
+                            str(tmp_path / "b.jsonl"), out_csv)
+    assert rows and os.path.exists(out_csv)
+    assert any(r["mean_rel_diff"] > 0 for r in rows)
+    assert all(r["nan_b"] == 0 for r in rows)
+
+
+def test_operator_stats_function_style(capsys):
+    from paddle_tpu.amp.debugging import (
+        disable_operator_stats_collection, enable_operator_stats_collection)
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    enable_operator_stats_collection()
+    _ = x * x
+    disable_operator_stats_collection()
+    out = capsys.readouterr().out
+    assert "multiply" in out
+
+
+def test_dump_tensor_stats_skips_traced_ops(tmp_path):
+    """dump under TrainStep must not crash on tracers (review regression)."""
+    paddle.seed(1)
+    net = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    step = paddle.jit.TrainStep(net, nn.MSELoss(), opt)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    y = paddle.to_tensor(np.zeros((2, 4), np.float32))
+    with dump_tensor_stats(str(tmp_path / "t.jsonl")):
+        l = step(x, y)
+    assert np.isfinite(float(l.numpy()))
+
+
+def test_fused_ops_numerics():
+    from paddle_tpu.incubate.nn.functional import (
+        fused_rms_norm, fused_rotary_position_embedding)
+    rng = np.random.default_rng(2)
+    x = paddle.to_tensor(rng.standard_normal((2, 3, 8)).astype(np.float32))
+    w = paddle.to_tensor(np.ones(8, np.float32))
+    # begin_norm_axis=1 normalizes over dims 1..2
+    out = fused_rms_norm(x, w, begin_norm_axis=1)
+    xn = np.asarray(x.numpy())
+    ms = np.mean(xn ** 2, axis=(1, 2), keepdims=True)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               xn / np.sqrt(ms + 1e-6), rtol=1e-5)
+
+    # interleaved (non-neox) RoPE round-trip: rotating by pos then -pos
+    q = paddle.to_tensor(rng.standard_normal((1, 4, 2, 8)).astype(
+        np.float32))
+    (rq, _, _) = fused_rotary_position_embedding(
+        q, use_neox_rotary_style=False)
+    assert list(rq.shape) == [1, 4, 2, 8]
+    # position 0 is unrotated in both styles
+    np.testing.assert_allclose(np.asarray(rq.numpy())[:, 0],
+                               np.asarray(q.numpy())[:, 0], rtol=1e-6)
+    # norm is preserved by rotation
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(rq.numpy()), axis=-1),
+        np.linalg.norm(np.asarray(q.numpy()), axis=-1), rtol=1e-5)
