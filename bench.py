@@ -1,11 +1,13 @@
 """Benchmark driver — prints ONE JSON line.
 
-BASELINE.md config 1: LeNet/MNIST under Model.fit-style training, compiled
-train step on the real chip. Metric: training steps/sec (batch 256).
-vs_baseline compares against the reference's published number — none exists
-in-tree (BASELINE.md: "published": {}), so vs_baseline is reported against
-the eager per-op dygraph path of THIS framework (the analog of reference
-dygraph), i.e. the compiled-path speedup.
+Headline: LLaMA causal-LM training throughput on the real chip
+(BASELINE.md config 4 family — tokens/sec/chip and achieved MFU vs the
+north-star 50% target; vs_baseline = achieved_MFU / 0.50). The same line
+carries the LeNet/MNIST compiled-step metric (BASELINE config 1) and the
+compiled-vs-eager speedup as extras.
+
+MFU = tokens/sec x train FLOPs/token / peak chip FLOP/s. Peak numbers
+per device kind below (bf16); unknown kinds fall back to v5e.
 """
 from __future__ import annotations
 
@@ -14,60 +16,117 @@ import time
 
 import numpy as np
 
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e bf16
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,   # Trillium reports 'TPU v6 lite'
+    "TPU v6e": 918e12,
+}
 
-def main():
+
+def bench_llama():
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.text.models import (LlamaConfig, LlamaForCausalLM,
+                                        llama_flops_per_token)
+
+    paddle.seed(0)
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=512, intermediate_size=1408,
+        num_hidden_layers=8, num_attention_heads=8,
+        num_key_value_heads=8, max_position_embeddings=1024)
+    batch, seq = 8, 512
+    net = LlamaForCausalLM(cfg)
+    loss_fn = nn.CrossEntropyLoss()
+    opt = paddle.optimizer.AdamW(3e-4, parameters=net.parameters())
+    step = paddle.jit.TrainStep(net, loss_fn, opt, amp_dtype="bfloat16")
+
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
+    labels = paddle.to_tensor(
+        rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
+
+    step(ids, labels)                       # compile
+    float(step(ids, labels).numpy())        # warm
+    n = 30
+    t0 = time.perf_counter()
+    for _ in range(n):
+        loss = step(ids, labels)
+    float(loss.numpy())
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = n * batch * seq / dt
+    flops_tok = llama_flops_per_token(cfg)
+    kind = jax.devices()[0].device_kind
+    peak = PEAK_FLOPS.get(kind, 197e12)
+    mfu = tokens_per_sec * flops_tok / peak
+    return tokens_per_sec, mfu, kind
+
+
+def bench_lenet():
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     from paddle_tpu.vision.models import LeNet
 
     paddle.seed(0)
     batch = 256
-    x = np.random.default_rng(0).standard_normal(
-        (batch, 1, 28, 28)).astype(np.float32)
-    y = np.random.default_rng(1).integers(0, 10, batch)
-    xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+        (batch, 1, 28, 28)).astype(np.float32))
+    y = paddle.to_tensor(np.random.default_rng(1).integers(0, 10, batch))
 
     net = LeNet()
     loss_fn = nn.CrossEntropyLoss()
     opt = paddle.optimizer.Adam(1e-3, parameters=net.parameters())
     step = paddle.jit.TrainStep(net, loss_fn, opt)
-
-    # compile + warmup
-    step(xt, yt)
-    l = step(xt, yt)
-    float(l.numpy())
-
-    n = 200
+    step(x, y)
+    float(step(x, y).numpy())
+    n = 100
     t0 = time.perf_counter()
     for _ in range(n):
-        l = step(xt, yt)
-    float(l.numpy())  # sync
-    dt = time.perf_counter() - t0
-    steps_per_sec = n / dt
+        loss = step(x, y)
+    float(loss.numpy())
+    compiled_sps = n / (time.perf_counter() - t0)
 
-    # eager dygraph path (reference-analog baseline): per-op dispatch + tape
+    # eager dygraph path (the reference-dygraph analog)
     net2 = LeNet()
     opt2 = paddle.optimizer.Adam(1e-3, parameters=net2.parameters())
-    out = loss_fn(net2(xt), yt)
-    out.backward()
-    opt2.step()
-    opt2.clear_grad()
-    n2 = 10
-    t0 = time.perf_counter()
-    for _ in range(n2):
-        loss = loss_fn(net2(xt), yt)
+
+    def eager_step():
+        loss = loss_fn(net2(x), y)
         loss.backward()
         opt2.step()
         opt2.clear_grad()
-    float(loss.numpy())
-    dt2 = time.perf_counter() - t0
-    eager_sps = n2 / dt2
+        return loss
 
+    eager_step()
+    n2 = 10
+    t0 = time.perf_counter()
+    for _ in range(n2):
+        loss = eager_step()
+    float(loss.numpy())
+    eager_sps = n2 / (time.perf_counter() - t0)
+    return compiled_sps, compiled_sps / eager_sps
+
+
+def main():
+    tokens_per_sec, mfu, kind = bench_llama()
+    lenet_sps, speedup = bench_lenet()
     print(json.dumps({
-        "metric": "lenet_mnist_train_steps_per_sec_b256",
-        "value": round(steps_per_sec, 2),
-        "unit": "steps/sec",
-        "vs_baseline": round(steps_per_sec / eager_sps, 2),
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(mfu / 0.50, 3),
+        "extras": {
+            "llama_mfu": round(mfu, 4),
+            "device_kind": kind,
+            "lenet_train_steps_per_sec_b256": round(lenet_sps, 2),
+            "lenet_compiled_vs_eager_speedup": round(speedup, 1),
+        },
     }))
 
 

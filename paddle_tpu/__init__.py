@@ -70,6 +70,7 @@ from . import vision  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
+from . import text  # noqa: F401,E402
 
 
 def disable_static(place=None):

@@ -71,25 +71,6 @@ def _stats_observer(name, leaves):
         _op_stats[key] = _op_stats.get(key, 0) + 1
 
 
-@contextlib.contextmanager
-def collect_operator_stats():
-    from ..core import dispatch
-    _op_stats.clear()
-    dispatch.OP_OBSERVERS.append(_stats_observer)
-    try:
-        yield
-    finally:
-        dispatch.OP_OBSERVERS.remove(_stats_observer)
-        by_dtype = {}
-        for (name, dt), cnt in sorted(_op_stats.items()):
-            by_dtype.setdefault(dt, []).append((name, cnt))
-        print("<------------------- op list ------------------->")
-        for dt, entries in by_dtype.items():
-            print(f"dtype: {dt}")
-            for name, cnt in entries:
-                print(f"  {name}: {cnt}")
-
-
 def enable_operator_stats_collection():
     """Function-style start (reference debugging.py
     enable_operator_stats_collection); pair with
@@ -112,6 +93,15 @@ def disable_operator_stats_collection():
         print(f"dtype: {dt}")
         for name, cnt in entries:
             print(f"  {name}: {cnt}")
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
 
 
 @contextlib.contextmanager
@@ -179,17 +169,21 @@ def compare_accuracy(dump_path, another_dump_path, output_filename,
 
     a, b = load(dump_path), load(another_dump_path)
     rows = []
+    inv_scale = 1.0 / loss_scale
     for key in sorted(set(a) & set(b)):
         for occ, (ra, rb) in enumerate(zip(a[key], b[key])):
-            denom = max(abs(ra["mean"]), abs(rb["mean"]), 1e-10)
-            mean_rel = abs(ra["mean"] - rb["mean"] * (1.0 / loss_scale
-                           if loss_scale != 1 else 1.0)) / denom
-            dmax = max(ra["absmax"], rb["absmax"], 1e-10)
-            max_rel = abs(ra["absmax"] - rb["absmax"]) / dmax
+            # run b was recorded with loss scaling: unscale BOTH stats
+            # before any comparison
+            b_mean = rb["mean"] * inv_scale
+            b_absmax = rb["absmax"] * inv_scale
+            denom = max(abs(ra["mean"]), abs(b_mean), 1e-10)
+            mean_rel = abs(ra["mean"] - b_mean) / denom
+            dmax = max(ra["absmax"], b_absmax, 1e-10)
+            max_rel = abs(ra["absmax"] - b_absmax) / dmax
             rows.append({
                 "op": key[0], "out": key[1], "occurrence": occ,
                 "dtype_a": ra["dtype"], "dtype_b": rb["dtype"],
-                "mean_a": ra["mean"], "mean_b": rb["mean"],
+                "mean_a": ra["mean"], "mean_b": b_mean,
                 "mean_rel_diff": mean_rel, "absmax_rel_diff": max_rel,
                 "nan_a": ra["nan"], "nan_b": rb["nan"],
                 "inf_a": ra["inf"], "inf_b": rb["inf"],

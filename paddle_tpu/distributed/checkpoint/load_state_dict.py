@@ -84,8 +84,9 @@ def _assign(target, full):
         arr = target._data
         sharding = getattr(arr, "sharding", None) if isinstance(
             arr, jax.Array) else None
-        new = np.asarray(full).astype(np.asarray(arr).dtype) \
-            if arr is not None else full
+        # read dtype from the array object — np.asarray would pull the
+        # whole tensor to host just to inspect it
+        new = full.astype(arr.dtype) if arr is not None else full
         if sharding is not None:
             target._data = jax.device_put(new, sharding)
         else:

@@ -113,13 +113,25 @@ def save_state_dict(state_dict: Dict, path: str,
 
 
 def _merge_manifests(path: str) -> None:
-    """Merge every rank's meta_shards_*.json (on the shared checkpoint
-    filesystem) into the global metadata.json. Multi-host callers must
-    barrier between ranks' saves and the coordinator's merge."""
+    """Merge every CURRENT rank's meta_shards_<rank>.json into the global
+    metadata.json. Manifests from ranks outside the current process count
+    (stale leftovers of an earlier save with more hosts) are deleted so
+    they can't leak old shard offsets into this checkpoint. Multi-host
+    callers must barrier between ranks' saves and the coordinator's
+    merge."""
     import glob
+    import re
 
-    merged = Metadata()
+    n_proc = jax.process_count()
+    paths = []
     for p in sorted(glob.glob(os.path.join(path, "meta_shards_*.json"))):
+        m_rank = re.search(r"meta_shards_(\d+)\.json$", p)
+        if m_rank and int(m_rank.group(1)) >= n_proc:
+            os.remove(p)
+            continue
+        paths.append(p)
+    merged = Metadata()
+    for p in paths:
         m = Metadata.load(p)
         for k, shards in m.state_dict_metadata.items():
             have = merged.state_dict_metadata.setdefault(k, [])

@@ -58,35 +58,60 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
             rot = jnp.stack([-t2, t1], axis=-1).reshape(t.shape)
         return t * cos_a + rot * sin_a
 
-    def angles_for(t):
-        """[s, d] sin/cos tables in the layout matching the rotary style:
-        neox = [θ0..θd/2-1, θ0..θd/2-1], interleaved = [θ0,θ0,θ1,θ1,…]."""
-        d = t.shape[-1]
-        s_len = t.shape[1]
-        inv = 1.0 / (10000.0 ** (jnp.arange(0, d, 2) / d))
+    def angles_for(a):
+        """sin/cos tables (in a.dtype, broadcastable to [b, s, 1, d]) in
+        the layout matching the rotary style: neox =
+        [θ0..θd/2-1, θ0..θd/2-1], interleaved = [θ0,θ0,θ1,θ1,…].
+        `a` is the raw jnp array; position_ids may be [s] or [b, s]
+        (per-row positions, e.g. left-padded batches)."""
+        d = a.shape[-1]
+        s_len = a.shape[1]
+        inv = 1.0 / (10000.0 ** (jnp.arange(0, d, 2, dtype=jnp.float32)
+                                 / d))
         if position_ids is not None:
             from ....core.dispatch import unwrap
-            pos_idx = jnp.asarray(unwrap(position_ids))  # [b?, s] or [s]
-            if pos_idx.ndim == 2:
-                pos_idx = pos_idx[0]
+            pos_idx = jnp.asarray(unwrap(position_ids))  # [s] or [b, s]
         else:
             pos_idx = jnp.arange(s_len)
-        pos = pos_idx[:, None] * inv[None, :]  # [s, d/2]
+        # pos: [..., s, d/2] with a leading batch dim iff per-row ids
+        pos = pos_idx.astype(jnp.float32)[..., :, None] * inv
         if use_neox_rotary_style:
             s_a = jnp.concatenate([jnp.sin(pos), jnp.sin(pos)], axis=-1)
             c_a = jnp.concatenate([jnp.cos(pos), jnp.cos(pos)], axis=-1)
         else:
             s_a = jnp.repeat(jnp.sin(pos), 2, axis=-1)
             c_a = jnp.repeat(jnp.cos(pos), 2, axis=-1)
-        return s_a[None, :, None, :], c_a[None, :, None, :]
+        s_a = s_a.astype(a.dtype)[..., :, None, :]  # [..., s, 1, d]
+        c_a = c_a.astype(a.dtype)[..., :, None, :]
+        if s_a.ndim == 3:  # shared positions -> add batch dim
+            s_a, c_a = s_a[None], c_a[None]
+        return s_a, c_a
+
+    def gather_table(tab, a):
+        """Index a user-provided [s_max, d]-ish sin/cos table by
+        position_ids (reference gathers sin[position_ids])."""
+        t = jnp.asarray(tab)
+        t = t.reshape(t.shape[-2], t.shape[-1])  # [s_max, d]
+        from ....core.dispatch import unwrap
+        pos_idx = jnp.asarray(unwrap(position_ids))
+        g = t[pos_idx]                  # [s, d] or [b, s, d]
+        g = g.astype(a.dtype)[..., :, None, :]
+        if g.ndim == 3:
+            g = g[None]
+        return g
 
     def make(t):
         if t is None:
             return None
         if sin is not None and cos is not None:
-            return run_op("fused_rope", rope_one, [t, sin, cos])
-        s_a, c_a = angles_for(t)
+            if position_ids is None:
+                return run_op("fused_rope", rope_one, [t, sin, cos])
+            return run_op(
+                "fused_rope",
+                lambda a, s_, c_: rope_one(a, gather_table(s_, a),
+                                           gather_table(c_, a)),
+                [t, sin, cos])
         return run_op("fused_rope",
-                      lambda a: rope_one(a, s_a, c_a), [t])
+                      lambda a: rope_one(a, *angles_for(a)), [t])
 
     return tuple(make(t) for t in (q, k, v))

@@ -179,6 +179,8 @@ class TrainStep:
         self._opt_state = optimizer.init_state_pytree(self._params)
         self._compiled = {}
         self._donate = donate
+        from .functional import _tensor_registry
+        self._registry = _tensor_registry(model)
 
     def _make_step(self):
         model, loss_fn, opt = self._model, self._loss_fn, self._opt
@@ -243,7 +245,8 @@ class TrainStep:
         # swap, no copies) — the donated inputs they held are now deleted,
         # and any eager read (state_dict/checkpoint/print) must see live
         # arrays without an explicit sync_to_model call
-        write_back(self._model, self._params, self._buffers)
+        write_back(self._model, self._params, self._buffers,
+                   registry=self._registry)
         return wrap(loss)
 
     def sync_to_model(self):

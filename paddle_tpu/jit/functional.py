@@ -94,9 +94,11 @@ def functional_call(layer, params, buffers, args, kwargs=None,
     return out_arrays, new_buffers
 
 
-def write_back(layer, params, buffers=None):
-    """Push updated arrays back into the layer's Tensors (post-step sync)."""
-    reg = _tensor_registry(layer)
+def write_back(layer, params, buffers=None, registry=None):
+    """Push updated arrays back into the layer's Tensors (post-step sync).
+    Pass a prebuilt `registry` (from _tensor_registry) on hot paths to
+    skip the per-call module-tree walk."""
+    reg = registry if registry is not None else _tensor_registry(layer)
     for name, arr in params.items():
         if name in reg:
             reg[name]._data = arr

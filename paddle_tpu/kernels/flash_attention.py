@@ -137,8 +137,9 @@ def flash_attention_arrays(q, k, v, causal=False, scale=None,
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    on_tpu = qt.devices() and next(iter(qt.devices())).platform in (
-        "tpu", "axon") if hasattr(qt, "devices") else False
+    import jax
+    # backend platform, not array placement: tracers have no devices
+    on_tpu = jax.default_backend() in ("tpu", "axon")
     use_pallas = force_pallas or (
         on_tpu and _tileable(qt.shape[2], kt.shape[2], qt.shape[3]))
     if use_pallas:

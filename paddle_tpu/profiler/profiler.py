@@ -146,16 +146,30 @@ class Profiler:
 
     def stop(self):
         global _current_store
+        had_trace = self._tracing
         if self._tracing:
             self._stop_trace()
-        if self._on_trace_ready is not None:
+        # fire only for a cycle still open at stop(); completed cycles
+        # already fired in step()
+        if self._on_trace_ready is not None and (
+                had_trace or self._timer_only):
             self._on_trace_ready(self)
         _current_store = None
 
     def step(self, num_samples: Optional[int] = None):
+        prev = self._state
         self.step_num += 1
         new_state = self._scheduler(self.step_num)
-        if new_state != self._state:
+        if prev == ProfilerState.RECORD_AND_RETURN:
+            # end of a recording cycle: close the trace (even if the next
+            # cycle records again — cycles must not merge) and hand the
+            # result to on_trace_ready, per the reference contract
+            if self._tracing:
+                self._stop_trace()
+            if self._on_trace_ready is not None:
+                self._on_trace_ready(self)
+        if new_state != self._state or prev == \
+                ProfilerState.RECORD_AND_RETURN:
             self._state = new_state
             self._transit()
 

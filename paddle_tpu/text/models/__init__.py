@@ -1,0 +1,4 @@
+from .bert import BertConfig, BertForPretraining, BertModel  # noqa: F401
+from .ernie_moe import ErnieMoEConfig, ErnieMoEForCausalLM  # noqa: F401
+from .llama import (LlamaConfig, LlamaDecoderLayer,  # noqa: F401
+                    LlamaForCausalLM, LlamaModel, llama_flops_per_token)

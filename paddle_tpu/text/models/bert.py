@@ -1,0 +1,174 @@
+"""BERT model family (BASELINE.md config 3: BERT-base + ZeRO-2).
+
+Reference analog: the reference ships transformer building blocks
+(python/paddle/nn/layer/transformer.py) and exercises BERT-style models
+throughout test/; model zoo lives in PaddleNLP. This is the in-tree
+TPU-native equivalent: homogeneous encoder blocks (pipelinable), mpu TP
+layers when an 'mp' axis is active, MLM pretraining head.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ...core.dispatch import run_op
+from ...distributed import mesh as mesh_mod
+from ...distributed.fleet.layers.mpu import (ColumnParallelLinear,
+                                             RowParallelLinear,
+                                             VocabParallelEmbedding)
+from ...nn import functional as F
+from ...nn.layer.common import Dropout, Embedding, Linear
+from ...nn.layer.layers import Layer
+from ...nn.layer.norm import LayerNorm
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-12
+
+    @staticmethod
+    def bert_base():
+        return BertConfig()
+
+    @staticmethod
+    def tiny(vocab=128, hidden=64, layers=2, heads=4):
+        return BertConfig(vocab_size=vocab, hidden_size=hidden,
+                          num_hidden_layers=layers,
+                          num_attention_heads=heads,
+                          intermediate_size=hidden * 4,
+                          max_position_embeddings=128)
+
+
+def _use_tp():
+    return mesh_mod.axis_degree("mp") > 1
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        Emb = VocabParallelEmbedding if _use_tp() else Embedding
+        self.word_embeddings = Emb(c.vocab_size, c.hidden_size)
+        self.position_embeddings = Embedding(c.max_position_embeddings,
+                                             c.hidden_size)
+        self.token_type_embeddings = Embedding(c.type_vocab_size,
+                                               c.hidden_size)
+        self.layer_norm = LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+        self.dropout = Dropout(c.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        b, s = input_ids.shape
+        import numpy as np
+
+        from ... import ops
+        pos = ops.creation.arange(0, s, dtype="int64").reshape([1, s])
+        x = self.word_embeddings(input_ids)
+        x = x + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            x = x + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class BertSelfAttention(Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        self.num_heads = c.num_attention_heads
+        self.head_dim = c.hidden_size // c.num_attention_heads
+        hs = c.hidden_size
+        if _use_tp():
+            self.qkv = ColumnParallelLinear(hs, 3 * hs,
+                                            gather_output=False)
+            self.out = RowParallelLinear(hs, hs, input_is_parallel=True)
+        else:
+            self.qkv = Linear(hs, 3 * hs)
+            self.out = Linear(hs, hs)
+        self.dropout_p = c.attention_probs_dropout_prob
+
+    def forward(self, x, attn_mask=None):
+        b, s, _ = x.shape
+        qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        from ...ops.manipulation import split as _split
+        q, k, v = [t.squeeze(2) for t in _split(qkv, 3, axis=2)]
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.dropout_p,
+            training=self.training)
+        return self.out(out.reshape([b, s, -1]))
+
+
+class BertEncoderLayer(Layer):
+    """Homogeneous block (post-LN like BERT)."""
+
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        self.attention = BertSelfAttention(c)
+        self.attn_norm = LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+        if _use_tp():
+            self.fc1 = ColumnParallelLinear(c.hidden_size,
+                                            c.intermediate_size,
+                                            gather_output=False)
+            self.fc2 = RowParallelLinear(c.intermediate_size,
+                                         c.hidden_size,
+                                         input_is_parallel=True)
+        else:
+            self.fc1 = Linear(c.hidden_size, c.intermediate_size)
+            self.fc2 = Linear(c.intermediate_size, c.hidden_size)
+        self.ffn_norm = LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+        self.dropout = Dropout(c.hidden_dropout_prob)
+
+    def forward(self, x):
+        x = self.attn_norm(x + self.dropout(self.attention(x)))
+        h = self.fc2(F.gelu(self.fc1(x)))
+        return self.ffn_norm(x + self.dropout(h))
+
+
+class BertModel(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        from ...nn.layer.container import LayerList
+        self.encoder = LayerList(
+            [BertEncoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.pooler = Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        for lyr in self.encoder:
+            x = lyr(x)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForPretraining(Layer):
+    """MLM + NSP heads (reference BertPretrainingHeads shape)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.transform = Linear(config.hidden_size, config.hidden_size)
+        self.transform_norm = LayerNorm(config.hidden_size,
+                                        epsilon=config.layer_norm_eps)
+        # decoder tied to word embeddings
+        self.nsp_head = Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None):
+        seq, pooled = self.bert(input_ids, token_type_ids)
+        h = self.transform_norm(F.gelu(self.transform(seq)))
+        w = self.bert.embeddings.word_embeddings.weight
+
+        def decode(hh, ww):
+            return jnp.einsum("bsh,vh->bsv", hh, ww)
+
+        mlm_logits = run_op("mlm_decode", decode, [h, w])
+        nsp_logits = self.nsp_head(pooled)
+        return mlm_logits, nsp_logits

@@ -1,0 +1,100 @@
+"""ERNIE-MoE style mixture-of-experts causal LM (BASELINE.md config 5:
+ERNIE-MoE 8x7B, expert-parallel AllToAll over ICI).
+
+Reference analog: python/paddle/incubate/distributed/models/moe (MoELayer
+used inside ERNIE-style transformers). Decoder blocks alternate dense and
+MoE FFNs (every `moe_every` layers) like the GShard/Switch recipe; the
+MoE dispatch all-to-alls over the 'ep' axis.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ...incubate.distributed.models.moe import MoELayer
+from ...nn.layer.layers import Layer
+from .llama import (LlamaAttention, LlamaConfig, LlamaRMSNorm)
+
+
+@dataclass
+class ErnieMoEConfig(LlamaConfig):
+    num_experts: int = 8
+    moe_every: int = 2          # every Nth block uses an MoE FFN
+    top_k: int = 2
+    capacity_factor: float = 2.0
+    aux_loss_coeff: float = 0.01
+
+    @staticmethod
+    def tiny(vocab=128, hidden=64, layers=2, heads=4, experts=4):
+        return ErnieMoEConfig(
+            vocab_size=vocab, hidden_size=hidden,
+            intermediate_size=hidden * 2,
+            num_hidden_layers=layers, num_attention_heads=heads,
+            num_key_value_heads=heads, num_experts=experts)
+
+
+class ErnieMoEDecoderLayer(Layer):
+    def __init__(self, config: ErnieMoEConfig, use_moe: bool):
+        super().__init__()
+        self.input_layernorm = LlamaRMSNorm(config.hidden_size,
+                                            config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = LlamaRMSNorm(config.hidden_size,
+                                                     config.rms_norm_eps)
+        if use_moe:
+            self.mlp = MoELayer(
+                d_model=config.hidden_size,
+                d_hidden=config.intermediate_size,
+                num_experts=config.num_experts, gate="gshard",
+                top_k=config.top_k,
+                capacity_factor=config.capacity_factor)
+        else:
+            from .llama import LlamaMLP
+            self.mlp = LlamaMLP(config)
+        self.is_moe = use_moe
+
+    def forward(self, x):
+        x = x + self.self_attn(self.input_layernorm(x))
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class ErnieMoEForCausalLM(Layer):
+    def __init__(self, config: ErnieMoEConfig):
+        super().__init__()
+        self.config = config
+        from ...distributed import mesh as mesh_mod
+        from ...distributed.fleet.layers.mpu import VocabParallelEmbedding
+        from ...nn.layer.common import Embedding, Linear
+        from ...nn.layer.container import LayerList
+
+        if mesh_mod.axis_degree("mp") > 1:
+            self.embed_tokens = VocabParallelEmbedding(
+                config.vocab_size, config.hidden_size)
+        else:
+            self.embed_tokens = Embedding(config.vocab_size,
+                                          config.hidden_size)
+        self.layers = LayerList([
+            ErnieMoEDecoderLayer(
+                config,
+                use_moe=(i % config.moe_every == config.moe_every - 1))
+            for i in range(config.num_hidden_layers)])
+        self.norm = LlamaRMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                              bias_attr=False)
+
+    def forward(self, input_ids):
+        x = self.embed_tokens(input_ids)
+        for lyr in self.layers:
+            x = lyr(x)
+        return self.lm_head(self.norm(x))
+
+    def aux_loss(self):
+        """Sum of the MoE load-balancing losses from the last forward."""
+        total = None
+        for lyr in self.layers:
+            if lyr.is_moe and lyr.mlp.l_aux is not None:
+                total = lyr.mlp.l_aux if total is None \
+                    else total + lyr.mlp.l_aux
+        if total is None:
+            raise RuntimeError("aux_loss read before any forward")
+        return total * self.config.aux_loss_coeff

@@ -1,0 +1,235 @@
+"""LLaMA model family — the flagship hybrid-parallel model.
+
+Reference: test/auto_parallel/hybrid_strategy/semi_auto_parallel_llama_model.py
+(the reference repo's in-tree LLaMA used for dp/mp/pp accuracy-alignment
+tests; BASELINE.md config 4 targets LLaMA-7B TP+PP+ZeRO-3).
+
+TPU-first design choices:
+- bfloat16-friendly: RMSNorm computed in fp32, cast back.
+- attention through kernels.flash_attention (Pallas on chip, XLA
+  fallback) or kernels.ring_attention when a 'sep' (context-parallel)
+  axis is active.
+- tensor parallelism via the mpu layer library (Column/Row parallel,
+  VocabParallelEmbedding) — GSPMD inserts the collectives.
+- homogeneous LlamaDecoderLayer blocks so PipelineLayer/PipelineParallel
+  can stack-and-pipeline them (pipelinable_run).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+from ... import ops
+from ...core.dispatch import run_op, unwrap
+from ...distributed import mesh as mesh_mod
+from ...distributed.fleet.layers.mpu import (ColumnParallelLinear,
+                                             RowParallelLinear,
+                                             VocabParallelEmbedding)
+from ...incubate.nn.functional import fused_rotary_position_embedding
+from ...nn import functional as F
+from ...nn.layer.common import Dropout, Embedding, Linear
+from ...nn.layer.layers import Layer
+
+import jax.numpy as jnp
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    tie_word_embeddings: bool = False
+    use_flash_attention: bool = True
+    sequence_parallel: bool = False
+    dtype: str = "float32"
+
+    @staticmethod
+    def llama_7b():
+        return LlamaConfig()
+
+    @staticmethod
+    def tiny(vocab=128, hidden=64, layers=2, heads=4):
+        return LlamaConfig(
+            vocab_size=vocab, hidden_size=hidden,
+            intermediate_size=hidden * 4 // 2 * 2,
+            num_hidden_layers=layers, num_attention_heads=heads,
+            num_key_value_heads=heads, max_position_embeddings=256)
+
+
+class LlamaRMSNorm(Layer):
+    def __init__(self, hidden_size, eps=1e-6):
+        super().__init__()
+        from ...nn.initializer import Constant
+        self.weight = self.create_parameter(
+            [hidden_size], default_initializer=Constant(1.0))
+        self.eps = eps
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, epsilon=self.eps)
+
+
+def _use_tp():
+    return mesh_mod.axis_degree("mp") > 1
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        c = config
+        self.num_heads = c.num_attention_heads
+        self.num_kv_heads = c.num_key_value_heads
+        self.head_dim = c.hidden_size // c.num_attention_heads
+        self.use_flash = c.use_flash_attention
+        hs = c.hidden_size
+        kv = self.num_kv_heads * self.head_dim
+        Lin = ColumnParallelLinear if _use_tp() else None
+        if Lin is not None:
+            self.q_proj = ColumnParallelLinear(hs, hs, has_bias=False,
+                                               gather_output=False)
+            self.k_proj = ColumnParallelLinear(hs, kv, has_bias=False,
+                                               gather_output=False)
+            self.v_proj = ColumnParallelLinear(hs, kv, has_bias=False,
+                                               gather_output=False)
+            self.o_proj = RowParallelLinear(hs, hs, has_bias=False,
+                                            input_is_parallel=True)
+        else:
+            self.q_proj = Linear(hs, hs, bias_attr=False)
+            self.k_proj = Linear(hs, kv, bias_attr=False)
+            self.v_proj = Linear(hs, kv, bias_attr=False)
+            self.o_proj = Linear(hs, hs, bias_attr=False)
+
+    def forward(self, x, position_ids=None):
+        b, s, _ = x.shape
+        q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
+        k = self.k_proj(x).reshape([b, s, self.num_kv_heads,
+                                    self.head_dim])
+        v = self.v_proj(x).reshape([b, s, self.num_kv_heads,
+                                    self.head_dim])
+        q, k, _ = fused_rotary_position_embedding(
+            q, k, None, position_ids=position_ids,
+            use_neox_rotary_style=True)
+        if self.num_kv_heads != self.num_heads:
+            rep = self.num_heads // self.num_kv_heads
+            k = ops.manipulation.repeat_interleave(k, rep, axis=2)
+            v = ops.manipulation.repeat_interleave(v, rep, axis=2)
+        if mesh_mod.axis_degree("sep") > 1:
+            from ...kernels.ring_attention import ring_flash_attention
+            out = ring_flash_attention(q, k, v, causal=True)
+        elif self.use_flash:
+            from ...kernels.flash_attention import flash_attention
+            out = flash_attention(q, k, v, causal=True)
+        else:
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        out = out.reshape([b, s, self.num_heads * self.head_dim])
+        return self.o_proj(out)
+
+
+class LlamaMLP(Layer):
+    """SwiGLU MLP (gate/up column-parallel, down row-parallel)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        hs, im = config.hidden_size, config.intermediate_size
+        if _use_tp():
+            self.gate_proj = ColumnParallelLinear(hs, im, has_bias=False,
+                                                  gather_output=False)
+            self.up_proj = ColumnParallelLinear(hs, im, has_bias=False,
+                                                gather_output=False)
+            self.down_proj = RowParallelLinear(im, hs, has_bias=False,
+                                               input_is_parallel=True)
+        else:
+            self.gate_proj = Linear(hs, im, bias_attr=False)
+            self.up_proj = Linear(hs, im, bias_attr=False)
+            self.down_proj = Linear(im, hs, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(Layer):
+    """One homogeneous block — the unit PipelineParallel stacks."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = LlamaRMSNorm(config.hidden_size,
+                                            config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = LlamaRMSNorm(config.hidden_size,
+                                                     config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x):
+        x = x + self.self_attn(self.input_layernorm(x))
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        if _use_tp():
+            self.embed_tokens = VocabParallelEmbedding(
+                config.vocab_size, config.hidden_size)
+        else:
+            self.embed_tokens = Embedding(config.vocab_size,
+                                          config.hidden_size)
+        from ...nn.layer.container import LayerList
+        self.layers = LayerList(
+            [LlamaDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.norm = LlamaRMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, input_ids):
+        x = self.embed_tokens(input_ids)
+        if self.config.sequence_parallel and \
+                mesh_mod.axis_degree("mp") > 1:
+            from ...distributed.fleet.utils.sequence_parallel_utils import \
+                scatter
+            x = scatter(x)
+        for lyr in self.layers:
+            x = lyr(x)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        elif _use_tp():
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=True)
+        else:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  bias_attr=False)
+
+    def forward(self, input_ids):
+        h = self.llama(input_ids)
+        if self.lm_head is not None:
+            return self.lm_head(h)
+        w = self.llama.embed_tokens.weight
+
+        def tied(hh, ww):
+            return jnp.einsum("bsh,vh->bsv", hh, ww)
+        return run_op("tied_lm_head", tied, [h, w])
+
+    def num_params(self):
+        return sum(math.prod(p.shape) for _, p in self.named_parameters())
+
+
+def llama_flops_per_token(config: LlamaConfig) -> float:
+    """Approximate training FLOPs/token (6N rule + attention term)."""
+    n = (config.vocab_size * config.hidden_size * 2
+         + config.num_hidden_layers * (
+             4 * config.hidden_size * config.hidden_size
+             + 3 * config.hidden_size * config.intermediate_size))
+    return 6.0 * n
