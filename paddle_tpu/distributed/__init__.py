@@ -10,6 +10,8 @@ auto_parallel surfaces are kept paddle-shaped on top.
 from . import auto_parallel  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import communication  # noqa: F401
+from . import launch  # noqa: F401
+from .spawn import spawn  # noqa: F401
 from . import env  # noqa: F401
 from . import fleet  # noqa: F401
 from . import mesh  # noqa: F401

@@ -1,0 +1,117 @@
+"""`python -m paddle_tpu.distributed.launch` — the process launcher.
+
+Reference: python/paddle/distributed/launch/main.py:23 + controllers/
+collective.py:22 (CollectiveController): spawn per-rank local processes
+with PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM/PADDLE_TRAINER_ENDPOINTS env,
+rendezvous through a master, per-rank log files, kill-all on first
+failure.
+
+TPU-native: the unit is one process per HOST (all local chips belong to
+it — PJRT model), so --nproc_per_node defaults to 1; multi-host jobs set
+--nnodes/--master/--rank and the spawned process joins the JAX
+distributed runtime via init_parallel_env (the TCPStore analog is the
+JAX coordinator service). --nproc_per_node > 1 exists for CPU-backend
+simulation (the reference's multi-GPU-per-node layout), used by the
+in-repo launcher tests.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch a distributed training job")
+    p.add_argument("--nnodes", type=int, default=1,
+                   help="number of hosts in the job")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per host (1 on TPU: PJRT owns all "
+                        "local chips; >1 for CPU simulation)")
+    p.add_argument("--master", type=str, default=None,
+                   help="coordinator host:port (required if nnodes>1)")
+    p.add_argument("--rank", type=int, default=0,
+                   help="this host's node rank")
+    p.add_argument("--log_dir", type=str, default="log",
+                   help="per-rank log directory")
+    p.add_argument("--devices", type=str, default=None,
+                   help="visible device selection (informational on TPU)")
+    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("--backend", type=str, default=None)
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def launch(args=None):
+    ns = build_parser().parse_args(args)
+    world = ns.nnodes * ns.nproc_per_node
+    if ns.nnodes > 1 and not ns.master:
+        raise SystemExit("--master host:port is required for nnodes>1")
+    master = ns.master or "127.0.0.1:49175"
+
+    os.makedirs(ns.log_dir, exist_ok=True)
+    procs = []
+    logs = []
+    try:
+        for local_rank in range(ns.nproc_per_node):
+            rank = ns.rank * ns.nproc_per_node + local_rank
+            env = dict(os.environ)
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_LOCAL_RANK": str(local_rank),
+                "PADDLE_MASTER": master,
+                "MASTER_ADDR": master.split(":")[0],
+                "MASTER_PORT": master.split(":")[-1],
+                "PADDLE_JOB_ID": ns.job_id,
+            })
+            if ns.devices is not None:
+                env["PADDLE_VISIBLE_DEVICES"] = ns.devices
+            log_path = os.path.join(ns.log_dir, f"workerlog.{rank}")
+            logf = open(log_path, "w")
+            logs.append(logf)
+            cmd = [sys.executable, ns.training_script] + \
+                ns.training_script_args
+            procs.append(subprocess.Popen(
+                cmd, env=env, stdout=logf, stderr=subprocess.STDOUT))
+
+        # watcher: kill the pod on first failure (reference watcher role)
+        exit_code = 0
+        running = list(procs)
+        while running and exit_code == 0:
+            time.sleep(0.2)
+            still = []
+            for p in running:
+                rc = p.poll()
+                if rc is None:
+                    still.append(p)
+                elif rc != 0:
+                    exit_code = rc
+            running = still
+        if exit_code != 0:
+            for p in procs:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGTERM)
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        return exit_code
+    finally:
+        for f in logs:
+            f.close()
+
+
+def main():
+    raise SystemExit(launch())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""paddle.distributed.spawn — multiprocessing entry for single-host jobs.
+
+Reference: python/paddle/distributed/spawn.py (spawns nprocs processes,
+each running func(rank, *args) with the distributed env prepared).
+
+TPU note: on real TPU hosts the PJRT process owns every local chip, so
+in-process spawn parallelism is a CPU-backend/testing tool; production
+multi-host jobs use `python -m paddle_tpu.distributed.launch` (one
+process per host).
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+from typing import Sequence
+
+
+def _worker(func, rank, nprocs, master, backend, args):
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    os.environ["PADDLE_LOCAL_RANK"] = str(rank)
+    if master:
+        os.environ["PADDLE_MASTER"] = master
+    if backend == "cpu" or os.environ.get("PADDLE_SPAWN_CPU"):
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    func(*args)
+
+
+def spawn(func, args: Sequence = (), nprocs: int = -1, join: bool = True,
+          daemon: bool = False, backend=None, master=None, **options):
+    """Run func in nprocs spawned processes; returns the context
+    (reference-shaped). func is called as func(*args) with the rank
+    available via paddle_tpu.distributed.get_rank()."""
+    if nprocs == -1:
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, rank, nprocs, master, backend, args),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+
+    class SpawnContext:
+        def __init__(self, processes):
+            self.processes = processes
+
+        def join(self, timeout=None):
+            for p in self.processes:
+                p.join(timeout)
+            bad = [p.exitcode for p in self.processes if p.exitcode]
+            if bad:
+                raise RuntimeError(
+                    f"spawned process failed with exit code {bad[0]}")
+
+    context = SpawnContext(procs)
+    if join:
+        context.join()
+    return context

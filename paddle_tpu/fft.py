@@ -1,0 +1,101 @@
+"""paddle.fft parity surface (reference python/paddle/fft.py; kernels
+fft_c2c / fft_r2c / fft_c2r in ops.yaml) over jnp.fft — XLA lowers to
+the TPU FFT implementation."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import run_op
+
+
+def _norm(norm):
+    return norm if norm in ("ortho", "forward") else "backward"
+
+
+def _op1(name, fn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return run_op(name, lambda a: fn(a, n=n, axis=axis,
+                                         norm=_norm(norm)), [x])
+    op.__name__ = name
+    return op
+
+
+fft = _op1("fft", jnp.fft.fft)
+ifft = _op1("ifft", jnp.fft.ifft)
+rfft = _op1("rfft", jnp.fft.rfft)
+irfft = _op1("irfft", jnp.fft.irfft)
+hfft = _op1("hfft", jnp.fft.hfft)
+ihfft = _op1("ihfft", jnp.fft.ihfft)
+
+
+def _opn(name, fn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        ax = tuple(axes) if axes is not None else None
+        return run_op(name, lambda a: fn(a, s=s, axes=ax,
+                                         norm=_norm(norm)), [x])
+    op.__name__ = name
+    return op
+
+
+fftn = _opn("fftn", jnp.fft.fftn)
+ifftn = _opn("ifftn", jnp.fft.ifftn)
+rfftn = _opn("rfftn", jnp.fft.rfftn)
+irfftn = _opn("irfftn", jnp.fft.irfftn)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return fftn(x, s=s, axes=axes, norm=norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ifftn(x, s=s, axes=axes, norm=norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return rfftn(x, s=s, axes=axes, norm=norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return irfftn(x, s=s, axes=axes, norm=norm)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.dispatch import wrap
+    return wrap(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.dispatch import wrap
+    return wrap(jnp.fft.rfftfreq(n, d))
+
+
+def fftshift(x, axes=None, name=None):
+    return run_op("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes),
+                  [x])
+
+
+def ifftshift(x, axes=None, name=None):
+    return run_op("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes),
+                  [x])
+
+
+# reference kernel-level names (ops.yaml: fft_c2c / fft_r2c / fft_c2r)
+def fft_c2c(x, axes, normalization="backward", forward=True, name=None):
+    fn = jnp.fft.fftn if forward else jnp.fft.ifftn
+    return run_op("fft_c2c", lambda a: fn(a, axes=tuple(axes),
+                                          norm=_norm(normalization)), [x])
+
+
+def fft_r2c(x, axes, normalization="backward", forward=True, onesided=True,
+            name=None):
+    return run_op("fft_r2c",
+                  lambda a: jnp.fft.rfftn(a, axes=tuple(axes),
+                                          norm=_norm(normalization)), [x])
+
+
+def fft_c2r(x, axes, normalization="backward", forward=True, last_dim_size=0,
+            name=None):
+    s = None if not last_dim_size else None
+    return run_op("fft_c2r",
+                  lambda a: jnp.fft.irfftn(a, axes=tuple(axes),
+                                           norm=_norm(normalization)), [x])

@@ -1,0 +1,88 @@
+"""paddle.geometric parity surface (reference python/paddle/geometric:
+message passing send_u_recv aggregation + segment pooling; ops.yaml:
+segment_pool)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import run_op, unwrap
+
+
+def _seg(fn_name):
+    fn = {"SUM": jax.ops.segment_sum, "MEAN": jax.ops.segment_sum,
+          "MAX": jax.ops.segment_max, "MIN": jax.ops.segment_min}[fn_name]
+    return fn
+
+
+def segment_sum(data, segment_ids, name=None):
+    n = int(jnp.max(unwrap(segment_ids))) + 1
+    return run_op("segment_pool",
+                  lambda d, s: jax.ops.segment_sum(d, s, num_segments=n),
+                  [data, segment_ids])
+
+
+def segment_mean(data, segment_ids, name=None):
+    n = int(jnp.max(unwrap(segment_ids))) + 1
+
+    def fn(d, s):
+        tot = jax.ops.segment_sum(d, s, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones_like(s, d.dtype), s,
+                                  num_segments=n)
+        shape = (n,) + (1,) * (d.ndim - 1)
+        return tot / jnp.maximum(cnt.reshape(shape), 1)
+    return run_op("segment_pool", fn, [data, segment_ids])
+
+
+def segment_max(data, segment_ids, name=None):
+    n = int(jnp.max(unwrap(segment_ids))) + 1
+    return run_op("segment_pool",
+                  lambda d, s: jax.ops.segment_max(d, s, num_segments=n),
+                  [data, segment_ids])
+
+
+def segment_min(data, segment_ids, name=None):
+    n = int(jnp.max(unwrap(segment_ids))) + 1
+    return run_op("segment_pool",
+                  lambda d, s: jax.ops.segment_min(d, s, num_segments=n),
+                  [data, segment_ids])
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Graph message passing (reference geometric/message_passing:
+    gather source features, scatter-reduce at destinations)."""
+    n = out_size or int(unwrap(x).shape[0])
+    red = {"sum": jax.ops.segment_sum, "mean": None,
+           "max": jax.ops.segment_max, "min": jax.ops.segment_min}
+
+    def fn(a, si, di):
+        msgs = a[si]
+        if reduce_op == "mean":
+            tot = jax.ops.segment_sum(msgs, di, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones_like(di, a.dtype), di,
+                                      num_segments=n)
+            shape = (n,) + (1,) * (a.ndim - 1)
+            return tot / jnp.maximum(cnt.reshape(shape), 1)
+        return red[reduce_op](msgs, di, num_segments=n)
+    return run_op("send_u_recv", fn, [x, src_index, dst_index])
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Messages combine node features with edge features."""
+    n = out_size or int(unwrap(x).shape[0])
+
+    def fn(a, e, si, di):
+        msgs = a[si]
+        msgs = msgs + e if message_op == "add" else msgs * e
+        if reduce_op == "mean":
+            tot = jax.ops.segment_sum(msgs, di, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones_like(di, a.dtype), di,
+                                      num_segments=n)
+            shape = (n,) + (1,) * (a.ndim - 1)
+            return tot / jnp.maximum(cnt.reshape(shape), 1)
+        red = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+               "min": jax.ops.segment_min}[reduce_op]
+        return red(msgs, di, num_segments=n)
+    return run_op("send_ue_recv", fn, [x, y, src_index, dst_index])

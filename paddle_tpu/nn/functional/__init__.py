@@ -1,23 +1,30 @@
 """paddle_tpu.nn.functional (reference: python/paddle/nn/functional)."""
 from .activation import *  # noqa: F401,F403
 from .attention import (  # noqa: F401
-    flash_attention, scaled_dot_product_attention, sequence_mask,
+    flash_attention, flash_attn, flash_attn_qkvpacked, flash_attn_unpadded,
+    flashmask_attention, memory_efficient_attention,
+    scaled_dot_product_attention, sequence_mask,
 )
 from .common import (  # noqa: F401
-    alpha_dropout, bilinear, channel_shuffle, cosine_similarity, dropout,
-    dropout2d, dropout3d, embedding, fold, interpolate, label_smooth, linear,
-    one_hot, pad, pixel_shuffle, pixel_unshuffle, unfold, upsample,
+    affine_grid, alpha_dropout, bicubic_interp, bilinear, bilinear_interp,
+    channel_shuffle, cosine_similarity, dropout, dropout2d, dropout3d,
+    embedding, fold, fused_softmax_mask, fused_softmax_mask_upper_triangle,
+    grid_sample, interpolate, label_smooth, linear, linear_interp,
+    nearest_interp, one_hot, pad, pad3d, pixel_shuffle, pixel_unshuffle,
+    temporal_shift, trilinear_interp, unfold, upsample,
 )
 from .conv import (  # noqa: F401
     conv1d, conv1d_transpose, conv2d, conv2d_transpose, conv3d,
     conv3d_transpose,
 )
 from .loss import (  # noqa: F401
-    binary_cross_entropy, binary_cross_entropy_with_logits,
+    bce_loss, binary_cross_entropy, binary_cross_entropy_with_logits,
     cosine_embedding_loss, cross_entropy, ctc_loss, hinge_embedding_loss,
-    kl_div, l1_loss, log_loss, margin_ranking_loss, mse_loss, nll_loss,
-    sigmoid_focal_loss, smooth_l1_loss, softmax_with_cross_entropy,
-    square_error_cost, triplet_margin_loss,
+    hinge_loss, huber_loss, identity_loss, kl_div, kldiv_loss, l1_loss,
+    log_loss, margin_cross_entropy, margin_ranking_loss, mse_loss,
+    nll_loss, sigmoid_cross_entropy_with_logits, sigmoid_focal_loss,
+    smooth_l1_loss, softmax_with_cross_entropy, square_error_cost,
+    triplet_margin_loss,
 )
 from .norm import (  # noqa: F401
     batch_norm, group_norm, instance_norm, layer_norm, local_response_norm,

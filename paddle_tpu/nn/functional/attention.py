@@ -77,3 +77,66 @@ def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
         m = maxlen if maxlen is not None else int(jnp.max(ln))
         return (jnp.arange(m)[None, :] < ln[:, None]).astype(dtype)
     return run_op("sequence_mask", fn, [lengths])
+
+
+# ---- coverage batch (reference ops.yaml names) -----------------------------
+
+def flash_attn(q, k, v, dropout=0.0, causal=False, return_softmax=False,
+               **kw):
+    """reference ops.yaml: flash_attn (paddle layout [b, s, h, d])."""
+    return flash_attention(q, k, v, dropout=dropout, causal=causal)
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, **kw):
+    """reference ops.yaml: flash_attn_qkvpacked ([b, s, 3, h, d])."""
+    from ...ops.manipulation import split as _split
+    q, k, v = [t.squeeze(2) for t in _split(qkv, 3, axis=2)]
+    return flash_attention(q, k, v, dropout=dropout, causal=causal)
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
+                               scale=None, training=True, **kw):
+    """reference incubate memory_efficient_attention — on TPU the
+    flash/XLA kernel IS the memory-efficient path."""
+    return scaled_dot_product_attention(
+        query, key, value, attn_mask=attn_bias, dropout_p=p,
+        training=training)
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=False, name=None):
+    """reference nn/functional/flash_attention.py:1098 flashmask_attention:
+    sparse attention masks described by per-column start/end row indices.
+    Lowered to a dense additive mask + the flash kernel (XLA fuses the
+    mask into the attention computation)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...core.dispatch import run_op
+
+    if startend_row_indices is None:
+        out, _ = flash_attention(query, key, value, dropout=dropout,
+                                 causal=causal)
+        return out
+
+    def fn(q, k, v, se):
+        # q,k,v: [b, s, h, d]; se: [b, kv_heads, s_k, {1,2}]
+        s_q, s_k = q.shape[1], k.shape[1]
+        # rows broadcast against per-COLUMN start/end indices:
+        # mask shape [b, h, s_q, s_k]
+        rows = jnp.arange(s_q)[None, None, :, None]
+        if se.shape[-1] == 1:
+            # LT-start: key column j is masked for query rows
+            # q >= start[j] (the flashmask causal-document pattern)
+            start = se[..., 0][..., None, :]        # [b, h, 1, s_k]
+            masked = rows >= start
+        else:
+            # [start, end) band per column masked
+            start = se[..., 0][..., None, :]
+            end = se[..., 1][..., None, :]
+            masked = (rows >= start) & (rows < end)
+        mask = jnp.where(masked, -jnp.inf, 0.0).astype(q.dtype)
+        return _sdpa_core(q, k, v, mask=mask, causal=causal)
+
+    return run_op("flashmask_attention", fn,
+                  [query, key, value, startend_row_indices])

@@ -276,3 +276,152 @@ def channel_shuffle(x, groups, data_format="NCHW", name=None):
         a = a.reshape(n, h, w, groups, c // groups)
         return a.transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
     return run_op("channel_shuffle", fn, [x])
+
+
+# ---- coverage batch (reference ops.yaml names) -----------------------------
+
+def nearest_interp(x, size=None, scale_factor=None, data_format="NCHW",
+                   **kw):
+    return interpolate(x, size=size, scale_factor=scale_factor,
+                       mode="nearest", data_format=data_format)
+
+
+def bilinear_interp(x, size=None, scale_factor=None, data_format="NCHW",
+                    align_corners=False, **kw):
+    return interpolate(x, size=size, scale_factor=scale_factor,
+                       mode="bilinear", align_corners=align_corners,
+                       data_format=data_format)
+
+
+def bicubic_interp(x, size=None, scale_factor=None, data_format="NCHW",
+                   align_corners=False, **kw):
+    return interpolate(x, size=size, scale_factor=scale_factor,
+                       mode="bicubic", align_corners=align_corners,
+                       data_format=data_format)
+
+
+def linear_interp(x, size=None, scale_factor=None, data_format="NCW",
+                  align_corners=False, **kw):
+    return interpolate(x, size=size, scale_factor=scale_factor,
+                       mode="linear", align_corners=align_corners,
+                       data_format=data_format)
+
+
+def trilinear_interp(x, size=None, scale_factor=None, data_format="NCDHW",
+                     align_corners=False, **kw):
+    return interpolate(x, size=size, scale_factor=scale_factor,
+                       mode="trilinear", align_corners=align_corners,
+                       data_format=data_format)
+
+
+def pad3d(x, paddings, mode="constant", value=0.0, data_format="NCDHW",
+          name=None):
+    """reference ops.yaml: pad3d."""
+    from ...ops.manipulation import pad as _pad
+    return _pad(x, paddings, mode=mode, value=value,
+                data_format=data_format)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2D affine sampling grid (reference ops.yaml: affine_grid)."""
+    def fn(th):
+        n, h, w = int(out_shape[0]), int(out_shape[-2]), int(out_shape[-1])
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, h)
+            xs = jnp.linspace(-1.0, 1.0, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [h,w,3]
+        # grid coordinates must not go through the MXU's bf16 path —
+        # bilinear sampling amplifies coordinate rounding
+        grid = jnp.einsum("hwk,nck->nhwc", base.astype(th.dtype), th,
+                          precision=jax.lax.Precision.HIGHEST)
+        return grid  # [n, h, w, 2]
+    return run_op("affine_grid", fn, [theta])
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """2D grid sampling (reference ops.yaml: grid_sample; NCHW input,
+    grid [n, h_out, w_out, 2] in [-1, 1] xy coords)."""
+    def fn(a, g):
+        n, c, h, w = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def reflect(i, size):
+            # reflect around the borders (..., 2, 1, 0, 1, 2, ...)
+            period = 2 * max(size - 1, 1)
+            i = jnp.abs(i) % period
+            return jnp.where(i >= size, period - i, i)
+
+        def gather(ix, iy):
+            if padding_mode == "reflection":
+                ixc = reflect(ix, w)
+                iyc = reflect(iy, h)
+            else:  # zeros / border both clamp; zeros re-masks below
+                ixc = jnp.clip(ix, 0, w - 1)
+                iyc = jnp.clip(iy, 0, h - 1)
+            vals = a[jnp.arange(n)[:, None, None], :, iyc, ixc]
+            # [n, ho, wo, c]
+            if padding_mode == "zeros":
+                ok = ((ix >= 0) & (ix <= w - 1) & (iy >= 0)
+                      & (iy <= h - 1))
+                vals = vals * ok[..., None]
+            return vals
+
+        if mode == "nearest":
+            out = gather(jnp.round(fx).astype(jnp.int32),
+                         jnp.round(fy).astype(jnp.int32))
+        else:
+            x0 = jnp.floor(fx).astype(jnp.int32)
+            y0 = jnp.floor(fy).astype(jnp.int32)
+            wx = fx - x0
+            wy = fy - y0
+            out = (gather(x0, y0) * ((1 - wx) * (1 - wy))[..., None]
+                   + gather(x0 + 1, y0) * (wx * (1 - wy))[..., None]
+                   + gather(x0, y0 + 1) * ((1 - wx) * wy)[..., None]
+                   + gather(x0 + 1, y0 + 1) * (wx * wy)[..., None])
+        return jnp.moveaxis(out, -1, 1)  # NCHW
+    return run_op("grid_sample", fn, [x, grid])
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """reference ops.yaml: temporal_shift."""
+    def fn(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate(
+            [v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], axis=1)
+        right = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, fold:2 * fold]),
+             v[:, :-1, fold:2 * fold]], axis=1)
+        rest = v[:, :, 2 * fold:]
+        return jnp.concatenate([left, right, rest],
+                               axis=2).reshape(nt, c, h, w)
+    return run_op("temporal_shift", fn, [x])
+
+
+def fused_softmax_mask(x, mask, name=None):
+    """reference ops.yaml: fused_softmax_mask (softmax(x + mask))."""
+    return run_op("fused_softmax_mask",
+                  lambda a, m: jax.nn.softmax(a + m, axis=-1), [x, mask])
+
+
+def fused_softmax_mask_upper_triangle(x, name=None):
+    """reference ops.yaml: fused_softmax_mask_upper_triangle (causal)."""
+    def fn(a):
+        s = a.shape[-1]
+        mask = jnp.triu(jnp.full((s, s), -1e9, a.dtype), k=1)
+        return jax.nn.softmax(a + mask, axis=-1)
+    return run_op("fused_softmax_mask_upper_triangle", fn, [x])

@@ -173,3 +173,20 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
     return _conv_transpose("conv3d_transpose", x, weight, bias, stride,
                            padding, output_padding, dilation, groups,
                            data_format == "NDHWC", 3, output_size)
+
+
+def depthwise_conv2d(x, weight, bias=None, stride=1, padding=0,
+                     dilation=1, data_format="NCHW", name=None):
+    """reference ops.yaml: depthwise_conv2d (groups == in_channels)."""
+    ch = x.shape[1] if data_format == "NCHW" else x.shape[-1]
+    return conv2d(x, weight, bias=bias, stride=stride, padding=padding,
+                  dilation=dilation, groups=ch, data_format=data_format)
+
+
+def conv2d_transpose_bias(x, weight, bias, stride=1, padding=0,
+                          output_padding=0, dilation=1, groups=1,
+                          data_format="NCHW", name=None):
+    return conv2d_transpose(x, weight, bias=bias, stride=stride,
+                            padding=padding, output_padding=output_padding,
+                            dilation=dilation, groups=groups,
+                            data_format=data_format)

@@ -284,3 +284,64 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         return _reduce(loss, reduction)
     return run_op("ctc_loss", fn,
                   [log_probs, labels, input_lengths, label_lengths])
+
+
+# ---- coverage batch (reference ops.yaml loss names) ------------------------
+
+bce_loss = binary_cross_entropy
+sigmoid_cross_entropy_with_logits = binary_cross_entropy_with_logits
+kldiv_loss = kl_div
+
+
+def hinge_loss(input, label, name=None):
+    """reference ops.yaml: hinge_loss (labels in {0,1})."""
+    def fn(x, y):
+        signed = 2.0 * y - 1.0
+        return jnp.maximum(0.0, 1.0 - signed * x)
+    return run_op("hinge_loss", fn, [input, label])
+
+
+def huber_loss(input, label, delta=1.0, name=None):
+    """reference ops.yaml: huber_loss (elementwise, no reduction)."""
+    def fn(x, y):
+        d = x - y
+        ad = jnp.abs(d)
+        return jnp.where(ad <= delta, 0.5 * d * d,
+                         delta * (ad - 0.5 * delta))
+    return run_op("huber_loss", fn, [input, label])
+
+
+def identity_loss(x, reduction="none", name=None):
+    """reference ops.yaml: identity_loss."""
+    red = {0: "sum", 1: "mean", 2: "none"}.get(reduction, reduction)
+    return run_op("identity_loss", lambda a: _reduce_arr(a, red), [x])
+
+
+def _reduce_arr(a, reduction):
+    if reduction == "mean":
+        return jnp.mean(a)
+    if reduction == "sum":
+        return jnp.sum(a)
+    return a
+
+
+def margin_cross_entropy(logits, label, return_softmax=False,
+                         margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, name=None):
+    """ArcFace/CosFace-style margin softmax CE (reference ops.yaml:
+    margin_cross_entropy). Single-device lowering; under TP the vocab
+    dim shards like ParallelCrossEntropy."""
+    def fn(lg, lb):
+        theta = jnp.arccos(jnp.clip(lg, -1.0, 1.0))
+        one_hot = jax.nn.one_hot(lb, lg.shape[-1], dtype=lg.dtype)
+        adj = jnp.cos(margin1 * theta + margin2) - margin3
+        out = jnp.where(one_hot > 0, adj, lg) * scale
+        logp = jax.nn.log_softmax(out, axis=-1)
+        loss = -jnp.sum(one_hot * logp, axis=-1, keepdims=True)
+        if return_softmax:
+            return loss, jnp.exp(logp)
+        return loss
+    return run_op("margin_cross_entropy", fn, [logits, label])
+
+
+cross_entropy_with_softmax = cross_entropy

@@ -24,6 +24,9 @@ from .norm import (  # noqa: F401
     InstanceNorm1D, InstanceNorm2D, InstanceNorm3D, LayerNorm,
     LocalResponseNorm, RMSNorm, SyncBatchNorm,
 )
+from .rnn import (  # noqa: F401
+    GRU, GRUCell, LSTM, LSTMCell, RNN, RNNBase, SimpleRNN, SimpleRNNCell,
+)
 from .pooling import (  # noqa: F401
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
     AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D,

@@ -1,0 +1,299 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU (+ cells).
+
+Reference: python/paddle/nn/layer/rnn.py (RNNBase with cudnn-style flat
+weights; ops.yaml: rnn / gru / lstm kernels).
+
+TPU-native: the whole sequence recurrence is ONE `jax.lax.scan` inside a
+single tape op — XLA unrolls nothing, the scan compiles to a fused loop
+with the gate matmuls on the MXU, and jax.grad reverses it (BPTT) for
+free. Weight layout matches paddle (weight_ih [G*H, I], weight_hh
+[G*H, H], separate ih/hh biases).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import run_op, unwrap, wrap
+from .layers import Layer
+
+
+def _split_gates(z, n):
+    return jnp.split(z, n, axis=-1)
+
+
+def _lstm_step(carry, x_t, wi, wh, bi, bh):
+    h, c = carry
+    z = x_t @ wi.T + h @ wh.T + bi + bh
+    i, f, g, o = _split_gates(z, 4)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    c = f * c + i * jnp.tanh(g)
+    h = o * jnp.tanh(c)
+    return (h, c), h
+
+
+def _gru_step(carry, x_t, wi, wh, bi, bh):
+    h = carry
+    zi = x_t @ wi.T + bi
+    zh = h @ wh.T + bh
+    ri, ui, ci = _split_gates(zi, 3)
+    rh, uh, ch = _split_gates(zh, 3)
+    r = jax.nn.sigmoid(ri + rh)
+    u = jax.nn.sigmoid(ui + uh)
+    cand = jnp.tanh(ci + r * ch)
+    h = u * h + (1.0 - u) * cand
+    return h, h
+
+
+def _rnn_step_tanh(carry, x_t, wi, wh, bi, bh):
+    h = jnp.tanh(x_t @ wi.T + carry @ wh.T + bi + bh)
+    return h, h
+
+
+def _rnn_step_relu(carry, x_t, wi, wh, bi, bh):
+    h = jnp.maximum(x_t @ wi.T + carry @ wh.T + bi + bh, 0.0)
+    return h, h
+
+
+_STEPS = {"LSTM": (_lstm_step, 4, True),
+          "GRU": (_gru_step, 3, False),
+          "RNN_TANH": (_rnn_step_tanh, 1, False),
+          "RNN_RELU": (_rnn_step_relu, 1, False)}
+
+
+class RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if direction not in ("forward", "bidirect", "bidirectional"):
+            raise ValueError(f"unknown direction {direction!r}")
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = float(dropout)
+        self.bidirect = direction != "forward"
+        self.num_directions = 2 if self.bidirect else 1
+        _, gates, self.has_cell = _STEPS[mode]
+        self._weights = []
+        std = 1.0 / math.sqrt(hidden_size)
+        from .. import initializer as I
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                in_sz = input_size if layer == 0 else \
+                    hidden_size * self.num_directions
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                for nm, shape in (
+                        (f"weight_ih{sfx}", [gates * hidden_size, in_sz]),
+                        (f"weight_hh{sfx}",
+                         [gates * hidden_size, hidden_size]),
+                        (f"bias_ih{sfx}", [gates * hidden_size]),
+                        (f"bias_hh{sfx}", [gates * hidden_size])):
+                    p = self.create_parameter(
+                        shape, default_initializer=I.Uniform(-std, std))
+                    setattr(self, nm, p)
+
+    def _layer_params(self, layer, d):
+        sfx = f"_l{layer}" + ("_reverse" if d else "")
+        return [getattr(self, f"{nm}{sfx}")
+                for nm in ("weight_ih", "weight_hh", "bias_ih", "bias_hh")]
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        step_fn, gates, has_cell = _STEPS[self.mode]
+        L, D, H = self.num_layers, self.num_directions, self.hidden_size
+        params = []
+        for layer in range(L):
+            for d in range(D):
+                params.extend(self._layer_params(layer, d))
+
+        init_arrays = []
+        if initial_states is not None:
+            states = initial_states if has_cell else (initial_states,)
+            init_arrays = [unwrap(s) for s in states]
+
+        time_major = self.time_major
+        drop_p = self.dropout if self.training else 0.0
+        drop_key = None
+        if drop_p > 0:
+            from ...core import random as random_mod
+            drop_key = random_mod.next_key()
+
+        def fn(x, *arrs):
+            ps = arrs[:len(params)]
+            inits = arrs[len(params):]
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)   # [T, B, I]
+            b = x.shape[1]
+            if inits:
+                h0_all = inits[0]
+                c0_all = inits[1] if has_cell else None
+            else:
+                h0_all = jnp.zeros((L * D, b, H), x.dtype)
+                c0_all = jnp.zeros((L * D, b, H), x.dtype) if has_cell \
+                    else None
+            hs, cs = [], []
+            out = x
+            idx = 0
+            for layer in range(L):
+                outs_dir = []
+                for d in range(D):
+                    wi, wh, bi, bh = ps[4 * idx:4 * idx + 4]
+                    h0 = h0_all[layer * D + d]
+                    carry = (h0, c0_all[layer * D + d]) if has_cell else h0
+                    seq = out[::-1] if d == 1 else out
+
+                    def body(c, xt, wi=wi, wh=wh, bi=bi, bh=bh):
+                        return step_fn(c, xt, wi, wh, bi, bh)
+                    carry, ys = jax.lax.scan(body, carry, seq)
+                    if d == 1:
+                        ys = ys[::-1]
+                    outs_dir.append(ys)
+                    if has_cell:
+                        hs.append(carry[0])
+                        cs.append(carry[1])
+                    else:
+                        hs.append(carry)
+                    idx += 1
+                out = jnp.concatenate(outs_dir, axis=-1) if D == 2 \
+                    else outs_dir[0]
+                # inter-layer dropout (reference: applied to every
+                # stacked layer's output except the last)
+                if drop_p > 0 and layer < L - 1:
+                    k = jax.random.fold_in(drop_key, layer)
+                    keep = jax.random.bernoulli(k, 1.0 - drop_p,
+                                                out.shape)
+                    out = jnp.where(keep, out / (1.0 - drop_p), 0.0)
+            h_n = jnp.stack(hs)
+            outputs = out if time_major else jnp.swapaxes(out, 0, 1)
+            if has_cell:
+                return outputs, h_n, jnp.stack(cs)
+            return outputs, h_n
+
+        res = run_op(self.mode.lower(), fn, [inputs] + params
+                     + init_arrays)
+        if has_cell:
+            outputs, h_n, c_n = res
+            return outputs, (h_n, c_n)
+        outputs, h_n = res
+        return outputs, h_n
+
+
+class SimpleRNN(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        mode = "RNN_RELU" if activation == "relu" else "RNN_TANH"
+        super().__init__(mode, input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class LSTM(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class GRU(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class _CellBase(Layer):
+    def __init__(self, mode, input_size, hidden_size):
+        super().__init__()
+        _, gates, self.has_cell = _STEPS[mode]
+        self.mode = mode
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        from .. import initializer as I
+        self.weight_ih = self.create_parameter(
+            [gates * hidden_size, input_size],
+            default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            [gates * hidden_size, hidden_size],
+            default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            [gates * hidden_size], is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            [gates * hidden_size], is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        step_fn, _, has_cell = _STEPS[self.mode]
+        b = inputs.shape[0]
+        H = self.hidden_size
+
+        def fn(x, wi, wh, bi, bh, *ss):
+            if ss:
+                carry = (ss[0], ss[1]) if has_cell else ss[0]
+            else:
+                z = jnp.zeros((b, H), x.dtype)
+                carry = (z, z) if has_cell else z
+            carry, y = step_fn(carry, x, wi, wh, bi, bh)
+            if has_cell:
+                return y, carry[0], carry[1]
+            return y, carry
+
+        extra = []
+        if states is not None:
+            ss = states if isinstance(states, (tuple, list)) else [states]
+            extra = list(ss)
+        res = run_op(self.mode.lower() + "_cell", fn,
+                     [inputs, self.weight_ih, self.weight_hh,
+                      self.bias_ih, self.bias_hh] + extra)
+        if has_cell:
+            y, h, c = res
+            return y, (h, c)
+        y, h = res
+        return y, h
+
+
+class SimpleRNNCell(_CellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", **kw):
+        super().__init__("RNN_RELU" if activation == "relu"
+                         else "RNN_TANH", input_size, hidden_size)
+
+
+class LSTMCell(_CellBase):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__("LSTM", input_size, hidden_size)
+
+
+class GRUCell(_CellBase):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__("GRU", input_size, hidden_size)
+
+
+class RNN(Layer):
+    """Wraps a cell into a sequence runner (reference nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        axis = 0 if self.time_major else 1
+        T = inputs.shape[axis]
+        states = initial_states
+        outs = []
+        rng = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        from ...ops import manipulation as M
+        for t in rng:
+            x_t = M.squeeze(M.slice(inputs, [axis], [t], [t + 1]), [axis])
+            y, states = self.cell(x_t, states)
+            outs.append(y)
+        if self.is_reverse:
+            outs = outs[::-1]
+        out = M.stack(outs, axis=axis)
+        return out, states

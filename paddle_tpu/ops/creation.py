@@ -265,3 +265,92 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
                               replace=replacement, p=p[i])
             for i, k in enumerate(keys)])
     return wrap(out.astype(np.int64))
+
+
+# ---- coverage batch (reference ops.yaml names) -----------------------------
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    """reference ops.yaml: gaussian."""
+    key = random_mod.next_key() if not seed else jax.random.PRNGKey(seed)
+    dt = _dt(dtype, jnp.float32)
+    return wrap(mean + std * jax.random.normal(key, _shape(shape), dt))
+
+
+def truncated_gaussian_random(shape, mean=0.0, std=1.0, seed=0, a=-2.0,
+                              b=2.0, dtype=None, name=None):
+    """reference ops.yaml: truncated_gaussian_random (2-sigma truncation)."""
+    key = random_mod.next_key() if not seed else jax.random.PRNGKey(seed)
+    dt = _dt(dtype, jnp.float32)
+    t = jax.random.truncated_normal(key, a, b, _shape(shape), dt)
+    return wrap(mean + std * t)
+
+
+def binomial(count, prob, name=None):
+    """reference ops.yaml: binomial."""
+    key = random_mod.next_key()
+    n = unwrap(count)
+    p = unwrap(prob)
+    return wrap(jax.random.binomial(key, n, p).astype(jnp.int64))
+
+
+def dirichlet(alpha, name=None):
+    """reference ops.yaml: dirichlet."""
+    key = random_mod.next_key()
+    a = unwrap(alpha)
+    return wrap(jax.random.dirichlet(key, a).astype(a.dtype))
+
+
+def standard_gamma(x, name=None):
+    """reference ops.yaml: standard_gamma."""
+    key = random_mod.next_key()
+    a = unwrap(x)
+    return wrap(jax.random.gamma(key, a).astype(a.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    """In-place exponential sampling (reference ops.yaml hints:
+    exponential_)."""
+    key = random_mod.next_key()
+    a = unwrap(x)
+    x._data = (jax.random.exponential(key, a.shape) / lam).astype(a.dtype)
+    return x
+
+
+def uniform_inplace(x, min=-1.0, max=1.0, seed=0, **kw):
+    key = random_mod.next_key() if not seed else jax.random.PRNGKey(seed)
+    a = unwrap(x)
+    x._data = jax.random.uniform(key, a.shape, a.dtype, min, max)
+    return x
+
+
+uniform_ = uniform_inplace
+
+
+def gaussian_inplace(x, mean=0.0, std=1.0, seed=0, **kw):
+    key = random_mod.next_key() if not seed else jax.random.PRNGKey(seed)
+    a = unwrap(x)
+    x._data = (mean + std * jax.random.normal(key, a.shape)).astype(
+        a.dtype)
+    return x
+
+
+normal_ = gaussian_inplace
+
+
+def full_batch_size_like(input, shape, dtype, value, input_dim_idx=0,
+                         output_dim_idx=0, name=None):
+    """reference ops.yaml: full_batch_size_like."""
+    a = unwrap(input)
+    shp = list(_shape(shape))
+    shp[output_dim_idx] = a.shape[input_dim_idx]
+    return full(shp, value, dtype=dtype)
+
+
+def full_with_tensor(value, shape, dtype=None, name=None):
+    """reference ops.yaml: full_with_tensor (shape from a tensor)."""
+    shp = [int(s) for s in np.asarray(unwrap(shape)).reshape(-1)]
+    return full(shp, float(np.asarray(unwrap(value))), dtype=dtype)
+
+
+def full_int_array(value, dtype="int64", name=None):
+    return wrap(jnp.asarray(np.asarray(value), _dt(dtype, jnp.int64)))

@@ -261,3 +261,43 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
     u, s, vh = jnp.linalg.svd(a, full_matrices=False)
     return (wrap(u[..., :q]), wrap(s[..., :q]),
             wrap(jnp.swapaxes(vh, -1, -2)[..., :q]))
+
+
+# ---- coverage batch (reference ops.yaml names) -----------------------------
+
+def matrix_rank_tol(x, tol=None, use_default_tol=True, hermitian=False,
+                    name=None):
+    """reference ops.yaml: matrix_rank_tol."""
+    def fn(a):
+        return jnp.linalg.matrix_rank(a, tol=tol)
+    return run_op_nodiff("matrix_rank_tol", fn, [x])
+
+
+def matrix_rank_atol_rtol(x, atol=None, rtol=None, hermitian=False,
+                          name=None):
+    def fn(a):
+        s = jnp.linalg.svd(a, compute_uv=False)
+        smax = jnp.max(s, axis=-1, keepdims=True)
+        a_ = 0.0 if atol is None else atol
+        r_ = (jnp.finfo(a.dtype).eps * max(a.shape[-2:])
+              if rtol is None else rtol)
+        thresh = jnp.maximum(a_, r_ * smax)
+        return jnp.sum(s > thresh, axis=-1)
+    return run_op_nodiff("matrix_rank_atol_rtol", fn, [x])
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Power-iteration spectral normalisation (reference ops.yaml:
+    spectral_norm)."""
+    def fn(w):
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        u = jnp.ones((wm.shape[0],), w.dtype) / np.sqrt(wm.shape[0])
+        v = jnp.ones((wm.shape[1],), w.dtype) / np.sqrt(wm.shape[1])
+        for _ in range(max(power_iters, 1)):
+            v = wm.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = wm @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        sigma = u @ wm @ v
+        return w / jnp.maximum(sigma, eps)
+    return run_op("spectral_norm", fn, [weight])

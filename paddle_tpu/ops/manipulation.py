@@ -664,3 +664,196 @@ def atleast_2d(*inputs, name=None):
 def atleast_3d(*inputs, name=None):
     outs = [run_op("atleast_3d", jnp.atleast_3d, [x]) for x in inputs]
     return outs[0] if len(outs) == 1 else outs
+
+
+# ---- coverage batch (reference ops.yaml names) -----------------------------
+
+def unstack(x, axis=0, num=None, name=None):
+    """reference ops.yaml: unstack."""
+    n = num if num is not None else unwrap(x).shape[axis]
+
+    def fn(a):
+        parts = jnp.split(a, n, axis=axis)
+        return tuple(jnp.squeeze(p, axis=axis) for p in parts)
+    return list(run_op("unstack", fn, [x]))
+
+
+def reverse(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return run_op("reverse", lambda a: jnp.flip(a, axis=ax), [x])
+
+
+def split_with_num(x, num, axis=0, name=None):
+    from . import manipulation as _m
+    return _m.split(x, int(num), axis=axis)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """reference ops.yaml: diag_embed."""
+    def fn(a):
+        last = a.shape[-1]
+        size = last + builtins_abs(offset)
+        base = jnp.zeros(a.shape[:-1] + (size, size), a.dtype)
+        idx = jnp.arange(last)
+        rows = idx + (-offset if offset < 0 else 0)
+        cols = idx + (offset if offset > 0 else 0)
+        out = base.at[..., rows, cols].set(a)
+        # move the two new dims into (dim1, dim2) positions
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        order = sorted([(d1, nd - 2), (d2, nd - 1)])
+        for pos, src in order:
+            perm.insert(pos, src)
+        return jnp.transpose(out, perm)
+    return run_op("diag_embed", fn, [input])
+
+
+builtins_abs = abs  # keep python abs reachable after ops shadow it
+
+
+def fill_(x, value):
+    """In-place fill (reference ops.yaml: fill)."""
+    x._data = jnp.full_like(unwrap(x), value)
+    return x
+
+
+fill = fill_
+
+
+builtins_min = min
+
+
+def _diag_fill_indices(h, w, offset, wrap):
+    """(rows, cols) of the (offset) diagonal; wrap=True continues the
+    diagonal past the bottom of a tall matrix (reference semantics)."""
+    rows, cols = [], []
+    r = -offset if offset < 0 else 0
+    c = offset if offset > 0 else 0
+    while r < h and c < w:
+        rows.append(r)
+        cols.append(c)
+        r += 1
+        c += 1
+        if wrap and r < h and c >= w:
+            r += 1  # skip one row, restart at column 0
+            c = 0
+    return jnp.asarray(rows), jnp.asarray(cols)
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    """reference ops.yaml: fill_diagonal."""
+    a = unwrap(x)
+    rows, cols = _diag_fill_indices(a.shape[-2], a.shape[-1], offset,
+                                    wrap)
+    x._data = a.at[..., rows, cols].set(value)
+    return x
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    def fn(a):
+        rows, cols = _diag_fill_indices(a.shape[-2], a.shape[-1], offset,
+                                        wrap)
+        return a.at[..., rows, cols].set(value)
+    return run_op("fill_diagonal", fn, [x])
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """reference ops.yaml: fill_diagonal_tensor."""
+    def fn(a, b):
+        nd = a.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        perm = [i for i in range(nd) if i not in (d1, d2)] + [d1, d2]
+        ap = jnp.transpose(a, perm)
+        n = builtins_min(ap.shape[-2], ap.shape[-1])
+        idx = jnp.arange(n)
+        rows = idx + (-offset if offset < 0 else 0)
+        cols = idx + (offset if offset > 0 else 0)
+        keep = (rows < ap.shape[-2]) & (cols < ap.shape[-1])
+        rows, cols = rows[keep], cols[keep]
+        bp = jnp.moveaxis(b, -1, -1)
+        ap = ap.at[..., rows, cols].set(bp)
+        inv = [0] * nd
+        for i, p in enumerate(perm):
+            inv[p] = i
+        return jnp.transpose(ap, inv)
+    return run_op("fill_diagonal_tensor", fn, [x, y])
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Sliding-window framing (reference ops.yaml: frame)."""
+    def fn(a):
+        a_m = jnp.moveaxis(a, axis, -1)
+        n = a_m.shape[-1]
+        num = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        out = a_m[..., idx]              # [..., num, frame_length]
+        out = jnp.swapaxes(out, -1, -2)  # [..., frame_length, num]
+        return out if axis in (-1, a.ndim - 1) else \
+            jnp.moveaxis(out, -1, axis)
+    return run_op("frame", fn, [x])
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame (reference ops.yaml: overlap_add)."""
+    def fn(a):
+        a_m = jnp.moveaxis(a, axis, -1) if axis not in (-1, a.ndim - 1) \
+            else a
+        # [..., frame_length, num]
+        frame_length = a_m.shape[-2]
+        num = a_m.shape[-1]
+        out_len = (num - 1) * hop_length + frame_length
+        out = jnp.zeros(a_m.shape[:-2] + (out_len,), a.dtype)
+        for i in range(num):
+            seg = a_m[..., :, i]
+            out = out.at[..., i * hop_length:
+                         i * hop_length + frame_length].add(seg)
+        return out
+    return run_op("overlap_add", fn, [x])
+
+
+def repeat_interleave_with_tensor_index(x, repeats, axis=None, name=None):
+    """reference ops.yaml: repeat_interleave_with_tensor_index."""
+    def fn(a, r):
+        return jnp.repeat(a, r, axis=axis,
+                          total_repeat_length=int(np.sum(np.asarray(r))))
+    return run_op("repeat_interleave_with_tensor_index", fn, [x, repeats])
+
+
+def index_select_strided(x, index, axis=0, name=None):
+    from . import manipulation as _m
+    return _m.index_select(x, index, axis)
+
+
+def view_shape(x, shape=None, name=None):
+    from . import manipulation as _m
+    return _m.reshape(x, shape)
+
+
+def view_dtype(x, dtype, name=None):
+    """Bitcast view (reference ops.yaml: view_dtype)."""
+    from ..core import dtype as dtype_mod
+    dt = dtype_mod.dtype(dtype).np_dtype
+    return run_op("view_dtype", lambda a: jax.lax.bitcast_convert_type(
+        a, dt), [x])
+
+
+def trans_layout(x, perm, name=None):
+    from . import manipulation as _m
+    return _m.transpose(x, perm)
+
+
+def assign_out_(x, output):
+    """reference ops.yaml: assign_out_ (copy x into output in place)."""
+    output._data = unwrap(x)
+    return output
+
+
+def assign_value_(output, shape, dtype, values, name=None):
+    from ..core import dtype as dtype_mod
+    arr = jnp.asarray(np.array(values).reshape(shape),
+                      dtype_mod.dtype(dtype).np_dtype)
+    output._data = arr
+    return output

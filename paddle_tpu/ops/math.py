@@ -559,3 +559,97 @@ def log_normalize(x, axis=-1):
     return run_op("log_normalize",
                   lambda a: a - jax.scipy.special.logsumexp(
                       a, axis=axis, keepdims=True), [x])
+
+
+# ---- coverage batch: reductions/norms/elementwise (reference ops.yaml) -----
+
+def dist(x, y, p=2.0, name=None):
+    """p-norm of (x - y) (reference ops.yaml: dist)."""
+    def fn(a, b):
+        d = jnp.abs(a - b).astype(jnp.float32)
+        if p == 0:
+            return jnp.sum(d != 0).astype(a.dtype)
+        if jnp.isinf(p):
+            return (jnp.min(d) if p < 0 else jnp.max(d)).astype(a.dtype)
+        return (jnp.sum(d ** p) ** (1.0 / p)).astype(a.dtype)
+    return run_op("dist", fn, [x, y])
+
+
+def p_norm(x, porder=2.0, axis=-1, epsilon=1e-12, keepdim=False,
+           asvector=False, name=None):
+    """reference ops.yaml: p_norm."""
+    def fn(a):
+        v = a.reshape(-1) if asvector else a
+        ax = None if asvector else axis
+        d = jnp.abs(v.astype(jnp.float32))
+        if porder == 0:
+            out = jnp.sum(d != 0, axis=ax, keepdims=keepdim)
+        elif np.isinf(porder):
+            red = jnp.min if porder < 0 else jnp.max
+            out = red(d, axis=ax, keepdims=keepdim)
+        else:
+            out = jnp.sum(d ** porder, axis=ax,
+                          keepdims=keepdim) ** (1.0 / porder)
+        return out.astype(a.dtype)
+    return run_op("p_norm", fn, [x])
+
+
+def frobenius_norm(x, axis=None, keepdim=False, name=None):
+    def fn(a):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        return jnp.sqrt(jnp.sum(jnp.square(a.astype(jnp.float32)),
+                                axis=ax, keepdims=keepdim)).astype(a.dtype)
+    return run_op("frobenius_norm", fn, [x])
+
+
+def l1_norm(x, name=None):
+    return run_op("l1_norm", lambda a: jnp.sum(jnp.abs(a)), [x])
+
+
+def squared_l2_norm(x, name=None):
+    return run_op("squared_l2_norm", lambda a: jnp.sum(jnp.square(a)), [x])
+
+
+def clip_by_norm(x, max_norm, name=None):
+    def fn(a):
+        n = jnp.sqrt(jnp.sum(jnp.square(a.astype(jnp.float32))))
+        scale = jnp.where(n > max_norm, max_norm / jnp.maximum(n, 1e-12),
+                          1.0)
+        return (a.astype(jnp.float32) * scale).astype(a.dtype)
+    return run_op("clip_by_norm", fn, [x])
+
+
+def mean_all(x, name=None):
+    return run_op("mean_all", jnp.mean, [x])
+
+
+def reduce_as(x, target, name=None):
+    """Reduce-sum x down to target's shape (reference ops.yaml:
+    reduce_as — the broadcast transpose)."""
+    def fn(a, t):
+        extra = a.ndim - t.ndim
+        out = jnp.sum(a, axis=tuple(range(extra))) if extra else a
+        axes = tuple(i for i, (s, ts) in
+                     enumerate(zip(out.shape, t.shape)) if ts == 1 != s)
+        if axes:
+            out = jnp.sum(out, axis=axes, keepdims=True)
+        return out.astype(a.dtype)
+    return run_op("reduce_as", fn, [x, target])
+
+
+def logsigmoid(x, name=None):
+    return run_op("logsigmoid", jax.nn.log_sigmoid, [x])
+
+
+def tanh_shrink(x, name=None):
+    return run_op("tanh_shrink", lambda a: a - jnp.tanh(a), [x])
+
+
+def multiplex(inputs, index, name=None):
+    """Select row-wise among candidate tensors (reference ops.yaml:
+    multiplex)."""
+    def fn(idx, *cands):
+        stacked = jnp.stack(cands, axis=0)  # [n, batch, ...]
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx.reshape(-1), rows]
+    return run_op("multiplex", fn, [index] + list(inputs))

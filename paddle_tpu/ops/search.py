@@ -1,6 +1,7 @@
 """Search/sort ops (reference: python/paddle/tensor/search.py)."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -60,7 +61,6 @@ def topk(x, k, axis=None, largest=True, sorted=True, name=None):  # noqa: A001
     return vals, idx
 
 
-import jax  # noqa: E402
 
 
 def kthvalue(x, k, axis=-1, keepdim=False, name=None):
@@ -130,3 +130,79 @@ def where(condition, x=None, y=None, name=None):
 def nonzero(x, as_tuple=False):
     from .manipulation import nonzero as _nz
     return _nz(x, as_tuple)
+
+
+# ---- coverage batch (reference ops.yaml names) -----------------------------
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=False, name=None):
+    """Viterbi decoding (reference ops.yaml: viterbi_decode).
+
+    potentials: [B, T, N] emission scores; transition_params: [N, N];
+    lengths: [B] valid lengths (padded steps are no-ops, their path
+    entries repeat the final state). include_bos_eos_tag treats the last
+    two tags as SOS/EOS like the reference.
+    Returns (scores [B], paths [B, T]).
+    """
+    args = [potentials, transition_params]
+    if lengths is not None:
+        args.append(lengths)
+
+    def fn(em, trans, *rest):
+        T = em.shape[1]
+        lens = rest[0] if rest else jnp.full((em.shape[0],), T)
+
+        def decode_one(e, n_valid):
+            score0 = e[0]
+            if include_bos_eos_tag:
+                score0 = score0 + trans[-2]  # SOS -> tag
+
+            def body(carry, xs):
+                score = carry
+                e_t, t = xs
+                cand = score[:, None] + trans
+                best = jnp.max(cand, axis=0) + e_t
+                idx = jnp.argmax(cand, axis=0)
+                valid = t < n_valid
+                # padded step: keep score, identity backpointer
+                best = jnp.where(valid, best, score)
+                idx = jnp.where(valid, idx, jnp.arange(trans.shape[0]))
+                return best, idx
+
+            final, backptrs = jax.lax.scan(
+                body, score0, (e[1:], jnp.arange(1, T)))
+            if include_bos_eos_tag:
+                final = final + trans[:, -1]  # tag -> EOS
+            last = jnp.argmax(final)
+
+            def back(carry, ptr_t):
+                prev = ptr_t[carry]
+                return prev, prev
+
+            _, path_rev = jax.lax.scan(back, last, backptrs[::-1])
+            path = jnp.concatenate([path_rev[::-1], last[None]])
+            return jnp.max(final), path
+
+        return jax.vmap(decode_one)(em, lens)
+    return run_op_nodiff("viterbi_decode", fn, args)
+
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search backtrace (reference ops.yaml: gather_tree).
+    ids/parents: [T, B, beam]."""
+    def fn(ids_a, par):
+        t = ids_a.shape[0]
+
+        def body(carry, xs):
+            beams = carry        # [B, beam] current beam indices
+            id_t, par_t = xs
+            out = jnp.take_along_axis(id_t, beams, axis=1)
+            beams = jnp.take_along_axis(par_t, beams, axis=1)
+            return beams, out
+
+        init = jnp.broadcast_to(
+            jnp.arange(ids_a.shape[2]), ids_a.shape[1:]).astype(
+                ids_a.dtype)
+        _, outs = jax.lax.scan(body, init, (ids_a[::-1], par[::-1]))
+        return outs[::-1]
+    return run_op_nodiff("gather_tree", fn, [ids, parents])

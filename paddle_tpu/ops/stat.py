@@ -4,7 +4,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.dispatch import run_op, unwrap, wrap
+from ..core.dispatch import run_op, run_op_nodiff, unwrap, wrap
 
 
 def _axis(axis):
@@ -80,3 +80,37 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
                                     ddof=1 if ddof else 0,
                                     fweights=unwrap(fweights),
                                     aweights=unwrap(aweights)), [x])
+
+
+# ---- coverage batch (reference ops.yaml names) -----------------------------
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Top-k accuracy (reference ops.yaml: accuracy)."""
+    def fn(x, y):
+        topk = jnp.argsort(-x, axis=-1)[..., :k]
+        hit = jnp.any(topk == y.reshape(-1, 1), axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+    return run_op_nodiff("accuracy", fn, [input, label])
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, name=None):
+    """Binned AUC (reference ops.yaml: auc)."""
+    def fn(x, y):
+        pos_prob = x[:, 1] if x.ndim == 2 else x
+        bins = jnp.clip((pos_prob * num_thresholds).astype(jnp.int32), 0,
+                        num_thresholds)
+        yb = y.reshape(-1).astype(bool)
+        pos_hist = jnp.zeros(num_thresholds + 1).at[bins].add(
+            yb.astype(jnp.float32))
+        neg_hist = jnp.zeros(num_thresholds + 1).at[bins].add(
+            (~yb).astype(jnp.float32))
+        # sweep thresholds high->low
+        tp = jnp.cumsum(pos_hist[::-1])
+        fp = jnp.cumsum(neg_hist[::-1])
+        tot_pos = jnp.maximum(tp[-1], 1e-6)
+        tot_neg = jnp.maximum(fp[-1], 1e-6)
+        tpr = jnp.concatenate([jnp.zeros(1), tp]) / tot_pos
+        fpr = jnp.concatenate([jnp.zeros(1), fp]) / tot_neg
+        return jnp.trapezoid(tpr, fpr)
+    return run_op_nodiff("auc", fn, [input, label])

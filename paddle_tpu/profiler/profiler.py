@@ -135,6 +135,7 @@ class Profiler:
         self.step_num = 0
         self._state = ProfilerState.CLOSED
         self._tracing = False
+        self._fired_in_step = False
         self._store = _HostEventStore()
 
     # -- lifecycle -----------------------------------------------------------
@@ -152,7 +153,8 @@ class Profiler:
         # fire only for a cycle still open at stop(); completed cycles
         # already fired in step()
         if self._on_trace_ready is not None and (
-                had_trace or self._timer_only):
+                had_trace or (self._timer_only
+                              and not self._fired_in_step)):
             self._on_trace_ready(self)
         _current_store = None
 
@@ -168,6 +170,7 @@ class Profiler:
                 self._stop_trace()
             if self._on_trace_ready is not None:
                 self._on_trace_ready(self)
+                self._fired_in_step = True
         if new_state != self._state or prev == \
                 ProfilerState.RECORD_AND_RETURN:
             self._state = new_state

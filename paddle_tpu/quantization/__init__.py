@@ -1,0 +1,12 @@
+"""paddle.quantization parity surface (reference python/paddle/quantization
+QAT/PTQ framework + the fake_quantize_* kernel family in ops.yaml).
+
+TPU-native: fake-quant is pure elementwise math XLA fuses for free; the
+class surface (QuantConfig/QAT/PTQ) wraps layers with fake-quant
+observers the same way the reference's imperative quantization does.
+"""
+from .functional import (  # noqa: F401
+    fake_channel_wise_quantize_dequantize_abs_max,
+    fake_quantize_abs_max, fake_quantize_dequantize_abs_max,
+    quantize_linear, dequantize_linear)
+from .qat import QAT, PTQ, QuantConfig  # noqa: F401

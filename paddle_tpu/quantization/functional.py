@@ -1,0 +1,63 @@
+"""Fake-quantization ops (reference ops.yaml fake_quantize_* family,
+kernels paddle/phi/kernels/*/fake_quantize_*)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import run_op
+
+
+def _qparams(bit_length):
+    return float(2 ** (bit_length - 1) - 1)
+
+
+def fake_quantize_abs_max(x, bit_length=8, name=None):
+    """Returns (quantized int levels as float, scale)."""
+    qmax = _qparams(bit_length)
+
+    def fn(a):
+        scale = jnp.max(jnp.abs(a))
+        q = jnp.round(a / jnp.maximum(scale, 1e-12) * qmax)
+        return q, scale
+    return run_op("fake_quantize_abs_max", fn, [x])
+
+
+def fake_quantize_dequantize_abs_max(x, bit_length=8, name=None):
+    """Quantize-dequantize round trip (the QAT forward)."""
+    qmax = _qparams(bit_length)
+
+    def fn(a):
+        scale = jnp.maximum(jnp.max(jnp.abs(a)), 1e-12)
+        q = jnp.round(a / scale * qmax)
+        return q * scale / qmax
+    return run_op("fake_quantize_dequantize_abs_max", fn, [x])
+
+
+def fake_channel_wise_quantize_dequantize_abs_max(x, bit_length=8,
+                                                  quant_axis=0, name=None):
+    qmax = _qparams(bit_length)
+
+    def fn(a):
+        axes = tuple(i for i in range(a.ndim) if i != quant_axis)
+        scale = jnp.maximum(jnp.max(jnp.abs(a), axis=axes, keepdims=True),
+                            1e-12)
+        q = jnp.round(a / scale * qmax)
+        return q * scale / qmax
+    return run_op("fake_channel_wise_quantize_dequantize_abs_max", fn,
+                  [x])
+
+
+def quantize_linear(x, scale, zero_point=0.0, bit_length=8, quant_axis=-1,
+                    name=None):
+    qmax = _qparams(bit_length)
+
+    def fn(a, s):
+        return jnp.clip(jnp.round(a / s + zero_point), -qmax - 1, qmax)
+    return run_op("quantize_linear", fn, [x, scale])
+
+
+def dequantize_linear(x, scale, zero_point=0.0, bit_length=8,
+                      quant_axis=-1, name=None):
+    def fn(a, s):
+        return (a - zero_point) * s
+    return run_op("dequantize_linear", fn, [x, scale])

@@ -1,0 +1,89 @@
+"""QAT/PTQ class surface (reference python/paddle/quantization/qat.py,
+ptq.py, config.py)."""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+from .functional import fake_quantize_dequantize_abs_max
+
+
+class QuantConfig:
+    """Reference: quantization/config.py QuantConfig — declares which
+    layer types get (activation, weight) quanters."""
+
+    def __init__(self, activation=None, weight=None, bit_length=8):
+        self.activation = activation
+        self.weight = weight
+        self.bit_length = bit_length
+        self._type_configs = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        for t in (layer_type if isinstance(layer_type, (list, tuple))
+                  else [layer_type]):
+            self._type_configs[t] = (activation, weight)
+
+    def matches(self, layer) -> bool:
+        return not self._type_configs or \
+            type(layer) in self._type_configs
+
+
+class QuantedWrapper(Layer):
+    """Wraps a layer: fake-quant the input activations and (QAT) weights
+    on every forward (the imperative quant-aware pattern)."""
+
+    def __init__(self, inner: Layer, bit_length=8, quant_weights=True):
+        super().__init__()
+        self.inner = inner
+        self.bit_length = bit_length
+        self.quant_weights = quant_weights
+
+    def forward(self, x):
+        x = fake_quantize_dequantize_abs_max(x, self.bit_length)
+        if self.quant_weights and hasattr(self.inner, "weight") and \
+                self.inner.weight is not None:
+            w = self.inner.weight
+            orig = w._data
+            w._data = fake_quantize_dequantize_abs_max(
+                w, self.bit_length)._data
+            try:
+                out = self.inner(x)
+            finally:
+                w._data = orig
+            return out
+        return self.inner(x)
+
+
+def _wrap_model(model: Layer, config: QuantConfig, quant_weights):
+    from ..nn.layer.common import Linear
+    from ..nn.layer.conv import Conv2D
+    targets = (Linear, Conv2D)
+    for name, sub in list(model._sub_layers.items()):
+        if isinstance(sub, targets) and config.matches(sub):
+            model._sub_layers[name] = QuantedWrapper(
+                sub, config.bit_length, quant_weights)
+        else:
+            _wrap_model(sub, config, quant_weights)
+    return model
+
+
+class QAT:
+    """Quant-aware training (reference quantization/qat.py)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace=False):
+        return _wrap_model(model, self.config, quant_weights=True)
+
+
+class PTQ:
+    """Post-training quantization (reference quantization/ptq.py):
+    activation observers only."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace=False):
+        return _wrap_model(model, self.config, quant_weights=False)
+
+    def convert(self, model: Layer, inplace=False):
+        return model

@@ -1,0 +1,74 @@
+"""paddle.signal parity surface (reference python/paddle/signal.py:
+stft/istft over the frame/overlap_add kernels)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import run_op, unwrap
+from .ops.manipulation import frame as _frame
+from .ops.manipulation import overlap_add as _overlap_add
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform (reference signal.py stft)."""
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    win = unwrap(window) if window is not None else jnp.ones(wl)
+
+    def fn(a):
+        v = a
+        if center:
+            pad = n_fft // 2
+            v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(pad, pad)],
+                        mode=pad_mode)
+        n = v.shape[-1]
+        num = 1 + (n - n_fft) // hop
+        starts = jnp.arange(num) * hop
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = v[..., idx]                      # [..., num, n_fft]
+        # keep the window in the signal dtype: under x64 a float64
+        # window promotes the spectrum to complex128, unsupported on TPU
+        w = jnp.zeros(n_fft, a.dtype).at[:wl].set(
+            jnp.asarray(win, a.dtype))
+        spec = jnp.fft.rfft(frames * w, axis=-1) if onesided else \
+            jnp.fft.fft(frames * w, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.sum(w ** 2))
+        return jnp.swapaxes(spec, -1, -2)         # [..., freq, num]
+    return run_op("stft", fn, [x])
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    win = unwrap(window) if window is not None else jnp.ones(wl)
+
+    def fn(a):
+        spec = jnp.swapaxes(a, -1, -2)            # [..., num, freq]
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided else \
+            jnp.fft.ifft(spec, axis=-1).real
+        w = jnp.zeros(n_fft, frames.dtype).at[:wl].set(
+            jnp.asarray(win, frames.dtype))
+        if normalized:
+            frames = frames * jnp.sqrt(jnp.sum(w ** 2))
+        frames = frames * w
+        num = frames.shape[-2]
+        out_len = (num - 1) * hop + n_fft
+        out = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+        norm = jnp.zeros(out_len, frames.dtype)
+        for i in range(num):
+            sl = slice(i * hop, i * hop + n_fft)
+            out = out.at[..., sl].add(frames[..., i, :])
+            norm = norm.at[sl].add(w ** 2)
+        out = out / jnp.maximum(norm, 1e-11)
+        if center:
+            pad = n_fft // 2
+            out = out[..., pad:out_len - pad]
+        if length is not None:
+            out = out[..., :length]
+        return out
+    return run_op("istft", fn, [x])

@@ -1,0 +1,268 @@
+"""paddle.vision.ops (reference python/paddle/vision/ops.py: nms,
+roi_align, roi_pool, box_coder, prior_box, yolo_box, ...).
+
+TPU-first notes: detection post-processing is branch-heavy; these
+lowerings keep static shapes (fixed iteration counts, masked selects) so
+they compile under jit. NMS returns keep-mask ordering like the
+reference's kept-indices (padded with -1) rather than a dynamic-length
+tensor.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import run_op, run_op_nodiff, unwrap
+
+
+def _iou_matrix(boxes):
+    x1, y1, x2, y2 = [boxes[:, i] for i in range(4)]
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Hard NMS (reference vision/ops.py nms). Returns kept indices
+    sorted by score, padded with -1 to the input length (static shape)."""
+    def fn(b, s):
+        n = b.shape[0]
+        order = jnp.argsort(-s)
+        iou = _iou_matrix(b)[order][:, order]
+        # greedy suppression with a fixed-length scan over rank positions
+        def body(keep, i):
+            # keep[j] == True means box at rank j survives so far
+            suppress = (iou[i] > iou_threshold) & keep[i] & \
+                (jnp.arange(n) > i)
+            return keep & ~suppress, None
+        keep0 = jnp.ones(n, bool)
+        keep, _ = jax.lax.scan(body, keep0, jnp.arange(n))
+        kept_sorted = jnp.where(keep, order, -1)
+        # stable-move -1 entries to the back
+        rank = jnp.where(keep, jnp.arange(n), n)
+        kept_sorted = kept_sorted[jnp.argsort(rank)]
+        if top_k is not None:
+            kept_sorted = kept_sorted[:top_k]
+        return kept_sorted
+    s = scores if scores is not None else \
+        jnp.arange(unwrap(boxes).shape[0], 0, -1).astype(jnp.float32)
+    return run_op_nodiff("nms", fn, [boxes, s])
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign via bilinear grid sampling (reference ops.yaml: roi_align)."""
+    out_h, out_w = (output_size if isinstance(output_size, (tuple, list))
+                    else (output_size, output_size))
+
+    def fn(feat, rois):
+        # feat: [N, C, H, W] (assume all rois on batch 0 slice per
+        # boxes_num convention flattened upstream); rois: [R, 4]
+        c, h, w = feat.shape[1:]
+        off = 0.5 if aligned else 0.0
+        ratio = sampling_ratio if sampling_ratio > 0 else 2
+
+        def one_roi(roi):
+            x1, y1, x2, y2 = roi * spatial_scale - off
+            rw = jnp.maximum(x2 - x1, 1e-6)
+            rh = jnp.maximum(y2 - y1, 1e-6)
+            ys = y1 + (jnp.arange(out_h * ratio) + 0.5) * rh / (
+                out_h * ratio)
+            xs = x1 + (jnp.arange(out_w * ratio) + 0.5) * rw / (
+                out_w * ratio)
+
+            def sample(py, px):
+                y0 = jnp.floor(py).astype(jnp.int32)
+                x0 = jnp.floor(px).astype(jnp.int32)
+                wy = py - y0
+                wx = px - x0
+
+                def g(yy, xx):
+                    yc = jnp.clip(yy, 0, h - 1)
+                    xc = jnp.clip(xx, 0, w - 1)
+                    v = feat[0, :, yc, xc]
+                    ok = (yy >= 0) & (yy <= h - 1) & (xx >= 0) & \
+                        (xx <= w - 1)
+                    return v * ok
+                return (g(y0, x0) * (1 - wy) * (1 - wx)
+                        + g(y0, x0 + 1) * (1 - wy) * wx
+                        + g(y0 + 1, x0) * wy * (1 - wx)
+                        + g(y0 + 1, x0 + 1) * wy * wx)
+
+            grid = jax.vmap(lambda py: jax.vmap(
+                lambda px: sample(py, px))(xs))(ys)
+            # [out_h*r, out_w*r, C] -> average pool r x r
+            grid = grid.reshape(out_h, ratio, out_w, ratio, c)
+            return jnp.mean(grid, axis=(1, 3)).transpose(2, 0, 1)
+
+        return jax.vmap(one_roi)(rois)  # [R, C, out_h, out_w]
+    return run_op("roi_align", fn, [x, boxes])
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """Max RoI pooling (reference ops.yaml: roi_pool) — implemented as
+    dense-sampled max (static shapes)."""
+    out_h, out_w = (output_size if isinstance(output_size, (tuple, list))
+                    else (output_size, output_size))
+
+    def fn(feat, rois):
+        c, h, w = feat.shape[1:]
+
+        def one_roi(roi):
+            x1, y1, x2, y2 = jnp.round(roi * spatial_scale)
+            rw = jnp.maximum(x2 - x1 + 1, 1.0)
+            rh = jnp.maximum(y2 - y1 + 1, 1.0)
+            ratio = 4
+            ys = y1 + (jnp.arange(out_h * ratio) + 0.5) * rh / (
+                out_h * ratio)
+            xs = x1 + (jnp.arange(out_w * ratio) + 0.5) * rw / (
+                out_w * ratio)
+            yi = jnp.clip(ys.astype(jnp.int32), 0, h - 1)
+            xi = jnp.clip(xs.astype(jnp.int32), 0, w - 1)
+            patch = feat[0][:, yi][:, :, xi]
+            patch = patch.reshape(c, out_h, ratio, out_w, ratio)
+            return jnp.max(patch, axis=(2, 4))
+
+        return jax.vmap(one_roi)(rois)
+    return run_op("roi_pool", fn, [x, boxes])
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """reference ops.yaml: box_coder."""
+    def fn(pb, pbv, tb):
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + ph * 0.5
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw * 0.5
+            tcy = tb[:, 1] + th * 0.5
+            out = jnp.stack([
+                (tcx - pcx) / pw / pbv[:, 0],
+                (tcy - pcy) / ph / pbv[:, 1],
+                jnp.log(tw / pw) / pbv[:, 2],
+                jnp.log(th / ph) / pbv[:, 3]], axis=1)
+        else:  # decode_center_size
+            dcx = pbv[:, 0] * tb[:, 0] * pw + pcx
+            dcy = pbv[:, 1] * tb[:, 1] * ph + pcy
+            dw = jnp.exp(pbv[:, 2] * tb[:, 2]) * pw
+            dh = jnp.exp(pbv[:, 3] * tb[:, 3]) * ph
+            out = jnp.stack([dcx - dw * 0.5, dcy - dh * 0.5,
+                             dcx + dw * 0.5 - norm,
+                             dcy + dh * 0.5 - norm], axis=1)
+        return out
+    return run_op("box_coder", fn, [prior_box, prior_box_var, target_box])
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior boxes (reference ops.yaml: prior_box)."""
+    a = unwrap(input)
+    img = unwrap(image)
+    h, w = a.shape[-2:]
+    ih, iw = img.shape[-2:]
+    step_h = steps[1] or ih / h
+    step_w = steps[0] or iw / w
+    ars = list(aspect_ratios)
+    if flip:
+        ars += [1.0 / r for r in aspect_ratios if r != 1.0]
+    boxes = []
+    for ms in min_sizes:
+        for ar in ars:
+            bw = ms * np.sqrt(ar) / 2
+            bh = ms / np.sqrt(ar) / 2
+            boxes.append((bw, bh))
+        if max_sizes:
+            for mx in max_sizes:
+                s = np.sqrt(ms * mx) / 2
+                boxes.append((s, s))
+    cy = (np.arange(h) + offset) * step_h
+    cx = (np.arange(w) + offset) * step_w
+    gy, gx = np.meshgrid(cy, cx, indexing="ij")
+    out = np.zeros((h, w, len(boxes), 4), np.float32)
+    for i, (bw, bh) in enumerate(boxes):
+        out[..., i, 0] = (gx - bw) / iw
+        out[..., i, 1] = (gy - bh) / ih
+        out[..., i, 2] = (gx + bw) / iw
+        out[..., i, 3] = (gy + bh) / ih
+    if clip:
+        out = np.clip(out, 0, 1)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    from ..core.dispatch import wrap
+    return wrap(jnp.asarray(out)), wrap(jnp.asarray(var))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """YOLO detection decode (reference ops.yaml: yolo_box)."""
+    na = len(anchors) // 2
+
+    def fn(a, imgs):
+        n, _, h, w = a.shape
+        v = a.reshape(n, na, 5 + class_num, h, w)
+        gx = jnp.arange(w).reshape(1, 1, 1, w)
+        gy = jnp.arange(h).reshape(1, 1, h, 1)
+        sx = jax.nn.sigmoid(v[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2
+        sy = jax.nn.sigmoid(v[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2
+        bx = (gx + sx) / w
+        by = (gy + sy) / h
+        aw = jnp.asarray(anchors[0::2], a.dtype).reshape(1, na, 1, 1)
+        ah = jnp.asarray(anchors[1::2], a.dtype).reshape(1, na, 1, 1)
+        bw = jnp.exp(v[:, :, 2]) * aw / (w * downsample_ratio)
+        bh = jnp.exp(v[:, :, 3]) * ah / (h * downsample_ratio)
+        conf = jax.nn.sigmoid(v[:, :, 4])
+        probs = jax.nn.sigmoid(v[:, :, 5:]) * conf[:, :, None]
+        imh = imgs[:, 0].reshape(n, 1, 1, 1)
+        imw = imgs[:, 1].reshape(n, 1, 1, 1)
+        x1 = (bx - bw / 2) * imw
+        y1 = (by - bh / 2) * imh
+        x2 = (bx + bw / 2) * imw
+        y2 = (by + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, -1, 4)
+        scores = probs.transpose(0, 1, 3, 4, 2).reshape(
+            n, -1, class_num)
+        keep = (conf > conf_thresh).reshape(n, -1, 1)
+        return boxes * keep, scores * keep
+    return run_op("yolo_box", fn, [x, img_size])
+
+
+def shuffle_channel(x, group, name=None):
+    """reference ops.yaml: shuffle_channel."""
+    def fn(a):
+        n, c, h, w = a.shape
+        return a.reshape(n, group, c // group, h, w).swapaxes(
+            1, 2).reshape(n, c, h, w)
+    return run_op("shuffle_channel", fn, [x])
+
+
+def deform_conv2d(*a, **kw):
+    raise NotImplementedError(
+        "deformable convolution needs a gather-heavy custom kernel; "
+        "planned as a Pallas kernel")
+
+
+def distribute_fpn_proposals(*a, **kw):
+    raise NotImplementedError("FPN proposal distribution is dynamic-shape "
+                              "host logic; run it outside jit")

@@ -1,0 +1,93 @@
+"""Launcher tests — reference pattern CommunicationTestDistBase
+(test/collective/test_communication_api_base.py:28): the driver shells
+out to the launcher which spawns worker scripts; asserts via logs."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_worker(tmp_path, body):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(body))
+    return str(script)
+
+
+def _run_launch(tmp_path, script, extra=()):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--log_dir", str(tmp_path / "log"), *extra, script]
+    return subprocess.run(cmd, env=env, cwd=str(tmp_path),
+                          capture_output=True, text=True, timeout=240)
+
+
+def test_launch_single_proc(tmp_path):
+    script = _write_worker(tmp_path, """
+        import os
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import paddle_tpu.distributed as dist
+        assert os.environ["PADDLE_TRAINERS_NUM"] == "1"
+        print("RANK", dist.get_rank(), "WORLD", dist.get_world_size())
+    """)
+    r = _run_launch(tmp_path, script)
+    assert r.returncode == 0, r.stderr
+    log = (tmp_path / "log" / "workerlog.0").read_text()
+    assert "RANK 0 WORLD 1" in log
+
+
+def test_launch_multi_proc_env(tmp_path):
+    script = _write_worker(tmp_path, """
+        import os
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        world = os.environ["PADDLE_TRAINERS_NUM"]
+        master = os.environ["PADDLE_MASTER"]
+        print(f"worker rank={rank} world={world} master={master}")
+    """)
+    r = _run_launch(tmp_path, script, extra=["--nproc_per_node", "2"])
+    assert r.returncode == 0, r.stderr
+    log0 = (tmp_path / "log" / "workerlog.0").read_text()
+    log1 = (tmp_path / "log" / "workerlog.1").read_text()
+    assert "rank=0 world=2" in log0
+    assert "rank=1 world=2" in log1
+
+
+def test_launch_failure_propagates(tmp_path):
+    script = _write_worker(tmp_path, """
+        import os, sys, time
+        if os.environ["PADDLE_TRAINER_ID"] == "1":
+            sys.exit(3)
+        time.sleep(60)  # must be killed by the watcher, not run 60s
+    """)
+    r = _run_launch(tmp_path, script, extra=["--nproc_per_node", "2"])
+    assert r.returncode == 3
+
+
+def test_spawn_multi_process(tmp_path):
+    script = _write_worker(tmp_path, """
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+        def work(tag):
+            import paddle_tpu.distributed as dist
+            print(f"spawned tag={tag} rank={dist.get_rank()}", flush=True)
+
+        if __name__ == "__main__":
+            import paddle_tpu.distributed as dist
+            dist.spawn(work, args=("t",), nprocs=2)
+            print("SPAWN DONE")
+    """)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([sys.executable, script], env=env,
+                       cwd=str(tmp_path), capture_output=True, text=True,
+                       timeout=240)
+    assert r.returncode == 0, r.stderr
+    assert "SPAWN DONE" in r.stdout
