@@ -73,6 +73,10 @@ from . import profiler  # noqa: F401,E402
 from . import text  # noqa: F401,E402
 from . import fft  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
+from . import sparse  # noqa: F401,E402
+from . import distribution  # noqa: F401,E402
+from . import geometric  # noqa: F401,E402
+from . import signal  # noqa: F401,E402
 
 
 def disable_static(place=None):

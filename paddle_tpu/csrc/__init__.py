@@ -1,0 +1,82 @@
+"""Native runtime components (C++, loaded via ctypes).
+
+Reference analogs: TCPStore (paddle/phi/core/distributed/store/
+tcp_store.h:121), monitors (paddle/phi/core/platform/monitor.h), host
+memory stats (paddle/phi/core/memory/stats.h). Built on first import
+with g++ into libpaddle_tpu_native.so (cached beside the sources);
+callers must handle `lib() is None` when no toolchain is present.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libpaddle_tpu_native.so")
+_SOURCES = ["tcp_store.cpp", "monitor.cpp"]
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    srcs = [os.path.join(_DIR, s) for s in _SOURCES]
+    newest = max(os.path.getmtime(s) for s in srcs)
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= newest:
+        return True
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           "-o", _SO] + srcs
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def lib():
+    """The loaded native library, or None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not _build():
+            return None
+        try:
+            lb = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lb.ts_server_start.restype = ctypes.c_void_p
+        lb.ts_server_start.argtypes = [ctypes.c_int]
+        lb.ts_server_stop.argtypes = [ctypes.c_void_p]
+        lb.ts_client_connect.restype = ctypes.c_int
+        lb.ts_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lb.ts_client_close.argtypes = [ctypes.c_int]
+        for name in ("ts_set", "ts_get", "ts_add", "ts_check",
+                     "ts_delete"):
+            getattr(lb, name).restype = ctypes.c_int64
+        lb.ts_set.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                              ctypes.c_int, ctypes.c_char_p,
+                              ctypes.c_int]
+        lb.ts_get.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                              ctypes.c_int, ctypes.c_char_p,
+                              ctypes.c_int,
+                              ctypes.POINTER(ctypes.c_int)]
+        lb.ts_add.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                              ctypes.c_int, ctypes.c_int64]
+        lb.ts_check.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                ctypes.c_int]
+        lb.ts_delete.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                 ctypes.c_int]
+        lb.monitor_add.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lb.monitor_get.restype = ctypes.c_int
+        lb.monitor_get.argtypes = [ctypes.c_char_p,
+                                   ctypes.POINTER(ctypes.c_int64)]
+        lb.monitor_reset.argtypes = [ctypes.c_char_p]
+        lb.host_memory_rss_bytes.restype = ctypes.c_int64
+        lb.host_memory_peak_bytes.restype = ctypes.c_int64
+        _lib = lb
+        return _lib

@@ -1,0 +1,81 @@
+// Host-side monitors + memory stats.
+//
+// Reference: paddle/phi/core/platform/monitor.h (named int64 monitors)
+// and paddle/phi/core/memory/stats.h:140 (DEVICE/HOST_MEMORY_STAT
+// peak/current counters). Device memory is XLA-managed on TPU (exposed
+// via jax's device memory_stats in Python); the native piece here tracks
+// HOST memory (RSS/peak from /proc) and user-named counters with
+// min/max/sum/count aggregation.
+
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace {
+
+struct Stat {
+  int64_t sum = 0;
+  int64_t count = 0;
+  int64_t min_v = INT64_MAX;
+  int64_t max_v = INT64_MIN;
+};
+
+std::mutex g_mu;
+std::map<std::string, Stat> g_monitors;
+
+int64_t read_proc_status_kb(const char* field) {
+  std::ifstream f("/proc/self/status");
+  std::string line;
+  size_t flen = std::strlen(field);
+  while (std::getline(f, line)) {
+    if (line.compare(0, flen, field) == 0) {
+      return std::stoll(line.substr(flen + 1));
+    }
+  }
+  return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+void monitor_add(const char* name, int64_t value) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  Stat& s = g_monitors[name];
+  s.sum += value;
+  s.count += 1;
+  if (value < s.min_v) s.min_v = value;
+  if (value > s.max_v) s.max_v = value;
+}
+
+// out: [sum, count, min, max]; returns 0 on success, -1 if unknown.
+int monitor_get(const char* name, int64_t* out) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_monitors.find(name);
+  if (it == g_monitors.end()) return -1;
+  out[0] = it->second.sum;
+  out[1] = it->second.count;
+  out[2] = it->second.min_v;
+  out[3] = it->second.max_v;
+  return 0;
+}
+
+void monitor_reset(const char* name) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_monitors.erase(name);
+}
+
+int64_t host_memory_rss_bytes() {
+  int64_t kb = read_proc_status_kb("VmRSS:");
+  return kb < 0 ? -1 : kb * 1024;
+}
+
+int64_t host_memory_peak_bytes() {
+  int64_t kb = read_proc_status_kb("VmHWM:");
+  return kb < 0 ? -1 : kb * 1024;
+}
+
+}  // extern "C"

@@ -1,0 +1,295 @@
+// TCPStore — native key-value rendezvous for distributed bootstrap.
+//
+// Reference: paddle/phi/core/distributed/store/tcp_store.h:121 (TCPStore
+// over a master socket: set/get/add/wait/barrier used to exchange NCCL
+// unique ids and synchronise process groups).
+//
+// TPU-native role: the JAX coordinator handles PJRT bootstrap, but the
+// framework-level rendezvous (launcher master, elastic restarts, user
+// barriers, fleet role assignment) still needs a tiny native store — this
+// is it. Single-threaded poll loop server + blocking clients, exposed to
+// Python through a C ABI (ctypes; pybind11 is not available in this
+// image).
+//
+// Protocol (all little-endian):
+//   request : u8 op | u32 klen | key bytes | u32 vlen | value bytes
+//   response: i64 num | u32 vlen | value bytes
+//   ops: 0=SET 1=GET(blocking until key exists) 2=ADD 3=WAIT(nonblock
+//        existence check) 4=DELETE
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Server {
+  int listen_fd = -1;
+  std::thread thread;
+  std::atomic<bool> stop{false};
+  std::mutex mu;
+  std::map<std::string, std::string> data;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_resp(int fd, int64_t num, const std::string& val) {
+  uint32_t vlen = static_cast<uint32_t>(val.size());
+  if (!write_full(fd, &num, 8)) return false;
+  if (!write_full(fd, &vlen, 4)) return false;
+  if (vlen && !write_full(fd, val.data(), vlen)) return false;
+  return true;
+}
+
+// Handle one request on fd. GET on a missing key parks the connection:
+// we return false_but_keep by pushing it to the waiters list instead.
+struct Waiter {
+  int fd;
+  std::string key;
+};
+
+void serve(Server* s) {
+  std::vector<int> conns;
+  std::vector<Waiter> waiters;
+  while (!s->stop.load()) {
+    std::vector<pollfd> pfds;
+    pfds.push_back({s->listen_fd, POLLIN, 0});
+    for (int c : conns) pfds.push_back({c, POLLIN, 0});
+    int rc = ::poll(pfds.data(), pfds.size(), 100 /*ms*/);
+    if (rc < 0) break;
+
+    // retry parked GET waiters whose key appeared
+    {
+      std::lock_guard<std::mutex> lk(s->mu);
+      for (size_t i = 0; i < waiters.size();) {
+        auto it = s->data.find(waiters[i].key);
+        if (it != s->data.end()) {
+          send_resp(waiters[i].fd, 0, it->second);
+          conns.push_back(waiters[i].fd);
+          waiters.erase(waiters.begin() + i);
+        } else {
+          ++i;
+        }
+      }
+    }
+    if (rc == 0) continue;
+
+    if (pfds[0].revents & POLLIN) {
+      int c = ::accept(s->listen_fd, nullptr, nullptr);
+      if (c >= 0) {
+        int one = 1;
+        ::setsockopt(c, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        conns.push_back(c);
+      }
+    }
+    for (size_t i = 1; i < pfds.size(); ++i) {
+      if (!(pfds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+      int fd = pfds[i].fd;
+      uint8_t op;
+      uint32_t klen = 0, vlen = 0;
+      std::string key, val;
+      bool ok = read_full(fd, &op, 1) && read_full(fd, &klen, 4);
+      if (ok && klen) {
+        key.resize(klen);
+        ok = read_full(fd, key.data(), klen);
+      }
+      if (ok) ok = read_full(fd, &vlen, 4);
+      if (ok && vlen) {
+        val.resize(vlen);
+        ok = read_full(fd, val.data(), vlen);
+      }
+      auto drop = [&]() {
+        ::close(fd);
+        conns.erase(std::find(conns.begin(), conns.end(), fd));
+      };
+      if (!ok) {
+        drop();
+        continue;
+      }
+      std::lock_guard<std::mutex> lk(s->mu);
+      switch (op) {
+        case 0:  // SET
+          s->data[key] = val;
+          send_resp(fd, 0, "");
+          break;
+        case 1: {  // GET (block until present)
+          auto it = s->data.find(key);
+          if (it != s->data.end()) {
+            send_resp(fd, 0, it->second);
+          } else {
+            waiters.push_back({fd, key});
+            conns.erase(std::find(conns.begin(), conns.end(), fd));
+          }
+          break;
+        }
+        case 2: {  // ADD
+          int64_t delta = 0;
+          if (val.size() == 8) std::memcpy(&delta, val.data(), 8);
+          int64_t cur = 0;
+          auto it = s->data.find(key);
+          if (it != s->data.end() && it->second.size() == 8)
+            std::memcpy(&cur, it->second.data(), 8);
+          cur += delta;
+          std::string enc(8, '\0');
+          std::memcpy(enc.data(), &cur, 8);
+          s->data[key] = enc;
+          send_resp(fd, cur, "");
+          break;
+        }
+        case 3: {  // WAIT (existence check, nonblocking)
+          send_resp(fd, s->data.count(key) ? 1 : 0, "");
+          break;
+        }
+        case 4:  // DELETE
+          send_resp(fd, static_cast<int64_t>(s->data.erase(key)), "");
+          break;
+        default:
+          drop();
+      }
+    }
+  }
+  for (int c : conns) ::close(c);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ts_server_start(int port) {
+  auto* s = new Server();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) < 0 ||
+      ::listen(s->listen_fd, 128) < 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  s->thread = std::thread(serve, s);
+  return s;
+}
+
+void ts_server_stop(void* handle) {
+  auto* s = static_cast<Server*>(handle);
+  if (!s) return;
+  s->stop.store(true);
+  if (s->thread.joinable()) s->thread.join();
+  ::close(s->listen_fd);
+  delete s;
+}
+
+int ts_client_connect(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+      0) {
+    ::close(fd);
+    return -1;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+void ts_client_close(int fd) {
+  if (fd >= 0) ::close(fd);
+}
+
+static int64_t request(int fd, uint8_t op, const char* key, int klen,
+                       const char* val, int vlen, char* out_buf,
+                       int out_cap, int* out_len) {
+  uint32_t kl = static_cast<uint32_t>(klen);
+  uint32_t vl = static_cast<uint32_t>(vlen);
+  if (!write_full(fd, &op, 1) || !write_full(fd, &kl, 4) ||
+      (kl && !write_full(fd, key, kl)) || !write_full(fd, &vl, 4) ||
+      (vl && !write_full(fd, val, vl)))
+    return INT64_MIN;
+  int64_t num;
+  uint32_t rlen;
+  if (!read_full(fd, &num, 8) || !read_full(fd, &rlen, 4))
+    return INT64_MIN;
+  std::string resp(rlen, '\0');
+  if (rlen && !read_full(fd, resp.data(), rlen)) return INT64_MIN;
+  if (out_len) *out_len = static_cast<int>(rlen);
+  if (out_buf && out_cap > 0) {
+    std::memcpy(out_buf, resp.data(),
+                std::min<size_t>(rlen, static_cast<size_t>(out_cap)));
+  }
+  return num;
+}
+
+int64_t ts_set(int fd, const char* key, int klen, const char* val,
+               int vlen) {
+  return request(fd, 0, key, klen, val, vlen, nullptr, 0, nullptr);
+}
+
+int64_t ts_get(int fd, const char* key, int klen, char* out_buf,
+               int out_cap, int* out_len) {
+  return request(fd, 1, key, klen, nullptr, 0, out_buf, out_cap, out_len);
+}
+
+int64_t ts_add(int fd, const char* key, int klen, int64_t delta) {
+  char enc[8];
+  std::memcpy(enc, &delta, 8);
+  return request(fd, 2, key, klen, enc, 8, nullptr, 0, nullptr);
+}
+
+int64_t ts_check(int fd, const char* key, int klen) {
+  return request(fd, 3, key, klen, nullptr, 0, nullptr, 0, nullptr);
+}
+
+int64_t ts_delete(int fd, const char* key, int klen) {
+  return request(fd, 4, key, klen, nullptr, 0, nullptr, 0, nullptr);
+}
+
+}  // extern "C"

@@ -160,3 +160,6 @@ def _memory_stat(key):
 
 cuda = _CudaNamespace()
 xpu = cuda
+from . import monitor  # noqa: F401
+from .monitor import (max_memory_allocated, max_memory_reserved,  # noqa: F401
+                      memory_allocated, memory_reserved)

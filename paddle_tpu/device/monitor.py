@@ -1,0 +1,87 @@
+"""Memory stats + named monitors.
+
+Reference: paddle/phi/core/memory/stats.h:140 (peak/current memory
+stats exposed as paddle.device.cuda.max_memory_allocated etc.) and
+paddle/phi/core/platform/monitor.h (named int64 monitors). Device memory
+is XLA-managed on TPU — read through jax's per-device memory_stats;
+host RSS/peak and counters come from the native module
+(paddle_tpu/csrc/monitor.cpp).
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+from .. import csrc
+
+
+def _device_stats(device_id: int = 0) -> dict:
+    import jax
+    devs = jax.devices()
+    if device_id >= len(devs):
+        raise ValueError(f"no device {device_id}")
+    stats = devs[device_id].memory_stats()
+    return stats or {}
+
+
+def memory_allocated(device=None) -> int:
+    """Current device bytes in use (reference
+    paddle.device.cuda.memory_allocated)."""
+    return int(_device_stats(_id(device)).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    return int(_device_stats(_id(device)).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None) -> int:
+    s = _device_stats(_id(device))
+    return int(s.get("bytes_reserved", s.get("bytes_limit", 0)))
+
+
+def max_memory_reserved(device=None) -> int:
+    s = _device_stats(_id(device))
+    return int(s.get("peak_bytes_reserved", s.get("bytes_limit", 0)))
+
+
+def _id(device) -> int:
+    if device is None:
+        return 0
+    if isinstance(device, int):
+        return device
+    s = str(device)
+    return int(s.split(":")[-1]) if ":" in s else 0
+
+
+def host_memory_rss() -> int:
+    """Current host RSS bytes (native /proc reader; -1 if unavailable)."""
+    lb = csrc.lib()
+    return int(lb.host_memory_rss_bytes()) if lb else -1
+
+
+def host_memory_peak() -> int:
+    lb = csrc.lib()
+    return int(lb.host_memory_peak_bytes()) if lb else -1
+
+
+def monitor_add(name: str, value: int) -> None:
+    """Record a sample on the named monitor (reference monitor.h)."""
+    lb = csrc.lib()
+    if lb:
+        lb.monitor_add(name.encode(), int(value))
+
+
+def monitor_get(name: str) -> Optional[dict]:
+    lb = csrc.lib()
+    if not lb:
+        return None
+    out = (ctypes.c_int64 * 4)()
+    if lb.monitor_get(name.encode(), out) != 0:
+        return None
+    return {"sum": out[0], "count": out[1], "min": out[2], "max": out[3]}
+
+
+def monitor_reset(name: str) -> None:
+    lb = csrc.lib()
+    if lb:
+        lb.monitor_reset(name.encode())

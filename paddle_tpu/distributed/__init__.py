@@ -12,6 +12,7 @@ from . import checkpoint  # noqa: F401
 from . import communication  # noqa: F401
 from . import launch  # noqa: F401
 from .spawn import spawn  # noqa: F401
+from .store import TCPStore  # noqa: F401
 from . import env  # noqa: F401
 from . import fleet  # noqa: F401
 from . import mesh  # noqa: F401

@@ -1,0 +1,116 @@
+"""TCPStore — framework-level rendezvous KV store.
+
+Reference: paddle/phi/core/distributed/store/tcp_store.h:121 and the
+Python surface paddle.distributed.TCPStore. Backed by the native C++
+store (paddle_tpu/csrc/tcp_store.cpp) over a ctypes ABI; the JAX
+coordinator bootstraps PJRT, this store serves launcher/elastic/user
+rendezvous (barriers, id exchange) exactly like the reference's.
+"""
+from __future__ import annotations
+
+import ctypes
+import time
+from typing import Optional
+
+from .. import csrc
+
+
+class TCPStore:
+    """store = TCPStore(host, port, is_master, world_size, timeout)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6170,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 300.0):
+        self._lib = csrc.lib()
+        if self._lib is None:
+            raise RuntimeError(
+                "native TCPStore unavailable (g++ toolchain missing)")
+        self.host = host
+        self.port = port
+        self.is_master = is_master
+        self.world_size = world_size
+        self.timeout = timeout
+        self._server = None
+        if is_master:
+            self._server = self._lib.ts_server_start(port)
+            if not self._server:
+                raise OSError(f"TCPStore: cannot bind port {port}")
+        deadline = time.time() + timeout
+        self._fd = -1
+        while time.time() < deadline:
+            self._fd = self._lib.ts_client_connect(host.encode(), port)
+            if self._fd >= 0:
+                break
+            time.sleep(0.05)
+        if self._fd < 0:
+            raise TimeoutError(
+                f"TCPStore: cannot reach master at {host}:{port}")
+
+    # -- KV API (reference-shaped) -------------------------------------------
+    def set(self, key: str, value) -> None:
+        v = value if isinstance(value, bytes) else str(value).encode()
+        k = key.encode()
+        if self._lib.ts_set(self._fd, k, len(k), v, len(v)) == \
+                -(2 ** 63):
+            raise ConnectionError("TCPStore set failed")
+
+    def get(self, key: str) -> bytes:
+        """Blocks (server-side) until the key exists."""
+        k = key.encode()
+        cap = 1 << 20
+        buf = ctypes.create_string_buffer(cap)
+        out_len = ctypes.c_int(0)
+        rc = self._lib.ts_get(self._fd, k, len(k), buf, cap,
+                              ctypes.byref(out_len))
+        if rc == -(2 ** 63):
+            raise ConnectionError("TCPStore get failed")
+        return buf.raw[:out_len.value]
+
+    def add(self, key: str, amount: int = 1) -> int:
+        k = key.encode()
+        rc = self._lib.ts_add(self._fd, k, len(k), int(amount))
+        if rc == -(2 ** 63):
+            raise ConnectionError("TCPStore add failed")
+        return int(rc)
+
+    def check(self, key: str) -> bool:
+        k = key.encode()
+        return bool(self._lib.ts_check(self._fd, k, len(k)))
+
+    def delete_key(self, key: str) -> bool:
+        k = key.encode()
+        return bool(self._lib.ts_delete(self._fd, k, len(k)))
+
+    def wait(self, keys, timeout: Optional[float] = None) -> None:
+        deadline = time.time() + (timeout or self.timeout)
+        for key in ([keys] if isinstance(keys, str) else keys):
+            while not self.check(key):
+                if time.time() > deadline:
+                    raise TimeoutError(f"TCPStore wait({key!r}) timed out")
+                time.sleep(0.02)
+
+    def barrier(self, name: str = "barrier",
+                timeout: Optional[float] = None) -> None:
+        """All world_size clients rendezvous (reference barrier via add)."""
+        n = self.add(f"__barrier/{name}", 1)
+        deadline = time.time() + (timeout or self.timeout)
+        while n < self.world_size:
+            if time.time() > deadline:
+                raise TimeoutError(f"barrier {name!r} timed out at {n}/"
+                                   f"{self.world_size}")
+            time.sleep(0.02)
+            n = self.add(f"__barrier/{name}", 0)
+
+    def close(self):
+        if self._fd >= 0:
+            self._lib.ts_client_close(self._fd)
+            self._fd = -1
+        if self._server:
+            self._lib.ts_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

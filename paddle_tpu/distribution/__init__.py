@@ -1,0 +1,4 @@
+from .distributions import (  # noqa: F401
+    Bernoulli, Beta, Categorical, Dirichlet, Distribution, Exponential,
+    Gamma, Gumbel, Laplace, LogNormal, Multinomial, Normal, Poisson,
+    TransformedDistribution, Uniform, kl_divergence, register_kl)

@@ -1,0 +1,429 @@
+"""paddle.distribution parity surface (reference
+python/paddle/distribution: ~20 distributions + KL registry, 9.3 K LoC).
+
+TPU-native: sampling through the framework RNG (core.random keys) and
+log-probs as pure jnp math (differentiable via the tape)."""
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as random_mod
+from ..core.dispatch import run_op, unwrap, wrap
+
+
+def _arr(x):
+    return jnp.asarray(unwrap(x))
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return run_op("exp", jnp.exp, [self.log_prob(value)])
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return wrap(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = tuple(shape) + self.batch_shape
+        return wrap(self.loc + self.scale * jax.random.normal(
+            key, shp, jnp.result_type(self.loc.dtype, jnp.float32)))
+
+    def log_prob(self, value):
+        def fn(v):
+            var = self.scale ** 2
+            return (-((v - self.loc) ** 2) / (2 * var)
+                    - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+        return run_op("normal_log_prob", fn, [value])
+
+    def entropy(self):
+        return wrap(0.5 + 0.5 * math.log(2 * math.pi)
+                    + jnp.log(self.scale)
+                    + jnp.zeros(self.batch_shape))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.base = Normal(loc, scale)
+        super().__init__(self.base.batch_shape)
+
+    def sample(self, shape=()):
+        return run_op("exp", jnp.exp, [self.base.sample(shape)])
+
+    def log_prob(self, value):
+        def fn(v):
+            lv = jnp.log(v)
+            var = self.base.scale ** 2
+            return (-((lv - self.base.loc) ** 2) / (2 * var)
+                    - jnp.log(self.base.scale) - lv
+                    - 0.5 * math.log(2 * math.pi))
+        return run_op("lognormal_log_prob", fn, [value])
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = tuple(shape) + self.batch_shape
+        return wrap(jax.random.uniform(
+            key, shp, minval=self.low, maxval=self.high))
+
+    def log_prob(self, value):
+        def fn(v):
+            inside = (v >= self.low) & (v < self.high)
+            return jnp.where(inside, -jnp.log(self.high - self.low),
+                             -jnp.inf)
+        return run_op("uniform_log_prob", fn, [value])
+
+    def entropy(self):
+        return wrap(jnp.log(self.high - self.low)
+                    + jnp.zeros(self.batch_shape))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is not None:
+            self.probs = _arr(probs)
+        else:
+            self.probs = jax.nn.sigmoid(_arr(logits))
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return wrap(self.probs)
+
+    @property
+    def variance(self):
+        return wrap(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = tuple(shape) + self.batch_shape
+        return wrap(jax.random.bernoulli(
+            key, self.probs, shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def fn(v):
+            p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+        return run_op("bernoulli_log_prob", fn, [value])
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return wrap(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None:
+            self.logits = _arr(logits)
+        else:
+            self.logits = jnp.log(jnp.clip(_arr(probs), 1e-12))
+        self.logits = self.logits - jax.scipy.special.logsumexp(
+            self.logits, axis=-1, keepdims=True)
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return wrap(jnp.exp(self.logits))
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = tuple(shape) + self.batch_shape
+        return wrap(jax.random.categorical(key, self.logits, shape=shp))
+
+    def log_prob(self, value):
+        def fn(v):
+            return jnp.take_along_axis(
+                self.logits, v.astype(jnp.int32)[..., None],
+                axis=-1)[..., 0]
+        return run_op("categorical_log_prob", fn, [value])
+
+    def entropy(self):
+        p = jnp.exp(self.logits)
+        return wrap(-jnp.sum(p * self.logits, axis=-1))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = tuple(shape) + self.batch_shape
+        return wrap(jax.random.exponential(key, shp) / self.rate)
+
+    def log_prob(self, value):
+        return run_op("exponential_log_prob",
+                      lambda v: jnp.log(self.rate) - self.rate * v,
+                      [value])
+
+    def entropy(self):
+        return wrap(1.0 - jnp.log(self.rate))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _arr(concentration)
+        self.rate = _arr(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = tuple(shape) + self.batch_shape
+        return wrap(jax.random.gamma(key, self.concentration, shp)
+                    / self.rate)
+
+    def log_prob(self, value):
+        def fn(v):
+            a, b = self.concentration, self.rate
+            return (a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                    - jax.scipy.special.gammaln(a))
+        return run_op("gamma_log_prob", fn, [value])
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        k1, k2 = jax.random.split(key)
+        shp = tuple(shape) + self.batch_shape
+        x = jax.random.gamma(k1, self.alpha, shp)
+        y = jax.random.gamma(k2, self.beta, shp)
+        return wrap(x / (x + y))
+
+    def log_prob(self, value):
+        def fn(v):
+            a, b = self.alpha, self.beta
+            lbeta = (jax.scipy.special.gammaln(a)
+                     + jax.scipy.special.gammaln(b)
+                     - jax.scipy.special.gammaln(a + b))
+            return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta
+        return run_op("beta_log_prob", fn, [value])
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _arr(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = tuple(shape) + self.batch_shape
+        return wrap(jax.random.dirichlet(key, self.concentration, shp))
+
+    def log_prob(self, value):
+        def fn(v):
+            a = self.concentration
+            lnorm = (jnp.sum(jax.scipy.special.gammaln(a), axis=-1)
+                     - jax.scipy.special.gammaln(jnp.sum(a, axis=-1)))
+            return jnp.sum((a - 1) * jnp.log(v), axis=-1) - lnorm
+        return run_op("dirichlet_log_prob", fn, [value])
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = tuple(shape) + self.batch_shape
+        return wrap(self.loc + self.scale * jax.random.laplace(key, shp))
+
+    def log_prob(self, value):
+        def fn(v):
+            return (-jnp.abs(v - self.loc) / self.scale
+                    - jnp.log(2 * self.scale))
+        return run_op("laplace_log_prob", fn, [value])
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = tuple(shape) + self.batch_shape
+        return wrap(self.loc + self.scale * jax.random.gumbel(key, shp))
+
+    def log_prob(self, value):
+        def fn(v):
+            z = (v - self.loc) / self.scale
+            return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+        return run_op("gumbel_log_prob", fn, [value])
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = tuple(shape) + self.batch_shape
+        return wrap(jax.random.poisson(key, self.rate, shp))
+
+    def log_prob(self, value):
+        def fn(v):
+            return (v * jnp.log(self.rate) - self.rate
+                    - jax.scipy.special.gammaln(v + 1))
+        return run_op("poisson_log_prob", fn, [value])
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_a = _arr(probs)
+        super().__init__(self.probs_a.shape[:-1],
+                         self.probs_a.shape[-1:])
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        cat = jax.random.categorical(
+            key, jnp.log(jnp.clip(self.probs_a, 1e-12)),
+            shape=tuple(shape) + (self.total_count,) + self.batch_shape)
+        k = self.probs_a.shape[-1]
+        onehot = jax.nn.one_hot(cat, k)
+        return wrap(jnp.sum(onehot, axis=len(shape)))
+
+    def log_prob(self, value):
+        def fn(v):
+            logp = jnp.log(jnp.clip(self.probs_a, 1e-12))
+            coef = (jax.scipy.special.gammaln(
+                jnp.sum(v, -1) + 1)
+                - jnp.sum(jax.scipy.special.gammaln(v + 1), -1))
+            return coef + jnp.sum(v * logp, axis=-1)
+        return run_op("multinomial_log_prob", fn, [value])
+
+
+class TransformedDistribution(Distribution):
+    """Minimal transformed distribution (reference
+    distribution/transformed_distribution.py): forward-sample through a
+    callable with a given inverse + log|det J|."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = transforms if isinstance(transforms, list) \
+            else [transforms]
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+
+# -- KL registry -------------------------------------------------------------
+
+_KL_REGISTRY: Dict[Tuple[Type, Type], callable] = {}
+
+
+def register_kl(p_cls, q_cls):
+    """Decorator (reference distribution/kl.py register_kl)."""
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return wrap(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    return wrap(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+    qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+    return wrap(pp * jnp.log(pp / qq)
+                + (1 - pp) * jnp.log((1 - pp) / (1 - qq)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    pp = jnp.exp(p.logits)
+    return wrap(jnp.sum(pp * (p.logits - q.logits), axis=-1))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential_exponential(p, q):
+    ratio = q.rate / p.rate
+    return wrap(jnp.log(p.rate / q.rate) + ratio - 1)

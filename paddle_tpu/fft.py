@@ -95,7 +95,11 @@ def fft_r2c(x, axes, normalization="backward", forward=True, onesided=True,
 
 def fft_c2r(x, axes, normalization="backward", forward=True, last_dim_size=0,
             name=None):
-    s = None if not last_dim_size else None
-    return run_op("fft_c2r",
-                  lambda a: jnp.fft.irfftn(a, axes=tuple(axes),
-                                           norm=_norm(normalization)), [x])
+    def fn(a):
+        s = None
+        if last_dim_size:
+            s = [a.shape[ax] for ax in axes]
+            s[-1] = int(last_dim_size)
+        return jnp.fft.irfftn(a, s=s, axes=tuple(axes),
+                              norm=_norm(normalization))
+    return run_op("fft_c2r", fn, [x])

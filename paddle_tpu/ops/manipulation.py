@@ -782,26 +782,34 @@ def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
 
 
 def frame(x, frame_length, hop_length, axis=-1, name=None):
-    """Sliding-window framing (reference ops.yaml: frame)."""
+    """Sliding-window framing (reference ops.yaml: frame). Layout matches
+    paddle: axis=-1 -> [..., frame_length, num_frames]; axis=0 ->
+    [num_frames, frame_length, ...]."""
     def fn(a):
-        a_m = jnp.moveaxis(a, axis, -1)
+        last = axis in (-1, a.ndim - 1)
+        a_m = a if last else jnp.moveaxis(a, 0, -1)
         n = a_m.shape[-1]
         num = 1 + (n - frame_length) // hop_length
         starts = jnp.arange(num) * hop_length
         idx = starts[:, None] + jnp.arange(frame_length)[None, :]
         out = a_m[..., idx]              # [..., num, frame_length]
-        out = jnp.swapaxes(out, -1, -2)  # [..., frame_length, num]
-        return out if axis in (-1, a.ndim - 1) else \
-            jnp.moveaxis(out, -1, axis)
+        if last:
+            return jnp.swapaxes(out, -1, -2)  # [..., fl, num]
+        # [..., num, fl] -> [num, fl, ...]
+        return jnp.moveaxis(jnp.moveaxis(out, -2, 0), -1, 1)
     return run_op("frame", fn, [x])
 
 
 def overlap_add(x, hop_length, axis=-1, name=None):
-    """Inverse of frame (reference ops.yaml: overlap_add)."""
+    """Inverse of frame (reference ops.yaml: overlap_add). Input layout
+    matches frame's output for the same axis."""
     def fn(a):
-        a_m = jnp.moveaxis(a, axis, -1) if axis not in (-1, a.ndim - 1) \
-            else a
-        # [..., frame_length, num]
+        last = axis in (-1, a.ndim - 1)
+        if last:
+            a_m = a                       # [..., frame_length, num]
+        else:
+            # [num, frame_length, ...] -> [..., frame_length, num]
+            a_m = jnp.moveaxis(jnp.moveaxis(a, 0, -1), 0, -2)
         frame_length = a_m.shape[-2]
         num = a_m.shape[-1]
         out_len = (num - 1) * hop_length + frame_length
@@ -810,7 +818,7 @@ def overlap_add(x, hop_length, axis=-1, name=None):
             seg = a_m[..., :, i]
             out = out.at[..., i * hop_length:
                          i * hop_length + frame_length].add(seg)
-        return out
+        return out if last else jnp.moveaxis(out, -1, 0)
     return run_op("overlap_add", fn, [x])
 
 

@@ -47,11 +47,21 @@ def fake_channel_wise_quantize_dequantize_abs_max(x, bit_length=8,
                   [x])
 
 
+def _axis_shape(a, s, quant_axis):
+    """Reshape a per-channel scale so it broadcasts along quant_axis."""
+    if s.ndim == 0 or quant_axis is None:
+        return s
+    shape = [1] * a.ndim
+    shape[quant_axis % a.ndim] = s.shape[0]
+    return s.reshape(shape)
+
+
 def quantize_linear(x, scale, zero_point=0.0, bit_length=8, quant_axis=-1,
                     name=None):
     qmax = _qparams(bit_length)
 
     def fn(a, s):
+        s = _axis_shape(a, s, quant_axis)
         return jnp.clip(jnp.round(a / s + zero_point), -qmax - 1, qmax)
     return run_op("quantize_linear", fn, [x, scale])
 
@@ -59,5 +69,6 @@ def quantize_linear(x, scale, zero_point=0.0, bit_length=8, quant_axis=-1,
 def dequantize_linear(x, scale, zero_point=0.0, bit_length=8,
                       quant_axis=-1, name=None):
     def fn(a, s):
+        s = _axis_shape(a, s, quant_axis)
         return (a - zero_point) * s
     return run_op("dequantize_linear", fn, [x, scale])

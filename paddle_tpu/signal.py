@@ -28,9 +28,11 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None,
         starts = jnp.arange(num) * hop
         idx = starts[:, None] + jnp.arange(n_fft)[None, :]
         frames = v[..., idx]                      # [..., num, n_fft]
-        # keep the window in the signal dtype: under x64 a float64
+        # window centered in the n_fft buffer (reference/librosa
+        # convention), kept in the signal dtype: under x64 a float64
         # window promotes the spectrum to complex128, unsupported on TPU
-        w = jnp.zeros(n_fft, a.dtype).at[:wl].set(
+        off = (n_fft - wl) // 2
+        w = jnp.zeros(n_fft, a.dtype).at[off:off + wl].set(
             jnp.asarray(win, a.dtype))
         spec = jnp.fft.rfft(frames * w, axis=-1) if onesided else \
             jnp.fft.fft(frames * w, axis=-1)
@@ -51,7 +53,8 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
         spec = jnp.swapaxes(a, -1, -2)            # [..., num, freq]
         frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided else \
             jnp.fft.ifft(spec, axis=-1).real
-        w = jnp.zeros(n_fft, frames.dtype).at[:wl].set(
+        off = (n_fft - wl) // 2
+        w = jnp.zeros(n_fft, frames.dtype).at[off:off + wl].set(
             jnp.asarray(win, frames.dtype))
         if normalized:
             frames = frames * jnp.sqrt(jnp.sum(w ** 2))

@@ -1,0 +1,149 @@
+"""paddle.sparse parity surface (reference python/paddle/sparse: COO/CSR
+creation + unary/binary/matmul/nn ops; N1 SparseCooTensor
+paddle/phi/core/sparse_coo_tensor.h:33).
+
+TPU-native: backed by jax.experimental.sparse.BCOO — XLA's batched-COO
+format with compiled scatter/gather kernels. The SparseTensor wrapper
+keeps the paddle API (indices()/values()/to_dense()).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.dispatch import unwrap, wrap
+from ..core.tensor import Tensor
+
+
+class SparseTensor:
+    """COO sparse tensor (reference SparseCooTensor)."""
+
+    def __init__(self, bcoo: jsparse.BCOO, fmt: str = "coo"):
+        self._bcoo = bcoo
+        self._fmt = fmt
+
+    # -- paddle surface ------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    def indices(self) -> Tensor:
+        return wrap(self._bcoo.indices.T)  # paddle: [ndim, nnz]
+
+    def values(self) -> Tensor:
+        return wrap(self._bcoo.data)
+
+    def nnz(self) -> int:
+        return int(self._bcoo.nse)
+
+    def to_dense(self) -> Tensor:
+        return wrap(self._bcoo.todense())
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return self._fmt == "coo"
+
+    def is_sparse_csr(self):
+        return self._fmt == "csr"
+
+    def __repr__(self):
+        return (f"SparseTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"format={self._fmt})")
+
+    # -- arithmetic ----------------------------------------------------------
+    def __add__(self, other):
+        if isinstance(other, SparseTensor):
+            return SparseTensor(self._bcoo + other._bcoo)
+        return wrap(self._bcoo.todense() + unwrap(other))
+
+    def __mul__(self, other):
+        if isinstance(other, SparseTensor):
+            return SparseTensor(jsparse.bcoo_multiply_sparse(
+                self._bcoo, other._bcoo))
+        o = jnp.asarray(unwrap(other))
+        if o.ndim == 0:  # scalar scales the stored values, stays sparse
+            return SparseTensor(
+                jsparse.BCOO((self._bcoo.data * o, self._bcoo.indices),
+                             shape=self._bcoo.shape), self._fmt)
+        return wrap(self._bcoo.todense() * o)
+
+    def matmul(self, other):
+        return matmul(self, other)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """Reference: paddle.sparse.sparse_coo_tensor (indices [ndim, nnz])."""
+    idx = jnp.asarray(unwrap(indices)).T  # BCOO wants [nnz, ndim]
+    vals = jnp.asarray(unwrap(values))
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in jnp.max(idx, axis=0))
+    return SparseTensor(jsparse.BCOO((vals, idx), shape=tuple(shape)))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    """CSR creation — stored as BCOO internally (format metadata kept)."""
+    crows_a = np.asarray(unwrap(crows))
+    cols_a = np.asarray(unwrap(cols))
+    vals = jnp.asarray(unwrap(values))
+    rows = np.repeat(np.arange(len(crows_a) - 1),
+                     np.diff(crows_a))
+    idx = jnp.asarray(np.stack([rows, cols_a], axis=1))
+    st = SparseTensor(jsparse.BCOO((vals, idx), shape=tuple(shape)),
+                      fmt="csr")
+    return st
+
+
+def is_sparse(x):
+    return isinstance(x, SparseTensor)
+
+
+def to_dense(x: SparseTensor) -> Tensor:
+    return x.to_dense()
+
+
+def to_sparse_coo(x, sparse_dim=None) -> SparseTensor:
+    a = unwrap(x)
+    return SparseTensor(jsparse.BCOO.fromdense(a))
+
+
+def matmul(x: SparseTensor, y):
+    """Sparse @ dense (reference paddle.sparse.matmul)."""
+    other = unwrap(y) if not isinstance(y, SparseTensor) else \
+        y._bcoo.todense()
+    return wrap(x._bcoo @ other)
+
+
+def add(x: SparseTensor, y: SparseTensor):
+    return SparseTensor(x._bcoo + y._bcoo)
+
+
+def multiply(x: SparseTensor, y: SparseTensor):
+    return SparseTensor(jsparse.bcoo_multiply_sparse(x._bcoo, y._bcoo))
+
+
+def _unary(name, fn):
+    def op(x: SparseTensor):
+        return SparseTensor(jsparse.BCOO((fn(x._bcoo.data),
+                                          x._bcoo.indices),
+                                         shape=x._bcoo.shape), x._fmt)
+    op.__name__ = name
+    return op
+
+
+relu = _unary("relu", lambda d: jnp.maximum(d, 0))
+abs = _unary("abs", jnp.abs)  # noqa: A001
+sin = _unary("sin", jnp.sin)
+tanh = _unary("tanh", jnp.tanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+neg = _unary("neg", jnp.negative)

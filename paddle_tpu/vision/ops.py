@@ -16,6 +16,17 @@ import numpy as np
 from ..core.dispatch import run_op, run_op_nodiff, unwrap
 
 
+def _roi_batch_indices(boxes, boxes_num):
+    """Per-RoI batch image index from boxes_num (reference roi_align
+    convention: the first boxes_num[0] rois belong to image 0, ...)."""
+    n_rois = int(unwrap(boxes).shape[0])
+    if boxes_num is None:
+        return jnp.zeros((n_rois,), jnp.int32)
+    counts = np.asarray(unwrap(boxes_num)).astype(np.int64).reshape(-1)
+    return jnp.asarray(np.repeat(np.arange(len(counts)), counts),
+                       jnp.int32)
+
+
 def _iou_matrix(boxes):
     x1, y1, x2, y2 = [boxes[:, i] for i in range(4)]
     area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
@@ -61,15 +72,16 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     """RoIAlign via bilinear grid sampling (reference ops.yaml: roi_align)."""
     out_h, out_w = (output_size if isinstance(output_size, (tuple, list))
                     else (output_size, output_size))
+    batch_idx = _roi_batch_indices(boxes, boxes_num)
 
     def fn(feat, rois):
-        # feat: [N, C, H, W] (assume all rois on batch 0 slice per
-        # boxes_num convention flattened upstream); rois: [R, 4]
+        # feat: [N, C, H, W]; rois: [R, 4]; each RoI reads its own
+        # image's features (batch assignment from boxes_num)
         c, h, w = feat.shape[1:]
         off = 0.5 if aligned else 0.0
         ratio = sampling_ratio if sampling_ratio > 0 else 2
 
-        def one_roi(roi):
+        def one_roi(roi, bidx):
             x1, y1, x2, y2 = roi * spatial_scale - off
             rw = jnp.maximum(x2 - x1, 1e-6)
             rh = jnp.maximum(y2 - y1, 1e-6)
@@ -79,6 +91,12 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                 out_w * ratio)
 
             def sample(py, px):
+                # reference border semantics (roi_align kernel): points
+                # beyond (-1, size) contribute 0; points in (-1, 0) clamp
+                # to the first pixel
+                inside = (py > -1.0) & (py < h) & (px > -1.0) & (px < w)
+                py = jnp.clip(py, 0.0, h - 1)
+                px = jnp.clip(px, 0.0, w - 1)
                 y0 = jnp.floor(py).astype(jnp.int32)
                 x0 = jnp.floor(px).astype(jnp.int32)
                 wy = py - y0
@@ -87,14 +105,12 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                 def g(yy, xx):
                     yc = jnp.clip(yy, 0, h - 1)
                     xc = jnp.clip(xx, 0, w - 1)
-                    v = feat[0, :, yc, xc]
-                    ok = (yy >= 0) & (yy <= h - 1) & (xx >= 0) & \
-                        (xx <= w - 1)
-                    return v * ok
-                return (g(y0, x0) * (1 - wy) * (1 - wx)
-                        + g(y0, x0 + 1) * (1 - wy) * wx
-                        + g(y0 + 1, x0) * wy * (1 - wx)
-                        + g(y0 + 1, x0 + 1) * wy * wx)
+                    return feat[bidx, :, yc, xc]
+                val = (g(y0, x0) * (1 - wy) * (1 - wx)
+                       + g(y0, x0 + 1) * (1 - wy) * wx
+                       + g(y0 + 1, x0) * wy * (1 - wx)
+                       + g(y0 + 1, x0 + 1) * wy * wx)
+                return val * inside
 
             grid = jax.vmap(lambda py: jax.vmap(
                 lambda px: sample(py, px))(xs))(ys)
@@ -102,7 +118,7 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
             grid = grid.reshape(out_h, ratio, out_w, ratio, c)
             return jnp.mean(grid, axis=(1, 3)).transpose(2, 0, 1)
 
-        return jax.vmap(one_roi)(rois)  # [R, C, out_h, out_w]
+        return jax.vmap(one_roi)(rois, batch_idx)  # [R, C, oh, ow]
     return run_op("roi_align", fn, [x, boxes])
 
 
@@ -112,11 +128,12 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     dense-sampled max (static shapes)."""
     out_h, out_w = (output_size if isinstance(output_size, (tuple, list))
                     else (output_size, output_size))
+    batch_idx = _roi_batch_indices(boxes, boxes_num)
 
     def fn(feat, rois):
         c, h, w = feat.shape[1:]
 
-        def one_roi(roi):
+        def one_roi(roi, bidx):
             x1, y1, x2, y2 = jnp.round(roi * spatial_scale)
             rw = jnp.maximum(x2 - x1 + 1, 1.0)
             rh = jnp.maximum(y2 - y1 + 1, 1.0)
@@ -127,11 +144,11 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                 out_w * ratio)
             yi = jnp.clip(ys.astype(jnp.int32), 0, h - 1)
             xi = jnp.clip(xs.astype(jnp.int32), 0, w - 1)
-            patch = feat[0][:, yi][:, :, xi]
+            patch = feat[bidx][:, yi][:, :, xi]
             patch = patch.reshape(c, out_h, ratio, out_w, ratio)
             return jnp.max(patch, axis=(2, 4))
 
-        return jax.vmap(one_roi)(rois)
+        return jax.vmap(one_roi)(rois, batch_idx)
     return run_op("roi_pool", fn, [x, boxes])
 
 

@@ -1,0 +1,117 @@
+"""Sparse tensor + probability distribution tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sparse
+import paddle_tpu.distribution as D
+
+
+def test_sparse_coo_roundtrip():
+    indices = paddle.to_tensor(np.array([[0, 1, 2], [1, 2, 0]]))
+    values = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    st = sparse.sparse_coo_tensor(indices, values, [3, 3])
+    assert st.nnz() == 3 and st.shape == [3, 3]
+    dense = np.asarray(st.to_dense().numpy())
+    want = np.zeros((3, 3), np.float32)
+    want[0, 1], want[1, 2], want[2, 0] = 1, 2, 3
+    np.testing.assert_array_equal(dense, want)
+    back = sparse.to_sparse_coo(paddle.to_tensor(want))
+    np.testing.assert_array_equal(np.asarray(back.to_dense().numpy()),
+                                  want)
+
+
+def test_sparse_csr_and_matmul():
+    crows = paddle.to_tensor(np.array([0, 1, 2, 3]))
+    cols = paddle.to_tensor(np.array([1, 2, 0]))
+    values = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    st = sparse.sparse_csr_tensor(crows, cols, values, [3, 3])
+    assert st.is_sparse_csr()
+    d = paddle.to_tensor(np.eye(3, dtype=np.float32))
+    out = np.asarray(sparse.matmul(st, d).numpy())
+    np.testing.assert_array_equal(out, np.asarray(st.to_dense().numpy()))
+
+
+def test_sparse_elementwise_and_unary():
+    a = sparse.to_sparse_coo(paddle.to_tensor(
+        np.array([[0, -1.0], [2.0, 0]], np.float32)))
+    r = sparse.relu(a)
+    np.testing.assert_array_equal(np.asarray(r.to_dense().numpy()),
+                                  [[0, 0], [2, 0]])
+    s = a * 2.0
+    np.testing.assert_array_equal(np.asarray(s.to_dense().numpy()),
+                                  [[0, -2], [4, 0]])
+
+
+def test_normal_distribution():
+    paddle.seed(0)
+    n = D.Normal(0.0, 1.0)
+    s = n.sample([10000])
+    assert abs(float(s.numpy().mean())) < 0.05
+    lp = float(n.log_prob(paddle.to_tensor(0.0)).numpy())
+    assert lp == pytest.approx(-0.9189385, rel=1e-5)
+    kl = float(D.kl_divergence(n, D.Normal(1.0, 1.0)).numpy())
+    assert kl == pytest.approx(0.5, rel=1e-5)
+
+
+def test_categorical_and_bernoulli():
+    paddle.seed(1)
+    c = D.Categorical(probs=paddle.to_tensor(
+        np.array([0.2, 0.8], np.float32)))
+    s = np.asarray(c.sample([5000]).numpy())
+    assert 0.7 < s.mean() < 0.9
+    lp = float(c.log_prob(paddle.to_tensor(np.array(1))).numpy())
+    assert lp == pytest.approx(np.log(0.8), rel=1e-4)
+    b = D.Bernoulli(probs=paddle.to_tensor(np.array(0.3, np.float32)))
+    assert float(b.entropy().numpy()) == pytest.approx(
+        -(0.3 * np.log(0.3) + 0.7 * np.log(0.7)), rel=1e-5)
+
+
+def test_gamma_beta_laplace_logprobs():
+    g = D.Gamma(2.0, 3.0)
+    x = paddle.to_tensor(np.array(0.5, np.float32))
+    from scipy import stats
+    assert float(g.log_prob(x).numpy()) == pytest.approx(
+        stats.gamma.logpdf(0.5, 2.0, scale=1 / 3.0), rel=1e-4)
+    be = D.Beta(2.0, 2.0)
+    assert float(be.log_prob(x).numpy()) == pytest.approx(
+        stats.beta.logpdf(0.5, 2, 2), rel=1e-4)
+    la = D.Laplace(0.0, 1.0)
+    assert float(la.log_prob(x).numpy()) == pytest.approx(
+        stats.laplace.logpdf(0.5), rel=1e-4)
+
+
+def test_log_prob_differentiable():
+    paddle.seed(2)
+    x = paddle.to_tensor(np.array(0.5, np.float32))
+    x.stop_gradient = False
+    n = D.Normal(0.0, 1.0)
+    lp = n.log_prob(x)
+    lp.backward()
+    assert float(x.grad.numpy()) == pytest.approx(-0.5, rel=1e-5)
+
+
+def test_roi_align_batch_assignment():
+    """RoIs must read their own image's features (review regression)."""
+    from paddle_tpu.vision.ops import roi_align
+    feat = np.zeros((2, 1, 4, 4), np.float32)
+    feat[1] = 7.0  # image 1 is constant 7
+    x = paddle.to_tensor(feat)
+    boxes = paddle.to_tensor(np.array([[0, 0, 3, 3], [0, 0, 3, 3]],
+                                      np.float32))
+    bn = paddle.to_tensor(np.array([1, 1]))
+    out = np.asarray(roi_align(x, boxes, bn, output_size=2).numpy())
+    assert out[0].max() == 0.0
+    np.testing.assert_allclose(out[1], 7.0)
+
+
+def test_quantize_linear_per_channel_axis0():
+    from paddle_tpu.quantization import dequantize_linear, quantize_linear
+    w = paddle.to_tensor(np.array([[1.0, 2.0], [10.0, 20.0]], np.float32))
+    scale = paddle.to_tensor(np.array([0.1, 1.0], np.float32))
+    q = quantize_linear(w, scale, quant_axis=0)
+    np.testing.assert_allclose(np.asarray(q.numpy()),
+                               [[10, 20], [10, 20]])
+    back = dequantize_linear(q, scale, quant_axis=0)
+    np.testing.assert_allclose(np.asarray(back.numpy()),
+                               np.asarray(w.numpy()))
