@@ -77,6 +77,8 @@ from . import sparse  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402
 from . import geometric  # noqa: F401,E402
 from . import signal  # noqa: F401,E402
+from . import inference  # noqa: F401,E402
+from . import cost_model  # noqa: F401,E402
 
 
 def disable_static(place=None):

@@ -1,0 +1,73 @@
+"""Op-level cost model (reference python/paddle/cost_model +
+static_op_benchmark.json profiled latency table, consumed by the
+auto-parallel planner).
+
+TPU-native: instead of a shipped V100 latency table, costs are derived
+from an analytic roofline (FLOPs / peak vs bytes / bandwidth, per device
+kind) and can be calibrated in place by timing compiled ops on the real
+chip (`CostModel.profile_op`)."""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+_CHIP = {
+    # device_kind: (peak bf16 FLOP/s, HBM bytes/s)
+    "TPU v5 lite": (197e12, 819e9),
+    "TPU v4": (275e12, 1228e9),
+    "TPU v5p": (459e12, 2765e9),
+    "TPU v6 lite": (918e12, 1640e9),
+}
+
+
+class CostModel:
+    def __init__(self, device_kind: Optional[str] = None):
+        if device_kind is None:
+            try:
+                import jax
+                device_kind = jax.devices()[0].device_kind
+            except Exception:
+                device_kind = "TPU v5 lite"
+        self.device_kind = device_kind
+        self.peak_flops, self.hbm_bw = _CHIP.get(
+            device_kind, _CHIP["TPU v5 lite"])
+        self._measured: Dict[str, float] = {}
+
+    # -- analytic roofline ---------------------------------------------------
+    def matmul_time(self, m: int, n: int, k: int,
+                    dtype_bytes: int = 2) -> float:
+        flops = 2.0 * m * n * k
+        bytes_moved = dtype_bytes * (m * k + k * n + m * n)
+        return max(flops / self.peak_flops, bytes_moved / self.hbm_bw)
+
+    def elementwise_time(self, numel: int, n_operands: int = 2,
+                         dtype_bytes: int = 4) -> float:
+        return numel * n_operands * dtype_bytes / self.hbm_bw
+
+    def collective_time(self, bytes_per_chip: int, n_chips: int,
+                        ici_bw: float = 45e9,
+                        kind: str = "all_reduce") -> float:
+        if n_chips <= 1:
+            return 0.0
+        factor = {"all_reduce": 2.0, "all_gather": 1.0,
+                  "reduce_scatter": 1.0, "all_to_all": 1.0}.get(kind, 2.0)
+        return factor * bytes_per_chip * (n_chips - 1) / (
+            n_chips * ici_bw)
+
+    # -- in-place calibration ------------------------------------------------
+    def profile_op(self, name: str, fn, *args, iters: int = 20) -> float:
+        """Time a compiled op on the live backend and remember it."""
+        import jax
+        jfn = jax.jit(fn)
+        out = jfn(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = jfn(*args)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / iters
+        self._measured[name] = dt
+        return dt
+
+    def get_cost(self, name: str) -> Optional[float]:
+        return self._measured.get(name)

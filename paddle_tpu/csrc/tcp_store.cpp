@@ -88,8 +88,19 @@ void serve(Server* s) {
     std::vector<pollfd> pfds;
     pfds.push_back({s->listen_fd, POLLIN, 0});
     for (int c : conns) pfds.push_back({c, POLLIN, 0});
+    // parked GET waiters are polled too so a hangup is detected and the
+    // fd reclaimed (a parked client should never send)
+    size_t waiter_base = pfds.size();
+    for (const Waiter& w : waiters) pfds.push_back({w.fd, POLLIN, 0});
     int rc = ::poll(pfds.data(), pfds.size(), 100 /*ms*/);
     if (rc < 0) break;
+    for (size_t i = pfds.size(); i-- > waiter_base;) {
+      if (pfds[i].revents & (POLLIN | POLLHUP | POLLERR)) {
+        size_t wi = i - waiter_base;
+        ::close(waiters[wi].fd);
+        waiters.erase(waiters.begin() + static_cast<long>(wi));
+      }
+    }
 
     // retry parked GET waiters whose key appeared
     {
@@ -182,6 +193,7 @@ void serve(Server* s) {
     }
   }
   for (int c : conns) ::close(c);
+  for (const Waiter& w : waiters) ::close(w.fd);
 }
 
 }  // namespace

@@ -113,19 +113,23 @@ class _CudaNamespace:
 
     @staticmethod
     def max_memory_allocated(device=None):
-        return _memory_stat("peak_bytes_in_use")
+        from .monitor import max_memory_allocated as f
+        return f(device)
 
     @staticmethod
     def max_memory_reserved(device=None):
-        return _memory_stat("peak_bytes_in_use")
+        from .monitor import max_memory_reserved as f
+        return f(device)
 
     @staticmethod
     def memory_allocated(device=None):
-        return _memory_stat("bytes_in_use")
+        from .monitor import memory_allocated as f
+        return f(device)
 
     @staticmethod
     def memory_reserved(device=None):
-        return _memory_stat("bytes_in_use")
+        from .monitor import memory_reserved as f
+        return f(device)
 
     @staticmethod
     def empty_cache():
@@ -151,11 +155,8 @@ class _CudaNamespace:
 
 
 def _memory_stat(key):
-    try:
-        stats = jax.devices()[0].memory_stats()
-        return int(stats.get(key, 0)) if stats else 0
-    except Exception:
-        return 0
+    from .monitor import _device_stats
+    return int(_device_stats(0).get(key, 0))
 
 
 cuda = _CudaNamespace()

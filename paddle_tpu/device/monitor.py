@@ -16,12 +16,16 @@ from .. import csrc
 
 
 def _device_stats(device_id: int = 0) -> dict:
+    """THE device memory-stats reader (device/__init__._memory_stat and
+    the cuda namespace delegate here — one key mapping, one behavior)."""
     import jax
-    devs = jax.devices()
-    if device_id >= len(devs):
-        raise ValueError(f"no device {device_id}")
-    stats = devs[device_id].memory_stats()
-    return stats or {}
+    try:
+        devs = jax.devices()
+        if device_id >= len(devs):
+            return {}
+        return devs[device_id].memory_stats() or {}
+    except Exception:
+        return {}
 
 
 def memory_allocated(device=None) -> int:
@@ -36,12 +40,13 @@ def max_memory_allocated(device=None) -> int:
 
 def memory_reserved(device=None) -> int:
     s = _device_stats(_id(device))
-    return int(s.get("bytes_reserved", s.get("bytes_limit", 0)))
+    return int(s.get("bytes_reserved", s.get("bytes_in_use", 0)))
 
 
 def max_memory_reserved(device=None) -> int:
     s = _device_stats(_id(device))
-    return int(s.get("peak_bytes_reserved", s.get("bytes_limit", 0)))
+    return int(s.get("peak_bytes_reserved",
+                     s.get("peak_bytes_in_use", 0)))
 
 
 def _id(device) -> int:

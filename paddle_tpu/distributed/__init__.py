@@ -10,6 +10,7 @@ auto_parallel surfaces are kept paddle-shaped on top.
 from . import auto_parallel  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import communication  # noqa: F401
+from . import auto_tuner  # noqa: F401
 from . import launch  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from .store import TCPStore  # noqa: F401

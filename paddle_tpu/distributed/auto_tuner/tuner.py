@@ -1,0 +1,101 @@
+"""Auto-tuner — search over parallelism configs.
+
+Reference: python/paddle/distributed/auto_tuner/tuner.py:21 (AutoTuner:
+grid/random search over dp/mp/pp/sharding/micro-batch degrees, trial
+launches, memory-model pruning).
+
+TPU-native: candidates are mesh-degree dicts whose product divides the
+chip count; pruning uses a parameter+activation memory model against
+per-chip HBM, and trials run a user-supplied `trial_fn(config) ->
+throughput` (e.g. a few compiled steps of the real model on a small
+mesh, or the cost model below)."""
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from typing import Callable, Dict, List, Optional
+
+
+class TunerConfig:
+    def __init__(self, num_devices: int, mode: str = "grid",
+                 max_trials: int = 0, hbm_bytes: float = 16e9,
+                 model_params: float = 0.0, hidden_size: int = 0,
+                 seq_len: int = 0, micro_batches=(1, 2, 4, 8),
+                 axes=("dp", "mp", "pp", "sharding")):
+        self.num_devices = num_devices
+        self.mode = mode
+        self.max_trials = max_trials
+        self.hbm_bytes = hbm_bytes
+        self.model_params = model_params
+        self.hidden_size = hidden_size
+        self.seq_len = seq_len
+        self.micro_batches = tuple(micro_batches)
+        self.axes = tuple(axes)
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+class AutoTuner:
+    def __init__(self, config: TunerConfig,
+                 trial_fn: Optional[Callable[[Dict], float]] = None):
+        self.config = config
+        self.trial_fn = trial_fn
+        self.history: List[Dict] = []
+
+    # -- candidate generation (reference search space) -----------------------
+    def candidates(self) -> List[Dict]:
+        n = self.config.num_devices
+        cands = []
+        for degs in itertools.product(_divisors(n),
+                                      repeat=len(self.config.axes)):
+            if math.prod(degs) != n:
+                continue
+            cfg = dict(zip(self.config.axes, degs))
+            for mb in self.config.micro_batches:
+                c = dict(cfg)
+                c["accumulate_steps"] = mb
+                if c.get("pp", 1) > 1 and mb < c["pp"]:
+                    continue  # pipeline needs >= pp microbatches
+                cands.append(c)
+        if self.config.mode == "random":
+            random.shuffle(cands)
+        if self.config.max_trials:
+            cands = cands[:self.config.max_trials]
+        return cands
+
+    # -- memory-model pruning (reference prune-by-memory) --------------------
+    def estimate_memory(self, cfg: Dict) -> float:
+        """Bytes/chip: params+grads+Adam moments sharded over mp*pp*
+        sharding, plus an activation term scaled by dp microbatching."""
+        p = self.config.model_params
+        if p <= 0:
+            return 0.0
+        shard = cfg.get("mp", 1) * cfg.get("pp", 1) * \
+            cfg.get("sharding", 1)
+        param_bytes = p * (2 + 4 + 8) / shard   # bf16 w + fp32 g + moments
+        act = (self.config.hidden_size * self.config.seq_len * 34
+               * max(cfg.get("accumulate_steps", 1), 1)
+               / max(cfg.get("pp", 1), 1) * 2)
+        return param_bytes + act
+
+    def prune(self, cands: List[Dict]) -> List[Dict]:
+        return [c for c in cands
+                if self.estimate_memory(c) <= self.config.hbm_bytes]
+
+    # -- search loop ---------------------------------------------------------
+    def tune(self) -> Dict:
+        best, best_score = None, -float("inf")
+        for cfg in self.prune(self.candidates()):
+            score = self.trial_fn(cfg) if self.trial_fn else \
+                -self.estimate_memory(cfg)
+            self.history.append({"config": cfg, "score": score})
+            if score > best_score:
+                best, best_score = cfg, score
+        if best is None:
+            raise RuntimeError("auto-tuner: every candidate was pruned "
+                               "by the memory model")
+        return {"best_config": best, "best_score": best_score,
+                "n_trials": len(self.history)}

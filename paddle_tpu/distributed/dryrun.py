@@ -138,6 +138,7 @@ def run_dryrun(n_devices: int) -> None:
 
     _dryrun_pipeline(jax, n_devices)
     _dryrun_moe(jax, n_devices)
+    _dryrun_context_parallel(jax, n_devices)
 
 
 def _dryrun_pipeline(jax, n_devices: int) -> None:
@@ -232,3 +233,52 @@ def _dryrun_moe(jax, n_devices: int) -> None:
         l1 = float(step(x, y).numpy())
     assert np.isfinite(l0) and np.isfinite(l1), (l0, l1)
     print(f"dryrun ep ok: ep={ep} dp={dp} loss0={l0:.4f} loss1={l1:.4f}")
+
+
+def _dryrun_context_parallel(jax, n_devices: int) -> None:
+    """Phase 4: sequence/context parallelism — ring attention over 'sep'
+    inside a full train step on a sep x dp mesh."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.kernels.ring_attention import ring_flash_attention
+
+    sep = 4 if n_devices % 4 == 0 else (2 if n_devices % 2 == 0 else 1)
+    if sep == 1:
+        print("dryrun sep: skipped (n_devices not divisible)")
+        return
+    dp = n_devices // sep
+    mesh_mod.set_mesh(mesh_mod.build_mesh({"dp": dp, "sep": sep}))
+
+    hidden, heads, seq, batch = 16, 2, 8 * sep, 2 * dp
+    paddle.seed(0)
+
+    class CPAttnNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.qkv = nn.Linear(hidden, 3 * hidden)
+            self.out = nn.Linear(hidden, hidden)
+            self.head = nn.Linear(hidden, 8)
+
+        def forward(self, x):
+            b, s, _ = x.shape
+            qkv = self.qkv(x).reshape([b, s, 3, heads, hidden // heads])
+            from paddle_tpu.ops.manipulation import split as _split
+            q, k, v = [t.squeeze(2) for t in _split(qkv, 3, axis=2)]
+            a = ring_flash_attention(q, k, v, causal=True)
+            h = self.out(a.reshape([b, s, hidden]))
+            return self.head(h)
+
+    net = CPAttnNet()
+    opt = paddle.optimizer.AdamW(1e-3, parameters=net.parameters())
+    step = paddle.jit.TrainStep(net, nn.CrossEntropyLoss(), opt)
+    rng = np.random.default_rng(3)
+    x = paddle.to_tensor(rng.standard_normal(
+        (batch, seq, hidden)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 8, (batch, seq)))
+    with jax.set_mesh(mesh_mod.get_mesh()):
+        l0 = float(step(x, y).numpy())
+        l1 = float(step(x, y).numpy())
+    assert np.isfinite(l0) and np.isfinite(l1), (l0, l1)
+    print(f"dryrun sep ok: sep={sep} dp={dp} loss0={l0:.4f} "
+          f"loss1={l1:.4f}")

@@ -1,0 +1,113 @@
+"""Elastic training manager.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py:125
+(ElasticManager — nodes register in etcd with TTL leases :234-253, watch
+callbacks detect join/leave, the launcher relaunches with the new world
+size).
+
+TPU-native: no etcd in the image; the registry is the framework's native
+TCPStore (the same store the launcher master hosts). Each node
+heartbeats `node/<id> -> ts`; the watch loop ages entries out after
+`lease_ttl` to detect dead nodes; scale in/out is reported to the caller
+(the launcher), which restarts the job from the latest distributed
+checkpoint — the coordinator-restart model XLA/PJRT requires
+(SURVEY.md §7.1 'Elastic etcd manager -> coordinator-service restart +
+ckpt-resume')."""
+from __future__ import annotations
+
+import enum
+import json
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class ElasticStatus(enum.Enum):
+    COMPLETED = 0
+    ERROR = 1
+    HOLD = 2
+    RESTART = 3
+    EXIT = 4
+
+
+class ElasticManager:
+    def __init__(self, node_id: str, store=None, np: int = 1,
+                 host: str = "127.0.0.1", port: int = 6170,
+                 is_master: bool = False, lease_ttl: float = 10.0,
+                 heartbeat_interval: float = 2.0):
+        if store is None:
+            from ....distributed.store import TCPStore
+            store = TCPStore(host, port, is_master=is_master,
+                             world_size=np)
+        self.store = store
+        self.node_id = node_id
+        self.np = np
+        self.lease_ttl = lease_ttl
+        self.heartbeat_interval = heartbeat_interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._callbacks: List[Callable[[List[str]], None]] = []
+        self._last_alive: List[str] = []
+
+    # -- registration / heartbeat (reference :234-253) -----------------------
+    def register(self):
+        self._beat()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _beat(self):
+        self.store.set(f"__elastic/node/{self.node_id}",
+                       json.dumps({"ts": time.time()}).encode())
+        members = set(self._members())
+        members.add(self.node_id)
+        self.store.set("__elastic/members",
+                       json.dumps(sorted(members)).encode())
+
+    def _members(self) -> List[str]:
+        if not self.store.check("__elastic/members"):
+            return []
+        return json.loads(self.store.get("__elastic/members"))
+
+    def alive_nodes(self) -> List[str]:
+        now = time.time()
+        out = []
+        for n in self._members():
+            key = f"__elastic/node/{n}"
+            if not self.store.check(key):
+                continue
+            ts = json.loads(self.store.get(key))["ts"]
+            if now - ts <= self.lease_ttl:
+                out.append(n)
+        return sorted(out)
+
+    def watch(self, callback: Callable[[List[str]], None]):
+        """callback(alive_nodes) fires on membership change
+        (reference watch callbacks)."""
+        self._callbacks.append(callback)
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._beat()
+            alive = self.alive_nodes()
+            if alive != self._last_alive:
+                for cb in self._callbacks:
+                    cb(alive)
+                self._last_alive = alive
+            self._stop.wait(self.heartbeat_interval)
+
+    # -- scaling decisions ---------------------------------------------------
+    def exit_status(self) -> ElasticStatus:
+        alive = self.alive_nodes()
+        if len(alive) == self.np:
+            return ElasticStatus.COMPLETED
+        if len(alive) < self.np:
+            return ElasticStatus.RESTART   # relaunch with fewer nodes
+        return ElasticStatus.RESTART       # scale out
+
+    def should_restart(self) -> bool:
+        return len(self.alive_nodes()) != self.np
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -9,6 +9,7 @@ rendezvous (barriers, id exchange) exactly like the reference's.
 from __future__ import annotations
 
 import ctypes
+import threading
 import time
 from typing import Optional
 
@@ -35,51 +36,79 @@ class TCPStore:
             self._server = self._lib.ts_server_start(port)
             if not self._server:
                 raise OSError(f"TCPStore: cannot bind port {port}")
+        import socket
+        try:
+            ip = socket.gethostbyname(host)  # C side needs numeric IPv4
+        except OSError:
+            ip = host
         deadline = time.time() + timeout
         self._fd = -1
         while time.time() < deadline:
-            self._fd = self._lib.ts_client_connect(host.encode(), port)
+            self._fd = self._lib.ts_client_connect(ip.encode(), port)
             if self._fd >= 0:
                 break
             time.sleep(0.05)
         if self._fd < 0:
             raise TimeoutError(
                 f"TCPStore: cannot reach master at {host}:{port}")
+        # one request/response must be atomic on the shared socket
+        self._io_lock = threading.Lock()
+        # per-name barrier epochs so a name can be reused (each call is
+        # a fresh counter key; processes hit barriers in program order)
+        self._barrier_epoch: dict = {}
 
     # -- KV API (reference-shaped) -------------------------------------------
     def set(self, key: str, value) -> None:
         v = value if isinstance(value, bytes) else str(value).encode()
         k = key.encode()
-        if self._lib.ts_set(self._fd, k, len(k), v, len(v)) == \
-                -(2 ** 63):
+        with self._io_lock:
+            rc = self._lib.ts_set(self._fd, k, len(k), v, len(v))
+        if rc == -(2 ** 63):
             raise ConnectionError("TCPStore set failed")
 
-    def get(self, key: str) -> bytes:
-        """Blocks (server-side) until the key exists."""
+    def get(self, key: str, timeout: Optional[float] = None) -> bytes:
+        """Waits (client-side poll, bounded by timeout) for the key, then
+        fetches it. Polling instead of the server-side blocking GET keeps
+        _io_lock release points so other threads on this store progress.
+        """
+        self.wait([key], timeout)
         k = key.encode()
         cap = 1 << 20
-        buf = ctypes.create_string_buffer(cap)
-        out_len = ctypes.c_int(0)
-        rc = self._lib.ts_get(self._fd, k, len(k), buf, cap,
-                              ctypes.byref(out_len))
-        if rc == -(2 ** 63):
-            raise ConnectionError("TCPStore get failed")
-        return buf.raw[:out_len.value]
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            out_len = ctypes.c_int(0)
+            with self._io_lock:
+                rc = self._lib.ts_get(self._fd, k, len(k), buf, cap,
+                                      ctypes.byref(out_len))
+            if rc == -(2 ** 63):
+                raise ConnectionError("TCPStore get failed")
+            if out_len.value <= cap:
+                return buf.raw[:out_len.value]
+            cap = out_len.value  # value larger than buffer: refetch
 
     def add(self, key: str, amount: int = 1) -> int:
         k = key.encode()
-        rc = self._lib.ts_add(self._fd, k, len(k), int(amount))
+        with self._io_lock:
+            rc = self._lib.ts_add(self._fd, k, len(k), int(amount))
         if rc == -(2 ** 63):
             raise ConnectionError("TCPStore add failed")
         return int(rc)
 
     def check(self, key: str) -> bool:
         k = key.encode()
-        return bool(self._lib.ts_check(self._fd, k, len(k)))
+        with self._io_lock:
+            rc = self._lib.ts_check(self._fd, k, len(k))
+        if rc == -(2 ** 63):
+            raise ConnectionError("TCPStore check failed")
+        return bool(rc)
 
     def delete_key(self, key: str) -> bool:
         k = key.encode()
-        return bool(self._lib.ts_delete(self._fd, k, len(k)))
+        with self._io_lock:
+            rc = self._lib.ts_delete(self._fd, k, len(k))
+        if rc == -(2 ** 63):
+            raise ConnectionError("TCPStore delete failed")
+        return bool(rc)
 
     def wait(self, keys, timeout: Optional[float] = None) -> None:
         deadline = time.time() + (timeout or self.timeout)
@@ -91,15 +120,20 @@ class TCPStore:
 
     def barrier(self, name: str = "barrier",
                 timeout: Optional[float] = None) -> None:
-        """All world_size clients rendezvous (reference barrier via add)."""
-        n = self.add(f"__barrier/{name}", 1)
+        """All world_size clients rendezvous (reference barrier via add).
+        Each call on a name uses a fresh epoch key so names are
+        reusable."""
+        epoch = self._barrier_epoch.get(name, 0)
+        self._barrier_epoch[name] = epoch + 1
+        key = f"__barrier/{name}/{epoch}"
+        n = self.add(key, 1)
         deadline = time.time() + (timeout or self.timeout)
         while n < self.world_size:
             if time.time() > deadline:
                 raise TimeoutError(f"barrier {name!r} timed out at {n}/"
                                    f"{self.world_size}")
             time.sleep(0.02)
-            n = self.add(f"__barrier/{name}", 0)
+            n = self.add(key, 0)
 
     def close(self):
         if self._fd >= 0:

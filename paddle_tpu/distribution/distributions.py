@@ -53,6 +53,9 @@ class Distribution:
 
 class Normal(Distribution):
     def __init__(self, loc, scale, name=None):
+        # keep the ORIGINAL (possibly Tensor) params: they are passed as
+        # run_op inputs so gradients flow to them (VAE/policy training)
+        self._loc_in, self._scale_in = loc, scale
         self.loc = _arr(loc)
         self.scale = _arr(scale)
         super().__init__(jnp.broadcast_shapes(self.loc.shape,
@@ -66,18 +69,29 @@ class Normal(Distribution):
     def variance(self):
         return wrap(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
 
-    def sample(self, shape=()):
+    def rsample(self, shape=()):
+        """Reparameterized: loc + scale * eps, differentiable in params."""
         key = random_mod.next_key()
         shp = tuple(shape) + self.batch_shape
-        return wrap(self.loc + self.scale * jax.random.normal(
-            key, shp, jnp.result_type(self.loc.dtype, jnp.float32)))
+
+        def fn(loc, scale):
+            eps = jax.random.normal(
+                key, shp, jnp.result_type(jnp.asarray(loc).dtype,
+                                          jnp.float32))
+            return loc + scale * eps
+        return run_op("normal_rsample", fn,
+                      [self._loc_in, self._scale_in])
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
 
     def log_prob(self, value):
-        def fn(v):
-            var = self.scale ** 2
-            return (-((v - self.loc) ** 2) / (2 * var)
-                    - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
-        return run_op("normal_log_prob", fn, [value])
+        def fn(v, loc, scale):
+            var = scale ** 2
+            return (-((v - loc) ** 2) / (2 * var)
+                    - jnp.log(scale) - 0.5 * math.log(2 * math.pi))
+        return run_op("normal_log_prob", fn,
+                      [value, self._loc_in, self._scale_in])
 
     def entropy(self):
         return wrap(0.5 + 0.5 * math.log(2 * math.pi)
@@ -130,6 +144,8 @@ class Uniform(Distribution):
 
 class Bernoulli(Distribution):
     def __init__(self, probs=None, logits=None, name=None):
+        self._probs_in = probs
+        self._logits_in = logits
         if probs is not None:
             self.probs = _arr(probs)
         else:
@@ -151,10 +167,18 @@ class Bernoulli(Distribution):
             key, self.probs, shp).astype(jnp.float32))
 
     def log_prob(self, value):
-        def fn(v):
-            p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
-            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
-        return run_op("bernoulli_log_prob", fn, [value])
+        if self._probs_in is not None:
+            def fn(v, probs):
+                p = jnp.clip(probs, 1e-7, 1 - 1e-7)
+                return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+            return run_op("bernoulli_log_prob", fn,
+                          [value, self._probs_in])
+
+        def fn(v, logits):
+            return (v * jax.nn.log_sigmoid(logits)
+                    + (1 - v) * jax.nn.log_sigmoid(-logits))
+        return run_op("bernoulli_log_prob", fn,
+                      [value, self._logits_in])
 
     def entropy(self):
         p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
@@ -163,6 +187,8 @@ class Bernoulli(Distribution):
 
 class Categorical(Distribution):
     def __init__(self, logits=None, probs=None, name=None):
+        self._logits_in = logits
+        self._probs_in = probs
         if logits is not None:
             self.logits = _arr(logits)
         else:
@@ -181,11 +207,16 @@ class Categorical(Distribution):
         return wrap(jax.random.categorical(key, self.logits, shape=shp))
 
     def log_prob(self, value):
-        def fn(v):
+        def fn(v, raw):
+            logits = raw if self._logits_in is not None else \
+                jnp.log(jnp.clip(raw, 1e-12))
+            logits = logits - jax.scipy.special.logsumexp(
+                logits, axis=-1, keepdims=True)
             return jnp.take_along_axis(
-                self.logits, v.astype(jnp.int32)[..., None],
-                axis=-1)[..., 0]
-        return run_op("categorical_log_prob", fn, [value])
+                logits, v.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+        raw = self._logits_in if self._logits_in is not None \
+            else self._probs_in
+        return run_op("categorical_log_prob", fn, [value, raw])
 
     def entropy(self):
         p = jnp.exp(self.logits)

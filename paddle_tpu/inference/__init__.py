@@ -1,0 +1,108 @@
+"""paddle.inference parity surface.
+
+Reference: paddle/fluid/inference (AnalysisPredictor,
+api/analysis_predictor.h:105 — load program+params, run IR optimization,
+zero-copy input/output handles). TPU-native: the artifact is the
+jit.save StableHLO module + param archive; "analysis passes" are XLA's
+compilation, and the predictor runs the deserialized executable with
+donated buffers. API mirrors paddle_infer: Config, create_predictor,
+get_input_names/get_input_handle/run/get_output_names.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dispatch import unwrap, wrap
+
+
+class Config:
+    """Reference: paddle_infer.Config(model_file, params_file)."""
+
+    def __init__(self, prog_file=None, params_file=None,
+                 model_dir=None):
+        if model_dir is not None and prog_file is None:
+            prog_file = model_dir
+        # accept either the jit.save prefix or explicit file paths
+        self.prefix = (prog_file[:-len(".pdmodel")]
+                       if prog_file and prog_file.endswith(".pdmodel")
+                       else prog_file)
+        self._ir_optim = True
+        self._memory_optim = True
+
+    # reference-shaped knobs: XLA already does both, keep as metadata
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag
+
+    def enable_memory_optim(self, flag=True):
+        self._memory_optim = flag
+
+    def enable_use_gpu(self, *a, **kw):
+        pass  # device selection is PJRT's job on TPU
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+
+class _Handle:
+    """Zero-copy-style tensor handle (reference ZeroCopyTensor)."""
+
+    def __init__(self):
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        self._value = np.ascontiguousarray(arr)
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(np.asarray(self._value).shape)
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from ..jit.serialization import load as jit_load
+        self._layer = jit_load(config.prefix)
+        n_in = getattr(self._layer, "num_inputs", 1)
+        self._input_names = [f"input_{i}" for i in range(n_in)]
+        self._inputs = {n: _Handle() for n in self._input_names}
+        self._outputs = []
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name) -> _Handle:
+        return self._inputs[name]
+
+    def run(self, inputs=None):
+        """Either positional arrays (returned directly, paddle_infer's
+        list API) or via input handles."""
+        if inputs is not None:
+            args = [wrap(np.asarray(a)) if not hasattr(a, "_data") else a
+                    for a in inputs]
+        else:
+            args = [wrap(self._inputs[n].copy_to_cpu())
+                    for n in self._input_names]
+        out = self._layer(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._outputs = [np.asarray(unwrap(o)) for o in outs]
+        if inputs is not None:
+            return self._outputs
+        return True
+
+    def get_output_names(self):
+        return [f"output_{i}" for i in range(len(self._outputs))]
+
+    def get_output_handle(self, name) -> _Handle:
+        i = int(name.split("_")[-1])
+        h = _Handle()
+        h.copy_from_cpu(self._outputs[i])
+        return h
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

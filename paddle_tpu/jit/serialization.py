@@ -63,16 +63,18 @@ def save(layer, path, input_spec=None, **config):
         pickle.dump({"params": {k: np.asarray(v) for k, v in params.items()},
                      "frozen": {k: np.asarray(v) for k, v in frozen.items()},
                      "buffers": {k: np.asarray(v)
-                                 for k, v in buffers.items()}}, f)
+                                 for k, v in buffers.items()},
+                     "n_inputs": len(specs)}, f)
 
 
 class TranslatedLayer:
     """Loaded AOT artifact; callable like the original layer (inference)."""
 
-    def __init__(self, exported, params, frozen):
+    def __init__(self, exported, params, frozen, n_inputs=1):
         self._exported = exported
         self._params = {k: jnp.asarray(v) for k, v in params.items()}
         self._frozen = {k: jnp.asarray(v) for k, v in frozen.items()}
+        self.num_inputs = n_inputs
 
     def __call__(self, *args):
         arrays = [unwrap(a) for a in args]
@@ -95,4 +97,5 @@ def load(path, **config):
         exported = jax_export.deserialize(f.read())
     with open(path + ".pdiparams", "rb") as f:
         state = pickle.load(f)
-    return TranslatedLayer(exported, state["params"], state["frozen"])
+    return TranslatedLayer(exported, state["params"], state["frozen"],
+                           state.get("n_inputs", 1))

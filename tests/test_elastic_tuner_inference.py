@@ -1,0 +1,110 @@
+"""Elastic manager, auto-tuner, cost model, and inference Predictor."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import csrc
+
+
+def test_inference_predictor_roundtrip(tmp_path):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    x = np.random.default_rng(0).standard_normal((2, 8)).astype(
+        np.float32)
+    want = np.asarray(net(paddle.to_tensor(x)).numpy())
+    prefix = str(tmp_path / "model")
+    paddle.jit.save(net, prefix,
+                    input_spec=[paddle.jit.InputSpec([2, 8], "float32")])
+
+    from paddle_tpu.inference import Config, create_predictor
+    pred = create_predictor(Config(prefix + ".pdmodel"))
+    name = pred.get_input_names()[0]
+    pred.get_input_handle(name).copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(
+        pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+@pytest.mark.skipif(csrc.lib() is None, reason="no native toolchain")
+def test_elastic_membership_and_watch():
+    from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                      ElasticStatus)
+    from paddle_tpu.distributed.store import TCPStore
+    store = TCPStore("127.0.0.1", 38770, is_master=True, world_size=2)
+    try:
+        m1 = ElasticManager("node0", store=store, np=2, lease_ttl=2.0,
+                            heartbeat_interval=0.2)
+        m2 = ElasticManager("node1", store=store, np=2, lease_ttl=2.0,
+                            heartbeat_interval=0.2)
+        events = []
+        m1.watch(lambda alive: events.append(list(alive)))
+        m1.register()
+        m2.register()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if m1.alive_nodes() == ["node0", "node1"]:
+                break
+            time.sleep(0.1)
+        assert m1.alive_nodes() == ["node0", "node1"]
+        assert not m1.should_restart()
+        assert m1.exit_status() == ElasticStatus.COMPLETED
+        # node1 dies -> lease ages out -> restart needed
+        m2.stop()
+        deadline = time.time() + 10
+        while time.time() < deadline and not m1.should_restart():
+            time.sleep(0.2)
+        assert m1.should_restart()
+        assert events and events[-1] != []
+        m1.stop()
+    finally:
+        store.close()
+
+
+def test_auto_tuner_search_and_prune():
+    from paddle_tpu.distributed.auto_tuner import AutoTuner, TunerConfig
+    cfg = TunerConfig(num_devices=8, model_params=1e8, hidden_size=1024,
+                      seq_len=2048, hbm_bytes=16e9)
+    tuner = AutoTuner(cfg, trial_fn=lambda c: -c.get("pp", 1))
+    res = tuner.tune()
+    assert res["best_config"]["pp"] == 1  # trial_fn prefers no pipeline
+    assert res["n_trials"] > 0
+    import math
+    degs = [res["best_config"][a] for a in cfg.axes]
+    assert math.prod(degs) == 8
+    # shrinking HBM prunes high-replication configs
+    small = TunerConfig(num_devices=8, model_params=5e9, hidden_size=1,
+                        seq_len=1, hbm_bytes=16e9)
+    t2 = AutoTuner(small)
+    pruned = t2.prune(t2.candidates())
+    assert all(c["mp"] * c["pp"] * c["sharding"] >= 5 for c in pruned)
+
+
+def test_cost_model_roofline():
+    from paddle_tpu.cost_model import CostModel
+    cm = CostModel("TPU v5 lite")
+    big = cm.matmul_time(8192, 8192, 8192)
+    small = cm.matmul_time(128, 128, 128)
+    assert big > small > 0
+    # large matmuls are compute-bound: time ~ flops/peak
+    assert big == pytest.approx(2 * 8192**3 / 197e12, rel=1e-6)
+    assert cm.collective_time(2**20, 8) > 0
+    assert cm.collective_time(2**20, 1) == 0
+
+
+def test_vision_models_forward():
+    from paddle_tpu.vision.models import MobileNetV2, vgg11
+    paddle.seed(0)
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+        (1, 3, 32, 32)).astype(np.float32))
+    m = MobileNetV2(num_classes=10)
+    m.eval()
+    assert list(m(x).shape) == [1, 10]
+    v = vgg11(num_classes=10)
+    v.eval()
+    x2 = paddle.to_tensor(np.random.default_rng(1).standard_normal(
+        (1, 3, 64, 64)).astype(np.float32))
+    assert list(v(x2).shape) == [1, 10]

@@ -115,3 +115,67 @@ def test_quantize_linear_per_channel_axis0():
     back = dequantize_linear(q, scale, quant_axis=0)
     np.testing.assert_allclose(np.asarray(back.numpy()),
                                np.asarray(w.numpy()))
+
+
+def test_distribution_param_gradients():
+    """Gradients must flow to distribution parameters (review
+    regression: params were baked as constants)."""
+    loc = paddle.to_tensor(np.array(0.5, np.float32))
+    scale = paddle.to_tensor(np.array(1.0, np.float32))
+    loc.stop_gradient = False
+    scale.stop_gradient = False
+    n = D.Normal(loc, scale)
+    x = paddle.to_tensor(np.array(1.5, np.float32))
+    n.log_prob(x).backward()
+    # d/dloc log N(x|loc,scale) = (x - loc) / scale^2 = 1.0
+    assert float(loc.grad.numpy()) == pytest.approx(1.0, rel=1e-5)
+    assert scale.grad is not None
+
+    paddle.seed(5)
+    loc2 = paddle.to_tensor(np.array(0.0, np.float32))
+    loc2.stop_gradient = False
+    s = D.Normal(loc2, 1.0).rsample([4])
+    s.sum().backward()
+    # d/dloc sum(loc + eps) = 4
+    assert float(loc2.grad.numpy()) == pytest.approx(4.0, rel=1e-5)
+
+    logits = paddle.to_tensor(np.zeros(3, np.float32))
+    logits.stop_gradient = False
+    c = D.Categorical(logits=logits)
+    c.log_prob(paddle.to_tensor(np.array(1))).backward()
+    g = np.asarray(logits.grad.numpy())
+    np.testing.assert_allclose(g, [-1 / 3, 2 / 3, -1 / 3], rtol=1e-4)
+
+
+def test_store_barrier_reusable():
+    """Same-name barriers must rendezvous each call (review regression)."""
+    from paddle_tpu import csrc
+    if csrc.lib() is None:
+        pytest.skip("no native toolchain")
+    import threading
+    from paddle_tpu.distributed.store import TCPStore
+    master = TCPStore("127.0.0.1", 38780, is_master=True, world_size=2)
+    client = TCPStore("127.0.0.1", 38780, is_master=False, world_size=2)
+    try:
+        import time
+        order = []
+
+        def worker():
+            client.barrier("x", timeout=20)
+            order.append("c1")
+            client.barrier("x", timeout=20)
+            order.append("c2")
+
+        t = threading.Thread(target=worker)
+        t.start()
+        time.sleep(0.2)
+        master.barrier("x", timeout=20)
+        time.sleep(0.3)
+        # second barrier must WAIT for the client again
+        t0 = time.time()
+        master.barrier("x", timeout=20)
+        t.join()
+        assert order == ["c1", "c2"]
+    finally:
+        client.close()
+        master.close()
