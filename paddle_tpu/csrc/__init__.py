@@ -56,7 +56,7 @@ def lib():
         lb.ts_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
         lb.ts_client_close.argtypes = [ctypes.c_int]
         for name in ("ts_set", "ts_get", "ts_add", "ts_check",
-                     "ts_delete"):
+                     "ts_delete", "ts_get_nowait"):
             getattr(lb, name).restype = ctypes.c_int64
         lb.ts_set.argtypes = [ctypes.c_int, ctypes.c_char_p,
                               ctypes.c_int, ctypes.c_char_p,
@@ -65,6 +65,8 @@ def lib():
                               ctypes.c_int, ctypes.c_char_p,
                               ctypes.c_int,
                               ctypes.POINTER(ctypes.c_int)]
+        lb.ts_get_nowait.restype = ctypes.c_int64
+        lb.ts_get_nowait.argtypes = lb.ts_get.argtypes
         lb.ts_add.argtypes = [ctypes.c_int, ctypes.c_char_p,
                               ctypes.c_int, ctypes.c_int64]
         lb.ts_check.argtypes = [ctypes.c_int, ctypes.c_char_p,

@@ -126,7 +126,9 @@ void serve(Server* s) {
         conns.push_back(c);
       }
     }
-    for (size_t i = 1; i < pfds.size(); ++i) {
+    // request loop covers only live connections — waiter pfds past
+    // waiter_base were handled (and possibly closed) above
+    for (size_t i = 1; i < waiter_base; ++i) {
       if (!(pfds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
       int fd = pfds[i].fd;
       uint8_t op;
@@ -182,6 +184,15 @@ void serve(Server* s) {
         }
         case 3: {  // WAIT (existence check, nonblocking)
           send_resp(fd, s->data.count(key) ? 1 : 0, "");
+          break;
+        }
+        case 5: {  // GET_NOWAIT: num=-1 if missing (never parks)
+          auto it = s->data.find(key);
+          if (it != s->data.end()) {
+            send_resp(fd, 0, it->second);
+          } else {
+            send_resp(fd, -1, "");
+          }
           break;
         }
         case 4:  // DELETE
@@ -288,6 +299,11 @@ int64_t ts_set(int fd, const char* key, int klen, const char* val,
 int64_t ts_get(int fd, const char* key, int klen, char* out_buf,
                int out_cap, int* out_len) {
   return request(fd, 1, key, klen, nullptr, 0, out_buf, out_cap, out_len);
+}
+
+int64_t ts_get_nowait(int fd, const char* key, int klen, char* out_buf,
+                      int out_cap, int* out_len) {
+  return request(fd, 5, key, klen, nullptr, 0, out_buf, out_cap, out_len);
 }
 
 int64_t ts_add(int fd, const char* key, int klen, int64_t delta) {

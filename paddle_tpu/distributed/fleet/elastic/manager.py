@@ -51,6 +51,11 @@ class ElasticManager:
 
     # -- registration / heartbeat (reference :234-253) -----------------------
     def register(self):
+        # race-free membership: claim a slot via atomic ADD, write the
+        # node id into it ONCE (heartbeats only touch this node's own
+        # key — no shared read-modify-write)
+        idx = self.store.add("__elastic/member_count", 1) - 1
+        self.store.set(f"__elastic/member/{idx}", self.node_id.encode())
         self._beat()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -58,15 +63,15 @@ class ElasticManager:
     def _beat(self):
         self.store.set(f"__elastic/node/{self.node_id}",
                        json.dumps({"ts": time.time()}).encode())
-        members = set(self._members())
-        members.add(self.node_id)
-        self.store.set("__elastic/members",
-                       json.dumps(sorted(members)).encode())
 
     def _members(self) -> List[str]:
-        if not self.store.check("__elastic/members"):
-            return []
-        return json.loads(self.store.get("__elastic/members"))
+        n = self.store.add("__elastic/member_count", 0)
+        out = set()
+        for i in range(int(n)):
+            key = f"__elastic/member/{i}"
+            if self.store.check(key):
+                out.add(self.store.get(key).decode())
+        return sorted(out)
 
     def alive_nodes(self) -> List[str]:
         now = time.time()

@@ -68,20 +68,27 @@ class TCPStore:
 
     def get(self, key: str, timeout: Optional[float] = None) -> bytes:
         """Waits (client-side poll, bounded by timeout) for the key, then
-        fetches it. Polling instead of the server-side blocking GET keeps
-        _io_lock release points so other threads on this store progress.
-        """
-        self.wait([key], timeout)
+        fetches it. Uses the NON-blocking server GET throughout so the
+        connection never parks while holding _io_lock (other threads on
+        this store keep progressing), even if the key is deleted between
+        the existence check and the fetch."""
+        deadline = time.time() + (timeout or self.timeout)
         k = key.encode()
         cap = 1 << 20
         while True:
             buf = ctypes.create_string_buffer(cap)
             out_len = ctypes.c_int(0)
             with self._io_lock:
-                rc = self._lib.ts_get(self._fd, k, len(k), buf, cap,
-                                      ctypes.byref(out_len))
+                rc = self._lib.ts_get_nowait(self._fd, k, len(k), buf,
+                                             cap, ctypes.byref(out_len))
             if rc == -(2 ** 63):
                 raise ConnectionError("TCPStore get failed")
+            if rc == -1:  # missing: poll until deadline
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"TCPStore get({key!r}) timed out")
+                time.sleep(0.02)
+                continue
             if out_len.value <= cap:
                 return buf.raw[:out_len.value]
             cap = out_len.value  # value larger than buffer: refetch

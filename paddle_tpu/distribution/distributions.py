@@ -94,9 +94,10 @@ class Normal(Distribution):
                       [value, self._loc_in, self._scale_in])
 
     def entropy(self):
-        return wrap(0.5 + 0.5 * math.log(2 * math.pi)
-                    + jnp.log(self.scale)
+        def fn(scale):
+            return (0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale)
                     + jnp.zeros(self.batch_shape))
+        return run_op("normal_entropy", fn, [self._scale_in])
 
 
 class LogNormal(Distribution):
@@ -119,6 +120,7 @@ class LogNormal(Distribution):
 
 class Uniform(Distribution):
     def __init__(self, low, high, name=None):
+        self._low_in, self._high_in = low, high
         self.low = _arr(low)
         self.high = _arr(high)
         super().__init__(jnp.broadcast_shapes(self.low.shape,
@@ -131,15 +133,17 @@ class Uniform(Distribution):
             key, shp, minval=self.low, maxval=self.high))
 
     def log_prob(self, value):
-        def fn(v):
-            inside = (v >= self.low) & (v < self.high)
-            return jnp.where(inside, -jnp.log(self.high - self.low),
-                             -jnp.inf)
-        return run_op("uniform_log_prob", fn, [value])
+        def fn(v, low, high):
+            inside = (v >= low) & (v < high)
+            return jnp.where(inside, -jnp.log(high - low), -jnp.inf)
+        return run_op("uniform_log_prob", fn,
+                      [value, self._low_in, self._high_in])
 
     def entropy(self):
-        return wrap(jnp.log(self.high - self.low)
-                    + jnp.zeros(self.batch_shape))
+        def fn(low, high):
+            return jnp.log(high - low) + jnp.zeros(self.batch_shape)
+        return run_op("uniform_entropy", fn,
+                      [self._low_in, self._high_in])
 
 
 class Bernoulli(Distribution):
@@ -181,8 +185,14 @@ class Bernoulli(Distribution):
                       [value, self._logits_in])
 
     def entropy(self):
-        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
-        return wrap(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+        raw = self._probs_in if self._probs_in is not None \
+            else self._logits_in
+
+        def fn(r):
+            p = r if self._probs_in is not None else jax.nn.sigmoid(r)
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+        return run_op("bernoulli_entropy", fn, [raw])
 
 
 class Categorical(Distribution):
@@ -219,12 +229,21 @@ class Categorical(Distribution):
         return run_op("categorical_log_prob", fn, [value, raw])
 
     def entropy(self):
-        p = jnp.exp(self.logits)
-        return wrap(-jnp.sum(p * self.logits, axis=-1))
+        raw = self._logits_in if self._logits_in is not None \
+            else self._probs_in
+
+        def fn(r):
+            logits = r if self._logits_in is not None else \
+                jnp.log(jnp.clip(r, 1e-12))
+            logits = logits - jax.scipy.special.logsumexp(
+                logits, axis=-1, keepdims=True)
+            return -jnp.sum(jnp.exp(logits) * logits, axis=-1)
+        return run_op("categorical_entropy", fn, [raw])
 
 
 class Exponential(Distribution):
     def __init__(self, rate, name=None):
+        self._rate_in = rate
         self.rate = _arr(rate)
         super().__init__(self.rate.shape)
 
@@ -430,31 +449,58 @@ def kl_divergence(p: Distribution, q: Distribution):
 
 @register_kl(Normal, Normal)
 def _kl_normal_normal(p, q):
-    var_ratio = (p.scale / q.scale) ** 2
-    t1 = ((p.loc - q.loc) / q.scale) ** 2
-    return wrap(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+    def fn(pl, ps, ql, qs):
+        var_ratio = (ps / qs) ** 2
+        t1 = ((pl - ql) / qs) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+    return run_op("kl_normal_normal", fn,
+                  [p._loc_in, p._scale_in, q._loc_in, q._scale_in])
 
 
 @register_kl(Uniform, Uniform)
 def _kl_uniform_uniform(p, q):
-    return wrap(jnp.log((q.high - q.low) / (p.high - p.low)))
+    def fn(pl, ph, ql, qh):
+        return jnp.log((qh - ql) / (ph - pl))
+    return run_op("kl_uniform_uniform", fn,
+                  [p._low_in, p._high_in, q._low_in, q._high_in])
 
 
 @register_kl(Bernoulli, Bernoulli)
 def _kl_bernoulli_bernoulli(p, q):
-    pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
-    qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
-    return wrap(pp * jnp.log(pp / qq)
+    def to_probs(d, r):
+        pr = r if d._probs_in is not None else jax.nn.sigmoid(r)
+        return jnp.clip(pr, 1e-7, 1 - 1e-7)
+
+    def fn(pr_raw, qr_raw):
+        pp = to_probs(p, pr_raw)
+        qq = to_probs(q, qr_raw)
+        return (pp * jnp.log(pp / qq)
                 + (1 - pp) * jnp.log((1 - pp) / (1 - qq)))
+    pr = p._probs_in if p._probs_in is not None else p._logits_in
+    qr = q._probs_in if q._probs_in is not None else q._logits_in
+    return run_op("kl_bernoulli_bernoulli", fn, [pr, qr])
 
 
 @register_kl(Categorical, Categorical)
 def _kl_categorical_categorical(p, q):
-    pp = jnp.exp(p.logits)
-    return wrap(jnp.sum(pp * (p.logits - q.logits), axis=-1))
+    def norm(d, r):
+        logits = r if d._logits_in is not None else \
+            jnp.log(jnp.clip(r, 1e-12))
+        return logits - jax.scipy.special.logsumexp(
+            logits, axis=-1, keepdims=True)
+
+    def fn(pr_raw, qr_raw):
+        pl = norm(p, pr_raw)
+        ql = norm(q, qr_raw)
+        return jnp.sum(jnp.exp(pl) * (pl - ql), axis=-1)
+    pr = p._logits_in if p._logits_in is not None else p._probs_in
+    qr = q._logits_in if q._logits_in is not None else q._probs_in
+    return run_op("kl_categorical_categorical", fn, [pr, qr])
 
 
 @register_kl(Exponential, Exponential)
 def _kl_exponential_exponential(p, q):
-    ratio = q.rate / p.rate
-    return wrap(jnp.log(p.rate / q.rate) + ratio - 1)
+    def fn(pr, qr):
+        return jnp.log(pr / qr) + qr / pr - 1
+    return run_op("kl_exponential_exponential", fn,
+                  [p._rate_in, q._rate_in])

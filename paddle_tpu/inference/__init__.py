@@ -21,7 +21,15 @@ class Config:
     def __init__(self, prog_file=None, params_file=None,
                  model_dir=None):
         if model_dir is not None and prog_file is None:
-            prog_file = model_dir
+            # paddle_infer semantics: the directory contains the artifact
+            import glob
+            import os
+            models = sorted(glob.glob(os.path.join(model_dir,
+                                                   "*.pdmodel")))
+            if not models:
+                raise FileNotFoundError(
+                    f"no .pdmodel artifact under {model_dir}")
+            prog_file = models[0]
         # accept either the jit.save prefix or explicit file paths
         self.prefix = (prog_file[:-len(".pdmodel")]
                        if prog_file and prog_file.endswith(".pdmodel")

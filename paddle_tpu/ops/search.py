@@ -135,13 +135,14 @@ def nonzero(x, as_tuple=False):
 # ---- coverage batch (reference ops.yaml names) -----------------------------
 
 def viterbi_decode(potentials, transition_params, lengths=None,
-                   include_bos_eos_tag=False, name=None):
+                   include_bos_eos_tag=True, name=None):
     """Viterbi decoding (reference ops.yaml: viterbi_decode).
 
     potentials: [B, T, N] emission scores; transition_params: [N, N];
     lengths: [B] valid lengths (padded steps are no-ops, their path
-    entries repeat the final state). include_bos_eos_tag treats the last
-    two tags as SOS/EOS like the reference.
+    entries repeat the final state). include_bos_eos_tag (default True,
+    matching the reference) treats the last two tags as SOS/EOS — the
+    transition matrix must then include those two extra tags.
     Returns (scores [B], paths [B, T]).
     """
     args = [potentials, transition_params]

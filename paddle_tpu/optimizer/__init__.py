@@ -2,5 +2,6 @@
 from . import lr  # noqa: F401
 from .optimizer import Optimizer  # noqa: F401
 from .optimizers import (  # noqa: F401
+    ASGD, Adadelta, NAdam, RAdam, Rprop,
     SGD, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, RMSProp,
 )

@@ -6,6 +6,7 @@ optimizer.py for the eager/compiled duality.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from .optimizer import Optimizer, _decay_value
@@ -253,3 +254,156 @@ class Adamax(Optimizer):
         p_new = p - lr / (1 - b1p) * m / (u + self._epsilon)
         return p_new, {"moment": m, "inf_norm": u,
                        "beta1_pow": b1p * self._beta1}
+
+
+class Adadelta(Optimizer):
+    """Reference: python/paddle/optimizer/adadelta.py."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _init_state(self, param):
+        return {"avg_squared_grad": jnp.zeros_like(param),
+                "avg_squared_update": jnp.zeros_like(param)}
+
+    def _update(self, p, g, state, lr, wd=None):
+        g = _apply_l2(g, p, wd if wd is not None else self._weight_decay)
+        asg = self._rho * state["avg_squared_grad"] + \
+            (1 - self._rho) * g * g
+        asu = state["avg_squared_update"]
+        update = g * jnp.sqrt(asu + self._epsilon) / \
+            jnp.sqrt(asg + self._epsilon)
+        asu = self._rho * asu + (1 - self._rho) * update * update
+        return p - lr * update, {"avg_squared_grad": asg,
+                                 "avg_squared_update": asu}
+
+
+class NAdam(Optimizer):
+    """Nesterov Adam (reference: python/paddle/optimizer/nadam.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._b1, self._b2 = beta1, beta2
+        self._epsilon = epsilon
+        self._psi = momentum_decay
+
+    def _init_state(self, param):
+        return {"moment1": jnp.zeros_like(param),
+                "moment2": jnp.zeros_like(param),
+                "step": jnp.zeros((), jnp.float32),
+                "mu_product": jnp.ones((), jnp.float32)}
+
+    def _update(self, p, g, state, lr, wd=None):
+        g = _apply_l2(g, p, wd if wd is not None else self._weight_decay)
+        t = state["step"] + 1
+        mu_t = self._b1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        mu_next = self._b1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        mu_prod = state["mu_product"] * mu_t
+        m = self._b1 * state["moment1"] + (1 - self._b1) * g
+        v = self._b2 * state["moment2"] + (1 - self._b2) * g * g
+        m_hat = (mu_next * m / (1 - mu_prod * mu_next)
+                 + (1 - mu_t) * g / (1 - mu_prod))
+        v_hat = v / (1 - self._b2 ** t)
+        new_p = p - lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+        return new_p, {"moment1": m, "moment2": v, "step": t,
+                       "mu_product": mu_prod}
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (reference: python/paddle/optimizer/radam.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._b1, self._b2 = beta1, beta2
+        self._epsilon = epsilon
+
+    def _init_state(self, param):
+        return {"moment1": jnp.zeros_like(param),
+                "moment2": jnp.zeros_like(param),
+                "step": jnp.zeros((), jnp.float32)}
+
+    def _update(self, p, g, state, lr, wd=None):
+        g = _apply_l2(g, p, wd if wd is not None else self._weight_decay)
+        t = state["step"] + 1
+        m = self._b1 * state["moment1"] + (1 - self._b1) * g
+        v = self._b2 * state["moment2"] + (1 - self._b2) * g * g
+        m_hat = m / (1 - self._b1 ** t)
+        rho_inf = 2.0 / (1 - self._b2) - 1
+        rho_t = rho_inf - 2 * t * self._b2 ** t / (1 - self._b2 ** t)
+        r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                     / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t,
+                                   1e-12))
+        v_hat = jnp.sqrt(v / (1 - self._b2 ** t))
+        adaptive = lr * r * m_hat / (v_hat + self._epsilon)
+        sgd_like = lr * m_hat
+        new_p = p - jnp.where(rho_t > 5.0, adaptive, sgd_like)
+        return new_p, {"moment1": m, "moment2": v, "step": t}
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (reference: python/paddle/optimizer/asgd.py — the
+    asgd_ kernel keeps the last `batch_num` gradients and steps with
+    their running mean: d += g - y[i]; y[i] = g; p -= lr * d / n)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._batch_num = max(int(batch_num), 1)
+
+    def _init_state(self, param):
+        n = self._batch_num
+        return {"d": jnp.zeros_like(param),
+                "y": jnp.zeros((n,) + tuple(param.shape), param.dtype),
+                "step": jnp.zeros((), jnp.int32)}
+
+    def _update(self, p, g, state, lr, wd=None):
+        g = _apply_l2(g, p, wd if wd is not None else self._weight_decay)
+        n = self._batch_num
+        i = jnp.mod(state["step"], n)
+        y_old = jax.lax.dynamic_index_in_dim(state["y"], i, 0,
+                                             keepdims=False)
+        d = state["d"] + g - y_old
+        y = jax.lax.dynamic_update_index_in_dim(state["y"], g, i, 0)
+        # until the window fills, average over the seen count
+        seen = jnp.minimum(state["step"] + 1, n).astype(g.dtype)
+        new_p = p - lr * d / seen
+        return new_p, {"d": d, "y": y, "step": state["step"] + 1}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference: python/paddle/optimizer/rprop.py)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 etas=(0.5, 1.2), parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+
+    def _init_state(self, param):
+        return {"prev_grad": jnp.zeros_like(param),
+                "step_size": jnp.full_like(param, float(self.get_lr()))}
+
+    def _update(self, p, g, state, lr, wd=None):
+        g = _apply_l2(g, p, wd if wd is not None else self._weight_decay)
+        sign = jnp.sign(g * state["prev_grad"])
+        factor = jnp.where(sign > 0, self._eta_pos,
+                           jnp.where(sign < 0, self._eta_neg, 1.0))
+        step = jnp.clip(state["step_size"] * factor, self._lr_min,
+                        self._lr_max)
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        new_p = p - jnp.sign(g_eff) * step
+        return new_p, {"prev_grad": g_eff, "step_size": step}

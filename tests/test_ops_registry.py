@@ -167,7 +167,8 @@ def test_viterbi_matches_brute_force():
     T, N = 4, 3
     em = rng.standard_normal((1, T, N)).astype(np.float32)
     tr = rng.standard_normal((N, N)).astype(np.float32)
-    sc, path = viterbi_decode(paddle.to_tensor(em), paddle.to_tensor(tr))
+    sc, path = viterbi_decode(paddle.to_tensor(em), paddle.to_tensor(tr),
+                              include_bos_eos_tag=False)
     best, bp = -1e9, None
     for p in itertools.product(range(N), repeat=T):
         s = em[0, 0, p[0]] + sum(tr[p[i - 1], p[i]] + em[0, i, p[i]]

@@ -155,3 +155,24 @@ def test_grad_scaler_with_real_optimizer():
     scaler.step(opt)
     scaler.update()
     assert net.weight.grad is not None
+
+
+def test_extended_optimizers_train():
+    """Adadelta/NAdam/RAdam/ASGD/Rprop reduce loss (ops.yaml covered_by
+    claims these classes exist — keep that honest)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    for cls_name in ["Adadelta", "NAdam", "RAdam", "ASGD", "Rprop"]:
+        paddle.seed(0)
+        net = nn.Linear(8, 4)
+        opt = getattr(paddle.optimizer, cls_name)(
+            0.01, parameters=net.parameters())
+        step = paddle.jit.TrainStep(net, nn.MSELoss(), opt)
+        x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+            (8, 8)).astype(np.float32))
+        y = paddle.to_tensor(np.zeros((8, 4), np.float32))
+        l0 = float(step(x, y).numpy())
+        for _ in range(10):
+            l1 = float(step(x, y).numpy())
+        assert np.isfinite(l1) and l1 < l0, (cls_name, l0, l1)

@@ -179,3 +179,22 @@ def test_store_barrier_reusable():
     finally:
         client.close()
         master.close()
+
+
+def test_kl_and_entropy_param_gradients():
+    """KL/entropy must propagate gradients to distribution params
+    (review regression: VAE KL term had zero gradient)."""
+    mu = paddle.to_tensor(np.array(0.5, np.float32))
+    sig = paddle.to_tensor(np.array(1.5, np.float32))
+    mu.stop_gradient = False
+    sig.stop_gradient = False
+    kl = D.kl_divergence(D.Normal(mu, sig), D.Normal(0.0, 1.0))
+    kl.backward()
+    # dKL/dmu = mu
+    assert float(mu.grad.numpy()) == pytest.approx(0.5, rel=1e-5)
+    # dKL/dsig = sig - 1/sig
+    assert float(sig.grad.numpy()) == pytest.approx(1.5 - 1 / 1.5,
+                                                    rel=1e-5)
+    sig.clear_grad()
+    D.Normal(0.0, sig).entropy().backward()
+    assert float(sig.grad.numpy()) == pytest.approx(1 / 1.5, rel=1e-5)
