@@ -65,7 +65,6 @@ def lib():
                               ctypes.c_int, ctypes.c_char_p,
                               ctypes.c_int,
                               ctypes.POINTER(ctypes.c_int)]
-        lb.ts_get_nowait.restype = ctypes.c_int64
         lb.ts_get_nowait.argtypes = lb.ts_get.argtypes
         lb.ts_add.argtypes = [ctypes.c_int, ctypes.c_char_p,
                               ctypes.c_int, ctypes.c_int64]

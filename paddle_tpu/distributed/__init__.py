@@ -18,9 +18,10 @@ from . import env  # noqa: F401
 from . import fleet  # noqa: F401
 from . import mesh  # noqa: F401
 from .auto_parallel import (  # noqa: F401
-    Partial, ProcessMesh, Replicate, Shard, ShardingStage1, ShardingStage2,
-    ShardingStage3, dtensor_from_fn, reshard, shard_layer, shard_optimizer,
-    shard_tensor, unshard_dtensor,
+    DistModel, Partial, ProcessMesh, Replicate, Shard, ShardingStage1,
+    ShardingStage2, ShardingStage3, dtensor_from_fn, parallelize, reshard,
+    shard_dataloader, shard_layer, shard_optimizer, shard_tensor,
+    to_static, unshard_dtensor,
 )
 from .communication import (  # noqa: F401
     P2POp, ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all,

@@ -1,6 +1,9 @@
 """Semi-auto (DTensor-style) parallel API. Reference:
 python/paddle/distributed/auto_parallel/ (55 K LoC) — collapsed to
 NamedSharding + GSPMD on TPU."""
+from .high_level import (  # noqa: F401
+    DistModel, parallelize, shard_dataloader, to_static,
+)
 from .api import (  # noqa: F401
     ShardingStage0, ShardingStage1, ShardingStage2, ShardingStage3,
     dtensor_from_fn, get_placement_of, is_dist_tensor, reshard, shard_layer,

@@ -1,0 +1,190 @@
+"""Semi-auto parallel high-level APIs.
+
+Reference: python/paddle/distributed/auto_parallel/api.py —
+to_static/DistModel (:2798/:2189, wrap a dygraph layer + loader + loss +
+optimizer into a static distributed program) and shard_dataloader
+(:3323); intermediate/parallelize.py:21 (one-call `parallelize(model,
+opt, config)` composing tp/pp/dp plans).
+
+TPU-native: "static distributed program" = the compiled
+DistributedTrainStep (one donated jit with GSPMD shardings); DistModel
+wraps it with train()/eval()/predict() mode switches. parallelize()
+builds the global mesh from the config and applies the TP plan by
+swapping Linear/Embedding sublayers for their mpu counterparts.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ...core.dispatch import unwrap
+from .. import mesh as mesh_mod
+
+
+class DistModel:
+    """Reference api.py:2189. Modes: train (loss+backward+opt), eval
+    (loss only), predict (outputs only)."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None, metrics=None):
+        self.network = layer
+        self._loss = loss
+        self._opt = optimizer
+        self._mode = "train" if optimizer is not None else (
+            "eval" if loss is not None else "predict")
+        self._train_step = None
+
+    def train(self):
+        self._mode = "train"
+
+    def eval(self):
+        self._mode = "eval"
+
+    def predict(self):
+        self._mode = "predict"
+
+    def _ensure_step(self):
+        if self._train_step is None:
+            from ..parallel_step import DistributedTrainStep
+            # DistributedTrainStep unwraps _inner_opt itself
+            self._train_step = DistributedTrainStep(
+                self.network, self._loss, self._opt)
+        return self._train_step
+
+    def __call__(self, *args):
+        if self._mode == "train":
+            if self._opt is None or self._loss is None:
+                raise RuntimeError("train mode needs loss and optimizer")
+            inputs, labels = args[:-1], args[-1]
+            return self._ensure_step()(
+                inputs if len(inputs) > 1 else inputs[0], labels)
+        out = self.network(*args[:-1] if self._mode == "eval" else args)
+        if self._mode == "eval":
+            return self._loss(out, args[-1])
+        return out
+
+    def state_dict(self, mode="all"):
+        """mode: 'all' (params + optimizer state, reference default) |
+        'model' | 'opt'."""
+        out = {}
+        if mode in ("all", "model"):
+            out.update(self.network.state_dict())
+        if mode in ("all", "opt") and self._opt is not None:
+            for k, v in self._opt.state_dict().items():
+                out[f"opt.{k}"] = v
+        return out
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None,
+              strategy=None, input_spec=None):
+    """Reference api.py:2798 dist.to_static."""
+    return DistModel(layer, loader, loss, optimizer, strategy)
+
+
+class _ShardedLoader:
+    def __init__(self, loader, axes):
+        self._loader = loader
+        self._axes = axes
+
+    def __iter__(self):
+        from ..fleet.layers.mpu.mp_ops import mark_sharding
+        entry = tuple(self._axes) if len(self._axes) > 1 else \
+            self._axes[0]
+
+        def place(t):
+            ndim = len(t.shape)
+            if ndim == 0:
+                return t
+            return mark_sharding(t, entry, *([None] * (ndim - 1)))
+
+        for batch in self._loader:
+            if isinstance(batch, dict):
+                yield {k: place(v) for k, v in batch.items()}
+            elif isinstance(batch, (list, tuple)):
+                yield [place(t) for t in batch]
+            else:
+                yield place(batch)
+
+    def __len__(self):
+        return len(self._loader)
+
+
+def shard_dataloader(dataloader, meshes=None, shard_dims=None,
+                     is_dataset_splitted=False):
+    """Reference api.py:3323: yield batches sharded over the data axes
+    of the mesh (batch dim 0 split across dp/sharding)."""
+    axes = shard_dims if shard_dims is not None else \
+        mesh_mod.data_axes() or ["dp"]
+    if isinstance(axes, str):
+        axes = [axes]
+    return _ShardedLoader(dataloader, list(axes))
+
+
+def parallelize(model, optimizer=None, config: Optional[Dict] = None):
+    """Reference intermediate/parallelize.py:21 — one call builds the
+    mesh from dp/mp/pp degrees and applies the TP plan (named sublayers
+    swapped to Column/Row/VocabParallel)."""
+    config = config or {}
+    dp = int(config.get("dp_config", {}).get("dp_degree",
+             config.get("dp_degree", 1)))
+    mp_cfg = config.get("mp_config", {})
+    mp = int(mp_cfg.get("mp_degree", config.get("mp_degree", 1)))
+    pp = int(config.get("pp_config", {}).get("pp_degree",
+             config.get("pp_degree", 1)))
+    sharding = int(config.get("sharding_config", {}).get(
+        "sharding_degree", config.get("sharding_degree", 1)))
+    mesh_mod.set_mesh(mesh_mod.build_mesh(
+        {"dp": dp, "mp": mp, "pp": pp, "sharding": sharding}))
+
+    plan = mp_cfg.get("parallelize_plan", {})
+    if plan and mp > 1:
+        _apply_tp_plan(model, plan)
+    if optimizer is not None and sharding > 1:
+        from ..fleet.meta_parallel.parallel_wrappers import \
+            shard_parameters_fsdp
+        shard_parameters_fsdp(model, axis="sharding")
+    return model, optimizer
+
+
+def _apply_tp_plan(model, plan: Dict[str, str]):
+    """plan: {sublayer name glob -> 'ColWiseParallel'|'RowWiseParallel'}
+    (reference intermediate/tensor_parallel.py plan names)."""
+    import fnmatch
+
+    from ...nn.layer.common import Embedding, Linear
+    from ..fleet.layers.mpu import (ColumnParallelLinear,
+                                    RowParallelLinear,
+                                    VocabParallelEmbedding)
+
+    def visit(layer, prefix=""):
+        for name, sub in list(layer._sub_layers.items()):
+            full = f"{prefix}.{name}" if prefix else name
+            kind = None
+            for pat, k in plan.items():
+                if fnmatch.fnmatch(full, pat):
+                    kind = k
+                    break
+            if kind and isinstance(sub, Linear):
+                in_f, out_f = sub._in_features, sub._out_features
+                has_bias = sub.bias is not None
+                if "Col" in kind:
+                    new = ColumnParallelLinear(in_f, out_f,
+                                               has_bias=has_bias,
+                                               gather_output=False)
+                else:
+                    new = RowParallelLinear(in_f, out_f,
+                                            has_bias=has_bias,
+                                            input_is_parallel=True)
+                new.weight.set_value(unwrap(sub.weight))
+                if has_bias:
+                    new.bias.set_value(unwrap(sub.bias))
+                layer._sub_layers[name] = new
+            elif kind and isinstance(sub, Embedding):
+                new = VocabParallelEmbedding(sub._num_embeddings,
+                                             sub._embedding_dim)
+                new.weight.set_value(unwrap(sub.weight))
+                layer._sub_layers[name] = new
+            else:
+                visit(sub, full)
+
+    visit(model)
+    return model

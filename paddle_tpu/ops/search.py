@@ -141,8 +141,9 @@ def viterbi_decode(potentials, transition_params, lengths=None,
     potentials: [B, T, N] emission scores; transition_params: [N, N];
     lengths: [B] valid lengths (padded steps are no-ops, their path
     entries repeat the final state). include_bos_eos_tag (default True,
-    matching the reference) treats the last two tags as SOS/EOS — the
-    transition matrix must then include those two extra tags.
+    matching the reference): the LAST transition row/column is the start
+    tag and the second-to-last is the stop tag, so the transition matrix
+    includes those two extra tags.
     Returns (scores [B], paths [B, T]).
     """
     args = [potentials, transition_params]
@@ -156,7 +157,9 @@ def viterbi_decode(potentials, transition_params, lengths=None,
         def decode_one(e, n_valid):
             score0 = e[0]
             if include_bos_eos_tag:
-                score0 = score0 + trans[-2]  # SOS -> tag
+                # reference convention (text/viterbi_decode.py:47): LAST
+                # row = start tag, SECOND-TO-LAST column = stop tag
+                score0 = score0 + trans[-1]  # start -> tag
 
             def body(carry, xs):
                 score = carry
@@ -173,7 +176,7 @@ def viterbi_decode(potentials, transition_params, lengths=None,
             final, backptrs = jax.lax.scan(
                 body, score0, (e[1:], jnp.arange(1, T)))
             if include_bos_eos_tag:
-                final = final + trans[:, -1]  # tag -> EOS
+                final = final + trans[:, -2]  # tag -> stop
             last = jnp.argmax(final)
 
             def back(carry, ptr_t):

@@ -221,3 +221,74 @@ def test_distributed_train_step_matches_single(hybrid_mesh):
 def test_dryrun_multichip_8():
     from paddle_tpu.distributed.dryrun import run_dryrun
     run_dryrun(8)
+
+
+def test_dist_model_to_static_trains(hybrid_mesh):
+    paddle.seed(7)
+    import paddle_tpu.nn as nn
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    dm = dist.to_static(net, loss=nn.CrossEntropyLoss(), optimizer=opt)
+    rng = np.random.default_rng(7)
+    x = paddle.to_tensor(rng.standard_normal((8, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 4, 8))
+    with jax.set_mesh(hybrid_mesh):
+        l0 = float(dm(x, y).numpy())
+        for _ in range(3):
+            l1 = float(dm(x, y).numpy())
+    assert np.isfinite(l0) and l1 < l0
+    dm.eval()
+    with jax.set_mesh(hybrid_mesh):
+        le = float(dm(x, y).numpy())
+    assert np.isfinite(le)
+
+
+def test_parallelize_applies_tp_plan(hybrid_mesh):
+    paddle.seed(8)
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.fleet.layers.mpu import (
+        ColumnParallelLinear, RowParallelLinear)
+
+    class Block(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.up = nn.Linear(8, 32)
+            self.down = nn.Linear(32, 8)
+
+        def forward(self, x):
+            return self.down(paddle.nn.functional.gelu(self.up(x)))
+
+    net = Block()
+    x = paddle.to_tensor(np.random.default_rng(8).standard_normal(
+        (2, 8)).astype(np.float32))
+    with jax.set_mesh(hybrid_mesh):
+        ref = np.asarray(net(x).numpy())
+    net2, _ = dist.parallelize(net, config={
+        "dp_degree": 2, "sharding_degree": 2,
+        "mp_config": {"mp_degree": 2, "parallelize_plan": {
+            "up": "ColWiseParallel", "down": "RowWiseParallel"}}})
+    assert isinstance(net2.up, ColumnParallelLinear)
+    assert isinstance(net2.down, RowParallelLinear)
+    from paddle_tpu.distributed import mesh as mesh_mod
+    with jax.set_mesh(mesh_mod.get_mesh()):
+        out = np.asarray(net2(x).numpy())
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_shard_dataloader(hybrid_mesh):
+    import paddle_tpu.io as io
+
+    class DS(io.Dataset):
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            return np.full(4, i, np.float32), np.int64(i % 2)
+
+    loader = io.DataLoader(DS(), batch_size=8)
+    with jax.set_mesh(hybrid_mesh):
+        sharded = dist.shard_dataloader(loader)
+        batches = list(sharded)
+    assert len(batches) == 2
+    xb, yb = batches[0]
+    assert list(xb.shape) == [8, 4]
