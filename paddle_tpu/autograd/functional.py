@@ -20,19 +20,21 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     """paddle.grad — tape-based partial derivative query."""
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-    # save/restore existing leaf grads so paddle.grad doesn't pollute .grad
-    saved = [t.grad for t in inputs]
-    for t in inputs:
-        t.grad = None
+    # grads collect into a sink dict: paddle.grad must leave every
+    # tensor's .grad untouched (including NON-input leaves)
+    sink = {}
     tape_mod.backward(list(outputs), grad_outputs,
                       retain_graph=True if retain_graph is None
-                      else retain_graph)
+                      else retain_graph,
+                      create_graph=create_graph, grad_sink=sink,
+                      capture_ids=frozenset(id(t) for t in inputs))
     results = []
-    for t, old in zip(inputs, saved):
-        g = t.grad
+    for t in inputs:
+        g = sink.get(id(t))
+        if g is not None and not isinstance(g, Tensor):
+            g = Tensor._from_array(g, stop_gradient=True)
         if g is None and not allow_unused:
             g = Tensor._from_array(jnp.zeros_like(t._data))
-        t.grad = old
         results.append(g)
     return results
 

@@ -143,6 +143,10 @@ def run_op(name: str, fn: Callable, tensor_args: Sequence[Any], **attrs):
     node = tape_mod.TapeNode(
         name, adapted_vjp, input_metas, input_tensors,
         [(a.shape, a.dtype) for a in flat_out])
+    # for create_graph=True double-backward: the pure forward closure and
+    # output structure let the tape re-linearize this op AS tape ops
+    node.op_closed = closed
+    node.out_treedef = treedef
 
     out_tensors = []
     for k, a in enumerate(flat_out):

@@ -38,11 +38,14 @@ class TapeNode:
     """One recorded op (reference: GradNodeBase, grad_node_info.h:197)."""
 
     __slots__ = ("name", "vjp_fn", "input_metas", "input_tensors",
-                 "out_avals", "grad_buffer", "pending", "visited")
+                 "out_avals", "grad_buffer", "pending", "visited",
+                 "op_closed", "out_treedef")
 
     def __init__(self, name, vjp_fn, input_metas, input_tensors, out_avals):
         self.name = name
         self.vjp_fn = vjp_fn
+        self.op_closed = None     # pure forward closure (create_graph)
+        self.out_treedef = None
         # metas of the differentiable inputs, aligned with vjp results
         self.input_metas = input_metas
         # strong refs to leaf tensors so .grad survives
@@ -97,13 +100,10 @@ def _zeros_cotangent(shape, dt):
     return np.zeros(shape, dtype=jax.dtypes.float0)
 
 
-def backward(tensors, grad_tensors=None, retain_graph=False):
-    """Run reverse-mode AD from `tensors` (reference: backward.cc:439).
 
-    Accumulates into each reachable leaf tensor's ``.grad``.
-    """
+def _classify_roots(tensors, grad_tensors, make_seed):
+    """Seed classification shared by both backward sweeps."""
     import jax.numpy as jnp
-    from .tensor import Tensor
 
     if not isinstance(tensors, (list, tuple)):
         tensors = [tensors]
@@ -111,29 +111,26 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
         grad_tensors = [None] * len(tensors)
     elif not isinstance(grad_tensors, (list, tuple)):
         grad_tensors = [grad_tensors]
-
-    # Seed gradients.
-    roots = []  # (node, output_index, seed) or leaf tensors
-    leaf_seeds = []
+    roots, leaf_seeds = [], []
     for t, g in zip(tensors, grad_tensors):
         if t._meta is None or (t._meta.node is None and t.stop_gradient):
             raise RuntimeError(
-                f"Tensor {t.name or ''} has stop_gradient=True and no grad "
-                "history; backward() from it is meaningless")
-        if g is None:
-            if t.size != 1:
-                raise RuntimeError(
-                    "grad must be provided for non-scalar backward root "
-                    f"(shape {t.shape})")
-            seed = jnp.ones_like(t._data)
-        else:
-            seed = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+                f"Tensor {t.name or ''} has stop_gradient=True and no "
+                "grad history; backward() from it is meaningless")
+        if g is None and t.size != 1:
+            raise RuntimeError(
+                "grad must be provided for non-scalar backward root "
+                f"(shape {t.shape})")
+        seed = make_seed(t, g)
         if t._meta.node is None:
             leaf_seeds.append((t, seed))
         else:
             roots.append((t._meta.node, t._meta.output_index, seed))
+    return roots, leaf_seeds
 
-    # Discover the reachable graph and count consumers per node.
+
+def _collect_graph(roots):
+    """Reachability sweep + per-node consumer counts."""
     visited = set()
     stack = [n for (n, _, _) in roots]
     topo_nodes = []
@@ -151,11 +148,49 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
         for meta in node.input_metas:
             if meta is not None and meta.node is not None:
                 pending[id(meta.node)] = pending.get(id(meta.node), 0) + 1
+    return topo_nodes, pending
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False,
+             create_graph=False, grad_sink=None, capture_ids=None):
+    """Run reverse-mode AD from `tensors` (reference: backward.cc:439).
+
+    Accumulates into each reachable leaf tensor's ``.grad`` — or, when
+    `grad_sink` (a dict) is given, into grad_sink[id(tensor)] so the
+    query leaves every .grad untouched (paddle.grad contract).
+    create_graph=True runs every node's backward AS tape ops (by
+    re-linearizing the stored forward closure), so the produced grads
+    are themselves differentiable — paddle double-backward semantics
+    (reference eager_gen higher-order GradNodes).
+    """
+    import jax.numpy as jnp
+    from .tensor import Tensor
+
+    if create_graph:
+        return _backward_create_graph(tensors, grad_tensors,
+                                      grad_sink=grad_sink,
+                                      capture_ids=capture_ids)
+
+    def make_seed(t, g):
+        if g is None:
+            return jnp.ones_like(t._data)
+        return g._data if isinstance(g, Tensor) else jnp.asarray(g)
+
+    roots, leaf_seeds = _classify_roots(tensors, grad_tensors, make_seed)
+    topo_nodes, pending = _collect_graph(roots)
+    capture_ids = capture_ids or frozenset()
+
+    def sink_leaf(t, g):
+        if grad_sink is not None:
+            cur = grad_sink.get(id(t))
+            grad_sink[id(t)] = g if cur is None else cur + g
+        else:
+            _accumulate_leaf(t, g)
 
     for node, idx, seed in roots:
         node.add_grad(idx, seed)
     for t, seed in leaf_seeds:
-        _accumulate_leaf(t, seed)
+        sink_leaf(t, seed)
 
     ready = [n for (n, _, _) in roots if pending.get(id(n), 0) == 0]
     # de-dup ready list
@@ -188,8 +223,14 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
                     g = out._data if isinstance(out, Tensor) else out
             if meta.node is None:
                 if tensor is not None:
-                    _accumulate_leaf(tensor, g)
+                    sink_leaf(tensor, g)
             else:
+                # paddle.grad can query INTERMEDIATE tensors: capture
+                # their cotangent contributions while still propagating
+                if tensor is not None and grad_sink is not None and \
+                        id(tensor) in capture_ids:
+                    cur = grad_sink.get(id(tensor))
+                    grad_sink[id(tensor)] = g if cur is None else cur + g
                 meta.node.add_grad(meta.output_index, g)
                 cnt = pending.get(id(meta.node), 0) - 1
                 pending[id(meta.node)] = cnt
@@ -205,6 +246,7 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
             # reference releases TensorWrappers the same way
             # (paddle/fluid/eager/tensor_wrapper.h).
             node.vjp_fn = _used_vjp
+            node.op_closed = None  # closes over forward inputs too
             node.input_tensors = [None] * len(node.input_tensors)
             node.input_metas = [None] * len(node.input_metas)
 
@@ -229,3 +271,123 @@ def _accumulate_leaf(tensor, g):
         tensor.grad.name = (tensor.name or "") + "@GRAD"
     else:
         tensor.grad._data = tensor.grad._data + g
+
+
+def _backward_create_graph(tensors, grad_tensors=None, grad_sink=None,
+                           capture_ids=None):
+    """Differentiable backward: each node's vjp is recomputed as ONE tape
+    op (jax.vjp of the stored forward closure, differentiable wrt both
+    the node's original inputs and the incoming cotangents), so the
+    accumulated .grad tensors carry their own grad history."""
+    import jax
+    import jax.numpy as jnp
+
+    from .dispatch import run_op
+    from .tensor import Tensor
+
+    def make_seed(t, g):
+        if g is None:
+            return Tensor._from_array(jnp.ones_like(t._data))
+        return g if isinstance(g, Tensor) else Tensor._from_array(
+            jnp.asarray(g))
+
+    roots, leaf_seeds = _classify_roots(tensors, grad_tensors, make_seed)
+    topo_nodes, pending = _collect_graph(roots)
+    capture_ids = capture_ids or frozenset()
+
+    # Tensor-valued cotangent buffers, per node
+    buffers = {id(n): [None] * len(n.out_avals) for n in topo_nodes}
+
+    def add_ct(buf, idx, g):
+        buf[idx] = g if buf[idx] is None else buf[idx] + g
+
+    def accumulate_leaf(t, g):
+        if grad_sink is not None:
+            cur = grad_sink.get(id(t))
+            grad_sink[id(t)] = g if cur is None else cur + g
+            return
+        if t.grad is None:
+            t.grad = g
+            t.grad.name = (t.name or "") + "@GRAD"
+        else:
+            t.grad = t.grad + g
+
+    for node, idx, seed in roots:
+        add_ct(buffers[id(node)], idx, seed)
+    for t, seed in leaf_seeds:
+        accumulate_leaf(t, seed)
+
+    ready = [n for (n, _, _) in roots if pending.get(id(n), 0) == 0]
+    seen_ready = set(id(n) for n in ready)
+    done = set()
+    while ready:
+        node = ready.pop()
+        if id(node) in done:
+            continue
+        done.add(id(node))
+        if getattr(node, "op_closed", None) is None:
+            raise RuntimeError(
+                f"node {node.name} predates create_graph support; rerun "
+                "the forward before double-backward")
+        buf = buffers[id(node)]
+        cts = []
+        for g, (shape, dt) in zip(buf, node.out_avals):
+            if g is not None:
+                cts.append(g)
+            elif np.issubdtype(np.dtype(dt), np.inexact):
+                cts.append(Tensor._from_array(jnp.zeros(shape, dt)))
+            else:
+                cts.append(None)  # float0 handled inside the pure fn
+        n_prim = len(node.input_tensors)
+        td = node.out_treedef
+        closed = node.op_closed
+        avals = node.out_avals
+        live_ct_idx = [i for i, c in enumerate(cts) if c is not None]
+
+        def pure(*arrays, _closed=closed, _td=td, _n=n_prim,
+                 _avals=avals, _live=tuple(live_ct_idx)):
+            prim = arrays[:_n]
+            given = arrays[_n:]
+            flat = []
+            it = iter(given)
+            for i, (shape, dt) in enumerate(_avals):
+                if i in _live:
+                    flat.append(next(it))
+                else:
+                    flat.append(np.zeros(shape, dtype=jax.dtypes.float0))
+            _, vjp = jax.vjp(_closed, *prim)
+            # tree_unflatten handles the single-leaf case too (a leaf
+            # treedef unflattens to the bare value)
+            out = vjp(jax.tree_util.tree_unflatten(_td, flat))
+            return tuple(out)
+
+        args = list(node.input_tensors) + [cts[i] for i in live_ct_idx]
+        grads = run_op(f"grad:{node.name}", pure, args)
+        if not isinstance(grads, (list, tuple)):
+            grads = (grads,)
+        for meta, tensor, g in zip(node.input_metas, node.input_tensors,
+                                   grads):
+            if meta is None or g is None:
+                continue
+            for hook in meta.hooks:
+                out = hook(g)
+                if out is not None:
+                    # hooks may return raw arrays (normal-path contract);
+                    # rewrap — note a raw return severs the second-order
+                    # path through that edge by construction
+                    g = out if isinstance(out, Tensor) else \
+                        Tensor._from_array(jnp.asarray(out))
+            if meta.node is None:
+                if tensor is not None:
+                    accumulate_leaf(tensor, g)
+            else:
+                if tensor is not None and grad_sink is not None and \
+                        id(tensor) in capture_ids:
+                    cur = grad_sink.get(id(tensor))
+                    grad_sink[id(tensor)] = g if cur is None else cur + g
+                add_ct(buffers[id(meta.node)], meta.output_index, g)
+                cnt = pending.get(id(meta.node), 0) - 1
+                pending[id(meta.node)] = cnt
+                if cnt <= 0 and id(meta.node) not in seen_ready:
+                    seen_ready.add(id(meta.node))
+                    ready.append(meta.node)

@@ -124,3 +124,55 @@ def test_matmul_chain_grad_matches_jax():
     loss.backward()
     ref = jax.grad(lambda W: jnp.tanh(x @ W).sum())(w)
     np.testing.assert_allclose(tw.grad.numpy(), np.asarray(ref), rtol=1e-5)
+
+
+def test_double_backward_create_graph():
+    """paddle.grad(create_graph=True) grads are differentiable
+    (reference double-grad; VERDICT r1 gap)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.autograd.functional import grad as pgrad
+
+    x = paddle.to_tensor(np.array(2.0, np.float32))
+    x.stop_gradient = False
+    y = x * x * x
+    (g,) = pgrad(y, [x], create_graph=True)
+    assert float(g.numpy()) == 12.0          # 3x^2
+    assert not g.stop_gradient
+    g.backward()
+    assert abs(float(x.grad.numpy()) - 12.0) < 1e-5  # 6x
+
+
+def test_grad_does_not_pollute_other_leaves():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.autograd.functional import grad as pgrad
+
+    w = paddle.to_tensor(np.array(3.0, np.float32))
+    x = paddle.to_tensor(np.array(1.5, np.float32))
+    w.stop_gradient = False
+    x.stop_gradient = False
+    out = w * x * x
+    (gx,) = pgrad(out, [x], create_graph=True)
+    assert w.grad is None and x.grad is None
+    # WGAN-GP pattern: d/dw (2wx - 1)^2 = 2(2wx-1)*2x = 48
+    penalty = (gx - 1.0) * (gx - 1.0)
+    penalty.backward()
+    assert abs(float(w.grad.numpy()) - 48.0) < 1e-4
+
+
+def test_grad_wrt_intermediate_tensor():
+    """paddle.grad must return real grads for intermediate inputs
+    (review regression: silently returned zeros)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.autograd.functional import grad as pgrad
+
+    x = paddle.to_tensor(np.array(2.0, np.float32))
+    x.stop_gradient = False
+    h = x * x
+    y = h * h * h
+    (gh,) = pgrad(y, [h])
+    assert abs(float(gh.numpy()) - 48.0) < 1e-4  # 3h^2, h=4
+    (gh2,) = pgrad(y, [h], create_graph=True)
+    assert abs(float(gh2.numpy()) - 48.0) < 1e-4
