@@ -101,8 +101,17 @@ def _zeros_cotangent(shape, dt):
 
 
 
+def _sink_add(grad_sink, t, g):
+    """Accumulate g into grad_sink[id(t)] (paddle.grad capture)."""
+    cur = grad_sink.get(id(t))
+    grad_sink[id(t)] = g if cur is None else cur + g
+
+
 def _classify_roots(tensors, grad_tensors, make_seed):
-    """Seed classification shared by both backward sweeps."""
+    """Seed classification shared by both backward sweeps. Returns
+    (roots, leaf_seeds, root_seeds) — root_seeds pairs each NON-leaf
+    root tensor with its seed so paddle.grad can capture a root that is
+    also a query input (grad of y wrt y)."""
     import jax.numpy as jnp
 
     if not isinstance(tensors, (list, tuple)):
@@ -111,7 +120,7 @@ def _classify_roots(tensors, grad_tensors, make_seed):
         grad_tensors = [None] * len(tensors)
     elif not isinstance(grad_tensors, (list, tuple)):
         grad_tensors = [grad_tensors]
-    roots, leaf_seeds = [], []
+    roots, leaf_seeds, root_seeds = [], [], []
     for t, g in zip(tensors, grad_tensors):
         if t._meta is None or (t._meta.node is None and t.stop_gradient):
             raise RuntimeError(
@@ -126,7 +135,8 @@ def _classify_roots(tensors, grad_tensors, make_seed):
             leaf_seeds.append((t, seed))
         else:
             roots.append((t._meta.node, t._meta.output_index, seed))
-    return roots, leaf_seeds
+            root_seeds.append((t, seed))
+    return roots, leaf_seeds, root_seeds
 
 
 def _collect_graph(roots):
@@ -176,14 +186,18 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
             return jnp.ones_like(t._data)
         return g._data if isinstance(g, Tensor) else jnp.asarray(g)
 
-    roots, leaf_seeds = _classify_roots(tensors, grad_tensors, make_seed)
+    roots, leaf_seeds, root_seeds = _classify_roots(
+        tensors, grad_tensors, make_seed)
     topo_nodes, pending = _collect_graph(roots)
     capture_ids = capture_ids or frozenset()
+    if grad_sink is not None:
+        for t, seed in root_seeds:
+            if id(t) in capture_ids:
+                _sink_add(grad_sink, t, seed)
 
     def sink_leaf(t, g):
         if grad_sink is not None:
-            cur = grad_sink.get(id(t))
-            grad_sink[id(t)] = g if cur is None else cur + g
+            _sink_add(grad_sink, t, g)
         else:
             _accumulate_leaf(t, g)
 
@@ -229,8 +243,7 @@ def backward(tensors, grad_tensors=None, retain_graph=False,
                 # their cotangent contributions while still propagating
                 if tensor is not None and grad_sink is not None and \
                         id(tensor) in capture_ids:
-                    cur = grad_sink.get(id(tensor))
-                    grad_sink[id(tensor)] = g if cur is None else cur + g
+                    _sink_add(grad_sink, tensor, g)
                 meta.node.add_grad(meta.output_index, g)
                 cnt = pending.get(id(meta.node), 0) - 1
                 pending[id(meta.node)] = cnt
@@ -291,9 +304,14 @@ def _backward_create_graph(tensors, grad_tensors=None, grad_sink=None,
         return g if isinstance(g, Tensor) else Tensor._from_array(
             jnp.asarray(g))
 
-    roots, leaf_seeds = _classify_roots(tensors, grad_tensors, make_seed)
+    roots, leaf_seeds, root_seeds = _classify_roots(
+        tensors, grad_tensors, make_seed)
     topo_nodes, pending = _collect_graph(roots)
     capture_ids = capture_ids or frozenset()
+    if grad_sink is not None:
+        for t, seed in root_seeds:
+            if id(t) in capture_ids:
+                _sink_add(grad_sink, t, seed)
 
     # Tensor-valued cotangent buffers, per node
     buffers = {id(n): [None] * len(n.out_avals) for n in topo_nodes}
@@ -303,8 +321,7 @@ def _backward_create_graph(tensors, grad_tensors=None, grad_sink=None,
 
     def accumulate_leaf(t, g):
         if grad_sink is not None:
-            cur = grad_sink.get(id(t))
-            grad_sink[id(t)] = g if cur is None else cur + g
+            _sink_add(grad_sink, t, g)
             return
         if t.grad is None:
             t.grad = g
@@ -327,8 +344,10 @@ def _backward_create_graph(tensors, grad_tensors=None, grad_sink=None,
         done.add(id(node))
         if getattr(node, "op_closed", None) is None:
             raise RuntimeError(
-                f"node {node.name} predates create_graph support; rerun "
-                "the forward before double-backward")
+                f"op {node.name!r} does not support create_graph=True "
+                "(PyLayer/custom nodes record no re-linearizable forward;"
+                " use jax-level transforms via autograd.functional.vjp "
+                "for higher-order grads through custom ops)")
         buf = buffers[id(node)]
         cts = []
         for g, (shape, dt) in zip(buf, node.out_avals):
@@ -383,8 +402,7 @@ def _backward_create_graph(tensors, grad_tensors=None, grad_sink=None,
             else:
                 if tensor is not None and grad_sink is not None and \
                         id(tensor) in capture_ids:
-                    cur = grad_sink.get(id(tensor))
-                    grad_sink[id(tensor)] = g if cur is None else cur + g
+                    _sink_add(grad_sink, tensor, g)
                 add_ct(buffers[id(meta.node)], meta.output_index, g)
                 cnt = pending.get(id(meta.node), 0) - 1
                 pending[id(meta.node)] = cnt

@@ -139,6 +139,7 @@ def run_dryrun(n_devices: int) -> None:
     _dryrun_pipeline(jax, n_devices)
     _dryrun_moe(jax, n_devices)
     _dryrun_context_parallel(jax, n_devices)
+    _dryrun_hybrid_3d(jax, n_devices)
 
 
 def _dryrun_pipeline(jax, n_devices: int) -> None:
@@ -281,4 +282,56 @@ def _dryrun_context_parallel(jax, n_devices: int) -> None:
         l1 = float(step(x, y).numpy())
     assert np.isfinite(l0) and np.isfinite(l1), (l0, l1)
     print(f"dryrun sep ok: sep={sep} dp={dp} loss0={l0:.4f} "
+          f"loss1={l1:.4f}")
+
+
+def _dryrun_hybrid_3d(jax, n_devices: int) -> None:
+    """Phase 5: the BASELINE config-4 composition — TP blocks inside the
+    compiled pipeline on a pp x dp x mp mesh."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        ColumnParallelLinear, LayerDesc, PipelineLayer, PipelineParallel,
+        RowParallelLinear)
+
+    if n_devices % 8 != 0:
+        print("dryrun 3d: skipped (needs a multiple of 8 devices)")
+        return
+    dp = n_devices // 4
+    mesh_mod.set_mesh(mesh_mod.build_mesh({"pp": 2, "dp": dp, "mp": 2}))
+
+    hidden, batch = 16, 4 * dp
+    paddle.seed(0)
+
+    class TPBlock(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.up = ColumnParallelLinear(hidden, 4 * hidden,
+                                           gather_output=False)
+            self.down = RowParallelLinear(4 * hidden, hidden,
+                                          input_is_parallel=True)
+
+        def forward(self, x):
+            return x + self.down(
+                paddle.nn.functional.gelu(self.up(x)))
+
+    pl = PipelineLayer(layers=[LayerDesc(TPBlock) for _ in range(4)],
+                       num_stages=2, loss_fn=nn.MSELoss())
+    strategy = fleet.DistributedStrategy()
+    strategy.pipeline_configs["accumulate_steps"] = 2
+    model = PipelineParallel(pl, strategy=strategy)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=pl.parameters())
+    rng = np.random.default_rng(4)
+    x = paddle.to_tensor(rng.standard_normal(
+        (batch, hidden)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal(
+        (batch, hidden)).astype(np.float32))
+    with jax.set_mesh(mesh_mod.get_mesh()):
+        l0 = float(model.train_batch((x, y), opt).numpy())
+        l1 = float(model.train_batch((x, y), opt).numpy())
+    assert np.isfinite(l0) and np.isfinite(l1), (l0, l1)
+    assert l1 < l0, (l0, l1)  # deterministic seed: one step must improve
+    print(f"dryrun 3d ok: pp=2 dp={dp} mp=2 loss0={l0:.4f} "
           f"loss1={l1:.4f}")

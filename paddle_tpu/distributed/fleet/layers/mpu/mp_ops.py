@@ -36,6 +36,14 @@ def _constrain(arr, *entries):
         return arr
     entries = [_norm_entry(e, mesh) for e in list(entries)[:arr.ndim]]
     if isinstance(arr, jax.core.Tracer):
+        # a bare PartitionSpec resolves against the AMBIENT mesh, whose
+        # axis types reflect shard_map manual regions (a concrete
+        # NamedSharding would mark e.g. 'pp' Auto and fail inside the
+        # compiled pipeline body); with no ambient mesh (plain jit
+        # without jax.set_mesh) use the concrete NamedSharding
+        if not jax.sharding.get_abstract_mesh().empty:
+            return jax.lax.with_sharding_constraint(
+                arr, PartitionSpec(*entries))
         sharding = NamedSharding(mesh, PartitionSpec(*entries))
         return jax.lax.with_sharding_constraint(arr, sharding)
     # device_put can't take UNCONSTRAINED: replicate those dims eagerly

@@ -176,3 +176,19 @@ def test_grad_wrt_intermediate_tensor():
     assert abs(float(gh.numpy()) - 48.0) < 1e-4  # 3h^2, h=4
     (gh2,) = pgrad(y, [h], create_graph=True)
     assert abs(float(gh2.numpy()) - 48.0) < 1e-4
+
+
+def test_grad_of_root_wrt_itself():
+    """paddle.grad(y, [y]) returns the seed, not zeros (review
+    regression)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.autograd.functional import grad as pgrad
+
+    x = paddle.to_tensor(np.array(3.0, np.float32))
+    x.stop_gradient = False
+    y = x * x
+    (gy,) = pgrad(y, [y])
+    assert float(gy.numpy()) == 1.0
+    (gy2,) = pgrad(y, [y], create_graph=True)
+    assert float(gy2.numpy()) == 1.0

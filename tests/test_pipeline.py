@@ -183,3 +183,15 @@ def test_new_group_world_ranks(pp_mesh):
     import paddle_tpu.distributed as dist
     g = dist.new_group(list(range(8)))
     assert set(g.axis_names) == set(pp_mesh.axis_names)
+
+
+def test_tp_inside_pipeline_3d():
+    """TP blocks inside the compiled pipeline (BASELINE config-4 shape:
+    pp x dp x mp) — reuses the dryrun phase-5 harness so the test always
+    exercises exactly what the driver runs."""
+    from paddle_tpu.distributed.dryrun import _dryrun_hybrid_3d
+    prev = mesh_mod.get_mesh()
+    try:
+        _dryrun_hybrid_3d(jax, 8)
+    finally:
+        mesh_mod._global_mesh = prev
