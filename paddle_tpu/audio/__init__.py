@@ -1,0 +1,4 @@
+"""paddle.audio parity surface (reference python/paddle/audio:
+features/functional over the stft kernels)."""
+from . import features  # noqa: F401
+from . import functional  # noqa: F401

@@ -1,0 +1,117 @@
+"""Audio functional ops (reference python/paddle/audio/functional:
+window functions, mel frequency helpers)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import run_op, unwrap, wrap
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True,
+               dtype: str = "float32"):
+    """hann/hamming/blackman/bartlett/rect windows (reference
+    audio/functional/window.py)."""
+    n = win_length
+    m = n if not fftbins else n + 1
+    if m < 2:  # degenerate 1-sample window (scipy returns [1.0])
+        return wrap(jnp.ones(n, jnp.dtype(dtype)))
+    k = np.arange(m)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * k / (m - 1))
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * k / (m - 1))
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * k / (m - 1))
+             + 0.08 * np.cos(4 * np.pi * k / (m - 1)))
+    elif window == "bartlett":
+        w = 1.0 - np.abs(2 * k / (m - 1) - 1)
+    elif window in ("rect", "boxcar", "ones"):
+        w = np.ones(m)
+    else:
+        raise ValueError(f"unknown window {window!r}")
+    w = w[:n] if fftbins else w
+    return wrap(jnp.asarray(w, jnp.dtype(dtype)))
+
+
+def hz_to_mel(freq, htk: bool = False):
+    f = np.asarray(unwrap(freq), np.float64)
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep, out)
+    return float(out) if out.ndim == 0 else wrap(jnp.asarray(out))
+
+
+def mel_to_hz(mel, htk: bool = False):
+    m = np.asarray(unwrap(mel), np.float64)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = np.where(m >= min_log_mel,
+                       min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                       out)
+    return float(out) if out.ndim == 0 else wrap(jnp.asarray(out))
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max=None, htk: bool = False,
+                         norm: str = "slaney", dtype: str = "float32"):
+    """Mel filterbank [n_mels, n_fft//2+1] (reference
+    audio/functional/functional.py compute_fbank_matrix)."""
+    f_max = f_max or sr / 2
+    n_freqs = n_fft // 2 + 1
+    freqs = np.linspace(0, sr / 2, n_freqs)
+    mel_pts = np.linspace(float(np.asarray(hz_to_mel(f_min, htk))),
+                          float(np.asarray(hz_to_mel(f_max, htk))),
+                          n_mels + 2)
+    hz_pts = np.asarray(unwrap(mel_to_hz(mel_pts, htk)))
+    fb = np.zeros((n_mels, n_freqs))
+    for i in range(n_mels):
+        lo, ce, hi = hz_pts[i], hz_pts[i + 1], hz_pts[i + 2]
+        up = (freqs - lo) / max(ce - lo, 1e-10)
+        down = (hi - freqs) / max(hi - ce, 1e-10)
+        fb[i] = np.maximum(0.0, np.minimum(up, down))
+    if norm == "slaney":
+        enorm = 2.0 / (hz_pts[2:] - hz_pts[:-2])
+        fb *= enorm[:, None]
+    return wrap(jnp.asarray(fb, jnp.dtype(dtype)))
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm="ortho",
+               dtype: str = "float32"):
+    """DCT-II matrix [n_mels, n_mfcc] (reference create_dct)."""
+    k = np.arange(n_mels)
+    dct = np.cos(np.pi / n_mels * (k[:, None] + 0.5)
+                 * np.arange(n_mfcc)[None, :])
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return wrap(jnp.asarray(dct, jnp.dtype(dtype)))
+
+
+def power_to_db(magnitude, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db=80.0):
+    def fn(a):
+        db = 10.0 * jnp.log10(jnp.maximum(a, amin))
+        db -= 10.0 * jnp.log10(jnp.maximum(jnp.asarray(ref_value), amin))
+        if top_db is not None:
+            db = jnp.maximum(db, jnp.max(db) - top_db)
+        return db
+    return run_op("power_to_db", fn, [magnitude])

@@ -1,0 +1,58 @@
+"""Audio features tests (reference python/paddle/audio)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.audio.features import (MFCC, LogMelSpectrogram,
+                                       MelSpectrogram, Spectrogram)
+from paddle_tpu.audio.functional import (compute_fbank_matrix, get_window,
+                                         hz_to_mel, mel_to_hz)
+
+
+def _sig(n=2048):
+    t = np.linspace(0, 1, n)
+    return paddle.to_tensor((np.sin(2 * np.pi * 440 * t)
+                             ).astype(np.float32).reshape(1, n))
+
+
+def test_windows():
+    w = np.asarray(get_window("hann", 64).numpy())
+    assert w.shape == (64,) and w[0] == pytest.approx(0.0, abs=1e-6)
+    assert np.asarray(get_window("hamming", 32).numpy()).shape == (32,)
+    with pytest.raises(ValueError):
+        get_window("nope", 8)
+
+
+def test_mel_scale_roundtrip():
+    hz = 440.0
+    assert mel_to_hz(hz_to_mel(hz)) == pytest.approx(hz, rel=1e-6)
+    assert mel_to_hz(hz_to_mel(hz, htk=True), htk=True) == \
+        pytest.approx(hz, rel=1e-6)
+
+
+def test_fbank_shape_and_norm():
+    fb = np.asarray(compute_fbank_matrix(16000, 512, n_mels=40).numpy())
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all() and fb.sum() > 0
+
+
+def test_spectrogram_peak_at_tone():
+    spec = Spectrogram(n_fft=512, hop_length=128)
+    out = np.asarray(spec(_sig()).numpy())
+    assert out.shape[1] == 257
+    # 440 Hz tone sampled at 2048 Hz -> bin 440/2048*512 = 110
+    peak = out.mean(-1).argmax()
+    assert abs(int(peak) - 110) <= 2
+
+
+def test_mel_logmel_mfcc_shapes():
+    x = _sig()
+    mel = MelSpectrogram(sr=2048, n_fft=256, n_mels=32, f_min=0.0)
+    m = mel(x)
+    assert m.shape[1] == 32
+    lm = LogMelSpectrogram(sr=2048, n_fft=256, n_mels=32, f_min=0.0)
+    lo = np.asarray(lm(x).numpy())
+    assert np.isfinite(lo).all()
+    mfcc = MFCC(sr=2048, n_mfcc=13, n_mels=32, n_fft=256, f_min=0.0)
+    c = mfcc(x)
+    assert c.shape[1] == 13
