@@ -71,6 +71,7 @@ from . import distributed  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import text  # noqa: F401,E402
+from . import utils  # noqa: F401,E402
 from . import audio  # noqa: F401,E402
 from . import fft  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402

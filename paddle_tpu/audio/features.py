@@ -4,7 +4,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..core.dispatch import run_op, unwrap
+from ..core.dispatch import run_op
 from ..nn.layer.layers import Layer
 from ..signal import stft
 from .functional import (compute_fbank_matrix, create_dct, get_window,

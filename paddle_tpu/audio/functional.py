@@ -16,7 +16,7 @@ def get_window(window: str, win_length: int, fftbins: bool = True,
     audio/functional/window.py)."""
     n = win_length
     m = n if not fftbins else n + 1
-    if m < 2:  # degenerate 1-sample window (scipy returns [1.0])
+    if n < 2:  # degenerate 1-sample window (scipy returns [1.0])
         return wrap(jnp.ones(n, jnp.dtype(dtype)))
     k = np.arange(m)
     if window in ("hann", "hanning"):

@@ -1,4 +1,6 @@
 """paddle.text parity surface: in-tree text model families
 (reference keeps BERT/LLaMA/ERNIE in PaddleNLP; the in-tree analog is
 test/auto_parallel/hybrid_strategy/semi_auto_parallel_llama_model.py)."""
+from . import datasets  # noqa: F401
 from . import models  # noqa: F401
+from .datasets import Conll05st, Imdb, UCIHousing  # noqa: F401

@@ -1,0 +1,71 @@
+"""Text datasets (reference python/paddle/text/datasets: Imdb, UCIHousing,
+Conll05st, ...). No network egress exists in this environment, so data is
+deterministic synthetic with the reference's shapes/vocabulary structure
+— swap `generator=` for a real corpus loader in production."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class Imdb(Dataset):
+    """Binary sentiment dataset shape: (token_ids int64 [seq], label)."""
+
+    def __init__(self, mode: str = "train", cutoff: int = 150,
+                 num_samples: int = 1000, vocab_size: int = 5000,
+                 seq_len: int = 200):
+        seed = 0 if mode == "train" else 1
+        rng = np.random.default_rng(seed)
+        self._x = rng.integers(1, vocab_size, (num_samples, seq_len),
+                               dtype=np.int64)
+        # cutoff ≈ the reference's rare-word frequency cutoff: the
+        # `cutoff` highest token ids are mapped to OOV (id 0)
+        oov_from = max(1, vocab_size - int(cutoff))
+        self._x = np.where(self._x >= oov_from, 0, self._x)
+        self._y = rng.integers(0, 2, num_samples, dtype=np.int64)
+        self.word_idx = {f"w{i}": i for i in range(oov_from)}
+
+    def __len__(self):
+        return len(self._y)
+
+    def __getitem__(self, i):
+        return self._x[i], self._y[i]
+
+
+class UCIHousing(Dataset):
+    """13 features -> house price regression."""
+
+    def __init__(self, mode: str = "train", num_samples: int = 506):
+        rng = np.random.default_rng(0 if mode == "train" else 1)
+        self._x = rng.standard_normal((num_samples, 13)).astype(
+            np.float32)
+        w = rng.standard_normal(13).astype(np.float32)
+        self._y = (self._x @ w + 0.1 * rng.standard_normal(
+            num_samples)).astype(np.float32).reshape(-1, 1)
+
+    def __len__(self):
+        return len(self._y)
+
+    def __getitem__(self, i):
+        return self._x[i], self._y[i]
+
+
+class Conll05st(Dataset):
+    """SRL dataset shape: word/predicate/label id sequences."""
+
+    def __init__(self, mode: str = "train", num_samples: int = 500,
+                 seq_len: int = 50, vocab_size: int = 2000,
+                 num_labels: int = 20):
+        rng = np.random.default_rng(0 if mode == "train" else 1)
+        self._words = rng.integers(0, vocab_size,
+                                   (num_samples, seq_len), np.int64)
+        self._preds = rng.integers(0, vocab_size, num_samples, np.int64)
+        self._labels = rng.integers(0, num_labels,
+                                    (num_samples, seq_len), np.int64)
+
+    def __len__(self):
+        return len(self._preds)
+
+    def __getitem__(self, i):
+        return self._words[i], self._preds[i], self._labels[i]

@@ -1,0 +1,41 @@
+"""paddle.utils parity surface (reference python/paddle/utils:
+unique_name, deprecated, try_import, dlpack interop, cpp_extension
+story)."""
+from . import dlpack  # noqa: F401
+from . import unique_name  # noqa: F401
+from .lazy_import import try_import  # noqa: F401
+from .deprecated import deprecated  # noqa: F401
+
+
+def require_version(min_version: str, max_version=None) -> bool:
+    from .. import version
+
+    def parse(v):
+        parts = [int(p) for p in str(v).split(".")[:3] if p.isdigit()]
+        return tuple(parts + [0] * (3 - len(parts)))  # pad: 0.1 == 0.1.0
+
+    cur = parse(version.full_version)
+    if cur < parse(min_version):
+        raise RuntimeError(
+            f"requires paddle_tpu >= {min_version}, have "
+            f"{version.full_version}")
+    if max_version is not None and cur > parse(max_version):
+        raise RuntimeError(
+            f"requires paddle_tpu <= {max_version}, have "
+            f"{version.full_version}")
+    return True
+
+
+def run_check():
+    """Reference paddle.utils.run_check: verify the install can compute
+    on the available device."""
+    import numpy as np
+
+    from .. import to_tensor
+    import jax
+
+    a = to_tensor(np.ones((2, 2), np.float32))
+    out = (a @ a).numpy()
+    assert float(out.sum()) == 8.0
+    dev = jax.devices()[0]
+    print(f"paddle_tpu works on {dev.platform} ({dev.device_kind}).")

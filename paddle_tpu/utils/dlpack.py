@@ -1,0 +1,30 @@
+"""DLPack interop (reference python/paddle/utils/dlpack.py) — zero-copy
+exchange with torch/numpy/etc.
+
+Modern DLPack passes the PRODUCER OBJECT (anything with __dlpack__ /
+__dlpack_device__), not a raw capsule — jax, numpy, and torch>=1.10 all
+consume objects. to_dlpack therefore returns the protocol-carrying
+device array (torch.from_dlpack / np.from_dlpack accept it directly).
+"""
+from __future__ import annotations
+
+from ..core.dispatch import unwrap, wrap
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack-protocol array (has __dlpack__/__dlpack_device__)."""
+    return unwrap(x)
+
+
+def from_dlpack(ext):
+    """Any __dlpack__ object (jax/numpy/torch array, or a Tensor) ->
+    Tensor."""
+    import jax.numpy as jnp
+
+    ext = unwrap(ext)  # a paddle_tpu Tensor unwraps to its jax array
+    if not hasattr(ext, "__dlpack__"):
+        raise TypeError(
+            "from_dlpack needs an object implementing the DLPack "
+            "protocol (__dlpack__); pass the source array/tensor itself "
+            "rather than a raw PyCapsule")
+    return wrap(jnp.from_dlpack(ext))

@@ -1,0 +1,102 @@
+"""paddle.utils + text datasets tests."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_unique_name():
+    from paddle_tpu.utils import unique_name
+    with unique_name.guard():
+        a = unique_name.generate("fc")
+        b = unique_name.generate("fc")
+    assert a == "fc_0" and b == "fc_1"
+    with unique_name.guard("pre_"):
+        assert unique_name.generate("fc") == "pre_fc_0"
+
+
+def test_deprecated_decorator():
+    from paddle_tpu.utils import deprecated
+
+    @deprecated(update_to="new_fn", since="2.0")
+    def old_fn():
+        return 42
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old_fn() == 42
+    assert any("deprecated" in str(x.message) for x in w)
+
+
+def test_try_import_and_require_version():
+    from paddle_tpu.utils import require_version, try_import
+    assert try_import("math") is not None
+    with pytest.raises(ImportError):
+        try_import("definitely_not_a_module_xyz")
+    assert require_version("0.0.1")
+
+
+def test_dlpack_roundtrip():
+    from paddle_tpu.utils.dlpack import from_dlpack, to_dlpack
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    y = from_dlpack(to_dlpack(x))
+    np.testing.assert_array_equal(np.asarray(y.numpy()),
+                                  np.asarray(x.numpy()))
+
+
+def test_run_check(capsys):
+    paddle.utils.run_check()
+    assert "works on" in capsys.readouterr().out
+
+
+def test_text_datasets():
+    from paddle_tpu.text import Conll05st, Imdb, UCIHousing
+    imdb = Imdb(mode="train", num_samples=32)
+    x, y = imdb[0]
+    assert x.dtype == np.int64 and y in (0, 1)
+    assert len(imdb) == 32
+    uci = UCIHousing(num_samples=16)
+    f, p = uci[3]
+    assert f.shape == (13,) and p.shape == (1,)
+    srl = Conll05st(num_samples=8)
+    w, pred, lab = srl[0]
+    assert w.shape == lab.shape
+
+    # trains through a DataLoader end to end
+    import paddle_tpu.io as io
+    import paddle_tpu.nn as nn
+    loader = io.DataLoader(uci, batch_size=8)
+    net = nn.Linear(13, 1)
+    opt = paddle.optimizer.SGD(0.01, parameters=net.parameters())
+    step = paddle.jit.TrainStep(net, nn.MSELoss(), opt)
+    for xb, yb in loader:
+        loss = step(xb, yb)
+    assert np.isfinite(float(loss.numpy()))
+
+
+def test_review_regressions():
+    from paddle_tpu.utils import require_version, unique_name
+    from paddle_tpu.utils.dlpack import from_dlpack
+    from paddle_tpu.audio.functional import get_window
+    from paddle_tpu.text import Imdb
+
+    # switch(state) restores counters
+    with unique_name.guard():
+        unique_name.generate("fc")
+        saved = unique_name.switch()
+        assert unique_name.generate("fc") == "fc_0"
+        unique_name.switch(saved)
+        assert unique_name.generate("fc") == "fc_1"
+    # from_dlpack accepts a Tensor directly
+    t = from_dlpack(paddle.to_tensor(np.ones(3, np.float32)))
+    np.testing.assert_array_equal(np.asarray(t.numpy()), 1.0)
+    # padded version comparison
+    assert require_version("0.1", "9999")
+    # length-1 periodic window is [1.0]
+    np.testing.assert_array_equal(np.asarray(get_window("hann", 1).numpy()),
+                                  [1.0])
+    # cutoff maps rare ids to OOV
+    ds = Imdb(num_samples=64, vocab_size=100, cutoff=50)
+    assert np.asarray(ds._x).max() < 50
