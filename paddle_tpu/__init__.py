@@ -18,7 +18,8 @@ from .core import dtype as _dtype_mod  # noqa: E402
 from .core.dtype import (  # noqa: F401,E402
     bfloat16, bool_, complex128, complex64, dtype, finfo, float16, float32,
     float64, float8_e4m3fn, float8_e5m2, get_default_dtype, iinfo, int16,
-    int32, int64, int8, promote_types, set_default_dtype, uint8,
+    int32, int64, int8, promote_types, pstring, raw, set_default_dtype,
+    uint8,
 )
 bool = bool_  # noqa: A001 (paddle.bool)
 
@@ -41,7 +42,16 @@ from .ops.search import *  # noqa: F401,F403,E402
 from .ops.stat import *  # noqa: F401,F403,E402
 from .ops import linalg  # noqa: F401,E402
 from .ops.linalg import norm, einsum  # noqa: F401,E402
+from .ops.linalg import cdist, pdist, matrix_transpose  # noqa: F401,E402
 from .ops.math import matmul, mm, bmm, mv, dot, pow  # noqa: F401,E402
+from .ops.inplace import *  # noqa: F401,F403,E402
+
+# numpy-compatible constants (reference: paddle.pi/nan/inf/newaxis)
+import numpy as _np  # noqa: E402
+pi = float(_np.pi)
+nan = float(_np.nan)
+inf = float(_np.inf)
+newaxis = None
 
 from .core.tape import no_grad_guard as no_grad  # noqa: F401,E402
 from .core.tape import enable_grad_guard as enable_grad  # noqa: F401,E402
@@ -81,6 +91,19 @@ from . import geometric  # noqa: F401,E402
 from . import signal  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import cost_model  # noqa: F401,E402
+
+
+from .framework.misc import (  # noqa: F401,E402
+    batch, check_shape, create_parameter, disable_signal_handler,
+    get_cuda_rng_state, set_cuda_rng_state, set_grad_enabled,
+    set_printoptions,
+)
+from .nn.initializer.lazy_init import LazyGuard  # noqa: F401,E402
+from .utils.dlpack import from_dlpack, to_dlpack  # noqa: F401,E402
+from .hapi.dynamic_flops import flops  # noqa: F401,E402
+from .distributed.fleet.meta_parallel.parallel_wrappers import (  # noqa: F401,E402
+    DataParallel,
+)
 
 
 def disable_static(place=None):

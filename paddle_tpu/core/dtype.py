@@ -79,6 +79,12 @@ _ALL = [
     float64, complex64, complex128,
 ] + [d for d in (float8_e4m3fn, float8_e5m2) if d is not None]
 
+# Non-numeric marker dtypes (reference: paddle.pstring / paddle.raw,
+# DataType enum values for string tensors and untyped buffers). No jnp
+# backing — usable only as type tags, matching the reference's surface.
+pstring = DType("pstring", np.object_)
+raw = DType("raw", np.void)
+
 _BY_NAME = {d.name: d for d in _ALL}
 _BY_NAME["bool_"] = bool_
 _BY_NAME["float"] = float32

@@ -72,6 +72,10 @@ def is_grad_enabled() -> bool:
     return _state.grad_enabled
 
 
+def set_grad_enabled(mode: bool) -> None:
+    _state.grad_enabled = bool(mode)
+
+
 @contextlib.contextmanager
 def no_grad_guard():
     prev = _state.grad_enabled

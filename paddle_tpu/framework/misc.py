@@ -1,0 +1,124 @@
+"""Top-level convenience APIs (reference: scattered across
+python/paddle/__init__.py — batch.py, data_feeder.check_shape,
+tensor/creation.create_parameter, framework set_grad_enabled,
+tensor_patch_methods set_printoptions, base/core signal handlers).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import random as random_mod
+from ..core import tape as tape_mod
+
+_print_options = {
+    "precision": 8, "threshold": 1000, "edgeitems": 3,
+    "linewidth": 80, "sci_mode": False,
+}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Configure Tensor repr formatting (reference: paddle.set_printoptions).
+
+    Maps onto numpy printoptions, which Tensor.__repr__ renders through.
+    """
+    if precision is not None:
+        _print_options["precision"] = int(precision)
+    if threshold is not None:
+        _print_options["threshold"] = int(threshold)
+    if edgeitems is not None:
+        _print_options["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        _print_options["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        _print_options["sci_mode"] = bool(sci_mode)
+    np.set_printoptions(
+        precision=_print_options["precision"],
+        threshold=_print_options["threshold"],
+        edgeitems=_print_options["edgeitems"],
+        linewidth=_print_options["linewidth"],
+        suppress=not _print_options["sci_mode"])
+
+
+class set_grad_enabled:
+    """Context manager enabling/disabling grad recording
+    (reference: paddle.set_grad_enabled)."""
+
+    def __init__(self, mode: bool):
+        self._mode = bool(mode)
+        self._prev = tape_mod.is_grad_enabled()
+        tape_mod.set_grad_enabled(self._mode)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        tape_mod.set_grad_enabled(self._prev)
+        return False
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Create a free-standing Parameter (reference:
+    python/paddle/tensor/creation.py:178 create_parameter)."""
+    from ..nn import Layer
+    helper = Layer()
+    p = helper.create_parameter(
+        shape=list(shape), attr=attr, dtype=dtype, is_bias=is_bias,
+        default_initializer=default_initializer)
+    if p is not None and name:
+        p.name = name
+    return p
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Wrap an item reader into a batch reader (reference: paddle/batch.py)."""
+    if batch_size <= 0:
+        raise ValueError("batch_size should be a positive value, "
+                         f"but got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader
+
+
+def check_shape(shape):
+    """Validate a shape argument (reference:
+    python/paddle/utils/layers_utils.py:484)."""
+    from ..core.tensor import Tensor
+    if isinstance(shape, Tensor):
+        if shape.dtype.name not in ("int32", "int64"):
+            raise TypeError("shape tensor must be int32 or int64")
+        return
+    if isinstance(shape, (list, tuple)):
+        for ele in shape:
+            if isinstance(ele, Tensor):
+                continue
+            if not isinstance(ele, (int, np.integer)):
+                raise TypeError("All elements in `shape` must be integers")
+            if ele < 0:
+                raise ValueError("All elements in `shape` must be positive")
+
+
+def disable_signal_handler():
+    """No-op on TPU: the DataLoader does not install process-wide signal
+    handlers (reference: paddle.disable_signal_handler guards theirs)."""
+
+
+def get_cuda_rng_state():
+    """Compat alias of get_rng_state (reference: paddle.get_cuda_rng_state);
+    there is one accelerator RNG stream here, keyed by JAX PRNG state."""
+    return random_mod.get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    """Compat alias of set_rng_state."""
+    return random_mod.set_rng_state(state)

@@ -73,6 +73,13 @@ class Parameter(Tensor):
         p.is_distributed = False
         return p
 
+    def initialize(self):
+        """Run the initializer deferred by LazyGuard (no-op otherwise)."""
+        init = self.__dict__.pop("_lazy_initializer", None)
+        if init is not None:
+            init(self)
+        return self
+
     def __repr__(self):
         return "Parameter containing:\n" + super().__repr__()
 

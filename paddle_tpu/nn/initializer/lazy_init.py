@@ -1,0 +1,30 @@
+"""Lazy parameter initialization (reference:
+python/paddle/nn/initializer/lazy_init.py:99 LazyGuard).
+
+Under ``with LazyGuard():`` layers record their initializer on each created
+Parameter instead of running it; ``param.initialize()`` materialises the
+values later (e.g. after sharding placements are chosen, so the initial
+values land directly in their final layout). Unlike the reference's
+startup-program machinery, the deferred state is just the initializer
+callable — XLA owns allocation either way.
+"""
+from __future__ import annotations
+
+_state = {"in_lazy_mode": False}
+
+
+def in_lazy_mode() -> bool:
+    return _state["in_lazy_mode"]
+
+
+class LazyGuard:
+    """Context manager: construct Layers without running param initializers."""
+
+    def __enter__(self):
+        self._prev = _state["in_lazy_mode"]
+        _state["in_lazy_mode"] = True
+        return self
+
+    def __exit__(self, *exc):
+        _state["in_lazy_mode"] = self._prev
+        return False

@@ -137,7 +137,11 @@ class Layer:
                       trainable=attr.trainable, name=attr.name,
                       regularizer=attr.regularizer, need_clip=attr.need_clip,
                       learning_rate=attr.learning_rate)
-        initializer(p)
+        from ..initializer import lazy_init
+        if lazy_init.in_lazy_mode():
+            p._lazy_initializer = initializer
+        else:
+            initializer(p)
         return p
 
     def add_parameter(self, name, parameter):

@@ -9,6 +9,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from . import creation, linalg, logic, manipulation, math, search, stat
+from . import inplace
 from ..core.dispatch import run_op, unwrap, wrap
 from ..core.tensor import Tensor
 
@@ -214,6 +215,15 @@ def _setitem(self, idx, value):
 Tensor.__getitem__ = _getitem
 Tensor.__setitem__ = _setitem
 
+def _patch_inplace_module():
+    """Patch every ops.inplace variant onto Tensor (names already patched
+    by _patch_inplace keep their existing binding)."""
+    for name in inplace.__all__:
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, getattr(inplace, name))
+
+
 _patch_methods()
 _patch_inplace()
+_patch_inplace_module()
 _patch_operators()

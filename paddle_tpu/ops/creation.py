@@ -354,3 +354,19 @@ def full_with_tensor(value, shape, dtype=None, name=None):
 
 def full_int_array(value, dtype="int64", name=None):
     return wrap(jnp.asarray(np.asarray(value), _dt(dtype, jnp.int64)))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype=None, name=None):
+    """Sample exp(Normal(mean, std)) (reference: log_normal)."""
+    if not isinstance(mean, Tensor):
+        mean = float(mean)
+    if not isinstance(std, Tensor):
+        std = float(std)
+    out = normal(mean=mean, std=std,
+                 shape=list(shape) if shape is not None else [1])
+    from ..ops import math as _math
+    out = _math.exp(out)
+    if dtype is not None:
+        from . import manipulation as _m
+        out = _m.cast(out, dtype)
+    return out

@@ -1,0 +1,136 @@
+"""Inplace op variants (trailing-underscore API).
+
+Reference: the `inplace:` entries in paddle/phi/ops/yaml/ops.yaml and their
+python surface in python/paddle/tensor/*.py. On TPU "inplace" is a Python-
+level contract — the out-of-place jnp result is rebound onto the same
+Tensor object (XLA owns the buffers; donation under jit gives the actual
+memory reuse) and the result is cast back to the input's dtype, matching
+the reference semantics of writing into an existing typed buffer.
+
+Each wrapper is also patched onto Tensor as a method and exported at the
+package top level (ops/__init__.py / paddle_tpu/__init__.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import random as random_mod
+from ..core.dispatch import unwrap
+from . import creation, linalg, logic, manipulation, math, search, stat
+
+
+def _rebind(x, out):
+    """Rebind out's value (cast to x's dtype) onto the Tensor object x."""
+    arr = out._data
+    if arr.dtype != x._data.dtype:
+        arr = arr.astype(x._data.dtype)
+        out = type(out)._from_array(arr, stop_gradient=out.stop_gradient)
+        out._meta = None  # dtype-cast rebind breaks the grad link by design
+    x._data = out._data
+    x._meta = out._meta
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+# base-op name -> providing module. Every entry generates `<name>_`.
+_BASES = {
+    "abs": math, "acos": math, "acosh": math, "addmm": math, "asin": math,
+    "asinh": math, "atan": math, "atanh": math, "copysign": math,
+    "cos": math, "cosh": math, "cumprod": math, "cumsum": math,
+    "digamma": math, "divide": math, "erf": math, "erfinv": math,
+    "expm1": math, "floor_divide": math, "floor_mod": math, "frac": math,
+    "gammainc": math, "gammaincc": math, "gammaln": math, "gcd": math,
+    "hypot": math, "i0": math, "lcm": math, "ldexp": math, "lgamma": math,
+    "log": math, "log10": math, "log1p": math, "log2": math, "logit": math,
+    "mod": math, "multigammaln": math, "multiply": math,
+    "nan_to_num": math, "neg": math, "polygamma": math, "renorm": math,
+    "sin": math, "sinc": math, "sinh": math, "square": math, "tan": math,
+    "tanh": math, "trunc": math, "remainder": math,
+    "equal": logic, "greater_equal": logic, "greater_than": logic,
+    "less": logic, "less_equal": logic, "less_than": logic,
+    "not_equal": logic,
+    "bitwise_and": logic, "bitwise_invert": logic,
+    "bitwise_left_shift": logic, "bitwise_not": logic, "bitwise_or": logic,
+    "bitwise_right_shift": logic, "bitwise_xor": logic,
+    "logical_and": logic, "logical_not": logic, "logical_or": logic,
+    "logical_xor": logic,
+    "masked_scatter": manipulation, "t": manipulation,
+    "transpose": manipulation,
+    "tril": creation, "triu": creation, "bernoulli": creation,
+}
+
+
+def _make(name, base_fn):
+    def inplace(x, *args, **kwargs):
+        return _rebind(x, base_fn(x, *args, **kwargs))
+    inplace.__name__ = name
+    inplace.__qualname__ = name
+    inplace.__module__ = __name__
+    inplace.__doc__ = f"Inplace variant of {base_fn.__module__}.{base_fn.__name__}."
+    return inplace
+
+
+def _build():
+    out = {}
+    for base, mod in _BASES.items():
+        fn = getattr(mod, base, None)
+        if fn is None and base == "neg":
+            fn = math.neg
+        assert callable(fn), f"inplace base {base} missing"
+        out[base + "_"] = _make(base + "_", fn)
+    return out
+
+
+_built = _build()
+globals().update(_built)
+
+__all__ = sorted(list(_built)
+                 + ["cauchy_", "geometric_", "log_normal_", "cast_"])
+
+
+# -- random fills and other bespoke inplace ops ---------------------------
+
+def cast_(x, dtype, name=None):
+    """Inplace cast — unlike other inplace ops this CHANGES x's dtype
+    (reference: cast_)."""
+    out = manipulation.cast(x, dtype)
+    x._data = out._data
+    x._meta = out._meta
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    """Fill x with Cauchy(loc, scale) samples (reference: cauchy_)."""
+    import jax
+    key = random_mod.next_key()
+    u = jax.random.uniform(key, tuple(x.shape), jnp.float32,
+                           minval=1e-7, maxval=1.0 - 1e-7)
+    vals = loc + scale * jnp.tan(jnp.pi * (u - 0.5))
+    x._data = vals.astype(x._data.dtype)
+    x._meta = None
+    return x
+
+
+def geometric_(x, probs, name=None):
+    """Fill x with Geometric(probs) samples (reference: geometric_)."""
+    import jax
+    key = random_mod.next_key()
+    p = unwrap(probs) if not isinstance(probs, (int, float)) else probs
+    u = jax.random.uniform(key, tuple(x.shape), jnp.float32,
+                           minval=1e-7, maxval=1.0 - 1e-7)
+    vals = jnp.ceil(jnp.log1p(-u) / jnp.log1p(-jnp.asarray(p, jnp.float32)))
+    x._data = vals.astype(x._data.dtype)
+    x._meta = None
+    return x
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    """Fill x with LogNormal(mean, std) samples (reference: log_normal_)."""
+    import jax
+    key = random_mod.next_key()
+    vals = jnp.exp(mean + std * jax.random.normal(
+        key, tuple(x.shape), jnp.float32))
+    x._data = vals.astype(x._data.dtype)
+    x._meta = None
+    return x

@@ -301,3 +301,49 @@ def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
         sigma = u @ wm @ v
         return w / jnp.maximum(sigma, eps)
     return run_op("spectral_norm", fn, [weight])
+
+
+def matrix_transpose(x, name=None):
+    """Transpose the last two dims (reference: matrix_transpose)."""
+    def fn(a):
+        if a.ndim < 2:
+            raise ValueError("matrix_transpose requires ndim >= 2")
+        return jnp.swapaxes(a, -2, -1)
+    return run_op("matrix_transpose", fn, [x])
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Batched pairwise distance between row vectors (reference: cdist).
+
+    On TPU the p==2 path routes through one matmul (MXU) instead of the
+    [..., m, n, d] broadcast, which would be HBM-bound.
+    """
+    use_mm = p == 2.0 and compute_mode != "donot_use_mm_for_euclid_dist"
+    def fn(a, b):
+        if use_mm:
+            a2 = jnp.sum(a * a, -1)[..., :, None]
+            b2 = jnp.sum(b * b, -1)[..., None, :]
+            d2 = a2 + b2 - 2.0 * jnp.matmul(a, jnp.swapaxes(b, -2, -1))
+            # safe sqrt: d/dx sqrt at 0 is inf -> NaN grads for coincident
+            # rows (pdist's self-diagonal always hits this)
+            pos = d2 > 0
+            return jnp.where(pos, jnp.sqrt(jnp.where(pos, d2, 1.0)), 0.0)
+        diff = jnp.abs(a[..., :, None, :] - b[..., None, :, :])
+        if p == 0:
+            return jnp.sum((diff != 0).astype(a.dtype), -1)
+        if jnp.isinf(p):
+            return jnp.max(diff, -1)
+        return jnp.sum(diff ** p, -1) ** (1.0 / p)
+    return run_op("cdist", fn, [x, y])
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distance of a [n, d] tensor: upper triangle of
+    cdist(x, x) as a flat [n*(n-1)/2] vector (reference: pdist)."""
+    def fn(a):
+        n = a.shape[0]
+        full = unwrap(cdist(wrap(a), wrap(a), p=p))
+        iu, ju = np.triu_indices(n, k=1)
+        return full[iu, ju]
+    return run_op("pdist", fn, [x])

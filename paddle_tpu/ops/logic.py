@@ -77,3 +77,8 @@ def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
 def isin(x, test_x, assume_unique=False, invert=False, name=None):
     return run_op_nodiff(
         "isin", lambda a, b: jnp.isin(a, b, invert=invert), [x, test_x])
+
+
+def less(x, y, name=None):
+    """Alias of less_than (reference: paddle.less)."""
+    return less_than(x, y)

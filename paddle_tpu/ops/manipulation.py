@@ -336,7 +336,7 @@ def index_sample(x, index):
 
 def index_add(x, index, axis, value, name=None):
     def fn(a, i, v):
-        idx = [slice(None)] * a.ndim
+        idx = [builtins_slice(None)] * a.ndim
         idx[axis] = i
         return a.at[tuple(idx)].add(v)
     return run_op("index_add", fn, [x, index, value])
@@ -359,7 +359,7 @@ def index_put_(x, indices, value, accumulate=False, name=None):
 
 def index_fill(x, index, axis, value, name=None):
     def fn(a, i):
-        idx = [slice(None)] * a.ndim
+        idx = [builtins_slice(None)] * a.ndim
         idx[axis] = i
         return a.at[tuple(idx)].set(value)
     return run_op("index_fill", fn, [x, index])
@@ -865,3 +865,106 @@ def assign_value_(output, shape, dtype, values, name=None):
                       dtype_mod.dtype(dtype).np_dtype)
     output._data = arr
     return output
+
+
+def block_diag(inputs, name=None):
+    """Block-diagonal matrix from a list of tensors (reference:
+    python/paddle/tensor/manipulation.py block_diag)."""
+    def fn(*mats):
+        mats2 = [m.reshape(1, -1) if m.ndim <= 1 else m for m in mats]
+        dt = jnp.result_type(*[m.dtype for m in mats2])
+        rows = sum(m.shape[0] for m in mats2)
+        cols = sum(m.shape[1] for m in mats2)
+        out = jnp.zeros((rows, cols), dt)
+        r = c = 0
+        for m in mats2:
+            out = out.at[r:r + m.shape[0], c:c + m.shape[1]].set(
+                m.astype(dt))
+            r += m.shape[0]
+            c += m.shape[1]
+        return out
+    return run_op("block_diag", fn, list(inputs))
+
+
+def cartesian_prod(x, name=None):
+    """Cartesian product of 1-D tensors (reference: cartesian_prod)."""
+    def fn(*vecs):
+        grids = jnp.meshgrid(*vecs, indexing="ij")
+        out = jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+        return out.reshape(-1) if len(vecs) == 1 else out
+    return run_op("cartesian_prod", fn, list(x))
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """Embed y along x's (axis1, axis2) diagonal (reference:
+    diagonal_scatter; inverse of paddle.diagonal)."""
+    def fn(a, b):
+        a2 = jnp.moveaxis(a, (axis1 % a.ndim, axis2 % a.ndim), (-2, -1))
+        h, w = a2.shape[-2], a2.shape[-1]
+        dlen = min(h, w - offset) if offset >= 0 else min(h + offset, w)
+        i = jnp.arange(dlen)
+        r = i - min(offset, 0)
+        c = i + max(offset, 0)
+        a2 = a2.at[..., r, c].set(b.astype(a.dtype))
+        return jnp.moveaxis(a2, (-2, -1), (axis1 % a.ndim, axis2 % a.ndim))
+    return run_op("diagonal_scatter", fn, [x, y])
+
+
+def select_scatter(x, values, axis, index, name=None):
+    """Write values into x at position index along axis (reference:
+    select_scatter)."""
+    def fn(a, v):
+        idx = [builtins_slice(None)] * a.ndim
+        idx[axis % a.ndim] = index
+        return a.at[tuple(idx)].set(v.astype(a.dtype))
+    return run_op("select_scatter", fn, [x, values])
+
+
+def slice_scatter(x, value, axes=None, starts=None, ends=None, strides=None,
+                  name=None):
+    """Write value into the strided slice of x (reference: slice_scatter)."""
+    axes = [0] if axes is None else _ints(axes)
+    axes = [axes] if isinstance(axes, int) else axes
+    def fn(a, v):
+        ss = [0] * len(axes) if starts is None else _ints(starts)
+        ee = [a.shape[ax] for ax in axes] if ends is None else _ints(ends)
+        tt = [1] * len(axes) if strides is None else _ints(strides)
+        idx = [builtins_slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, ss, ee, tt):
+            idx[int(ax) % a.ndim] = builtins_slice(int(s), int(e), int(st))
+        return a.at[tuple(idx)].set(v.astype(a.dtype))
+    return run_op("slice_scatter", fn, [x, value])
+
+
+def hsplit(x, num_or_indices, name=None):
+    """Split horizontally: axis 0 for 1-D, else axis 1 (reference: hsplit,
+    numpy semantics via tensor_split)."""
+    ax = 0 if len(x.shape) == 1 else 1
+    return tensor_split(x, num_or_indices, axis=ax)
+
+
+def vsplit(x, num_or_indices, name=None):
+    """Split along axis 0; requires ndim >= 2 (reference: vsplit)."""
+    if len(x.shape) < 2:
+        raise ValueError("vsplit expects a tensor with at least 2 dims, "
+                         f"got {len(x.shape)}")
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    """Split along axis 2; requires ndim >= 3 (reference: dsplit)."""
+    if len(x.shape) < 3:
+        raise ValueError("dsplit expects a tensor with at least 3 dims, "
+                         f"got {len(x.shape)}")
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def unflatten(x, axis, shape, name=None):
+    """Expand one axis into the given shape (reference: unflatten)."""
+    shp = _ints(shape)
+    shp = [shp] if isinstance(shp, int) else list(shp)
+    def fn(a):
+        ax = axis % a.ndim
+        new = list(a.shape[:ax]) + shp + list(a.shape[ax + 1:])
+        return jnp.reshape(a, new)
+    return run_op("unflatten", fn, [x])

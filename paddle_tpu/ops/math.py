@@ -653,3 +653,34 @@ def multiplex(inputs, index, name=None):
         rows = jnp.arange(stacked.shape[1])
         return stacked[idx.reshape(-1), rows]
     return run_op("multiplex", fn, [index] + list(inputs))
+
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a list of tensors (reference ops.yaml: add_n)."""
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    def fn(*xs):
+        out = xs[0]
+        for a in xs[1:]:
+            out = out + a
+        return out
+    return run_op("add_n", fn, list(inputs))
+
+
+def sinc(x, name=None):
+    """Normalised sinc: sin(pi x)/(pi x), 1 at 0 (reference: sinc)."""
+    return run_op("sinc", jnp.sinc, [x])
+
+
+def multigammaln(x, p, name=None):
+    """Log multivariate gamma (reference: multigammaln)."""
+    from jax.scipy.special import multigammaln as _mgl
+    return run_op("multigammaln", lambda a: _mgl(a, int(p)), [x])
+
+
+def positive(x, name=None):
+    """Unary + (reference: positive; errors on bool like the reference)."""
+    a = unwrap(x)
+    if a.dtype == jnp.bool_:
+        raise TypeError("positive is not supported for bool tensors")
+    return run_op("positive", lambda b: +b, [x])

@@ -49,6 +49,7 @@ def _modules() -> Dict[str, object]:
         "nn.functional": F,
         "fft": _fft, "signal": _signal, "geometric": _geo,
         "vision.ops": _vops, "quantization.functional": _qf,
+        "inplace": ops.inplace,
     }
 
 

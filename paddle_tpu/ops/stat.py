@@ -114,3 +114,12 @@ def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
         fpr = jnp.concatenate([jnp.zeros(1), fp]) / tot_neg
         return jnp.trapezoid(tpr, fpr)
     return run_op_nodiff("auc", fn, [input, label])
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    """Bin edges only, numpy semantics (reference: histogram_bin_edges)."""
+    rng = None if (min == 0 and max == 0) else (float(min), float(max))
+    return run_op_nodiff(
+        "histogram_bin_edges",
+        lambda a: jnp.histogram_bin_edges(a, bins=int(bins), range=rng),
+        [input])
