@@ -1,6 +1,7 @@
 """paddle_tpu.amp — automatic mixed precision (see auto_cast.py)."""
 from .auto_cast import (  # noqa: F401
-    auto_cast, amp_guard, amp_state, WHITE_LIST, BLACK_LIST,
+    auto_cast, amp_guard, amp_state, amp_decorate, decorate,
+    WHITE_LIST, BLACK_LIST,
 )
 from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
 from . import debugging  # noqa: F401

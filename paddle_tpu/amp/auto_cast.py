@@ -111,3 +111,65 @@ def autocast_inputs(op_name, tensor_args):
         return x
 
     return [cast_one(x) for x in tensor_args]
+
+
+def amp_decorate(models, optimizers=None, level="O1", dtype="float16",
+                 master_weight=None, save_dtype=None):
+    """Decorate models/optimizers for AMP (reference:
+    python/paddle/amp/auto_cast.py amp_decorate / paddle.amp.decorate).
+
+    O1 is a no-op on the model (casting happens per-op under auto_cast);
+    O2 casts the model parameters to the low dtype up front — optimizers
+    keep fp32 master weights themselves (our optimizers accumulate in the
+    param dtype unless multi_precision is set, which O2 turns on).
+    """
+    from ..nn import Layer
+
+    single_model = isinstance(models, Layer)
+    model_list = [models] if single_model else list(models or [])
+    single_opt = optimizers is not None and not isinstance(
+        optimizers, (list, tuple))
+    opt_list = ([optimizers] if single_opt
+                else list(optimizers or []))
+    if level not in ("O1", "O2"):
+        raise ValueError("level should be O1 or O2")
+    if level == "O2":
+        from .. import nn
+        keep_fp32 = tuple(
+            cls for cls in (
+                getattr(nn, n, None) for n in (
+                    "BatchNorm", "BatchNorm1D", "BatchNorm2D",
+                    "BatchNorm3D", "SyncBatchNorm", "LayerNorm",
+                    "InstanceNorm1D", "InstanceNorm2D", "InstanceNorm3D",
+                    "GroupNorm"))
+            if cls is not None)
+        want = jnp.bfloat16 if dtype == "bfloat16" else jnp.float16
+        for m in model_list:
+            if not isinstance(m, Layer):
+                raise TypeError("models must be nn.Layer instances")
+            # cast everything except normalisation layers, whose params
+            # and running stats the reference keeps fp32 under O2
+            # (python/paddle/amp/auto_cast.py need_keep_fp32)
+            for lyr in m.sublayers(include_self=True):
+                if isinstance(lyr, keep_fp32):
+                    continue
+                for param in lyr._parameters.values():
+                    if param is not None and jnp.issubdtype(
+                            param._data.dtype, jnp.floating):
+                        param._data = param._data.astype(want)
+                for buf in lyr._buffers.values():
+                    if buf is not None and jnp.issubdtype(
+                            buf._data.dtype, jnp.floating):
+                        buf._data = buf._data.astype(want)
+            m._amp_level = "O2"
+        for opt in opt_list:
+            opt._multi_precision = True
+    for m in model_list:
+        m._amp_save_dtype = save_dtype
+    models_out = model_list[0] if single_model else model_list
+    if optimizers is None:
+        return models_out
+    return models_out, (opt_list[0] if single_opt else opt_list)
+
+
+decorate = amp_decorate

@@ -1,6 +1,8 @@
 """paddle_tpu.autograd (reference: python/paddle/autograd)."""
 from .backward_mode import backward  # noqa: F401
-from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+from .py_layer import (  # noqa: F401
+    PyLayer, PyLayerContext, saved_tensors_hooks,
+)
 from .functional import grad, jacobian, hessian, vjp, jvp  # noqa: F401
 from ..core.tape import no_grad_guard as no_grad  # noqa: F401
 from ..core.tape import enable_grad_guard as enable_grad  # noqa: F401

@@ -14,17 +14,51 @@ from ..core.dispatch import unwrap, wrap
 from ..core.tensor import Tensor
 
 
+_hooks_stack: list = []  # active saved_tensors_hooks (pack, unpack) pairs
+
+
+class saved_tensors_hooks:
+    """Intercept PyLayer saved tensors with pack/unpack hooks (reference:
+    python/paddle/autograd/saved_tensors_hooks.py).
+
+    pack_hook(tensor) runs at save_for_backward time (e.g. offload to host
+    numpy); unpack_hook(packed) runs when backward reads saved_tensor().
+    Only PyLayer saves route through here — built-in ops' residuals live
+    inside jax.vjp closures, where XLA already owns their lifetime.
+    """
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        _hooks_stack.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _hooks_stack.pop()
+        return False
+
+
 class PyLayerContext:
     def __init__(self):
         self._saved = ()
+        self._unpack = None
         self.materialize_grads = True
 
     def save_for_backward(self, *tensors):
-        self._saved = tensors
+        if _hooks_stack:
+            pack, unpack = _hooks_stack[-1]
+            self._saved = tuple(pack(t) for t in tensors)
+            self._unpack = unpack
+        else:
+            self._saved = tensors
 
     def saved_tensor(self):
         """paddle API: a method, not a property
         (python/paddle/autograd/py_layer.py PyLayerContext.saved_tensor)."""
+        if self._unpack is not None:
+            return tuple(self._unpack(p) for p in self._saved)
         return self._saved
 
     saved_tensors = saved_tensor

@@ -103,3 +103,64 @@ def fft_c2r(x, axes, normalization="backward", forward=True, last_dim_size=0,
         return jnp.fft.irfftn(a, s=s, axes=tuple(axes),
                               norm=_norm(normalization))
     return run_op("fft_c2r", fn, [x])
+
+
+def _h_axes(a_ndim, s, axes, two_d):
+    if axes is None:
+        axes = (-2, -1) if two_d else tuple(range(a_ndim))
+    axes = tuple(int(ax) for ax in axes)
+    if s is not None:
+        s = tuple(int(v) for v in s)
+    return s, axes
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """N-D FFT of a Hermitian-symmetric input -> real output (reference:
+    paddle.fft.hfftn). Decomposed as c2c FFTs over the leading axes and a
+    1-D hfft (c2r) over the last transform axis."""
+    def fn(a):
+        ss, axs = _h_axes(a.ndim, s, axes, two_d=False)
+        lead, last = axs[:-1], axs[-1]
+        if lead:
+            a = jnp.fft.fftn(a, s=None if ss is None else ss[:-1],
+                             axes=lead, norm=_norm(norm))
+        n_last = None if ss is None else ss[-1]
+        return jnp.fft.hfft(a, n=n_last, axis=last, norm=_norm(norm))
+    return run_op("hfftn", fn, [x])
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """2-D Hermitian FFT (reference: paddle.fft.hfft2)."""
+    def fn(a):
+        ss, axs = _h_axes(a.ndim, s, axes, two_d=True)
+        a2 = jnp.fft.fft(a, n=None if ss is None else ss[0], axis=axs[0],
+                         norm=_norm(norm))
+        return jnp.fft.hfft(a2, n=None if ss is None else ss[1],
+                            axis=axs[1], norm=_norm(norm))
+    return run_op("hfft2", fn, [x])
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Inverse of hfftn: real input -> Hermitian-symmetric half-spectrum
+    (reference: paddle.fft.ihfftn)."""
+    def fn(a):
+        ss, axs = _h_axes(a.ndim, s, axes, two_d=False)
+        lead, last = axs[:-1], axs[-1]
+        out = jnp.fft.ihfft(a, n=None if ss is None else ss[-1], axis=last,
+                            norm=_norm(norm))
+        if lead:
+            out = jnp.fft.ifftn(out, s=None if ss is None else ss[:-1],
+                                axes=lead, norm=_norm(norm))
+        return out
+    return run_op("ihfftn", fn, [x])
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """2-D inverse Hermitian FFT (reference: paddle.fft.ihfft2)."""
+    def fn(a):
+        ss, axs = _h_axes(a.ndim, s, axes, two_d=True)
+        out = jnp.fft.ihfft(a, n=None if ss is None else ss[1], axis=axs[1],
+                            norm=_norm(norm))
+        return jnp.fft.ifft(out, n=None if ss is None else ss[0],
+                            axis=axs[0], norm=_norm(norm))
+    return run_op("ihfft2", fn, [x])

@@ -8,3 +8,31 @@ from .api import (  # noqa: F401
 )
 from .functional import functional_call, get_buffers, get_params  # noqa: F401
 from .serialization import load, save  # noqa: F401
+from .serialization import TranslatedLayer  # noqa: F401
+
+_ignored_modules: list = []
+
+
+def ignore_module(modules):
+    """Compat shim (reference: paddle.jit.ignore_module). The reference's
+    SOT tracer skips these modules during bytecode capture; jax.jit traces
+    by execution so there is nothing to skip — the list is recorded for
+    introspection only."""
+    global _ignored_modules
+    if not isinstance(modules, (list, tuple)):
+        modules = [modules]
+    _ignored_modules.extend(modules)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Log transformed-code verbosity (reference: paddle.jit.set_code_level).
+    There is no AST transform here; kept for API parity as a logging knob."""
+    import logging
+    logging.getLogger("paddle_tpu.jit").setLevel(logging.DEBUG)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Set jit logging verbosity (reference: paddle.jit.set_verbosity)."""
+    import logging
+    lvl = logging.DEBUG if level > 0 else logging.WARNING
+    logging.getLogger("paddle_tpu.jit").setLevel(lvl)

@@ -56,7 +56,7 @@ _BASES = {
     "logical_xor": logic,
     "masked_scatter": manipulation, "t": manipulation,
     "transpose": manipulation,
-    "tril": creation, "triu": creation, "bernoulli": creation,
+    "tril": creation, "triu": creation,
 }
 
 
@@ -85,7 +85,8 @@ _built = _build()
 globals().update(_built)
 
 __all__ = sorted(list(_built)
-                 + ["cauchy_", "geometric_", "log_normal_", "cast_"])
+                 + ["bernoulli_", "cauchy_", "geometric_", "log_normal_",
+                    "cast_"])
 
 
 # -- random fills and other bespoke inplace ops ---------------------------
@@ -97,6 +98,18 @@ def cast_(x, dtype, name=None):
     x._data = out._data
     x._meta = out._meta
     x.stop_gradient = out.stop_gradient
+    return x
+
+
+def bernoulli_(x, p=0.5, name=None):
+    """Fill x with Bernoulli(p) samples (reference: bernoulli_(x, p=0.5) —
+    p is the probability, NOT x's values, unlike out-of-place bernoulli)."""
+    import jax
+    key = random_mod.next_key()
+    pr = unwrap(p) if not isinstance(p, (int, float)) else p
+    vals = jax.random.bernoulli(key, pr, tuple(x.shape))
+    x._data = vals.astype(x._data.dtype)
+    x._meta = None
     return x
 
 

@@ -8,6 +8,7 @@ import numpy as np
 
 from ..core.dispatch import run_op, run_op_nodiff, unwrap, wrap
 from .math import matmul, mm, bmm, mv, dot, conj  # noqa: F401  (re-export)
+from .stat import cov, corrcoef  # noqa: F401  (paddle.linalg re-exports)
 
 
 def norm(x, p=None, axis=None, keepdim=False, name=None):
@@ -215,12 +216,6 @@ def multi_dot(x, name=None):
     return run_op("multi_dot", lambda *xs: jnp.linalg.multi_dot(xs), list(x))
 
 
-def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
-    a = unwrap(input)
-    rng = (min, max) if (min != 0 or max != 0) else None
-    return wrap(jnp.histogram_bin_edges(a, bins=bins, range=rng))
-
-
 def householder_product(x, tau, name=None):
     def fn(a, t):
         m, n = a.shape[-2], a.shape[-1]
@@ -303,15 +298,6 @@ def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
     return run_op("spectral_norm", fn, [weight])
 
 
-def matrix_transpose(x, name=None):
-    """Transpose the last two dims (reference: matrix_transpose)."""
-    def fn(a):
-        if a.ndim < 2:
-            raise ValueError("matrix_transpose requires ndim >= 2")
-        return jnp.swapaxes(a, -2, -1)
-    return run_op("matrix_transpose", fn, [x])
-
-
 def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
           name=None):
     """Batched pairwise distance between row vectors (reference: cdist).
@@ -347,3 +333,63 @@ def pdist(x, p=2.0, name=None):
         iu, ju = np.triu_indices(n, k=1)
         return full[iu, ju]
     return run_op("pdist", fn, [x])
+
+
+def matrix_exp(x, name=None):
+    """Matrix exponential via scaling-and-squaring (reference:
+    paddle.linalg.matrix_exp; jax.scipy.linalg.expm underneath)."""
+    from jax.scipy.linalg import expm
+    return run_op("matrix_exp", expm, [x])
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Multiply y by Q (or Q^T) from the Householder QR factorization
+    (x, tau) (reference: paddle.linalg.ormqr).
+
+    Q is materialised by applying the k reflectors to the identity — k is
+    static so the Python loop unrolls into a fixed XLA program.
+    """
+    def fn(a, t, b):
+        m = a.shape[-2]
+        k = t.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m))
+        for i in range(k):
+            v = a[..., :, i]
+            v = jnp.where(jnp.arange(m) < i, 0.0, v)
+            v = v.at[..., i].set(1.0)
+            ti = t[..., i][..., None, None]
+            vv = v[..., :, None] * v[..., None, :]
+            q = q @ (jnp.eye(m, dtype=a.dtype) - ti * vv)
+        if transpose:
+            q = jnp.swapaxes(q, -2, -1)
+        return q @ b if left else b @ q
+    return run_op("ormqr", fn, [x, tau, y])
+
+
+def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False,
+                            transpose_y=False, scale=1.0,
+                            output_dtype="float16", name=None):
+    """FP8 x FP8 -> half GEMM (reference: linalg.fp8_fp8_half_gemm_fused).
+
+    Inputs are quantised through float8_e4m3fn, then the MXU matmul runs
+    with a half-precision accumulator type; bias/scale fuse into the same
+    XLA program.
+    """
+    from ..core import dtype as dtype_mod
+    out_dt = dtype_mod.dtype(output_dtype).np_dtype
+
+    def fn(a, b, *rest):
+        f8 = jnp.float8_e4m3fn
+        a8 = a.astype(f8).astype(out_dt)
+        b8 = b.astype(f8).astype(out_dt)
+        if transpose_x:
+            a8 = jnp.swapaxes(a8, -2, -1)
+        if transpose_y:
+            b8 = jnp.swapaxes(b8, -2, -1)
+        out = jnp.matmul(a8, b8) * jnp.asarray(scale, out_dt)
+        if rest:
+            out = out + rest[0].astype(out_dt)
+        return out
+    args = [x, y] + ([bias] if bias is not None else [])
+    return run_op("fp8_fp8_half_gemm_fused", fn, args)

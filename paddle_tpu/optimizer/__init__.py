@@ -1,6 +1,7 @@
 """paddle_tpu.optimizer (reference: python/paddle/optimizer)."""
 from . import lr  # noqa: F401
 from .optimizer import Optimizer  # noqa: F401
+from .lbfgs import LBFGS  # noqa: F401
 from .optimizers import (  # noqa: F401
     ASGD, Adadelta, NAdam, RAdam, Rprop,
     SGD, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, RMSProp,

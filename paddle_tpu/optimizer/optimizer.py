@@ -48,6 +48,9 @@ class Optimizer:
         self._grad_clip = grad_clip
         if grad_clip is not None and not isinstance(grad_clip, ClipGradBase):
             raise TypeError("grad_clip must be a paddle_tpu.nn.Clip* object")
+        # fp32 master weights for half-precision params (reference:
+        # multi_precision kwarg; amp.decorate(level="O2") switches it on)
+        self._multi_precision = False
         # accumulator state: {param_id: {name: jnp array}}
         self._accumulators: Dict[int, Dict[str, jnp.ndarray]] = {}
         self._global_step = 0
@@ -111,15 +114,31 @@ class Optimizer:
                 g = grad_of.get(id(p))
                 if g is None:
                     continue
-                state = self._state_for(p)
                 plr = lr * p.optimize_attr.get("learning_rate", 1.0) \
                     if hasattr(p, "optimize_attr") else lr
                 garr = unwrap(g)
-                if garr.dtype != p._data.dtype:
-                    garr = garr.astype(p._data.dtype)
-                new_p, new_state = self._update(p._data, garr, state, plr,
-                                                wd)
-                p._data = new_p
+                mp = self._multi_precision and \
+                    p._data.dtype in (jnp.float16, jnp.bfloat16)
+                if mp:
+                    # accumulate in an fp32 master copy; moments init fp32
+                    if id(p) not in self._accumulators:
+                        master = p._data.astype(jnp.float32)
+                        st = self._init_state(master)
+                        st["_master_weight"] = master
+                        self._accumulators[id(p)] = st
+                    state = self._accumulators[id(p)]
+                    master = state["_master_weight"]
+                    new_master, new_state = self._update(
+                        master, garr.astype(jnp.float32), state, plr, wd)
+                    new_state["_master_weight"] = new_master
+                    p._data = new_master.astype(p._data.dtype)
+                else:
+                    state = self._state_for(p)
+                    if garr.dtype != p._data.dtype:
+                        garr = garr.astype(p._data.dtype)
+                    new_p, new_state = self._update(p._data, garr, state,
+                                                    plr, wd)
+                    p._data = new_p
                 self._accumulators[id(p)] = new_state
         self._global_step += 1
 

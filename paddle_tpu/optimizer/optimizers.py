@@ -116,6 +116,7 @@ class Adam(Optimizer):
         self._beta2 = beta2
         self._epsilon = epsilon
         self._amsgrad = amsgrad
+        self._multi_precision = bool(multi_precision)
 
     def _init_state(self, param):
         s = {"moment1": jnp.zeros_like(param),

@@ -147,3 +147,158 @@ tanh = _unary("tanh", jnp.tanh)
 sqrt = _unary("sqrt", jnp.sqrt)
 square = _unary("square", jnp.square)
 neg = _unary("neg", jnp.negative)
+asin = _unary("asin", jnp.arcsin)
+asinh = _unary("asinh", jnp.arcsinh)
+atan = _unary("atan", jnp.arctan)
+atanh = _unary("atanh", jnp.arctanh)
+sinh = _unary("sinh", jnp.sinh)
+tan = _unary("tan", jnp.tan)
+expm1 = _unary("expm1", jnp.expm1)
+log1p = _unary("log1p", jnp.log1p)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+isnan = _unary("isnan", jnp.isnan)
+
+
+def pow(x: SparseTensor, factor):  # noqa: A001
+    """Elementwise power on stored values (reference: paddle.sparse.pow)."""
+    return SparseTensor(jsparse.BCOO(
+        (jnp.power(x._bcoo.data, factor), x._bcoo.indices),
+        shape=x._bcoo.shape), x._fmt)
+
+
+def cast(x: SparseTensor, index_dtype=None, value_dtype=None, name=None):
+    """Cast index/value dtypes (reference: paddle.sparse.cast)."""
+    from ..core import dtype as dtype_mod
+    data, idx = x._bcoo.data, x._bcoo.indices
+    if value_dtype is not None:
+        data = data.astype(dtype_mod.dtype(value_dtype).np_dtype)
+    if index_dtype is not None:
+        idx = idx.astype(dtype_mod.dtype(index_dtype).np_dtype)
+    return SparseTensor(jsparse.BCOO((data, idx), shape=x._bcoo.shape),
+                        x._fmt)
+
+
+def coalesce(x: SparseTensor, name=None):
+    """Merge duplicate indices (reference: paddle.sparse.coalesce;
+    BCOO sum_duplicates underneath)."""
+    return SparseTensor(x._bcoo.sum_duplicates(), x._fmt)
+
+
+def subtract(x: SparseTensor, y: SparseTensor, name=None):
+    neg_y = jsparse.BCOO((-y._bcoo.data, y._bcoo.indices),
+                         shape=y._bcoo.shape)
+    return SparseTensor(x._bcoo + neg_y)
+
+
+def divide(x: SparseTensor, y: SparseTensor, name=None):
+    """Elementwise divide; densifies (quotient of sparse tensors is dense
+    wherever y==0 anyway, so the dense route is the honest one)."""
+    out = unwrap(to_dense(x)) / unwrap(to_dense(y))
+    return to_sparse_coo(wrap(out), sparse_dim=len(x.shape))
+
+
+def is_same_shape(x, y) -> bool:
+    """Shape equality across sparse/dense operands (reference:
+    paddle.sparse.is_same_shape)."""
+    return list(x.shape) == list(y.shape)
+
+
+def reshape(x: SparseTensor, shape, name=None):
+    from jax.experimental.sparse import bcoo_reshape
+    return SparseTensor(bcoo_reshape(x._bcoo.sum_duplicates(),
+                                     new_sizes=tuple(int(s) for s in shape)),
+                        x._fmt)
+
+
+def transpose(x: SparseTensor, perm, name=None):
+    from jax.experimental.sparse import bcoo_transpose
+    return SparseTensor(bcoo_transpose(x._bcoo,
+                                       permutation=tuple(int(p)
+                                                         for p in perm)),
+                        x._fmt)
+
+
+def slice(x: SparseTensor, axes, starts, ends, name=None):  # noqa: A001
+    """Slice a sparse tensor (reference: paddle.sparse.slice)."""
+    import builtins
+    idx = [builtins.slice(None)] * len(x.shape)
+    for ax, st, en in zip(axes, starts, ends):
+        ax = int(ax)
+        size = x.shape[ax]
+        st, en = int(st), int(en)
+        st = st + size if st < 0 else st
+        en = en + size if en < 0 else min(en, size)
+        idx[ax] = builtins.slice(st, en)
+    dense = unwrap(to_dense(x))[tuple(idx)]
+    return to_sparse_coo(wrap(dense), sparse_dim=len(x.shape))
+
+
+def sum(x: SparseTensor, axis=None, dtype=None, keepdim=False,  # noqa: A001
+        name=None):
+    """Reduce-sum; returns a SparseTensor like the reference."""
+    from ..core import dtype as dtype_mod
+    dense = unwrap(to_dense(x))
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    out = jnp.sum(dense, axis=ax, keepdims=keepdim)
+    if dtype is not None:
+        out = out.astype(dtype_mod.dtype(dtype).np_dtype)
+    nd = max(out.ndim, 1)
+    return to_sparse_coo(wrap(out.reshape((1,) if out.ndim == 0 else
+                                          out.shape)), sparse_dim=nd)
+
+
+def mv(x: SparseTensor, vec, name=None):
+    """Sparse matrix x dense vector (reference: paddle.sparse.mv)."""
+    v = unwrap(vec)
+    out = x._bcoo @ v
+    return wrap(out)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x@y) with sparse x (reference:
+    paddle.sparse.addmm)."""
+    xy = x._bcoo @ unwrap(y) if isinstance(x, SparseTensor) \
+        else unwrap(x) @ unwrap(y)
+    base = unwrap(to_dense(input)) if isinstance(input, SparseTensor) \
+        else unwrap(input)
+    return wrap(beta * base + alpha * xy)
+
+
+def masked_matmul(x, y, mask: SparseTensor, name=None):
+    """(x @ y) sampled at mask's sparsity pattern (reference:
+    paddle.sparse.masked_matmul — SDDMM). Computes only the nnz outputs
+    by gathering the needed rows/cols, so the dense product never
+    materialises."""
+    a, b = unwrap(x), unwrap(y)
+    idx = mask._bcoo.indices  # [nnz, 2]
+    rows = a[idx[:, 0], :]           # [nnz, k]
+    cols = b[:, idx[:, 1]].T         # [nnz, k]
+    vals = jnp.sum(rows * cols, axis=-1).astype(a.dtype)
+    return SparseTensor(jsparse.BCOO((vals, idx), shape=mask._bcoo.shape),
+                        mask._fmt)
+
+
+def mask_as(x, mask: SparseTensor, name=None):
+    """Sample dense x at mask's sparsity pattern (reference:
+    paddle.sparse.mask_as)."""
+    a = unwrap(x)
+    idx = mask._bcoo.indices
+    vals = a[tuple(idx[:, i] for i in range(idx.shape[1]))]
+    return SparseTensor(jsparse.BCOO((vals, idx), shape=mask._bcoo.shape),
+                        mask._fmt)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Low-rank PCA of a (sparse or dense) matrix (reference:
+    paddle.sparse.pca_lowrank). Densifies — the decomposition output is
+    dense regardless, and XLA's SVD wants the dense operand."""
+    a = unwrap(to_dense(x)) if isinstance(x, SparseTensor) else unwrap(x)
+    m, n = a.shape[-2], a.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        a = a - jnp.mean(a, axis=-2, keepdims=True)
+    u, s, vh = jnp.linalg.svd(a, full_matrices=False)
+    return (wrap(u[..., :q]), wrap(s[..., :q]),
+            wrap(jnp.swapaxes(vh, -2, -1)[..., :q]))

@@ -3,3 +3,6 @@ from . import datasets  # noqa: F401
 from . import models  # noqa: F401
 from . import transforms  # noqa: F401
 from .models import LeNet, ResNet, resnet18, resnet50  # noqa: F401
+from .image import (  # noqa: F401
+    get_image_backend, image_load, set_image_backend,
+)

@@ -1,0 +1,190 @@
+"""Submodule parity batch: fft hfft family, linalg additions, sparse ops,
+LBFGS, amp.decorate O2, saved_tensors_hooks, jit/vision shims."""
+import re
+import pathlib
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+REF = pathlib.Path("/root/reference/python/paddle")
+
+
+def _ref_all(rel):
+    f = REF / rel
+    m = re.search(r"__all__\s*=\s*\[(.*?)\]", f.read_text(), re.S)
+    return set(re.findall(r"'([^']+)'", m.group(1)))
+
+
+@pytest.mark.skipif(not REF.exists(), reason="reference not mounted")
+@pytest.mark.parametrize("rel,mod", [
+    ("linalg.py", "linalg"), ("fft.py", "fft"), ("sparse/__init__.py",
+                                                 "sparse"),
+    ("amp/__init__.py", "amp"), ("autograd/__init__.py", "autograd"),
+    ("optimizer/__init__.py", "optimizer"), ("vision/__init__.py",
+                                             "vision"),
+    ("jit/__init__.py", "jit"),
+])
+def test_submodule_all_parity(rel, mod):
+    ours = getattr(paddle, mod)
+    missing = sorted(_ref_all(rel) - set(dir(ours)))
+    assert not missing, f"paddle.{mod} missing: {missing}"
+
+
+def test_hfft_family():
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((4, 5)) + 1j * rng.standard_normal((4, 5))
+    want = np.fft.hfft(np.fft.fft(a, axis=0), axis=1)
+    got = paddle.fft.hfft2(paddle.to_tensor(a, dtype="complex128")).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-9)
+    r = rng.standard_normal((4, 6))
+    half = paddle.fft.ihfftn(paddle.to_tensor(r, dtype="float64"))
+    back = paddle.fft.hfftn(half, s=[4, 6]).numpy()
+    np.testing.assert_allclose(back, r, atol=1e-8)
+
+
+def test_matrix_exp_and_ormqr():
+    from scipy.linalg import expm, qr
+    a = np.random.default_rng(0).standard_normal((4, 4)) * 0.3
+    got = paddle.linalg.matrix_exp(
+        paddle.to_tensor(a, dtype="float64")).numpy()
+    np.testing.assert_allclose(got, expm(a), atol=1e-8)
+    A = np.random.default_rng(1).standard_normal((5, 3))
+    (qr_mat, tau), _ = qr(A, mode="raw")
+    y = np.random.default_rng(2).standard_normal((5, 2))
+    Qfull = qr(A)[0]
+    got = paddle.linalg.ormqr(
+        paddle.to_tensor(np.asarray(qr_mat), dtype="float64"),
+        paddle.to_tensor(np.asarray(tau), dtype="float64"),
+        paddle.to_tensor(y, dtype="float64")).numpy()
+    np.testing.assert_allclose(got, Qfull @ y, atol=1e-8)
+    gotT = paddle.linalg.ormqr(
+        paddle.to_tensor(np.asarray(qr_mat), dtype="float64"),
+        paddle.to_tensor(np.asarray(tau), dtype="float64"),
+        paddle.to_tensor(y, dtype="float64"), transpose=True).numpy()
+    np.testing.assert_allclose(gotT, Qfull.T @ y, atol=1e-8)
+
+
+def test_fp8_gemm():
+    out = paddle.linalg.fp8_fp8_half_gemm_fused(
+        paddle.ones([4, 8]), paddle.ones([8, 4]), bias=paddle.ones([4]),
+        output_dtype="float16")
+    assert out.dtype.name == "float16"
+    np.testing.assert_allclose(out.numpy(), 9.0)
+
+
+def test_sparse_ops():
+    sp = paddle.sparse
+    dense = np.array([[0, 2.0, 0], [3, 0, 4.0]], np.float32)
+    st = sp.to_sparse_coo(paddle.to_tensor(dense), sparse_dim=2)
+    np.testing.assert_allclose(sp.to_dense(sp.subtract(st, st)).numpy(), 0)
+    np.testing.assert_allclose(
+        sp.mv(st, paddle.to_tensor(np.ones(3, np.float32))).numpy(),
+        dense @ np.ones(3))
+    np.testing.assert_allclose(
+        sp.to_dense(sp.transpose(st, [1, 0])).numpy(), dense.T)
+    np.testing.assert_allclose(
+        sp.to_dense(sp.reshape(st, [3, 2])).numpy(), dense.reshape(3, 2))
+    x = np.random.default_rng(0).standard_normal((2, 4)).astype(np.float32)
+    y = np.random.default_rng(1).standard_normal((4, 3)).astype(np.float32)
+    full = x @ y
+    mm = sp.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y), st)
+    np.testing.assert_allclose(
+        sp.to_dense(mm).numpy(), np.where(dense != 0, full, 0), atol=1e-5)
+    ma = sp.mask_as(paddle.to_tensor(full), st)
+    np.testing.assert_allclose(
+        sp.to_dense(ma).numpy(), np.where(dense != 0, full, 0), atol=1e-6)
+    assert sp.is_same_shape(st, paddle.to_tensor(dense))
+    c = sp.cast(st, value_dtype="float64")
+    assert c.values().numpy().dtype == np.float64
+
+
+def test_lbfgs_converges_to_lstsq():
+    rng = np.random.default_rng(0)
+    A = paddle.to_tensor(rng.standard_normal((6, 4)).astype(np.float32))
+    b = paddle.to_tensor(rng.standard_normal((6,)).astype(np.float32))
+    x = paddle.create_parameter([4], "float32")
+    opt = paddle.optimizer.LBFGS(
+        learning_rate=1.0, max_iter=30, line_search_fn="strong_wolfe",
+        parameters=[x])
+
+    def closure():
+        r = paddle.matmul(A, x) - b
+        loss = (r * r).sum()
+        loss.backward()
+        return loss
+
+    for _ in range(5):
+        loss = opt.step(closure)
+    xstar, *_ = np.linalg.lstsq(A.numpy(), b.numpy(), rcond=None)
+    np.testing.assert_allclose(x.numpy(), xstar, atol=1e-3)
+
+
+def test_amp_decorate_o2_keeps_norm_fp32_and_master_weights():
+    class NetBN(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 8)
+            self.bn = nn.BatchNorm1D(8)
+
+        def forward(self, x):
+            return self.bn(self.fc(x))
+
+    net = NetBN()
+    opt = paddle.optimizer.Adam(parameters=net.parameters())
+    m, o = paddle.amp.decorate(net, opt, level="O2", dtype="float16")
+    assert net.fc.weight.dtype.name == "float16"
+    assert net.bn.weight.dtype.name == "float32"
+    assert o._multi_precision
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+        (8, 4)).astype(np.float16))
+    net(x).sum().backward()
+    o.step()
+    st = o._accumulators[id(net.fc.weight)]
+    assert str(st["_master_weight"].dtype) == "float32"
+    assert str(st["moment1"].dtype) == "float32"
+    assert net.fc.weight.dtype.name == "float16"
+
+
+def test_bernoulli_inplace_uses_p():
+    t = paddle.zeros([2000])
+    t.bernoulli_(0.25)
+    frac = float(t.numpy().mean())
+    assert 0.15 < frac < 0.35
+
+
+def test_saved_tensors_hooks():
+    from paddle_tpu.autograd import saved_tensors_hooks
+    packed = []
+
+    class Sq(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, a):
+            ctx.save_for_backward(a)
+            return a * a
+
+        @staticmethod
+        def backward(ctx, g):
+            (a,) = ctx.saved_tensor()
+            return 2 * a * g
+
+    with saved_tensors_hooks(
+            lambda t: (packed.append(1), t.numpy())[1],
+            lambda p: paddle.to_tensor(p)):
+        inp = paddle.to_tensor([3.0], stop_gradient=False)
+        out = Sq.apply(inp)
+    out.backward()
+    assert packed == [1]
+    np.testing.assert_allclose(inp.grad.numpy(), [6.0])
+
+
+def test_jit_and_vision_shims():
+    paddle.jit.set_verbosity(0)
+    paddle.jit.set_code_level()
+    paddle.jit.ignore_module([np])
+    paddle.vision.set_image_backend("pil")
+    assert paddle.vision.get_image_backend() == "pil"
+    with pytest.raises(ValueError):
+        paddle.vision.set_image_backend("bogus")
