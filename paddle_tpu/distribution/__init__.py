@@ -1,4 +1,6 @@
 from .distributions import (  # noqa: F401
-    Bernoulli, Beta, Categorical, Dirichlet, Distribution, Exponential,
-    Gamma, Gumbel, Laplace, LogNormal, Multinomial, Normal, Poisson,
-    TransformedDistribution, Uniform, kl_divergence, register_kl)
+    Bernoulli, Beta, Binomial, Categorical, Cauchy, Chi2,
+    ContinuousBernoulli, Dirichlet, Distribution, Exponential,
+    ExponentialFamily, Gamma, Geometric, Gumbel, Independent, LKJCholesky,
+    Laplace, LogNormal, Multinomial, MultivariateNormal, Normal, Poisson,
+    StudentT, TransformedDistribution, Uniform, kl_divergence, register_kl)

@@ -504,3 +504,478 @@ def _kl_exponential_exponential(p, q):
         return jnp.log(pr / qr) + qr / pr - 1
     return run_op("kl_exponential_exponential", fn,
                   [p._rate_in, q._rate_in])
+
+
+# -- round-2 parity batch (reference python/paddle/distribution/*.py) --------
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (reference
+    distribution/exponential_family.py). entropy() falls out of the
+    log-normalizer via autodiff (the Bregman identity), which is the
+    reference's _entropy mechanism re-expressed with jax.grad."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        nat = [jnp.asarray(p, jnp.float32) for p in
+               self._natural_parameters]
+        lg = self._log_normalizer(*nat)
+        ent = lg - self._mean_carrier_measure
+        grads = jax.grad(lambda *ps: jnp.sum(self._log_normalizer(*ps)),
+                         argnums=tuple(range(len(nat))))(*nat)
+        for p, g in zip(nat, grads):
+            ent = ent - p * g
+        return wrap(ent)
+
+
+class Binomial(Distribution):
+    """Binomial(total_count, probs) (reference distribution/binomial.py)."""
+
+    def __init__(self, total_count, probs, name=None):
+        self._probs_in = probs
+        self.total_count = _arr(total_count).astype(jnp.int32)
+        self.probs = _arr(probs)
+        super().__init__(jnp.broadcast_shapes(self.total_count.shape,
+                                              self.probs.shape))
+
+    @property
+    def mean(self):
+        return wrap(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return wrap(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = tuple(shape) + self.batch_shape
+        n = jnp.broadcast_to(self.total_count, self.batch_shape)
+        out = jax.random.binomial(key, n.astype(jnp.float32),
+                                  jnp.broadcast_to(self.probs,
+                                                   self.batch_shape),
+                                  shape=shp)
+        return wrap(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        def fn(v, p):
+            n = self.total_count.astype(v.dtype)
+            comb = (jax.scipy.special.gammaln(n + 1)
+                    - jax.scipy.special.gammaln(v + 1)
+                    - jax.scipy.special.gammaln(n - v + 1))
+            return comb + v * jnp.log(p) + (n - v) * jnp.log1p(-p)
+        return run_op("binomial_log_prob", fn, [value, self._probs_in])
+
+    def entropy(self):
+        # explicit sum over the support, like the reference kernel
+        n_max = int(jnp.max(self.total_count))
+        ks = jnp.arange(n_max + 1, dtype=jnp.float32)
+
+        def fn(p):
+            n = jnp.broadcast_to(self.total_count, self.batch_shape) \
+                .astype(jnp.float32)
+            k = ks.reshape((-1,) + (1,) * len(self.batch_shape))
+            comb = (jax.scipy.special.gammaln(n + 1)
+                    - jax.scipy.special.gammaln(k + 1)
+                    - jax.scipy.special.gammaln(n - k + 1))
+            logp = comb + k * jnp.log(p) + (n - k) * jnp.log1p(-p)
+            logp = jnp.where(k <= n, logp, -jnp.inf)
+            pk = jnp.exp(logp)
+            return -jnp.sum(jnp.where(pk > 0, pk * logp, 0.0), axis=0)
+        return run_op("binomial_entropy", fn, [self._probs_in])
+
+
+class Cauchy(Distribution):
+    """Cauchy(loc, scale) (reference distribution/cauchy.py)."""
+
+    def __init__(self, loc, scale, name=None):
+        self._loc_in, self._scale_in = loc, scale
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def rsample(self, shape=()):
+        key = random_mod.next_key()
+        shp = tuple(shape) + self.batch_shape
+
+        def fn(loc, scale):
+            u = jax.random.uniform(key, shp, minval=1e-7, maxval=1 - 1e-7)
+            return loc + scale * jnp.tan(jnp.pi * (u - 0.5))
+        return run_op("cauchy_rsample", fn, [self._loc_in, self._scale_in])
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def log_prob(self, value):
+        def fn(v, loc, scale):
+            z = (v - loc) / scale
+            return -jnp.log(jnp.pi * scale * (1 + z * z))
+        return run_op("cauchy_log_prob", fn,
+                      [value, self._loc_in, self._scale_in])
+
+    def cdf(self, value):
+        def fn(v, loc, scale):
+            return jnp.arctan((v - loc) / scale) / jnp.pi + 0.5
+        return run_op("cauchy_cdf", fn,
+                      [value, self._loc_in, self._scale_in])
+
+    def entropy(self):
+        def fn(scale):
+            return jnp.log(4 * jnp.pi * scale) + jnp.zeros(self.batch_shape)
+        return run_op("cauchy_entropy", fn, [self._scale_in])
+
+
+class Chi2(Gamma):
+    """Chi-squared = Gamma(df/2, rate 1/2) (reference
+    distribution/chi2.py)."""
+
+    def __init__(self, df, name=None):
+        self.df = _arr(df)
+        super().__init__(self.df / 2.0, 0.5)
+
+
+class ContinuousBernoulli(Distribution):
+    """Continuous Bernoulli on [0, 1] (reference
+    distribution/continuous_bernoulli.py)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self._probs_in = probs
+        self.probs = _arr(probs)
+        self._lims = lims
+        super().__init__(self.probs.shape)
+
+    def _log_C(self, lam):
+        # log normalizing constant, with the removable singularity at 1/2
+        # handled by a Taylor guard like the reference
+        lo, hi = self._lims
+        safe = jnp.where((lam > lo) & (lam < hi), 0.25, lam)
+        logc = jnp.log(
+            (2 * jnp.arctanh(1 - 2 * safe)) / (1 - 2 * safe))
+        taylor = math.log(2.0) + 4.0 / 3.0 * (lam - 0.5) ** 2
+        return jnp.where((lam > lo) & (lam < hi), taylor, logc)
+
+    @property
+    def mean(self):
+        lam = self.probs
+        lo, hi = self._lims
+        safe = jnp.where((lam > lo) & (lam < hi), 0.25, lam)
+        m = safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * safe))
+        return wrap(jnp.where((lam > lo) & (lam < hi), 0.5, m))
+
+    def rsample(self, shape=()):
+        key = random_mod.next_key()
+        shp = tuple(shape) + self.batch_shape
+
+        def fn(lam):
+            u = jax.random.uniform(key, shp, minval=1e-6, maxval=1 - 1e-6)
+            lo, hi = self._lims
+            safe = jnp.where((lam > lo) & (lam < hi), 0.25, lam)
+            x = (jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+                 / (jnp.log(safe) - jnp.log1p(-safe)))
+            return jnp.where((lam > lo) & (lam < hi), u, x)
+        return run_op("cb_rsample", fn, [self._probs_in])
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def log_prob(self, value):
+        def fn(v, lam):
+            return (v * jnp.log(lam) + (1 - v) * jnp.log1p(-lam)
+                    + self._log_C(lam))
+        return run_op("cb_log_prob", fn, [value, self._probs_in])
+
+
+class Geometric(Distribution):
+    """Geometric(probs): trials-to-first-success on {1, 2, ...} minus
+    semantics follow the reference (support {0, 1, ...} for pmf
+    (1-p)^k p) (reference distribution/geometric.py)."""
+
+    def __init__(self, probs, name=None):
+        self._probs_in = probs
+        self.probs = _arr(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return wrap((1 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        return wrap((1 - self.probs) / self.probs ** 2)
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = tuple(shape) + self.batch_shape
+
+        def fn(p):
+            u = jax.random.uniform(key, shp, minval=1e-7, maxval=1 - 1e-7)
+            return jnp.floor(jnp.log1p(-u) / jnp.log1p(-p))
+        return run_op("geometric_sample", fn, [self._probs_in])
+
+    def log_prob(self, value):
+        def fn(v, p):
+            return v * jnp.log1p(-p) + jnp.log(p)
+        return run_op("geometric_log_prob", fn, [value, self._probs_in])
+
+    def entropy(self):
+        def fn(p):
+            q = 1 - p
+            return -(q * jnp.log(q) + p * jnp.log(p)) / p
+        return run_op("geometric_entropy", fn, [self._probs_in])
+
+    def cdf(self, value):
+        def fn(v, p):
+            return 1 - jnp.power(1 - p, v + 1)
+        return run_op("geometric_cdf", fn, [value, self._probs_in])
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims as event dims (reference
+    distribution/independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank, name=None):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        bs = base.batch_shape
+        k = self.reinterpreted_batch_rank
+        if k > len(bs):
+            raise ValueError(
+                "reinterpreted_batch_rank exceeds base batch rank")
+        super().__init__(bs[:len(bs) - k],
+                         bs[len(bs) - k:] + tuple(base.event_shape))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        k = self.reinterpreted_batch_rank
+        if k == 0:
+            return lp
+        def fn(a):
+            return jnp.sum(a, axis=tuple(range(a.ndim - k, a.ndim)))
+        return run_op("independent_log_prob", fn, [lp])
+
+    def entropy(self):
+        ent = self.base.entropy()
+        k = self.reinterpreted_batch_rank
+        if k == 0:
+            return ent
+        def fn(a):
+            return jnp.sum(a, axis=tuple(range(a.ndim - k, a.ndim)))
+        return run_op("independent_entropy", fn, [ent])
+
+
+class MultivariateNormal(Distribution):
+    """MVN via scale_tril (reference
+    distribution/multivariate_normal.py). Exactly one of
+    covariance_matrix / precision_matrix / scale_tril must be given."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        given = [a is not None for a in (covariance_matrix,
+                                         precision_matrix, scale_tril)]
+        if sum(given) != 1:
+            raise ValueError("pass exactly one of covariance_matrix, "
+                             "precision_matrix, scale_tril")
+        self._loc_in = loc
+        self.loc = _arr(loc)
+        # keep the RAW covariance input: it is passed through run_op so
+        # gradients reach it (the jax cholesky/inv inside the op are
+        # differentiable); _to_tril re-derives L inside each op.
+        if scale_tril is not None:
+            self._cov_in, self._cov_form = scale_tril, "tril"
+        elif covariance_matrix is not None:
+            self._cov_in, self._cov_form = covariance_matrix, "cov"
+        else:
+            self._cov_in, self._cov_form = precision_matrix, "prec"
+        self.scale_tril = self._to_tril(_arr(self._cov_in))
+        d = self.loc.shape[-1]
+        super().__init__(jnp.broadcast_shapes(
+            self.loc.shape[:-1], self.scale_tril.shape[:-2]), (d,))
+
+    @property
+    def mean(self):
+        return wrap(jnp.broadcast_to(self.loc,
+                                     self.batch_shape + self.event_shape))
+
+    @property
+    def covariance_matrix(self):
+        return wrap(self.scale_tril @ jnp.swapaxes(self.scale_tril,
+                                                   -2, -1))
+
+    @property
+    def variance(self):
+        return wrap(jnp.broadcast_to(
+            jnp.sum(self.scale_tril ** 2, axis=-1),
+            self.batch_shape + self.event_shape))
+
+    def _to_tril(self, raw):
+        if self._cov_form == "tril":
+            return raw
+        if self._cov_form == "cov":
+            return jnp.linalg.cholesky(raw)
+        return jnp.linalg.cholesky(jnp.linalg.inv(raw))
+
+    def rsample(self, shape=()):
+        key = random_mod.next_key()
+        shp = tuple(shape) + self.batch_shape + self.event_shape
+
+        def fn(loc, cov_raw):
+            L = self._to_tril(cov_raw)
+            eps = jax.random.normal(key, shp, jnp.float32)
+            return loc + jnp.einsum("...ij,...j->...i", L, eps)
+        return run_op("mvn_rsample", fn, [self._loc_in, self._cov_in])
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def log_prob(self, value):
+        def fn(v, loc, cov_raw):
+            L = self._to_tril(cov_raw)
+            d = v.shape[-1]
+            diff = v - loc
+            z = jax.scipy.linalg.solve_triangular(
+                L, diff[..., None], lower=True)[..., 0]
+            half_logdet = jnp.sum(jnp.log(jnp.abs(
+                jnp.diagonal(L, axis1=-2, axis2=-1))), -1)
+            return (-0.5 * jnp.sum(z * z, -1) - half_logdet
+                    - 0.5 * d * math.log(2 * math.pi))
+        return run_op("mvn_log_prob", fn,
+                      [value, self._loc_in, self._cov_in])
+
+    def entropy(self):
+        d = self.event_shape[0]
+        half_logdet = jnp.sum(jnp.log(jnp.abs(
+            jnp.diagonal(self.scale_tril, axis1=-2, axis2=-1))), -1)
+        ent = 0.5 * d * (1 + math.log(2 * math.pi)) + half_logdet
+        return wrap(jnp.broadcast_to(ent, self.batch_shape))
+
+
+class StudentT(Distribution):
+    """Student's t (reference distribution/student_t.py)."""
+
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self._df_in, self._loc_in, self._scale_in = df, loc, scale
+        self.df = _arr(df)
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(
+            self.df.shape, self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return wrap(jnp.where(self.df > 1,
+                              jnp.broadcast_to(self.loc, self.batch_shape),
+                              jnp.nan))
+
+    @property
+    def variance(self):
+        v = jnp.where(self.df > 2, self.df / (self.df - 2), jnp.inf)
+        v = jnp.where(self.df > 1, v, jnp.nan)
+        return wrap(jnp.broadcast_to(self.scale ** 2 * v, self.batch_shape))
+
+    def sample(self, shape=()):
+        key = random_mod.next_key()
+        shp = tuple(shape) + self.batch_shape
+
+        def fn(df, loc, scale):
+            t = jax.random.t(key, jnp.broadcast_to(df, shp), shp)
+            return loc + scale * t
+        return run_op("student_t_sample", fn,
+                      [self._df_in, self._loc_in, self._scale_in])
+
+    def log_prob(self, value):
+        def fn(v, df, loc, scale):
+            z = (v - loc) / scale
+            return (jax.scipy.special.gammaln((df + 1) / 2)
+                    - jax.scipy.special.gammaln(df / 2)
+                    - 0.5 * jnp.log(df * jnp.pi) - jnp.log(scale)
+                    - (df + 1) / 2 * jnp.log1p(z * z / df))
+        return run_op("student_t_log_prob", fn,
+                      [value, self._df_in, self._loc_in, self._scale_in])
+
+    def entropy(self):
+        def fn(df, scale):
+            half = (df + 1) / 2
+            return (jnp.log(scale) + 0.5 * jnp.log(df)
+                    + jax.scipy.special.betaln(df / 2, 0.5)
+                    + half * (jax.scipy.special.digamma(half)
+                              - jax.scipy.special.digamma(df / 2)))
+        return run_op("student_t_entropy", fn,
+                      [self._df_in, self._scale_in])
+
+
+class LKJCholesky(Distribution):
+    """LKJ prior over Cholesky factors of correlation matrices
+    (reference distribution/lkj_cholesky.py). Sampling uses the onion
+    method; log_prob follows the standard LKJ density on L."""
+
+    def __init__(self, dim, concentration=1.0, sample_method="onion",
+                 name=None):
+        if dim < 2:
+            raise ValueError("dim must be >= 2")
+        if sample_method not in ("onion", "cvine"):
+            raise ValueError("sample_method must be 'onion' or 'cvine'")
+        self.dim = int(dim)
+        self.concentration = _arr(concentration)
+        self.sample_method = sample_method
+        super().__init__(self.concentration.shape, (self.dim, self.dim))
+
+    def sample(self, shape=()):
+        # onion method: build row by row from Beta marginals + spheres
+        d = self.dim
+        shp = tuple(shape) + self.batch_shape
+        eta = jnp.broadcast_to(self.concentration, shp)
+        key = random_mod.next_key()
+        keys = jax.random.split(key, 2 * d)
+        L = jnp.zeros(shp + (d, d), jnp.float32).at[..., 0, 0].set(1.0)
+        beta = eta + (d - 2) / 2.0
+        for i in range(1, d):
+            b = jax.random.beta(keys[2 * i], i / 2.0, beta, shp)
+            beta = beta - 0.5
+            u = jax.random.normal(keys[2 * i + 1], shp + (i,))
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            w = jnp.sqrt(b)[..., None] * u
+            L = L.at[..., i, :i].set(w)
+            L = L.at[..., i, i].set(jnp.sqrt(jnp.maximum(1 - b, 1e-12)))
+        return wrap(L)
+
+    def log_prob(self, value):
+        def fn(L, eta):
+            d = self.dim
+            diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+            orders = jnp.arange(2, d + 1, dtype=jnp.float32)
+            exponents = 2 * (eta[..., None] - 1) + d - orders
+            unnorm = jnp.sum(exponents * jnp.log(diag), axis=-1)
+            # normalizer in multivariate-gamma form (LKJ 2009 p.1999;
+            # reference lkj_cholesky.py uses the same identity)
+            dm1 = d - 1
+            alpha = eta + 0.5 * dm1
+            norm = (0.5 * dm1 * math.log(math.pi)
+                    + jax.scipy.special.multigammaln(alpha - 0.5, dm1)
+                    - dm1 * jax.scipy.special.gammaln(alpha))
+            return unnorm - norm
+        return run_op("lkj_log_prob", fn,
+                      [value, wrap(jnp.asarray(self.concentration,
+                                               jnp.float32))])

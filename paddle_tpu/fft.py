@@ -107,7 +107,13 @@ def fft_c2r(x, axes, normalization="backward", forward=True, last_dim_size=0,
 
 def _h_axes(a_ndim, s, axes, two_d):
     if axes is None:
-        axes = (-2, -1) if two_d else tuple(range(a_ndim))
+        if two_d:
+            axes = (-2, -1)
+        elif s is not None:
+            # numpy semantics: s given -> transform the last len(s) dims
+            axes = tuple(range(a_ndim - len(s), a_ndim))
+        else:
+            axes = tuple(range(a_ndim))
     axes = tuple(int(ax) for ax in axes)
     if s is not None:
         s = tuple(int(v) for v in s)

@@ -67,12 +67,29 @@ class LBFGS(Optimizer):
         return jnp.concatenate(parts)
 
     def _eval(self, closure, flat_x):
-        """Set params to flat_x, run closure -> (loss value, flat grad)."""
+        """Set params to flat_x, run closure -> (loss value, flat grad).
+
+        weight_decay adds the L2 term to both loss and gradient (so the
+        line search sees the regularised objective); grad_clip runs on the
+        per-parameter grads through the standard clip interface before
+        flattening.
+        """
         self._set_flat_params(flat_x)
         self.clear_grad()
         loss = closure()
         self._n_evals += 1
-        return float(unwrap(loss)), self._gather_flat_grad()
+        if self._grad_clip is not None:
+            pg = [(p, p.grad) for p in self._params() if p.grad is not None]
+            for p, g in self._grad_clip(pg):
+                p.grad = g
+        loss_val = float(unwrap(loss))
+        flat_grad = self._gather_flat_grad()
+        from .optimizer import _decay_value
+        coeff = _decay_value(self._weight_decay)
+        if coeff:
+            loss_val += 0.5 * coeff * float(jnp.vdot(flat_x, flat_x))
+            flat_grad = flat_grad + coeff * flat_x
+        return loss_val, flat_grad
 
     # -- search direction -------------------------------------------------
     def _direction(self, flat_grad):

@@ -120,13 +120,18 @@ class Optimizer:
                 mp = self._multi_precision and \
                     p._data.dtype in (jnp.float16, jnp.bfloat16)
                 if mp:
-                    # accumulate in an fp32 master copy; moments init fp32
-                    if id(p) not in self._accumulators:
+                    # accumulate in an fp32 master copy; moments init fp32.
+                    # A pre-existing state without a master (steps taken
+                    # before multi_precision was enabled) gets one lazily.
+                    state = self._accumulators.get(id(p))
+                    if state is None:
                         master = p._data.astype(jnp.float32)
-                        st = self._init_state(master)
-                        st["_master_weight"] = master
-                        self._accumulators[id(p)] = st
-                    state = self._accumulators[id(p)]
+                        state = self._init_state(master)
+                        state["_master_weight"] = master
+                        self._accumulators[id(p)] = state
+                    elif "_master_weight" not in state:
+                        state["_master_weight"] = \
+                            p._data.astype(jnp.float32)
                     master = state["_master_weight"]
                     new_master, new_state = self._update(
                         master, garr.astype(jnp.float32), state, plr, wd)

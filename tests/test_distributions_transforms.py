@@ -1,0 +1,169 @@
+"""Round-2 distributions (vs scipy) + vision transforms parity batch."""
+import re
+import pathlib
+
+import numpy as np
+import pytest
+from scipy import stats
+
+import paddle_tpu as paddle
+
+D = paddle.distribution
+T = paddle.vision.transforms
+REF = pathlib.Path("/root/reference/python/paddle")
+
+
+@pytest.mark.skipif(not REF.exists(), reason="reference not mounted")
+@pytest.mark.parametrize("rel,mod", [
+    ("distribution/__init__.py", D), ("vision/transforms/__init__.py", T),
+])
+def test_all_parity(rel, mod):
+    m = re.search(r"__all__\s*=\s*\[(.*?)\]", (REF / rel).read_text(), re.S)
+    ra = set(re.findall(r"'([^']+)'", m.group(1)))
+    missing = sorted(ra - set(dir(mod)))
+    assert not missing, missing
+
+
+def test_binomial_vs_scipy():
+    b = D.Binomial(10, paddle.to_tensor(0.3))
+    np.testing.assert_allclose(
+        float(b.log_prob(paddle.to_tensor(4.0)).numpy()),
+        stats.binom.logpmf(4, 10, 0.3), rtol=1e-5)
+    np.testing.assert_allclose(float(b.entropy().numpy()),
+                               stats.binom.entropy(10, 0.3), rtol=1e-4)
+    np.testing.assert_allclose(float(b.mean.numpy()), 3.0, rtol=1e-6)
+
+
+def test_cauchy_chi2_geometric_studentt():
+    c = D.Cauchy(paddle.to_tensor(1.0), paddle.to_tensor(2.0))
+    np.testing.assert_allclose(
+        float(c.log_prob(paddle.to_tensor(0.5)).numpy()),
+        stats.cauchy.logpdf(0.5, 1, 2), rtol=1e-5)
+    np.testing.assert_allclose(float(c.entropy().numpy()),
+                               stats.cauchy.entropy(1, 2), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(c.cdf(paddle.to_tensor(1.0)).numpy()), 0.5, atol=1e-6)
+    ch = D.Chi2(paddle.to_tensor(3.0))
+    np.testing.assert_allclose(
+        float(ch.log_prob(paddle.to_tensor(2.0)).numpy()),
+        stats.chi2.logpdf(2, 3), rtol=1e-4)
+    g = D.Geometric(paddle.to_tensor(0.3))
+    np.testing.assert_allclose(
+        float(g.log_prob(paddle.to_tensor(2.0)).numpy()),
+        stats.geom.logpmf(3, 0.3), rtol=1e-5)
+    np.testing.assert_allclose(float(g.entropy().numpy()),
+                               stats.geom.entropy(0.3), rtol=1e-4)
+    t = D.StudentT(paddle.to_tensor(5.0), paddle.to_tensor(1.0),
+                   paddle.to_tensor(2.0))
+    np.testing.assert_allclose(
+        float(t.log_prob(paddle.to_tensor(0.0)).numpy()),
+        stats.t.logpdf(0, 5, 1, 2), rtol=1e-4)
+    np.testing.assert_allclose(float(t.entropy().numpy()),
+                               stats.t.entropy(5, 1, 2), rtol=1e-4)
+
+
+def test_mvn_logprob_entropy_and_grad():
+    L = np.array([[1.0, 0], [0.5, 1.2]], np.float32)
+    cov_np = L @ L.T
+    cov = paddle.to_tensor(cov_np, stop_gradient=False)
+    mvn = D.MultivariateNormal(paddle.to_tensor([0.0, 0.0]),
+                               covariance_matrix=cov)
+    np.testing.assert_allclose(
+        float(mvn.log_prob(paddle.to_tensor([0.3, -0.2])).numpy()),
+        stats.multivariate_normal.logpdf([0.3, -0.2], np.zeros(2), cov_np),
+        rtol=1e-4)
+    np.testing.assert_allclose(
+        float(mvn.entropy().numpy()),
+        stats.multivariate_normal(np.zeros(2), cov_np).entropy(), rtol=1e-5)
+    mvn.log_prob(paddle.to_tensor([0.4, -0.1])).sum().backward()
+    assert cov.grad is not None
+    assert np.isfinite(cov.grad.numpy()).all()
+    assert mvn.rsample([3]).shape == [3, 2]
+
+
+def test_independent_and_lkj():
+    base = D.Normal(paddle.to_tensor(np.zeros((3, 4), np.float32)),
+                    paddle.to_tensor(np.ones((3, 4), np.float32)))
+    ind = D.Independent(base, 1)
+    assert ind.batch_shape == (3,) and ind.event_shape == (4,)
+    lp = ind.log_prob(paddle.to_tensor(np.zeros((3, 4), np.float32)))
+    assert lp.shape == [3]
+    np.testing.assert_allclose(
+        lp.numpy(), 4 * stats.norm.logpdf(0.0), rtol=1e-5)
+    lkj = D.LKJCholesky(3, 1.0)
+    Ls = lkj.sample()
+    corr = Ls.numpy() @ Ls.numpy().T
+    np.testing.assert_allclose(np.diag(corr), 1.0, atol=1e-5)
+    assert np.isfinite(lkj.log_prob(Ls).numpy()).all()
+
+
+def test_continuous_bernoulli():
+    import math
+    cb = D.ContinuousBernoulli(paddle.to_tensor(0.7))
+    lam = 0.7
+    C = (2 * math.atanh(1 - 2 * lam)) / (1 - 2 * lam)
+    np.testing.assert_allclose(
+        float(cb.log_prob(paddle.to_tensor(0.5)).numpy()),
+        0.5 * math.log(lam) + 0.5 * math.log(1 - lam) + math.log(C),
+        rtol=1e-4)
+    s = cb.sample([2000]).numpy()
+    assert abs(s.mean() - float(cb.mean.numpy())) < 0.03
+
+
+IMG = np.random.default_rng(0).uniform(0, 1, (3, 32, 32)).astype(np.float32)
+
+
+def test_functional_geometry():
+    np.testing.assert_allclose(T.vflip(T.vflip(IMG)), IMG)
+    assert T.crop(IMG, 4, 6, 10, 12).shape == (3, 10, 12)
+    assert T.center_crop(IMG, 16).shape == (3, 16, 16)
+    assert T.pad(IMG, (1, 2, 3, 4)).shape == (3, 38, 36)
+    r = T.rotate(IMG, 90.0)
+    np.testing.assert_allclose(r, np.stack([np.rot90(c) for c in IMG]),
+                               atol=1e-4)
+    # pure translation round-trips
+    a = T.affine(IMG, 0, (3, 5), 1.0, (0, 0))
+    np.testing.assert_allclose(a[:, 6:30, 4:30], IMG[:, 1:25, 1:27],
+                               atol=1e-4)
+    # identity perspective
+    pts = [(0, 0), (31, 0), (31, 31), (0, 31)]
+    np.testing.assert_allclose(T.perspective(IMG, pts, pts), IMG, atol=1e-5)
+
+
+def test_functional_color():
+    np.testing.assert_allclose(T.adjust_brightness(IMG, 2.0), IMG * 2,
+                               rtol=1e-6)
+    np.testing.assert_allclose(T.adjust_hue(IMG, 0.0), IMG, atol=1e-4)
+    np.testing.assert_allclose(T.adjust_contrast(IMG, 1.0), IMG, atol=1e-5)
+    np.testing.assert_allclose(T.adjust_saturation(IMG, 1.0), IMG,
+                               atol=1e-5)
+    g = T.to_grayscale(IMG)
+    assert g.shape == (1, 32, 32)
+    np.testing.assert_allclose(
+        g[0], 0.299 * IMG[0] + 0.587 * IMG[1] + 0.114 * IMG[2], atol=1e-5)
+
+
+def test_transform_classes_run():
+    np.random.seed(0)
+    classes = [
+        T.RandomVerticalFlip(1.0), T.Transpose((1, 2, 0)), T.Pad(2),
+        T.Grayscale(3), T.BrightnessTransform(0.4),
+        T.ContrastTransform((0.6, 1.2)), T.SaturationTransform(0.4),
+        T.HueTransform(0.2), T.ColorJitter(0.4, 0.4, 0.4, 0.2),
+        T.ColorJitter(brightness=(0.5, 1.5), hue=(-0.1, 0.1)),
+        T.RandomRotation(30),
+        T.RandomAffine(15, translate=(0.1, 0.1), scale=(0.8, 1.2),
+                       shear=10),
+        T.RandomPerspective(1.0, 0.3), T.RandomErasing(1.0),
+        T.RandomErasing(1.0, value=[0.1, 0.2, 0.3]),
+        T.RandomErasing(1.0, value="random"), T.RandomResizedCrop(24),
+    ]
+    for c in classes:
+        out = c(IMG)
+        assert out is not None
+
+
+def test_erase_region():
+    e = T.erase(IMG, 2, 3, 5, 6, 0.0)
+    assert e[:, 2:7, 3:9].sum() == 0
+    assert not np.allclose(e, 0)
