@@ -91,6 +91,13 @@ from . import geometric  # noqa: F401,E402
 from . import signal  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import cost_model  # noqa: F401,E402
+from . import callbacks  # noqa: F401,E402
+from . import hub  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
+from . import reader  # noqa: F401,E402
+from . import static  # noqa: F401,E402
+from . import sysconfig  # noqa: F401,E402
+from . import tensor  # noqa: F401,E402
 
 
 from .framework.misc import (  # noqa: F401,E402

@@ -1,0 +1,8 @@
+"""paddle.callbacks namespace (reference: python/paddle/callbacks.py —
+re-exports the hapi callback classes)."""
+from .hapi.callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+)
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
+           "EarlyStopping"]

@@ -1,3 +1,18 @@
 """paddle.incubate parity surface (reference python/paddle/incubate)."""
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from .graph import (  # noqa: F401
+    graph_khop_sampler, graph_reindex, graph_sample_neighbors,
+)
+from ..geometric import (  # noqa: F401
+    segment_max, segment_mean, segment_min, segment_sum,
+)
+from ..geometric import send_u_recv as graph_send_recv  # noqa: F401
+from ..nn.functional.loss import identity_loss  # noqa: F401
+from ..nn.functional.common import (  # noqa: F401
+    fused_softmax_mask as softmax_mask_fuse,
+    fused_softmax_mask_upper_triangle as softmax_mask_fuse_upper_triangle,
+)
+from .. import inference  # noqa: F401  (reference: incubate.inference
+#   exposes the predictor toolchain; ours lives at paddle.inference)

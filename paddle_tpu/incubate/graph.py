@@ -1,0 +1,105 @@
+"""Incubate graph sampling ops (reference:
+python/paddle/incubate/operators/graph_khop_sampler.py,
+graph_sample_neighbors.py, graph_reindex.py).
+
+Sampling is inherently host-side (data-dependent shapes); the kernels run
+on numpy like the reference's CPU path, returning device tensors.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dispatch import unwrap, wrap
+
+
+def _np(x):
+    return np.asarray(unwrap(x))
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """Sample up to sample_size neighbors per input node from a CSC graph
+    (reference: graph_sample_neighbors). Returns (neighbors, count[,
+    eids])."""
+    row_np, colptr_np, nodes = _np(row), _np(colptr), _np(input_nodes)
+    eids_np = _np(eids) if eids is not None else None
+    out_neighbors, out_counts, out_eids = [], [], []
+    rng = np.random.default_rng()
+    for n in nodes.reshape(-1):
+        beg, end = int(colptr_np[n]), int(colptr_np[n + 1])
+        neigh = row_np[beg:end]
+        idx = np.arange(beg, end)
+        if 0 < sample_size < len(neigh):
+            pick = rng.choice(len(neigh), sample_size, replace=False)
+            neigh, idx = neigh[pick], idx[pick]
+        out_neighbors.append(neigh)
+        out_counts.append(len(neigh))
+        if eids_np is not None:
+            out_eids.append(eids_np[idx])
+    neighbors = wrap(np.concatenate(out_neighbors)
+                     if out_neighbors else np.zeros(0, row_np.dtype))
+    counts = wrap(np.asarray(out_counts, np.int32))
+    if return_eids:
+        if eids_np is None:
+            raise ValueError("return_eids requires eids")
+        return neighbors, counts, wrap(np.concatenate(out_eids))
+    return neighbors, counts
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Reindex a sampled subgraph to local ids (reference: graph_reindex).
+    Returns (reindex_src, reindex_dst, out_nodes)."""
+    x_np, neigh, cnt = _np(x).reshape(-1), _np(neighbors), _np(count)
+    uniq = list(dict.fromkeys(x_np.tolist()))
+    seen = {v: i for i, v in enumerate(uniq)}
+    for v in neigh.tolist():
+        if v not in seen:
+            seen[v] = len(uniq)
+            uniq.append(v)
+    reindex_src = np.asarray([seen[v] for v in neigh.tolist()], np.int64)
+    dst = np.repeat(np.arange(len(x_np)), cnt)
+    return (wrap(reindex_src), wrap(dst.astype(np.int64)),
+            wrap(np.asarray(uniq, x_np.dtype)))
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling + reindex (reference:
+    graph_khop_sampler). Returns (edge_src, edge_dst, sample_index,
+    reindex_nodes[, edge_eids])."""
+    frontier = _np(input_nodes).reshape(-1)
+    all_src, all_dst, all_eids = [], [], []
+    for size in sample_sizes:
+        res = graph_sample_neighbors(row, colptr, wrap(frontier),
+                                     eids=sorted_eids,
+                                     sample_size=size,
+                                     return_eids=return_eids)
+        if return_eids:
+            neigh, cnt, eids = res
+            all_eids.append(_np(eids))
+        else:
+            neigh, cnt = res
+        neigh_np, cnt_np = _np(neigh), _np(cnt)
+        all_src.append(neigh_np)
+        all_dst.append(np.repeat(frontier, cnt_np))
+        frontier = np.unique(np.concatenate([frontier, neigh_np]))
+    src = np.concatenate(all_src) if all_src else np.zeros(0, np.int64)
+    dst = np.concatenate(all_dst) if all_dst else np.zeros(0, np.int64)
+    # reindex over the union, seeds first
+    seeds = _np(input_nodes).reshape(-1)
+    uniq = list(dict.fromkeys(seeds.tolist()))
+    seen = {v: i for i, v in enumerate(uniq)}
+    for v in np.concatenate([src, dst]).tolist():
+        if v not in seen:
+            seen[v] = len(uniq)
+            uniq.append(v)
+    r_src = np.asarray([seen[v] for v in src.tolist()], np.int64)
+    r_dst = np.asarray([seen[v] for v in dst.tolist()], np.int64)
+    out = (wrap(r_src), wrap(r_dst), wrap(np.asarray(uniq, np.int64)),
+           wrap(np.asarray([seen[v] for v in seeds.tolist()], np.int64)))
+    if return_eids:
+        return out + (wrap(np.concatenate(all_eids)),)
+    return out

@@ -1,0 +1,112 @@
+"""Incubate optimizer wrappers (reference:
+python/paddle/incubate/optimizer/lookahead.py, modelaverage.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import unwrap
+
+
+class LookAhead:
+    """Lookahead: k fast steps, then slow weights pull toward fast
+    (reference: incubate.LookAhead)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step = 0
+        self._slow = {}
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step += 1
+        if self._step % self.k == 0:
+            for p in self._parameter_list:
+                if p.stop_gradient:
+                    continue
+                slow = self._slow.get(id(p))
+                if slow is None:
+                    slow = p._data
+                slow = slow + self.alpha * (p._data - slow)
+                self._slow[id(p)] = slow
+                p._data = slow
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+
+    def clear_grad(self, **kw):
+        self.inner_optimizer.clear_grad(**kw)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self.inner_optimizer.state_dict()
+
+    def set_state_dict(self, sd):
+        return self.inner_optimizer.set_state_dict(sd)
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+
+class ModelAverage:
+    """Running average of parameters with apply()/restore() swap
+    (reference: incubate.ModelAverage)."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.average_window_rate = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._parameter_list = list(parameters or [])
+        self._sums = {id(p): jnp.zeros_like(unwrap(p))
+                      for p in self._parameter_list}
+        self._counts = {id(p): 0 for p in self._parameter_list}
+        self._backup = {}
+
+    def step(self):
+        for p in self._parameter_list:
+            key = id(p)
+            if self._counts[key] >= self.max_average_window:
+                # restart the window like the reference's circular buffers
+                self._sums[key] = jnp.zeros_like(unwrap(p))
+                self._counts[key] = 0
+            self._sums[key] = self._sums[key] + p._data
+            self._counts[key] += 1
+
+    def apply(self, executor=None, need_restore=True):
+        """Context manager: swap in averaged params."""
+        outer = self
+
+        class _Ctx:
+            def __enter__(ctx):
+                for p in outer._parameter_list:
+                    key = id(p)
+                    if outer._counts[key] == 0:
+                        continue
+                    outer._backup[key] = p._data
+                    p._data = (outer._sums[key]
+                               / outer._counts[key]).astype(p._data.dtype)
+                return ctx
+
+            def __exit__(ctx, *exc):
+                if need_restore:
+                    outer.restore()
+                return False
+        return _Ctx()
+
+    def restore(self, executor=None):
+        for p in self._parameter_list:
+            key = id(p)
+            if key in self._backup:
+                p._data = self._backup.pop(key)
+
+    def minimize(self, loss, **kw):
+        self.step()

@@ -2,8 +2,9 @@
 from .activation import *  # noqa: F401,F403
 from .attention import (  # noqa: F401
     flash_attention, flash_attn, flash_attn_qkvpacked, flash_attn_unpadded,
-    flashmask_attention, memory_efficient_attention,
-    scaled_dot_product_attention, sequence_mask,
+    flash_attn_varlen_qkvpacked, flashmask_attention,
+    memory_efficient_attention, scaled_dot_product_attention,
+    sequence_mask,
 )
 from .common import (  # noqa: F401
     affine_grid, alpha_dropout, bicubic_interp, bilinear, bilinear_interp,
@@ -12,6 +13,10 @@ from .common import (  # noqa: F401
     grid_sample, interpolate, label_smooth, linear, linear_interp,
     nearest_interp, one_hot, pad, pad3d, pixel_shuffle, pixel_unshuffle,
     temporal_shift, trilinear_interp, unfold, upsample,
+)
+from .common import (  # noqa: F401
+    class_center_sample, feature_alpha_dropout, gather_tree,
+    pairwise_distance, sparse_attention, zeropad2d,
 )
 from .conv import (  # noqa: F401
     conv1d, conv1d_transpose, conv2d, conv2d_transpose, conv3d,
@@ -26,6 +31,12 @@ from .loss import (  # noqa: F401
     smooth_l1_loss, softmax_with_cross_entropy, square_error_cost,
     triplet_margin_loss,
 )
+from .loss import (  # noqa: F401
+    adaptive_log_softmax_with_loss, dice_loss, gaussian_nll_loss,
+    hsigmoid_loss, multi_label_soft_margin_loss, multi_margin_loss,
+    npair_loss, poisson_nll_loss, rnnt_loss, soft_margin_loss,
+    triplet_margin_with_distance_loss,
+)
 from .norm import (  # noqa: F401
     batch_norm, group_norm, instance_norm, layer_norm, local_response_norm,
     normalize, rms_norm,
@@ -33,6 +44,7 @@ from .norm import (  # noqa: F401
 from .pooling import (  # noqa: F401
     adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_pool3d,
     adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d,
-    avg_pool1d, avg_pool2d, avg_pool3d, lp_pool2d, max_pool1d, max_pool2d,
-    max_pool3d,
+    avg_pool1d, avg_pool2d, avg_pool3d, fractional_max_pool2d,
+    fractional_max_pool3d, lp_pool1d, lp_pool2d, max_pool1d, max_pool2d,
+    max_pool3d, max_unpool1d, max_unpool2d, max_unpool3d,
 )

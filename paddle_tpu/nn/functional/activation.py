@@ -200,3 +200,30 @@ def swiglu(x, y=None, name=None):
 def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
     return run_op("thresholded_relu",
                   lambda a: jnp.where(a > threshold, a, value), [x])
+
+
+def _act_inplace(x, out):
+    x._data = out._data
+    x._meta = out._meta
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def tanh_(x, name=None):
+    """Inplace tanh (reference: F.tanh_)."""
+    return _act_inplace(x, tanh(x))
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):
+    """Inplace hardtanh (reference: F.hardtanh_)."""
+    return _act_inplace(x, hardtanh(x, min, max))
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    """Inplace leaky_relu (reference: F.leaky_relu_)."""
+    return _act_inplace(x, leaky_relu(x, negative_slope))
+
+
+def thresholded_relu_(x, threshold=1.0, value=0.0, name=None):
+    """Inplace thresholded_relu (reference: F.thresholded_relu_)."""
+    return _act_inplace(x, thresholded_relu(x, threshold, value))

@@ -68,8 +68,48 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
 def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
                         causal=False, return_softmax=False, name=None):
-    raise NotImplementedError(
-        "varlen flash attention: pack to dense + mask instead on TPU")
+    """Varlen ("unpadded") attention over packed sequences (reference:
+    flash_attn_unpadded). q/k/v: [total_tokens, heads, dim]; cu_seqlens
+    mark sequence boundaries. On TPU the ragged batch lowers to ONE dense
+    attention over the packed axis with a block-diagonal segment mask —
+    XLA fuses the mask, so no per-sequence launches and no padding copies.
+    """
+    import jax
+
+    def fn(q, k, v, cq, ck):
+        tq = q.shape[0]
+        tk = k.shape[0]
+        # segment id per token: #boundaries <= position
+        seg_q = jnp.sum(jnp.arange(tq)[:, None] >= cq[None, 1:], axis=-1)
+        seg_k = jnp.sum(jnp.arange(tk)[:, None] >= ck[None, 1:], axis=-1)
+        mask = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            pos_q = jnp.arange(tq) - cq[seg_q]
+            pos_k = jnp.arange(tk) - ck[seg_k]
+            mask = mask & (pos_q[:, None] >= pos_k[None, :])
+        scores = jnp.einsum("qhd,khd->hqk", q, k) * scale
+        scores = jnp.where(mask[None], scores, -1e30)
+        attn = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("hqk,khd->qhd", attn, v)
+    out = run_op("flash_attn_unpadded", fn,
+                 [query, key, value, cu_seqlens_q, cu_seqlens_k])
+    return (out, None) if return_softmax else out
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q, max_seqlen_k, scale=None,
+                                dropout=0.0, causal=False,
+                                return_softmax=False, name=None):
+    """Packed-QKV varlen attention (reference:
+    flash_attn_varlen_qkvpacked). qkv: [total_tokens, 3, heads, dim]."""
+    from ...ops.manipulation import split as _split
+    q, k, v = [t.squeeze(1) for t in _split(qkv, 3, axis=1)]
+    if scale is None:
+        scale = float(q.shape[-1]) ** -0.5
+    out = flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                              max_seqlen_q, max_seqlen_k, scale,
+                              dropout, causal, return_softmax=False)
+    return (out, None) if return_softmax else out
 
 
 def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):

@@ -425,3 +425,119 @@ def fused_softmax_mask_upper_triangle(x, name=None):
         mask = jnp.triu(jnp.full((s, s), -1e9, a.dtype), k=1)
         return jax.nn.softmax(a + mask, axis=-1)
     return run_op("fused_softmax_mask_upper_triangle", fn, [x])
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """Zero-pad spatial dims; padding = [left, right, top, bottom]
+    (reference: zeropad2d — a thin wrapper over F.pad, same here)."""
+    pads = [int(v) for v in (unwrap(padding).tolist()
+                             if hasattr(padding, "shape") else padding)]
+    return pad(x, pads, mode="constant", value=0.0,
+               data_format=data_format)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """||x - y + eps||_p along the last dim (reference:
+    pairwise_distance)."""
+    def fn(a, b):
+        d = jnp.abs(a - b + epsilon)
+        if p == float("inf"):
+            out = jnp.max(d, axis=-1, keepdims=keepdim)
+        elif p == 0:
+            out = jnp.sum((d != 0).astype(a.dtype), axis=-1,
+                          keepdims=keepdim)
+        else:
+            out = jnp.sum(d ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+        return out
+    return run_op("pairwise_distance", fn, [x, y])
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout over whole channels (dim 1), keeping SELU statistics
+    (reference: feature_alpha_dropout)."""
+    if not training or p == 0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = random_mod.next_key()
+
+    def fn(a):
+        mask_shape = (a.shape[0], a.shape[1]) + (1,) * (a.ndim - 2)
+        keep = jax.random.bernoulli(key, 1 - p, mask_shape)
+        A = (1 - p + p * alpha_p ** 2 * (1 - p)) ** -0.5
+        B = -A * p * alpha_p
+        return A * jnp.where(keep, a, alpha_p) + B
+    return run_op("feature_alpha_dropout", fn, [x])
+
+
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """Sample negative class centers + positives; remap labels into the
+    sampled set (reference: class_center_sample, hybrid-parallel face
+    recognition). Host-side sampling like the reference's CPU path."""
+    lab = np.asarray(unwrap(label)).astype(np.int64)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        neg_pool = np.setdiff1d(np.arange(num_classes), pos,
+                                assume_unique=True)
+        extra = np.random.choice(neg_pool, num_samples - len(pos),
+                                 replace=False)
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return wrap(jnp.asarray(remap[lab])), wrap(jnp.asarray(sampled))
+
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search backtrace (reference: gather_tree kernel). ids/parents:
+    [max_time, batch, beam]."""
+    ids_np = np.asarray(unwrap(ids))
+    par_np = np.asarray(unwrap(parents))
+    T, B, W = ids_np.shape
+    out = np.empty_like(ids_np)
+    out[-1] = ids_np[-1]
+    beam_idx = np.tile(np.arange(W), (B, 1))
+    for t in range(T - 2, -1, -1):
+        beam_idx = np.take_along_axis(par_np[t + 1], beam_idx, axis=1)
+        out[t] = np.take_along_axis(ids_np[t], beam_idx, axis=1)
+    return wrap(jnp.asarray(out))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention sampled at a CSR pattern (reference:
+    sparse_attention, CUDA kernel). On TPU the pattern lowers to a dense
+    additive mask — XLA fuses it into one attention program; the CSR
+    pattern defines WHICH scores participate, exactly like the kernel."""
+    def fn(q, k, v, off, cols, *rest):
+        B, H, M, D = q.shape
+        nnz = cols.shape[-1]
+        j = jnp.arange(nnz)
+        # per-(b,h) row of each CSR entry: #offsets <= j
+        rows = jnp.sum(j[None, None, None, :] >= off[..., 1:, None],
+                       axis=-2)
+        mask = jnp.zeros((B, H, M, M), bool)
+        b_i = jnp.arange(B)[:, None, None]
+        h_i = jnp.arange(H)[None, :, None]
+        mask = mask.at[b_i, h_i, rows, cols].set(True)
+        scores = jnp.einsum("bhmd,bhnd->bhmn", q, k) / jnp.sqrt(D)
+        scores = jnp.where(mask, scores, -1e30)
+        rest = list(rest)
+        if key_padding_mask is not None:
+            kp = rest.pop(0)
+            scores = jnp.where(kp[:, None, None, :] > 0, scores, -1e30)
+        if attn_mask is not None:
+            scores = scores + rest.pop(0)[:, None, :, :]
+        attn = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.where(mask, attn, 0.0)
+        return jnp.einsum("bhmn,bhnd->bhmd", attn, v)
+    args = [query, key, value, sparse_csr_offset, sparse_csr_columns]
+    if key_padding_mask is not None:
+        args.append(key_padding_mask)
+    if attn_mask is not None:
+        args.append(attn_mask)
+    return run_op("sparse_attention", fn, args)

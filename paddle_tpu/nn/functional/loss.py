@@ -6,8 +6,11 @@ class weights, reduction modes, axis.
 """
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...core.dispatch import run_op, unwrap
 
@@ -345,3 +348,277 @@ def margin_cross_entropy(logits, label, return_softmax=False,
 
 
 cross_entropy_with_softmax = cross_entropy
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Dice loss over the last-dim class probabilities (reference:
+    nn/functional/loss.py dice_loss)."""
+    def fn(p, lab):
+        num_classes = p.shape[-1]
+        lab_oh = jax.nn.one_hot(lab.reshape(lab.shape[:-1]), num_classes,
+                                dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * lab_oh, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(lab_oh, axis=red)
+        return jnp.mean(1.0 - (2 * inter + epsilon) / (union + epsilon))
+    return run_op("dice_loss", fn, [input, label])
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """Gaussian negative log likelihood (reference: gaussian_nll_loss)."""
+    def fn(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * math.log(2 * math.pi)
+        return _reduce(loss, reduction)
+    return run_op("gaussian_nll_loss", fn, [input, label, variance])
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    """Poisson NLL (reference: poisson_nll_loss)."""
+    def fn(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            # Stirling approximation for log(y!) at y > 1
+            stir = y * jnp.log(y) - y + 0.5 * jnp.log(2 * jnp.pi * y)
+            loss = loss + jnp.where(y > 1, stir, 0.0)
+        return _reduce(loss, reduction)
+    return run_op("poisson_nll_loss", fn, [input, label])
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """log(1 + exp(-label * input)) (reference: soft_margin_loss)."""
+    def fn(x, y):
+        return _reduce(jnp.log1p(jnp.exp(-y * x)), reduction)
+    return run_op("soft_margin_loss", fn, [input, label])
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    """Multi-label one-versus-all margin loss (reference:
+    multi_label_soft_margin_loss)."""
+    def fn(x, y, *rest):
+        loss = -(y * jax.nn.log_sigmoid(x)
+                 + (1 - y) * jax.nn.log_sigmoid(-x))
+        if rest:
+            loss = loss * rest[0]
+        return _reduce(jnp.mean(loss, axis=-1), reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return run_op("multi_label_soft_margin_loss", fn, args)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """Multi-class margin loss (reference: multi_margin_loss)."""
+    def fn(x, y, *rest):
+        n, c = x.shape
+        correct = jnp.take_along_axis(x, y[:, None], axis=1)
+        m = jnp.maximum(margin - correct + x, 0.0) ** p
+        if rest:
+            m = m * rest[0][y][:, None]
+        mask = jax.nn.one_hot(y, c, dtype=x.dtype)
+        loss = jnp.sum(m * (1 - mask), axis=1) / c
+        return _reduce(loss, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return run_op("multi_margin_loss", fn, args)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """Triplet loss with a custom distance callable (reference:
+    triplet_margin_with_distance_loss)."""
+    if distance_function is None:
+        def distance_function(a, b):
+            from ...ops import math as M
+            return M.sqrt(((a - b) * (a - b)).sum(-1))
+    d_pos = distance_function(input, positive)
+    d_neg = distance_function(input, negative)
+    if swap:
+        d_pn = distance_function(positive, negative)
+        from ...ops import math as M
+        d_neg = M.minimum(d_neg, d_pn)
+
+    def fn(dp, dn):
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+    return run_op("triplet_margin_with_distance_loss", fn, [d_pos, d_neg])
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """N-pair loss (reference: npair_loss)."""
+    def fn(a, p, y):
+        reg = l2_reg * (jnp.sum(a * a) / a.shape[0]
+                        + jnp.sum(p * p) / p.shape[0]) * 0.25
+        sim = a @ p.T  # [n, n]
+        same = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = same / jnp.sum(same, axis=1, keepdims=True)
+        xent = jnp.mean(jnp.sum(
+            -tgt * jax.nn.log_softmax(sim, axis=1), axis=1))
+        return xent + reg
+    return run_op("npair_loss", fn, [anchor, positive, labels])
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference: hsigmoid_loss; custom trees via path_table/path_code).
+
+    Default tree: internal node ids follow the heap layout the reference
+    kernel uses (codes from the binary expansion of label + num_classes).
+    """
+    def default_paths(num_classes):
+        depth = int(np.ceil(np.log2(max(num_classes, 2))))
+        tables, codes = [], []
+        for lab in range(num_classes):
+            node = lab + num_classes
+            tab, code = [], []
+            while node > 1:
+                tab.append(node // 2 - 1)
+                code.append(node % 2)
+                node //= 2
+            tab = tab[::-1] + [-1] * (depth - len(tab))
+            code = code[::-1] + [-1] * (depth - len(code))
+            tables.append(tab)
+            codes.append(code)
+        return (np.asarray(tables, np.int64), np.asarray(codes, np.int64))
+
+    if path_table is None:
+        tab_np, code_np = default_paths(int(num_classes))
+        path_table_arr = jnp.asarray(tab_np)
+        path_code_arr = jnp.asarray(code_np)
+    else:
+        path_table_arr = unwrap(path_table)
+        path_code_arr = unwrap(path_code)
+
+    def fn(x, lab, w, *rest):
+        tab = path_table_arr[lab]      # [n, depth]
+        code = path_code_arr[lab]      # [n, depth]
+        valid = tab >= 0
+        safe_tab = jnp.maximum(tab, 0)
+        wt = w[safe_tab]               # [n, depth, feat]
+        logits = jnp.einsum("ndf,nf->nd", wt, x)
+        if rest:
+            logits = logits + rest[0][safe_tab]
+        # code==1 -> right branch (positive class), matching the kernel
+        y = code.astype(x.dtype)
+        ll = y * jax.nn.log_sigmoid(logits) \
+            + (1 - y) * jax.nn.log_sigmoid(-logits)
+        return -jnp.sum(jnp.where(valid, ll, 0.0), axis=1, keepdims=True)
+    args = [input, label, weight] + ([bias] if bias is not None else [])
+    return run_op("hsigmoid_loss", fn, args)
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Adaptive softmax (Grave et al.) (reference:
+    adaptive_log_softmax_with_loss). Returns (output, loss)."""
+    n_clusters = len(cutoffs) - 1  # cutoffs includes n_classes at the end
+    head_size = cutoffs[0] + n_clusters
+
+    def fn(x, lab, hw, *rest):
+        bias_ct = 1 if head_bias is not None else 0
+        hb = rest[0] if bias_ct else None
+        tails = rest[bias_ct:]
+        head_logits = x @ hw
+        if hb is not None:
+            head_logits = head_logits + hb
+        head_lp = jax.nn.log_softmax(head_logits, axis=-1)
+        # in-shortlist term
+        out = jnp.take_along_axis(
+            head_lp, jnp.clip(lab, 0, cutoffs[0] - 1)[:, None],
+            axis=1)[:, 0]
+        for i in range(n_clusters):
+            lo, hi = cutoffs[i], cutoffs[i + 1]
+            in_c = (lab >= lo) & (lab < hi)
+            w_dn, w_up = tails[2 * i], tails[2 * i + 1]
+            tail_lp = jax.nn.log_softmax((x @ w_dn) @ w_up, axis=-1)
+            rel = jnp.clip(lab - lo, 0, hi - lo - 1)
+            cluster_lp = head_lp[:, cutoffs[0] + i] \
+                + jnp.take_along_axis(tail_lp, rel[:, None], axis=1)[:, 0]
+            out = jnp.where(in_c, cluster_lp, out)
+        return out, -jnp.mean(out)
+    args = [input, label, head_weight]
+    if head_bias is not None:
+        args.append(head_bias)
+    for pair in tail_weights:
+        args.extend(pair)
+    return run_op("adaptive_log_softmax_with_loss", fn, args)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-T transducer loss via the (T, U) log-space alpha recursion
+    (reference: rnnt_loss, warprnnt kernel). input: [B, T, U+1, V]
+    joint-network log-probable logits."""
+    def fn(logits, lab, in_len, lab_len):
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        B, T, U1, V = lp.shape
+        U = U1 - 1
+        blank_lp = lp[..., blank]                      # [B, T, U+1]
+        lab_idx = jnp.clip(lab, 0, V - 1)              # [B, U]
+        emit_lp = jnp.take_along_axis(
+            lp[:, :, :U, :],
+            jnp.broadcast_to(lab_idx[:, None, :, None],
+                             (B, T, U, 1)), axis=-1)[..., 0]
+        # FastEmit (Yu et al. 2021, eq. 9): weight every emission
+        # transition by (1 + lambda), which scales emit-path gradients by
+        # the same factor; lambda=0 reduces to plain RNN-T
+        if fastemit_lambda:
+            emit_lp = emit_lp + jnp.log1p(
+                jnp.asarray(fastemit_lambda, lp.dtype))
+        neg_inf = jnp.asarray(-1e30, lp.dtype)
+
+        def t_step(alpha_prev, t):
+            # alpha_prev: [B, U+1] for time t-1; returns alpha at t
+            # first compute diagonal blank moves (t-1, u) -> (t, u)
+            from_blank = alpha_prev + blank_lp[:, t - 1, :]
+
+            def u_step(carry, u):
+                # emit move within time t: (t, u-1) -> (t, u)
+                prev_u = carry  # alpha[t, u-1]
+                fb = jnp.take_along_axis(
+                    from_blank, jnp.full((B, 1), u), axis=1)[:, 0]
+                em = prev_u + jnp.take_along_axis(
+                    emit_lp[:, t, :], jnp.clip(
+                        jnp.full((B, 1), u - 1), 0, U - 1), axis=1)[:, 0]
+                val = jnp.where(u == 0, fb,
+                                jnp.logaddexp(fb, em))
+                return val, val
+            _, cols = jax.lax.scan(u_step, jnp.full((B,), 0.0),
+                                   jnp.arange(U1))
+            return jnp.swapaxes(cols, 0, 1), None
+
+        # alpha[0, u]: only emit moves along u at t=0
+        def u0_step(carry, u):
+            em = carry + jnp.take_along_axis(
+                emit_lp[:, 0, :], jnp.clip(jnp.full((B, 1), u - 1), 0,
+                                           U - 1), axis=1)[:, 0]
+            val = jnp.where(u == 0, jnp.zeros((B,), lp.dtype), em)
+            return val, val
+        _, cols0 = jax.lax.scan(u0_step, jnp.zeros((B,), lp.dtype),
+                                jnp.arange(U1))
+        alpha0 = jnp.swapaxes(cols0, 0, 1)
+
+        def scan_t(alpha_prev, t):
+            alpha_t, _ = t_step(alpha_prev, t)
+            return alpha_t, alpha_t
+        alpha_last, alphas = jax.lax.scan(scan_t, alpha0,
+                                          jnp.arange(1, T))
+        all_alphas = jnp.concatenate([alpha0[None], alphas], axis=0)
+        # final: alpha[T_b - 1, U_b] + blank at (T_b - 1, U_b)
+        t_idx = jnp.clip(in_len - 1, 0, T - 1)         # [B]
+        u_idx = jnp.clip(lab_len, 0, U)                # [B]
+        a_fin = all_alphas[t_idx, jnp.arange(B), u_idx]
+        ll = a_fin + blank_lp[jnp.arange(B), t_idx, u_idx]
+        loss = -ll
+        return _reduce(loss, reduction)
+    return run_op("rnnt_loss", fn,
+                  [input, label, input_lengths, label_lengths])

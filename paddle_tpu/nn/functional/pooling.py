@@ -127,7 +127,8 @@ def _pool_mask(x, out, nd, kernel_size, stride, padding, channel_last):
             take = cv > av
             return (jnp.where(take, cv, av), jnp.where(take, ci, ai))
         vals, idxs = jax.lax.reduce_window(
-            (a, idx.astype(jnp.int32)), (-jnp.inf, jnp.int32(-1)), red,
+            (a, idx.astype(jnp.int32)),
+            (jnp.asarray(-jnp.inf, a.dtype), jnp.int32(-1)), red,
             window, wstrides, pad if not isinstance(pad, str) else pad)
         return idxs.astype(jnp.int64)
     return run_op_nodiff("max_pool_mask", fn, [x])
@@ -238,3 +239,147 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive_pool("adaptive_max_pool3d", x, output_size, 3, False,
                           True, return_mask)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    """1-D power-average pooling (reference: lp_pool1d)."""
+    p = float(norm_type)
+
+    def power(t):
+        return run_op("pow_abs", lambda a: jnp.abs(a) ** p, [t])
+    pooled = _pool("lp_pool1d", power(x), 1, kernel_size, stride, padding,
+                   data_format == "NLC", jax.lax.add, 0.0,
+                   ceil_mode=ceil_mode)
+    return run_op("root", lambda a: a ** (1.0 / p), [pooled])
+
+
+def _max_unpool(name, x, indices, nd, kernel_size, stride, padding,
+                data_format, output_size):
+    ksize = _tuple(kernel_size, nd)
+    strides = _tuple(stride if stride is not None else kernel_size, nd)
+    pads = _tuple(padding, nd)
+    channel_last = data_format in ("NLC", "NHWC", "NDHWC")
+
+    def fn(a, idx):
+        if channel_last:
+            sp_in = a.shape[1:1 + nd]
+        else:
+            sp_in = a.shape[2:2 + nd]
+        if output_size is not None:
+            sp_out = tuple(int(s) for s in output_size)[-nd:]
+        else:
+            sp_out = tuple(
+                (sp_in[d] - 1) * strides[d] - 2 * pads[d] + ksize[d]
+                for d in range(nd))
+        if channel_last:
+            a_nc = jnp.moveaxis(a, -1, 1)
+            idx_nc = jnp.moveaxis(idx, -1, 1)
+        else:
+            a_nc, idx_nc = a, idx
+        n, c = a_nc.shape[0], a_nc.shape[1]
+        flat_in = a_nc.reshape(n, c, -1)
+        flat_idx = idx_nc.reshape(n, c, -1)
+        out = jnp.zeros((n, c, int(np.prod(sp_out))), a.dtype)
+        bi = jnp.arange(n)[:, None, None]
+        ci = jnp.arange(c)[None, :, None]
+        out = out.at[bi, ci, flat_idx].set(flat_in)
+        out = out.reshape((n, c) + sp_out)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return run_op(name, fn, [x, indices])
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """Inverse of max_pool1d with return_mask indices (reference:
+    max_unpool1d)."""
+    return _max_unpool("max_unpool1d", x, indices, 1, kernel_size, stride,
+                       padding, data_format, output_size)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """Inverse of max_pool2d (reference: max_unpool2d)."""
+    return _max_unpool("max_unpool2d", x, indices, 2, kernel_size, stride,
+                       padding, data_format, output_size)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    """Inverse of max_pool3d (reference: max_unpool3d)."""
+    return _max_unpool("max_unpool3d", x, indices, 3, kernel_size, stride,
+                       padding, data_format, output_size)
+
+
+def _fractional_edges(in_size, out_size, u, kernel=None):
+    """Graham's pseudo-random pooling boundaries: b_i = ceil(a*(i+u)) with
+    a = in/out, shifted so coverage starts at 0 and ends at in_size."""
+    alpha = in_size / out_size
+    base = int(np.ceil(alpha * u)) if u > 0 else 0
+    edges = []
+    for i in range(out_size + 1):
+        e = int(np.ceil(alpha * (i + u))) - base
+        edges.append(min(max(e, i), in_size))
+    edges[0], edges[-1] = 0, in_size
+    return edges
+
+
+def _fractional_pool(name, x, output_size, nd, kernel_size, random_u,
+                     return_mask):
+    out_sp = _tuple(output_size, nd)
+    ks = _tuple(kernel_size, nd) if kernel_size is not None else None
+    if random_u is None:
+        u = float(np.random.uniform(0.01, 0.99))
+    else:
+        u = float(random_u)
+
+    def fn(a):
+        sp_in = a.shape[2:2 + nd]
+        edges = [_fractional_edges(sp_in[d], out_sp[d], u)
+                 for d in range(nd)]
+        flat_sp = jnp.arange(int(np.prod(sp_in))).reshape(sp_in)
+        vals = []
+        idxs = []
+        import itertools
+        for pos in itertools.product(*[range(out_sp[d])
+                                       for d in range(nd)]):
+            sl = [slice(None), slice(None)]
+            for d in range(nd):
+                s = edges[d][pos[d]]
+                e = s + ks[d] if ks is not None else edges[d][pos[d] + 1]
+                e = min(max(e, s + 1), sp_in[d])
+                sl.append(slice(s, e))
+            window = a[tuple(sl)]
+            red = tuple(range(2, 2 + nd))
+            vals.append(jnp.max(window, axis=red))
+            if return_mask:
+                widx = flat_sp[tuple(sl[2:])]
+                flat_w = window.reshape(window.shape[:2] + (-1,))
+                am = jnp.argmax(flat_w, axis=-1)
+                idxs.append(widx.reshape(-1)[am])
+        out = jnp.stack(vals, axis=-1).reshape(a.shape[:2] + out_sp)
+        if return_mask:
+            msk = jnp.stack(idxs, axis=-1).reshape(a.shape[:2] + out_sp)
+            return out, msk.astype(jnp.int64)
+        return out
+    if return_mask:
+        out, mask = run_op(name, fn, [x])
+        return out, mask
+    return run_op(name, fn, [x])
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Fractional max pooling, Graham 2014 (reference:
+    fractional_max_pool2d). Disjoint regions when kernel_size is None."""
+    return _fractional_pool("fractional_max_pool2d", x, output_size, 2,
+                            kernel_size, random_u, return_mask)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """3-D fractional max pooling (reference: fractional_max_pool3d)."""
+    return _fractional_pool("fractional_max_pool3d", x, output_size, 3,
+                            kernel_size, random_u, return_mask)

@@ -2,12 +2,14 @@
 from .activation import *  # noqa: F401,F403
 from .common import (  # noqa: F401
     AlphaDropout, Bilinear, ChannelShuffle, CosineSimilarity, Dropout,
-    Dropout2D, Dropout3D, Embedding, Flatten, Fold, Identity, Linear, Pad1D,
-    Pad2D, Pad3D, PixelShuffle, PixelUnshuffle, Unfold, Upsample,
-    UpsamplingBilinear2D, UpsamplingNearest2D, ZeroPad2D,
+    Dropout2D, Dropout3D, Embedding, FeatureAlphaDropout, Flatten, Fold,
+    Identity, Linear, Pad1D, Pad2D, Pad3D, PairwiseDistance, PixelShuffle,
+    PixelUnshuffle, Softmax2D, Unflatten, Unfold, Upsample,
+    UpsamplingBilinear2D, UpsamplingNearest2D, ZeroPad1D, ZeroPad2D,
+    ZeroPad3D,
 )
 from .container import (  # noqa: F401
-    LayerDict, LayerList, ParameterList, Sequential,
+    LayerDict, LayerList, ParameterDict, ParameterList, Sequential,
 )
 from .conv import (  # noqa: F401
     Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose, Conv3D,
@@ -15,22 +17,28 @@ from .conv import (  # noqa: F401
 )
 from .layers import Layer  # noqa: F401
 from .loss import (  # noqa: F401
-    BCELoss, BCEWithLogitsLoss, CTCLoss, CosineEmbeddingLoss,
-    CrossEntropyLoss, HingeEmbeddingLoss, KLDivLoss, L1Loss,
-    MarginRankingLoss, MSELoss, NLLLoss, SmoothL1Loss, TripletMarginLoss,
+    AdaptiveLogSoftmaxWithLoss, BCELoss, BCEWithLogitsLoss, CTCLoss,
+    CosineEmbeddingLoss, CrossEntropyLoss, GaussianNLLLoss,
+    HSigmoidLoss, HingeEmbeddingLoss, KLDivLoss, L1Loss,
+    MarginRankingLoss, MSELoss, MultiLabelSoftMarginLoss, MultiMarginLoss,
+    NLLLoss, PoissonNLLLoss, RNNTLoss, SmoothL1Loss, SoftMarginLoss,
+    TripletMarginLoss, TripletMarginWithDistanceLoss,
 )
 from .norm import (  # noqa: F401
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm,
     InstanceNorm1D, InstanceNorm2D, InstanceNorm3D, LayerNorm,
-    LocalResponseNorm, RMSNorm, SyncBatchNorm,
+    LocalResponseNorm, RMSNorm, SpectralNorm, SyncBatchNorm,
 )
 from .rnn import (  # noqa: F401
     GRU, GRUCell, LSTM, LSTMCell, RNN, RNNBase, SimpleRNN, SimpleRNNCell,
+    BeamSearchDecoder, BiRNN, RNNCellBase, dynamic_decode,
 )
 from .pooling import (  # noqa: F401
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
     AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D,
-    AvgPool2D, AvgPool3D, LPPool2D, MaxPool1D, MaxPool2D, MaxPool3D,
+    AvgPool2D, AvgPool3D, FractionalMaxPool2D, FractionalMaxPool3D,
+    LPPool1D, LPPool2D, MaxPool1D, MaxPool2D, MaxPool3D, MaxUnPool1D,
+    MaxUnPool2D, MaxUnPool3D,
 )
 from .transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder,

@@ -266,3 +266,79 @@ class Fold(Layer):
     def forward(self, x):
         return F.fold(x, self.output_sizes, self.kernel_sizes, self.strides,
                       self.paddings, self.dilations)
+
+
+class Unflatten(Layer):
+    """Expand one axis into a shape (reference: nn.Unflatten)."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape = axis, shape
+
+    def forward(self, x):
+        from ...ops import manipulation as M
+        return M.unflatten(x, self.axis, self.shape)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW/CHW inputs (reference:
+    nn.Softmax2D)."""
+
+    def forward(self, x):
+        if len(x.shape) not in (3, 4):
+            raise ValueError("Softmax2D expects 3D or 4D input")
+        return F.softmax(x, axis=-3)
+
+
+class ZeroPad1D(Layer):
+    """Zero-pad the last dim; padding = [left, right] (reference:
+    nn.ZeroPad1D)."""
+
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__()
+        self.padding = [padding, padding] if isinstance(padding, int) \
+            else list(padding)
+        self.data_format = data_format
+
+    def forward(self, x):
+        from ...ops import manipulation as M
+        l, r = self.padding
+        nd = len(x.shape)
+        cfg = [0] * (2 * nd)
+        ax = nd - 1 if self.data_format == "NCL" else nd - 2
+        cfg[2 * ax], cfg[2 * ax + 1] = l, r
+        return M.pad(x, cfg)
+
+
+class ZeroPad3D(Layer):
+    """Zero-pad D/H/W dims; padding = [l, r, top, bottom, front, back]
+    (reference: nn.ZeroPad3D)."""
+
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__()
+        self.padding = [padding] * 6 if isinstance(padding, int) \
+            else list(padding)
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad3d(x, self.padding, mode="constant", value=0.0,
+                       data_format=self.data_format)
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, self.p, self.training)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon,
+                                   self.keepdim)

@@ -222,8 +222,53 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
+    """Normalise a weight by its largest singular value, estimated with
+    persistent power-iteration vectors (reference: nn.SpectralNorm —
+    forward(weight) -> weight / sigma)."""
+
     def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
                  name=None):
         super().__init__()
-        raise NotImplementedError(
-            "SpectralNorm: use paddle_tpu.nn.utils.spectral_norm wrapper")
+        import numpy as np
+
+        from ...core.tensor import to_tensor
+        self.dim = dim
+        self.power_iters = power_iters
+        self.epsilon = epsilon
+        shape = [int(s) for s in weight_shape]
+        h = shape[dim]
+        w = int(np.prod(shape)) // h
+        rng = np.random.default_rng(0)
+        self.register_buffer(
+            "weight_u", to_tensor(_l2norm_np(rng.standard_normal(h))))
+        self.register_buffer(
+            "weight_v", to_tensor(_l2norm_np(rng.standard_normal(w))))
+
+    def forward(self, weight):
+        import jax.numpy as jnp
+
+        from ...core.dispatch import run_op, unwrap
+        dim, eps, iters = self.dim, self.epsilon, self.power_iters
+        u0 = unwrap(self.weight_u)
+        v0 = unwrap(self.weight_v)
+
+        def fn(w):
+            wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+            u, v = u0.astype(wm.dtype), v0.astype(wm.dtype)
+            for _ in range(max(iters, 1)):
+                v = wm.T @ u
+                v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+                u = wm @ v
+                u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+            sigma = u @ wm @ v
+            return w / jnp.maximum(sigma, eps), u, v
+        out, u_new, v_new = run_op("spectral_norm_layer", fn, [weight])
+        self.weight_u._data = unwrap(u_new)
+        self.weight_v._data = unwrap(v_new)
+        return out
+
+
+def _l2norm_np(a):
+    import numpy as np
+    a = a.astype(np.float32)
+    return a / max(float(np.linalg.norm(a)), 1e-12)

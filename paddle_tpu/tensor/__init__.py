@@ -1,0 +1,19 @@
+"""paddle.tensor namespace (reference: python/paddle/tensor/__init__.py —
+the functional tensor library the top level re-exports from).
+
+Here the implementation modules live in paddle_tpu.ops; this package
+mirrors the reference layout so `from paddle.tensor import creation`
+style imports keep working.
+"""
+from ..ops import creation, linalg, logic, manipulation, search, stat  # noqa: F401
+from ..ops import math  # noqa: F401
+from ..ops.creation import *  # noqa: F401,F403
+from ..ops.math import *  # noqa: F401,F403
+from ..ops.manipulation import *  # noqa: F401,F403
+from ..ops.logic import *  # noqa: F401,F403
+from ..ops.search import *  # noqa: F401,F403
+from ..ops.stat import *  # noqa: F401,F403
+from ..ops.inplace import *  # noqa: F401,F403
+
+random = creation  # reference tensor/random.py: sampling creation ops
+attribute = manipulation  # shape/rank/is_* live in manipulation here

@@ -1,0 +1,294 @@
+"""nn + nn.functional parity batch: losses vs torch, unpool/fractional
+pools, varlen attention, beam search, incubate, misc namespaces."""
+import re
+import pathlib
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+F = paddle.nn.functional
+REF = pathlib.Path("/root/reference/python/paddle")
+RNG = np.random.default_rng(0)
+
+
+@pytest.mark.skipif(not REF.exists(), reason="reference not mounted")
+@pytest.mark.parametrize("rel,mod", [
+    ("nn/__init__.py", nn), ("nn/functional/__init__.py", F),
+    ("incubate/__init__.py", paddle.incubate),
+])
+def test_all_parity(rel, mod):
+    m = re.search(r"__all__\s*=\s*\[(.*?)\]", (REF / rel).read_text(), re.S)
+    ra = set(re.findall(r"'([^']+)'", m.group(1)))
+    missing = sorted(ra - set(dir(mod)))
+    assert not missing, missing
+
+
+def test_losses_match_torch():
+    x = RNG.standard_normal((6, 5)).astype(np.float32)
+    y = RNG.integers(0, 5, (6,))
+    xf, tx = paddle.to_tensor(x), torch.tensor(x)
+    var = np.abs(RNG.standard_normal((6, 5)).astype(np.float32)) + 0.1
+    tgt = RNG.standard_normal((6, 5)).astype(np.float32)
+    np.testing.assert_allclose(
+        float(F.gaussian_nll_loss(xf, paddle.to_tensor(tgt),
+                                  paddle.to_tensor(var)).numpy()),
+        float(tF.gaussian_nll_loss(tx, torch.tensor(tgt),
+                                   torch.tensor(var))), rtol=1e-4)
+    cnt = RNG.poisson(3, (6, 5)).astype(np.float32)
+    np.testing.assert_allclose(
+        float(F.poisson_nll_loss(xf, paddle.to_tensor(cnt),
+                                 full=True).numpy()),
+        float(tF.poisson_nll_loss(tx, torch.tensor(cnt), full=True)),
+        rtol=1e-4)
+    ysm = (RNG.integers(0, 2, (6, 5)) * 2 - 1).astype(np.float32)
+    np.testing.assert_allclose(
+        float(F.soft_margin_loss(xf, paddle.to_tensor(ysm)).numpy()),
+        float(tF.soft_margin_loss(tx, torch.tensor(ysm))), rtol=1e-5)
+    yml = RNG.integers(0, 2, (6, 5)).astype(np.float32)
+    np.testing.assert_allclose(
+        float(F.multi_label_soft_margin_loss(
+            xf, paddle.to_tensor(yml)).numpy()),
+        float(tF.multilabel_soft_margin_loss(tx, torch.tensor(yml))),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        float(F.multi_margin_loss(xf, paddle.to_tensor(
+            y.astype(np.int64))).numpy()),
+        float(tF.multi_margin_loss(tx, torch.tensor(y))), rtol=1e-5)
+    pos = RNG.standard_normal((6, 5)).astype(np.float32)
+    neg = RNG.standard_normal((6, 5)).astype(np.float32)
+    np.testing.assert_allclose(
+        float(F.triplet_margin_with_distance_loss(
+            xf, paddle.to_tensor(pos), paddle.to_tensor(neg)).numpy()),
+        float(tF.triplet_margin_with_distance_loss(
+            tx, torch.tensor(pos), torch.tensor(neg))), rtol=1e-4)
+
+
+def test_adaptive_log_softmax_matches_torch():
+    torch.manual_seed(0)
+    asm = torch.nn.AdaptiveLogSoftmaxWithLoss(8, 12, cutoffs=[4, 8],
+                                              div_value=2.0)
+    xa = RNG.standard_normal((10, 8)).astype(np.float32)
+    ya = RNG.integers(0, 12, (10,))
+    t_out = asm(torch.tensor(xa), torch.tensor(ya))
+    hw = asm.head.weight.detach().numpy().T
+    tails = [(paddle.to_tensor(m[0].weight.detach().numpy().T),
+              paddle.to_tensor(m[1].weight.detach().numpy().T))
+             for m in asm.tail]
+    out, loss = F.adaptive_log_softmax_with_loss(
+        paddle.to_tensor(xa), paddle.to_tensor(ya.astype(np.int64)),
+        paddle.to_tensor(hw), tails, cutoffs=[4, 8, 12])
+    np.testing.assert_allclose(out.numpy(), t_out.output.detach().numpy(),
+                               atol=1e-5)
+    np.testing.assert_allclose(float(loss.numpy()), float(t_out.loss),
+                               rtol=1e-5)
+
+
+def test_adaptive_layer_log_prob_normalized():
+    als = nn.AdaptiveLogSoftmaxWithLoss(8, 12, [4, 8])
+    xa = paddle.to_tensor(RNG.standard_normal((5, 8)).astype(np.float32))
+    lp = als.log_prob(xa)
+    np.testing.assert_allclose(np.exp(lp.numpy()).sum(-1), 1.0, atol=1e-5)
+    pred = als.predict(xa)
+    assert pred.shape == [5]
+
+
+def test_rnnt_loss_vs_naive_dp():
+    from scipy.special import log_softmax, logsumexp
+    B, T, U, V = 2, 5, 3, 4
+    logits = RNG.standard_normal((B, T, U + 1, V)).astype(np.float32)
+    labels = RNG.integers(1, V, (B, U)).astype(np.int64)
+    in_len = np.array([5, 4], np.int64)
+    lab_len = np.array([3, 2], np.int64)
+
+    def naive(b):
+        lp = log_softmax(logits, axis=-1)
+        Tb, Ub = in_len[b], lab_len[b]
+        alpha = np.full((Tb, Ub + 1), -np.inf)
+        alpha[0, 0] = 0
+        for t in range(Tb):
+            for u in range(Ub + 1):
+                if t == 0 and u == 0:
+                    continue
+                c = []
+                if t > 0:
+                    c.append(alpha[t - 1, u] + lp[b, t - 1, u, 0])
+                if u > 0:
+                    c.append(alpha[t, u - 1]
+                             + lp[b, t, u - 1, labels[b, u - 1]])
+                alpha[t, u] = logsumexp(c)
+        return -(alpha[Tb - 1, Ub] + lp[b, Tb - 1, Ub, 0])
+
+    got = F.rnnt_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                      paddle.to_tensor(in_len), paddle.to_tensor(lab_len),
+                      fastemit_lambda=0.0, reduction="none").numpy()
+    np.testing.assert_allclose(got, [naive(0), naive(1)], rtol=1e-4)
+    # FastEmit weighting lowers the loss (emission paths upweighted)
+    fe = F.rnnt_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                     paddle.to_tensor(in_len), paddle.to_tensor(lab_len),
+                     fastemit_lambda=0.01, reduction="none").numpy()
+    assert (fe < got).all()
+
+
+def test_unpool_matches_torch():
+    x = RNG.standard_normal((2, 3, 8, 8)).astype(np.float32)
+    out, mask = F.max_pool2d(paddle.to_tensor(x), 2, 2, return_mask=True)
+    un = F.max_unpool2d(out, mask, 2, 2)
+    tout, tmask = tF.max_pool2d(torch.tensor(x), 2, 2, return_indices=True)
+    np.testing.assert_allclose(
+        un.numpy(), tF.max_unpool2d(tout, tmask, 2, 2).numpy())
+    x1 = RNG.standard_normal((2, 3, 10)).astype(np.float32)
+    o1, m1 = F.max_pool1d(paddle.to_tensor(x1), 2, 2, return_mask=True)
+    t1, tm1 = tF.max_pool1d(torch.tensor(x1), 2, 2, return_indices=True)
+    np.testing.assert_allclose(
+        F.max_unpool1d(o1, m1, 2, 2).numpy(),
+        tF.max_unpool1d(t1, tm1, 2, 2).numpy())
+
+
+def test_lp_pool1d_and_fractional():
+    x1 = np.abs(RNG.standard_normal((2, 3, 10))).astype(np.float32)
+    np.testing.assert_allclose(
+        F.lp_pool1d(paddle.to_tensor(x1), 2, 2, 2).numpy(),
+        tF.lp_pool1d(torch.tensor(x1), 2, 2, 2).numpy(), rtol=1e-5)
+    x = paddle.to_tensor(RNG.standard_normal((2, 3, 8, 8)).astype(
+        np.float32))
+    assert F.fractional_max_pool2d(x, 4, random_u=0.5).shape == [2, 3, 4, 4]
+    o, m = F.fractional_max_pool3d(
+        paddle.to_tensor(RNG.standard_normal((1, 2, 8, 8, 8)).astype(
+            np.float32)), 4, random_u=0.3, return_mask=True)
+    assert o.shape == [1, 2, 4, 4, 4] and m.shape == [1, 2, 4, 4, 4]
+
+
+def test_varlen_attention_equals_per_segment():
+    total, h, d = 10, 2, 4
+    q = RNG.standard_normal((total, h, d)).astype(np.float32)
+    cu = np.array([0, 6, 10], np.int64)
+    out = F.flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+        paddle.to_tensor(cu), paddle.to_tensor(cu), 6, 6, scale=d ** -0.5)
+
+    def seg(lo, hi):
+        s = np.einsum("qhd,khd->hqk", q[lo:hi], q[lo:hi]) * d ** -0.5
+        e = np.exp(s - s.max(-1, keepdims=True))
+        a = e / e.sum(-1, keepdims=True)
+        return np.einsum("hqk,khd->qhd", a, q[lo:hi])
+
+    np.testing.assert_allclose(
+        out.numpy(), np.concatenate([seg(0, 6), seg(6, 10)]), atol=1e-5)
+    qkv = RNG.standard_normal((total, 3, h, d)).astype(np.float32)
+    assert F.flash_attn_varlen_qkvpacked(
+        paddle.to_tensor(qkv), paddle.to_tensor(cu), paddle.to_tensor(cu),
+        6, 6).shape == [10, 2, 4]
+
+
+def test_beam_search_decode():
+    paddle.seed(0)
+    V, H = 7, 6
+    dec = nn.BeamSearchDecoder(nn.GRUCell(4, H), start_token=1,
+                               end_token=2, beam_size=3,
+                               embedding_fn=nn.Embedding(V, 4),
+                               output_fn=nn.Linear(H, V))
+    ids, st, lens = nn.dynamic_decode(dec, inits=paddle.zeros([2, H]),
+                                      max_step_num=6, return_length=True)
+    assert ids.shape[0] == 2 and ids.shape[2] == 3
+    assert lens.shape == [2, 3]
+
+
+def test_birnn_and_custom_cell():
+    xo = paddle.to_tensor(RNG.standard_normal((2, 5, 4)).astype(np.float32))
+    yo, _ = nn.BiRNN(nn.GRUCell(4, 6), nn.GRUCell(4, 6))(xo)
+    assert yo.shape == [2, 5, 12]
+
+    class MyCell(nn.RNNCellBase):
+        def __init__(self):
+            super().__init__()
+            self.hidden_size = 3
+            self.fc = nn.Linear(4, 3)
+
+        def forward(self, x, states=None):
+            h = states if states is not None \
+                else self.get_initial_states(x, [3])
+            out = paddle.tanh(self.fc(x) + h)
+            return out, out
+
+    yo2, _ = nn.RNN(MyCell())(xo)
+    assert yo2.shape == [2, 5, 3]
+
+
+def test_spectral_norm_layer():
+    w = paddle.to_tensor(RNG.standard_normal((4, 6)).astype(np.float32),
+                         stop_gradient=False)
+    sn = nn.SpectralNorm([4, 6], power_iters=20)
+    out = sn(w)
+    sv = np.linalg.svd(out.numpy(), compute_uv=False)
+    np.testing.assert_allclose(sv[0], 1.0, atol=1e-3)
+    out.sum().backward()
+    assert w.grad is not None
+
+
+def test_incubate_lookahead_modelaverage():
+    w = paddle.create_parameter([2], "float32")
+    la = paddle.incubate.LookAhead(
+        paddle.optimizer.SGD(0.1, parameters=[w]), alpha=0.5, k=3)
+    tgt = paddle.to_tensor(np.array([1.0, -1.0], np.float32))
+    for _ in range(60):
+        ((w - tgt) ** 2).sum().backward()
+        la.step()
+        la.clear_grad()
+    np.testing.assert_allclose(w.numpy(), [1, -1], atol=1e-2)
+    import jax.numpy as jnp
+    ma = paddle.incubate.ModelAverage(0.15, parameters=[w])
+    for v in [0.0, 2.0]:
+        w._data = jnp.full((2,), v, w._data.dtype)
+        ma.step()
+    with ma.apply():
+        np.testing.assert_allclose(w.numpy(), 1.0)
+    np.testing.assert_allclose(w.numpy(), 2.0)
+
+
+def test_incubate_graph_ops():
+    colptr = np.array([0, 0, 1, 3], np.int64)
+    row = np.array([0, 0, 1], np.int64)
+    nb, cnt = paddle.incubate.graph_sample_neighbors(
+        paddle.to_tensor(row), paddle.to_tensor(colptr),
+        paddle.to_tensor(np.array([2, 1], np.int64)))
+    assert cnt.numpy().tolist() == [2, 1]
+    rs, rd, nodes = paddle.incubate.graph_reindex(
+        paddle.to_tensor(np.array([2, 1], np.int64)), nb, cnt)
+    assert nodes.numpy()[0] == 2 and len(rs.numpy()) == 3
+    out = paddle.incubate.graph_khop_sampler(
+        paddle.to_tensor(row), paddle.to_tensor(colptr),
+        paddle.to_tensor(np.array([2], np.int64)), [2, 2])
+    assert len(out) == 4
+
+
+def test_misc_namespaces():
+    assert sorted(list(paddle.reader.shuffle(
+        lambda: iter(range(10)), 5)())) == list(range(10))
+    assert list(paddle.reader.compose(
+        lambda: iter([1, 2]), lambda: iter([(3, 4), (5, 6)]))()) == \
+        [(1, 3, 4), (2, 5, 6)]
+    assert paddle.sysconfig.get_include().endswith("csrc")
+    assert paddle.static.InputSpec is paddle.jit.InputSpec
+    with paddle.static.name_scope("x"):
+        pass
+    with pytest.raises(NotImplementedError):
+        paddle.static.default_main_program()
+    assert paddle.tensor.math.add is not None
+    assert paddle.callbacks.EarlyStopping is not None
+    with pytest.raises((ImportError, NotImplementedError)):
+        paddle.onnx.export(None, "x")
+
+
+def test_hub_local(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "def mymodel(n=1):\n    'a doc'\n    return n * 2\n")
+    assert paddle.hub.list(str(tmp_path)) == ["mymodel"]
+    assert paddle.hub.help(str(tmp_path), "mymodel") == "a doc"
+    assert paddle.hub.load(str(tmp_path), "mymodel", n=3) == 6
+    with pytest.raises(RuntimeError):
+        paddle.hub.load("user/repo", "m")
