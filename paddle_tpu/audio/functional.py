@@ -115,3 +115,25 @@ def power_to_db(magnitude, ref_value: float = 1.0, amin: float = 1e-10,
             db = jnp.maximum(db, jnp.max(db) - top_db)
         return db
     return run_op("power_to_db", fn, [magnitude])
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype="float32"):
+    """Center frequencies of FFT bins (reference:
+    audio/functional/functional.py fft_frequencies)."""
+    from ..core.dispatch import wrap
+    from ..core import dtype as dtype_mod
+    out = jnp.linspace(0, float(sr) / 2, 1 + n_fft // 2)
+    return wrap(out.astype(dtype_mod.dtype(dtype).np_dtype))
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype="float32"):
+    """Mel-spaced frequency grid (reference: mel_frequencies)."""
+    from ..core.dispatch import wrap
+    from ..core import dtype as dtype_mod
+    lo = hz_to_mel(f_min, htk=htk)
+    hi = hz_to_mel(f_max, htk=htk)
+    mels = jnp.linspace(lo, hi, n_mels)
+    return wrap(jnp.asarray(mel_to_hz(mels, htk=htk)).astype(
+        dtype_mod.dtype(dtype).np_dtype))

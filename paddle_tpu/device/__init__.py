@@ -164,3 +164,53 @@ xpu = cuda
 from . import monitor  # noqa: F401
 from .monitor import (max_memory_allocated, max_memory_reserved,  # noqa: F401
                       memory_allocated, memory_reserved)
+
+
+def get_cudnn_version():
+    """CUDA compat (reference: device.get_cudnn_version): no cuDNN in the
+    XLA:TPU stack — None, like a CPU-only reference build."""
+    return None
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    """The graph compiler here is XLA, not CINN."""
+    return False
+
+
+def is_compiled_with_custom_device(device_name=None):
+    """PJRT plugins are the custom-device mechanism; the axon TPU platform
+    itself loads through one."""
+    import jax
+    try:
+        platforms = {d.platform for d in jax.devices()}
+    except Exception:
+        return False
+    return device_name in platforms if device_name else bool(platforms)
+
+
+def is_compiled_with_distribute():
+    """Distributed is always built in (jax.distributed + mesh)."""
+    return True
+
+
+class IPUPlace:
+    def __init__(self, *a):
+        raise RuntimeError("IPU is not a PJRT backend in this build")
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def stream_guard(stream=None):
+    """Streams are XLA-managed; kept as a no-op scope (reference:
+    device.stream_guard)."""
+    yield

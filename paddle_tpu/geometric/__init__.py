@@ -86,3 +86,98 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
                "min": jax.ops.segment_min}[reduce_op]
         return red(msgs, di, num_segments=n)
     return run_op("send_ue_recv", fn, [x, y, src_index, dst_index])
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge messages combining both endpoints' features (reference:
+    geometric.send_uv). Returns one message per edge (no reduce)."""
+    ops = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+           "div": jnp.divide}
+    if message_op not in ops:
+        raise ValueError(f"message_op must be one of {sorted(ops)}")
+
+    def fn(a, b, si, di):
+        return ops[message_op](a[si], b[di])
+    return run_op("send_uv", fn, [x, y, src_index, dst_index])
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """CSC neighbor sampling (reference: geometric.sample_neighbors; same
+    kernel as incubate.graph_sample_neighbors)."""
+    from ..incubate.graph import graph_sample_neighbors
+    return graph_sample_neighbors(row, colptr, input_nodes, eids=eids,
+                                  sample_size=sample_size,
+                                  return_eids=return_eids)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None,
+                              return_eids=False, name=None):
+    """Weight-biased neighbor sampling (reference:
+    geometric.weighted_sample_neighbors)."""
+    import numpy as np
+
+    from ..core.dispatch import wrap as _wrap
+    row_np = np.asarray(unwrap(row))
+    colptr_np = np.asarray(unwrap(colptr))
+    w_np = np.asarray(unwrap(edge_weight)).astype(np.float64)
+    nodes = np.asarray(unwrap(input_nodes)).reshape(-1)
+    eids_np = np.asarray(unwrap(eids)) if eids is not None else None
+    rng = np.random.default_rng()
+    out_n, out_c, out_e = [], [], []
+    for nd in nodes:
+        beg, end = int(colptr_np[nd]), int(colptr_np[nd + 1])
+        neigh = row_np[beg:end]
+        idx = np.arange(beg, end)
+        if 0 < sample_size < len(neigh):
+            pr = w_np[beg:end]
+            pr = pr / pr.sum() if pr.sum() > 0 else None
+            pick = rng.choice(len(neigh), sample_size, replace=False,
+                              p=pr)
+            neigh, idx = neigh[pick], idx[pick]
+        out_n.append(neigh)
+        out_c.append(len(neigh))
+        if eids_np is not None:
+            out_e.append(eids_np[idx])
+    neighbors = _wrap(np.concatenate(out_n)
+                      if out_n else np.zeros(0, row_np.dtype))
+    counts = _wrap(np.asarray(out_c, np.int32))
+    if return_eids:
+        if eids_np is None:
+            raise ValueError("return_eids requires eids")
+        return neighbors, counts, _wrap(np.concatenate(out_e))
+    return neighbors, counts
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, name=None):
+    """(reference: geometric.reindex_graph — same kernel as
+    incubate.graph_reindex)."""
+    from ..incubate.graph import graph_reindex
+    return graph_reindex(x, neighbors, count)
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Reindex per-relation neighbor lists against one shared node set
+    (reference: geometric.reindex_heter_graph)."""
+    import numpy as np
+
+    from ..core.dispatch import wrap as _wrap
+    x_np = np.asarray(unwrap(x)).reshape(-1)
+    uniq = list(dict.fromkeys(x_np.tolist()))
+    seen = {v: i for i, v in enumerate(uniq)}
+    srcs, dsts = [], []
+    for nb, ct in zip(neighbors, count):
+        nb_np, ct_np = np.asarray(unwrap(nb)), np.asarray(unwrap(ct))
+        for v in nb_np.tolist():
+            if v not in seen:
+                seen[v] = len(uniq)
+                uniq.append(v)
+        srcs.append(np.asarray([seen[v] for v in nb_np.tolist()],
+                               np.int64))
+        dsts.append(np.repeat(np.arange(len(x_np)), ct_np))
+    return (_wrap(np.concatenate(srcs)),
+            _wrap(np.concatenate(dsts).astype(np.int64)),
+            _wrap(np.asarray(uniq, x_np.dtype)))

@@ -93,7 +93,8 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         return jnp.einsum("hqk,khd->qhd", attn, v)
     out = run_op("flash_attn_unpadded", fn,
                  [query, key, value, cu_seqlens_q, cu_seqlens_k])
-    return (out, None) if return_softmax else out
+    # reference contract: ALWAYS (out, softmax-or-None)
+    return out, None
 
 
 def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
@@ -106,10 +107,10 @@ def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
     q, k, v = [t.squeeze(1) for t in _split(qkv, 3, axis=1)]
     if scale is None:
         scale = float(q.shape[-1]) ** -0.5
-    out = flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
-                              max_seqlen_q, max_seqlen_k, scale,
-                              dropout, causal, return_softmax=False)
-    return (out, None) if return_softmax else out
+    out, _ = flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                                 max_seqlen_q, max_seqlen_k, scale,
+                                 dropout, causal, return_softmax=False)
+    return out, None
 
 
 def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):

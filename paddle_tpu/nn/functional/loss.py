@@ -592,8 +592,8 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
                 val = jnp.where(u == 0, fb,
                                 jnp.logaddexp(fb, em))
                 return val, val
-            _, cols = jax.lax.scan(u_step, jnp.full((B,), 0.0),
-                                   jnp.arange(U1))
+            _, cols = jax.lax.scan(
+                u_step, jnp.zeros((B,), lp.dtype), jnp.arange(U1))
             return jnp.swapaxes(cols, 0, 1), None
 
         # alpha[0, u]: only emit moves along u at t=0

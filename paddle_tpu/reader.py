@@ -109,28 +109,57 @@ def firstn(reader, n):
 
 
 def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
-    """Thread-pool map over a reader (reference: xmap_readers; threads
-    instead of the reference's raw threads-with-signals, same contract)."""
+    """Thread-pool map over a STREAMING reader through bounded queues
+    (reference: xmap_readers — same contract: items flow through
+    process_num workers; order=True preserves input order)."""
+    class _End:
+        pass
+
     def xreader():
-        items = list(reader())
-        results = [None] * len(items)
-        q = Queue()
-        for i, it in enumerate(items):
-            q.put((i, it))
+        in_q = Queue(maxsize=buffer_size)
+        out_q = Queue(maxsize=buffer_size)
+
+        def feed():
+            for i, item in enumerate(reader()):
+                in_q.put((i, item))
+            for _ in range(process_num):
+                in_q.put(_End)
 
         def work():
-            while not q.empty():
-                try:
-                    i, it = q.get_nowait()
-                except Exception:
+            while True:
+                got = in_q.get()
+                if got is _End:
+                    out_q.put(_End)
                     return
-                results[i] = mapper(it)
-        threads = [Thread(target=work) for _ in range(process_num)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        yield from results
+                i, item = got
+                out_q.put((i, mapper(item)))
+
+        Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            Thread(target=work, daemon=True).start()
+        done = 0
+        if order:
+            pending = {}
+            next_i = 0
+            while done < process_num:
+                got = out_q.get()
+                if got is _End:
+                    done += 1
+                    continue
+                i, val = got
+                pending[i] = val
+                while next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while done < process_num:
+                got = out_q.get()
+                if got is _End:
+                    done += 1
+                    continue
+                yield got[1]
     return xreader
 
 

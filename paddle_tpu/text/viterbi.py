@@ -1,0 +1,72 @@
+"""Viterbi decoding (reference: python/paddle/text/viterbi_decode.py —
+ViterbiDecoder / viterbi_decode over CRF transition scores).
+
+The time recursion is a lax.scan, so the whole decode compiles to one XLA
+program (scores [B, T, N] static-shaped); the backtrace runs as a second
+scan over the argmax history.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import run_op, run_op_nodiff
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Best tag path per sequence. Returns (scores [B], paths [B, T])."""
+    def fn(emis, trans, lens):
+        B, T, N = emis.shape
+        if include_bos_eos_tag:
+            # reference semantics: last two tags are BOS/EOS; start from
+            # BOS transition row, end adding the EOS column
+            start = trans[N - 2][None, :] + emis[:, 0]
+            stop = trans[:, N - 1][None, :]
+        else:
+            start = emis[:, 0]
+            stop = jnp.zeros((1, N), emis.dtype)
+
+        def step(carry, t):
+            alpha = carry  # [B, N]
+            # scores[b, i, j] = alpha[b, i] + trans[i, j] + emis[b, t, j]
+            scores = alpha[:, :, None] + trans[None, :, :]
+            best_prev = jnp.argmax(scores, axis=1)         # [B, N]
+            alpha_t = jnp.max(scores, axis=1) + emis[:, t]
+            # sequences already past their length keep their alpha
+            active = (t < lens)[:, None]
+            alpha_t = jnp.where(active, alpha_t, alpha)
+            return alpha_t, best_prev
+
+        alpha, history = jax.lax.scan(step, start, jnp.arange(1, T))
+        final = alpha + stop
+        scores = jnp.max(final, axis=-1)
+        last_tag = jnp.argmax(final, axis=-1)              # [B]
+
+        def back(carry, t):
+            tag = carry
+            bp = history[t]                                # [B, N]
+            prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+            active = (t + 1) < lens
+            prev = jnp.where(active, prev, tag)
+            return prev, prev
+
+        _, path_rev = jax.lax.scan(back, last_tag,
+                                   jnp.arange(T - 2, -1, -1))
+        paths = jnp.concatenate(
+            [path_rev[::-1].T, last_tag[:, None]], axis=1)  # [B, T]
+        return scores, paths.astype(jnp.int64)
+    return run_op("viterbi_decode", fn,
+                  [potentials, transition_params, lengths])
+
+
+class ViterbiDecoder:
+    """Layer-style wrapper (reference: text.ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,6 +1,7 @@
 """paddle_tpu.vision (reference: python/paddle/vision)."""
 from . import datasets  # noqa: F401
 from . import models  # noqa: F401
+from . import ops  # noqa: F401
 from . import transforms  # noqa: F401
 from .models import LeNet, ResNet, resnet18, resnet50  # noqa: F401
 from .image import (  # noqa: F401

@@ -100,3 +100,133 @@ class Cifar10(Dataset):
 
 class Cifar100(Cifar10):
     NUM_CLASSES = 100
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm",
+                  ".tif", ".tiff", ".webp")
+
+
+class DatasetFolder(Dataset):
+    """Generic folder-of-class-folders dataset (reference:
+    vision/datasets/folder.py DatasetFolder): root/<class>/<file>."""
+
+    def __init__(self, root, loader=None, extensions=None,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        extensions = extensions or IMG_EXTENSIONS
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class folders found in {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for base, _, files in sorted(os.walk(cdir)):
+                for fname in sorted(files):
+                    path = os.path.join(base, fname)
+                    ok = is_valid_file(path) if is_valid_file else \
+                        fname.lower().endswith(tuple(extensions))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no valid files found under {root}")
+        self.targets = [t for _, t in self.samples]
+
+    @staticmethod
+    def _default_loader(path):
+        from PIL import Image
+        with open(path, "rb") as f:
+            return Image.open(f).convert("RGB")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+
+class ImageFolder(Dataset):
+    """Flat/recursive folder of images, no labels (reference:
+    vision/datasets/folder.py ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or DatasetFolder._default_loader
+        extensions = extensions or IMG_EXTENSIONS
+        self.samples = []
+        for base, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(base, fname)
+                ok = is_valid_file(path) if is_valid_file else \
+                    fname.lower().endswith(tuple(extensions))
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise RuntimeError(f"no valid files found under {root}")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+
+class Flowers(Dataset):
+    """Flowers-102 (reference: vision/datasets/flowers.py). Synthetic
+    stand-in (no egress): 102 classes of class-conditional images."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True,
+                 backend=None, num_samples=600):
+        seed = {"train": 0, "valid": 1, "test": 2}.get(mode, 0)
+        self._x, self._y = _synthetic_images(num_samples, 102,
+                                             (3, 96, 96), seed + 400)
+        self.transform = transform
+
+    def __len__(self):
+        return len(self._y)
+
+    def __getitem__(self, i):
+        img = self._x[i]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self._y[i]
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation (reference: vision/datasets/voc2012.py):
+    (image, label_mask) pairs, 21 classes. Synthetic stand-in: masks are
+    thresholded class prototypes so mIoU training is meaningful."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, num_samples=200):
+        seed = {"train": 0, "valid": 1, "test": 2}.get(mode, 0)
+        imgs, labels = _synthetic_images(num_samples, 21, (3, 64, 64),
+                                         seed + 500)
+        self._x = imgs
+        # mask: the class's wave pattern thresholded into fg/bg
+        self._masks = (imgs.mean(axis=1) > 0.5).astype(np.int64) * \
+            (labels[:, None, None] + 0)
+        self.transform = transform
+
+    def __len__(self):
+        return len(self._x)
+
+    def __getitem__(self, i):
+        img = self._x[i]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self._masks[i]

@@ -274,12 +274,504 @@ def shuffle_channel(x, group, name=None):
     return run_op("shuffle_channel", fn, [x])
 
 
-def deform_conv2d(*a, **kw):
-    raise NotImplementedError(
-        "deformable convolution needs a gather-heavy custom kernel; "
-        "planned as a Pallas kernel")
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (reference ops.yaml: deformable_conv).
+
+    Implemented as bilinear gather at offset positions + one einsum
+    contraction — the gather vectorises over (kernel pos, output pos) so
+    XLA sees a single large batched-gather + matmul instead of the
+    reference's per-position CUDA kernel. mask=None is v1; with mask it's
+    v2 (modulated)."""
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) else dilation
+
+    def fn(a, off, w, *rest):
+        n, cin, h, wdt = a.shape
+        cout, cin_g, kh, kw = w.shape
+        hout = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        wout = (wdt + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        dg = deformable_groups
+        off = off.reshape(n, dg, kh * kw, 2, hout, wout)
+        msk = None
+        if mask is not None:
+            msk = rest[0].reshape(n, dg, kh * kw, hout, wout)
+        # base sampling grid per kernel tap: tap (i, j) reads
+        # (ho*sh - ph + i*dh, wo*sw - pw + j*dw)
+        ho = jnp.arange(hout) * sh - ph
+        wo = jnp.arange(wout) * sw - pw
+        ki = jnp.arange(kh) * dh
+        kj = jnp.arange(kw) * dw
+        grid_y = ki[:, None, None, None] + ho[None, None, :, None]
+        grid_x = kj[None, :, None, None] + wo[None, None, None, :]
+        base_y = jnp.broadcast_to(grid_y, (kh, kw, hout, wout)) \
+            .reshape(kh * kw, hout, wout).astype(off.dtype)
+        base_x = jnp.broadcast_to(grid_x, (kh, kw, hout, wout)) \
+            .reshape(kh * kw, hout, wout).astype(off.dtype)
+        # offsets are (dy, dx) per tap
+        py = base_y[None, None] + off[:, :, :, 0]        # [n, dg, K, ho, wo]
+        px = base_x[None, None] + off[:, :, :, 1]
+
+        def bilinear(img, yy, xx):
+            # img: [cpg, h, w]; yy/xx: [K, ho, wo]
+            inside = (yy > -1.0) & (yy < h) & (xx > -1.0) & (xx < wdt)
+            yy = jnp.clip(yy, 0.0, h - 1)
+            xx = jnp.clip(xx, 0.0, wdt - 1)
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            wy = yy - y0
+            wx = xx - x0
+
+            def g(yc, xc):
+                yc = jnp.clip(yc, 0, h - 1)
+                xc = jnp.clip(xc, 0, wdt - 1)
+                return img[:, yc, xc]                    # [cpg, K, ho, wo]
+            val = (g(y0, x0) * (1 - wy) * (1 - wx)
+                   + g(y0, x0 + 1) * (1 - wy) * wx
+                   + g(y0 + 1, x0) * wy * (1 - wx)
+                   + g(y0 + 1, x0 + 1) * wy * wx)
+            return val * inside
+
+        cpg = cin // dg                                   # chans per dgroup
+
+        def per_image(img, yy, xx, m=None):
+            # img [cin, h, w]; yy/xx [dg, K, ho, wo]
+            cols = []
+            for g_i in range(dg):
+                v = bilinear(img[g_i * cpg:(g_i + 1) * cpg],
+                             yy[g_i], xx[g_i])
+                if m is not None:
+                    v = v * m[g_i][None]
+                cols.append(v)
+            return jnp.concatenate(cols, axis=0)          # [cin, K, ho, wo]
+        if msk is not None:
+            sampled = jax.vmap(per_image)(a, py, px, msk)
+        else:
+            sampled = jax.vmap(
+                lambda img, yy, xx: per_image(img, yy, xx))(a, py, px)
+        # grouped contraction: [n, cin, K, ho, wo] x [cout, cin_g, K]
+        wf = w.reshape(cout, cin_g, kh * kw)
+        cpg_out = cout // groups
+        outs = []
+        for g_i in range(groups):
+            s_g = sampled[:, g_i * cin_g:(g_i + 1) * cin_g]
+            w_g = wf[g_i * cpg_out:(g_i + 1) * cpg_out]
+            outs.append(jnp.einsum("nckhw,ock->nohw", s_g, w_g))
+        out = jnp.concatenate(outs, axis=1)
+        if bias is not None:
+            out = out + rest[-1].reshape(1, -1, 1, 1)
+        return out
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+    return run_op("deform_conv2d", fn, args)
+
+
+from ..nn import Layer as _Layer
+
+
+class DeformConv2D(_Layer):
+    """Layer owning the deformable-conv weight/bias (reference:
+    python/paddle/vision/ops.py:973 DeformConv2D(Layer)); params register
+    on the module tree so optimizers/state_dict see them."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        kh, kw = (kernel_size, kernel_size) \
+            if isinstance(kernel_size, int) else kernel_size
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, kh, kw],
+            attr=weight_attr)
+        self.bias = self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True) \
+            if bias_attr is not False else None
+        self.stride, self.padding = stride, padding
+        self.dilation = dilation
+        self.deformable_groups = deformable_groups
+        self.groups = groups
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             self.stride, self.padding, self.dilation,
+                             self.deformable_groups, self.groups, mask)
 
 
 def distribute_fpn_proposals(*a, **kw):
     raise NotImplementedError("FPN proposal distribution is dynamic-shape "
                               "host logic; run it outside jit")
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference ops.yaml: psroi_pool):
+    input channels C = out_c * oh * ow; output bin (i, j) average-pools
+    its own channel group over that bin's spatial region."""
+    out_h, out_w = (output_size if isinstance(output_size, (tuple, list))
+                    else (output_size, output_size))
+    batch_idx = _roi_batch_indices(boxes, boxes_num)
+
+    def fn(feat, rois):
+        n, c, h, w = feat.shape
+        if c % (out_h * out_w) != 0:
+            raise ValueError(
+                f"psroi_pool needs channels divisible by {out_h * out_w}")
+        out_c = c // (out_h * out_w)
+        ratio = 2  # dense sub-samples per bin side
+
+        # bins loop in python (out_h/out_w static -> unrolls into one
+        # XLA program; each bin reads its own channel group)
+        def one_roi(roi, bidx):
+            x1, y1, x2, y2 = roi * spatial_scale
+            rw = jnp.maximum(x2 - x1, 0.1)
+            rh = jnp.maximum(y2 - y1, 0.1)
+            rows = []
+            for i in range(out_h):
+                cols = []
+                for j in range(out_w):
+                    ys = y1 + (i + (jnp.arange(ratio) + 0.5) / ratio) \
+                        * rh / out_h
+                    xs = x1 + (j + (jnp.arange(ratio) + 0.5) / ratio) \
+                        * rw / out_w
+                    yi = jnp.clip(ys.astype(jnp.int32), 0, h - 1)
+                    xi = jnp.clip(xs.astype(jnp.int32), 0, w - 1)
+                    # reference psroi_pool_kernel.cc:151 is channel-
+                    # major: out channel c at bin (i, j) reads input
+                    # channel c*oh*ow + i*ow + j
+                    group = feat[bidx,
+                                 i * out_w + j::out_h * out_w]
+                    patch = group[:, yi][:, :, xi]
+                    cols.append(jnp.mean(patch, axis=(1, 2)))
+                rows.append(jnp.stack(cols, axis=-1))
+            return jnp.stack(rows, axis=-2)          # [out_c, oh, ow]
+        return jax.vmap(one_roi)(rois, batch_idx)
+    return run_op("psroi_pool", fn, [x, boxes])
+
+
+class RoIAlign:
+    """Layer wrapper (reference: vision.ops.RoIAlign)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale, aligned=aligned)
+
+
+class RoIPool:
+    """Layer wrapper (reference: vision.ops.RoIPool)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class PSRoIPool:
+    """Layer wrapper (reference: vision.ops.PSRoIPool)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+def read_file(filename, name=None):
+    """Read a file's bytes into a uint8 tensor (reference: read_file)."""
+    from ..core.dispatch import wrap
+    with open(filename, "rb") as f:
+        data = f.read()
+    return wrap(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a uint8 JPEG byte tensor to CHW uint8 (reference:
+    decode_jpeg; PIL does the host-side decode)."""
+    import io as _io
+
+    from PIL import Image
+
+    from ..core.dispatch import wrap
+    data = bytes(np.asarray(unwrap(x)).astype(np.uint8).tobytes())
+    img = Image.open(_io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return wrap(jnp.asarray(arr))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2) — decay scores by overlap with higher-scored
+    same-class candidates (reference ops.yaml: matrix_nms). Host-side
+    like the reference CPU kernel (dynamic output count)."""
+    from ..core.dispatch import wrap
+    b_np = np.asarray(unwrap(bboxes))   # [N, M, 4]
+    s_np = np.asarray(unwrap(scores))   # [N, C, M]
+    outs, indices, counts = [], [], []
+    for n in range(b_np.shape[0]):
+        per_img = []
+        for c in range(s_np.shape[1]):
+            if c == background_label:
+                continue
+            sc = s_np[n, c]
+            keep = np.where(sc > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-sc[keep])][:nms_top_k]
+            boxes_c = b_np[n, order]
+            sc_c = sc[order]
+            # pairwise IoU of the sorted candidates
+            x1 = np.maximum(boxes_c[:, None, 0], boxes_c[None, :, 0])
+            y1 = np.maximum(boxes_c[:, None, 1], boxes_c[None, :, 1])
+            x2 = np.minimum(boxes_c[:, None, 2], boxes_c[None, :, 2])
+            y2 = np.minimum(boxes_c[:, None, 3], boxes_c[None, :, 3])
+            off = 0.0 if normalized else 1.0
+            inter = (np.clip(x2 - x1 + off, 0, None)
+                     * np.clip(y2 - y1 + off, 0, None))
+            area = ((boxes_c[:, 2] - boxes_c[:, 0] + off)
+                    * (boxes_c[:, 3] - boxes_c[:, 1] + off))
+            iou = inter / np.maximum(area[:, None] + area[None, :]
+                                     - inter, 1e-10)
+            iou = np.triu(iou, k=1)
+            iou_cmax = iou.max(axis=0)     # per-candidate max w/ higher
+            # decay_j = min_i f(iou_ij) / f(iou_cmax_i): denominator runs
+            # over the HIGHER-ranked candidate i (rows)
+            if use_gaussian:
+                decay = np.exp((iou_cmax[:, None] ** 2 - iou ** 2)
+                               * gaussian_sigma)
+            else:
+                decay = (1 - iou) / np.maximum(
+                    1 - iou_cmax[:, None], 1e-10)
+            decay = np.triu(decay, k=1) + np.tril(np.ones_like(decay))
+            decay = decay.min(axis=0)
+            dec_sc = sc_c * decay
+            sel = np.where(dec_sc >= post_threshold)[0]
+            for i in sel:
+                per_img.append((c, dec_sc[i], *boxes_c[i], order[i]))
+        per_img.sort(key=lambda r: -r[1])
+        per_img = per_img[:keep_top_k]
+        counts.append(len(per_img))
+        for r in per_img:
+            outs.append(r[:6])
+            # global index into the flattened [N*M] box tensor
+            # (reference matrix_nms_kernel.cc:235 pushes start + idx)
+            indices.append(n * b_np.shape[1] + r[6])
+    out = wrap(np.asarray(outs, np.float32).reshape(-1, 6))
+    res = [out]
+    if return_index:
+        res.append(wrap(np.asarray(indices, np.int64)))
+    if return_rois_num:
+        res.append(wrap(np.asarray(counts, np.int32)))
+    return tuple(res) if len(res) > 1 else out
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=True,
+                       name=None):
+    """RPN proposal generation: decode deltas at anchors, clip to image,
+    filter small, NMS (reference ops.yaml: generate_proposals). Host-side
+    (dynamic output count, like the reference CPU kernel)."""
+    from ..core.dispatch import wrap
+    sc = np.asarray(unwrap(scores))       # [N, A, H, W]
+    bd = np.asarray(unwrap(bbox_deltas))  # [N, 4A, H, W]
+    ims = np.asarray(unwrap(img_size))    # [N, 2]
+    anc = np.asarray(unwrap(anchors)).reshape(-1, 4)
+    var = np.asarray(unwrap(variances)).reshape(-1, 4)
+    N = sc.shape[0]
+    rois_out, num_out, score_out = [], [], []
+    off = 1.0 if pixel_offset else 0.0
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)
+        d = bd[n].reshape(-1, 4, sc.shape[2], sc.shape[3]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s_n, d_n, a_n, v_n = s[order], d[order], anc[order], var[order]
+        aw = a_n[:, 2] - a_n[:, 0] + off
+        ah = a_n[:, 3] - a_n[:, 1] + off
+        acx = a_n[:, 0] + aw / 2
+        acy = a_n[:, 1] + ah / 2
+        cx = v_n[:, 0] * d_n[:, 0] * aw + acx
+        cy = v_n[:, 1] * d_n[:, 1] * ah + acy
+        wk = aw * np.exp(np.clip(v_n[:, 2] * d_n[:, 2], None, 10))
+        hk = ah * np.exp(np.clip(v_n[:, 3] * d_n[:, 3], None, 10))
+        props = np.stack([cx - wk / 2, cy - hk / 2,
+                          cx + wk / 2 - off, cy + hk / 2 - off], axis=1)
+        H_im, W_im = float(ims[n, 0]), float(ims[n, 1])
+        props[:, 0::2] = np.clip(props[:, 0::2], 0, W_im - off)
+        props[:, 1::2] = np.clip(props[:, 1::2], 0, H_im - off)
+        ws = props[:, 2] - props[:, 0] + off
+        hs = props[:, 3] - props[:, 1] + off
+        keep = np.where((ws >= min_size) & (hs >= min_size))[0]
+        props, s_n = props[keep], s_n[keep]
+        # greedy NMS
+        order2 = np.argsort(-s_n)
+        selected = []
+        while order2.size and len(selected) < post_nms_top_n:
+            i = order2[0]
+            selected.append(i)
+            xx1 = np.maximum(props[i, 0], props[order2[1:], 0])
+            yy1 = np.maximum(props[i, 1], props[order2[1:], 1])
+            xx2 = np.minimum(props[i, 2], props[order2[1:], 2])
+            yy2 = np.minimum(props[i, 3], props[order2[1:], 3])
+            inter = (np.clip(xx2 - xx1 + off, 0, None)
+                     * np.clip(yy2 - yy1 + off, 0, None))
+            area_i = (props[i, 2] - props[i, 0] + off) \
+                * (props[i, 3] - props[i, 1] + off)
+            area_o = (props[order2[1:], 2] - props[order2[1:], 0] + off) \
+                * (props[order2[1:], 3] - props[order2[1:], 1] + off)
+            iou = inter / np.maximum(area_i + area_o - inter, 1e-10)
+            order2 = order2[1:][iou <= nms_thresh]
+        rois_out.append(props[selected])
+        score_out.append(s_n[selected])
+        num_out.append(len(selected))
+    rois = wrap(np.concatenate(rois_out).astype(np.float32)
+                if rois_out else np.zeros((0, 4), np.float32))
+    scs = wrap(np.concatenate(score_out).astype(np.float32))
+    if return_rois_num:
+        return rois, scs, wrap(np.asarray(num_out, np.int32))
+    return rois, scs
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 detection loss (reference ops.yaml: yolo_loss kernel).
+
+    x: [N, mask*(5+cls), H, W]; gt_box: [N, B, 4] (xywh, image-relative
+    0..1); gt_label: [N, B]. Anchor assignment (best wh-IoU over ALL
+    anchors), coordinate SCE/L1 losses weighted by (2 - gw*gh),
+    objectness BCE with ignore region, class BCE — same decomposition as
+    the reference kernel, all as one vectorised jnp program.
+    """
+    anchors_np = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask_np = np.asarray(anchor_mask, np.int64)
+    n_mask = len(mask_np)
+
+    def fn(xx, gbox, glabel, *rest):
+        N, C, H, W = xx.shape
+        xx = xx.reshape(N, n_mask, 5 + class_num, H, W)
+        B = gbox.shape[1]
+        an_w = jnp.asarray(anchors_np[:, 0]) / (downsample_ratio * W)
+        an_h = jnp.asarray(anchors_np[:, 1]) / (downsample_ratio * H)
+
+        tx, ty = xx[:, :, 0], xx[:, :, 1]
+        tw, th = xx[:, :, 2], xx[:, :, 3]
+        tobj = xx[:, :, 4]
+        tcls = xx[:, :, 5:]
+
+        # decoded prediction boxes (for the ignore mask)
+        gx = (jax.nn.sigmoid(tx) * scale_x_y - 0.5 * (scale_x_y - 1)
+              + jnp.arange(W)[None, None, None, :]) / W
+        gy = (jax.nn.sigmoid(ty) * scale_x_y - 0.5 * (scale_x_y - 1)
+              + jnp.arange(H)[None, None, :, None]) / H
+        gw = jnp.exp(tw) * an_w[mask_np][None, :, None, None]
+        gh = jnp.exp(th) * an_h[mask_np][None, :, None, None]
+
+        # IoU of every predicted box with every gt box -> ignore mask
+        px1, px2 = gx - gw / 2, gx + gw / 2
+        py1, py2 = gy - gh / 2, gy + gh / 2
+        bx1 = (gbox[:, :, 0] - gbox[:, :, 2] / 2)[:, None, :]
+        bx2 = (gbox[:, :, 0] + gbox[:, :, 2] / 2)[:, None, :]
+        by1 = (gbox[:, :, 1] - gbox[:, :, 3] / 2)[:, None, :]
+        by2 = (gbox[:, :, 1] + gbox[:, :, 3] / 2)[:, None, :]
+        ix1 = jnp.maximum(px1[:, :, None], bx1[..., None, None])
+        ix2 = jnp.minimum(px2[:, :, None], bx2[..., None, None])
+        iy1 = jnp.maximum(py1[:, :, None], by1[..., None, None])
+        iy2 = jnp.minimum(py2[:, :, None], by2[..., None, None])
+        inter = jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0)
+        area_p = (gw * gh)[:, :, None]
+        area_g = (gbox[:, :, 2] * gbox[:, :, 3])[:, None, :, None, None]
+        valid_gt = (gbox[:, :, 2] > 0)[:, None, :, None, None]
+        iou = inter / jnp.maximum(area_p + area_g - inter, 1e-10)
+        iou = jnp.where(valid_gt, iou, 0.0)
+        best_iou = jnp.max(iou, axis=2)               # [N, m, H, W]
+        ignore = best_iou > ignore_thresh
+
+        # anchor assignment per gt: best wh-IoU over ALL anchors
+        bw = gbox[:, :, 2][..., None]                  # [N, B, 1]
+        bh = gbox[:, :, 3][..., None]
+        inter_a = jnp.minimum(bw, an_w) * jnp.minimum(bh, an_h)
+        iou_a = inter_a / jnp.maximum(bw * bh + an_w * an_h - inter_a,
+                                      1e-10)
+        best_anchor = jnp.argmax(iou_a, axis=-1)       # [N, B]
+        # position of each gt in the grid
+        gi = jnp.clip((gbox[:, :, 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gbox[:, :, 1] * H).astype(jnp.int32), 0, H - 1)
+        score = rest[0] if rest else jnp.ones((N, B), xx.dtype)
+
+        loss = jnp.zeros((N,), xx.dtype)
+        obj_target = jnp.zeros((N, n_mask, H, W), xx.dtype)
+        obj_weight = jnp.zeros((N, n_mask, H, W), xx.dtype)
+        smooth_pos = 1.0 - 1.0 / class_num if use_label_smooth and \
+            class_num > 1 else 1.0
+        smooth_neg = 1.0 / class_num if use_label_smooth and \
+            class_num > 1 else 0.0
+        ni = jnp.arange(N)[:, None]
+        for k, a_idx in enumerate(mask_np):
+            resp = (best_anchor == a_idx) & (gbox[:, :, 2] > 0)  # [N, B]
+            wgt = (2.0 - gbox[:, :, 2] * gbox[:, :, 3]) * score
+            # targets at (gj, gi)
+            tgt_x = gbox[:, :, 0] * W - gi
+            tgt_y = gbox[:, :, 1] * H - gj
+            tgt_w = jnp.log(jnp.maximum(gbox[:, :, 2] / an_w[a_idx],
+                                        1e-9))
+            tgt_h = jnp.log(jnp.maximum(gbox[:, :, 3] / an_h[a_idx],
+                                        1e-9))
+            px = tx[:, k][ni, gj, gi]
+            py_ = ty[:, k][ni, gj, gi]
+            pw = tw[:, k][ni, gj, gi]
+            ph = th[:, k][ni, gj, gi]
+
+            def bce(logit, target):
+                return jnp.maximum(logit, 0) - logit * target \
+                    + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+            coord = (bce(px, tgt_x) + bce(py_, tgt_y)
+                     + jnp.abs(pw - tgt_w) + jnp.abs(ph - tgt_h)) * wgt
+            loss = loss + jnp.sum(jnp.where(resp, coord, 0.0), axis=1)
+            # class loss at responsible cells
+            pcls = tcls[:, k][ni, :, gj, gi]           # [N, B, cls]
+            onehot = jax.nn.one_hot(glabel, class_num, dtype=xx.dtype)
+            cls_tgt = onehot * smooth_pos + (1 - onehot) * smooth_neg
+            cls_l = jnp.sum(bce(pcls, cls_tgt), axis=-1) * score
+            loss = loss + jnp.sum(jnp.where(resp, cls_l, 0.0), axis=1)
+            # objectness target map
+            obj_target = obj_target.at[ni, k, gj, gi].max(
+                jnp.where(resp, 1.0, 0.0))
+            obj_weight = obj_weight.at[ni, k, gj, gi].max(
+                jnp.where(resp, score, 0.0))
+        # objectness: positives weighted by score; negatives (not ignored)
+        pos = obj_target > 0
+        neg_ok = (~pos) & (~ignore)
+        obj_bce = jnp.maximum(tobj, 0) - tobj * obj_target \
+            + jnp.log1p(jnp.exp(-jnp.abs(tobj)))
+        obj_l = jnp.where(pos, obj_bce * obj_weight,
+                          jnp.where(neg_ok, obj_bce, 0.0))
+        loss = loss + jnp.sum(obj_l, axis=(1, 2, 3))
+        return loss
+    args = [x, gt_box, gt_label] + ([gt_score] if gt_score is not None
+                                    else [])
+    return run_op("yolo_loss", fn, args)

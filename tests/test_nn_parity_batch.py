@@ -167,7 +167,7 @@ def test_varlen_attention_equals_per_segment():
     total, h, d = 10, 2, 4
     q = RNG.standard_normal((total, h, d)).astype(np.float32)
     cu = np.array([0, 6, 10], np.int64)
-    out = F.flash_attn_unpadded(
+    out, _ = F.flash_attn_unpadded(
         paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
         paddle.to_tensor(cu), paddle.to_tensor(cu), 6, 6, scale=d ** -0.5)
 
@@ -180,9 +180,10 @@ def test_varlen_attention_equals_per_segment():
     np.testing.assert_allclose(
         out.numpy(), np.concatenate([seg(0, 6), seg(6, 10)]), atol=1e-5)
     qkv = RNG.standard_normal((total, 3, h, d)).astype(np.float32)
-    assert F.flash_attn_varlen_qkvpacked(
+    vout, _ = F.flash_attn_varlen_qkvpacked(
         paddle.to_tensor(qkv), paddle.to_tensor(cu), paddle.to_tensor(cu),
-        6, 6).shape == [10, 2, 4]
+        6, 6)
+    assert vout.shape == [10, 2, 4]
 
 
 def test_beam_search_decode():

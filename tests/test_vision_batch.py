@@ -1,0 +1,233 @@
+"""Vision batch: model zoo forward shapes, deform_conv numerics, RoI
+family, detection host ops, folder datasets, text/viterbi, geometric
+sampling, device/audio shims."""
+import os
+import re
+import pathlib
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+REF = pathlib.Path("/root/reference/python/paddle")
+RNG = np.random.default_rng(0)
+
+
+@pytest.mark.skipif(not REF.exists(), reason="reference not mounted")
+@pytest.mark.parametrize("rel,mod", [
+    ("vision/models/__init__.py", paddle.vision.models),
+    ("vision/datasets/__init__.py", paddle.vision.datasets),
+    ("vision/ops.py", paddle.vision.ops),
+    ("text/__init__.py", paddle.text),
+    ("geometric/__init__.py", paddle.geometric),
+    ("device/__init__.py", paddle.device),
+    ("audio/functional/__init__.py", paddle.audio.functional),
+])
+def test_all_parity(rel, mod):
+    m = re.search(r"__all__\s*=\s*\[(.*?)\]", (REF / rel).read_text(),
+                  re.S)
+    ra = set(re.findall(r"'([^']+)'", m.group(1)))
+    missing = sorted(ra - set(dir(mod)))
+    assert not missing, missing
+
+
+@pytest.mark.parametrize("factory,size", [
+    ("alexnet", 224), ("squeezenet1_1", 224), ("densenet121", 64),
+    ("mobilenet_v1", 64), ("mobilenet_v3_small", 64),
+    ("shufflenet_v2_x0_25", 64), ("resnext50_32x4d", 64),
+    ("wide_resnet50_2", 64),
+])
+def test_model_zoo_forward(factory, size):
+    net = getattr(paddle.vision.models, factory)(num_classes=7)
+    net.eval()
+    x = paddle.to_tensor(RNG.standard_normal(
+        (1, 3, size, size)).astype(np.float32))
+    assert net(x).shape == [1, 7]
+
+
+def test_googlenet_heads():
+    g = paddle.vision.models.googlenet(num_classes=5)
+    x = paddle.to_tensor(RNG.standard_normal(
+        (1, 3, 224, 224)).astype(np.float32))
+    g.eval()
+    assert g(x).shape == [1, 5]
+    g.train()
+    out, a1, a2 = g(x)
+    assert out.shape == [1, 5] and a1.shape == [1, 5]
+
+
+def test_deform_conv2d_equals_conv_at_zero_offset():
+    import jax
+    import jax.numpy as jnp
+    x = RNG.standard_normal((2, 4, 8, 8)).astype(np.float32)
+    w = RNG.standard_normal((6, 4, 3, 3)).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 8, 8), np.float32)
+    out = paddle.vision.ops.deform_conv2d(
+        paddle.to_tensor(x), paddle.to_tensor(off), paddle.to_tensor(w),
+        padding=1)
+    want = jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    np.testing.assert_allclose(out.numpy(), np.asarray(want), atol=1e-4)
+    # modulation mask scales linearly
+    msk = np.full((2, 9, 8, 8), 0.5, np.float32)
+    out2 = paddle.vision.ops.deform_conv2d(
+        paddle.to_tensor(x), paddle.to_tensor(off), paddle.to_tensor(w),
+        padding=1, mask=paddle.to_tensor(msk))
+    np.testing.assert_allclose(out2.numpy(), 0.5 * out.numpy(), atol=1e-4)
+
+
+def test_psroi_pool_shape():
+    x = RNG.standard_normal((1, 2 * 2 * 3, 8, 8)).astype(np.float32)
+    boxes = np.array([[0, 0, 7, 7]], np.float32)
+    out = paddle.vision.ops.psroi_pool(
+        paddle.to_tensor(x), paddle.to_tensor(boxes),
+        paddle.to_tensor(np.array([1], np.int32)), 2)
+    assert out.shape == [1, 3, 2, 2]
+
+
+def test_matrix_nms_decays_overlaps():
+    bboxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                        [20, 20, 30, 30]]], np.float32)
+    scores = np.array([[[0.9, 0.85, 0.7]]], np.float32)
+    out, num = paddle.vision.ops.matrix_nms(
+        paddle.to_tensor(bboxes), paddle.to_tensor(scores), 0.1,
+        background_label=-1)
+    sc = {tuple(r[2:4].astype(int)): r[1] for r in out.numpy()}
+    assert sc[(0, 0)] == pytest.approx(0.9)
+    assert sc[(1, 1)] < 0.4          # heavy overlap decayed
+    assert sc[(20, 20)] == pytest.approx(0.7)  # far box untouched
+
+
+def test_generate_proposals_and_yolo_loss():
+    H = W = 4
+    A = 3
+    scores = RNG.random((1, A, H, W)).astype(np.float32)
+    deltas = (RNG.standard_normal((1, 4 * A, H, W)) * 0.1).astype(
+        np.float32)
+    anchors = (RNG.random((H, W, A, 4)) * 10).astype(np.float32)
+    anchors[..., 2:] += 10
+    rois, scs, num = paddle.vision.ops.generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas),
+        paddle.to_tensor(np.array([[32.0, 32.0]], np.float32)),
+        paddle.to_tensor(anchors), paddle.to_tensor(
+            np.ones_like(anchors)), pre_nms_top_n=20, post_nms_top_n=5)
+    assert rois.shape[1] == 4 and num.numpy()[0] <= 5
+    x = RNG.standard_normal((2, 3 * 10, 8, 8)).astype(np.float32)
+    gt_box = np.zeros((2, 4, 4), np.float32)
+    gt_box[:, 0] = [0.5, 0.5, 0.2, 0.3]
+    gt_label = RNG.integers(0, 5, (2, 4)).astype(np.int64)
+    xt = paddle.to_tensor(x, stop_gradient=False)
+    loss = paddle.vision.ops.yolo_loss(
+        xt, paddle.to_tensor(gt_box), paddle.to_tensor(gt_label),
+        [10, 13, 16, 30, 33, 23], [0, 1, 2], 5, 0.7, 32)
+    assert loss.shape == [2] and (loss.numpy() > 0).all()
+    loss.sum().backward()
+    assert np.isfinite(xt.grad.numpy()).all()
+
+
+def test_folder_datasets(tmp_path):
+    from PIL import Image
+    for cls in ["cat", "dog"]:
+        os.makedirs(tmp_path / cls)
+        for i in range(3):
+            Image.fromarray((RNG.random((8, 8, 3)) * 255).astype(
+                np.uint8)).save(tmp_path / cls / f"{i}.png")
+    df = paddle.vision.datasets.DatasetFolder(str(tmp_path))
+    assert len(df) == 6 and df.classes == ["cat", "dog"]
+    assert df[0][1] == 0 and df[5][1] == 1
+    imf = paddle.vision.datasets.ImageFolder(str(tmp_path))
+    assert len(imf) == 6
+    fl = paddle.vision.datasets.Flowers(num_samples=10)
+    assert fl[0][0].shape == (3, 96, 96)
+    img, mask = paddle.vision.datasets.VOC2012(num_samples=5)[0]
+    assert mask.shape == (64, 64)
+
+
+def test_read_file_decode_jpeg(tmp_path):
+    import io
+
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray((RNG.random((16, 16, 3)) * 255).astype(
+        np.uint8)).save(buf, format="JPEG")
+    f = tmp_path / "t.jpg"
+    f.write_bytes(buf.getvalue())
+    raw = paddle.vision.ops.read_file(str(f))
+    assert raw.dtype.name == "uint8"
+    img = paddle.vision.ops.decode_jpeg(raw)
+    assert img.shape == [3, 16, 16]
+
+
+def test_viterbi_decode_matches_brute_force():
+    import itertools
+    B, T, N = 2, 4, 3
+    emis = RNG.standard_normal((B, T, N)).astype(np.float32)
+    trans = RNG.standard_normal((N, N)).astype(np.float32)
+    lens = np.array([4, 4], np.int64)
+    sc, paths = paddle.text.viterbi_decode(
+        paddle.to_tensor(emis), paddle.to_tensor(trans),
+        paddle.to_tensor(lens), include_bos_eos_tag=False)
+    for b in range(B):
+        best, arg = -1e30, None
+        for path in itertools.product(range(N), repeat=T):
+            s = emis[b, 0, path[0]] + sum(
+                trans[path[t - 1], path[t]] + emis[b, t, path[t]]
+                for t in range(1, T))
+            if s > best:
+                best, arg = s, list(path)
+        np.testing.assert_allclose(float(sc.numpy()[b]), best, rtol=1e-5)
+        assert paths.numpy()[b].tolist() == arg
+
+
+def test_text_datasets_and_decoder_layer():
+    for ds in [paddle.text.Imikolov(), paddle.text.Movielens(),
+               paddle.text.WMT14(), paddle.text.WMT16()]:
+        assert len(ds) > 0 and ds[0] is not None
+    seq = paddle.text.Imikolov(data_type="SEQ")
+    src, trg = seq[0]
+    assert len(src) == len(trg)
+    trans = paddle.to_tensor(RNG.standard_normal((4, 4)).astype(
+        np.float32))
+    dec = paddle.text.ViterbiDecoder(trans, include_bos_eos_tag=False)
+    emis = paddle.to_tensor(RNG.standard_normal((1, 3, 4)).astype(
+        np.float32))
+    sc, path = dec(emis, paddle.to_tensor(np.array([3], np.int64)))
+    assert path.shape == [1, 3]
+
+
+def test_geometric_sampling():
+    colptr = np.array([0, 0, 1, 3], np.int64)
+    row = np.array([0, 0, 1], np.int64)
+    nb, cnt = paddle.geometric.sample_neighbors(
+        paddle.to_tensor(row), paddle.to_tensor(colptr),
+        paddle.to_tensor(np.array([2], np.int64)))
+    assert cnt.numpy().tolist() == [2]
+    w = np.array([1.0, 0.5, 0.5])
+    nb2, cnt2 = paddle.geometric.weighted_sample_neighbors(
+        paddle.to_tensor(row), paddle.to_tensor(colptr),
+        paddle.to_tensor(w), paddle.to_tensor(np.array([2], np.int64)),
+        sample_size=1)
+    assert cnt2.numpy().tolist() == [1]
+    uv = paddle.geometric.send_uv(
+        paddle.to_tensor(np.eye(3, dtype=np.float32)),
+        paddle.to_tensor(np.eye(3, dtype=np.float32)),
+        paddle.to_tensor(np.array([0, 1], np.int64)),
+        paddle.to_tensor(np.array([1, 2], np.int64)))
+    assert uv.shape == [2, 3]
+    rs, rd, nodes = paddle.geometric.reindex_graph(
+        paddle.to_tensor(np.array([2, 1], np.int64)), nb, cnt)
+    assert nodes.numpy()[0] == 2
+
+
+def test_device_and_audio_shims():
+    assert paddle.device.get_cudnn_version() is None
+    assert not paddle.device.is_compiled_with_rocm()
+    assert paddle.device.is_compiled_with_distribute()
+    with paddle.device.stream_guard():
+        pass
+    f = paddle.audio.functional.fft_frequencies(16000, 8)
+    np.testing.assert_allclose(f.numpy(), [0, 2000, 4000, 6000, 8000])
+    m = paddle.audio.functional.mel_frequencies(4, 0.0, 8000.0)
+    assert m.shape == [4] and m.numpy()[0] == pytest.approx(0.0)
