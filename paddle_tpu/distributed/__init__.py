@@ -18,7 +18,8 @@ from . import env  # noqa: F401
 from . import fleet  # noqa: F401
 from . import mesh  # noqa: F401
 from .auto_parallel import (  # noqa: F401
-    DistModel, Partial, ProcessMesh, Replicate, Shard, ShardingStage1,
+    DistModel, Partial, Placement, ProcessMesh, Replicate, Shard,
+    ShardingStage1,
     ShardingStage2, ShardingStage3, dtensor_from_fn, parallelize, reshard,
     shard_dataloader, shard_layer, shard_optimizer, shard_tensor,
     to_static, unshard_dtensor,
@@ -35,6 +36,15 @@ from .env import (  # noqa: F401
 )
 from .mesh import (  # noqa: F401
     build_mesh, get_mesh, set_mesh,
+)
+from . import io  # noqa: F401
+from .auto_parallel.high_level import Strategy  # noqa: F401
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+from .compat import (  # noqa: F401
+    CountFilterEntry, DistAttr, InMemoryDataset, ParallelMode,
+    ProbabilityEntry, QueueDataset, ReduceType, ShowClickEntry,
+    broadcast_object_list, gather, gloo_barrier, gloo_init_parallel_env,
+    gloo_release, scatter_object_list, shard_scaler, split,
 )
 
 

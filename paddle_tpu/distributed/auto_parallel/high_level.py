@@ -188,3 +188,48 @@ def _apply_tp_plan(model, plan: Dict[str, str]):
 
     visit(model)
     return model
+
+
+class _ConfigGroup:
+    """One strategy sub-config: attribute bag with defaults; overrides
+    win over defaults."""
+
+    def __init__(self, _overrides=None, **defaults):
+        self.__dict__.update(defaults)
+        self.__dict__.update(_overrides or {})
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.__dict__})"
+
+
+class Strategy:
+    """Auto-parallel strategy config tree (reference:
+    python/paddle/distributed/auto_parallel/strategy.py Strategy — the
+    config carried into dist.to_static). Fields mirror the reference's
+    groups; the compiled path reads sharding/amp/pipeline degrees, the
+    rest are accepted for config compat (XLA already fuses/overlaps what
+    the reference's passes hand-schedule)."""
+
+    def __init__(self, config=None):
+        config = config or {}
+        self.sharding = _ConfigGroup(
+            enable=False, stage=1, degree=8,
+            _overrides=config.get("sharding", {}))
+        self.amp = _ConfigGroup(
+            enable=False, dtype="float16", level="O1",
+            _overrides=config.get("amp", {}))
+        self.pipeline = _ConfigGroup(
+            enable=False, schedule_mode="1F1B", micro_batch_size=1,
+            accumulate_steps=1, _overrides=config.get("pipeline", {}))
+        self.gradient_merge = _ConfigGroup(
+            enable=False, k_steps=1, avg=True,
+            _overrides=config.get("gradient_merge", {}))
+        self.fused_passes = _ConfigGroup(
+            enable=False, fused_passes_list=[],
+            _overrides=config.get("fused_passes", {}))
+        self.recompute = _ConfigGroup(
+            enable=False, _overrides=config.get("recompute", {}))
+
+    def __repr__(self):
+        return (f"Strategy(sharding={self.sharding}, amp={self.amp}, "
+                f"pipeline={self.pipeline})")

@@ -1,0 +1,289 @@
+"""Top-level paddle.distributed compat pieces (reference:
+python/paddle/distributed/__init__.py exports not covered elsewhere:
+parallel modes, gloo bootstrap, the TP `split` mega-op, object
+collectives, DistAttr/ReduceType, the PS dataset config surface).
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+
+class ParallelMode:
+    """Parallelism kind markers (reference: ParallelMode in
+    distributed/parallel.py)."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class ReduceType:
+    """Partial-placement reduce kinds (reference: ReduceType in
+    auto_parallel/placement_type.py)."""
+
+    kRedSum = 0
+    kRedAvg = 1
+    kRedMax = 2
+    kRedMin = 3
+    kRedProd = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class DistAttr:
+    """Legacy dist-attr bundle: mesh + per-dim sharding specs (reference:
+    DistAttr in auto_parallel/api.py — superseded by placements; kept so
+    shard_tensor(dist_attr=...) call sites keep working)."""
+
+    def __init__(self, mesh, sharding_specs):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs)
+
+    def to_placements(self):
+        from .auto_parallel import Replicate, Shard
+        axis_names = list(getattr(self.process_mesh, "dim_names",
+                                  getattr(self.process_mesh, "axis_names",
+                                          [])))
+        placements = [Replicate()] * max(len(axis_names), 1)
+        for dim, spec in enumerate(self.sharding_specs):
+            if spec is not None:
+                placements[axis_names.index(spec)] = Shard(dim)
+        return placements
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """CPU rendezvous (reference: gloo_init_parallel_env). The control
+    plane here is the native TCPStore — gloo's role (host barriers and
+    small CPU collectives) rides on it."""
+    from .store import TCPStore
+    host, port = server_endpoint.rsplit(":", 1)
+    global _gloo_store, _gloo_rank, _gloo_size
+    _gloo_store = TCPStore(host, int(port), world_size=rank_num,
+                           is_master=(rank_id == 0))
+    _gloo_rank, _gloo_size = rank_id, rank_num
+
+
+_gloo_store = None
+_gloo_rank = _gloo_size = 0
+
+
+def gloo_barrier():
+    """Host barrier over the TCPStore (reference: gloo_barrier)."""
+    if _gloo_store is None:
+        raise RuntimeError("call gloo_init_parallel_env first")
+    _gloo_store.barrier()
+
+
+def gloo_release():
+    """Tear down the gloo-compat store (reference: gloo_release)."""
+    global _gloo_store
+    _gloo_store = None
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """The TP mega-op (reference: distributed/parallel.py split): build a
+    row/column-parallel linear or vocab-parallel embedding across the
+    model-parallel axis. Delegates to the fleet mpu layers — the
+    sharding-constraint form of the reference's manual all_gather/
+    identity graphs."""
+    from .fleet.layers.mpu import (ColumnParallelLinear,
+                                   RowParallelLinear,
+                                   VocabParallelEmbedding)
+    if operation == "linear":
+        if axis == 0:
+            layer = RowParallelLinear(
+                size[0], size[1], weight_attr=weight_attr, has_bias=True,
+                input_is_parallel=False)
+        else:
+            layer = ColumnParallelLinear(
+                size[0], size[1], weight_attr=weight_attr, has_bias=True,
+                gather_output=gather_out)
+        return layer(x)
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError("operation must be 'linear' or 'embedding'")
+
+
+def gather(tensor, dst=0, gather_list=None, group=None, sync_op=True):
+    """Collective gather to dst (reference: communication/gather.py).
+    Under SPMD every rank computes the all_gather; non-dst ranks simply
+    drop the result — XLA DCEs the unused branches."""
+    from . import env as env_mod
+    from .communication import all_gather
+    tmp = []
+    all_gather(tmp, tensor, group=group)
+    if gather_list is not None and env_mod.get_rank() == dst:
+        gather_list.clear()
+        gather_list.extend(tmp)
+    return tmp if env_mod.get_rank() == dst else None
+
+
+def _object_to_tensor(obj):
+    import paddle_tpu as paddle
+    data = np.frombuffer(pickle.dumps(obj), np.uint8).copy()
+    return paddle.to_tensor(data), len(data)
+
+
+def _tensor_to_object(t, size):
+    return pickle.loads(bytes(np.asarray(t.numpy()[:size], np.uint8)))
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """Broadcast picklable objects (reference:
+    communication/broadcast.py broadcast_object_list). Single-controller
+    SPMD: every process already holds src's value, so this is the
+    identity — kept for API compat with the multi-controller launcher,
+    where the TCPStore carries the bytes."""
+    from . import env as env_mod
+    from .store import default_store
+    store = default_store()
+    if store is None or env_mod.get_world_size() <= 1:
+        return object_list
+    global _obj_coll_seq
+    _obj_coll_seq += 1
+    key = f"_bcast_obj_{_obj_coll_seq}"  # per-call key: no reuse races
+    if env_mod.get_rank() == src:
+        store.set(key, pickle.dumps(object_list))
+    store.barrier()
+    object_list[:] = pickle.loads(store.get(key))
+    store.barrier()  # everyone has read before src's next call can write
+    return object_list
+
+
+_obj_coll_seq = 0
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Scatter picklable objects (reference: scatter_object_list)."""
+    from . import env as env_mod
+    rank = env_mod.get_rank()
+    world = env_mod.get_world_size()
+    if in_object_list is None:
+        in_object_list = []
+    if world <= 1:
+        out_object_list[:] = in_object_list[:1] if in_object_list else []
+        return out_object_list
+    from .store import default_store
+    store = default_store()
+    global _obj_coll_seq
+    _obj_coll_seq += 1
+    seq = _obj_coll_seq
+    if rank == src:
+        for r in range(world):
+            store.set(f"_scatter_obj_{seq}_{r}",
+                      pickle.dumps(in_object_list[r]))
+    store.barrier()
+    out_object_list[:] = [
+        pickle.loads(store.get(f"_scatter_obj_{seq}_{rank}"))]
+    store.barrier()
+    return out_object_list
+
+
+def shard_scaler(scaler):
+    """Make a GradScaler hybrid-parallel aware (reference:
+    auto_parallel/api.py shard_scaler). Under compiled SPMD the found-inf
+    reduction is already a mesh-wide psum inside the step, so the scaler
+    is returned as-is."""
+    return scaler
+
+
+# -- PS dataset config surface (reference: distributed/entry_attr.py and
+#    fleet/dataset) — config carriers plus a working in-memory loader for
+#    the slot-data text protocol. The parameter-server RUNTIME stays out
+#    of scope (SURVEY §7.1), but pipelines that only read these datasets
+#    work.
+
+class ProbabilityEntry:
+    def __init__(self, probability):
+        self._probability = float(probability)
+
+    def _to_attr(self):
+        return f"probability_entry:{self._probability}"
+
+
+class CountFilterEntry:
+    def __init__(self, count_filter):
+        self._count_filter = int(count_filter)
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self._count_filter}"
+
+
+class ShowClickEntry:
+    def __init__(self, show_name, click_name):
+        self._show_name = show_name
+        self._click_name = click_name
+
+    def _to_attr(self):
+        return f"show_click_entry:{self._show_name}:{self._click_name}"
+
+
+class InMemoryDataset:
+    """Slot-data text dataset held in memory (reference:
+    distributed/fleet/dataset InMemoryDataset): each line is
+    `slot:v ...` tokens produced by MultiSlotDataGenerator."""
+
+    def __init__(self):
+        self._filelist = []
+        self._data = []
+        self._use_vars = []
+        self._batch_size = 1
+        self._thread_num = 1
+
+    def init(self, batch_size=1, thread_num=1, use_var=None, **kw):
+        self._batch_size = batch_size
+        self._thread_num = thread_num
+        self._use_vars = list(use_var or [])
+
+    update_settings = init
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def load_into_memory(self):
+        self._data = []
+        for path in self._filelist:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        self._data.append(line)
+
+    def get_memory_data_size(self):
+        return len(self._data)
+
+    def local_shuffle(self):
+        import random
+        random.shuffle(self._data)
+
+    global_shuffle = local_shuffle
+
+    def release_memory(self):
+        self._data = []
+
+    def __iter__(self):
+        return iter(self._data)
+
+
+class QueueDataset(InMemoryDataset):
+    """Streaming variant: iterates files lazily (reference:
+    QueueDataset)."""
+
+    def load_into_memory(self):
+        raise RuntimeError(
+            "QueueDataset streams from file; use InMemoryDataset to load")
+
+    def __iter__(self):
+        for path in self._filelist:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        yield line

@@ -37,6 +37,10 @@ from .meta_optimizers import (DygraphShardingOptimizer,  # noqa: F401
                               HybridParallelOptimizer)
 from .model import distributed_model  # noqa: F401
 from .optimizer import distributed_optimizer  # noqa: F401
+from .compat import (  # noqa: F401
+    Fleet, MultiSlotDataGenerator, MultiSlotStringDataGenerator, Role,
+    UserDefinedRoleMaker, UtilBase,
+)
 
 _fleet_initialized = False
 _strategy: Optional[DistributedStrategy] = None

@@ -1,0 +1,168 @@
+"""Fleet compat classes (reference: fleet/base/role_maker.py Role /
+UserDefinedRoleMaker, fleet/base/util_factory.py UtilBase,
+fleet/fleet.py Fleet, fleet/data_generator).
+"""
+from __future__ import annotations
+
+import sys
+
+
+class Role:
+    """Worker/server role ids (reference: role_maker.Role)."""
+
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class UserDefinedRoleMaker:
+    """Explicit role assignment (reference: role_maker.
+    UserDefinedRoleMaker). On TPU only collective (all-worker) roles make
+    sense; server roles are carried for config compat."""
+
+    def __init__(self, is_collective=False, current_id=0, role=Role.WORKER,
+                 worker_num=1, server_endpoints=None, **kwargs):
+        self._is_collective = is_collective
+        self._current_id = int(current_id)
+        self._role = role
+        self._worker_num = int(worker_num)
+        self._server_endpoints = list(server_endpoints or [])
+
+    def worker_index(self):
+        return self._current_id
+
+    def worker_num(self):
+        return self._worker_num
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        return self.is_worker() and self._current_id == 0
+
+
+class UtilBase:
+    """Cross-worker utilities (reference: util_factory.UtilBase), over
+    the mesh collectives instead of gloo."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from ..communication import ReduceOp, all_reduce
+        op = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX,
+              "min": ReduceOp.MIN}[mode]
+        t = paddle.to_tensor(np.asarray(input))
+        all_reduce(t, op=op)
+        return t.numpy()
+
+    def barrier(self, comm_world="worker"):
+        from ..communication import barrier
+        barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from ..communication import all_gather
+        out = []
+        all_gather(out, paddle.to_tensor(np.asarray(input)))
+        return [t.numpy() for t in out]
+
+    def get_file_shard(self, files):
+        from .. import env as env_mod
+        rank, world = env_mod.get_rank(), env_mod.get_world_size()
+        return files[rank::world]
+
+    def print_on_rank(self, message, rank_id=0):
+        from .. import env as env_mod
+        if env_mod.get_rank() == rank_id:
+            print(message)
+
+
+class Fleet:
+    """The fleet facade as a class (reference: fleet/fleet.py:218 Fleet;
+    the module-level paddle.distributed.fleet functions are the singleton
+    instance's methods — this class binds the same functions so
+    `Fleet().init(...)` call sites work)."""
+
+    def __init__(self):
+        self._util = UtilBase()
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level=None):
+        from . import init as _init
+        return _init(role_maker=role_maker, is_collective=is_collective,
+                     strategy=strategy, log_level=log_level)
+
+    def distributed_model(self, model):
+        from . import distributed_model as _dm
+        return _dm(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        from . import distributed_optimizer as _do
+        return _do(optimizer, strategy=strategy)
+
+    @property
+    def util(self):
+        return self._util
+
+    def __getattr__(self, name):
+        import paddle_tpu.distributed.fleet as fleet_mod
+        attr = getattr(fleet_mod, name, None)
+        if attr is None:
+            raise AttributeError(name)
+        return attr
+
+
+class MultiSlotDataGenerator:
+    """Slot-data text protocol writer (reference:
+    fleet/data_generator/data_generator.py MultiSlotDataGenerator):
+    generate() yields [(slot_name, [int/float values]), ...] per sample;
+    run_from_stdin/run_from_files emit `slot:n v1 .. vn` lines the PS
+    datasets (and our InMemoryDataset) read."""
+
+    def __init__(self):
+        self._line_limit = None
+
+    def generate_sample(self, line):
+        raise NotImplementedError
+
+    def _format(self, sample):
+        parts = []
+        for name, values in sample:
+            parts.append(f"{len(values)}")
+            parts.extend(str(v) for v in values)
+        return " ".join(parts)
+
+    def run_from_stdin(self):
+        for line in sys.stdin:
+            gen = self.generate_sample(line.rstrip("\n"))
+            for sample in gen():
+                sys.stdout.write(self._format(sample) + "\n")
+
+    def run_from_files(self, filelist, output_file):
+        with open(output_file, "w") as out:
+            for path in filelist:
+                with open(path) as f:
+                    for line in f:
+                        gen = self.generate_sample(line.rstrip("\n"))
+                        for sample in gen():
+                            out.write(self._format(sample) + "\n")
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """String-valued slots variant (reference:
+    MultiSlotStringDataGenerator)."""
+
+    def _format(self, sample):
+        parts = []
+        for name, values in sample:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts)

@@ -1,0 +1,39 @@
+"""paddle.distributed.io (reference: python/paddle/distributed/io.py —
+save/load persistables for distributed training).
+
+The program/executor arguments exist only for signature parity: on TPU a
+"persistable set" is just the Layer's state_dict, saved through the same
+framework.io path every checkpoint uses.
+"""
+from __future__ import annotations
+
+import os
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Save a Layer's persistable state (reference: io.save_persistables;
+    `main_program` carries the Layer here)."""
+    import paddle_tpu as paddle
+    layer = main_program if main_program is not None else executor
+    if not hasattr(layer, "state_dict"):
+        raise TypeError("pass the nn.Layer whose state should be saved "
+                        "as main_program")
+    os.makedirs(dirname, exist_ok=True)
+    path = os.path.join(dirname, filename or "__persistables__")
+    paddle.save(layer.state_dict(), path)
+    return path
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    """Load state saved by save_persistables into the Layer."""
+    import paddle_tpu as paddle
+    layer = main_program if main_program is not None else executor
+    path = os.path.join(dirname, filename or "__persistables__")
+    state = paddle.load(path)
+    layer.set_state_dict(state)
+    return layer
+
+
+def is_persistable(var) -> bool:
+    """(reference: io.is_persistable)"""
+    return bool(getattr(var, "persistable", False))

@@ -155,3 +155,17 @@ class TCPStore:
             self.close()
         except Exception:
             pass
+
+
+_default_store = None
+
+
+def set_default_store(store: "TCPStore") -> None:
+    """Register the process-wide rendezvous store (launcher/env set it)."""
+    global _default_store
+    _default_store = store
+
+
+def default_store():
+    """The process-wide TCPStore, or None when single-process."""
+    return _default_store

@@ -115,51 +115,60 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
     class _End:
         pass
 
+    class _Error:
+        def __init__(self, exc):
+            self.exc = exc
+
     def xreader():
         in_q = Queue(maxsize=buffer_size)
         out_q = Queue(maxsize=buffer_size)
 
         def feed():
-            for i, item in enumerate(reader()):
-                in_q.put((i, item))
-            for _ in range(process_num):
-                in_q.put(_End)
+            try:
+                for i, item in enumerate(reader()):
+                    in_q.put((i, item))
+            except BaseException as e:  # surface in the consumer
+                out_q.put(_Error(e))
+            finally:
+                for _ in range(process_num):
+                    in_q.put(_End)
 
         def work():
-            while True:
-                got = in_q.get()
-                if got is _End:
-                    out_q.put(_End)
-                    return
-                i, item = got
-                out_q.put((i, mapper(item)))
+            try:
+                while True:
+                    got = in_q.get()
+                    if got is _End:
+                        return
+                    i, item = got
+                    out_q.put((i, mapper(item)))
+            except BaseException as e:
+                out_q.put(_Error(e))
+            finally:
+                out_q.put(_End)
 
         Thread(target=feed, daemon=True).start()
         for _ in range(process_num):
             Thread(target=work, daemon=True).start()
         done = 0
-        if order:
-            pending = {}
-            next_i = 0
-            while done < process_num:
-                got = out_q.get()
-                if got is _End:
-                    done += 1
-                    continue
-                i, val = got
+        pending = {}
+        next_i = 0
+        while done < process_num:
+            got = out_q.get()
+            if got is _End:
+                done += 1
+                continue
+            if isinstance(got, _Error):
+                raise got.exc
+            i, val = got
+            if order:
                 pending[i] = val
                 while next_i in pending:
                     yield pending.pop(next_i)
                     next_i += 1
-            for i in sorted(pending):
-                yield pending[i]
-        else:
-            while done < process_num:
-                got = out_q.get()
-                if got is _End:
-                    done += 1
-                    continue
-                yield got[1]
+            else:
+                yield val
+        for i in sorted(pending):
+            yield pending[i]
     return xreader
 
 

@@ -19,13 +19,15 @@ def viterbi_decode(potentials, transition_params, lengths,
     def fn(emis, trans, lens):
         B, T, N = emis.shape
         if include_bos_eos_tag:
-            # reference semantics: last two tags are BOS/EOS; start from
-            # BOS transition row, end adding the EOS column
-            start = trans[N - 2][None, :] + emis[:, 0]
-            stop = trans[:, N - 1][None, :]
+            # reference viterbi_decode_kernel.cc splits transition rows
+            # into {rest: 0..N-3, stop: N-2, start: N-1}: start row seeds
+            # alpha, stop row is added at each sequence's LAST valid step
+            start = trans[N - 1][None, :] + emis[:, 0]
+            stop_row = trans[N - 2][None, :]
+            start = start + jnp.where((lens == 1)[:, None], stop_row, 0.0)
         else:
             start = emis[:, 0]
-            stop = jnp.zeros((1, N), emis.dtype)
+            stop_row = jnp.zeros((1, N), emis.dtype)
 
         def step(carry, t):
             alpha = carry  # [B, N]
@@ -33,13 +35,16 @@ def viterbi_decode(potentials, transition_params, lengths,
             scores = alpha[:, :, None] + trans[None, :, :]
             best_prev = jnp.argmax(scores, axis=1)         # [B, N]
             alpha_t = jnp.max(scores, axis=1) + emis[:, t]
+            if include_bos_eos_tag:
+                alpha_t = alpha_t + jnp.where(
+                    (t == lens - 1)[:, None], stop_row, 0.0)
             # sequences already past their length keep their alpha
             active = (t < lens)[:, None]
             alpha_t = jnp.where(active, alpha_t, alpha)
             return alpha_t, best_prev
 
         alpha, history = jax.lax.scan(step, start, jnp.arange(1, T))
-        final = alpha + stop
+        final = alpha
         scores = jnp.max(final, axis=-1)
         last_tag = jnp.argmax(final, axis=-1)              # [B]
 

@@ -315,19 +315,22 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
         px = base_x[None, None] + off[:, :, :, 1]
 
         def bilinear(img, yy, xx):
-            # img: [cpg, h, w]; yy/xx: [K, ho, wo]
+            # img: [cpg, h, w]; yy/xx: [K, ho, wo]. Reference
+            # DmcnIm2colBilinear semantics: the whole sample is 0 outside
+            # (-1, size); inside, each out-of-range CORNER contributes 0
+            # (no coordinate clamping), so border samples keep partial
+            # bilinear weights.
             inside = (yy > -1.0) & (yy < h) & (xx > -1.0) & (xx < wdt)
-            yy = jnp.clip(yy, 0.0, h - 1)
-            xx = jnp.clip(xx, 0.0, wdt - 1)
             y0 = jnp.floor(yy).astype(jnp.int32)
             x0 = jnp.floor(xx).astype(jnp.int32)
             wy = yy - y0
             wx = xx - x0
 
             def g(yc, xc):
-                yc = jnp.clip(yc, 0, h - 1)
-                xc = jnp.clip(xc, 0, wdt - 1)
-                return img[:, yc, xc]                    # [cpg, K, ho, wo]
+                valid = (yc >= 0) & (yc < h) & (xc >= 0) & (xc < wdt)
+                ycs = jnp.clip(yc, 0, h - 1)
+                xcs = jnp.clip(xc, 0, wdt - 1)
+                return img[:, ycs, xcs] * valid          # [cpg, K, ho, wo]
             val = (g(y0, x0) * (1 - wy) * (1 - wx)
                    + g(y0, x0 + 1) * (1 - wy) * wx
                    + g(y0 + 1, x0) * wy * (1 - wx)
@@ -422,33 +425,40 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
             raise ValueError(
                 f"psroi_pool needs channels divisible by {out_h * out_w}")
         out_c = c // (out_h * out_w)
-        ratio = 2  # dense sub-samples per bin side
 
-        # bins loop in python (out_h/out_w static -> unrolls into one
-        # XLA program; each bin reads its own channel group)
         def one_roi(roi, bidx):
-            x1, y1, x2, y2 = roi * spatial_scale
+            # reference psroi_pool_kernel.cc: start = round(box)*scale,
+            # end = (round(box)+1)*scale; each bin averages EVERY pixel
+            # in [floor(start), ceil(end)) — done here as a masked mean
+            # (static shapes, exact)
+            x1 = jnp.round(roi[0]) * spatial_scale
+            y1 = jnp.round(roi[1]) * spatial_scale
+            x2 = (jnp.round(roi[2]) + 1.0) * spatial_scale
+            y2 = (jnp.round(roi[3]) + 1.0) * spatial_scale
             rw = jnp.maximum(x2 - x1, 0.1)
             rh = jnp.maximum(y2 - y1, 0.1)
+            bin_h = rh / out_h
+            bin_w = rw / out_w
+            ys = jnp.arange(h)
+            xs = jnp.arange(w)
             rows = []
             for i in range(out_h):
                 cols = []
+                hstart = jnp.clip(jnp.floor(y1 + i * bin_h), 0, h)
+                hend = jnp.clip(jnp.ceil(y1 + (i + 1) * bin_h), 0, h)
+                my = (ys >= hstart) & (ys < hend)
                 for j in range(out_w):
-                    ys = y1 + (i + (jnp.arange(ratio) + 0.5) / ratio) \
-                        * rh / out_h
-                    xs = x1 + (j + (jnp.arange(ratio) + 0.5) / ratio) \
-                        * rw / out_w
-                    yi = jnp.clip(ys.astype(jnp.int32), 0, h - 1)
-                    xi = jnp.clip(xs.astype(jnp.int32), 0, w - 1)
-                    # reference psroi_pool_kernel.cc:151 is channel-
-                    # major: out channel c at bin (i, j) reads input
-                    # channel c*oh*ow + i*ow + j
+                    wstart = jnp.clip(jnp.floor(x1 + j * bin_w), 0, w)
+                    wend = jnp.clip(jnp.ceil(x1 + (j + 1) * bin_w), 0, w)
+                    mx = (xs >= wstart) & (xs < wend)
+                    mask = my[:, None] & mx[None, :]
                     group = feat[bidx,
                                  i * out_w + j::out_h * out_w]
-                    patch = group[:, yi][:, :, xi]
-                    cols.append(jnp.mean(patch, axis=(1, 2)))
+                    cnt = jnp.maximum(jnp.sum(mask), 1)
+                    cols.append(jnp.sum(group * mask, axis=(1, 2)) / cnt)
                 rows.append(jnp.stack(cols, axis=-1))
-            return jnp.stack(rows, axis=-2)          # [out_c, oh, ow]
+            return jnp.stack(rows, axis=-2)              # [out_c, oh, ow]
+
         return jax.vmap(one_roi)(rois, batch_idx)
     return run_op("psroi_pool", fn, [x, boxes])
 

@@ -231,3 +231,59 @@ def test_device_and_audio_shims():
     np.testing.assert_allclose(f.numpy(), [0, 2000, 4000, 6000, 8000])
     m = paddle.audio.functional.mel_frequencies(4, 0.0, 8000.0)
     assert m.shape == [4] and m.numpy()[0] == pytest.approx(0.0)
+
+
+def test_viterbi_bos_eos_matches_brute_force():
+    import itertools
+    B, T, N = 2, 4, 5  # last two tags are EOS (N-2) / BOS (N-1)
+    emis = RNG.standard_normal((B, T, N)).astype(np.float32)
+    trans = RNG.standard_normal((N, N)).astype(np.float32)
+    lens = np.array([4, 3], np.int64)
+    sc, paths = paddle.text.viterbi_decode(
+        paddle.to_tensor(emis), paddle.to_tensor(trans),
+        paddle.to_tensor(lens))
+    for b, L in [(0, 4), (1, 3)]:
+        best, arg = -1e30, None
+        for path in itertools.product(range(N), repeat=L):
+            s = trans[N - 1, path[0]] + emis[b, 0, path[0]]
+            for t in range(1, L):
+                s += trans[path[t - 1], path[t]] + emis[b, t, path[t]]
+            s += trans[N - 2, path[L - 1]]
+            if s > best:
+                best, arg = s, list(path)
+        np.testing.assert_allclose(float(sc.numpy()[b]), best, rtol=1e-5)
+        assert paths.numpy()[b][:L].tolist() == arg
+
+
+def test_deform_conv_border_partial_weights():
+    x = np.zeros((1, 1, 4, 4), np.float32)
+    x[0, 0, 0, :] = 2.0
+    w = np.ones((1, 1, 1, 1), np.float32)
+    off = np.zeros((1, 2, 4, 4), np.float32)
+    off[:, 0] = -0.5  # sample at y=-0.5: corner outside contributes 0
+    out = paddle.vision.ops.deform_conv2d(
+        paddle.to_tensor(x), paddle.to_tensor(off),
+        paddle.to_tensor(w)).numpy()
+    np.testing.assert_allclose(out[0, 0, 0, 0], 1.0, atol=1e-6)
+
+
+def test_psroi_exact_bin_mean():
+    feat = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    feat4 = np.tile(feat, (1, 4, 1, 1))
+    for c in range(4):
+        feat4[0, c] = feat[0, 0] + 100 * c
+    out = paddle.vision.ops.psroi_pool(
+        paddle.to_tensor(feat4),
+        paddle.to_tensor(np.array([[0, 0, 3, 3]], np.float32)),
+        paddle.to_tensor(np.array([1], np.int32)), 2).numpy()
+    np.testing.assert_allclose(out[0, 0, 0, 0],
+                               feat[0, 0][0:2, 0:2].mean())
+
+
+def test_xmap_readers_propagates_errors():
+    def bad(v):
+        raise ValueError("boom")
+
+    r = paddle.reader.xmap_readers(bad, lambda: iter(range(3)), 2, 2)
+    with pytest.raises(ValueError):
+        list(r())
