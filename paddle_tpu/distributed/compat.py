@@ -94,14 +94,15 @@ def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
                                    RowParallelLinear,
                                    VocabParallelEmbedding)
     if operation == "linear":
+        has_bias = bias_attr is not False
         if axis == 0:
             layer = RowParallelLinear(
-                size[0], size[1], weight_attr=weight_attr, has_bias=True,
-                input_is_parallel=False)
+                size[0], size[1], weight_attr=weight_attr,
+                has_bias=has_bias, input_is_parallel=False)
         else:
             layer = ColumnParallelLinear(
-                size[0], size[1], weight_attr=weight_attr, has_bias=True,
-                gather_output=gather_out)
+                size[0], size[1], weight_attr=weight_attr,
+                has_bias=has_bias, gather_output=gather_out)
         return layer(x)
     if operation == "embedding":
         layer = VocabParallelEmbedding(size[0], size[1],
@@ -110,7 +111,7 @@ def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
     raise ValueError("operation must be 'linear' or 'embedding'")
 
 
-def gather(tensor, dst=0, gather_list=None, group=None, sync_op=True):
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     """Collective gather to dst (reference: communication/gather.py).
     Under SPMD every rank computes the all_gather; non-dst ranks simply
     drop the result — XLA DCEs the unused branches."""
@@ -172,6 +173,9 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
         return out_object_list
     from .store import default_store
     store = default_store()
+    if store is None:
+        out_object_list[:] = in_object_list[:1] if in_object_list else []
+        return out_object_list
     global _obj_coll_seq
     _obj_coll_seq += 1
     seq = _obj_coll_seq
@@ -242,7 +246,15 @@ class InMemoryDataset:
         self._thread_num = thread_num
         self._use_vars = list(use_var or [])
 
-    update_settings = init
+    def update_settings(self, **kw):
+        """Update ONLY the provided settings (reference
+        fleet/dataset/dataset.py:534 update_settings)."""
+        if "batch_size" in kw:
+            self._batch_size = kw["batch_size"]
+        if "thread_num" in kw:
+            self._thread_num = kw["thread_num"]
+        if "use_var" in kw:
+            self._use_vars = list(kw["use_var"] or [])
 
     def set_filelist(self, filelist):
         self._filelist = list(filelist)

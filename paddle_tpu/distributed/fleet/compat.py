@@ -158,11 +158,5 @@ class MultiSlotDataGenerator:
 
 class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
     """String-valued slots variant (reference:
-    MultiSlotStringDataGenerator)."""
-
-    def _format(self, sample):
-        parts = []
-        for name, values in sample:
-            parts.append(str(len(values)))
-            parts.extend(str(v) for v in values)
-        return " ".join(parts)
+    MultiSlotStringDataGenerator) — the text protocol is identical, the
+    values are just not required to parse as numbers."""

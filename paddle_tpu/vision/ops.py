@@ -431,10 +431,14 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
             # end = (round(box)+1)*scale; each bin averages EVERY pixel
             # in [floor(start), ceil(end)) — done here as a masked mean
             # (static shapes, exact)
-            x1 = jnp.round(roi[0]) * spatial_scale
-            y1 = jnp.round(roi[1]) * spatial_scale
-            x2 = (jnp.round(roi[2]) + 1.0) * spatial_scale
-            y2 = (jnp.round(roi[3]) + 1.0) * spatial_scale
+            # C++ std::round = half away from zero (jnp.round is
+            # half-to-even): sign(x) * floor(|x| + 0.5)
+            def cround(v):
+                return jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5)
+            x1 = cround(roi[0]) * spatial_scale
+            y1 = cround(roi[1]) * spatial_scale
+            x2 = (cround(roi[2]) + 1.0) * spatial_scale
+            y2 = (cround(roi[3]) + 1.0) * spatial_scale
             rw = jnp.maximum(x2 - x1, 0.1)
             rh = jnp.maximum(y2 - y1, 0.1)
             bin_h = rh / out_h
