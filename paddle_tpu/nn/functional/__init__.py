@@ -20,7 +20,7 @@ from .common import (  # noqa: F401
 )
 from .conv import (  # noqa: F401
     conv1d, conv1d_transpose, conv2d, conv2d_transpose, conv3d,
-    conv3d_transpose,
+    conv3d_transpose, depthwise_conv2d_transpose,
 )
 from .loss import (  # noqa: F401
     bce_loss, binary_cross_entropy, binary_cross_entropy_with_logits,

@@ -190,3 +190,16 @@ def conv2d_transpose_bias(x, weight, bias, stride=1, padding=0,
                             padding=padding, output_padding=output_padding,
                             dilation=dilation, groups=groups,
                             data_format=data_format)
+
+
+def depthwise_conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                               output_padding=0, groups=None, dilation=1,
+                               output_size=None, data_format="NCHW",
+                               name=None):
+    """Transposed depthwise conv: groups == in_channels (reference
+    ops.yaml: depthwise_conv2d_transpose)."""
+    from ...core.dispatch import unwrap
+    ch = int(unwrap(x).shape[1 if data_format == "NCHW" else -1])
+    return conv2d_transpose(x, weight, bias, stride, padding,
+                            output_padding, groups or ch, dilation,
+                            output_size, data_format)

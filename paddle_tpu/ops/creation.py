@@ -370,3 +370,13 @@ def log_normal(mean=1.0, std=2.0, shape=None, dtype=None, name=None):
         from . import manipulation as _m
         out = _m.cast(out, dtype)
     return out
+
+
+def uniform_random_batch_size_like(input, shape, min=-1.0, max=1.0,
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   dtype="float32", name=None):
+    """Uniform sample whose output_dim_idx dim copies input's
+    input_dim_idx (reference ops.yaml: uniform_random_batch_size_like)."""
+    shp = [int(s) for s in shape]
+    shp[output_dim_idx] = int(unwrap(input).shape[input_dim_idx])
+    return uniform(shp, dtype, float(min), float(max))

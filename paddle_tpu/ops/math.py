@@ -684,3 +684,55 @@ def positive(x, name=None):
     if a.dtype == jnp.bool_:
         raise TypeError("positive is not supported for bool tensors")
     return run_op("positive", lambda b: +b, [x])
+
+
+def add_position_encoding(x, alpha=1.0, beta=1.0, name=None):
+    """alpha*x + beta*sinusoidal position encoding (reference ops.yaml:
+    add_position_encoding; x: [batch, seq, feat])."""
+    def fn(a):
+        b, t, d = a.shape
+        half = d // 2
+        pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+        div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32)
+                        / (half if half > 0 else 1))
+        ang = pos / div[None, :]
+        pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+        if pe.shape[-1] < d:
+            pe = jnp.pad(pe, [(0, 0), (0, d - pe.shape[-1])])
+        return alpha * a + beta * pe[None].astype(a.dtype)
+    return run_op("add_position_encoding", fn, [x])
+
+
+def edit_distance(hyps, refs, hyp_lengths=None, ref_lengths=None,
+                  normalized=True, ignored_tokens=None, name=None):
+    """Levenshtein distance per sequence pair (reference ops.yaml:
+    edit_distance). Host-side DP like the reference CPU kernel; returns
+    (distances [B, 1], sequence_num)."""
+    from ..core.dispatch import wrap as _wrap
+    h = np.asarray(unwrap(hyps))
+    r = np.asarray(unwrap(refs))
+    hl = np.asarray(unwrap(hyp_lengths)) if hyp_lengths is not None \
+        else np.full(h.shape[0], h.shape[1])
+    rl = np.asarray(unwrap(ref_lengths)) if ref_lengths is not None \
+        else np.full(r.shape[0], r.shape[1])
+    ignored = set(ignored_tokens or [])
+    out = []
+    for b in range(h.shape[0]):
+        hs = [t for t in h[b][:hl[b]].tolist() if t not in ignored]
+        rs = [t for t in r[b][:rl[b]].tolist() if t not in ignored]
+        import builtins
+        m, n = len(hs), len(rs)
+        dp = np.arange(n + 1, dtype=np.float64)
+        for i in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = i
+            for j in range(1, n + 1):
+                dp[j] = builtins.min(
+                    prev[j] + 1, dp[j - 1] + 1,
+                    prev[j - 1] + (hs[i - 1] != rs[j - 1]))
+        d = dp[n]
+        if normalized:
+            d = d / builtins.max(n, 1)
+        out.append(d)
+    return (_wrap(np.asarray(out, np.float32).reshape(-1, 1)),
+            _wrap(np.asarray([h.shape[0]], np.int64)))

@@ -152,7 +152,7 @@ _COVERED_BY = {
     "rnn": "nn.SimpleRNN/LSTM/GRU (lax.scan)",
     "lstm": "nn.LSTM", "gru": "nn.GRU", "gru_unit": "nn.GRUCell",
     "warpctc": "nn.functional.ctc_loss",
-    "warprnnt": "nn.functional.ctc_loss (rnnt variant pending)",
+    "warprnnt": "nn.functional.rnnt_loss",
     "segment_pool": "geometric.segment_sum/mean/max/min",
     "stft": "signal.stft",
     # quantization kernels -> paddle_tpu.quantization.functional
@@ -199,6 +199,14 @@ _COVERED_BY = {
     "view_slice": "ops.manipulation.slice (XLA views)",
     "assign_value_": "ops.manipulation.assign_value_",
     "assign_out_": "ops.manipulation.assign_out_",
+    # kernels whose implementation lives under a DIFFERENT name or a
+    # namespace outside the op registry (same-named ops register
+    # directly through ops.yaml and never reach this table)
+    "deformable_conv": "vision.ops.deform_conv2d / DeformConv2D",
+    "unpool": "nn.functional.max_unpool2d",
+    "unpool3d": "nn.functional.max_unpool3d",
+    "graph_khop_sampler": "incubate.graph_khop_sampler",
+    "graph_sample_neighbors": "incubate.graph_sample_neighbors",
 }
 
 

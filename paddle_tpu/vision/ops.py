@@ -789,3 +789,310 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
     args = [x, gt_box, gt_label] + ([gt_score] if gt_score is not None
                                     else [])
     return run_op("yolo_loss", fn, args)
+
+
+def affine_channel(x, scale, bias, data_format="NCHW", name=None):
+    """Per-channel affine y = scale*x + bias (reference ops.yaml:
+    affine_channel)."""
+    def fn(a, s, b):
+        if data_format == "NCHW":
+            shape = (1, -1) + (1,) * (a.ndim - 2)
+        else:
+            shape = (1,) * (a.ndim - 1) + (-1,)
+        return a * s.reshape(shape) + b.reshape(shape)
+    return run_op("affine_channel", fn, [x, scale, bias])
+
+
+def box_clip(input, im_info, name=None):
+    """Clip boxes to image bounds (reference ops.yaml: box_clip;
+    im_info rows are [H, W, scale])."""
+    def fn(b, info):
+        info2 = info.reshape(-1, info.shape[-1])
+        h = info2[:, 0] / info2[:, 2] - 1.0
+        w = info2[:, 1] / info2[:, 2] - 1.0
+        if b.ndim == 3:
+            # batched [N, M, 4]: one limit per image
+            h = h[:, None]
+            w = w[:, None]
+        else:
+            # flat [M, 4]: single image -> scalar limits
+            h = h[0]
+            w = w[0]
+        x1 = jnp.clip(b[..., 0], 0, None)
+        y1 = jnp.clip(b[..., 1], 0, None)
+        x2 = b[..., 2]
+        y2 = b[..., 3]
+        return jnp.stack([jnp.minimum(x1, w), jnp.minimum(y1, h),
+                          jnp.clip(jnp.minimum(x2, w), 0, None),
+                          jnp.clip(jnp.minimum(y2, h), 0, None)], axis=-1)
+    return run_op("box_clip", fn, [input, im_info])
+
+
+def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5,
+                    name=None):
+    """Greedy bipartite matching of a [M, N] distance matrix (reference
+    ops.yaml: bipartite_match kernel's greedy algorithm). Returns
+    (match_indices [1, N], match_distances [1, N]); per_prediction mode
+    additionally matches leftover columns above the threshold."""
+    from ..core.dispatch import wrap
+    d = np.array(unwrap(dist_matrix), np.float64)
+    m, n_ = d.shape
+    idx = np.full(n_, -1, np.int64)
+    dist = np.zeros(n_, np.float64)
+    work = d.copy()
+    # greedy global-max assignment, one row to one column
+    for _ in range(min(m, n_)):
+        r, c = np.unravel_index(np.argmax(work), work.shape)
+        if work[r, c] <= 0:
+            break
+        idx[c] = r
+        dist[c] = d[r, c]
+        work[r, :] = -1
+        work[:, c] = -1
+    if match_type == "per_prediction":
+        for c in range(n_):
+            if idx[c] == -1:
+                r = int(np.argmax(d[:, c]))
+                if d[r, c] >= dist_threshold:
+                    idx[c] = r
+                    dist[c] = d[r, c]
+    return (wrap(idx.reshape(1, -1)),
+            wrap(dist.astype(np.float32).reshape(1, -1)))
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    """Merge per-FPN-level proposals, keep top-N by score PER IMAGE
+    (reference ops.yaml: collect_fpn_proposals). Host-side (dynamic
+    count). rois_num_per_level: per-level [batch] counts; without it the
+    whole input is one image."""
+    from ..core.dispatch import wrap
+    rois_l = [np.asarray(unwrap(r)) for r in multi_rois]
+    scores_l = [np.asarray(unwrap(s)).reshape(-1) for s in multi_scores]
+    if rois_num_per_level is None:
+        rois = np.concatenate(rois_l)
+        scores = np.concatenate(scores_l)
+        order = np.argsort(-scores)[:post_nms_top_n]
+        return wrap(rois[order].astype(np.float32))
+    counts_l = [np.asarray(unwrap(c)).astype(np.int64).reshape(-1)
+                for c in rois_num_per_level]
+    batch = len(counts_l[0])
+    out_rois, out_counts = [], []
+    for b in range(batch):
+        rs, ss = [], []
+        for rois, scores, counts in zip(rois_l, scores_l, counts_l):
+            beg = int(counts[:b].sum())
+            end = beg + int(counts[b])
+            rs.append(rois[beg:end])
+            ss.append(scores[beg:end])
+        rs = np.concatenate(rs)
+        ss = np.concatenate(ss)
+        order = np.argsort(-ss)[:post_nms_top_n]
+        out_rois.append(rs[order])
+        out_counts.append(len(order))
+    return (wrap(np.concatenate(out_rois).astype(np.float32)),
+            wrap(np.asarray(out_counts, np.int32)))
+
+
+def multiclass_nms3(bboxes, scores, rois_num=None, score_threshold=0.05,
+                    nms_top_k=1000, keep_top_k=100, nms_threshold=0.3,
+                    normalized=True, nms_eta=1.0, background_label=-1,
+                    return_index=False, name=None):
+    """Per-class hard NMS over [N, M, 4] boxes / [N, C, M] scores
+    (reference ops.yaml: multiclass_nms3). Output rows are
+    [label, score, x1, y1, x2, y2] like the kernel."""
+    from ..core.dispatch import wrap
+    b_np = np.asarray(unwrap(bboxes))
+    s_np = np.asarray(unwrap(scores))
+    off = 0.0 if normalized else 1.0
+    outs, indices, counts = [], [], []
+    for n in range(b_np.shape[0]):
+        per_img = []
+        for c in range(s_np.shape[1]):
+            if c == background_label:
+                continue
+            sc = s_np[n, c]
+            keep = np.where(sc > score_threshold)[0]
+            order = keep[np.argsort(-sc[keep])][:nms_top_k]
+            boxes_c = b_np[n, order]
+            kept = []
+            thr = nms_threshold
+            cand = list(range(len(order)))
+            while cand:
+                i = cand.pop(0)
+                kept.append(i)
+                if not cand:
+                    break
+                bi = boxes_c[i]
+                rest = boxes_c[cand]
+                xx1 = np.maximum(bi[0], rest[:, 0])
+                yy1 = np.maximum(bi[1], rest[:, 1])
+                xx2 = np.minimum(bi[2], rest[:, 2])
+                yy2 = np.minimum(bi[3], rest[:, 3])
+                inter = (np.clip(xx2 - xx1 + off, 0, None)
+                         * np.clip(yy2 - yy1 + off, 0, None))
+                ai = (bi[2] - bi[0] + off) * (bi[3] - bi[1] + off)
+                ar = ((rest[:, 2] - rest[:, 0] + off)
+                      * (rest[:, 3] - rest[:, 1] + off))
+                iou = inter / np.maximum(ai + ar - inter, 1e-10)
+                cand = [c2 for k, c2 in enumerate(cand) if iou[k] <= thr]
+                if nms_eta < 1.0 and thr > 0.5:
+                    thr *= nms_eta
+            for i in kept:
+                per_img.append((c, sc[order[i]], *boxes_c[i],
+                                n * b_np.shape[1] + order[i]))
+        per_img.sort(key=lambda r: -r[1])
+        per_img = per_img[:keep_top_k]
+        counts.append(len(per_img))
+        for r in per_img:
+            outs.append(r[:6])
+            indices.append(r[6])
+    out = wrap(np.asarray(outs, np.float32).reshape(-1, 6))
+    res = [out]
+    if return_index:
+        res.append(wrap(np.asarray(indices, np.int64)))
+    res.append(wrap(np.asarray(counts, np.int32)))
+    return tuple(res)
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  ap_version="integral", name=None):
+    """Mean average precision over detection results (reference ops.yaml:
+    detection_map). detect_res rows: [label, score, x1, y1, x2, y2];
+    label rows: [label, x1, y1, x2, y2(, difficult)]. Single-image host
+    evaluation like the reference CPU kernel's core loop."""
+    from ..core.dispatch import wrap
+    det = np.asarray(unwrap(detect_res), np.float64).reshape(-1, 6)
+    gt = np.asarray(unwrap(label), np.float64)
+    has_difficult = gt.shape[1] >= 6
+    difficult = gt[:, 5].astype(bool) if has_difficult \
+        else np.zeros(len(gt), bool)
+    if not evaluate_difficult:
+        gt = gt[~difficult]
+    aps = []
+    for c in range(class_num):
+        if c == background_label:
+            continue
+        d_c = det[det[:, 0] == c]
+        g_c = gt[gt[:, 0] == c]
+        if len(g_c) == 0:
+            continue
+        order = np.argsort(-d_c[:, 1])
+        d_c = d_c[order]
+        matched = np.zeros(len(g_c), bool)
+        tp = np.zeros(len(d_c))
+        fp = np.zeros(len(d_c))
+        for i, row in enumerate(d_c):
+            best_iou, best_j = 0.0, -1
+            for j, g in enumerate(g_c):
+                xx1 = max(row[2], g[1])
+                yy1 = max(row[3], g[2])
+                xx2 = min(row[4], g[3])
+                yy2 = min(row[5], g[4])
+                inter = max(xx2 - xx1, 0) * max(yy2 - yy1, 0)
+                a1 = (row[4] - row[2]) * (row[5] - row[3])
+                a2 = (g[3] - g[1]) * (g[4] - g[2])
+                iou = inter / max(a1 + a2 - inter, 1e-10)
+                if iou > best_iou:
+                    best_iou, best_j = iou, j
+            if best_iou >= overlap_threshold and not matched[best_j]:
+                tp[i] = 1
+                matched[best_j] = True
+            else:
+                fp[i] = 1
+        ctp = np.cumsum(tp)
+        cfp = np.cumsum(fp)
+        rec = ctp / len(g_c)
+        prec = ctp / np.maximum(ctp + cfp, 1e-10)
+        if ap_version == "11point":
+            ap = np.mean([prec[rec >= t].max() if (rec >= t).any() else 0
+                          for t in np.linspace(0, 1, 11)])
+        else:  # integral
+            ap = 0.0
+            prev_r = 0.0
+            for r, p2 in zip(rec, prec):
+                ap += (r - prev_r) * p2
+                prev_r = r
+        aps.append(ap)
+    m = float(np.mean(aps)) if aps else 0.0
+    return wrap(np.asarray(m, np.float32))
+
+
+def yolo_box_head(x, anchors, class_num, name=None):
+    """YOLO head passthrough (reference ops.yaml: yolo_box_head — the
+    fused CUDA graph just forwards activations to yolo_box_post)."""
+    return x
+
+
+def yolo_box_post(boxes0, boxes1, boxes2, image_shape, image_scale,
+                  anchors0, anchors1, anchors2, class_num, conf_thresh,
+                  downsample_ratio0, downsample_ratio1, downsample_ratio2,
+                  clip_bbox=True, scale_x_y=1.0, nms_threshold=0.45,
+                  name=None):
+    """Decode 3 YOLO feature maps + NMS (reference ops.yaml:
+    yolo_box_post): yolo_box per level, concat, per-class NMS."""
+    from ..core.dispatch import wrap
+    from ..ops.manipulation import concat
+    levels = [(boxes0, anchors0, downsample_ratio0),
+              (boxes1, anchors1, downsample_ratio1),
+              (boxes2, anchors2, downsample_ratio2)]
+    all_boxes, all_scores = [], []
+    img_shape = unwrap(image_shape)
+    for feat, anc, ds in levels:
+        b, s = yolo_box(feat, wrap(jnp.asarray(img_shape)), list(anc),
+                        class_num, conf_thresh, ds, clip_bbox,
+                        scale_x_y=scale_x_y)
+        all_boxes.append(b)
+        all_scores.append(s)
+    boxes = concat(all_boxes, axis=1)            # [N, sumM, 4]
+    scores = concat(all_scores, axis=1)          # [N, sumM, C]
+    # rescale to original-image coordinates (reference divides by scale)
+    scale = np.asarray(unwrap(image_scale), np.float32).reshape(-1)
+    boxes_np = np.asarray(unwrap(boxes)) / scale[:, None, None]
+    scores_t = np.asarray(unwrap(scores)).transpose(0, 2, 1)
+    return multiclass_nms3(wrap(boxes_np), wrap(scores_t),
+                           score_threshold=conf_thresh,
+                           nms_threshold=nms_threshold)
+
+
+def correlation(x, y, pad_size, kernel_size, max_displacement, stride1,
+                stride2, corr_type_multiply=1, name=None):
+    """FlowNet correlation layer (reference ops.yaml: correlation): for
+    each displacement, the channel-patch inner product between x and the
+    displaced y, averaged over channels * kernel_size^2. Displacements
+    are static python unrolls -> one fused XLA program; shifts slice a
+    zero-padded copy (reference zero-padding semantics, no wraparound)."""
+    def fn(a, b):
+        n, c, h, w = a.shape
+        d = max_displacement // stride2
+        k = kernel_size
+        # pad a by pad_size; pad b by pad_size + max displacement so any
+        # shifted window reads zeros, never wrapped pixels
+        ap = jnp.pad(a, [(0, 0), (0, 0), (pad_size, pad_size),
+                         (pad_size, pad_size)])
+        m = max_displacement
+        bp = jnp.pad(b, [(0, 0), (0, 0), (pad_size + m, pad_size + m),
+                         (pad_size + m, pad_size + m)])
+        h2, w2 = ap.shape[2], ap.shape[3]
+        outs = []
+        for dy in range(-d, d + 1):
+            for dx in range(-d, d + 1):
+                oy, ox = dy * stride2, dx * stride2
+                b_shift = bp[:, :, m + oy:m + oy + h2,
+                             m + ox:m + ox + w2]
+                prod = jnp.mean(ap * b_shift, axis=1)    # [n, h2, w2]
+                if k > 1:
+                    # patch mean over the k x k window (SAME padding)
+                    prod = jax.lax.reduce_window(
+                        prod, jnp.asarray(0.0, prod.dtype),
+                        jax.lax.add, (1, k, k), (1, 1, 1),
+                        "SAME") / (k * k)
+                outs.append(prod[:, pad_size:pad_size + h,
+                                 pad_size:pad_size + w])
+        out = jnp.stack(outs, axis=1)                    # [n, D*D, h, w]
+        if stride1 > 1:
+            out = out[:, :, ::stride1, ::stride1]
+        return out
+    return run_op("correlation", fn, [x, y])

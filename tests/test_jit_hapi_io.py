@@ -116,6 +116,9 @@ def test_distributed_batch_sampler():
 def test_model_fit_evaluate_predict(tmp_path):
     from paddle_tpu.vision.datasets import MNIST
     from paddle_tpu.vision.models import LeNet
+    # fix the init/shuffle stream: earlier tests advance the global RNG
+    # and some init draws land LeNet in a slow-converging basin
+    paddle.seed(1234)
     train = MNIST(mode="train")
     train.images = train.images[:512]
     train.labels = train.labels[:512]

@@ -287,3 +287,58 @@ def test_xmap_readers_propagates_errors():
     r = paddle.reader.xmap_readers(bad, lambda: iter(range(3)), 2, 2)
     with pytest.raises(ValueError):
         list(r())
+
+
+def test_new_detection_ops():
+    rng = np.random.default_rng(3)
+    # correlation vs naive (patch mean + zero-pad shifts)
+    a = rng.standard_normal((1, 2, 6, 6)).astype(np.float32)
+    b = rng.standard_normal((1, 2, 6, 6)).astype(np.float32)
+    got = paddle.vision.ops.correlation(
+        paddle.to_tensor(a), paddle.to_tensor(b), 2, 3, 2, 1, 1).numpy()
+    pad, k, md = 2, 3, 2
+    ap = np.pad(a, [(0, 0), (0, 0), (pad, pad), (pad, pad)])
+    bp = np.pad(b, [(0, 0), (0, 0), (pad + md, pad + md),
+                    (pad + md, pad + md)])
+    H2, W2 = ap.shape[2], ap.shape[3]
+    outs = []
+    for dy in range(-md, md + 1):
+        for dx in range(-md, md + 1):
+            bs = bp[:, :, md + dy:md + dy + H2, md + dx:md + dx + W2]
+            prod = (ap * bs).mean(axis=1)
+            pp = np.pad(prod, [(0, 0), (1, 1), (1, 1)])
+            sm = np.zeros_like(prod)
+            for u in range(k):
+                for v in range(k):
+                    sm += pp[:, u:u + H2, v:v + W2]
+            outs.append((sm / 9)[:, pad:pad + 6, pad:pad + 6])
+    np.testing.assert_allclose(got, np.stack(outs, 1), atol=1e-5)
+    # box_clip keeps rank for 2-D input
+    bc = paddle.vision.ops.box_clip(
+        paddle.to_tensor(np.array([[-5., -5., 100., 100.]], np.float32)),
+        paddle.to_tensor(np.array([[50., 60., 1.]], np.float32)))
+    assert bc.shape == [1, 4]
+    np.testing.assert_allclose(bc.numpy(), [[0, 0, 59, 49]])
+    # collect_fpn per-image budgets
+    mr = [paddle.to_tensor(rng.random((6, 4)).astype(np.float32))]
+    ms = [paddle.to_tensor(rng.random((6,)).astype(np.float32))]
+    cnt = [paddle.to_tensor(np.array([4, 2], np.int64))]
+    rois, num = paddle.vision.ops.collect_fpn_proposals(
+        mr, ms, 2, 5, 3, rois_num_per_level=cnt)
+    assert num.numpy().tolist() == [3, 2]
+    # detection_map difficult exclusion
+    det = np.array([[1, 0.9, 0, 0, 10, 10]], np.float32)
+    gt = np.array([[1, 0, 0, 10, 10, 0]], np.float32)
+    m = float(paddle.vision.ops.detection_map(
+        paddle.to_tensor(det), paddle.to_tensor(gt), 2,
+        evaluate_difficult=False).numpy())
+    assert m == pytest.approx(1.0)
+    # multiclass_nms3 + bipartite + edit distance basics
+    mi, _ = paddle.vision.ops.bipartite_match(
+        paddle.to_tensor(np.array([[0.9, 0.1], [0.2, 0.8]], np.float32)))
+    assert mi.numpy().tolist() == [[0, 1]]
+    d, _ = paddle.edit_distance(
+        paddle.to_tensor(np.array([[1, 2, 3]], np.int64)),
+        paddle.to_tensor(np.array([[1, 3, 3]], np.int64)),
+        normalized=False)
+    assert float(d.numpy()[0, 0]) == 1.0
