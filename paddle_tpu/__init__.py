@@ -40,7 +40,7 @@ from .ops.manipulation import *  # noqa: F401,F403,E402
 from .ops.logic import *  # noqa: F401,F403,E402
 from .ops.search import *  # noqa: F401,F403,E402
 from .ops.stat import *  # noqa: F401,F403,E402
-from .ops import linalg  # noqa: F401,E402
+from . import linalg  # noqa: E402  (real module: import paddle.linalg works)
 from .ops.linalg import norm, einsum  # noqa: F401,E402
 from .ops.linalg import cdist, pdist, matrix_transpose  # noqa: F401,E402
 from .ops.math import matmul, mm, bmm, mv, dot, pow  # noqa: F401,E402

@@ -196,3 +196,34 @@ def compare_accuracy(dump_path, another_dump_path, output_filename,
             w.writeheader()
             w.writerows(rows)
     return rows
+
+
+def check_layer_numerics(func):
+    """Decorator for Layer.forward: assert inputs/outputs finite
+    (reference: amp/debugging.py check_layer_numerics)."""
+    import functools
+
+    import numpy as np
+
+    from ..core.dispatch import unwrap as _unwrap
+    from ..core.tensor import Tensor as _Tensor
+
+    @functools.wraps(func)
+    def wrapper(self, *args, **kwargs):
+        named = list(enumerate(args)) + list(kwargs.items())
+        for i, a in named:
+            if isinstance(a, _Tensor) and \
+                    not bool(np.isfinite(np.asarray(_unwrap(a))).all()):
+                raise RuntimeError(
+                    f"check_layer_numerics: input {i} of "
+                    f"{type(self).__name__} has nan/inf")
+        out = func(self, *args, **kwargs)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        for i, o in enumerate(outs):
+            if isinstance(o, _Tensor) and \
+                    not bool(np.isfinite(np.asarray(_unwrap(o))).all()):
+                raise RuntimeError(
+                    f"check_layer_numerics: output {i} of "
+                    f"{type(self).__name__} has nan/inf")
+        return out
+    return wrapper

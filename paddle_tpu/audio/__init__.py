@@ -2,3 +2,9 @@
 features/functional over the stft kernels)."""
 from . import features  # noqa: F401
 from . import functional  # noqa: F401
+from . import backends  # noqa: F401,E402
+from . import datasets  # noqa: F401,E402
+from .backends import info, load, save  # noqa: F401,E402
+
+__all__ = ["functional", "features", "datasets", "backends", "load",
+           "info", "save"]

@@ -2,7 +2,9 @@
 re-exports the hapi callback classes)."""
 from .hapi.callbacks import (  # noqa: F401
     Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+    ReduceLROnPlateau, VisualDL, WandbCallback,
 )
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-           "EarlyStopping"]
+           "EarlyStopping", "ReduceLROnPlateau", "VisualDL",
+           "WandbCallback"]

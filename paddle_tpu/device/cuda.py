@@ -1,0 +1,33 @@
+"""paddle.device.cuda as an importable module (reference:
+python/paddle/device/cuda): the compat shims map onto the TPU device."""
+from . import _CudaNamespace as _NS
+from .monitor import (  # noqa: F401
+    max_memory_allocated, max_memory_reserved, memory_allocated,
+    memory_reserved,
+)
+
+from . import _sync as _sync_impl
+
+_ns = _NS()
+
+
+def synchronize(device=None):
+    _sync_impl()
+
+
+device_count = _ns.device_count
+empty_cache = _ns.empty_cache
+get_device_properties = _ns.get_device_properties
+get_device_name = _ns.get_device_name
+get_device_capability = _ns.get_device_capability
+
+
+def current_stream(device=None):
+    """Streams are XLA-managed; a token object for API compat."""
+    from . import Stream
+    return Stream()
+
+
+def stream_guard(stream):
+    from . import stream_guard as _sg
+    return _sg(stream)

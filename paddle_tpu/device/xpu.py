@@ -1,0 +1,9 @@
+"""paddle.device.xpu module compat — same shims as device.cuda
+(reference: python/paddle/device/xpu)."""
+from .cuda import *  # noqa: F401,F403
+from .cuda import device_count, empty_cache  # noqa: F401
+
+
+def synchronize(device=None):
+    import jax
+    (jax.device_put(0) + 0).block_until_ready()

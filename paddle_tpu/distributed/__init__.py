@@ -38,6 +38,7 @@ from .mesh import (  # noqa: F401
     build_mesh, get_mesh, set_mesh,
 )
 from . import io  # noqa: F401
+from . import sharding  # noqa: F401
 from .auto_parallel.high_level import Strategy  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from .compat import (  # noqa: F401

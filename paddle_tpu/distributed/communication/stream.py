@@ -65,3 +65,9 @@ def send(tensor, dst=0, group=None, sync_op=True, **kw):
 
 def recv(tensor, src=0, group=None, sync_op=True, **kw):
     return _c.recv(tensor, src=src, group=group, sync_op=sync_op)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True,
+           **kw):
+    from ..compat import gather as _gather
+    return _gather(tensor, gather_list, dst, group, sync_op)

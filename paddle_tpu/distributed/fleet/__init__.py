@@ -107,4 +107,4 @@ def stop_worker():
     pass
 
 
-utils = None  # populated lazily by fleet.utils import
+from . import utils  # noqa: F401,E402,F811  (the real subpackage)

@@ -1,2 +1,96 @@
 from ..recompute import recompute, recompute_sequential  # noqa: F401
 from . import sequence_parallel_utils  # noqa: F401
+
+
+class LocalFS:
+    """Local filesystem client (reference: fleet/utils/fs.py LocalFS) —
+    the checkpoint/elastic code's FS abstraction."""
+
+    def ls_dir(self, fs_path):
+        import os
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, name))
+             else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        import os
+        os.makedirs(fs_path, exist_ok=True)
+
+    def is_dir(self, fs_path):
+        import os
+        return os.path.isdir(fs_path)
+
+    def is_file(self, fs_path):
+        import os
+        return os.path.isfile(fs_path)
+
+    def is_exist(self, fs_path):
+        import os
+        return os.path.exists(fs_path)
+
+    def delete(self, fs_path):
+        import os
+        import shutil
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path)
+        elif os.path.exists(fs_path):
+            os.remove(fs_path)
+
+    def rename(self, src, dst):
+        import os
+        os.rename(src, dst)
+
+    def mv(self, src, dst, overwrite=False, test_exists=True):
+        import os
+        if not overwrite and os.path.exists(dst):
+            raise FileExistsError(dst)
+        os.replace(src, dst)
+
+    def upload(self, local_path, fs_path):
+        import shutil
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        import shutil
+        shutil.copy(fs_path, local_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        import os
+        if not exist_ok and os.path.exists(fs_path):
+            raise FileExistsError(fs_path)
+        open(fs_path, "a").close()
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient:
+    """HDFS client stub (reference: fleet/utils/fs.py HDFSClient wraps
+    the hadoop CLI); constructing raises unless a hadoop binary exists."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=300000,
+                 sleep_inter=1000):
+        import shutil
+        hadoop = shutil.which("hadoop") if hadoop_home is None else \
+            hadoop_home
+        if not hadoop:
+            raise RuntimeError(
+                "HDFSClient needs a hadoop installation (hadoop binary "
+                "not found); use LocalFS for local checkpoints")
+        self._hadoop = hadoop
+
+
+class DistributedInfer:
+    """Distributed inference helper (reference:
+    fleet/utils/__init__.py DistributedInfer — a PS-era wrapper that
+    swaps programs for inference). Dygraph form: eval() the layer."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        self._layer = main_program
+
+    def get_dist_infer_program(self):
+        if self._layer is not None and hasattr(self._layer, "eval"):
+            self._layer.eval()
+        return self._layer

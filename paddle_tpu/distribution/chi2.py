@@ -1,0 +1,4 @@
+"""Module alias (reference: distribution/chi2.py)."""
+from .distributions import Chi2  # noqa: F401
+
+__all__ = ["Chi2"]

@@ -1,0 +1,4 @@
+"""Module alias (reference: distribution/kl.py)."""
+from .distributions import kl_divergence, register_kl  # noqa: F401
+
+__all__ = ["kl_divergence", "register_kl"]

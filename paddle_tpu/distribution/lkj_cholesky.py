@@ -1,0 +1,4 @@
+"""Module alias (reference: distribution/lkj_cholesky.py)."""
+from .distributions import LKJCholesky  # noqa: F401
+
+__all__ = ["LKJCholesky"]

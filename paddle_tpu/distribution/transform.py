@@ -1,0 +1,280 @@
+"""Probability transforms (reference: python/paddle/distribution/
+transform.py — Transform + 12 concrete bijectors/injections).
+
+Each transform supplies forward / inverse / forward_log_det_jacobian as
+pure jnp math over Tensors (differentiable through the tape)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import run_op, unwrap, wrap
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+class Transform:
+    """Base transform (reference transform.py Transform)."""
+
+    _event_rank = 0
+
+    def forward(self, x):
+        return run_op(type(self).__name__ + "_fwd", self._forward, [x])
+
+    def inverse(self, y):
+        return run_op(type(self).__name__ + "_inv", self._inverse, [y])
+
+    def forward_log_det_jacobian(self, x):
+        return run_op(type(self).__name__ + "_fldj",
+                      self._forward_log_det_jacobian, [x])
+
+    def inverse_log_det_jacobian(self, y):
+        def fn(yv):
+            return -self._forward_log_det_jacobian(self._inverse(yv))
+        return run_op(type(self).__name__ + "_ildj", fn, [y])
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    def __call__(self, x):
+        return self.forward(x)
+
+    # subclasses implement the jnp-level versions
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # principal branch, like the reference
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(unwrap(loc))
+        self.scale = jnp.asarray(unwrap(scale))
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = jnp.asarray(unwrap(power))
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh(x)^2) = 2*(log2 - x - softplus(-2x))
+        return 2.0 * (jnp.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """x -> softmax(x) over the last axis (not bijective; inverse is the
+    log left-inverse, like the reference)."""
+
+    _event_rank = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(int(s) for s in in_event_shape)
+        self.out_event_shape = tuple(int(s) for s in out_event_shape)
+        self._event_rank = len(self.in_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+    def forward_shape(self, shape):
+        k = len(self.in_event_shape)
+        return tuple(shape[:-k]) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        k = len(self.out_event_shape)
+        return tuple(shape[:-k]) + self.in_event_shape
+
+
+class StickBreakingTransform(Transform):
+    """Unconstrained R^k -> open (k+1)-simplex (reference
+    StickBreakingTransform)."""
+
+    _event_rank = 1
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        cum = jnp.cumprod(1 - z, axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones_like(cum[..., :1]), cum[..., :-1]], axis=-1)
+        first = z * lead
+        last = cum[..., -1:]
+        return jnp.concatenate([first, last], axis=-1)
+
+    def _inverse(self, y):
+        k = y.shape[-1] - 1
+        cum = 1 - jnp.cumsum(y[..., :-1], axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones_like(cum[..., :1]), cum[..., :-1]], axis=-1)
+        z = y[..., :-1] / jnp.maximum(lead, 1e-30)
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=y.dtype))
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def _forward_log_det_jacobian(self, x):
+        # triangular jacobian: det = prod_i sigmoid'(t_i) * lead_i
+        k = x.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=x.dtype))
+        t = x - offset
+        z = jax.nn.sigmoid(t)
+        cum = jnp.cumprod(1 - z, axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones_like(cum[..., :1]), cum[..., :-1]], axis=-1)
+        return jnp.sum(-jax.nn.softplus(t) - jax.nn.softplus(-t)
+                       + jnp.log(jnp.maximum(lead, 1e-30)), axis=-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._forward_log_det_jacobian(x)
+            x = t._forward(x)
+        return total
+
+
+class IndependentTransform(Transform):
+    """Reinterpret trailing batch dims of a base transform as event dims
+    (sums the log-det over them)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ldj = self.base._forward_log_det_jacobian(x)
+        k = self.reinterpreted_batch_rank
+        return jnp.sum(ldj, axis=tuple(range(ldj.ndim - k, ldj.ndim)))
+
+
+class StackTransform(Transform):
+    """Apply one transform per slice along an axis (reference
+    StackTransform)."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _map(self, fn_name, v):
+        parts = jnp.split(v, len(self.transforms), axis=self.axis)
+        outs = [getattr(t, fn_name)(jnp.squeeze(pv, self.axis))
+                for t, pv in zip(self.transforms, parts)]
+        return jnp.stack(outs, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map("_forward", x)
+
+    def _inverse(self, y):
+        return self._map("_inverse", y)
+
+    def _forward_log_det_jacobian(self, x):
+        return self._map("_forward_log_det_jacobian", x)

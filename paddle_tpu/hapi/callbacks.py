@@ -176,3 +176,90 @@ class LRScheduler(Callback):
         s = self._sched()
         if self.by_epoch and s is not None:
             s.step()
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce LR when a monitored metric stops improving (reference:
+    hapi/callbacks.py ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10,
+                 verbose=1, mode="auto", min_delta=1e-4, cooldown=0,
+                 min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode == "auto":
+            mode = "min" if "acc" not in monitor else "max"
+        self.mode = mode
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def _better(self, cur):
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return cur < self.best - self.min_delta
+        return cur > self.best + self.min_delta
+
+    def on_eval_end(self, logs=None):
+        self._check(logs or {})
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._check(logs or {})
+
+    def _check(self, logs):
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            changed = False
+            if opt is not None and hasattr(opt, "_learning_rate") and \
+                    not hasattr(opt._learning_rate, "step"):
+                lr = opt.get_lr()
+                new_lr = max(lr * self.factor, self.min_lr)
+                if new_lr < lr:
+                    opt._learning_rate = new_lr
+                    changed = True
+                    if self.verbose:
+                        print(f"ReduceLROnPlateau: lr {lr:g} -> "
+                              f"{new_lr:g}")
+            if changed:
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
+
+
+class VisualDL(Callback):
+    """VisualDL logging (reference: hapi/callbacks.py VisualDL). The
+    visualdl package is not installed in this environment — constructing
+    the callback raises the same ImportError the reference would."""
+
+    def __init__(self, log_dir="vdl_log"):
+        raise ImportError(
+            "VisualDL is not installed; pip install visualdl to use this "
+            "callback (scalar logs are also written by ProgBarLogger)")
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logging (reference: hapi/callbacks.py
+    WandbCallback); requires the external wandb package."""
+
+    def __init__(self, *a, **kw):
+        raise ImportError(
+            "wandb is not installed; pip install wandb to use this "
+            "callback")

@@ -16,3 +16,6 @@ from ..nn.functional.common import (  # noqa: F401
 )
 from .. import inference  # noqa: F401  (reference: incubate.inference
 #   exposes the predictor toolchain; ours lives at paddle.inference)
+from . import asp  # noqa: F401,E402
+from . import autograd  # noqa: F401,E402
+from . import autotune  # noqa: F401,E402

@@ -1,0 +1,100 @@
+"""paddle.incubate.asp — automatic structured (2:4) sparsity (reference:
+python/paddle/incubate/asp). Real mask computation: prune_model applies
+2:4 magnitude masks to supported layers' weights; decorate wraps the
+optimizer so masks re-apply after each step (the reference's
+OptimizerWithSparsityGuarantee).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["calculate_density", "decorate", "prune_model",
+           "set_excluded_layers", "reset_excluded_layers",
+           "add_supported_layer"]
+
+_excluded = set()
+_supported_types = None
+_masks = {}
+
+
+def _supported():
+    global _supported_types
+    if _supported_types is None:
+        from ... import nn
+        _supported_types = [nn.Linear, nn.Conv2D]
+    return _supported_types
+
+
+def add_supported_layer(layer_type, pruning_func=None):
+    _supported().append(layer_type)
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def calculate_density(x) -> float:
+    """Fraction of non-zeros (reference: asp.calculate_density)."""
+    from ...core.dispatch import unwrap
+    a = np.asarray(unwrap(x) if hasattr(x, "shape") else x)
+    return float((a != 0).sum()) / max(a.size, 1)
+
+
+def _mask_2to4(w: np.ndarray) -> np.ndarray:
+    """2:4 magnitude mask along the last axis (reference
+    create_mask(mask_algo='mask_1d', n=2, m=4))."""
+    flat = w.reshape(-1, w.shape[-1])
+    cols = flat.shape[1]
+    pad = (-cols) % 4
+    if pad:
+        flat = np.pad(flat, [(0, 0), (0, pad)])
+    groups = flat.reshape(flat.shape[0], -1, 4)
+    order = np.argsort(-np.abs(groups), axis=-1)
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, order[..., :2], 1.0, axis=-1)
+    mask = mask.reshape(flat.shape)[:, :cols]
+    return mask.reshape(w.shape)
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply n:m magnitude masks to supported layers (reference:
+    asp.prune_model). Returns {param_name: mask}."""
+    import jax.numpy as jnp
+    out = {}
+    for name, sub in model.named_sublayers(include_self=True):
+        if not isinstance(sub, tuple(_supported())):
+            continue
+        w = getattr(sub, "weight", None)
+        if w is None or w.name in _excluded or len(w.shape) < 2:
+            continue
+        mask = _mask_2to4(np.asarray(w.numpy()))
+        w._data = w._data * jnp.asarray(mask, w._data.dtype)
+        key = f"{name}.weight" if name else "weight"
+        out[key] = mask
+        _masks[id(w)] = (w, mask)
+    return out
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-apply the pruning masks after each
+    update (reference: asp.decorate ->
+    OptimizerWithSparsityGuarantee)."""
+    import jax.numpy as jnp
+
+    class _ASPOptimizer:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def step(self):
+            self._inner.step()
+            for w, mask in _masks.values():
+                w._data = w._data * jnp.asarray(mask, w._data.dtype)
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    return _ASPOptimizer(optimizer)

@@ -1,0 +1,66 @@
+"""paddle.incubate.autograd (reference: python/paddle/incubate/autograd
+— functional AD + prim switches). vjp/jvp/Jacobian/Hessian are the same
+objects as paddle.autograd's; primitive lowering is jax's own tracing,
+so the prim toggles are recorded no-ops.
+"""
+from __future__ import annotations
+
+from ...autograd.functional import (  # noqa: F401
+    Hessian, Jacobian, jvp, vjp,
+)
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "enable_prim",
+           "disable_prim", "forward_grad", "grad"]
+
+_prim_enabled = False
+
+
+def enable_prim():
+    """jax always differentiates via primitives; recorded for
+    prim_enabled() introspection (reference: incubate.autograd
+    enable_prim toggles the paddle prim IR)."""
+    global _prim_enabled
+    _prim_enabled = True
+
+
+def disable_prim():
+    global _prim_enabled
+    _prim_enabled = False
+
+
+def prim_enabled():
+    return _prim_enabled
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """Forward-mode grad of outputs w.r.t. inputs (reference:
+    incubate.autograd.forward_grad) — jvp with default unit tangents."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    single = not isinstance(inputs, (list, tuple))
+    xs = [inputs] if single else list(inputs)
+    if grad_inputs is None:
+        vs = [paddle.ones_like(x) for x in xs]
+    else:
+        vs = [grad_inputs] if not isinstance(grad_inputs,
+                                             (list, tuple)) \
+            else list(grad_inputs)
+
+    def fn(*args):
+        out = outputs(*args) if callable(outputs) else None
+        if out is None:
+            raise TypeError(
+                "forward_grad expects a function for outputs (the "
+                "static-program form has no TPU analog)")
+        return out
+    _, tangents = jvp(fn, xs if len(xs) > 1 else xs[0],
+                      vs if len(vs) > 1 else vs[0])
+    return tangents
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """Reverse-mode grad (reference: incubate.autograd.grad — same
+    contract as paddle.grad)."""
+    from ...autograd.functional import grad as _grad
+    return _grad(outputs, inputs, grad_outputs)

@@ -1,0 +1,34 @@
+"""paddle.incubate.autotune (reference: python/paddle/incubate/
+autotune.py set_config): kernel/layout/dataloader tuning knobs. XLA's
+autotuner owns kernel selection on TPU; the config is recorded and the
+dataloader knob is applied."""
+from __future__ import annotations
+
+import json
+import warnings
+
+__all__ = ["set_config"]
+
+_config = {"kernel": {"enable": False},
+           "layout": {"enable": False},
+           "dataloader": {"enable": False}}
+
+
+def set_config(config=None):
+    """Accepts a dict or a JSON file path (reference contract)."""
+    global _config
+    if config is None:
+        _config = {k: {"enable": True} for k in _config}
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    for key, val in config.items():
+        if key not in _config:
+            warnings.warn(f"autotune: unknown config field {key}")
+            continue
+        _config[key].update(val)
+
+
+def get_config():
+    return _config

@@ -1,1 +1,3 @@
 from . import models  # noqa: F401
+
+from . import utils  # noqa: F401,E402

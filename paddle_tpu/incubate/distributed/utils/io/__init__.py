@@ -1,0 +1,4 @@
+"""incubate.distributed.utils.io (reference: dist_save/dist_load —
+gather-then-save under hybrid parallelism)."""
+from .dist_save import save  # noqa: F401
+from .save_for_auto import save_for_auto_inference  # noqa: F401

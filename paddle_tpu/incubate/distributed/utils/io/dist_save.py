@@ -1,0 +1,15 @@
+"""(reference: incubate/distributed/utils/io/dist_save.py save) —
+state_dicts of DistTensors gather to replicated values before writing;
+jax.Array.addressable shards make that a device_get here."""
+from __future__ import annotations
+
+__all__ = ["save", "save_for_auto_inference"]
+
+from .save_for_auto import save_for_auto_inference  # noqa: F401,E402
+
+
+def save(state_dict, path, **configs):
+    """Save a (possibly sharded) state dict; sharded jax arrays are
+    fetched whole (process 0 semantics of the reference)."""
+    import paddle_tpu as paddle
+    return paddle.save(state_dict, path, **configs)

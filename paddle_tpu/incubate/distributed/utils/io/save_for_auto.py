@@ -1,0 +1,16 @@
+"""(reference: save_for_auto.py save_for_auto_inference) — export dygraph
+weights in the layout the auto-parallel engine loads."""
+from __future__ import annotations
+
+__all__ = ["save_for_auto_inference"]
+
+
+def save_for_auto_inference(path_prefix, dist_model, cut_prefix=True):
+    import paddle_tpu as paddle
+    net = getattr(dist_model, "network", dist_model)
+    sd = net.state_dict()
+    if cut_prefix:
+        sd = {k.split(".", 1)[-1] if "." in k else k: v
+              for k, v in sd.items()}
+    paddle.save(sd, path_prefix + "_dist0.pdparams")
+    return path_prefix + "_dist0.pdparams"

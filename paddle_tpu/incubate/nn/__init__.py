@@ -1,1 +1,6 @@
 from . import functional  # noqa: F401
+from .layer import (  # noqa: F401,E402
+    FusedBiasDropoutResidualLayerNorm, FusedDropoutAdd, FusedFeedForward,
+    FusedLinear, FusedMultiHeadAttention, FusedMultiTransformer,
+    FusedTransformerEncoderLayer,
+)

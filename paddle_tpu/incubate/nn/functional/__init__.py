@@ -4,6 +4,7 @@ On TPU the 'fused' ops are XLA fusions of the plain implementations —
 these wrappers provide the reference names, delegating to the canonical
 implementations in paddle_tpu.nn.functional where they exist.
 """
+import jax
 import jax.numpy as jnp
 
 from ....core.dispatch import run_op
@@ -115,3 +116,372 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                       lambda a: rope_one(a, *angles_for(a)), [t])
 
     return tuple(make(t) for t in (q, k, v))
+
+
+# -- fused-op parity batch (reference: incubate/nn/functional/*) -------------
+# On TPU "fused" is a property of the compiled program: each of these is
+# written as one composition that XLA fuses into the surrounding matmuls,
+# which is exactly what the reference's hand-fused CUDA kernels buy.
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """(reference: fused_linear)"""
+    def fn(a, w, *rest):
+        wm = w.T if transpose_weight else w
+        out = a @ wm
+        if rest:
+            out = out + rest[0]
+        return out
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return run_op("fused_linear", fn, args)
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False,
+                      transpose_y=False, name=None):
+    """(reference: fused_matmul_bias)"""
+    def fn(a, b, *rest):
+        if transpose_x:
+            a = jnp.swapaxes(a, -2, -1)
+        if transpose_y:
+            b = jnp.swapaxes(b, -2, -1)
+        out = a @ b
+        if rest:
+            out = out + rest[0]
+        return out
+    args = [x, y] + ([bias] if bias is not None else [])
+    return run_op("fused_matmul_bias", fn, args)
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu", name=None):
+    """(reference: fused_linear_activation)"""
+    out = fused_matmul_bias(x, y, bias, trans_x, trans_y)
+    act = {"gelu": jax.nn.gelu, "relu": lambda a: jnp.maximum(a, 0),
+           "none": lambda a: a}[activation]
+    return run_op("fused_act", act, [out])
+
+
+def fused_bias_act(x, bias=None, dequant_scales=None, shift=None,
+                   smooth=None, act_method="gelu", compute_dtype="default",
+                   quant_scale=-1, quant_round_type=0, quant_max_bound=0,
+                   quant_min_bound=0, name=None):
+    """(reference: fused_bias_act)"""
+    def fn(a, *rest):
+        if bias is not None:
+            a = a + rest[0]
+        act = {"gelu": jax.nn.gelu, "relu": lambda v: jnp.maximum(v, 0),
+               "swiglu": lambda v: swiglu_ref(v),
+               "geglu": lambda v: geglu_ref(v)}[act_method]
+        return act(a)
+
+    def swiglu_ref(v):
+        u, g = jnp.split(v, 2, axis=-1)
+        return jax.nn.silu(u) * g
+
+    def geglu_ref(v):
+        u, g = jnp.split(v, 2, axis=-1)
+        return jax.nn.gelu(u) * g
+    args = [x] + ([bias] if bias is not None else [])
+    return run_op("fused_bias_act", fn, args)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """dropout(x) + y in one program (reference: fused_dropout_add)."""
+    from ....core import random as random_mod
+    if not training or p == 0:
+        if mode == "downscale_in_infer" and p:
+            # canonical dropout semantics: eval scales by (1-p)
+            return run_op("fused_dropout_add",
+                          lambda a, b: a * (1.0 - p) + b, [x, y])
+        return run_op("fused_dropout_add", lambda a, b: a + b, [x, y])
+    key = random_mod.next_key()
+
+    def fn(a, b):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        if mode == "upscale_in_train":
+            a = jnp.where(keep, a / (1.0 - p), 0.0)
+        else:
+            a = jnp.where(keep, a, 0.0)
+        return a + b
+    return run_op("fused_dropout_add", fn, [x, y])
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     residual_alpha=1.0, begin_norm_axis=1, bias=None,
+                     residual=None, quant_scale=-1, quant_round_type=0,
+                     quant_max_bound=0, quant_min_bound=0, name=None):
+    """(reference: fused_layer_norm — optional bias/residual folded in).
+    Returns (out, residual_out) when a residual is given."""
+    def fn(a, w, b, *rest):
+        it = iter(rest)
+        if bias is not None:
+            a = a + next(it)
+        res_out = None
+        if residual is not None:
+            a = a + residual_alpha * next(it)
+            res_out = a
+        axes = tuple(range(begin_norm_axis, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) / jnp.sqrt(var + epsilon)
+        shape = (1,) * begin_norm_axis + a.shape[begin_norm_axis:]
+        out = out * w.reshape(shape) + b.reshape(shape)
+        return (out, res_out) if res_out is not None else out
+    args = [x, norm_weight, norm_bias]
+    if bias is not None:
+        args.append(bias)
+    if residual is not None:
+        args.append(residual)
+    return run_op("fused_layer_norm", fn, args)
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """(reference: fused_bias_dropout_residual_layer_norm)"""
+    from ....core import random as random_mod
+    key = random_mod.next_key() if (training and dropout_rate) else None
+
+    def fn(a, res, *rest):
+        it = iter(rest)
+        if bias is not None:
+            a = a + next(it)
+        if key is not None:
+            keep = jax.random.bernoulli(key, 1.0 - dropout_rate, a.shape)
+            if mode == "upscale_in_train":
+                a = jnp.where(keep, a / (1.0 - dropout_rate), 0.0)
+            else:
+                a = jnp.where(keep, a, 0.0)
+        a = a + res
+        mean = jnp.mean(a, axis=-1, keepdims=True)
+        var = jnp.var(a, axis=-1, keepdims=True)
+        out = (a - mean) / jnp.sqrt(var + ln_epsilon)
+        if ln_scale is not None:
+            out = out * next(it)
+        if ln_bias is not None:
+            out = out + next(it)
+        return out
+    args = [x, residual]
+    for t in (bias, ln_scale, ln_bias):
+        if t is not None:
+            args.append(t)
+    return run_op("fused_bias_dropout_residual_ln", fn, args)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode=
+                      "upscale_in_train", ring_id=-1, name=None):
+    """Transformer FFN block in one program (reference:
+    fused_feedforward): [pre-]LN -> linear1 -> act -> dropout -> linear2
+    -> dropout -> residual [-> post-LN]."""
+    from ....core import random as random_mod
+    k1 = random_mod.next_key() if (training and dropout1_rate) else None
+    k2 = random_mod.next_key() if (training and dropout2_rate) else None
+
+    def drop(a, rate, key):
+        if key is None or rate == 0:
+            return a
+        keep = jax.random.bernoulli(key, 1.0 - rate, a.shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - rate), 0.0)
+        return jnp.where(keep, a, 0.0)
+
+    def ln(a, scale, bias_, eps):
+        mean = jnp.mean(a, axis=-1, keepdims=True)
+        var = jnp.var(a, axis=-1, keepdims=True)
+        out = (a - mean) / jnp.sqrt(var + eps)
+        if scale is not None:
+            out = out * scale
+        if bias_ is not None:
+            out = out + bias_
+        return out
+
+    act = {"relu": lambda a: jnp.maximum(a, 0),
+           "gelu": jax.nn.gelu}[activation]
+
+    def fn(a, w1, w2, *rest):
+        it = iter(rest)
+        b1 = next(it) if linear1_bias is not None else None
+        b2 = next(it) if linear2_bias is not None else None
+        s1 = next(it) if ln1_scale is not None else None
+        bb1 = next(it) if ln1_bias is not None else None
+        s2 = next(it) if ln2_scale is not None else None
+        bb2 = next(it) if ln2_bias is not None else None
+        resid = a
+        if pre_layer_norm:
+            a = ln(a, s1, bb1, ln1_epsilon)
+        h = a @ w1
+        if b1 is not None:
+            h = h + b1
+        h = drop(act(h), dropout1_rate, k1)
+        h = h @ w2
+        if b2 is not None:
+            h = h + b2
+        out = resid + drop(h, dropout2_rate, k2)
+        if not pre_layer_norm:
+            out = ln(out, s2 if s2 is not None else s1,
+                     bb2 if bb2 is not None else bb1, ln2_epsilon)
+        return out
+    args = [x, linear1_weight, linear2_weight]
+    for t in (linear1_bias, linear2_bias, ln1_scale, ln1_bias, ln2_scale,
+              ln2_bias):
+        if t is not None:
+            args.append(t)
+    return run_op("fused_feedforward", fn, args)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None,
+                               cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5,
+                               ln_epsilon=1e-5, training=True,
+                               mode="upscale_in_train", ring_id=-1,
+                               add_residual=True, num_heads=None,
+                               name=None):
+    """Whole MHA block in one program (reference:
+    fused_multi_head_attention): [pre-]LN -> QKV -> attention -> proj ->
+    dropout -> residual [-> post-LN]. qkv_weight: [3, H, D, E]."""
+    from ....core import random as random_mod
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "cached decode: use nn.MultiHeadAttention(cache=...) under "
+            "jit; the fused kernel's cache layout is CUDA-specific")
+    kd = random_mod.next_key() if (training and dropout_rate) else None
+    ka = random_mod.next_key() if (training and attn_dropout_rate)         else None
+
+    def fn(a, wqkv, wo, *rest):
+        it = iter(rest)
+        pls = next(it) if pre_ln_scale is not None else None
+        plb = next(it) if pre_ln_bias is not None else None
+        ls = next(it) if ln_scale is not None else None
+        lb = next(it) if ln_bias is not None else None
+        bqkv = next(it) if qkv_bias is not None else None
+        bo = next(it) if linear_bias is not None else None
+        mask = next(it) if attn_mask is not None else None
+        resid = a
+        if pre_layer_norm:
+            mean = jnp.mean(a, axis=-1, keepdims=True)
+            var = jnp.var(a, axis=-1, keepdims=True)
+            a = (a - mean) / jnp.sqrt(var + pre_ln_epsilon)
+            if pls is not None:
+                a = a * pls
+            if plb is not None:
+                a = a + plb
+        three, H, D, E = wqkv.shape
+        qkv = jnp.einsum("bse,thde->tbshd", a, wqkv)
+        if bqkv is not None:
+            qkv = qkv + bqkv.reshape(3, 1, 1, H, D)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        scores = jnp.einsum("bshd,bthd->bhst", q, k) / jnp.sqrt(D)
+        if mask is not None:
+            scores = scores + mask
+        attn = jax.nn.softmax(scores, axis=-1)
+        if ka is not None:
+            keep = jax.random.bernoulli(ka, 1.0 - attn_dropout_rate,
+                                        attn.shape)
+            attn = jnp.where(keep, attn / (1.0 - attn_dropout_rate), 0.0)
+        ctx = jnp.einsum("bhst,bthd->bshd", attn, v)
+        out = ctx.reshape(ctx.shape[0], ctx.shape[1], H * D) @ wo
+        if bo is not None:
+            out = out + bo
+        if kd is not None:
+            keep = jax.random.bernoulli(kd, 1.0 - dropout_rate, out.shape)
+            out = jnp.where(keep, out / (1.0 - dropout_rate), 0.0) \
+                if mode == "upscale_in_train" else \
+                jnp.where(keep, out, 0.0)
+        if add_residual:
+            out = out + resid
+        if not pre_layer_norm:
+            mean = jnp.mean(out, axis=-1, keepdims=True)
+            var = jnp.var(out, axis=-1, keepdims=True)
+            out = (out - mean) / jnp.sqrt(var + ln_epsilon)
+            if ls is not None:
+                out = out * ls
+            if lb is not None:
+                out = out + lb
+        return out
+    args = [x, qkv_weight, linear_weight]
+    for t in (pre_ln_scale, pre_ln_bias, ln_scale, ln_bias, qkv_bias,
+              linear_bias, attn_mask):
+        if t is not None:
+            args.append(t)
+    return run_op("fused_multi_head_attention", fn, args)
+
+
+def fused_multi_transformer(*args, **kwargs):
+    """(reference: fused_multi_transformer — a whole decoder stack in one
+    CUDA graph). The TPU equivalent IS the jitted model: build the stack
+    from FusedTransformerEncoderLayer / nn.TransformerEncoder and wrap in
+    paddle.jit.to_static — one XLA program, same fusion outcome."""
+    raise NotImplementedError(
+        "build the transformer stack with nn layers under "
+        "paddle.jit.to_static — jit compiles it into one program, which "
+        "is what fused_multi_transformer hand-builds on CUDA")
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               **kwargs):
+    """(reference: masked_multihead_attention — the CUDA decoding
+    kernel)."""
+    raise NotImplementedError(
+        "decode with nn.MultiHeadAttention(cache=...) under jit; the "
+        "masked single-query kernel is a CUDA-runtime specialization")
+
+
+def variable_length_memory_efficient_attention(query, key, value,
+                                               seq_lens, kv_seq_lens,
+                                               mask=None, scale=None,
+                                               causal=False, name=None):
+    """Varlen attention with per-sequence lengths (reference:
+    variable_length_memory_efficient_attention); masks padded keys."""
+    def fn(q, k, v, sl, kvl, *rest):
+        B, H, S, D = q.shape
+        T = k.shape[2]
+        scl = scale if scale is not None else 1.0 / jnp.sqrt(D)
+        scores = jnp.einsum("bhsd,bhtd->bhst", q, k) * scl
+        kmask = jnp.arange(T)[None, :] < kvl[:, None]
+        scores = jnp.where(kmask[:, None, None, :], scores, -1e30)
+        if causal:
+            # bottom-right alignment: query position s corresponds to
+            # key position s + (T - S) (the decode-step convention)
+            cm = (jnp.arange(S)[:, None] + (T - S)
+                  >= jnp.arange(T)[None, :])
+            scores = jnp.where(cm[None, None], scores, -1e30)
+        if rest:
+            scores = scores + rest[0]
+        attn = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhst,bhtd->bhsd", attn, v)
+        # zero padded query rows (beyond each sequence's length)
+        qmask = jnp.arange(S)[None, :] < sl[:, None]
+        return out * qmask[:, None, :, None]
+    args = [query, key, value, seq_lens, kv_seq_lens]
+    if mask is not None:
+        args.append(mask)
+    return run_op("varlen_mem_efficient_attention", fn, args)
+
+
+def block_multihead_attention(*args, **kwargs):
+    """(reference: block_multihead_attention — paged-KV CUDA decoding
+    kernel)."""
+    raise NotImplementedError(
+        "paged-attention decoding is a CUDA-runtime kernel; TPU decoding "
+        "uses dense cache_kv attention under jit")
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size=None,
+                     name=None):
+    """Max encoder/decoder lengths for block attention (reference:
+    blha_get_max_len)."""
+    from ....core.dispatch import unwrap as _u, wrap as _w
+    import numpy as np
+    enc = int(np.max(np.asarray(_u(seq_lens_encoder))))
+    dec = int(np.max(np.asarray(_u(seq_lens_decoder))))
+    return _w(np.asarray([enc])), _w(np.asarray([dec]))

@@ -4,7 +4,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..core.dispatch import unwrap
+from ...core.dispatch import unwrap
 
 
 class LookAhead:
@@ -110,3 +110,7 @@ class ModelAverage:
 
     def minimize(self, loss, **kw):
         self.step()
+
+
+from ...optimizer import LBFGS  # noqa: F401,E402  (reference re-export)
+from . import functional  # noqa: F401,E402

@@ -1,0 +1,65 @@
+"""Functional optimizers (reference: python/paddle/incubate/optimizer/
+functional: minimize_bfgs / minimize_lbfgs over a scalar closure)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]
+
+
+def _minimize(objective_func, initial_position, max_iters, tolerance_grad,
+              tolerance_change, history_size, use_lbfgs):
+    import paddle_tpu as paddle
+
+    x = paddle.create_parameter(list(initial_position.shape),
+                                str(initial_position.dtype.name))
+    x._data = initial_position._data
+    opt = paddle.optimizer.LBFGS(
+        learning_rate=1.0, max_iter=max_iters,
+        tolerance_grad=tolerance_grad, tolerance_change=tolerance_change,
+        history_size=history_size if use_lbfgs else max(max_iters, 50),
+        line_search_fn="strong_wolfe", parameters=[x])
+
+    def closure():
+        loss = objective_func(x)
+        loss.backward()
+        return loss
+
+    loss = opt.step(closure)
+    g = x.grad
+    grad_norm = float(np.abs(np.asarray(g.numpy())).max()) \
+        if g is not None else 0.0
+    converged = paddle.to_tensor(grad_norm <= tolerance_grad)
+    num_iters = paddle.to_tensor(np.int64(opt._n_evals))
+    return (converged, num_iters, x, g if g is not None
+            else paddle.zeros_like(x), loss,
+            paddle.to_tensor(jnp.eye(int(np.prod(x.shape)),
+                                     dtype=jnp.float32)))
+
+
+def minimize_bfgs(objective_func, initial_position, max_iters=50,
+                  tolerance_grad=1e-7, tolerance_change=1e-9,
+                  initial_inverse_hessian_estimate=None, line_search_fn=
+                  "strong_wolfe", max_line_search_iters=50,
+                  initial_step_length=1.0, dtype="float32", name=None):
+    """BFGS minimization of objective_func(x) (reference:
+    incubate.optimizer.functional.minimize_bfgs). Returns (is_converge,
+    num_func_calls, position, gradient, objective_value,
+    inverse_hessian_estimate)."""
+    return _minimize(objective_func, initial_position, max_iters,
+                     tolerance_grad, tolerance_change,
+                     history_size=max(max_iters, 50), use_lbfgs=False)
+
+
+def minimize_lbfgs(objective_func, initial_position, history_size=100,
+                   max_iters=50, tolerance_grad=1e-8,
+                   tolerance_change=1e-8, initial_inverse_hessian_estimate
+                   =None, line_search_fn="strong_wolfe",
+                   max_line_search_iters=50, initial_step_length=1.0,
+                   dtype="float32", name=None):
+    """L-BFGS minimization (reference: minimize_lbfgs); same return
+    structure as minimize_bfgs."""
+    return _minimize(objective_func, initial_position, max_iters,
+                     tolerance_grad, tolerance_change, history_size,
+                     use_lbfgs=True)

@@ -114,3 +114,140 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+# -- compat surface (reference: paddle/inference/__init__.py) ----------------
+
+import enum as _enum
+
+
+class DataType(_enum.Enum):
+    """(reference: inference.DataType)"""
+
+    FLOAT32 = 0
+    FLOAT16 = 1
+    INT64 = 2
+    INT32 = 3
+    UINT8 = 4
+    INT8 = 5
+    BOOL = 6
+    BFLOAT16 = 7
+
+
+class PlaceType(_enum.Enum):
+    """(reference: inference.PlaceType; TPU rides the custom slot)"""
+
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3
+
+
+class PrecisionType(_enum.Enum):
+    """(reference: inference.PrecisionType)"""
+
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+    Bfloat16 = 3
+
+
+class XpuConfig:
+    """Config bag (reference: inference.XpuConfig); no XPU backend in
+    PJRT here — carried for config-file compat."""
+
+    def __init__(self):
+        self.device_id = 0
+        self.l3_size = 0
+
+
+class Tensor:
+    """Predictor IO tensor handle (reference: inference.Tensor): the
+    copy_from_cpu/copy_to_cpu view over a device array."""
+
+    def __init__(self, data=None):
+        self._data = data
+
+    def copy_from_cpu(self, arr):
+        import jax.numpy as jnp
+        self._data = jnp.asarray(arr)
+
+    def copy_to_cpu(self):
+        import numpy as np
+        return np.asarray(self._data)
+
+    def shape(self):
+        return list(self._data.shape) if self._data is not None else []
+
+    def reshape(self, shape):
+        self._data = self._data.reshape(shape)
+
+
+class PredictorPool:
+    """N independent predictors over one config (reference:
+    inference.PredictorPool)."""
+
+    def __init__(self, config, size=1):
+        self._predictors = [create_predictor(config)
+                            for _ in range(int(size))]
+
+    def retrive(self, idx):  # reference spells it 'retrive'
+        return self._predictors[idx]
+
+    retrieve = retrive
+
+
+def get_version():
+    """(reference: inference.get_version)"""
+    from ..version import full_version
+    return f"paddle_tpu inference {full_version}"
+
+
+def get_num_bytes_of_data_type(dtype):
+    sizes = {DataType.FLOAT32: 4, DataType.FLOAT16: 2, DataType.INT64: 8,
+             DataType.INT32: 4, DataType.UINT8: 1, DataType.INT8: 1,
+             DataType.BOOL: 1, DataType.BFLOAT16: 2}
+    return sizes[dtype]
+
+
+def get_trt_compile_version():
+    """No TensorRT in the XLA stack (reference returns the linked TRT
+    version); (0, 0, 0) is the reference's not-compiled answer."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kw):
+    """Convert a saved StableHLO artifact's params to half precision
+    (reference: convert_to_mixed_precision rewrites the program; here
+    the params archive is re-saved cast, and jit re-traces in the low
+    dtype at load)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    state = paddle.load(params_file)
+    want = "bfloat16" if str(getattr(mixed_precision, "name",
+                                     mixed_precision)).lower().startswith(
+        ("bf", "bfloat")) else "float16"
+    out = {}
+    for k, v in state.items():
+        arr = v.numpy() if hasattr(v, "numpy") else np.asarray(v)
+        if arr.dtype in (np.float32, np.float64):
+            arr = paddle.to_tensor(arr).astype(want).numpy()
+        out[k] = arr
+    paddle.save(out, mixed_params_file)
+    import shutil
+    shutil.copy(model_file, mixed_model_file)
+
+
+def _get_phi_kernel_name(op_name):
+    """(reference: maps fluid op name -> phi kernel name; ops here keep
+    one name)"""
+    return op_name

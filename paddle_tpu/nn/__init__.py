@@ -12,3 +12,4 @@ from . import utils  # noqa: F401
 from .clip import (  # noqa: F401
     ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
 )
+from . import quant  # noqa: F401,E402

@@ -240,3 +240,24 @@ def global_weight_initializer():
 
 def global_bias_initializer():
     return _global_bias_initializer
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed convs (reference:
+    nn/initializer/Bilinear): weight [C_out, C_in, k, k] gets the
+    standard bilinear interpolation stencil."""
+
+    def __call__(self, param, block=None):
+        import numpy as np
+        shape = param._data.shape
+        if len(shape) != 4:
+            raise ValueError("Bilinear expects a 4-D conv weight")
+        k = shape[-1]
+        f = int(np.ceil(k / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        og = np.ogrid[:k, :k]
+        filt = ((1 - np.abs(og[0] / f - c))
+                * (1 - np.abs(og[1] / f - c)))
+        w = np.zeros(shape, np.float32)
+        w[...] = filt
+        return self._set(param, jnp.asarray(w))

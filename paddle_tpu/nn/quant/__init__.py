@@ -1,0 +1,42 @@
+"""paddle.nn.quant (reference: python/paddle/nn/quant): quant-layer
+surface re-exported from paddle_tpu.quantization."""
+from . import quant_layers  # noqa: F401
+from ...quantization.functional import (  # noqa: F401
+    weight_quantize, weight_dequantize,
+)
+
+
+class Stub:
+    """Quant insertion point marker (reference: nn/quant/stub.py Stub):
+    QAT replaces it with the configured quanter; eagerly it is
+    identity."""
+
+    def __init__(self, observer=None):
+        self._observer = observer
+
+    def __call__(self, x):
+        return x
+
+    forward = __call__
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1,
+                       name=None):
+    """Linear with int8/int4 quantized weights (reference:
+    nn/quant weight_only_linear): dequantize-then-matmul; XLA fuses the
+    dequant into the matmul's operand path."""
+    from ...quantization.functional import weight_dequantize
+    w = weight_dequantize(weight, weight_scale) if weight_scale \
+        is not None else weight
+    from ...nn.functional import linear
+    return linear(x, w, bias)
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0, name=None):
+    """LLM.int8() style linear (reference: nn/quant llm_int8_linear).
+    The outlier decomposition exists for CUDA int8 tensor cores; on TPU
+    the dequantized bf16 matmul IS the fast path, so numerics follow the
+    dequantize route."""
+    return weight_only_linear(x, weight, bias, weight_scale)

@@ -1,0 +1,8 @@
+"""paddle.nn.quant.quant_layers (reference: nn/quant/quant_layers.py):
+QAT layer wrappers; the TPU build's fake-quant node is the quanter."""
+from ...quantization.qat import QuantedWrapper  # noqa: F401
+from ...quantization.quanters import (  # noqa: F401
+    BaseQuanter as QuanterBase, FakeQuanterWithAbsMax,
+)
+
+FakeQuantAbsMax = FakeQuanterWithAbsMax

@@ -86,3 +86,16 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
         l._parameters[name] = None
     layer.register_forward_pre_hook(hook)
     return layer
+
+
+def clip_grad_value_(parameters, clip_value):
+    """Clamp gradients elementwise into [-clip_value, clip_value]
+    in-place (reference: nn/utils/clip_grad_value_)."""
+    import jax.numpy as jnp
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    cv = float(clip_value)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._data = jnp.clip(unwrap(p.grad), -cv, cv)
+    return parameters

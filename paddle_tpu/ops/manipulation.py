@@ -968,3 +968,8 @@ def unflatten(x, axis, shape, name=None):
         new = list(a.shape[:ax]) + shp + list(a.shape[ax + 1:])
         return jnp.reshape(a, new)
     return run_op("unflatten", fn, [x])
+
+
+def index_fill_(x, index, axis, value, name=None):
+    """Inplace index_fill (reference: index_fill_)."""
+    return _rebind(x, index_fill(x, index, axis, value))

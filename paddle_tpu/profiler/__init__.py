@@ -16,3 +16,5 @@ from .profiler import (Profiler, ProfilerState, ProfilerTarget,  # noqa: F401
                        export_protobuf, make_scheduler)
 from .timer import benchmark  # noqa: F401
 from .profiler_statistic import SortedKeys, StatisticData  # noqa: F401
+from .profiler_statistic import SummaryView  # noqa: F401,E402
+from .profiler import load_profiler_result  # noqa: F401,E402

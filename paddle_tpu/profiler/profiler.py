@@ -246,6 +246,10 @@ def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
     return export_chrome_tracing(dir_name, worker_name)
 
 
-def load_profiler_result(path):
-    raise NotImplementedError(
-        "use TensorBoard/perfetto on the XPlane files under log_dir")
+def load_profiler_result(filename: str):
+    """Load an exported chrome-trace file back into a dict (reference:
+    profiler.load_profiler_result over the protobuf dump; ours exports
+    chrome-trace JSON, so that's what loads)."""
+    import json
+    with open(filename) as f:
+        return json.load(f)

@@ -26,3 +26,18 @@ class StatisticData:
 
     def __getitem__(self, name):
         return self._agg[name]
+
+
+class SummaryView(enum.Enum):
+    """Report views (reference: profiler/profiler_statistic.py
+    SummaryView)."""
+
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8

@@ -72,3 +72,47 @@ def dequantize_linear(x, scale, zero_point=0.0, bit_length=8,
         s = _axis_shape(a, s, quant_axis)
         return (a - zero_point) * s
     return run_op("dequantize_linear", fn, [x, scale])
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1,
+                    name=None):
+    """Quantize a weight [K, N] to int8/int4 values with per-column (or
+    per-group-of-rows) scales (reference: nn/quant weight_quantize).
+    int4 values live in an int8 container (the reference packs pairs for
+    CUDA tensor cores; XLA gains nothing from packing). Returns
+    (quantized weight, scales)."""
+    if algo not in ("weight_only_int8", "weight_only_int4", "llm.int8"):
+        raise ValueError(f"unsupported weight_quantize algo {algo}")
+    bound = 7.0 if algo == "weight_only_int4" else 127.0
+
+    def fn(a):
+        if group_size > 0:
+            k, n = a.shape
+            if k % group_size:
+                raise ValueError("group_size must divide K")
+            g = a.reshape(k // group_size, group_size, n)
+            scale = jnp.max(jnp.abs(g), axis=1) / bound  # [K/gs, N]
+            q = jnp.clip(jnp.round(g / jnp.maximum(scale[:, None, :],
+                                                   1e-12)),
+                         -bound, bound).astype(jnp.int8).reshape(k, n)
+            return q, scale
+        scale = jnp.max(jnp.abs(a), axis=0) / bound
+        q = jnp.clip(jnp.round(a / jnp.maximum(scale, 1e-12)),
+                     -bound, bound).astype(jnp.int8)
+        return q, scale
+    return run_op("weight_quantize", fn, [x])
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype=None,
+                      name=None):
+    """Inverse of weight_quantize (handles per-column and per-group
+    scales)."""
+    def fn(q, s):
+        qf = q.astype(jnp.float32)
+        if s.ndim == 2 and s.shape[0] != 1:
+            k = qf.shape[0]
+            gs = k // s.shape[0]
+            return (qf.reshape(s.shape[0], gs, -1)
+                    * s[:, None, :]).reshape(qf.shape)
+        return qf * s
+    return run_op("weight_dequantize", fn, [x, scale])

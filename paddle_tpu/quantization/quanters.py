@@ -1,0 +1,40 @@
+"""Trainable quanters (reference: python/paddle/quantization/quanters):
+QAT fake-quant nodes inserted into layers."""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+from .functional import fake_quantize_dequantize_abs_max
+
+
+class BaseQuanter(Layer):
+    """Quanter contract (reference: quantization/base_quanter.py)."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+
+    def forward(self, x):
+        return fake_quantize_dequantize_abs_max(
+            x, bit_length=self.quant_bits)
+
+    def scales(self):
+        return None
+
+    def zero_points(self):
+        return None
+
+
+class FakeQuanterWithAbsMax(BaseQuanter):
+    """Abs-max fake quant (reference quanters/abs_max.py)."""
+
+
+def quanter(name):
+    """Class decorator registering a quanter under a config name
+    (reference: quantization/factory.py quanter)."""
+    def wrap(cls):
+        _QUANTER_REGISTRY[name] = cls
+        return cls
+    return wrap
+
+
+_QUANTER_REGISTRY = {}

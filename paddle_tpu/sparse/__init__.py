@@ -302,3 +302,6 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
     u, s, vh = jnp.linalg.svd(a, full_matrices=False)
     return (wrap(u[..., :q]), wrap(s[..., :q]),
             wrap(jnp.swapaxes(vh, -2, -1)[..., :q]))
+
+from . import creation  # noqa: F401,E402
+from . import nn  # noqa: F401,E402

@@ -1,0 +1,152 @@
+"""paddle.sparse.nn (reference: python/paddle/sparse/nn): layer wrappers
+over the sparse functional ops."""
+from __future__ import annotations
+
+from ...nn import Layer
+from . import functional  # noqa: F401
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        from .. import relu
+        return relu(x)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return functional.softmax(x, self.axis)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return functional.leaky_relu(x, self.negative_slope)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        from .. import SparseTensor
+        from jax.experimental import sparse as jsparse
+        import jax.numpy as jnp
+        return SparseTensor(jsparse.BCOO(
+            (jnp.clip(x._bcoo.data, 0, 6), x._bcoo.indices),
+            shape=x._bcoo.shape), x._fmt)
+
+
+class _SparseConvBase(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, nd,
+                 stride=1, padding=0, dilation=1, groups=1,
+                 subm=False, weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (kernel_size,) * nd if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, *ks], attr=weight_attr)
+        self.bias = self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True) \
+            if bias_attr is not False else None
+        self.stride, self.padding = stride, padding
+        self.dilation, self.groups = dilation, groups
+        self._nd, self._subm = nd, subm
+
+    def forward(self, x):
+        fn = {(2, False): functional.conv2d,
+              (2, True): functional.subm_conv2d,
+              (3, False): functional.conv3d,
+              (3, True): functional.subm_conv3d}[(self._nd, self._subm)]
+        return fn(x, self.weight, self.bias, self.stride, self.padding,
+                  self.dilation, self.groups)
+
+
+class Conv2D(_SparseConvBase):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 2,
+                         stride, padding, dilation, groups, False,
+                         weight_attr, bias_attr)
+
+
+class Conv3D(_SparseConvBase):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 3,
+                         stride, padding, dilation, groups, False,
+                         weight_attr, bias_attr)
+
+
+class SubmConv2D(_SparseConvBase):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 2,
+                         stride, padding, dilation, groups, True,
+                         weight_attr, bias_attr)
+
+
+class SubmConv3D(_SparseConvBase):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 3,
+                         stride, padding, dilation, groups, True,
+                         weight_attr, bias_attr)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self.kernel_size, self.stride = kernel_size, stride
+        self.padding = padding
+
+    def forward(self, x):
+        return functional.max_pool3d(x, self.kernel_size, self.stride,
+                                     self.padding)
+
+
+class BatchNorm(Layer):
+    """Sparse batch norm over the channel dim (reference:
+    sparse/nn/layer/norm.py BatchNorm): normalizes stored values."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from ...nn import BatchNorm1D
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon, weight_attr=weight_attr,
+                               bias_attr=bias_attr)
+
+    def forward(self, x):
+        from jax.experimental import sparse as jsparse
+
+        from .. import SparseTensor
+        from ...core.dispatch import unwrap as _u, wrap as _w
+        data = x._bcoo.data
+        if data.ndim == 1:
+            # fully-sparse layout (channel dim in the indices): densify,
+            # normalize the channel axis, re-sparsify
+            from .. import to_dense, to_sparse_coo
+            dense = _u(to_dense(x))
+            flat = dense.reshape(-1, dense.shape[-1])
+            out = _u(self._bn(_w(flat))).reshape(dense.shape)
+            return to_sparse_coo(_w(out), sparse_dim=dense.ndim)
+        vals = self._bn(_w(data))
+        return SparseTensor(jsparse.BCOO((_u(vals), x._bcoo.indices),
+                                         shape=x._bcoo.shape), x._fmt)
+
+
+class SyncBatchNorm(BatchNorm):
+    """GSPMD reduces the stats across the mesh under jit (reference:
+    sparse SyncBatchNorm)."""

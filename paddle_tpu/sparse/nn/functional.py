@@ -1,0 +1,122 @@
+"""paddle.sparse.nn.functional (reference: sparse/nn/functional):
+activations over sparse values + attention with a sparse mask."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import SparseTensor
+from ...core.dispatch import unwrap, wrap
+
+
+def _on_values(x: SparseTensor, fn):
+    from jax.experimental import sparse as jsparse
+    return SparseTensor(jsparse.BCOO((fn(x._bcoo.data), x._bcoo.indices),
+                                     shape=x._bcoo.shape), x._fmt)
+
+
+def relu(x, name=None):
+    return _on_values(x, lambda d: jnp.maximum(d, 0))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _on_values(x, lambda d: jnp.where(d >= 0, d,
+                                             negative_slope * d))
+
+
+def relu6(x, name=None):
+    return _on_values(x, lambda d: jnp.clip(d, 0, 6))
+
+
+def softmax(x, axis=-1, name=None):
+    """Softmax over the stored values per row (reference:
+    sparse.nn.functional.softmax on CSR rows). Densifies the row,
+    masking empty entries out of the normalization."""
+    dense = unwrap(x.to_dense()) if hasattr(x, "to_dense") else unwrap(x)
+    present = dense != 0
+    scores = jnp.where(present, dense, -jnp.inf)
+    out = jax.nn.softmax(scores, axis=axis)
+    out = jnp.where(present, out, 0.0)
+    from .. import to_sparse_coo
+    return to_sparse_coo(wrap(out), sparse_dim=out.ndim)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Attention restricted to a sparse mask pattern (reference:
+    sparse.nn.functional.attention)."""
+    from ...nn.functional.common import sparse_attention
+    raise NotImplementedError(
+        "use paddle.nn.functional.sparse_attention (CSR offsets/columns "
+        "form) — the fused QKV-sparse kernel shape is CUDA-specific")
+
+
+def _dense_conv(x, weight, bias, stride, padding, dilation, groups, nd,
+                subm):
+    """Shared sparse-conv path: densify -> XLA conv -> re-sparsify.
+    subm (submanifold) masks the output to the input's active sites
+    (reference sparse conv semantics)."""
+    from ... import nn as dense_nn
+    from ...nn import functional as dF
+    from .. import to_sparse_coo
+    dense = wrap(unwrap(x.to_dense()))
+    # sparse layout is channels-last [N, *spatial, C]; dense convs here
+    # are channels-first
+    perm_in = (0, nd + 1) + tuple(range(1, nd + 1))
+    perm_out = (0,) + tuple(range(2, nd + 2)) + (1,)
+    a = jnp.transpose(unwrap(dense), perm_in)
+    conv = dF.conv3d if nd == 3 else dF.conv2d
+    out = conv(wrap(a), weight, bias, stride=stride, padding=padding,
+               dilation=dilation, groups=groups)
+    out_cl = jnp.transpose(unwrap(out), perm_out)
+    if subm:
+        active = jnp.any(unwrap(dense) != 0, axis=-1, keepdims=True)
+        out_cl = jnp.where(active, out_cl, 0.0)
+    return to_sparse_coo(wrap(out_cl), sparse_dim=nd + 1)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NHWC", name=None):
+    """Sparse 2-D conv (reference: sparse/nn/functional/conv.py conv2d;
+    x: [N, H, W, C] sparse)."""
+    return _dense_conv(x, weight, bias, stride, padding, dilation,
+                       groups, nd=2, subm=False)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NDHWC", name=None):
+    """Sparse 3-D conv (reference: conv3d; x: [N, D, H, W, C])."""
+    return _dense_conv(x, weight, bias, stride, padding, dilation,
+                       groups, nd=3, subm=False)
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    """Submanifold sparse conv: output sparsity == input sparsity
+    (reference: subm_conv2d)."""
+    return _dense_conv(x, weight, bias, stride, padding, dilation,
+                       groups, nd=2, subm=True)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """(reference: subm_conv3d)"""
+    return _dense_conv(x, weight, bias, stride, padding, dilation,
+                       groups, nd=3, subm=True)
+
+
+# igemm variants: same math, different CUDA kernel in the reference
+subm_conv2d_igemm = subm_conv2d
+subm_conv3d_igemm = subm_conv3d
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NDHWC", name=None):
+    """Sparse 3-D max pool (reference: sparse/nn/functional/pooling)."""
+    from ...nn import functional as dF
+    from .. import to_sparse_coo
+    dense = unwrap(x.to_dense())
+    a = jnp.transpose(dense, (0, 4, 1, 2, 3))
+    out = dF.max_pool3d(wrap(a), kernel_size, stride, padding)
+    out_cl = jnp.transpose(unwrap(out), (0, 2, 3, 4, 1))
+    return to_sparse_coo(wrap(out_cl), sparse_dim=4)

@@ -43,3 +43,324 @@ def default_main_program():
 def default_startup_program():
     raise NotImplementedError(
         "no static program world on TPU — use paddle.jit.to_static")
+
+
+# -- graph-free statics kept runnable (reference: paddle.static.*) -----------
+
+Variable = None  # assigned below (Tensor alias; no Program variables here)
+
+
+def cpu_places(device_count=None):
+    """(reference: static.cpu_places)"""
+    from ..core.place import CPUPlace
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Same factory as paddle.create_parameter (reference shares it)."""
+    from ..framework.misc import create_parameter as _cp
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """A module-level Tensor variable (reference: create_global_var)."""
+    from ..ops.creation import full
+    t = full(shape, value, dtype)
+    t.persistable = persistable
+    t.name = name
+    return t
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Top-k accuracy (reference: static.accuracy)."""
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    """AUC (reference: static.auc) — returns (auc, batch_auc, states)
+    shaped like the reference's first output."""
+    from ..ops.stat import auc as _auc
+    val = _auc(input, label, num_thresholds=num_thresholds)
+    return val, val, []
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Eager print passthrough (reference: static.Print is a graph op;
+    in dygraph the value is simply printed and returned)."""
+    if message:
+        print(message, input)
+    else:
+        print(input)
+    return input
+
+
+class WeightNormParamAttr:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "use paddle.nn.utils.weight_norm on the Layer instead")
+
+
+class BuildStrategy:
+    """Config bag (reference: static.BuildStrategy). XLA already performs
+    the fusions these flags used to toggle; kept for config compat."""
+
+    def __init__(self):
+        self.enable_addto = False
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.enable_auto_fusion = False
+
+
+class ExponentialMovingAverage:
+    """EMA over parameters with apply/restore swap (reference:
+    static.ExponentialMovingAverage, dygraph-usable here)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._ema = {}
+        self._backup = {}
+        self._params = []
+
+    def update(self, parameters=None):
+        import jax.numpy as jnp
+        if parameters is not None:
+            self._params = list(parameters)
+        for p in self._params:
+            key = id(p)
+            prev = self._ema.get(key)
+            cur = p._data.astype(jnp.float32)
+            self._ema[key] = cur if prev is None else \
+                self._decay * prev + (1 - self._decay) * cur
+
+    def apply(self, executor=None, need_restore=True):
+        outer = self
+
+        class _Ctx:
+            def __enter__(ctx):
+                for p in outer._params:
+                    if id(p) in outer._ema:
+                        outer._backup[id(p)] = p._data
+                        p._data = outer._ema[id(p)].astype(p._data.dtype)
+                return ctx
+
+            def __exit__(ctx, *exc):
+                if need_restore:
+                    outer.restore()
+                return False
+        return _Ctx()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data = self._backup.pop(id(p))
+
+
+class CompiledProgram:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "no program world on TPU; jit-compile with "
+            "paddle.jit.to_static")
+
+
+class Executor:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "no executor world on TPU; call layers eagerly or compile "
+            "with paddle.jit.to_static")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError("IPU is not a PJRT backend here")
+
+
+class IpuStrategy(IpuCompiledProgram):
+    pass
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    raise NotImplementedError(
+        "no static graphs to append to — call loss.backward() (eager) "
+        "or let paddle.jit.TrainStep differentiate the whole step")
+
+
+from ..core.tensor import Tensor as Variable  # noqa: E402,F811
+
+
+__all__ += ["cpu_places", "create_parameter", "create_global_var",
+            "accuracy", "auc", "Print", "WeightNormParamAttr",
+            "BuildStrategy", "ExponentialMovingAverage",
+            "CompiledProgram", "Executor", "append_backward", "Variable"]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places (reference: static.cuda_places; TPU here)."""
+    import jax
+
+    from ..core.place import TPUPlace
+    if device_ids is None:
+        device_ids = range(len(jax.devices()))
+    return [TPUPlace(i) for i in device_ids]
+
+
+def data(name, shape, dtype=None, lod_level=0):
+    """Input placeholder -> InputSpec (reference: static.data creates a
+    feed Variable; the jit world's placeholder is the InputSpec)."""
+    return InputSpec(shape=shape, dtype=dtype or "float32", name=name)
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """(reference: static.gradients) — eager grad over the tape."""
+    import paddle_tpu as paddle
+    return paddle.grad(targets, inputs, grad_outputs=target_gradients)
+
+
+def global_scope():
+    """No Scope world; module-level dict stands in (reference:
+    static.global_scope)."""
+    return _GLOBAL_SCOPE
+
+
+class _Scope(dict):
+    def var(self, name):
+        return self.setdefault(name, None)
+
+    def find_var(self, name):
+        return self.get(name)
+
+
+_GLOBAL_SCOPE = _Scope()
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    raise NotImplementedError("IPU is not a PJRT backend here")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """Load params saved by static.save (framework io underneath)."""
+    import paddle_tpu as paddle
+    return paddle.load(model_path + ".pdparams"
+                       if not model_path.endswith(".pdparams")
+                       else model_path)
+
+
+def save(program, model_path):
+    raise NotImplementedError(
+        "no Programs to save; paddle.save(state_dict) or paddle.jit.save")
+
+
+def load_from_file(path):
+    """Raw bytes of a file (reference: static.load_from_file)."""
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Load a jit.save'd inference artifact (reference:
+    static.load_inference_model returns (program, feeds, fetches); here
+    the loaded TranslatedLayer plays the program's role)."""
+    import paddle_tpu as paddle
+    layer = paddle.jit.load(path_prefix)
+    return [layer, [], []]
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    raise NotImplementedError(
+        "export with paddle.jit.save(layer, path) (StableHLO artifact)")
+
+
+def load_program_state(model_path, var_list=None):
+    """(reference: static.load_program_state) — the saved state dict."""
+    import paddle_tpu as paddle
+    return paddle.load(model_path + ".pdparams"
+                       if not model_path.endswith(".pdparams")
+                       else model_path)
+
+
+def set_program_state(program, state_dict):
+    """Apply a state dict onto the Layer standing in for the program."""
+    if hasattr(program, "set_state_dict"):
+        program.set_state_dict(state_dict)
+        return program
+    raise TypeError("pass the nn.Layer to receive the state")
+
+
+def serialize_program(*a, **kw):
+    raise NotImplementedError("no Program serialization; paddle.jit.save")
+
+
+def deserialize_program(*a, **kw):
+    raise NotImplementedError("no Program serialization; paddle.jit.load")
+
+
+def serialize_persistables(*a, **kw):
+    raise NotImplementedError("paddle.save(state_dict) replaces this")
+
+
+def deserialize_persistables(*a, **kw):
+    raise NotImplementedError("paddle.load replaces this")
+
+
+def normalize_program(*a, **kw):
+    raise NotImplementedError("no Programs on TPU")
+
+
+def ctr_metric_bundle(*a, **kw):
+    raise NotImplementedError("PS/CTR serving stack is out of scope "
+                              "(SURVEY §7.1)")
+
+
+__all__ += ["cuda_places", "data", "gradients", "global_scope", "load",
+            "save", "load_from_file", "save_to_file",
+            "load_inference_model", "save_inference_model",
+            "load_program_state", "set_program_state",
+            "serialize_program", "deserialize_program",
+            "serialize_persistables", "deserialize_persistables",
+            "normalize_program", "ctr_metric_bundle", "ipu_shard_guard"]
+
+
+@contextlib.contextmanager
+def program_guard(main_program=None, startup_program=None):
+    """No programs to guard; a no-op scope for source compat."""
+    yield
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    """(reference: static.scope_guard) — the module scope stands in."""
+    yield
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """Eager python call (reference: static.py_func)."""
+    return func(x)
+
+
+def set_ipu_shard(layer, index=-1, stage=-1):
+    raise NotImplementedError("IPU is not a PJRT backend here")
+
+
+def xpu_places(device_ids=None):
+    raise NotImplementedError("XPU is not a PJRT backend here; "
+                              "accelerator places are cuda_places()")
+
+
+__all__ += ["program_guard", "scope_guard", "py_func", "set_ipu_shard",
+            "xpu_places"]

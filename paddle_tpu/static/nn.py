@@ -1,0 +1,145 @@
+"""paddle.static.nn compat (reference: python/paddle/static/nn): the
+static-graph layer builders map onto the dygraph functional library —
+same math, no Program."""
+from __future__ import annotations
+
+from ..nn import functional as F
+
+
+batch_norm = F.batch_norm
+conv2d = F.conv2d
+conv3d = F.conv3d
+embedding = F.embedding
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None,
+         return_names=None):
+    """Eager conditional (reference: static.nn.cond builds a select
+    program; dygraph evaluates the branch)."""
+    import numpy as np
+
+    from ..core.dispatch import unwrap
+    take_true = bool(np.asarray(unwrap(pred)).reshape(()))
+    if take_true:
+        return true_fn() if true_fn is not None else None
+    return false_fn() if false_fn is not None else None
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First-true-wins branch (reference: static.nn.case)."""
+    import numpy as np
+
+    from ..core.dispatch import unwrap
+    for pred, fn in pred_fn_pairs:
+        if bool(np.asarray(unwrap(pred)).reshape(())):
+            return fn()
+    return default() if default is not None else None
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """(reference: static.nn.switch_case)"""
+    import numpy as np
+
+    from ..core.dispatch import unwrap
+    idx = int(np.asarray(unwrap(branch_index)).reshape(()))
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) \
+        else branch_fns
+    fn = fns.get(idx)
+    if fn is None:
+        return default() if default is not None else None
+    return fn()
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Eager while (reference: static.nn.while_loop)."""
+    import numpy as np
+
+    from ..core.dispatch import unwrap
+    vals = list(loop_vars)
+    while bool(np.asarray(unwrap(cond(*vals))).reshape(())):
+        out = body(*vals)
+        vals = list(out) if isinstance(out, (list, tuple)) else [out]
+    return vals
+
+
+conv2d_transpose = F.conv2d_transpose
+conv3d_transpose = F.conv3d_transpose
+layer_norm = F.layer_norm
+group_norm = F.group_norm
+instance_norm = F.instance_norm
+prelu = F.prelu
+bilinear_tensor_product = F.bilinear
+
+
+def data_norm(*a, **kw):
+    raise NotImplementedError(
+        "data_norm is a PS-era layer; use nn.BatchNorm")
+
+
+def nce(*a, **kw):
+    raise NotImplementedError(
+        "NCE sampling loss: compose with paddle.nn.functional ops; the "
+        "static param-creating builder has no dygraph analog")
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, **kw):
+    raise NotImplementedError(
+        "use paddle.vision.ops.deform_conv2d / DeformConv2D (weights as "
+        "explicit Tensors)")
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    raise NotImplementedError(
+        "static.nn.embedding creates Program variables; use "
+        "paddle.nn.Embedding")
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    raise NotImplementedError(
+        "static.nn.fc builds Program variables; use paddle.nn.Linear")
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=
+            None):
+    """Run a python callable over tensors (reference: static.nn.py_func;
+    eager call here)."""
+    return func(x)
+
+
+def sparse_embedding(*a, **kw):
+    raise NotImplementedError("PS sparse table embedding is out of scope")
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """(reference: static.nn.spectral_norm) — same functional as the op
+    library's spectral normalization."""
+    from ..ops.linalg import spectral_norm as _sn
+    return _sn(weight, dim=dim, power_iters=power_iters, eps=eps)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    raise NotImplementedError(
+        "row_conv (lookahead conv) predates the jit world; compose with "
+        "paddle.nn.functional.conv1d")
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """(reference: static.nn.static_pylayer) — dygraph PyLayer covers
+    this; eager call here."""
+    return forward_fn(*inputs)
+
+
+def _sequence_unsupported(*a, **kw):
+    raise NotImplementedError(
+        "LoD sequence ops are a legacy CPU-graph feature with no TPU "
+        "analog; use padded batches + paddle.nn.functional masks")
+
+
+sequence_conv = _sequence_unsupported
+sequence_expand = _sequence_unsupported
+sequence_first_step = _sequence_unsupported
+sequence_last_step = _sequence_unsupported
+sequence_pool = _sequence_unsupported
+sequence_softmax = _sequence_unsupported

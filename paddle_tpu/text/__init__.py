@@ -7,3 +7,8 @@ from .datasets import (  # noqa: F401
     Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
 )
 from .viterbi import ViterbiDecoder, viterbi_decode  # noqa: F401
+
+from . import viterbi_decode as viterbi_decode_module  # noqa: F401,E402
+# the submodule import above rebinds the package attr to the MODULE;
+# restore the function (reference exposes both, function winning)
+from .viterbi import viterbi_decode  # noqa: F401,E402

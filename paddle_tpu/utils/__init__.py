@@ -39,3 +39,5 @@ def run_check():
     assert float(out.sum()) == 8.0
     dev = jax.devices()[0]
     print(f"paddle_tpu works on {dev.platform} ({dev.device_kind}).")
+
+from . import download  # noqa: F401,E402

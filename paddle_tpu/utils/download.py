@@ -1,0 +1,26 @@
+"""paddle.utils.download (reference: python/paddle/utils/download.py).
+
+Zero-egress environment: get_weights_path_from_url resolves from the
+local cache (~/.cache/paddle/weights) only and raises a clear error for
+uncached URLs instead of attempting a download.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_weights_path_from_url"]
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle/weights")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    """Map a weights URL to its local cache path (reference contract:
+    download-if-missing; here cache-hit-or-error — no network egress)."""
+    fname = os.path.basename(url)
+    path = os.path.join(WEIGHTS_HOME, fname)
+    if os.path.exists(path):
+        return path
+    raise RuntimeError(
+        f"weights {fname} not in local cache {WEIGHTS_HOME} and this "
+        "environment has no network egress; place the file there "
+        "manually")

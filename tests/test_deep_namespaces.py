@@ -1,0 +1,198 @@
+"""Behavioral checks for the deep-namespace batch: fused incubate ops,
+asp pruning, sparse nn, quant linears, static control flow, transforms,
+audio IO, device modules, functional minimizers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+RNG = np.random.default_rng(0)
+Fi = paddle.incubate.nn.functional
+
+
+def test_fused_linear_and_layer_norm():
+    x = paddle.to_tensor(RNG.standard_normal((2, 6, 16)).astype(
+        np.float32))
+    w = paddle.to_tensor(RNG.standard_normal((16, 8)).astype(np.float32))
+    b = paddle.to_tensor(RNG.standard_normal((8,)).astype(np.float32))
+    np.testing.assert_allclose(
+        Fi.fused_linear(x, w, b).numpy(),
+        x.numpy() @ w.numpy() + b.numpy(), rtol=1e-4, atol=1e-5)
+    out = Fi.fused_layer_norm(x, paddle.ones([16]), paddle.zeros([16]),
+                              begin_norm_axis=2)
+    manual = (x.numpy() - x.numpy().mean(-1, keepdims=True)) / np.sqrt(
+        x.numpy().var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(out.numpy(), manual, atol=1e-5)
+
+
+def test_fused_blocks_run():
+    x = paddle.to_tensor(RNG.standard_normal((2, 6, 16)).astype(
+        np.float32))
+    mha = paddle.incubate.nn.FusedMultiHeadAttention(
+        16, 4, dropout_rate=0.0, attn_dropout_rate=0.0)
+    mha.eval()
+    assert mha(x).shape == [2, 6, 16]
+    ffn = paddle.incubate.nn.FusedFeedForward(16, 32, dropout_rate=0.0)
+    ffn.eval()
+    assert ffn(x).shape == [2, 6, 16]
+    enc = paddle.incubate.nn.FusedTransformerEncoderLayer(
+        16, 4, 32, dropout_rate=0.0)
+    enc.eval()
+    assert enc(x).shape == [2, 6, 16]
+    # downscale_in_infer semantics at eval
+    out = Fi.fused_dropout_add(x, paddle.zeros([2, 6, 16]), p=0.5,
+                               training=False,
+                               mode="downscale_in_infer")
+    np.testing.assert_allclose(out.numpy(), 0.5 * x.numpy(), rtol=1e-6)
+    with pytest.raises(NotImplementedError):
+        Fi.fused_multi_head_attention(
+            x, paddle.zeros([3, 4, 4, 16]), paddle.zeros([16, 16]),
+            cache_kv="cache")
+
+
+def test_varlen_mea_decode_alignment():
+    q = paddle.to_tensor(RNG.standard_normal((1, 1, 1, 4)).astype(
+        np.float32))
+    kv = paddle.to_tensor(RNG.standard_normal((1, 1, 3, 4)).astype(
+        np.float32))
+    out = Fi.variable_length_memory_efficient_attention(
+        q, kv, kv, paddle.to_tensor(np.array([1], np.int64)),
+        paddle.to_tensor(np.array([3], np.int64)), causal=True)
+    s = np.einsum("bhsd,bhtd->bhst", q.numpy(), kv.numpy()) / 2.0
+    a = np.exp(s - s.max(-1, keepdims=True))
+    a /= a.sum(-1, keepdims=True)
+    want = np.einsum("bhst,bhtd->bhsd", a, kv.numpy())
+    np.testing.assert_allclose(out.numpy(), want, atol=1e-5)
+
+
+def test_asp_prune_and_decorate():
+    net = nn.Linear(8, 8)
+    paddle.incubate.asp.prune_model(net)
+    assert abs(paddle.incubate.asp.calculate_density(net.weight)
+               - 0.5) < 0.01
+    opt = paddle.incubate.asp.decorate(
+        paddle.optimizer.SGD(0.1, parameters=net.parameters()))
+    (net(paddle.ones([2, 8])) ** 2).sum().backward()
+    opt.step()
+    assert abs(paddle.incubate.asp.calculate_density(net.weight)
+               - 0.5) < 0.01
+
+
+def test_minimize_lbfgs():
+    from paddle_tpu.incubate.optimizer.functional import minimize_lbfgs
+
+    def f(x):
+        return ((x - paddle.to_tensor(np.array([1.0, -2.0],
+                                               np.float32))) ** 2).sum()
+
+    conv, n, pos, g, loss, hinv = minimize_lbfgs(
+        f, paddle.to_tensor(np.zeros(2, np.float32)))
+    np.testing.assert_allclose(pos.numpy(), [1, -2], atol=1e-4)
+    assert bool(conv.numpy())
+
+
+def test_sparse_nn_layers():
+    sp = paddle.sparse
+    dense = np.zeros((1, 6, 6, 2), np.float32)
+    dense[0, 1, 1] = [1.0, 2.0]
+    dense[0, 4, 3] = [3.0, 0.5]
+    x = sp.to_sparse_coo(paddle.to_tensor(dense), sparse_dim=4)
+    od = sp.to_dense(sp.nn.SubmConv2D(2, 3, 3, padding=1)(x)).numpy()
+    assert (((od != 0).any(-1)) == ((dense != 0).any(-1))).all()
+    assert sp.to_dense(sp.nn.BatchNorm(2)(x)).shape == [1, 6, 6, 2]
+    d3 = np.zeros((1, 4, 4, 4, 2), np.float32)
+    d3[0, 0, 0, 0] = [1, 2]
+    pooled = sp.nn.MaxPool3D(2, 2)(
+        sp.to_sparse_coo(paddle.to_tensor(d3), sparse_dim=5))
+    assert sp.to_dense(pooled).shape == [1, 2, 2, 2, 2]
+
+
+def test_quant_linears():
+    w = paddle.to_tensor(RNG.standard_normal((4, 8)).astype(np.float32))
+    q8, s8 = paddle.quantization.functional.weight_quantize(w)
+    x = paddle.to_tensor(RNG.standard_normal((2, 4)).astype(np.float32))
+    out = paddle.nn.quant.weight_only_linear(x, q8, weight_scale=s8)
+    np.testing.assert_allclose(out.numpy(), x.numpy() @ w.numpy(),
+                               atol=0.1)
+    q4, _ = paddle.quantization.functional.weight_quantize(
+        w, algo="weight_only_int4")
+    assert int(np.abs(q4.numpy()).max()) <= 7
+    qg, sg = paddle.quantization.functional.weight_quantize(w,
+                                                            group_size=2)
+    back = paddle.quantization.functional.weight_dequantize(qg, sg)
+    np.testing.assert_allclose(back.numpy(), w.numpy(), atol=0.05)
+    with pytest.raises(ValueError):
+        paddle.quantization.functional.weight_quantize(w, algo="int3")
+
+
+def test_static_control_flow_and_scope():
+    import paddle_tpu.static.nn as snn
+    assert snn.cond(paddle.to_tensor([True]), lambda: 1, lambda: 2) == 1
+    assert snn.case([(paddle.to_tensor([False]), lambda: 1),
+                     (paddle.to_tensor([True]), lambda: 2)]) == 2
+    assert snn.switch_case(paddle.to_tensor(1),
+                           {0: lambda: "a", 1: lambda: "b"}) == "b"
+    out = snn.while_loop(lambda i: i < paddle.to_tensor(3),
+                         lambda i: i + 1, [paddle.to_tensor(0)])
+    assert int(out[0].numpy()) == 3
+    with paddle.static.program_guard():
+        pass
+    spec = paddle.static.data("x", [None, 3])
+    assert spec.shape[-1] == 3
+    ema = paddle.static.ExponentialMovingAverage(0.5)
+    assert ema is not None
+
+
+def test_distribution_transforms():
+    D = paddle.distribution
+    x = paddle.to_tensor(np.array([0.3, -0.7], np.float32))
+    for t in [D.TanhTransform(), D.SigmoidTransform(), D.ExpTransform(),
+              D.AffineTransform(paddle.to_tensor(1.0),
+                                paddle.to_tensor(2.0))]:
+        y = t.forward(x)
+        back = t.inverse(y)
+        np.testing.assert_allclose(back.numpy(), x.numpy(), atol=1e-5)
+    sb = D.StickBreakingTransform()
+    simplex = sb.forward(x)
+    np.testing.assert_allclose(simplex.numpy().sum(), 1.0, atol=1e-5)
+    np.testing.assert_allclose(sb.inverse(simplex).numpy(), x.numpy(),
+                               atol=1e-4)
+    ch = D.ChainTransform([D.ExpTransform(),
+                           D.PowerTransform(paddle.to_tensor(2.0))])
+    np.testing.assert_allclose(ch.inverse(ch.forward(x)).numpy(),
+                               x.numpy(), atol=1e-5)
+
+
+def test_audio_io_roundtrip(tmp_path):
+    wav = paddle.to_tensor((0.5 * np.sin(
+        2 * np.pi * 440 * np.arange(1600) / 16000)).astype(
+            np.float32)[None])
+    f = str(tmp_path / "t.wav")
+    paddle.audio.save(f, wav, 16000)
+    back, sr = paddle.audio.load(f)
+    assert sr == 16000
+    np.testing.assert_allclose(back.numpy(), wav.numpy(), atol=1e-3)
+    info = paddle.audio.info(f)
+    assert info.sample_rate == 16000 and info.bits_per_sample == 16
+    w, lab = paddle.audio.datasets.ESC50(num_samples=3)[0]
+    assert w.shape == (16000,)
+
+
+def test_device_modules_and_misc():
+    import paddle_tpu.device.cuda as cuda
+    import paddle_tpu.device.xpu as xpu
+    cuda.synchronize()
+    assert cuda.device_count() >= 1
+    xpu.synchronize()
+    t = paddle.inference.Tensor()
+    t.copy_from_cpu(np.ones((2, 2)))
+    assert t.copy_to_cpu().shape == (2, 2)
+    assert paddle.inference.get_num_bytes_of_data_type(
+        paddle.inference.DataType.FLOAT32) == 4
+    fs = paddle.distributed.fleet.utils.LocalFS()
+    assert fs.is_exist("/tmp")
+    lin = nn.Linear(4, 4)
+    m, o, _ = paddle.distributed.sharding.group_sharded_parallel(
+        lin, paddle.optimizer.SGD(parameters=lin.parameters()), "p_g_os")
+    assert m is not None
