@@ -1,6 +1,12 @@
 """paddle.device.cuda as an importable module (reference:
-python/paddle/device/cuda): the compat shims map onto the TPU device."""
+python/paddle/device/cuda): the compat shims map onto the TPU device.
+
+NOTE: importing this module rebinds the paddle.device.cuda attribute
+from the namespace object to the module, so everything the namespace
+exposed must be re-exported here."""
+from . import Event, Stream  # noqa: F401
 from . import _CudaNamespace as _NS
+from . import is_compiled_with_cuda as is_available  # noqa: F401
 from .monitor import (  # noqa: F401
     max_memory_allocated, max_memory_reserved, memory_allocated,
     memory_reserved,

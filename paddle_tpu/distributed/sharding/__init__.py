@@ -30,10 +30,7 @@ def group_sharded_parallel(model, optimizer, level, scaler=None,
         # shard optimizer state (+grads), which the compiled step's
         # sharded optimizer placements handle
         model = shard_parameters_fsdp(model)
-    if hasattr(optimizer, "_sharding_stage"):
-        optimizer._sharding_stage = stage
-    else:
-        setattr(optimizer, "_sharding_stage", stage)
+    optimizer._sharding_stage = stage
     if scaler is not None:
         return model, optimizer, scaler
     return model, optimizer, None

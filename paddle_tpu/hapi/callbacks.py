@@ -207,14 +207,13 @@ class ReduceLROnPlateau(Callback):
             return cur < self.best - self.min_delta
         return cur > self.best + self.min_delta
 
-    def on_eval_end(self, logs=None):
-        self._check(logs or {})
-
     def on_epoch_end(self, epoch, logs=None):
         self._check(logs or {})
 
     def _check(self, logs):
-        cur = logs.get(self.monitor)
+        # eval metrics surface in epoch logs with an eval_ prefix
+        cur = logs.get(self.monitor,
+                       logs.get("eval_" + self.monitor))
         if cur is None:
             return
         cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)

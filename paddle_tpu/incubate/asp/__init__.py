@@ -75,7 +75,8 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
         w._data = w._data * jnp.asarray(mask, w._data.dtype)
         key = f"{name}.weight" if name else "weight"
         out[key] = mask
-        _masks[id(w)] = (w, mask)
+        import weakref
+        _masks[id(w)] = (weakref.ref(w), mask)
     return out
 
 
@@ -85,14 +86,25 @@ def decorate(optimizer):
     OptimizerWithSparsityGuarantee)."""
     import jax.numpy as jnp
 
+    # bind masks for THIS optimizer's parameters only (other pruned
+    # models' masks must not be touched by this optimizer's steps)
+    own = {id(p) for p in optimizer._parameter_list}
+
     class _ASPOptimizer:
         def __init__(self, inner):
             self._inner = inner
 
         def step(self):
             self._inner.step()
-            for w, mask in _masks.values():
-                w._data = w._data * jnp.asarray(mask, w._data.dtype)
+            dead = []
+            for key, (wref, mask) in _masks.items():
+                w = wref()
+                if w is None:
+                    dead.append(key)
+                elif key in own:
+                    w._data = w._data * jnp.asarray(mask, w._data.dtype)
+            for key in dead:
+                del _masks[key]
 
         def __getattr__(self, name):
             return getattr(self._inner, name)

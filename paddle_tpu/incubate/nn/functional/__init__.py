@@ -253,6 +253,9 @@ def fused_bias_dropout_residual_layer_norm(
                 a = jnp.where(keep, a / (1.0 - dropout_rate), 0.0)
             else:
                 a = jnp.where(keep, a, 0.0)
+        elif dropout_rate and mode == "downscale_in_infer" \
+                and not training:
+            a = a * (1.0 - dropout_rate)
         a = a + res
         mean = jnp.mean(a, axis=-1, keepdims=True)
         var = jnp.var(a, axis=-1, keepdims=True)
@@ -285,6 +288,9 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
 
     def drop(a, rate, key):
         if key is None or rate == 0:
+            # eval: downscale_in_infer's contract scales by (1-p) here
+            if rate and mode == "downscale_in_infer" and not training:
+                return a * (1.0 - rate)
             return a
         keep = jax.random.bernoulli(key, 1.0 - rate, a.shape)
         if mode == "upscale_in_train":
@@ -397,6 +403,9 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
             out = jnp.where(keep, out / (1.0 - dropout_rate), 0.0) \
                 if mode == "upscale_in_train" else \
                 jnp.where(keep, out, 0.0)
+        elif dropout_rate and mode == "downscale_in_infer" \
+                and not training:
+            out = out * (1.0 - dropout_rate)
         if add_residual:
             out = out + resid
         if not pre_layer_norm:

@@ -88,13 +88,6 @@ def deform_conv2d(x, offset, mask, num_filters, filter_size, **kw):
         "explicit Tensors)")
 
 
-def embedding(input, size, is_sparse=False, padding_idx=None,
-              param_attr=None, dtype="float32"):
-    raise NotImplementedError(
-        "static.nn.embedding creates Program variables; use "
-        "paddle.nn.Embedding")
-
-
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
        activation=None, name=None):
     raise NotImplementedError(
