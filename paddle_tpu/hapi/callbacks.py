@@ -211,9 +211,10 @@ class ReduceLROnPlateau(Callback):
         self._check(logs or {})
 
     def _check(self, logs):
-        # eval metrics surface in epoch logs with an eval_ prefix
-        cur = logs.get(self.monitor,
-                       logs.get("eval_" + self.monitor))
+        # eval metrics surface in epoch logs with an eval_ prefix;
+        # prefer them (the reference monitors eval, not the last train
+        # batch) and fall back to the raw key for train-only fits
+        cur = logs.get("eval_" + self.monitor, logs.get(self.monitor))
         if cur is None:
             return
         cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)

@@ -6,6 +6,8 @@ OptimizerWithSparsityGuarantee).
 """
 from __future__ import annotations
 
+import weakref
+
 import numpy as np
 
 __all__ = ["calculate_density", "decorate", "prune_model",
@@ -75,7 +77,6 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
         w._data = w._data * jnp.asarray(mask, w._data.dtype)
         key = f"{name}.weight" if name else "weight"
         out[key] = mask
-        import weakref
         _masks[id(w)] = (weakref.ref(w), mask)
     return out
 

@@ -393,7 +393,14 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
         if ka is not None:
             keep = jax.random.bernoulli(ka, 1.0 - attn_dropout_rate,
                                         attn.shape)
-            attn = jnp.where(keep, attn / (1.0 - attn_dropout_rate), 0.0)
+            if mode == "upscale_in_train":
+                attn = jnp.where(keep,
+                                 attn / (1.0 - attn_dropout_rate), 0.0)
+            else:
+                attn = jnp.where(keep, attn, 0.0)
+        elif attn_dropout_rate and mode == "downscale_in_infer" \
+                and not training:
+            attn = attn * (1.0 - attn_dropout_rate)
         ctx = jnp.einsum("bhst,bthd->bshd", attn, v)
         out = ctx.reshape(ctx.shape[0], ctx.shape[1], H * D) @ wo
         if bo is not None:

@@ -138,8 +138,13 @@ def flash_attention_arrays(q, k, v, causal=False, scale=None,
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     import jax
-    # backend platform, not array placement: tracers have no devices
-    on_tpu = jax.default_backend() in ("tpu", "axon")
+    # backend platform, not array placement: tracers have no devices.
+    # 'axon' (the tunneled single-chip platform) routes compiles through
+    # a remote helper that cannot build Mosaic kernels (measured: every
+    # pallas_call 500s at compile), so it takes the XLA path — which
+    # reaches the same ~73% train MFU at bench shapes; Mosaic engages on
+    # directly-attached TPU platforms.
+    on_tpu = jax.default_backend() == "tpu"
     use_pallas = force_pallas or (
         on_tpu and _tileable(qt.shape[2], kt.shape[2], qt.shape[3]))
     if use_pallas:

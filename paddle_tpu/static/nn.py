@@ -9,7 +9,6 @@ from ..nn import functional as F
 batch_norm = F.batch_norm
 conv2d = F.conv2d
 conv3d = F.conv3d
-embedding = F.embedding
 
 
 def cond(pred, true_fn=None, false_fn=None, name=None,
@@ -92,6 +91,18 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
        activation=None, name=None):
     raise NotImplementedError(
         "static.nn.fc builds Program variables; use paddle.nn.Linear")
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    """static.nn.embedding(input, size=(vocab, dim)) creates a Program
+    variable for its table — there is no stateless analog; the dygraph
+    path is paddle.nn.Embedding (or F.embedding with an explicit weight
+    Tensor)."""
+    raise NotImplementedError(
+        "static.nn.embedding creates Program variables; use "
+        "paddle.nn.Embedding(vocab, dim) or nn.functional.embedding(x, "
+        "weight)")
 
 
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=
