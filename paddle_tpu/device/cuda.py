@@ -6,13 +6,6 @@ from the namespace object to the module, so everything the namespace
 exposed must be re-exported here."""
 from . import Event, Stream  # noqa: F401
 from . import _CudaNamespace as _NS
-from . import is_compiled_with_tpu as _is_accel
-
-
-def is_available():
-    """Accelerator availability (matches the namespace object this module
-    replaces: True when the TPU backend is up)."""
-    return _is_accel()
 from .monitor import (  # noqa: F401
     max_memory_allocated, max_memory_reserved, memory_allocated,
     memory_reserved,
@@ -21,6 +14,7 @@ from .monitor import (  # noqa: F401
 from . import _sync as _sync_impl
 
 _ns = _NS()
+is_available = _ns.is_available
 
 
 def synchronize(device=None):

@@ -141,9 +141,10 @@ class EarlyStopping(Callback):
         return cur > self.best + self.min_delta
 
     def on_epoch_end(self, epoch, logs=None):
-        cur = (logs or {}).get(self.monitor)
-        if cur is None:
-            cur = (logs or {}).get(f"eval_{self.monitor}")
+        # prefer the eval metric (same rationale as ReduceLROnPlateau:
+        # the reference stops on eval, not the noisy last train batch)
+        cur = (logs or {}).get(f"eval_{self.monitor}",
+                               (logs or {}).get(self.monitor))
         if cur is None:
             return
         if self._better(cur):
@@ -219,8 +220,12 @@ class ReduceLROnPlateau(Callback):
             return
         cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
         if self.cooldown_counter > 0:
+            # in cooldown: no wait accumulation, no reductions
             self.cooldown_counter -= 1
             self.wait = 0
+            if self._better(cur):
+                self.best = cur
+            return
         if self._better(cur):
             self.best = cur
             self.wait = 0

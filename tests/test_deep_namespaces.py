@@ -196,3 +196,40 @@ def test_device_modules_and_misc():
     m, o, _ = paddle.distributed.sharding.group_sharded_parallel(
         lin, paddle.optimizer.SGD(parameters=lin.parameters()), "p_g_os")
     assert m is not None
+
+
+def test_reduce_lr_on_plateau_prefers_eval():
+    cb = paddle.callbacks.ReduceLROnPlateau(monitor="loss", factor=0.5,
+                                            patience=1, verbose=0)
+
+    class FakeOpt:
+        _learning_rate = 0.1
+
+        def get_lr(self):
+            return self._learning_rate
+
+    class FakeModel:
+        _optimizer = FakeOpt()
+
+    cb.model = FakeModel()
+    # eval loss plateaus while train loss (noise) improves: the eval
+    # metric must drive the decision
+    cb.on_epoch_end(0, {"loss": 1.0, "eval_loss": 0.5})
+    cb.on_epoch_end(1, {"loss": 0.9, "eval_loss": 0.5})
+    # patience=1: each further plateaued epoch halves again
+    assert cb.model._optimizer._learning_rate == pytest.approx(0.05)
+    cb.on_epoch_end(2, {"loss": 0.8, "eval_loss": 0.5})
+    assert cb.model._optimizer._learning_rate == pytest.approx(0.025)
+
+
+def test_check_layer_numerics_decorator():
+    class L(nn.Layer):
+        @paddle.amp.debugging.check_layer_numerics
+        def forward(self, x=None):
+            return x
+
+    bad = paddle.to_tensor(np.array([np.nan], np.float32))
+    with pytest.raises(RuntimeError):
+        L()(x=bad)
+    good = paddle.to_tensor(np.array([1.0], np.float32))
+    assert L()(x=good) is good

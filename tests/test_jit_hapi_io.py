@@ -116,16 +116,18 @@ def test_distributed_batch_sampler():
 def test_model_fit_evaluate_predict(tmp_path):
     from paddle_tpu.vision.datasets import MNIST
     from paddle_tpu.vision.models import LeNet
-    # fix the init/shuffle stream: earlier tests advance the global RNG
-    # and some init draws land LeNet in a slow-converging basin
+    # fix BOTH rng streams: paddle keys drive init, numpy drives the
+    # DataLoader shuffle — suite ordering must not change this test
+    import numpy as _np
     paddle.seed(1234)
+    _np.random.seed(1234)
     train = MNIST(mode="train")
     train.images = train.images[:512]
     train.labels = train.labels[:512]
     model = paddle.Model(LeNet())
     opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
     model.prepare(opt, nn.CrossEntropyLoss(), paddle.metric.Accuracy())
-    model.fit(train, batch_size=128, epochs=2, verbose=0)
+    model.fit(train, batch_size=128, epochs=3, verbose=0)
     res = model.evaluate(train, batch_size=128, verbose=0)
     assert res["acc"] > 0.6
     out = model.predict(train, batch_size=128, stack_outputs=True)
