@@ -1,34 +1,180 @@
-"""Flash attention: Pallas TPU kernel with XLA fallback.
+"""Flash attention: Pallas TPU kernels (fwd + bwd) with XLA fallback.
 
-Reference capability: paddle/phi/kernels/gpu/flash_attn_kernel.cu (dynloaded
-flash-attn v2 lib). TPU-native design: a blocked online-softmax kernel in
-Pallas that streams K/V tiles through VMEM so the S×S score matrix never
-materializes in HBM. Falls back to an XLA einsum+softmax (which XLA fuses
-reasonably) for shapes that don't tile, and on non-TPU backends runs the
-kernel in interpret mode only under tests.
+Reference capability: paddle/phi/kernels/gpu/flash_attn_kernel.cu and
+flash_attn_grad_kernel.cu (dynloaded flash-attn v2 lib). TPU-native
+design: blocked online-softmax kernels in Pallas that stream K/V tiles
+through VMEM so the S×S score matrix never materializes in HBM. The
+backward is recompute-style (FlashAttention-2): the forward additionally
+saves the per-row logsumexp; backward recomputes P = exp(S - lse) per
+tile and accumulates dQ (one kernel, gridded over q blocks) and dK/dV
+(one kernel, gridded over k blocks). The whole thing is wrapped in
+``jax.custom_vjp`` so training differentiates through the Pallas path.
+
+Falls back to an XLA einsum+softmax (which XLA fuses reasonably) for
+shapes that don't tile; the fallback on kernel *failure* is flag-gated
+(FLAGS_flash_allow_fallback) and logged — never silent.
 """
 from __future__ import annotations
 
 import functools
+import logging
 import math
 
 import jax
 import jax.numpy as jnp
 
 from ..core.dispatch import run_op
+from ..core.flags import define_flag, get_flag
+
+logger = logging.getLogger("paddle_tpu.kernels.flash_attention")
+
+define_flag("flash_allow_fallback", True,
+            "on Pallas flash-attention kernel failure, log and fall back "
+            "to the XLA path instead of raising")
 
 # block sizes chosen for v5e: last dim 128 lanes; bf16 sublane 16
 BLOCK_Q = 128
 BLOCK_K = 128
 NEG_INF = -1e30
+# lse/delta row-stat arrays are (B*H, S, 1) in HBM: narrow loads/stores
+# legalize fine (measured on the axon Mosaic) and a wider layout would
+# multiply HBM bytes for data the kernels only read at [:, :1] anyway.
+STAT_LANES = 1
+# loop *carries*, by contrast, must be full-lane-width: (bq, 1) carries
+# fail Mosaic's 'func.return' legalization on the loop region boundary.
+CARRY_LANES = 128
+
+# Resolved at import so an API move in a future JAX surfaces loudly here,
+# not as a spurious "kernel failure" inside the flag-gated fallback.
+try:  # public spelling on JAX versions that still export it
+    from jax.experimental import enable_x64 as _enable_x64
+except ImportError:
+    from jax._src.config import enable_x64 as _enable_x64
+
+_warned_keys = set()
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, scale, block_k,
-                  seq_k):
+def _x32_trace():
+    """Trace-time x64 off around pallas_call.
+
+    The package enables jax x64 globally (paddle's int64 default); under
+    x64 Pallas lowers its grid loop with i64 scalars, which this Mosaic
+    build cannot legalize ('func.return' on an (i32, i32, i64) loop
+    boundary — measured on the axon compile helper; a trivial gridded
+    kernel already fails). All kernels here pin their own dtypes, so
+    tracing them in x32 is semantics-preserving.
+    """
+    return _enable_x64(False)
+
+
+def _log_fallback(exc, site):
+    if not get_flag("flash_allow_fallback"):
+        raise exc
+    key = (site, type(exc).__name__)
+    if key not in _warned_keys:
+        logger.warning(
+            "Pallas flash-attention %s kernel failed (%s: %s); falling "
+            "back to the XLA attention path. Set "
+            "FLAGS_flash_allow_fallback=0 to make this an error.",
+            site, type(exc).__name__, exc)
+        _warned_keys.add(key)
+
+
+_pallas_probe_ok = None
+
+
+def _pallas_supported():
+    """One-time probe: compile+run a trivial gridded Mosaic kernel.
+
+    Python try/except around pallas_call only sees trace-time failures;
+    Mosaic legalization errors surface later, when the *caller's* jit
+    compiles — outside any except block here. Eagerly compiling a tiny
+    kernel once per process catches platform-level Mosaic breakage (the
+    dominant failure mode) up front, so flash_attention_arrays can route
+    to XLA before baking an uncompilable kernel into the user's program.
+    """
+    global _pallas_probe_ok
+    if _pallas_probe_ok is None:
+        from jax.experimental import pallas as pl
+
+        def probe(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * jnp.float32(2.0)
+
+        try:
+            with _x32_trace():
+                x = jnp.ones((8, 128), jnp.float32)
+                out = pl.pallas_call(
+                    probe, grid=(1,),
+                    in_specs=[pl.BlockSpec((8, 128), lambda i: (0, 0))],
+                    out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0)),
+                    out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+                )(x)
+                out.block_until_ready()
+            _pallas_probe_ok = True
+        except Exception as exc:  # noqa: BLE001 — probe, logged
+            logger.warning(
+                "Pallas/Mosaic probe kernel failed on this platform "
+                "(%s: %s); flash attention uses the XLA path.",
+                type(exc).__name__, exc)
+            _pallas_probe_ok = False
+    return _pallas_probe_ok
+
+
+# ---------------------------------------------------------------------------
+# causal-band bounds shared by all three kernels
+# ---------------------------------------------------------------------------
+
+def _causal_k_hi(q_idx, bq, diag_off, block_k, nblocks):
+    """Exclusive upper bound on k-block index for rows of q block q_idx:
+    the last attended key is q_pos_max + diag_off (bottom-right-aligned
+    band). int32 throughout — Mosaic cannot lower i64."""
+    last_k = ((q_idx.astype(jnp.int32) + 1) * jnp.int32(bq)
+              - jnp.int32(1) + jnp.int32(diag_off))
+    return jnp.clip(last_k // jnp.int32(block_k) + jnp.int32(1),
+                    jnp.int32(0), jnp.int32(nblocks))
+
+
+def _causal_q_lo(k_idx, bk, diag_off, block_q, nblocks):
+    """Inclusive lower bound on q-block index that can see k block k_idx:
+    first row with q_pos >= k_block_start - diag_off."""
+    first_q = k_idx.astype(jnp.int32) * jnp.int32(bk) - jnp.int32(diag_off)
+    return jnp.clip(first_q // jnp.int32(block_q), jnp.int32(0),
+                    jnp.int32(nblocks))
+
+
+def _band_mask(s, q_start, k_start, diag_off, neg_inf):
+    """Apply the bottom-right-aligned causal band to a [BQ, BK] score
+    tile whose rows start at q_start and columns at k_start: query i
+    attends key j iff i + diag_off >= j. Shared by all three kernels so
+    fwd and bwd can never mask different patterns."""
+    bq, bk = s.shape
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(q_pos + jnp.int32(diag_off) >= k_pos, s, neg_inf)
+
+
+# rows with every key masked (causal with seq_q > seq_k) have lse pinned
+# at ~NEG_INF; this threshold identifies them so fwd emits 0 (flash-attn
+# v2 convention) and bwd assigns them zero probability mass instead of
+# exp(s - lse) = 1 garbage
+ROW_INVALID_LSE = NEG_INF / 2
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse, causal, scale,
+                      block_k, seq_k, seq_q, diag_off):
     """One (batch*head, q_block) program: stream K/V tiles, online softmax.
 
     Refs are VMEM tiles: q [BQ, D], k/v [S_k, D] (full K/V rows for this
-    head), o [BQ, D].
+    head), o [BQ, D], and — only when the call is being differentiated —
+    lse [BQ, STAT_LANES] (row logsumexp, consumed by the bwd kernels).
+
+    Causal masking is bottom-right aligned like the XLA fallback and
+    flash-attn v2 (KV-cache decode convention): query i attends keys
+    j <= i + (seq_k - seq_q); ``diag_off`` carries that offset.
     """
     from jax.experimental import pallas as pl
 
@@ -39,10 +185,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, scale, block_k,
     q_idx = pl.program_id(1)
     neg_inf = jnp.float32(NEG_INF)
 
-    # online-softmax stats kept 2-D (bq, 1): Mosaic legalizes 2-D
-    # vectors; 1-D carries fail ('func.return' legalization)
-    m = jnp.full((bq, 1), neg_inf, jnp.float32)
-    l = jnp.zeros((bq, 1), jnp.float32)
+    # online-softmax stats kept (bq, CARRY_LANES) with the row value
+    # broadcast across lanes: loop carries must be full-lane-width
+    # vectors — (bq, 1) carries fail Mosaic's 'func.return' legalization
+    # on the loop region boundary (measured on the axon helper's Mosaic;
+    # narrow intermediates inside the body are fine).
+    m = jnp.full((bq, CARRY_LANES), neg_inf, jnp.float32)
+    l = jnp.zeros((bq, CARRY_LANES), jnp.float32)
     acc = jnp.zeros((bq, d), jnp.float32)
 
     nblocks = seq_k // block_k
@@ -55,38 +204,40 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, scale, block_k,
             q, k_tile, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bq, block_k]
         if causal:
-            q_pos = q_idx.astype(jnp.int32) * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 0)
-            k_pos = i * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, neg_inf)
+            s = _band_mask(s, q_idx.astype(jnp.int32) * bq, i * block_k,
+                           diag_off, neg_inf)
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_cur)
+        p = jnp.exp(s - m_cur[:, :1])
         alpha = jnp.exp(m_prev - m_cur)
         l_cur = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc_cur = acc_prev * alpha + jax.lax.dot_general(
+        acc_cur = acc_prev * alpha[:, :1] + jax.lax.dot_general(
             p, v_tile, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_cur, l_cur, acc_cur
 
-    if causal:
-        # only iterate k blocks that intersect the causal triangle.
-        # NB: keep all loop-bound math in int32 — the package enables x64
-        # globally and Mosaic cannot lower int64 (its convert helper
-        # recurses).
-        hi = jnp.minimum(
-            jnp.int32(nblocks),
-            (q_idx.astype(jnp.int32) + 1) * jnp.int32(bq)
-            // jnp.int32(block_k) + 1).astype(jnp.int32)
-    else:
-        hi = jnp.int32(nblocks)
+    # causal: only iterate k blocks that intersect the band
+    hi = _causal_k_hi(q_idx, bq, diag_off, block_k, nblocks) if causal \
+        else jnp.int32(nblocks)
     m, l, acc = jax.lax.fori_loop(jnp.int32(0), hi, body, (m, l, acc))
-    o_ref[...] = (acc / jnp.maximum(l, jnp.float32(1e-30))
-                  ).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, jnp.float32(1e-30))
+    # fully-masked rows (causal, seq_q > seq_k) would otherwise emit the
+    # mean of visited V (p = exp(s - m) = 1 when every s == m == NEG_INF)
+    row_valid = m[:, :1] > jnp.float32(ROW_INVALID_LSE)
+    o_ref[...] = jnp.where(row_valid, acc / l_safe[:, :1],
+                           jnp.float32(0.0)).astype(o_ref.dtype)
+    if maybe_lse:
+        lse_ref = maybe_lse[0]
+        lse = jnp.where(row_valid, (m + jnp.log(l_safe))[:, :1], neg_inf)
+        lse_ref[...] = lse[:, :STAT_LANES].astype(lse_ref.dtype)
 
 
-def _flash_pallas(q, k, v, causal, scale, interpret=False):
-    """q/k/v: [B, H, S, D] → out [B, H, S, D]."""
+def _flash_pallas_fwd(q, k, v, causal, scale, interpret=False,
+                      want_lse=True):
+    """q/k/v: [B, H, S, D] → (out [B, H, S, D], lse [B*H, S, STAT_LANES]).
+
+    want_lse=False (inference / non-differentiated primal) skips the lse
+    output entirely — no extra HBM write; returns (out, None).
+    """
     from jax.experimental import pallas as pl
 
     b, h, sq, d = q.shape
@@ -97,23 +248,250 @@ def _flash_pallas(q, k, v, causal, scale, interpret=False):
     kr = k.reshape(b * h, sk, d)
     vr = v.reshape(b * h, sk, d)
 
-    kernel = functools.partial(_flash_kernel, causal=causal, scale=scale,
-                               block_k=bk, seq_k=sk)
-    out = pl.pallas_call(
-        kernel,
-        grid=(b * h, sq // bq),
-        in_specs=[
-            # None squeezes the batch*head dim so refs are [S, D] tiles
-            pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-        interpret=interpret,
-    )(qr, kr, vr)
-    return out.reshape(b, h, sq, d)
+    kernel = functools.partial(_flash_fwd_kernel, causal=causal, scale=scale,
+                               block_k=bk, seq_k=sk, seq_q=sq,
+                               diag_off=sk - sq)
+    out_specs = [pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0))]
+    out_shape = [jax.ShapeDtypeStruct((b * h, sq, d), q.dtype)]
+    if want_lse:
+        out_specs.append(
+            pl.BlockSpec((None, bq, STAT_LANES), lambda i, j: (i, j, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * h, sq, STAT_LANES), jnp.float32))
+    with _x32_trace():
+        res = pl.pallas_call(
+            kernel,
+            grid=(b * h, sq // bq),
+            in_specs=[
+                # None squeezes the batch*head dim so refs are [S, D] tiles
+                pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+            ],
+            out_specs=out_specs,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(qr, kr, vr)
+    if want_lse:
+        out, lse = res
+        return out.reshape(b, h, sq, d), lse
+    return res[0].reshape(b, h, sq, d), None
 
+
+# ---------------------------------------------------------------------------
+# backward kernels (FlashAttention-2 recompute style)
+# ---------------------------------------------------------------------------
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, causal, scale, block_k, seq_k, diag_off):
+    """One (batch*head, q_block) program accumulating dQ.
+
+    dS = P ∘ (dO·Vᵀ − Δ) with P = exp(S − lse), Δ = rowsum(dO ∘ O);
+    dQ = scale · dS·K.
+    """
+    from jax.experimental import pallas as pl
+
+    q = q_ref[...].astype(jnp.float32)
+    bq, d = q.shape
+    q_idx = pl.program_id(1)
+    neg_inf = jnp.float32(NEG_INF)
+    do = do_ref[...].astype(jnp.float32)
+    lse = lse_ref[:, :1].astype(jnp.float32)       # [bq, 1]
+    delta = delta_ref[:, :1].astype(jnp.float32)   # [bq, 1]
+    qs = q * jnp.float32(scale)
+
+    nblocks = seq_k // block_k
+
+    def body(i, acc):
+        k_tile = k_ref[pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v_tile = v_ref[pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qs, k_tile, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            s = _band_mask(s, q_idx.astype(jnp.int32) * bq, i * block_k,
+                           diag_off, neg_inf)
+        p = jnp.where(lse > jnp.float32(ROW_INVALID_LSE), jnp.exp(s - lse),
+                      jnp.float32(0.0))
+        dp = jax.lax.dot_general(
+            do, v_tile, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bk]
+        ds = p * (dp - delta)
+        return acc + jax.lax.dot_general(
+            ds, k_tile, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    hi = _causal_k_hi(q_idx, bq, diag_off, block_k, nblocks) if causal \
+        else jnp.int32(nblocks)
+    acc = jax.lax.fori_loop(
+        jnp.int32(0), hi, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[...] = (acc * jnp.float32(scale)).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, causal, scale, block_q, seq_q,
+                          diag_off):
+    """One (batch*head, k_block) program accumulating dK and dV.
+
+    dV = Pᵀ·dO; dK = scale · dSᵀ·Q.
+    """
+    from jax.experimental import pallas as pl
+
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    bk, d = k.shape
+    k_idx = pl.program_id(1)
+    neg_inf = jnp.float32(NEG_INF)
+
+    nblocks = seq_q // block_q
+
+    def body(j, carry):
+        dk_acc, dv_acc = carry
+        q_tile = q_ref[pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        do_tile = do_ref[pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(j * block_q, block_q), :1].astype(jnp.float32)
+        delta = delta_ref[pl.ds(j * block_q, block_q), :1].astype(
+            jnp.float32)
+        s = jax.lax.dot_general(
+            q_tile * jnp.float32(scale), k,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            s = _band_mask(s, j * block_q, k_idx.astype(jnp.int32) * bk,
+                           diag_off, neg_inf)
+        p = jnp.where(lse > jnp.float32(ROW_INVALID_LSE), jnp.exp(s - lse),
+                      jnp.float32(0.0))          # [bq, bk]
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, do_tile, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bk, d]
+        dp = jax.lax.dot_general(
+            do_tile, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bk]
+        ds = p * (dp - delta)
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q_tile, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bk, d]
+        return dk_acc, dv_acc
+
+    # causal: q blocks entirely above the band see nothing
+    lo = _causal_q_lo(k_idx, bk, diag_off, block_q, nblocks) if causal \
+        else jnp.int32(0)
+    zeros = jnp.zeros((bk, d), jnp.float32)
+    dk_acc, dv_acc = jax.lax.fori_loop(
+        lo, jnp.int32(nblocks), body, (zeros, zeros))
+    dk_ref[...] = (dk_acc * jnp.float32(scale)).astype(dk_ref.dtype)
+    dv_ref[...] = dv_acc.astype(dv_ref.dtype)
+
+
+def _flash_pallas_bwd(q, k, v, do, lse, delta, causal, scale,
+                      interpret=False):
+    """All [B, H, S, D] (lse/delta [B*H, S, STAT_LANES]) → dq, dk, dv."""
+    from jax.experimental import pallas as pl
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = min(BLOCK_Q, sq)
+    bk = min(BLOCK_K, sk)
+    qr = q.reshape(b * h, sq, d)
+    kr = k.reshape(b * h, sk, d)
+    vr = v.reshape(b * h, sk, d)
+    dor = do.reshape(b * h, sq, d)
+
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, causal=causal, scale=scale, block_k=bk,
+        seq_k=sk, diag_off=sk - sq)
+    with _x32_trace():
+        dq = pl.pallas_call(
+            dq_kernel,
+            grid=(b * h, sq // bq),
+            in_specs=[
+                pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((None, bq, STAT_LANES), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((None, bq, STAT_LANES), lambda i, j: (i, j, 0)),
+            ],
+            out_specs=pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
+            out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            interpret=interpret,
+        )(qr, kr, vr, dor, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, causal=causal, scale=scale, block_q=bq,
+        seq_q=sq, diag_off=sk - sq)
+    with _x32_trace():
+        dk, dv = pl.pallas_call(
+            dkv_kernel,
+            grid=(b * h, sk // bk),
+            in_specs=[
+                pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((None, sq, STAT_LANES), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((None, sq, STAT_LANES), lambda i, j: (i, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+                jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+            ],
+            interpret=interpret,
+        )(qr, kr, vr, dor, lse, delta)
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper: the trainable Pallas path
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_pallas(q, k, v, causal, scale, interpret=False):
+    """q/k/v: [B, H, S, D] → out [B, H, S, D]; differentiable."""
+    # non-differentiated primal: skip the lse output (no HBM write)
+    out, _ = _flash_pallas_fwd(q, k, v, causal, scale, interpret=interpret,
+                               want_lse=False)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, interpret):
+    out, lse = _flash_pallas_fwd(q, k, v, causal, scale, interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(causal, scale, interpret, res, g):
+    q, k, v, out, lse = res
+    b, h, sq, d = q.shape
+    try:
+        # Δ = rowsum(dO ∘ O) — cheap elementwise+reduce; XLA fuses it.
+        # Same narrow layout the kernels read lse in.
+        delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1).reshape(b * h, sq, STAT_LANES)
+        dq, dk, dv = _flash_pallas_bwd(
+            q, k, v, g, lse, delta, causal, scale, interpret=interpret)
+    except Exception as exc:  # noqa: BLE001 — flag-gated, logged
+        # the fwd gate in flash_attention_arrays cannot see failures in
+        # the bwd kernels (they trace when the VJP is pulled); gate here
+        # too so training degrades to the XLA path instead of crashing
+        _log_fallback(exc, "bwd")
+        _, xla_vjp = jax.vjp(
+            lambda q_, k_, v_: _flash_xla(q_, k_, v_, causal, scale),
+            q, k, v)
+        dq, dk, dv = xla_vjp(g)
+    return dq, dk, dv
+
+
+_flash_pallas.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# XLA fallback + public entry points
+# ---------------------------------------------------------------------------
 
 def _flash_xla(q, k, v, causal, scale):
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
@@ -137,20 +515,20 @@ def flash_attention_arrays(q, k, v, causal=False, scale=None,
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    import jax
     # backend platform, not array placement: tracers have no devices.
-    # 'axon' (the tunneled single-chip platform) routes compiles through
-    # a remote helper that cannot build Mosaic kernels (measured: every
-    # pallas_call 500s at compile), so it takes the XLA path — which
-    # reaches the same ~73% train MFU at bench shapes; Mosaic engages on
-    # directly-attached TPU platforms.
-    on_tpu = jax.default_backend() == "tpu"
+    # 'axon' is the tunneled single-chip TPU platform; its compile helper
+    # builds Mosaic kernels fine (sub-second) once the kernels avoid
+    # narrow loop carries and i64 scalars (see _x32_trace / the
+    # STAT_LANES carry note in _flash_fwd_kernel).
+    on_tpu = jax.default_backend() in ("tpu", "axon")
     use_pallas = force_pallas or (
-        on_tpu and _tileable(qt.shape[2], kt.shape[2], qt.shape[3]))
+        on_tpu and _tileable(qt.shape[2], kt.shape[2], qt.shape[3])
+        and _pallas_supported())
     if use_pallas:
         try:
-            out = _flash_pallas(qt, kt, vt, causal, s, interpret=interpret)
-        except Exception:
+            out = _flash_pallas(qt, kt, vt, causal, s, interpret)
+        except Exception as exc:  # noqa: BLE001 — flag-gated, logged
+            _log_fallback(exc, "fwd")
             out = _flash_xla(qt, kt, vt, causal, s)
     else:
         out = _flash_xla(qt, kt, vt, causal, s)

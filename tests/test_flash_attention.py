@@ -1,0 +1,167 @@
+"""Flash-attention Pallas kernel tests (interpret mode on the CPU mesh).
+
+Reference test model: test/legacy_test/test_flash_attention.py (forward
+vs naive attention + gradient checks against the unfused path). Here the
+ground truth is the XLA einsum+softmax path, and the Pallas kernels run
+in interpret mode so CI needs no TPU.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.kernels.flash_attention import (_flash_pallas, _flash_xla,
+                                                flash_attention_arrays)
+
+
+def _mk(rng, b=1, h=2, s=256, d=128, dtype=np.float32):
+    def one():
+        return jnp.asarray(
+            rng.standard_normal((b, h, s, d)).astype(dtype) * 0.3)
+    return one(), one(), one()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_forward_matches_xla(rng, causal):
+    q, k, v = _mk(rng)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    out = _flash_pallas(q, k, v, causal, scale, True)
+    ref = _flash_xla(q, k, v, causal, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_matches_xla(rng, causal):
+    q, k, v = _mk(rng)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    # weighted sum keeps the cotangent non-uniform across rows/cols
+    w = jnp.asarray(rng.standard_normal(q.shape).astype(np.float32))
+
+    def loss_pl(q, k, v):
+        return jnp.sum(_flash_pallas(q, k, v, causal, scale, True) * w)
+
+    def loss_xla(q, k, v):
+        return jnp.sum(_flash_xla(q, k, v, causal, scale) * w)
+
+    g_pl = jax.grad(loss_pl, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in zip(g_pl, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-3, atol=2e-3,
+            err_msg=f"d{name} mismatch (causal={causal})")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_rectangular(rng, causal):
+    # cross-attention shape sq != sk; causal must be bottom-right aligned
+    # (KV-cache decode convention) on BOTH paths
+    q = jnp.asarray(rng.standard_normal((1, 2, 128, 128)).astype(np.float32)
+                    * 0.3)
+    k = jnp.asarray(rng.standard_normal((1, 2, 256, 128)).astype(np.float32)
+                    * 0.3)
+    v = jnp.asarray(rng.standard_normal((1, 2, 256, 128)).astype(np.float32)
+                    * 0.3)
+    scale = 1.0 / np.sqrt(128)
+
+    def loss_pl(q, k, v):
+        return jnp.sum(_flash_pallas(q, k, v, causal, scale, True) ** 2)
+
+    def loss_xla(q, k, v):
+        return jnp.sum(_flash_xla(q, k, v, causal, scale) ** 2)
+
+    np.testing.assert_allclose(
+        np.asarray(_flash_pallas(q, k, v, causal, scale, True)),
+        np.asarray(_flash_xla(q, k, v, causal, scale)),
+        rtol=2e-4, atol=2e-4)
+    g_pl = jax.grad(loss_pl, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for got, want in zip(g_pl, g_ref):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_flash_causal_sq_gt_sk(rng):
+    """Bottom-right causal with seq_q > seq_k: rows attending zero keys
+    emit 0 (flash-attn v2 convention) with zero, finite gradients —
+    not exp(s - lse) = 1 garbage mass."""
+    q = jnp.asarray(rng.standard_normal((1, 2, 256, 128)).astype(np.float32)
+                    * 0.3)
+    k = jnp.asarray(rng.standard_normal((1, 2, 128, 128)).astype(np.float32)
+                    * 0.3)
+    v = jnp.asarray(rng.standard_normal((1, 2, 128, 128)).astype(np.float32)
+                    * 0.3)
+    scale = 1.0 / np.sqrt(128)
+    out = _flash_pallas(q, k, v, True, scale, True)
+    # diag_off = -128: rows 0..127 attend no keys -> exactly zero
+    np.testing.assert_array_equal(np.asarray(out[:, :, :128]), 0.0)
+    # rows 128.. attend keys 0..row-128; spot-check the last row, which
+    # attends every key: plain softmax attention over all of k
+    s_last = np.asarray(q[0, 0, -1] @ np.asarray(k[0, 0]).T) * scale
+    p_last = np.exp(s_last - s_last.max())
+    p_last /= p_last.sum()
+    np.testing.assert_allclose(np.asarray(out[0, 0, -1]),
+                               p_last @ np.asarray(v[0, 0]),
+                               rtol=2e-4, atol=2e-4)
+
+    def loss(q, k, v):
+        return jnp.sum(_flash_pallas(q, k, v, True, scale, True) ** 2)
+
+    gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for g in (gq, gk, gv):
+        assert bool(jnp.all(jnp.isfinite(g)))
+    # fully-masked rows contribute no gradient anywhere
+    np.testing.assert_array_equal(np.asarray(gq[:, :, :128]), 0.0)
+
+
+def test_flash_bf16_forward(rng):
+    q, k, v = _mk(rng, dtype=np.float32)
+    q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    out = _flash_pallas(q, k, v, True, scale, True)
+    ref = _flash_xla(q, k, v, True, scale)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2)
+
+
+def test_force_pallas_trains(rng):
+    """force_pallas=True path is trainable end-to-end (VERDICT item 2)."""
+    q, k, v = _mk(rng, b=1, h=1, s=128, d=128)
+
+    def step(q, k, v):
+        # paddle layout [B, S, H, D]
+        out = flash_attention_arrays(
+            jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+            jnp.swapaxes(v, 1, 2), causal=True, force_pallas=True,
+            interpret=True)
+        return jnp.mean(out ** 2)
+
+    val, grads = jax.value_and_grad(step, argnums=(0, 1, 2))(q, k, v)
+    assert np.isfinite(float(val))
+    for g in grads:
+        assert bool(jnp.all(jnp.isfinite(g)))
+        assert float(jnp.max(jnp.abs(g))) > 0
+
+
+def test_fallback_is_flag_gated(rng, monkeypatch):
+    """Kernel failure raises when the fallback flag is off, falls back
+    (logged) when on — never silently."""
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.kernels import flash_attention as mod
+
+    def boom(*a, **kw):
+        raise RuntimeError("mosaic exploded")
+
+    monkeypatch.setattr(mod, "_flash_pallas", boom)
+    q = jnp.ones((1, 128, 2, 128), jnp.float32)  # paddle layout [B,S,H,D]
+    set_flags({"flash_allow_fallback": False})
+    try:
+        with pytest.raises(RuntimeError, match="mosaic exploded"):
+            mod.flash_attention_arrays(q, q, q, force_pallas=True)
+    finally:
+        set_flags({"flash_allow_fallback": True})
+    # with the flag on (default) it falls back to the XLA path
+    out = mod.flash_attention_arrays(q, q, q, force_pallas=True)
+    assert out.shape == (1, 128, 2, 128)
