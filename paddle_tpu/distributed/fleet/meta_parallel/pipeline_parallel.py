@@ -31,7 +31,7 @@ from ....jit.api import _clip_pytree
 from ....jit.functional import functional_call
 from ... import mesh as mesh_mod
 from ...pipeline import (merge_microbatches, pipeline_apply,
-                         split_microbatches)
+                         pipeline_apply_vpp, split_microbatches)
 from .meta_parallel_base import MetaParallelBase
 from .pp_layers import PipelineLayer
 
@@ -70,21 +70,45 @@ class PipelineParallel(MetaParallelBase):
         if self.accumulate_steps < self._pp:
             # fewer microbatches than stages leaves bubbles > compute
             self.accumulate_steps = max(self._pp, self.accumulate_steps)
+        # interleaved schedule: vpp_degree chunks per stage (reference
+        # pipeline_parallel.py:1179; strategy key matches the reference's
+        # pipeline_configs). The PipelineLayer's
+        # num_virtual_pipeline_stages wins when set (>1); the strategy
+        # key applies otherwise — conflicting non-default values raise.
+        layer_v = int(getattr(layers, "_num_virtual_stages", 1) or 1)
+        cfg_v = int(cfg.get("vpp_degree", 1) or 1)
+        if layer_v > 1 and cfg_v > 1 and layer_v != cfg_v:
+            raise ValueError(
+                f"conflicting vpp degrees: PipelineLayer has "
+                f"num_virtual_pipeline_stages={layer_v} but strategy "
+                f"pipeline_configs['vpp_degree']={cfg_v}")
+        self.vpp_degree = layer_v if layer_v > 1 else cfg_v
         self._compiled = {}
         self._state = None
 
     # -- functional state ----------------------------------------------------
     def _split_state(self):
-        """(pre_params, stacked_block_params, post_params, frozen, meta)."""
+        """(pre_params, stacked_block_params, post_params, frozen, meta).
+
+        Stacked leaves are [S, ...] (GPipe) or [S, V, ...] (interleaved):
+        stage s, virtual index v holds global layer-chunk v*S + s —
+        Megatron round-robin placement, so consecutive blocks spread
+        across stages.
+        """
         pl: PipelineLayer = self._layers
         lo, hi = pl.pipelinable_run()
         S = self._pp
+        V = self.vpp_degree
         run_len = hi - lo
-        if S > 1 and run_len >= S:
-            # trim run so it divides evenly into S stages
-            run_len -= run_len % S
+        if S > 1 and run_len >= S * V:
+            # trim run so it divides evenly into S*V chunks
+            run_len -= run_len % (S * V)
             hi = lo + run_len
         else:
+            if S > 1 and V > 1:
+                raise ValueError(
+                    f"vpp_degree={V} needs at least pp*vpp="
+                    f"{S * V} homogeneous blocks; run has {run_len}")
             lo = hi = len(pl._items)  # no pipelined region -> all prefix
         # the stacked-param schedule always carves the homogeneous run
         # into uniform chunks; warn when the user asked for something else
@@ -102,7 +126,7 @@ class PipelineParallel(MetaParallelBase):
                 "used only by the eager/segmented path", stacklevel=3)
         items = pl._items
         blocks = [items[i] for i in range(lo, hi)]
-        chunk = len(blocks) // S if S and blocks else 0
+        chunk = len(blocks) // (S * V) if S and blocks else 0
 
         pre_names, post_names = set(), set()
         block_ranges = []
@@ -134,16 +158,19 @@ class PipelineParallel(MetaParallelBase):
         pre = {k: v for k, v in all_train.items() if k in pre_names}
         post = {k: v for k, v in all_train.items() if k in post_names}
 
-        # stage param stacks: per stage, {chunkpos.localname: arr};
+        # param stacks: per (stage, virtual chunk), {chunkpos.name: arr};
         # frozen (stop_gradient) block params are stacked separately and
         # passed as non-differentiated inputs so each stage computes with
         # ITS OWN frozen values (not stage 0's)
-        stage_dicts = [dict() for _ in range(S)] if chunk else []
-        stage_frozen = [dict() for _ in range(S)] if chunk else []
+        sv_dicts = [[dict() for _ in range(V)] for _ in range(S)] \
+            if chunk else []
+        sv_frozen = [[dict() for _ in range(V)] for _ in range(S)] \
+            if chunk else []
         templates = []
         for pos, lyr, prefix in block_ranges:
-            st, cp = divmod(pos, chunk)
-            if st == 0:
+            c, cp = divmod(pos, chunk)      # global chunk, pos in chunk
+            st, v = c % S, c // S           # round-robin placement
+            if c == 0:
                 templates.append(lyr)
             if next(lyr.named_buffers(), None) is not None:
                 raise NotImplementedError(
@@ -151,10 +178,20 @@ class PipelineParallel(MetaParallelBase):
                     "stats) are not supported by the compiled schedule; "
                     "keep such layers outside the homogeneous block run")
             for n, p in lyr.named_parameters():
-                d = stage_frozen[st] if p.stop_gradient else stage_dicts[st]
+                d = sv_frozen[st][v] if p.stop_gradient else sv_dicts[st][v]
                 d[f"{cp}.{n}"] = p._data
-        stacked = _stack_tree(stage_dicts) if stage_dicts else {}
-        stacked_frozen = _stack_tree(stage_frozen) if stage_frozen else {}
+
+        def _stack_sv(sv):
+            if not sv:
+                return {}
+            if V == 1:
+                return _stack_tree([d[0] for d in sv])
+            return _stack_tree([
+                {k: jnp.stack([d[v][k] for v in range(V)])
+                 for k in d[0]} for d in sv])
+
+        stacked = _stack_sv(sv_dicts)
+        stacked_frozen = _stack_sv(sv_frozen)
         meta = dict(lo=lo, hi=hi, chunk=chunk, templates=templates,
                     stacked_frozen=stacked_frozen,
                     block_prefixes=[(pos, prefix)
@@ -175,16 +212,19 @@ class PipelineParallel(MetaParallelBase):
                     reg[name]._data = arr
         _, _, _, _, meta = self._ensure_state()
         chunk = meta["chunk"]
+        V = self.vpp_degree
         if chunk:
             for pos, prefix in meta["block_prefixes"]:
-                st, cp = divmod(pos, chunk)
-                for k, v in stacked.items():
+                c, cp = divmod(pos, chunk)
+                st, vi = c % self._pp, c // self._pp
+                for k, arr in stacked.items():
                     want = f"{cp}."
                     if k.startswith(want):
                         local = k[len(want):]
                         full = f"{prefix}.{local}"
                         if full in reg:
-                            reg[full]._data = v[st]
+                            reg[full]._data = arr[st] if V == 1 \
+                                else arr[st][vi]
 
     # -- forward (eval / debugging) -----------------------------------------
     def _resync_if_stale(self):
@@ -220,7 +260,7 @@ class PipelineParallel(MetaParallelBase):
         pl: PipelineLayer = self._layers
         pre_p, stacked, post_p, frozen, meta = self._ensure_state()
         mesh = self._mesh
-        S, M = self._pp, self.accumulate_steps
+        S, M, V = self._pp, self.accumulate_steps, self.vpp_degree
         chunk, templates = meta["chunk"], meta["templates"]
         stacked_frozen = meta["stacked_frozen"]
         lo, hi = meta["lo"], meta["hi"]
@@ -263,16 +303,12 @@ class PipelineParallel(MetaParallelBase):
                         x = unwrap(item(wrap(x)))
             return x
 
-        def block_fn(stage_params, x, key, tick):
+        def run_chunk(stage_params, x, key, mb, chunk_idx):
             # stage_params carries trainable ("t:") and frozen ("f:")
             # entries; gradients flow only to "t:" (the frozen stack
             # enters as a non-differentiated closure constant upstream).
-            from jax import lax as _lax
-            stage = _lax.axis_index("pp")
-            # microbatch this tick computes on this stage — folding the
-            # key by (microbatch, global layer index) keeps dropout masks
-            # independent of the stage assignment
-            mb = jnp.clip(tick - stage, 0, M - 1)
+            # Folding the key by (microbatch, global layer index) keeps
+            # dropout masks independent of the stage assignment.
             for cp in range(chunk):
                 tmpl = templates[cp]
                 t_want, f_want = f"t:{cp}.", f"f:{cp}."
@@ -281,7 +317,7 @@ class PipelineParallel(MetaParallelBase):
                 sub_frozen = {k[len(f_want):]: v
                               for k, v in stage_params.items()
                               if k.startswith(f_want)}
-                layer_idx = stage * chunk + cp
+                layer_idx = chunk_idx * chunk + cp
                 k = jax.random.fold_in(jax.random.fold_in(key, mb),
                                        layer_idx)
                 out, _ = functional_call(
@@ -290,8 +326,45 @@ class PipelineParallel(MetaParallelBase):
                 x = out
             return x
 
+        def block_fn(stage_params, x, key, tick):
+            # GPipe: one chunk per stage; chunk_idx == stage
+            from jax import lax as _lax
+            stage = _lax.axis_index("pp")
+            mb = jnp.clip(tick - stage, 0, M - 1)
+            return run_chunk(stage_params, x, key, mb, stage)
+
+        def block_fn_vpp(chunk_params, x, key, mb, chunk_idx):
+            return run_chunk(chunk_params, x, key, mb, chunk_idx)
+
+        from jax.sharding import NamedSharding, PartitionSpec as _P
+
+        def _pp_shardable(a):
+            return (getattr(a, "ndim", 0) >= 1 and a.shape[0] >= S
+                    and a.shape[0] % S == 0)
+
+        def _pp_shard_tree(tree):
+            """ZeRO-over-pp for the non-pipelined prefix/suffix params.
+
+            The reference places embedding on the first stage and the
+            head on the last (pp_layers.py segmentation); in one SPMD
+            program per-stage residency is expressed as sharding instead:
+            dim 0 of each prefix/suffix param (and its grads/opt state,
+            by propagation) is split over the 'pp' axis, so the vocab
+            embedding is no longer replicated on every pp rank. XLA
+            all-gathers transiently where the replicated compute needs
+            the full value.
+            """
+            if S <= 1:
+                return tree
+            return jax.tree_util.tree_map(
+                lambda a: jax.lax.with_sharding_constraint(
+                    a, NamedSharding(mesh, _P("pp")))
+                if _pp_shardable(a) else a, tree)
+
         def step(pre_p, stacked, post_p, opt_state, key, lr, inputs,
                  labels):
+            pre_p = _pp_shard_tree(pre_p)
+            post_p = _pp_shard_tree(post_p)
             def loss_of(trainable):
                 pre_p, stacked, post_p = trainable
                 pool = dict(pre_p)
@@ -304,10 +377,16 @@ class PipelineParallel(MetaParallelBase):
                     merged = {**{f"t:{k}": v for k, v in stacked.items()},
                               **{f"f:{k}": v
                                  for k, v in stacked_frozen.items()}}
-                    ys = pipeline_apply(
-                        block_fn, merged, xs,
-                        jax.random.fold_in(key, 2), mesh=mesh,
-                        n_micro=M, remat=remat)
+                    if V > 1:
+                        ys = pipeline_apply_vpp(
+                            block_fn_vpp, merged, xs,
+                            jax.random.fold_in(key, 2), vpp_degree=V,
+                            mesh=mesh, n_micro=M, remat=remat)
+                    else:
+                        ys = pipeline_apply(
+                            block_fn, merged, xs,
+                            jax.random.fold_in(key, 2), mesh=mesh,
+                            n_micro=M, remat=remat)
                     x = merge_microbatches(ys)
                 x = run_items(items[hi:], pool, x,
                               jax.random.fold_in(key, 3))
@@ -328,12 +407,18 @@ class PipelineParallel(MetaParallelBase):
                 flat_g = _clip_pytree(flat_g, optimizer._grad_clip)
             new_flat, new_state = optimizer.apply_gradients_pytree(
                 flat_p, flat_g, opt_state, lr)
-            n_pre = {k[len("pre."):]: v for k, v in new_flat.items()
-                     if k.startswith("pre.")}
+            n_pre = _pp_shard_tree(
+                {k[len("pre."):]: v for k, v in new_flat.items()
+                 if k.startswith("pre.")})
             n_blk = {k[len("blk."):]: v for k, v in new_flat.items()
                      if k.startswith("blk.")}
-            n_post = {k[len("post."):]: v for k, v in new_flat.items()
-                      if k.startswith("post.")}
+            n_post = _pp_shard_tree(
+                {k[len("post."):]: v for k, v in new_flat.items()
+                 if k.startswith("post.")})
+            new_state = {
+                k: _pp_shard_tree(v)
+                if (k.startswith("pre.") or k.startswith("post.")) else v
+                for k, v in new_state.items()}
             return n_pre, n_blk, n_post, new_state, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2, 3))

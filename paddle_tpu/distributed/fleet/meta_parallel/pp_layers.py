@@ -79,6 +79,9 @@ class PipelineLayer(Layer):
         self._loss_fn = loss_fn
         self._seg_method = seg_method
         self._recompute_interval = int(recompute_interval)
+        # interleaved-schedule chunks per stage (reference
+        # pp_layers.py:208 PipelineLayerChunk / VPP)
+        self._num_virtual_stages = int(num_virtual_pipeline_stages or 1)
         self._shared_layers = {}
 
         built: List[Any] = []

@@ -1,1 +1,2 @@
-from .recompute import recompute, recompute_sequential  # noqa: F401
+from .recompute import (checkpoint_name, recompute,  # noqa: F401
+                        recompute_sequential, save_only_names)

@@ -55,6 +55,25 @@ def _owning_layers(function):
     return found
 
 
+def checkpoint_name(x, name):
+    """Tag a Tensor as a named rematerialization boundary.
+
+    Selective-recompute policies (reference recompute_granularity:
+    paddle configs choose full/core_attn-style granularity) reference
+    these names: `recompute(fn, x, policy=save_only_names(...))` keeps
+    the tagged activations and recomputes everything else. A no-op under
+    full recompute and outside jax.checkpoint.
+    """
+    from jax.ad_checkpoint import checkpoint_name as jcn
+    return run_op("checkpoint_name", lambda a: jcn(a, name), [x])
+
+
+def save_only_names(*names):
+    """Policy: save only checkpoint_name-tagged activations with these
+    names; rematerialize everything else inside the checkpointed region."""
+    return jax.checkpoint_policies.save_only_these_names(*names)
+
+
 def recompute(function, *args, **kwargs):
     """Run `function(*args)` with activation rematerialization.
 
@@ -63,9 +82,12 @@ def recompute(function, *args, **kwargs):
     key is an input of the checkpointed computation.
     use_reentrant: accepted for API parity; both modes map to
     jax.checkpoint.
+    policy: optional jax.checkpoint_policies policy (e.g.
+    save_only_names("attn_core", "ffn_mid")) for selective recompute.
     """
     kwargs.pop("preserve_rng_state", True)
     kwargs.pop("use_reentrant", True)
+    policy = kwargs.pop("policy", None)
     for v in kwargs.values():
         if isinstance(v, Tensor):
             raise ValueError(
@@ -109,7 +131,9 @@ def recompute(function, *args, **kwargs):
         return out_arrays, new_bufs
 
     inputs = list(args) + [p for _, _, p in named]
-    out, new_bufs = run_op("recompute", jax.checkpoint(pure), inputs)
+    ckpt = jax.checkpoint(pure, policy=policy) if policy is not None \
+        else jax.checkpoint(pure)
+    out, new_bufs = run_op("recompute", ckpt, inputs)
     for (li, n), t in zip(buf_keys, new_bufs):
         reg = {bn: b for bn, b in layers[li].named_buffers()}
         reg[n]._data = unwrap(t)

@@ -98,10 +98,15 @@ def _pallas_supported():
         from jax.experimental import pallas as pl
 
         def probe(x_ref, o_ref):
-            o_ref[...] = x_ref[...] * jnp.float32(2.0)
+            # x + x, not x * const: under ensure_compile_time_eval a
+            # jnp constant would concretize and trip pallas's
+            # captured-constant check
+            o_ref[...] = x_ref[...] + x_ref[...]
 
         try:
-            with _x32_trace():
+            # the probe may be reached while tracing the caller's jit;
+            # ensure_compile_time_eval keeps it a real eager compile+run
+            with jax.ensure_compile_time_eval(), _x32_trace():
                 x = jnp.ones((8, 128), jnp.float32)
                 out = pl.pallas_call(
                     probe, grid=(1,),
@@ -109,7 +114,7 @@ def _pallas_supported():
                     out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0)),
                     out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
                 )(x)
-                out.block_until_ready()
+                jax.block_until_ready(out)
             _pallas_probe_ok = True
         except Exception as exc:  # noqa: BLE001 — probe, logged
             logger.warning(

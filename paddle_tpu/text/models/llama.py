@@ -46,6 +46,16 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     use_flash_attention: bool = True
     sequence_parallel: bool = False
+    # activation checkpointing per decoder layer (reference
+    # recompute_interval semantics): required to fit 1B+ params at
+    # seq>=2048 in one chip's HBM
+    recompute: bool = False
+    # "full" reruns the whole layer in backward (~2N extra FLOPs/token);
+    # "selective" saves the attention-core output and the SwiGLU mid
+    # activation (checkpoint_name tags) so backward only recomputes the
+    # cheap projections/norms — the reference's recompute_granularity
+    # knob, TPU-style via jax.checkpoint policies
+    recompute_granularity: str = "full"
     dtype: str = "float32"
 
     @staticmethod
@@ -126,6 +136,8 @@ class LlamaAttention(Layer):
         else:
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
         out = out.reshape([b, s, self.num_heads * self.head_dim])
+        from ...distributed.fleet.recompute import checkpoint_name
+        out = checkpoint_name(out, "attn_core")
         return self.o_proj(out)
 
 
@@ -148,7 +160,10 @@ class LlamaMLP(Layer):
             self.down_proj = Linear(im, hs, bias_attr=False)
 
     def forward(self, x):
-        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+        from ...distributed.fleet.recompute import checkpoint_name
+        mid = checkpoint_name(F.silu(self.gate_proj(x)) * self.up_proj(x),
+                              "ffn_mid")
+        return self.down_proj(mid)
 
 
 class LlamaDecoderLayer(Layer):
@@ -192,8 +207,22 @@ class LlamaModel(Layer):
             from ...distributed.fleet.utils.sequence_parallel_utils import \
                 scatter
             x = scatter(x)
-        for lyr in self.layers:
-            x = lyr(x)
+        if self.config.recompute:
+            from ...distributed.fleet.recompute import (recompute,
+                                                        save_only_names)
+            gran = self.config.recompute_granularity
+            if gran not in ("full", "selective"):
+                raise ValueError(
+                    f"recompute_granularity={gran!r}: expected 'full' or "
+                    "'selective'")
+            policy = None
+            if gran == "selective":
+                policy = save_only_names("attn_core", "ffn_mid")
+            for lyr in self.layers:
+                x = recompute(lyr, x, policy=policy)
+        else:
+            for lyr in self.layers:
+                x = lyr(x)
         return self.norm(x)
 
 
