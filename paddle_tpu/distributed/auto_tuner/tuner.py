@@ -85,17 +85,81 @@ class AutoTuner:
         return [c for c in cands
                 if self.estimate_memory(c) <= self.config.hbm_bytes]
 
+    # -- measured trials (reference: tuner launches real trial runs) ---------
+    def launch_trial(self, cfg: Dict, steps: int = 4,
+                     timeout: float = 300.0) -> float:
+        """Run one candidate as a subprocess dryrun on the virtual mesh
+        and return measured steps/sec (-inf on failure, so broken
+        configs lose instead of aborting the search). Reference:
+        auto_tuner/tuner.py launches each pruned candidate and records
+        its metric."""
+        import json
+        import os
+        import re
+        import subprocess
+        import sys
+
+        # run trial.py BY PATH, not -m: python -m would import the
+        # paddle_tpu parent package (and initialize the site-pinned jax
+        # backend) before the trial can force the virtual-CPU platform
+        trial_path = os.path.join(os.path.dirname(__file__), "trial.py")
+        cmd = [sys.executable, trial_path,
+               "--config", json.dumps(cfg),
+               "--num-devices", str(self.config.num_devices),
+               "--steps", str(steps)]
+        env = dict(os.environ)
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       env.get("XLA_FLAGS", "")).strip()
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count="
+            f"{self.config.num_devices}").strip()
+        env["JAX_PLATFORMS"] = "cpu"
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        try:
+            out = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=timeout, env=env)
+        except subprocess.TimeoutExpired:
+            return -float("inf")
+        for line in reversed(out.stdout.strip().splitlines()):
+            try:
+                res = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if res.get("ok"):
+                return float(res["steps_per_sec"])
+            break
+        return -float("inf")
+
     # -- search loop ---------------------------------------------------------
-    def tune(self) -> Dict:
-        best, best_score = None, -float("inf")
-        for cfg in self.prune(self.candidates()):
-            score = self.trial_fn(cfg) if self.trial_fn else \
-                -self.estimate_memory(cfg)
-            self.history.append({"config": cfg, "score": score})
-            if score > best_score:
-                best, best_score = cfg, score
-        if best is None:
+    def tune(self, measure: bool = False, top_k: int = 4) -> Dict:
+        """Pick the best config. measure=False scores by the memory
+        model (cheap); measure=True launches the top_k pruned candidates
+        as subprocess trials and picks the measured-fastest."""
+        pruned = self.prune(self.candidates())
+        if not pruned:
             raise RuntimeError("auto-tuner: every candidate was pruned "
                                "by the memory model")
+        if measure:
+            # rank by the memory model first so the measured trials spend
+            # time on the likeliest candidates
+            pruned = sorted(pruned, key=self.estimate_memory)[:top_k]
+        best, best_score = None, -float("inf")
+        for cfg in pruned:
+            if measure:
+                score = self.launch_trial(cfg)
+            elif self.trial_fn:
+                score = self.trial_fn(cfg)
+            else:
+                score = -self.estimate_memory(cfg)
+            self.history.append({"config": cfg, "score": score})
+            if best is None or score > best_score:
+                best, best_score = cfg, score
+        if not math.isfinite(best_score) and measure:
+            raise RuntimeError(
+                "auto-tuner: every measured trial failed; see history "
+                f"for configs tried: {[h['config'] for h in self.history]}")
         return {"best_config": best, "best_score": best_score,
                 "n_trials": len(self.history)}

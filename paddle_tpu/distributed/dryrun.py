@@ -185,6 +185,7 @@ def run_dryrun(n_devices: int) -> None:
     _dryrun_moe(jax, n_devices)
     _dryrun_context_parallel(jax, n_devices)
     _dryrun_hybrid_3d(jax, n_devices)
+    _dryrun_dcn(jax, n_devices)
 
 
 def _dryrun_pipeline(jax, n_devices: int) -> None:
@@ -317,6 +318,62 @@ def _dryrun_vpp(jax, n_devices: int) -> None:
             o1).numpy()) for _ in range(2)]
 
     _assert_aligned("vpp", [l0, l1],
+                    _single_device_losses(jax, single_run))
+
+
+def _dryrun_dcn(jax, n_devices: int) -> None:
+    """Phase 6: multi-slice mesh — data parallelism over the DCN (slice)
+    dimension, sharding+mp over ICI within each slice (SURVEY §7.3
+    multi-slice; VERDICT r2 item 5: dcn_dp=2 x ici=4)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed import mesh as mesh_mod
+
+    if n_devices % 8 != 0:
+        print("dryrun dcn: skipped (needs a multiple of 8 devices)")
+        return
+    mesh_mod.set_mesh(mesh_mod.build_mesh(
+        {"dp": n_devices // 8, "sharding": 2, "mp": 2},
+        dcn_degrees={"dp": 2}))
+    assert mesh_mod.axis_degree("dp") == n_devices // 4
+
+    hidden, batch = 16, 4 * mesh_mod.axis_degree("dp")
+    paddle.seed(0)
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(hidden, 4 * hidden)
+            self.fc2 = nn.Linear(4 * hidden, 8)
+
+        def forward(self, x):
+            return self.fc2(paddle.nn.functional.gelu(self.fc1(x)))
+
+    net = Net()
+    opt = paddle.optimizer.AdamW(1e-3, parameters=net.parameters())
+    step = paddle.jit.TrainStep(net, nn.CrossEntropyLoss(), opt)
+    rng = np.random.default_rng(6)
+    x_np = rng.standard_normal((batch, hidden)).astype(np.float32)
+    y_np = rng.integers(0, 8, batch)
+    with jax.set_mesh(mesh_mod.get_mesh()):
+        l0 = float(step(paddle.to_tensor(x_np),
+                        paddle.to_tensor(y_np)).numpy())
+        l1 = float(step(paddle.to_tensor(x_np),
+                        paddle.to_tensor(y_np)).numpy())
+    assert np.isfinite(l0) and np.isfinite(l1), (l0, l1)
+    print(f"dryrun dcn ok: dcn_dp=2 x ici=(sharding=2,mp=2) "
+          f"loss0={l0:.4f} loss1={l1:.4f}")
+
+    def single_run():
+        paddle.seed(0)
+        n1 = Net()
+        o1 = paddle.optimizer.AdamW(1e-3, parameters=n1.parameters())
+        s1 = paddle.jit.TrainStep(n1, nn.CrossEntropyLoss(), o1)
+        return [float(s1(paddle.to_tensor(x_np),
+                         paddle.to_tensor(y_np)).numpy())
+                for _ in range(2)]
+
+    _assert_aligned("dcn", [l0, l1],
                     _single_device_losses(jax, single_run))
 
 

@@ -43,24 +43,27 @@ def build_parser():
                    help="visible device selection (informational on TPU)")
     p.add_argument("--job_id", type=str, default="default")
     p.add_argument("--backend", type=str, default=None)
+    p.add_argument("--elastic_level", type=int, default=0,
+                   help="0: kill pod on first failure (default); 1: "
+                        "relaunch survivors with the new world size, "
+                        "resuming from the latest checkpoint (reference "
+                        "fleet/elastic/manager.py:125,218-253)")
+    p.add_argument("--max_restarts", type=int, default=3,
+                   help="elastic relaunch budget")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p
 
 
-def launch(args=None):
-    ns = build_parser().parse_args(args)
-    world = ns.nnodes * ns.nproc_per_node
-    if ns.nnodes > 1 and not ns.master:
-        raise SystemExit("--master host:port is required for nnodes>1")
-    master = ns.master or "127.0.0.1:49175"
-
+def _run_pod(ns, nproc, world, master, restart_count):
+    """Spawn one generation of worker processes; wait for completion or
+    first failure. Returns (exit_code, n_alive_at_failure)."""
     os.makedirs(ns.log_dir, exist_ok=True)
     procs = []
     logs = []
     try:
-        for local_rank in range(ns.nproc_per_node):
-            rank = ns.rank * ns.nproc_per_node + local_rank
+        for local_rank in range(nproc):
+            rank = ns.rank * nproc + local_rank
             env = dict(os.environ)
             env.update({
                 "PADDLE_TRAINER_ID": str(rank),
@@ -70,10 +73,13 @@ def launch(args=None):
                 "MASTER_ADDR": master.split(":")[0],
                 "MASTER_PORT": master.split(":")[-1],
                 "PADDLE_JOB_ID": ns.job_id,
+                "PADDLE_RESTART_COUNT": str(restart_count),
             })
             if ns.devices is not None:
                 env["PADDLE_VISIBLE_DEVICES"] = ns.devices
             log_path = os.path.join(ns.log_dir, f"workerlog.{rank}")
+            if restart_count:
+                log_path += f".restart{restart_count}"
             logf = open(log_path, "w")
             logs.append(logf)
             cmd = [sys.executable, ns.training_script] + \
@@ -81,7 +87,7 @@ def launch(args=None):
             procs.append(subprocess.Popen(
                 cmd, env=env, stdout=logf, stderr=subprocess.STDOUT))
 
-        # watcher: kill the pod on first failure (reference watcher role)
+        # watcher: stop the pod on first failure (reference watcher role)
         exit_code = 0
         running = list(procs)
         while running and exit_code == 0:
@@ -94,6 +100,7 @@ def launch(args=None):
                 elif rc != 0:
                     exit_code = rc
             running = still
+        alive = len(running)
         if exit_code != 0:
             for p in procs:
                 if p.poll() is None:
@@ -103,10 +110,44 @@ def launch(args=None):
                     p.wait(timeout=10)
                 except subprocess.TimeoutExpired:
                     p.kill()
-        return exit_code
+        return exit_code, alive
     finally:
         for f in logs:
             f.close()
+
+
+def launch(args=None):
+    ns = build_parser().parse_args(args)
+    if ns.nnodes > 1 and not ns.master:
+        raise SystemExit("--master host:port is required for nnodes>1")
+    if ns.elastic_level and ns.nnodes > 1:
+        # each node's launcher only sees local failures; shrinking nproc
+        # per-node would desynchronize world size across nodes. Node-level
+        # elasticity needs the store-based membership (fleet.elastic
+        # ElasticManager) driving a coordinated restart.
+        raise SystemExit(
+            "--elastic_level currently supports single-node jobs "
+            "(nnodes=1); multi-node elasticity is coordinated through "
+            "fleet.elastic")
+    master = ns.master or "127.0.0.1:49175"
+
+    nproc = ns.nproc_per_node
+    restarts = 0
+    while True:
+        world = ns.nnodes * nproc
+        exit_code, alive = _run_pod(ns, nproc, world, master, restarts)
+        if exit_code == 0 or not ns.elastic_level or \
+                restarts >= ns.max_restarts:
+            return exit_code
+        # elastic relaunch (reference manager.py:125: watch detects the
+        # lost member, launcher restarts with the new world size; the
+        # training script resumes from its latest checkpoint)
+        new_nproc = max(1, alive)
+        print(f"launch: rank failure (exit {exit_code}); elastic "
+              f"relaunch {restarts + 1}/{ns.max_restarts} with "
+              f"nproc {nproc} -> {new_nproc}", flush=True)
+        nproc = new_nproc
+        restarts += 1
 
 
 def main():

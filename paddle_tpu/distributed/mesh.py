@@ -31,11 +31,22 @@ _global_mesh: Optional[Mesh] = None
 
 
 def build_mesh(degrees: Dict[str, int], devices=None,
-               axis_order: Sequence[str] = HYBRID_AXES) -> Mesh:
+               axis_order: Sequence[str] = HYBRID_AXES,
+               dcn_degrees: Optional[Dict[str, int]] = None) -> Mesh:
     """Build a Mesh from per-axis degrees (missing axes default to 1).
 
     Axes with degree 1 are still materialised so sharding specs can always
     name every axis regardless of the configured topology.
+
+    dcn_degrees: multi-slice topology (SURVEY §7.1 ProcessGroup row /
+    §7.3 multi-slice; reference counterpart is the multi-node launch +
+    master rendezvous, launch/controllers/master.py). Each named axis's
+    total degree becomes dcn_degree * ici_degree with the DCN part as the
+    slow (outer) component, so collectives over the axis's inner part ride
+    ICI within one slice and only the outer part crosses the
+    data-center network. E.g. degrees={'dp': 2, 'mp': 4},
+    dcn_degrees={'dp': 2} on 2 slices of 4 chips: mp stays intra-slice,
+    dp spans slices.
     """
     devices = list(devices if devices is not None else jax.devices())
     full = {ax: int(degrees.get(ax, 1)) for ax in axis_order}
@@ -43,6 +54,34 @@ def build_mesh(degrees: Dict[str, int], devices=None,
     axis_names = tuple(axis_order) + tuple(extra)
     for k in extra:
         full[k] = int(degrees[k])
+
+    if dcn_degrees:
+        bad = [k for k in dcn_degrees if k not in axis_names]
+        if bad:
+            raise ValueError(f"unknown dcn axes {bad}")
+        dcn = {ax: int(dcn_degrees.get(ax, 1)) for ax in axis_names}
+        total = {ax: full[ax] * dcn[ax] for ax in axis_names}
+        n = math.prod(total.values())
+        if n > len(devices):
+            raise ValueError(
+                f"mesh degrees {total} need {n} devices, have "
+                f"{len(devices)}")
+        # group devices by slice: real TPU slices expose slice_index;
+        # the virtual CPU mesh (and single-slice platforms) fall back to
+        # contiguous equal blocks — device order from jax.devices() is
+        # already slice-major on multi-slice systems.
+        devs = devices[:n]
+        dcn_shape = tuple(dcn[ax] for ax in axis_names)
+        ici_shape = tuple(full[ax] for ax in axis_names)
+        arr = np.asarray(devs, dtype=object).reshape(dcn_shape + ici_shape)
+        # interleave [dcn_0, ici_0, dcn_1, ici_1, ...] then merge pairs,
+        # making DCN the outer component of every named axis
+        k = len(axis_names)
+        order = [i for pair in ((d, d + k) for d in range(k)) for i in pair]
+        arr = arr.transpose(order).reshape(
+            tuple(total[ax] for ax in axis_names))
+        return Mesh(arr, axis_names)
+
     n = math.prod(full.values())
     if n > len(devices):
         raise ValueError(

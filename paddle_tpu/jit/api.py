@@ -71,6 +71,8 @@ class StaticFunction:
             self._fn = fn.forward
         self._input_spec = input_spec
         self._cache = {}
+        self._always_eager = False
+        self._warned_break = False
         functools.update_wrapper(self, self._fn)
 
     @property
@@ -101,7 +103,34 @@ class StaticFunction:
             return out, new_buf
         return pure
 
+    # tracer-concretization errors = the reference's "graph break":
+    # value-dependent Python control flow the tracer cannot stage
+    # (reference jit/sot/translate.py:91 falls back to eager for the
+    # un-traceable region; here the region is the whole call)
+    _BREAK_ERRORS = (
+        jax.errors.TracerBoolConversionError,
+        jax.errors.TracerIntegerConversionError,
+        jax.errors.TracerArrayConversionError,
+        jax.errors.ConcretizationTypeError,
+    )
+
+    def _graph_break(self, exc, args, kwargs):
+        if not self._warned_break:
+            import warnings
+            name = getattr(self._fn, "__qualname__", repr(self._fn))
+            warnings.warn(
+                f"to_static({name}): value-dependent Python control flow "
+                f"cannot be traced ({type(exc).__name__}); falling back "
+                "to eager for this function. Use paddle.static.nn.cond / "
+                "while_loop to keep it compiled.", stacklevel=3)
+            self._warned_break = True
+        target = self._layer if self._layer is not None else self._fn
+        return target(*args, **kwargs)
+
     def __call__(self, *args, **kwargs):
+        if self._always_eager:
+            target = self._layer if self._layer is not None else self._fn
+            return target(*args, **kwargs)
         tensor_args = []
         static_kwargs = {}
         for a in args:
@@ -117,8 +146,12 @@ class StaticFunction:
             if entry is None:
                 entry = jax.jit(self._pure(static_kwargs))
                 self._cache[sig] = entry
-            # run as ONE tape op: compiled forward, vjp = compiled backward
-            return run_op("jit_fn", entry, tensor_args)
+            try:
+                # ONE tape op: compiled forward, vjp = compiled backward
+                return run_op("jit_fn", entry, tensor_args)
+            except self._BREAK_ERRORS as exc:
+                self._always_eager = True
+                return self._graph_break(exc, args, kwargs)
 
         layer = self._layer
         params = get_params(layer)
@@ -129,7 +162,12 @@ class StaticFunction:
             self._cache[sig] = entry
         key = random_mod.next_key()
         arrays = [unwrap(a) for a in tensor_args]
-        out_arrays, new_buf = entry(params, buffers, frozen, key, *arrays)
+        try:
+            out_arrays, new_buf = entry(params, buffers, frozen, key,
+                                        *arrays)
+        except self._BREAK_ERRORS as exc:
+            self._always_eager = True
+            return self._graph_break(exc, args, kwargs)
         write_back(layer, {}, new_buf)
         return jax.tree_util.tree_map(
             lambda a: wrap(a), out_arrays,

@@ -11,6 +11,7 @@ from __future__ import annotations
 import contextlib
 
 from ..jit.api import InputSpec  # noqa: F401
+from . import nn  # noqa: F401
 
 __all__ = ["InputSpec", "name_scope", "device_guard", "Program",
            "default_main_program", "default_startup_program"]

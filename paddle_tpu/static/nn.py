@@ -11,14 +11,51 @@ conv2d = F.conv2d
 conv3d = F.conv3d
 
 
+def _unwrap_tree(x):
+    import jax
+
+    from ..core.dispatch import unwrap
+    from ..core.tensor import Tensor
+    return jax.tree_util.tree_map(
+        lambda t: unwrap(t) if isinstance(t, Tensor) else t, x,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _wrap_tree(x):
+    import jax
+
+    from ..core.dispatch import wrap
+    return jax.tree_util.tree_map(
+        lambda a: wrap(a) if isinstance(a, jax.Array) else a, x,
+        is_leaf=lambda a: isinstance(a, jax.Array))
+
+
+def _is_traced(a):
+    import jax
+    return isinstance(a, jax.core.Tracer)
+
+
 def cond(pred, true_fn=None, false_fn=None, name=None,
          return_names=None):
-    """Eager conditional (reference: static.nn.cond builds a select
-    program; dygraph evaluates the branch)."""
+    """Conditional (reference: static.nn.cond builds a select program).
+
+    Traced (inside to_static/jit): lowers to lax.cond — both branches
+    staged, runtime select; this is the structured spelling that keeps
+    value-dependent control flow compiled instead of graph-breaking.
+    Eager: evaluates the taken branch only.
+    """
+    import jax
+    import jax.numpy as jnp
     import numpy as np
 
     from ..core.dispatch import unwrap
-    take_true = bool(np.asarray(unwrap(pred)).reshape(()))
+    p = unwrap(pred)
+    if _is_traced(p):
+        return _wrap_tree(jax.lax.cond(
+            jnp.reshape(p, ()).astype(bool),
+            lambda: _unwrap_tree(true_fn() if true_fn else None),
+            lambda: _unwrap_tree(false_fn() if false_fn else None)))
+    take_true = bool(np.asarray(p).reshape(()))
     if take_true:
         return true_fn() if true_fn is not None else None
     return false_fn() if false_fn is not None else None
@@ -50,10 +87,31 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
 
 
 def while_loop(cond, body, loop_vars, is_test=False, name=None):
-    """Eager while (reference: static.nn.while_loop)."""
+    """While (reference: static.nn.while_loop). Traced: lax.while_loop
+    (compiled loop, carries must keep shape/dtype); eager: Python loop."""
+    import jax
+    import jax.numpy as jnp
     import numpy as np
 
     from ..core.dispatch import unwrap
+    # decide by the carry leaves alone: probing cond() here would run the
+    # user condition an extra time in eager mode. A concrete carry with a
+    # cond that closes over outer tracers falls to the Python loop and
+    # surfaces as a concretization error (handled by to_static's
+    # graph-break fallback).
+    carry = tuple(_unwrap_tree(list(loop_vars)))
+    if any(_is_traced(a) for a in jax.tree_util.tree_leaves(carry)):
+        def lax_cond(c):
+            return jnp.reshape(
+                unwrap(cond(*_wrap_tree(list(c)))), ()).astype(bool)
+
+        def lax_body(c):
+            out = body(*_wrap_tree(list(c)))
+            out = out if isinstance(out, (list, tuple)) else [out]
+            return tuple(_unwrap_tree(list(out)))
+
+        res = jax.lax.while_loop(lax_cond, lax_body, carry)
+        return _wrap_tree(list(res))
     vals = list(loop_vars)
     while bool(np.asarray(unwrap(cond(*vals))).reshape(())):
         out = body(*vals)

