@@ -53,15 +53,59 @@ def wrap(arr, stop_gradient=True) -> Tensor:
     return Tensor._from_array(arr, stop_gradient=stop_gradient)
 
 
+# pending (op name, device-side bad flag) pairs — flushed in one host
+# sync every FLAGS_check_nan_inf_batch ops (default 1 = reference
+# semantics, raise at the offending op; larger values amortize the
+# device round-trip the check otherwise costs on every eager op)
+_nan_pending = []
+
+
+def _nan_report(name):
+    msg = f"Op {name} output contains NaN/Inf"
+    if get_flag("check_nan_inf_level") == 0:
+        raise FloatingPointError(msg)
+    print("WARNING:", msg)
+
+
+def flush_nan_checks():
+    """Sync and report all queued NaN/Inf flags (one device round-trip
+    for the whole batch). Called automatically every
+    FLAGS_check_nan_inf_batch ops; call explicitly at step boundaries
+    when batching is enabled."""
+    global _nan_pending
+    pending, _nan_pending = _nan_pending, []
+    if not pending:
+        return
+    if len(pending) == 1:
+        if bool(pending[0][1]):
+            _nan_report(pending[0][0])
+        return
+    vals = np.asarray(jnp.stack([b for _, b in pending]))
+    for (name, _), v in zip(pending, vals):
+        if v:
+            _nan_report(name)
+
+
 def _check_nan_inf(name, arrays):
-    for a in arrays:
-        if isinstance(a, jax.Array) and _is_inexact(a):
-            bad = bool(jnp.any(~jnp.isfinite(a)))
-            if bad:
-                msg = f"Op {name} output contains NaN/Inf"
-                if get_flag("check_nan_inf_level") == 0:
-                    raise FloatingPointError(msg)
-                print("WARNING:", msg)
+    flags = [jnp.any(~jnp.isfinite(a)) for a in arrays
+             if isinstance(a, jax.Array) and _is_inexact(a)]
+    if not flags:
+        return
+    bad = flags[0]
+    for f in flags[1:]:
+        bad = jnp.logical_or(bad, f)
+    if isinstance(bad, jax.core.Tracer):
+        # inside a jit trace: never queue tracers (a later flush would
+        # hit UnexpectedTracerError). bool() concretizes and raises the
+        # same ConcretizationTypeError the unbatched path always raised
+        # here, which to_static treats as a graph break.
+        if bool(bad):
+            _nan_report(name)
+        return
+    _nan_pending.append((name, bad))
+    batch = int(get_flag("check_nan_inf_batch") or 1)
+    if len(_nan_pending) >= max(batch, 1):
+        flush_nan_checks()
 
 
 # observers called as obs(op_name, flat_output_arrays) after every op —

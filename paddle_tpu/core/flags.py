@@ -79,6 +79,10 @@ def all_flags() -> Iterable[str]:
 # --- Core flags (subset of /root/reference/paddle/common/flags.cc relevant on TPU) ---
 define_flag("check_nan_inf", False, "Scan op outputs for NaN/Inf in eager mode")
 define_flag("check_nan_inf_level", 0, "0: raise on nan/inf; >0: log only")
+define_flag("check_nan_inf_batch", 1,
+            "ops per NaN-check host sync: 1 raises at the offending op "
+            "(reference semantics); larger batches amortize the per-op "
+            "device round-trip, reporting up to N ops late")
 define_flag("benchmark", False, "Synchronize after each op and log timing")
 define_flag("eager_delete_tensor_gb", 0.0, "Compat no-op: XLA manages memory")
 define_flag("allocator_strategy", "auto_growth", "Compat: XLA/PJRT owns allocation")

@@ -78,3 +78,8 @@ class ParallelEnv:
     @property
     def nranks(self):
         return get_world_size()
+
+# SIGUSR1 -> stack dump must be live from import time under a
+# watchdog-enabled launcher (a rank can wedge before its first tick)
+from . import watchdog as _watchdog  # noqa: E402
+_watchdog.register_faulthandler_if_enabled()

@@ -52,7 +52,11 @@ def run_trial(cfg: dict, num_devices: int, steps: int = 4,
 
     pp = degrees.get("pp", 1)
     dp = degrees.get("dp", 1) * degrees.get("sharding", 1)
-    batch = 4 * max(dp, 1) * max(acc, 1)
+    # PipelineParallel raises accumulate_steps to >= pp; the batch must
+    # stay divisible by the EFFECTIVE microbatch count or pp configs
+    # would spuriously score -inf
+    acc_eff = max(acc, pp) if pp > 1 else max(acc, 1)
+    batch = 4 * max(dp, 1) * acc_eff
     rng = np.random.default_rng(0)
 
     if pp > 1:

@@ -463,6 +463,8 @@ class PipelineParallel(MetaParallelBase):
         self._stale_model = True  # Layer tensors now hold donated buffers
         if lr_scheduler is not None:
             lr_scheduler.step()
+        from ... import watchdog
+        watchdog.maybe_start_and_tick()
         return wrap(loss)
 
     def _flat_params(self):

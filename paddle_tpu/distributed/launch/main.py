@@ -50,6 +50,12 @@ def build_parser():
                         "fleet/elastic/manager.py:125,218-253)")
     p.add_argument("--max_restarts", type=int, default=3,
                    help="elastic relaunch budget")
+    p.add_argument("--heartbeat_timeout", type=float, default=0.0,
+                   help="seconds without train-step progress before a "
+                        "rank is declared wedged: dump store state + "
+                        "per-rank stacks (SIGUSR1/faulthandler), then "
+                        "kill the pod (reference comm_task_manager.cc "
+                        "timeout dump). 0 disables")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p
@@ -61,6 +67,18 @@ def _run_pod(ns, nproc, world, master, restart_count):
     os.makedirs(ns.log_dir, exist_ok=True)
     procs = []
     logs = []
+    wd_store = None
+    wd_port = None
+    if ns.heartbeat_timeout > 0:
+        import socket
+
+        from ..store import TCPStore
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        wd_port = s.getsockname()[1]
+        s.close()
+        wd_store = TCPStore("127.0.0.1", wd_port, is_master=True,
+                            world_size=nproc)
     try:
         for local_rank in range(nproc):
             rank = ns.rank * nproc + local_rank
@@ -75,6 +93,9 @@ def _run_pod(ns, nproc, world, master, restart_count):
                 "PADDLE_JOB_ID": ns.job_id,
                 "PADDLE_RESTART_COUNT": str(restart_count),
             })
+            if wd_port is not None:
+                env["PADDLE_WATCHDOG_PORT"] = str(wd_port)
+                env["PADDLE_WATCHDOG_ADDR"] = "127.0.0.1"
             if ns.devices is not None:
                 env["PADDLE_VISIBLE_DEVICES"] = ns.devices
             log_path = os.path.join(ns.log_dir, f"workerlog.{rank}")
@@ -89,6 +110,8 @@ def _run_pod(ns, nproc, world, master, restart_count):
 
         # watcher: stop the pod on first failure (reference watcher role)
         exit_code = 0
+        pod_start = time.time()
+        rank_of = {id(p): ns.rank * nproc + i for i, p in enumerate(procs)}
         running = list(procs)
         while running and exit_code == 0:
             time.sleep(0.2)
@@ -100,6 +123,23 @@ def _run_pod(ns, nproc, world, master, restart_count):
                 elif rc != 0:
                     exit_code = rc
             running = still
+            if wd_store is not None and running:
+                from .. import watchdog as wd
+                # only THIS pod's still-running ranks: remote ranks never
+                # reach the node-local store, and cleanly-exited ranks
+                # stop ticking legitimately
+                wedged = wd.monitor_dump(
+                    wd_store, [rank_of[id(p)] for p in running],
+                    ns.heartbeat_timeout, started_at=pod_start)
+                if wedged:
+                    # stacks into each rank's log before the kill
+                    for p in running:
+                        try:
+                            p.send_signal(signal.SIGUSR1)
+                        except OSError:
+                            pass
+                    time.sleep(2.0)  # let faulthandler flush
+                    exit_code = 124
         alive = len(running)
         if exit_code != 0:
             for p in procs:

@@ -1,0 +1,176 @@
+"""Hang observability: per-rank progress heartbeats + stack dumps.
+
+Reference: paddle/phi/core/distributed/comm_task_manager.cc:142-274 — a
+background thread detects collectives stuck past a timeout, logs store
+state and aborts. Compiled XLA programs cannot deadlock *mid-program*,
+but a rank can still wedge (host-side hang, a stuck data loader, a
+mismatched mesh between hosts blocking at dispatch). The TPU-native
+analog:
+
+- each worker ticks a progress counter from its train loop
+  (``tick()`` — TrainStep and PipelineParallel call it); a daemon thread
+  publishes the last tick time under ``__watchdog/rank/<r>`` in the
+  job's TCPStore;
+- the launcher (``--heartbeat_timeout T``) watches those keys; a rank
+  whose ticks stop for T seconds triggers a diagnostic dump — store
+  state (per-rank tick ages) plus a SIGUSR1 to every worker, which
+  faulthandler turns into a full per-thread Python stack dump in that
+  rank's log — before the pod is killed.
+
+Worker side activates automatically when the launcher sets
+PADDLE_WATCHDOG_PORT (see init_parallel_env / TrainStep).
+"""
+from __future__ import annotations
+
+import faulthandler
+import json
+import os
+import signal
+import threading
+import time
+from typing import Optional
+
+_state = {
+    "store": None,
+    "rank": 0,
+    "thread": None,
+    "stop": None,
+    "ticks": 0,
+    "last_tick": 0.0,
+    "enabled": False,
+}
+
+
+def enabled() -> bool:
+    return _state["enabled"]
+
+
+def register_faulthandler_if_enabled() -> None:
+    """Register the SIGUSR1 stack-dump handler as soon as the package
+    imports under a watchdog-enabled launcher. Without this, a rank that
+    wedges BEFORE its first train-step tick (startup/compile hang — the
+    exact case the startup-grace path flags) would take SIGUSR1's
+    default action (terminate) instead of dumping stacks."""
+    if not os.environ.get("PADDLE_WATCHDOG_PORT"):
+        return
+    try:
+        faulthandler.register(signal.SIGUSR1, all_threads=True)
+    except (AttributeError, ValueError):
+        pass
+
+
+def start(store=None, rank: Optional[int] = None,
+          interval: float = 1.0) -> bool:
+    """Begin publishing this process's progress heartbeats. Returns True
+    when a watchdog store is available (PADDLE_WATCHDOG_PORT set by the
+    launcher, or an explicit store)."""
+    if _state["enabled"]:
+        return True
+    if store is None:
+        port = os.environ.get("PADDLE_WATCHDOG_PORT")
+        if not port:
+            return False
+        from .store import TCPStore
+        # the launcher hosts the watchdog store on the LOCAL node (it
+        # binds 127.0.0.1) — never MASTER_ADDR, which is a remote host
+        # on multi-node jobs
+        host = os.environ.get("PADDLE_WATCHDOG_ADDR", "127.0.0.1")
+        store = TCPStore(host, int(port), is_master=False)
+    if rank is None:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    _state.update(store=store, rank=int(rank), enabled=True,
+                  last_tick=time.time())
+    # SIGUSR1 -> per-thread stack dump on stderr (lands in the rank log)
+    try:
+        faulthandler.register(signal.SIGUSR1, all_threads=True)
+    except (AttributeError, ValueError):
+        pass  # non-main thread or platform without SIGUSR1
+
+    stop = threading.Event()
+    _state["stop"] = stop
+
+    def publish():
+        while not stop.is_set():
+            try:
+                store.set(
+                    f"__watchdog/rank/{rank}",
+                    json.dumps({"ticks": _state["ticks"],
+                                "ts": _state["last_tick"]}).encode())
+            except Exception:  # noqa: BLE001 — store may be tearing down
+                pass
+            stop.wait(interval)
+
+    th = threading.Thread(target=publish, daemon=True,
+                          name="paddle-watchdog")
+    _state["thread"] = th
+    th.start()
+    return True
+
+
+def tick() -> None:
+    """Mark forward progress (one train step). Cheap when disabled."""
+    if _state["enabled"]:
+        _state["ticks"] += 1
+        _state["last_tick"] = time.time()
+
+
+def maybe_start_and_tick() -> None:
+    """Called from hot paths (TrainStep): lazily activate under a
+    launcher that requested watchdog monitoring, then tick."""
+    if not _state["enabled"]:
+        if not os.environ.get("PADDLE_WATCHDOG_PORT"):
+            return
+        start()
+    tick()
+
+
+def stop() -> None:
+    if _state["stop"] is not None:
+        _state["stop"].set()
+    _state["enabled"] = False
+
+
+# --------------------------------------------------------------------------
+# launcher side
+# --------------------------------------------------------------------------
+
+def monitor_dump(store, ranks, timeout: float,
+                 started_at: Optional[float] = None) -> list:
+    """Return the list of wedged ranks and print the store-state dump
+    (the CommTaskManager-style diagnostic) for any rank in `ranks` whose
+    progress ticks are older than `timeout` seconds.
+
+    `ranks` must be exactly the global ranks THIS launcher is
+    responsible for AND that are still running: the heartbeat store is
+    node-local, so remote ranks would always look absent, and a rank
+    that exited cleanly stops ticking legitimately — both would be
+    false 'wedged' kills if included.
+
+    A rank that never produced its FIRST tick (hung in startup /
+    first-step compile / a stuck data loader) is flagged once the pod is
+    older than 10x the timeout — first compiles legitimately take
+    minutes, so the startup grace is deliberately long."""
+    now = time.time()
+    startup_grace = 10.0 * timeout
+    wedged = []
+    lines = []
+    for r in ranks:
+        key = f"__watchdog/rank/{r}"
+        if not store.check(key):
+            lines.append(f"  rank {r}: no heartbeat yet")
+            if started_at is not None and now - started_at > startup_grace:
+                wedged.append(r)
+            continue
+        rec = json.loads(store.get(key))
+        age = now - rec["ts"]
+        lines.append(f"  rank {r}: ticks={rec['ticks']} "
+                     f"last_progress={age:.1f}s ago")
+        if age > timeout:
+            wedged.append(r)
+    if wedged:
+        print("watchdog: detected wedged rank(s) "
+              f"{wedged} (no progress for > {timeout}s). Store state:",
+              flush=True)
+        for ln in lines:
+            print(ln, flush=True)
+    return wedged

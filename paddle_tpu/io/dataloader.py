@@ -57,17 +57,128 @@ def default_collate_fn(batch):
 _POOL_DATASET = None
 
 
-def _pool_init(dataset, worker_id_counter, num_workers):
+def _pool_init(dataset, worker_id_counter, num_workers, worker_init_fn):
     global _POOL_DATASET, _worker_info
     _POOL_DATASET = dataset
     with worker_id_counter.get_lock():
         wid = worker_id_counter.value
         worker_id_counter.value += 1
     _worker_info = WorkerInfo(wid, num_workers, dataset)
+    if worker_init_fn is not None:
+        worker_init_fn(wid)
+
+
+class _ShmArray:
+    """Pickle-light handle for a numpy array living in a SharedMemory
+    segment (reference: the worker-side shared-memory transport of
+    dataloader_iter.py:368 — batches cross the process boundary as a
+    name + dtype + shape instead of pickled bytes)."""
+
+    def __init__(self, name, shape, dtype):
+        self.name = name
+        self.shape = shape
+        self.dtype = dtype
+
+    def open(self):
+        from multiprocessing import shared_memory
+        shm = shared_memory.SharedMemory(name=self.name)
+        arr = np.ndarray(self.shape, dtype=self.dtype, buffer=shm.buf)
+        return shm, arr
+
+
+def _shm_pack(obj):
+    """Move every large ndarray in a collated batch into shared memory."""
+    if isinstance(obj, Tensor):
+        obj = np.asarray(obj.numpy())
+    if isinstance(obj, np.ndarray) and obj.nbytes >= 1 << 16:
+        from multiprocessing import resource_tracker, shared_memory
+        shm = shared_memory.SharedMemory(create=True, size=obj.nbytes)
+        dst = np.ndarray(obj.shape, dtype=obj.dtype, buffer=shm.buf)
+        dst[...] = obj
+        handle = _ShmArray(shm.name, obj.shape, obj.dtype)
+        # ownership transfers to the parent (which unlinks after the
+        # copy); drop the worker-side tracker entry or every segment is
+        # double-reported at worker exit
+        try:
+            resource_tracker.unregister(shm._name, "shared_memory")
+        except Exception:  # noqa: BLE001 — tracker API is private-ish
+            pass
+        shm.close()
+        return handle
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_shm_pack(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _shm_pack(v) for k, v in obj.items()}
+    return obj
+
+
+def _shm_unpack(obj):
+    """Parent side: rebuild Tensors from shared segments, then release."""
+    if isinstance(obj, _ShmArray):
+        shm, arr = obj.open()
+        try:
+            t = Tensor(np.array(arr))  # one copy: shm -> device staging
+        finally:
+            shm.close()
+            shm.unlink()
+        return t
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_shm_unpack(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _shm_unpack(v) for k, v in obj.items()}
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    return obj
+
+
+def _np_collate(batch):
+    """Numpy-only collate for worker processes: forked workers must not
+    build device arrays (jax state does not survive fork), so stacking
+    happens in numpy and the parent wraps the result."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s.numpy()) for s in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return np.asarray(batch)
+    if isinstance(sample, (list, tuple)):
+        return [_np_collate(list(items)) for items in zip(*batch)]
+    if isinstance(sample, dict):
+        return {k: _np_collate([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    raise TypeError(f"cannot collate type {type(sample)}")
+
+
+def _shm_discard(obj):
+    """Unlink packed segments without materializing them (early-exit
+    cleanup path)."""
+    if isinstance(obj, _ShmArray):
+        try:
+            shm, _ = obj.open()
+            shm.close()
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+        return
+    if isinstance(obj, (list, tuple)):
+        for o in obj:
+            _shm_discard(o)
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            _shm_discard(v)
 
 
 def _pool_fetch(indices):
     return [_POOL_DATASET[i] for i in indices]
+
+
+def _pool_fetch_collated(indices):
+    """Collate in the worker (numpy) and ship via shared memory: the
+    parent never pays per-sample pickle cost for the big arrays."""
+    batch = _np_collate([_POOL_DATASET[i] for i in indices])
+    return _shm_pack(batch)
 
 
 class DataLoader:
@@ -79,9 +190,13 @@ class DataLoader:
                  persistent_workers=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
+        self._default_collate = collate_fn is None
         self.num_workers = int(num_workers)
         self.prefetch_factor = max(int(prefetch_factor), 1)
         self.worker_init_fn = worker_init_fn
+        self.use_shared_memory = bool(use_shared_memory)
+        self.persistent_workers = bool(persistent_workers)
+        self._pool = None
         self._iterable_ds = isinstance(dataset, IterableDataset)
         if self._iterable_ds:
             self.batch_sampler = None
@@ -126,17 +241,68 @@ class DataLoader:
         for indices in self.batch_sampler:
             yield self.collate_fn([self.dataset[i] for i in indices])
 
-    def _iter_workers(self):
+    def _make_pool(self):
         counter = mp.Value("i", 0)
         ctx = mp.get_context("fork")
-        with ctx.Pool(self.num_workers, initializer=_pool_init,
-                      initargs=(self.dataset, counter,
-                                self.num_workers)) as pool:
-            batches = pool.imap(
-                _pool_fetch, iter(self.batch_sampler),
-                chunksize=1)
-            for samples in batches:
-                yield self.collate_fn(samples)
+        return ctx.Pool(
+            self.num_workers, initializer=_pool_init,
+            initargs=(self.dataset, counter, self.num_workers,
+                      self.worker_init_fn))
+
+    def _get_pool(self):
+        """Persistent pool, created once (reference persistent_workers:
+        previously a fresh Pool was forked per epoch, paying worker
+        startup every time). Non-persistent iteration makes a private
+        pool per iterator instead — overlapping iterators must not
+        tear each other's workers down."""
+        if self._pool is None:
+            self._pool = self._make_pool()
+            # tear down before interpreter finalization: Pool.__del__
+            # at shutdown races freed queue internals and warns
+            import atexit
+            import weakref
+            ref = weakref.ref(self)
+
+            def _cleanup():
+                dl = ref()
+                if dl is not None and dl._pool is not None:
+                    dl._pool.terminate()
+                    dl._pool = None
+            atexit.register(_cleanup)
+        return self._pool
+
+    def _iter_workers(self):
+        own_pool = not self.persistent_workers
+        pool = self._make_pool() if own_pool else self._get_pool()
+        shm_mode = self.use_shared_memory and self._default_collate
+        fetch = _pool_fetch_collated if shm_mode else _pool_fetch
+        # bounded in-flight window (prefetch_factor per worker): imap
+        # would enqueue the WHOLE epoch eagerly, making early abandon
+        # either leak /dev/shm segments or drain the full dataset
+        window = self.num_workers * self.prefetch_factor
+        inflight = []
+        it = iter(self.batch_sampler)
+        try:
+            for indices in it:
+                inflight.append(pool.apply_async(fetch, (indices,)))
+                if len(inflight) < window:
+                    continue
+                res = inflight.pop(0).get()
+                yield _shm_unpack(res) if shm_mode else self.collate_fn(res)
+            while inflight:
+                res = inflight.pop(0).get()
+                yield _shm_unpack(res) if shm_mode else self.collate_fn(res)
+        finally:
+            # early abandon: only the in-flight window needs draining
+            if shm_mode:
+                for h in inflight:
+                    try:
+                        _shm_discard(h.get(timeout=60))
+                    except Exception:  # noqa: BLE001 — best-effort
+                        pass
+            if own_pool:
+                pool.terminate()
+                pool.join()
 
     def __iter__(self):
         if self._iterable_ds:
@@ -147,3 +313,11 @@ class DataLoader:
 
     def __call__(self):
         return self.__iter__()
+
+    def __del__(self):
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            try:
+                pool.terminate()
+            except Exception:  # noqa: BLE001 — interpreter teardown
+                pass

@@ -71,7 +71,9 @@ class StaticFunction:
             self._fn = fn.forward
         self._input_spec = input_spec
         self._cache = {}
-        self._always_eager = False
+        # signatures that graph-broke; other signatures keep their
+        # compiled entries
+        self._eager_sigs = set()
         self._warned_break = False
         functools.update_wrapper(self, self._fn)
 
@@ -128,9 +130,6 @@ class StaticFunction:
         return target(*args, **kwargs)
 
     def __call__(self, *args, **kwargs):
-        if self._always_eager:
-            target = self._layer if self._layer is not None else self._fn
-            return target(*args, **kwargs)
         tensor_args = []
         static_kwargs = {}
         for a in args:
@@ -141,6 +140,9 @@ class StaticFunction:
             else:
                 static_kwargs[k] = v
         sig = _sig_of(tensor_args, static_kwargs)
+        if sig in self._eager_sigs:
+            target = self._layer if self._layer is not None else self._fn
+            return target(*args, **kwargs)
         entry = self._cache.get(sig)
         if self._layer is None:
             if entry is None:
@@ -150,7 +152,7 @@ class StaticFunction:
                 # ONE tape op: compiled forward, vjp = compiled backward
                 return run_op("jit_fn", entry, tensor_args)
             except self._BREAK_ERRORS as exc:
-                self._always_eager = True
+                self._eager_sigs.add(sig)
                 return self._graph_break(exc, args, kwargs)
 
         layer = self._layer
@@ -166,7 +168,7 @@ class StaticFunction:
             out_arrays, new_buf = entry(params, buffers, frozen, key,
                                         *arrays)
         except self._BREAK_ERRORS as exc:
-            self._always_eager = True
+            self._eager_sigs.add(sig)
             return self._graph_break(exc, args, kwargs)
         write_back(layer, {}, new_buf)
         return jax.tree_util.tree_map(
@@ -285,6 +287,8 @@ class TrainStep:
         # arrays without an explicit sync_to_model call
         write_back(self._model, self._params, self._buffers,
                    registry=self._registry)
+        from ..distributed import watchdog
+        watchdog.maybe_start_and_tick()
         return wrap(loss)
 
     def sync_to_model(self):

@@ -41,3 +41,4 @@ def run_check():
     print(f"paddle_tpu works on {dev.platform} ({dev.device_kind}).")
 
 from . import download  # noqa: F401,E402
+from . import cpp_extension  # noqa: F401

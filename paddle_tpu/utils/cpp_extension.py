@@ -1,0 +1,135 @@
+"""Custom-op extension API — the out-of-tree kernel story.
+
+Reference: paddle/fluid/framework/custom_operator.cc (PD_BUILD_OP ABI) +
+python/paddle/utils/cpp_extension/cpp_extension.py (compile user .cc at
+runtime, register the op at dlopen). TPU-native translation (SURVEY §2.1
+N33): device kernels come from Python — jnp compositions or Pallas — and
+host-side native kernels come from a C shared library driven through
+``jax.pure_callback``; both register through the same ``register_op``
+entry, which wires the dygraph tape (custom VJP) and optional Tensor
+method exactly like built-in ops.
+
+    # 1. pure-Python / Pallas custom op with a gradient
+    def silu_fwd(x):
+        return x * jax.nn.sigmoid(x)
+    def silu_bwd(x, g):
+        s = jax.nn.sigmoid(x)
+        return (g * (s + x * s * (1 - s)),)
+    my_silu = register_op("my_silu", silu_fwd, backward=silu_bwd)
+
+    # 2. native host kernel
+    lib = load(name="my_ops", sources=["my_ops.cc"])   # g++ -shared
+    ... wrap lib.my_kernel with ctypes + register_op(...)
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+from typing import Callable, Optional, Sequence
+
+
+def load(name: str, sources: Sequence[str], extra_cflags=None,
+         extra_ldflags=None, build_directory: Optional[str] = None,
+         verbose: bool = False):
+    """Compile C/C++ sources into a shared library and dlopen it.
+
+    Reference: cpp_extension.load (JIT-compiles user sources). Returns a
+    ``ctypes.CDLL``; symbols use the C ABI (extern "C"). The image's
+    toolchain provides g++; no pybind11 — callers drive symbols via
+    ctypes and lift them into ops with :func:`register_op` +
+    ``jax.pure_callback``.
+    """
+    import ctypes
+
+    build_dir = build_directory or os.path.join(
+        tempfile.gettempdir(), f"paddle_tpu_ext_{name}")
+    os.makedirs(build_dir, exist_ok=True)
+    out = os.path.join(build_dir, f"lib{name}.so")
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-o", out,
+           *map(str, sources), *(extra_cflags or []),
+           *(extra_ldflags or [])]
+    if verbose:
+        print("cpp_extension:", " ".join(cmd))
+    res = subprocess.run(cmd, capture_output=True, text=True)
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"cpp_extension build failed:\n{res.stderr}")
+    return ctypes.CDLL(out)
+
+
+_REGISTRY = {}
+
+
+def register_op(name: str, forward: Callable, backward: Callable = None,
+                tensor_method: bool = False):
+    """Register a custom operator.
+
+    forward(*arrays, **attrs) -> array(s): jax-traceable (jnp, Pallas,
+    or a jax.pure_callback around native code).
+    backward(*arrays, grad_out) -> tuple of input grads: optional; when
+    given the op trains through the dygraph tape and under jit (wired as
+    jax.custom_vjp, the TPU analog of PD_BUILD_GRAD_OP).
+
+    Returns the Tensor-level op callable; it is also importable as
+    ``paddle_tpu.ops.custom.<name>`` and (tensor_method=True) bound as a
+    Tensor method — the same three surfaces built-in ops get.
+    """
+    import jax
+
+    from ..core.dispatch import run_op
+    from ..core.tensor import Tensor
+
+    def _build(attrs_items):
+        """One differentiable fn per distinct attrs set: custom_vjp
+        functions take only array args, so attrs close over."""
+        attrs = dict(attrs_items)
+        if backward is None:
+            return lambda *arrays: forward(*arrays, **attrs)
+
+        @jax.custom_vjp
+        def fn(*arrays):
+            return forward(*arrays, **attrs)
+
+        def fwd(*arrays):
+            return forward(*arrays, **attrs), arrays
+
+        def bwd(res, g):
+            grads = backward(*res, g, **attrs)
+            if not isinstance(grads, (list, tuple)):
+                grads = (grads,)
+            return tuple(grads)
+
+        fn.defvjp(fwd, bwd)
+        return fn
+
+    builders = {}
+
+    def op(*tensors, **attrs):
+        key = tuple(sorted(attrs.items()))
+        fn = builders.get(key)
+        if fn is None:
+            fn = builders[key] = _build(key)
+        return run_op(name, fn, list(tensors))
+
+    op.__name__ = name
+    _REGISTRY[name] = op
+
+    from .. import ops as ops_pkg
+    custom = getattr(ops_pkg, "custom", None)
+    if custom is None:
+        import sys
+        import types
+        custom = types.ModuleType("paddle_tpu.ops.custom")
+        custom.__doc__ = "user-registered custom ops (cpp_extension)"
+        ops_pkg.custom = custom
+        # make `from paddle_tpu.ops.custom import <op>` importable
+        sys.modules["paddle_tpu.ops.custom"] = custom
+    setattr(custom, name, op)
+    if tensor_method:
+        setattr(Tensor, name, op)
+    return op
+
+
+def get_op(name: str):
+    return _REGISTRY[name]

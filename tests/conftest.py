@@ -27,3 +27,9 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+# Persistent XLA compilation cache: compile-heavy distributed tests are
+# the suite's cost center on the 1-CPU CI host; cached executables make
+# re-runs cheap. Safe across runs — keyed by HLO + flags.
+jax.config.update("jax_compilation_cache_dir", "/tmp/paddle_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)

@@ -42,6 +42,7 @@ def test_launch_single_proc(tmp_path):
     assert "RANK 0 WORLD 1" in log
 
 
+@pytest.mark.nightly
 def test_launch_multi_proc_env(tmp_path):
     script = _write_worker(tmp_path, """
         import os
@@ -58,6 +59,7 @@ def test_launch_multi_proc_env(tmp_path):
     assert "rank=1 world=2" in log1
 
 
+@pytest.mark.nightly
 def test_launch_failure_propagates(tmp_path):
     script = _write_worker(tmp_path, """
         import os, sys, time
@@ -93,6 +95,7 @@ def test_spawn_multi_process(tmp_path):
     assert "SPAWN DONE" in r.stdout
 
 
+@pytest.mark.nightly
 def test_elastic_relaunch_resumes_from_checkpoint(tmp_path):
     """Kill a rank mid-run: the launcher relaunches the survivors with
     the new world size and training resumes from the latest checkpoint
@@ -151,3 +154,52 @@ def test_elastic_relaunch_resumes_from_checkpoint(tmp_path):
     post = [float(m) for m in _re.findall(r"loss (\d+\.\d+)", log0)]
     assert post and pre and post[0] < pre[0]
     assert post == sorted(post, reverse=True)  # still decreasing
+
+
+@pytest.mark.nightly
+def test_watchdog_dumps_wedged_rank(tmp_path):
+    """A rank that stops making progress trips the launcher watchdog:
+    store-state dump + per-rank stack dump (SIGUSR1/faulthandler), then
+    the pod is killed (VERDICT r2 item 10; reference
+    comm_task_manager.cc:142-274 timeout dump+abort)."""
+    script = _write_worker(tmp_path, """
+    import os, time
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    paddle.seed(0)
+    net = nn.Linear(8, 8)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    loss_fn = nn.MSELoss()
+    step = paddle.jit.TrainStep(net, loss_fn, opt)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    for i in range(100):
+        float(step(x, y).numpy())
+        if rank == 1 and i == 3:
+            time.sleep(3600)   # wedged: no further progress ticks
+        time.sleep(0.1)
+    print("DONE", flush=True)
+    """)
+    r = _run_launch(tmp_path, script,
+                    extra=["--nproc_per_node", "2",
+                           "--heartbeat_timeout", "8"])
+    assert r.returncode != 0
+    # rank 1 must be flagged; a heavily loaded CI host may stall rank 0
+    # past the timeout too, so only require membership
+    import re as _re
+    m = _re.search(r"wedged rank\(s\) \[([^\]]*)\]", r.stdout)
+    assert m is not None, r.stdout
+    assert "1" in m.group(1), r.stdout
+    # store-state dump present (tick ages, or 'no heartbeat yet' when
+    # the rank wedged before its first tick on a slow host)
+    assert "last_progress" in r.stdout or "no heartbeat" in r.stdout
+    # faulthandler stack dump landed in the wedged rank's log: frames
+    # listed per thread with file/line (the C-level sleep shows as the
+    # worker.py line that called it)
+    log1 = (tmp_path / "log" / "workerlog.1").read_text()
+    assert "Current thread" in log1 or "Thread 0x" in log1
+    assert "worker.py" in log1

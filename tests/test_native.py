@@ -32,6 +32,7 @@ def test_store_set_get_add_wait():
         master.close()
 
 
+@pytest.mark.nightly
 def test_store_blocking_get_across_processes(tmp_path):
     """get() must BLOCK until another process sets the key."""
     worker = tmp_path / "w.py"
