@@ -12,6 +12,7 @@ from . import checkpoint  # noqa: F401
 from . import communication  # noqa: F401
 from . import auto_tuner  # noqa: F401
 from . import launch  # noqa: F401
+from . import rpc  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from .store import TCPStore  # noqa: F401
 from . import env  # noqa: F401

@@ -1,0 +1,238 @@
+"""paddle.distributed.rpc — host-side RPC between training workers.
+
+Reference: python/paddle/distributed/rpc/rpc.py (init_rpc :85,
+rpc_sync :160, rpc_async :206, shutdown :305, WorkerInfo registry
+:336-393) — there a brpc C++ service carries pickled Python calls
+between ranks. TPU-native translation: device communication is compiled
+XLA collectives, so RPC is purely a *host* control-plane facility
+(custom coordination, metrics aggregation, PS-style side channels). The
+transport is the stdlib ``multiprocessing.connection`` listener (SPMD
+hosts are a trusted, launcher-provisioned set; same trust model as the
+reference's brpc endpoints), and the endpoint exchange rides the
+framework's native TCPStore — the same rendezvous the launcher uses.
+
+    dist.rpc.init_rpc("worker0", rank=0, world_size=2,
+                      master_endpoint="127.0.0.1:8813")
+    fut = dist.rpc.rpc_async("worker1", max, args=(3, 5))
+    assert fut.wait() == 5
+    dist.rpc.shutdown()
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+from collections import namedtuple
+from concurrent.futures import Future
+from multiprocessing.connection import Client, Listener
+from typing import Optional
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_state = {
+    "store": None,
+    "self": None,          # WorkerInfo
+    "workers": {},         # name -> WorkerInfo
+    "listener": None,
+    "serve_thread": None,
+    "stop": None,
+    "world_size": 0,
+}
+
+_AUTHKEY = b"paddle_tpu_rpc"
+
+
+def _serve(listener, stop):
+    """Accept loop: each request is one (fn, args, kwargs) pickle; the
+    reply is ("ok", result) or ("err", exception). shutdown() closes
+    the listener, which breaks the accept with OSError."""
+    while not stop.is_set():
+        try:
+            conn = listener.accept()
+        except (OSError, EOFError):
+            break
+        try:
+            payload = conn.recv_bytes()
+            fn, args, kwargs = pickle.loads(payload)
+            try:
+                result = ("ok", fn(*args, **kwargs))
+            except Exception as exc:  # noqa: BLE001 — shipped to caller
+                result = ("err", exc)
+            try:
+                blob = pickle.dumps(result)
+            except Exception as exc:  # noqa: BLE001 — unpicklable result
+                # e.g. a generator/lock return value raises TypeError,
+                # which must not kill the serve loop
+                blob = pickle.dumps(
+                    ("err", RuntimeError(
+                        f"rpc result not picklable: {exc}")))
+            conn.send_bytes(blob)
+        except (OSError, EOFError, pickle.PickleError):
+            pass
+        finally:
+            conn.close()
+
+
+def _bind_ip() -> str:
+    """The address peers should dial: a routable host IP for multi-host
+    jobs (PADDLE_RPC_BIND_IP overrides), loopback as last resort."""
+    import socket
+    override = os.environ.get("PADDLE_RPC_BIND_IP")
+    if override:
+        return override
+    try:
+        ip = socket.gethostbyname(socket.gethostname())
+        return ip or "127.0.0.1"
+    except OSError:
+        return "127.0.0.1"
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None):
+    """Start this worker's RPC service and exchange worker infos through
+    the TCPStore rendezvous (reference rpc.py:85).
+
+    The store is the process's default store when one exists (the same
+    rendezvous init_parallel_env uses); otherwise one is created on
+    master_port + 2 — NOT the master port itself, which the JAX
+    coordinator binds in a launched job.
+    """
+    from .. import store as store_mod
+
+    if _state["self"] is not None:
+        raise RuntimeError("rpc already initialized; call shutdown() first")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+        else int(rank)
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else int(world_size)
+
+    store = None
+    if master_endpoint is None:
+        store = store_mod.default_store()
+    if store is None:
+        master = master_endpoint or os.environ.get(
+            "PADDLE_MASTER", "127.0.0.1:8813")
+        host, port = master.rsplit(":", 1)
+        if master_endpoint is None:
+            # the launcher's master port belongs to the coordinator
+            port = str(int(port) + 2)
+        store = store_mod.TCPStore(host, int(port),
+                                   is_master=(rank == 0),
+                                   world_size=world_size)
+
+    listener = Listener((_bind_ip(), 0), authkey=_AUTHKEY)
+    my_ip, my_port = listener.address
+    stop = threading.Event()
+    th = threading.Thread(target=_serve, args=(listener, stop),
+                          daemon=True, name=f"rpc-serve-{name}")
+    th.start()
+
+    try:
+        info = WorkerInfo(name, rank, my_ip, int(my_port))
+        store.set(f"__rpc/worker/{rank}", pickle.dumps(tuple(info)))
+        workers = {}
+        for r in range(world_size):
+            store.wait([f"__rpc/worker/{r}"])
+            w = WorkerInfo(*pickle.loads(store.get(f"__rpc/worker/{r}")))
+            if w.name in workers and workers[w.name].rank != w.rank:
+                raise ValueError(
+                    f"duplicate rpc worker name {w.name!r} (ranks "
+                    f"{workers[w.name].rank} and {w.rank})")
+            workers[w.name] = w
+    except BaseException:
+        # failed rendezvous must not leak the bound listener/thread
+        stop.set()
+        listener.close()
+        th.join(timeout=5)
+        raise
+
+    _state.update(store=store, self=info, workers=workers,
+                  listener=listener, serve_thread=th, stop=stop,
+                  world_size=world_size)
+
+
+def _invoke(to: str, fn, args, kwargs, timeout):
+    w = _state["workers"].get(to)
+    if w is None:
+        raise ValueError(f"unknown rpc worker {to!r}; known: "
+                         f"{sorted(_state['workers'])}")
+    conn = Client((w.ip, w.port), authkey=_AUTHKEY)
+    try:
+        conn.send_bytes(pickle.dumps((fn, tuple(args or ()),
+                                      dict(kwargs or {}))))
+        if timeout and timeout > 0:
+            if not conn.poll(timeout):
+                raise TimeoutError(
+                    f"rpc to {to} timed out after {timeout}s")
+        status, value = pickle.loads(conn.recv_bytes())
+    finally:
+        conn.close()
+    if status == "err":
+        raise value
+    return value
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None, timeout=-1):
+    """Blocking call of fn(*args, **kwargs) on worker `to`
+    (reference rpc.py:160)."""
+    _require_init()
+    return _invoke(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None, timeout=-1):
+    """Non-blocking variant returning a Future with .wait()
+    (reference rpc.py:206)."""
+    _require_init()
+    fut = Future()
+
+    def run():
+        try:
+            fut.set_result(_invoke(to, fn, args, kwargs, timeout))
+        except BaseException as exc:  # noqa: BLE001 — delivered via wait
+            fut.set_exception(exc)
+
+    threading.Thread(target=run, daemon=True).start()
+    fut.wait = fut.result  # paddle Future spelling
+    return fut
+
+
+def shutdown():
+    """Barrier with every worker, then stop the local service
+    (reference rpc.py:305)."""
+    if _state["self"] is None:
+        return
+    store = _state["store"]
+    try:
+        store.barrier("__rpc/shutdown", timeout=60)
+    except Exception:  # noqa: BLE001 — peers may already be gone
+        pass
+    _state["stop"].set()
+    # closing the listener breaks the serve thread's accept() with
+    # OSError — no wake-up dial needed (dialing could deadlock if the
+    # thread exits between the connect and the accept)
+    _state["listener"].close()
+    _state["serve_thread"].join(timeout=5)
+    _state.update(store=None, self=None, workers={}, listener=None,
+                  serve_thread=None, stop=None, world_size=0)
+
+
+def _require_init():
+    if _state["self"] is None:
+        raise RuntimeError("call paddle.distributed.rpc.init_rpc first")
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    _require_init()
+    return _state["workers"][name]
+
+
+def get_all_worker_infos():
+    _require_init()
+    return sorted(_state["workers"].values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info() -> WorkerInfo:
+    _require_init()
+    return _state["self"]
