@@ -1,0 +1,4 @@
+from .the_one_ps import (DenseTable, PSClient, PSServer,  # noqa: F401
+                         SparseTable)
+
+__all__ = ["DenseTable", "SparseTable", "PSServer", "PSClient"]

@@ -1,0 +1,187 @@
+"""Minimal parameter server (sync mode).
+
+Reference: paddle/fluid/distributed/ps (~55K LoC C++: brpc services,
+sparse/dense tables, CTR accessors) driven by
+python/paddle/distributed/ps/the_one_ps.py. That stack exists for
+CPU-cluster recommender training with huge sparse embeddings. The
+TPU-native stance (COMPONENTS.md): dense SPMD training does not need a
+PS — but the *capability* is kept in a deliberately small, host-side
+form for embedding-table workloads:
+
+- ``DenseTable`` / ``SparseTable``: numpy-backed parameter storage with
+  an SGD update rule; sparse rows are lazily initialized (the CTR
+  "accessor" essence) and sharded over servers by ``id % n_servers``.
+- ``PSServer``: registers its tables in the process-global registry and
+  serves pull/push through ``paddle.distributed.rpc`` (the stdlib-
+  transport RPC layer; the reference uses brpc services).
+- ``PSClient``: pull_dense/push_dense/pull_sparse/push_sparse against
+  the server set, synchronous (the reference's sync mode; geo/async
+  staleness modes are out of scope).
+
+Trainers embed pulled rows on-host (or feed them to the jitted step)
+and push gradients back after the step.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+# process-global table registry: the RPC handlers below run inside the
+# server process and resolve tables here (the reference's table map in
+# brpc_ps_server.cc plays this role)
+_TABLES: Dict[str, object] = {}
+
+
+class DenseTable:
+    """A dense parameter block with an SGD rule (reference dense table +
+    sgd accessor)."""
+
+    def __init__(self, name: str, shape, lr: float = 0.01,
+                 init: Optional[np.ndarray] = None):
+        self.name = name
+        self.value = (np.array(init, np.float32) if init is not None
+                      else np.zeros(shape, np.float32))
+        self.lr = float(lr)
+
+    def pull(self) -> np.ndarray:
+        return self.value
+
+    def push(self, grad: np.ndarray) -> None:
+        self.value -= self.lr * np.asarray(grad, np.float32)
+
+
+class SparseTable:
+    """Lazily-initialized embedding rows keyed by int64 id (reference
+    memory sparse table: rows materialize on first access)."""
+
+    def __init__(self, name: str, dim: int, lr: float = 0.01,
+                 initializer: Optional[Callable[[int], np.ndarray]] = None):
+        self.name = name
+        self.dim = int(dim)
+        self.lr = float(lr)
+        self.rows: Dict[int, np.ndarray] = {}
+        self._init = initializer or (
+            lambda _id: np.zeros(self.dim, np.float32))
+
+    def _row(self, _id: int) -> np.ndarray:
+        r = self.rows.get(_id)
+        if r is None:
+            r = self.rows[_id] = np.asarray(self._init(_id), np.float32)
+        return r
+
+    def pull(self, ids: Sequence[int]) -> np.ndarray:
+        return np.stack([self._row(int(i)) for i in ids]) if len(ids) \
+            else np.zeros((0, self.dim), np.float32)
+
+    def push(self, ids: Sequence[int], grads: np.ndarray) -> None:
+        grads = np.asarray(grads, np.float32)
+        for i, g in zip(ids, grads):
+            self._row(int(i))[...] -= self.lr * g
+
+
+# ---- RPC handlers (execute in the server process) -------------------------
+
+def _srv_register_dense(name, shape, lr, init):
+    _TABLES[name] = DenseTable(name, shape, lr, init)
+    return True
+
+
+def _srv_register_sparse(name, dim, lr):
+    _TABLES[name] = SparseTable(name, dim, lr)
+    return True
+
+
+def _srv_pull_dense(name):
+    return _TABLES[name].pull()
+
+
+def _srv_push_dense(name, grad):
+    _TABLES[name].push(grad)
+    return True
+
+
+def _srv_pull_sparse(name, ids):
+    return _TABLES[name].pull(ids)
+
+
+def _srv_push_sparse(name, ids, grads):
+    _TABLES[name].push(ids, grads)
+    return True
+
+
+class PSServer:
+    """Host a table set inside an rpc worker (reference
+    brpc_ps_server.cc). Call after ``rpc.init_rpc``; tables live until
+    the process exits."""
+
+    def __init__(self):
+        self.tables = _TABLES
+
+
+class PSClient:
+    """Sync-mode client (reference brpc_ps_client.cc + the_one_ps
+    worker side). ``servers`` are rpc worker names; sparse ids shard by
+    id % len(servers), dense tables live on servers[0]."""
+
+    def __init__(self, servers: Sequence[str]):
+        if not servers:
+            raise ValueError("PSClient needs at least one server name")
+        self.servers = list(servers)
+
+    # -- table creation ----------------------------------------------------
+    def create_dense_table(self, name, shape, lr=0.01, init=None):
+        from .. import rpc
+        rpc.rpc_sync(self.servers[0], _srv_register_dense,
+                     args=(name, tuple(shape), lr, init))
+
+    def create_sparse_table(self, name, dim, lr=0.01):
+        from .. import rpc
+        for s in self.servers:
+            rpc.rpc_sync(s, _srv_register_sparse, args=(name, dim, lr))
+
+    # -- dense -------------------------------------------------------------
+    def pull_dense(self, name) -> np.ndarray:
+        from .. import rpc
+        return rpc.rpc_sync(self.servers[0], _srv_pull_dense, args=(name,))
+
+    def push_dense(self, name, grad) -> None:
+        from .. import rpc
+        rpc.rpc_sync(self.servers[0], _srv_push_dense,
+                     args=(name, np.asarray(grad, np.float32)))
+
+    # -- sparse ------------------------------------------------------------
+    def _shard(self, ids):
+        ids = np.asarray(ids, np.int64)
+        owner = ids % len(self.servers)
+        return ids, owner
+
+    def pull_sparse(self, name, ids) -> np.ndarray:
+        from .. import rpc
+        ids, owner = self._shard(ids)
+        out = np.zeros((len(ids), 0), np.float32)
+        rows = [None] * len(ids)
+        for s_idx, s in enumerate(self.servers):
+            mask = owner == s_idx
+            if not mask.any():
+                continue
+            got = rpc.rpc_sync(s, _srv_pull_sparse,
+                               args=(name, ids[mask].tolist()))
+            for pos, row in zip(np.nonzero(mask)[0], got):
+                rows[pos] = row
+        return np.stack(rows) if rows else out
+
+    def push_sparse(self, name, ids, grads) -> None:
+        from .. import rpc
+        ids, owner = self._shard(ids)
+        grads = np.asarray(grads, np.float32)
+        futures = []
+        for s_idx, s in enumerate(self.servers):
+            mask = owner == s_idx
+            if not mask.any():
+                continue
+            futures.append(rpc.rpc_async(
+                s, _srv_push_sparse,
+                args=(name, ids[mask].tolist(), grads[mask])))
+        for f in futures:
+            f.wait()

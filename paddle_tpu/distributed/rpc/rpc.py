@@ -43,49 +43,81 @@ _state = {
 _AUTHKEY = b"paddle_tpu_rpc"
 
 
+def _handle_one(conn):
+    """One request/response on an accepted connection. Every failure —
+    a payload that won't unpickle (AttributeError for a missing
+    __main__ symbol, ModuleNotFoundError, ...), a raising handler, an
+    unpicklable result — is answered as ("err", ...) where possible and
+    must never propagate (a dead serve side silently wedges peers)."""
+    try:
+        try:
+            payload = conn.recv_bytes()
+            fn, args, kwargs = pickle.loads(payload)
+        except Exception as exc:  # noqa: BLE001 — bad request
+            result = ("err", RuntimeError(f"rpc request undecodable: "
+                                          f"{type(exc).__name__}: {exc}"))
+        else:
+            try:
+                result = ("ok", fn(*args, **kwargs))
+            except Exception as exc:  # noqa: BLE001 — shipped to caller
+                result = ("err", exc)
+        try:
+            blob = pickle.dumps(result)
+        except Exception as exc:  # noqa: BLE001 — unpicklable result
+            blob = pickle.dumps(
+                ("err", RuntimeError(f"rpc result not picklable: {exc}")))
+        conn.send_bytes(blob)
+    except (OSError, EOFError):
+        pass
+    finally:
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+
 def _serve(listener, stop):
-    """Accept loop: each request is one (fn, args, kwargs) pickle; the
-    reply is ("ok", result) or ("err", exception). shutdown() closes
+    """Accept loop: requests dispatch to handler threads so one slow
+    handler cannot serialize the whole control plane. shutdown() closes
     the listener, which breaks the accept with OSError."""
     while not stop.is_set():
         try:
             conn = listener.accept()
         except (OSError, EOFError):
             break
-        try:
-            payload = conn.recv_bytes()
-            fn, args, kwargs = pickle.loads(payload)
-            try:
-                result = ("ok", fn(*args, **kwargs))
-            except Exception as exc:  # noqa: BLE001 — shipped to caller
-                result = ("err", exc)
-            try:
-                blob = pickle.dumps(result)
-            except Exception as exc:  # noqa: BLE001 — unpicklable result
-                # e.g. a generator/lock return value raises TypeError,
-                # which must not kill the serve loop
-                blob = pickle.dumps(
-                    ("err", RuntimeError(
-                        f"rpc result not picklable: {exc}")))
-            conn.send_bytes(blob)
-        except (OSError, EOFError, pickle.PickleError):
-            pass
-        finally:
-            conn.close()
+        threading.Thread(target=_handle_one, args=(conn,),
+                         daemon=True).start()
 
 
 def _bind_ip() -> str:
     """The address peers should dial: a routable host IP for multi-host
-    jobs (PADDLE_RPC_BIND_IP overrides), loopback as last resort."""
+    jobs (PADDLE_RPC_BIND_IP overrides), loopback as last resort.
+
+    Uses the UDP-connect trick first: gethostbyname(gethostname())
+    resolves to 127.0.1.1 on stock Debian /etc/hosts, which remote
+    peers cannot dial."""
     import socket
     override = os.environ.get("PADDLE_RPC_BIND_IP")
     if override:
         return override
     try:
-        ip = socket.gethostbyname(socket.gethostname())
-        return ip or "127.0.0.1"
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("10.255.255.255", 1))  # no packet is sent
+            ip = s.getsockname()[0]
+        finally:
+            s.close()
+        if ip and not ip.startswith("127."):
+            return ip
     except OSError:
-        return "127.0.0.1"
+        pass
+    try:
+        ip = socket.gethostbyname(socket.gethostname())
+        if ip and not ip.startswith("127."):
+            return ip
+    except OSError:
+        pass
+    return "127.0.0.1"
 
 
 def init_rpc(name: str, rank: Optional[int] = None,
@@ -122,7 +154,7 @@ def init_rpc(name: str, rank: Optional[int] = None,
                                    is_master=(rank == 0),
                                    world_size=world_size)
 
-    listener = Listener((_bind_ip(), 0), authkey=_AUTHKEY)
+    listener = Listener((_bind_ip(), 0), backlog=16, authkey=_AUTHKEY)
     my_ip, my_port = listener.address
     stop = threading.Event()
     th = threading.Thread(target=_serve, args=(listener, stop),
@@ -176,8 +208,16 @@ def _invoke(to: str, fn, args, kwargs, timeout):
 
 def rpc_sync(to: str, fn, args=None, kwargs=None, timeout=-1):
     """Blocking call of fn(*args, **kwargs) on worker `to`
-    (reference rpc.py:160)."""
+    (reference rpc.py:160). A positive timeout bounds the WHOLE call —
+    connect + handshake + execution — not just the reply wait."""
     _require_init()
+    if timeout and timeout > 0:
+        fut = rpc_async(to, fn, args, kwargs, timeout=-1)
+        try:
+            return fut.result(timeout)
+        except TimeoutError:
+            raise TimeoutError(f"rpc to {to} timed out after {timeout}s") \
+                from None
     return _invoke(to, fn, args, kwargs, timeout)
 
 
@@ -214,6 +254,13 @@ def shutdown():
     # thread exits between the connect and the accept)
     _state["listener"].close()
     _state["serve_thread"].join(timeout=5)
+    # clear rendezvous keys: a later init_rpc on the SAME store (e.g.
+    # the process default_store) must not read stale listener ports
+    try:
+        for r in range(_state["world_size"]):
+            store.delete_key(f"__rpc/worker/{r}")
+    except Exception:  # noqa: BLE001 — store may be gone
+        pass
     _state.update(store=None, self=None, workers={}, listener=None,
                   serve_thread=None, stop=None, world_size=0)
 

@@ -35,12 +35,17 @@ def test_store_set_get_add_wait():
 @pytest.mark.nightly
 def test_store_blocking_get_across_processes(tmp_path):
     """get() must BLOCK until another process sets the key."""
+    import socket
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
     worker = tmp_path / "w.py"
-    worker.write_text(textwrap.dedent("""
+    worker.write_text(textwrap.dedent(f"""
         import sys, time
         from paddle_tpu.distributed.store import TCPStore
         role = sys.argv[1]
-        s = TCPStore("127.0.0.1", 38762, is_master=(role == "master"),
+        s = TCPStore("127.0.0.1", {port}, is_master=(role == "master"),
                      world_size=2)
         if role == "master":
             time.sleep(0.5)           # let the getter block first

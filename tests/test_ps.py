@@ -1,0 +1,61 @@
+"""Minimal parameter-server tests (reference test model: the PS CTR
+tests under test/ps — pull/push of dense params and lazily-initialized
+sparse embedding rows; here sync mode over the host RPC layer)."""
+import numpy as np
+import pytest
+
+
+def test_ps_loopback_dense_and_sparse():
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.ps import PSClient, PSServer
+
+    dist.rpc.init_rpc("ps0", rank=0, world_size=1,
+                      master_endpoint="127.0.0.1:38781")
+    try:
+        PSServer()
+        client = PSClient(["ps0"])
+
+        # dense: pull -> local grad -> push applies the SGD rule
+        client.create_dense_table("w", (4,), lr=0.5,
+                                  init=np.ones(4, np.float32))
+        w = client.pull_dense("w")
+        np.testing.assert_allclose(w, 1.0)
+        client.push_dense("w", np.full(4, 2.0, np.float32))
+        np.testing.assert_allclose(client.pull_dense("w"), 0.0)  # 1-0.5*2
+
+        # sparse: rows lazily initialize to zeros, push is row-wise
+        client.create_sparse_table("emb", dim=3, lr=1.0)
+        rows = client.pull_sparse("emb", [7, 42])
+        assert rows.shape == (2, 3)
+        np.testing.assert_allclose(rows, 0.0)
+        client.push_sparse("emb", [42], np.full((1, 3), 0.25, np.float32))
+        rows2 = client.pull_sparse("emb", [42, 7])
+        np.testing.assert_allclose(rows2[0], -0.25)
+        np.testing.assert_allclose(rows2[1], 0.0)
+    finally:
+        dist.rpc.shutdown()
+
+
+@pytest.mark.nightly
+def test_ps_embedding_training_loop(tmp_path):
+    """A tiny embedding 'training' loop against the PS: pull rows, take a
+    gradient step on-host, push; the table converges toward the target."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.ps import PSClient, PSServer
+
+    dist.rpc.init_rpc("ps0", rank=0, world_size=1,
+                      master_endpoint="127.0.0.1:38782")
+    try:
+        PSServer()
+        client = PSClient(["ps0"])
+        client.create_sparse_table("emb", dim=2, lr=0.5)
+        target = np.array([[1.0, -1.0], [2.0, 0.5]], np.float32)
+        ids = [3, 9]
+        for _ in range(30):
+            rows = client.pull_sparse("emb", ids)
+            grad = rows - target     # d/drows 0.5*||rows-target||^2
+            client.push_sparse("emb", ids, grad)
+        final = client.pull_sparse("emb", ids)
+        np.testing.assert_allclose(final, target, atol=1e-3)
+    finally:
+        dist.rpc.shutdown()
