@@ -32,6 +32,13 @@ import numpy as np
 # brpc_ps_server.cc plays this role)
 _TABLES: Dict[str, object] = {}
 
+# the RPC service dispatches each request on its own thread; table
+# updates are read-modify-write, so serialize them (one coarse lock —
+# the minimal PS optimizes for correctness, not update throughput)
+import threading  # noqa: E402
+
+_LOCK = threading.RLock()
+
 
 class DenseTable:
     """A dense parameter block with an SGD rule (reference dense table +
@@ -83,30 +90,36 @@ class SparseTable:
 # ---- RPC handlers (execute in the server process) -------------------------
 
 def _srv_register_dense(name, shape, lr, init):
-    _TABLES[name] = DenseTable(name, shape, lr, init)
+    with _LOCK:
+        _TABLES[name] = DenseTable(name, shape, lr, init)
     return True
 
 
 def _srv_register_sparse(name, dim, lr):
-    _TABLES[name] = SparseTable(name, dim, lr)
+    with _LOCK:
+        _TABLES[name] = SparseTable(name, dim, lr)
     return True
 
 
 def _srv_pull_dense(name):
-    return _TABLES[name].pull()
+    with _LOCK:
+        return _TABLES[name].pull().copy()
 
 
 def _srv_push_dense(name, grad):
-    _TABLES[name].push(grad)
+    with _LOCK:
+        _TABLES[name].push(grad)
     return True
 
 
 def _srv_pull_sparse(name, ids):
-    return _TABLES[name].pull(ids)
+    with _LOCK:
+        return _TABLES[name].pull(ids)
 
 
 def _srv_push_sparse(name, ids, grads):
-    _TABLES[name].push(ids, grads)
+    with _LOCK:
+        _TABLES[name].push(ids, grads)
     return True
 
 
@@ -159,17 +172,23 @@ class PSClient:
     def pull_sparse(self, name, ids) -> np.ndarray:
         from .. import rpc
         ids, owner = self._shard(ids)
-        out = np.zeros((len(ids), 0), np.float32)
+        if len(ids) == 0:
+            # the table knows dim; keep the (0, dim) shape contract
+            return rpc.rpc_sync(self.servers[0], _srv_pull_sparse,
+                                args=(name, []))
         rows = [None] * len(ids)
+        pending = []
         for s_idx, s in enumerate(self.servers):
             mask = owner == s_idx
             if not mask.any():
                 continue
-            got = rpc.rpc_sync(s, _srv_pull_sparse,
-                               args=(name, ids[mask].tolist()))
-            for pos, row in zip(np.nonzero(mask)[0], got):
+            fut = rpc.rpc_async(s, _srv_pull_sparse,
+                                args=(name, ids[mask].tolist()))
+            pending.append((np.nonzero(mask)[0], fut))
+        for positions, fut in pending:
+            for pos, row in zip(positions, fut.wait()):
                 rows[pos] = row
-        return np.stack(rows) if rows else out
+        return np.stack(rows)
 
     def push_sparse(self, name, ids, grads) -> None:
         from .. import rpc

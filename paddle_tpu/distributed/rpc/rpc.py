@@ -162,12 +162,18 @@ def init_rpc(name: str, rank: Optional[int] = None,
     th.start()
 
     try:
+        # generation-scoped keys: the k-th init_rpc on every rank gets
+        # the same generation number (each rank bumps its own counter),
+        # so a re-init on a shared store can never read a previous
+        # generation's stale listener ports — no deletion race either
+        gen = store.add(f"__rpc/seq/{rank}", 1)
         info = WorkerInfo(name, rank, my_ip, int(my_port))
-        store.set(f"__rpc/worker/{rank}", pickle.dumps(tuple(info)))
+        store.set(f"__rpc/{gen}/worker/{rank}", pickle.dumps(tuple(info)))
         workers = {}
         for r in range(world_size):
-            store.wait([f"__rpc/worker/{r}"])
-            w = WorkerInfo(*pickle.loads(store.get(f"__rpc/worker/{r}")))
+            key = f"__rpc/{gen}/worker/{r}"
+            store.wait([key])
+            w = WorkerInfo(*pickle.loads(store.get(key)))
             if w.name in workers and workers[w.name].rank != w.rank:
                 raise ValueError(
                     f"duplicate rpc worker name {w.name!r} (ranks "
@@ -182,7 +188,7 @@ def init_rpc(name: str, rank: Optional[int] = None,
 
     _state.update(store=store, self=info, workers=workers,
                   listener=listener, serve_thread=th, stop=stop,
-                  world_size=world_size)
+                  world_size=world_size, gen=gen)
 
 
 def _invoke(to: str, fn, args, kwargs, timeout):
@@ -245,22 +251,19 @@ def shutdown():
         return
     store = _state["store"]
     try:
-        store.barrier("__rpc/shutdown", timeout=60)
+        # generation-scoped barrier: a reused store must not satisfy a
+        # later shutdown from this generation's counters
+        store.barrier(f"__rpc/{_state.get('gen', 0)}/shutdown",
+                      timeout=60)
     except Exception:  # noqa: BLE001 — peers may already be gone
         pass
     _state["stop"].set()
     # closing the listener breaks the serve thread's accept() with
     # OSError — no wake-up dial needed (dialing could deadlock if the
-    # thread exits between the connect and the accept)
+    # thread exits between the connect and the accept). Stale
+    # generation keys are harmless: every generation reads only its own.
     _state["listener"].close()
     _state["serve_thread"].join(timeout=5)
-    # clear rendezvous keys: a later init_rpc on the SAME store (e.g.
-    # the process default_store) must not read stale listener ports
-    try:
-        for r in range(_state["world_size"]):
-            store.delete_key(f"__rpc/worker/{r}")
-    except Exception:  # noqa: BLE001 — store may be gone
-        pass
     _state.update(store=None, self=None, workers={}, listener=None,
                   serve_thread=None, stop=None, world_size=0)
 

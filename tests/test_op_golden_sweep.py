@@ -1254,3 +1254,98 @@ def test_sweep_breadth():
     """The sweep must cover >=300 distinct public ops (VERDICT r2 #4)."""
     names = {s.name for s in SPECS}
     assert len(names) >= 250, f"only {len(names)} distinct ops covered"
+
+
+# ---------------------------------------------------------------------------
+# inplace `_` variants (module: inplace in ops.yaml, reference paddle
+# convention: x.op_() mutates x and returns it)
+# ---------------------------------------------------------------------------
+
+def _inplace_ops_from_yaml():
+    import yaml  # PyYAML ships with the image
+
+    path = os.path.join(os.path.dirname(__file__), "..", "paddle_tpu",
+                        "ops", "ops.yaml")
+    with open(path) as f:
+        entries = yaml.safe_load(f)
+    return sorted(e["op"] for e in entries
+                  if e.get("module") == "inplace"
+                  and not e.get("alias_of"))
+
+
+import os  # noqa: E402
+
+_INPLACE_SKIP = {
+    # multi-input signatures exercised elsewhere (addmm in the forward
+    # sweep; the binary family in test_inplace_binary_sample)
+    "addmm_",
+    # value-dependent/randomized or non-elementwise contracts covered by
+    # their own tests
+    "exponential_", "uniform_", "normal_", "gaussian_", "bernoulli_",
+    "log_normal_", "cauchy_", "geometric_", "fill_", "zero_",
+    "fill_diagonal_", "fill_diagonal_tensor_", "put_along_axis_",
+    "index_put_", "index_add_", "index_fill_", "scatter_", "scatter_nd_add_",
+    "masked_fill_", "masked_scatter_", "set_", "copy_", "renorm_",
+    "resize_", "reshape_", "squeeze_", "unsqueeze_", "flatten_",
+    "transpose_", "t_", "lerp_", "clip_", "remainder_", "floor_divide_",
+    "pow_", "subtract_", "add_", "multiply_", "divide_", "scale_",
+    "where_", "logical_and_", "logical_or_", "logical_xor_",
+    "logical_not_", "bitwise_and_", "bitwise_or_", "bitwise_xor_",
+    "bitwise_not_", "equal_", "not_equal_", "less_than_", "less_equal_",
+    "greater_than_", "greater_equal_", "cumsum_", "cumprod_",
+    "nan_to_num_", "i0_", "tril_", "triu_",
+}
+
+
+def _unary_inplace_ops():
+    return [n for n in _inplace_ops_from_yaml() if n not in _INPLACE_SKIP]
+
+
+@pytest.mark.parametrize("name", _unary_inplace_ops())
+def test_inplace_unary_matches_base(name):
+    """x.op_() returns the same values as paddle.op(x) and rebinds x in
+    place (reference inplace `_` convention)."""
+    base_name = name[:-1]
+    base = getattr(paddle, base_name, None)
+    if base is None:
+        base = getattr(paddle.Tensor, base_name, None)
+    if base is None:
+        pytest.skip(f"no public base op for {name}")
+    # domain-safe positive inputs strictly inside every unary domain
+    a = np.asarray([[0.31, 0.52], [0.23, 0.74]], np.float32)
+    x_ref = paddle.to_tensor(a.copy())
+    try:
+        want = base(x_ref)
+    except TypeError:
+        pytest.skip(f"{base_name} needs extra args")
+    x = paddle.to_tensor(a.copy())
+    method = getattr(x, name, None)
+    if method is None:
+        pytest.skip(f"Tensor.{name} missing")
+    out = method()
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(want.numpy()),
+                               rtol=1e-5, atol=1e-6,
+                               err_msg=f"{name} != {base_name}")
+    # inplace: the SAME Tensor object now holds the result
+    np.testing.assert_allclose(np.asarray(x.numpy()),
+                               np.asarray(want.numpy()),
+                               rtol=1e-5, atol=1e-6,
+                               err_msg=f"{name} did not mutate in place")
+
+
+def test_inplace_binary_sample():
+    """Spot-check the arithmetic inplace family against base ops."""
+    a = np.asarray([1.5, 2.5, -3.0], np.float32)
+    b = np.asarray([0.5, 2.0, 1.5], np.float32)
+    for name, ref in [("add_", np.add), ("subtract_", np.subtract),
+                      ("multiply_", np.multiply), ("divide_", np.divide),
+                      ("remainder_", np.mod), ("pow_", np.power)]:
+        x = paddle.to_tensor(a.copy())
+        y = paddle.to_tensor(b.copy())
+        out = getattr(x, name)(y)
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref(a, b),
+                                   rtol=1e-5, atol=1e-6, err_msg=name)
+        np.testing.assert_allclose(np.asarray(x.numpy()), ref(a, b),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=f"{name} not in place")

@@ -1,8 +1,18 @@
 """Minimal parameter-server tests (reference test model: the PS CTR
 tests under test/ps — pull/push of dense params and lazily-initialized
 sparse embedding rows; here sync mode over the host RPC layer)."""
+import socket
+
 import numpy as np
 import pytest
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
 
 
 def test_ps_loopback_dense_and_sparse():
@@ -10,7 +20,7 @@ def test_ps_loopback_dense_and_sparse():
     from paddle_tpu.distributed.ps import PSClient, PSServer
 
     dist.rpc.init_rpc("ps0", rank=0, world_size=1,
-                      master_endpoint="127.0.0.1:38781")
+                      master_endpoint=f"127.0.0.1:{_free_port()}")
     try:
         PSServer()
         client = PSClient(["ps0"])
@@ -44,7 +54,7 @@ def test_ps_embedding_training_loop(tmp_path):
     from paddle_tpu.distributed.ps import PSClient, PSServer
 
     dist.rpc.init_rpc("ps0", rank=0, world_size=1,
-                      master_endpoint="127.0.0.1:38782")
+                      master_endpoint=f"127.0.0.1:{_free_port()}")
     try:
         PSServer()
         client = PSClient(["ps0"])

@@ -6,8 +6,19 @@ import subprocess
 import sys
 import textwrap
 
+import socket
+
 import numpy as np
 import pytest
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -21,7 +32,7 @@ def test_rpc_self_loopback():
     import paddle_tpu.distributed as dist
 
     dist.rpc.init_rpc("self", rank=0, world_size=1,
-                      master_endpoint="127.0.0.1:38771")
+                      master_endpoint=f"127.0.0.1:{_free_port()}")
     try:
         assert dist.rpc.rpc_sync("self", max, args=(3, 5)) == 5
         fut = dist.rpc.rpc_async("self", _mul, args=(6, 7))
@@ -41,7 +52,7 @@ def test_rpc_self_loopback():
         dist.rpc.shutdown()
     # re-init after shutdown works
     dist.rpc.init_rpc("again", rank=0, world_size=1,
-                      master_endpoint="127.0.0.1:38772")
+                      master_endpoint=f"127.0.0.1:{_free_port()}")
     assert dist.rpc.rpc_sync("again", len, args=((1, 2, 3),)) == 3
     dist.rpc.shutdown()
 
@@ -49,13 +60,14 @@ def test_rpc_self_loopback():
 @pytest.mark.nightly
 def test_rpc_cross_process(tmp_path):
     worker = tmp_path / "w.py"
+    port = _free_port()
     worker.write_text(textwrap.dedent("""
         import sys
         import paddle_tpu.distributed as dist
 
         rank = int(sys.argv[1])
         dist.rpc.init_rpc(f"worker{rank}", rank=rank, world_size=2,
-                          master_endpoint="127.0.0.1:38773")
+                          master_endpoint="127.0.0.1:PORT")
         if rank == 0:
             assert dist.rpc.rpc_sync("worker1", pow, args=(2, 10)) == 1024
             fut = dist.rpc.rpc_async("worker1", sorted,
@@ -63,7 +75,7 @@ def test_rpc_cross_process(tmp_path):
             assert fut.wait() == [1, 2, 3]
             print("RPC OK", flush=True)
         dist.rpc.shutdown()
-    """))
+    """).replace("PORT", str(port)))
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
