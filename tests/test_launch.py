@@ -125,6 +125,7 @@ def test_elastic_relaunch_resumes_from_checkpoint(tmp_path):
     x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
     y = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
     loss_fn = nn.MSELoss()
+    import time
     for step in range(start, 8):
         loss = loss_fn(net(x), y)
         loss.backward()
@@ -135,6 +136,9 @@ def test_elastic_relaunch_resumes_from_checkpoint(tmp_path):
             paddle.save({"net": net.state_dict(), "step": step + 1}, ckpt)
         if restart == 0 and rank == 1 and step == 3:
             os.kill(os.getpid(), signal.SIGKILL)  # simulate node loss
+        # pace the loop so the pre-kill generation cannot finish all 8
+        # steps before the launcher detects the lost rank
+        time.sleep(0.5)
     print("DONE", flush=True)
     """)
     r = _run_launch(tmp_path, script,
@@ -146,6 +150,10 @@ def test_elastic_relaunch_resumes_from_checkpoint(tmp_path):
     log0 = (tmp_path / "log" / "workerlog.0.restart1").read_text()
     assert "resumed from step" in log0
     assert "DONE" in log0
+    import re as _re0
+    resumed_at = int(_re0.search(r"resumed from step (\d+)",
+                                 log0).group(1))
+    assert 0 < resumed_at < 8  # resumed mid-run, not a fresh start
     # loss continuity: the resumed first loss continues the decreasing
     # sequence (it is <= the pre-kill generation's first loss)
     first_gen = (tmp_path / "log" / "workerlog.0").read_text()
