@@ -136,9 +136,10 @@ def test_elastic_relaunch_resumes_from_checkpoint(tmp_path):
             paddle.save({"net": net.state_dict(), "step": step + 1}, ckpt)
         if restart == 0 and rank == 1 and step == 3:
             os.kill(os.getpid(), signal.SIGKILL)  # simulate node loss
-        # pace the loop so the pre-kill generation cannot finish all 8
-        # steps before the launcher detects the lost rank
-        time.sleep(0.5)
+        if restart == 0:
+            # pace the loop so the pre-kill generation cannot finish
+            # all 8 steps before the launcher detects the lost rank
+            time.sleep(0.5)
     print("DONE", flush=True)
     """)
     r = _run_launch(tmp_path, script,
