@@ -21,6 +21,7 @@ kinds fall back to v5e.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
@@ -68,7 +69,7 @@ def _time_steps(step_fn, n, groups=2):
     return best_dt
 
 
-def _llama_run(cfg, batch, seq, n_steps=6):
+def _llama_run(cfg, batch, seq, n_steps=6, moment_dtype="bfloat16"):
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     from paddle_tpu.text.models import (LlamaForCausalLM,
@@ -77,7 +78,11 @@ def _llama_run(cfg, batch, seq, n_steps=6):
     paddle.seed(0)
     net = LlamaForCausalLM(cfg)
     loss_fn = nn.CrossEntropyLoss()
-    opt = paddle.optimizer.AdamW(3e-4, parameters=net.parameters())
+    # bf16 AdamW moments (fp32 master weights + update math): frees
+    # ~4.3 GB of HBM on the 1B config — the round-4 lever that bought
+    # batch 8 at seq 1024 (0.57 -> 0.64 MFU measured sweep)
+    opt = paddle.optimizer.AdamW(3e-4, parameters=net.parameters(),
+                                 moment_dtype=moment_dtype)
     step = paddle.jit.TrainStep(net, loss_fn, opt, amp_dtype="bfloat16")
     rng = np.random.default_rng(0)
     ids = paddle.to_tensor(
@@ -96,15 +101,20 @@ def _llama_run(cfg, batch, seq, n_steps=6):
 
 
 def bench_llama_1b():
-    """Headline: 1.07B params (LLaMA-7B layer shapes), seq 1024."""
+    """Headline: 1.07B params (LLaMA-7B layer shapes), seq 1024.
+
+    Round-4 measured-best single-chip config: batch 8 (bf16 optimizer
+    moments buy the HBM headroom), selective_qkv recompute (backward
+    recomputes no matmuls), tuned Pallas flash blocks.
+    """
     from paddle_tpu.text.models import LlamaConfig
     cfg = LlamaConfig(
         vocab_size=32000, hidden_size=4096, intermediate_size=11008,
         num_hidden_layers=4, num_attention_heads=32,
         num_key_value_heads=32, max_position_embeddings=1024,
-        recompute=True, recompute_granularity="selective",
+        recompute=True, recompute_granularity="selective_qkv",
         use_flash_attention=True)
-    return _llama_run(cfg, batch=4, seq=1024)
+    return _llama_run(cfg, batch=8, seq=1024)
 
 
 def bench_llama_long_seq():
@@ -114,9 +124,9 @@ def bench_llama_long_seq():
         vocab_size=32000, hidden_size=4096, intermediate_size=11008,
         num_hidden_layers=4, num_attention_heads=32,
         num_key_value_heads=32, max_position_embeddings=2048,
-        recompute=True, recompute_granularity="selective",
+        recompute=True, recompute_granularity="selective_qkv",
         use_flash_attention=True)
-    return _llama_run(cfg, batch=2, seq=2048)
+    return _llama_run(cfg, batch=4, seq=2048)
 
 
 def bench_llama_small():
@@ -186,12 +196,25 @@ def bench_lenet():
 
 
 def main():
+    """Timeout-proof protocol (round-4 fix for the r3 rc=124 loss):
+
+    1. Measure the 1B HEADLINE first and print the complete JSON line
+       the moment it exists — a driver kill after this point can only
+       truncate extras, never erase the round's number.
+    2. Run each extra under an explicit wall-clock budget
+       (``BENCH_TIME_BUDGET`` seconds, default 19 min); an extra is
+       skipped — and recorded as skipped — when its cost estimate
+       would overrun the budget. After every extra the FULL line is
+       re-printed, so the last JSON line on stdout is always the most
+       complete result.
+    """
     _enable_compile_cache()
+    t_start = time.time()
+    budget = float(os.environ.get("BENCH_TIME_BUDGET", str(19 * 60)))
+    deadline = t_start + budget
+
     tok_1b, mfu_1b, kind, n_params = bench_llama_1b()
-    tok_ls, mfu_ls, _, _ = bench_llama_long_seq()
-    tok_sm, mfu_sm, _, _ = bench_llama_small()
-    lenet_sps, speedup = bench_lenet()
-    print(json.dumps({
+    result = {
         "metric": "llama_1b_train_tokens_per_sec_per_chip",
         "value": round(tok_1b, 1),
         "unit": "tokens/sec",
@@ -199,15 +222,45 @@ def main():
         "extras": {
             "llama_1b_mfu": round(mfu_1b, 4),
             "llama_1b_params": int(n_params),
-            "llama_seq2048_mfu": round(mfu_ls, 4),
-            "llama_seq2048_tokens_per_sec": round(tok_ls, 1),
-            "llama_small_seq512_mfu": round(mfu_sm, 4),
-            "llama_small_tokens_per_sec": round(tok_sm, 1),
             "device_kind": kind,
-            "lenet_train_steps_per_sec_b256": round(lenet_sps, 2),
-            "lenet_compiled_vs_eager_speedup": round(speedup, 1),
         },
-    }))
+    }
+    print(json.dumps(result), flush=True)
+
+    def add_llama(prefix, fn):
+        tok, mfu, _, _ = fn()
+        result["extras"][f"{prefix}_mfu"] = round(mfu, 4)
+        result["extras"][f"{prefix}_tokens_per_sec"] = round(tok, 1)
+
+    def add_lenet():
+        sps, speedup = bench_lenet()
+        result["extras"]["lenet_train_steps_per_sec_b256"] = round(sps, 2)
+        result["extras"]["lenet_compiled_vs_eager_speedup"] = round(speedup, 1)
+
+    # (name, runner, wall-clock cost estimate in seconds: compile+measure
+    # on the tunneled chip, cold cache)
+    extras = [
+        ("llama_seq2048", lambda: add_llama("llama_seq2048",
+                                            bench_llama_long_seq), 420),
+        ("llama_small_seq512", lambda: add_llama("llama_small_seq512",
+                                                 bench_llama_small), 240),
+        ("lenet", add_lenet, 120),
+    ]
+    skipped = []
+    for name, run, est in extras:
+        if time.time() + est > deadline:
+            skipped.append(name)
+            continue
+        try:
+            run()
+        except Exception as exc:  # noqa: BLE001 — an extra must not kill the line
+            result["extras"][f"{name}_error"] = f"{type(exc).__name__}: {exc}"[:200]
+        if skipped:
+            result["extras"]["skipped"] = skipped
+        print(json.dumps(result), flush=True)
+    if skipped:
+        result["extras"]["skipped"] = skipped
+        print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

@@ -109,7 +109,8 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
-                 use_multi_tensor=False, amsgrad=False, name=None):
+                 use_multi_tensor=False, amsgrad=False, moment_dtype=None,
+                 name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name)
         self._beta1 = beta1
@@ -117,33 +118,47 @@ class Adam(Optimizer):
         self._epsilon = epsilon
         self._amsgrad = amsgrad
         self._multi_precision = bool(multi_precision)
+        # moment_dtype="bfloat16" halves optimizer-state HBM (the inverse
+        # of the reference's multi_precision lever: params stay the fp32
+        # masters, the *moments* are stored narrow and the update math
+        # still runs in fp32). On a 1B-param model this frees ~4.3 GB —
+        # the difference between batch 4 and batch 8 at seq 1024.
+        self._moment_dtype = jnp.dtype(moment_dtype) \
+            if moment_dtype is not None else None
 
     def _init_state(self, param):
-        s = {"moment1": jnp.zeros_like(param),
-             "moment2": jnp.zeros_like(param),
+        mdt = self._moment_dtype or param.dtype
+        s = {"moment1": jnp.zeros(param.shape, mdt),
+             "moment2": jnp.zeros(param.shape, mdt),
              "beta1_pow": jnp.ones((), param.dtype) * self._beta1,
              "beta2_pow": jnp.ones((), param.dtype) * self._beta2}
         if self._amsgrad:
-            s["moment2_max"] = jnp.zeros_like(param)
+            s["moment2_max"] = jnp.zeros(param.shape, mdt)
         return s
 
     def _adam_core(self, p, g, state, lr):
-        m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g
-        m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
+        mdt = state["moment1"].dtype
+        cdt = jnp.promote_types(mdt, jnp.float32)  # update math in fp32
+        g32 = g.astype(cdt)
+        m1 = self._beta1 * state["moment1"].astype(cdt) \
+            + (1 - self._beta1) * g32
+        m2 = self._beta2 * state["moment2"].astype(cdt) \
+            + (1 - self._beta2) * g32 * g32
         b1p, b2p = state["beta1_pow"], state["beta2_pow"]
         lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
         if self._amsgrad:
-            m2m = jnp.maximum(state.get("moment2_max"), m2)
+            m2m = jnp.maximum(state.get("moment2_max").astype(cdt), m2)
             denom = jnp.sqrt(m2m) + self._epsilon * jnp.sqrt(1 - b2p)
-            new = {"moment1": m1, "moment2": m2, "moment2_max": m2m,
+            new = {"moment1": m1.astype(mdt), "moment2": m2.astype(mdt),
+                   "moment2_max": m2m.astype(mdt),
                    "beta1_pow": b1p * self._beta1,
                    "beta2_pow": b2p * self._beta2}
         else:
             denom = jnp.sqrt(m2) + self._epsilon * jnp.sqrt(1 - b2p)
-            new = {"moment1": m1, "moment2": m2,
+            new = {"moment1": m1.astype(mdt), "moment2": m2.astype(mdt),
                    "beta1_pow": b1p * self._beta1,
                    "beta2_pow": b2p * self._beta2}
-        return p - lr_t * m1 / denom, new
+        return p - (lr_t * m1 / denom).astype(p.dtype), new
 
     def _update(self, p, g, state, lr, wd=None):
         g = _apply_l2(g, p, wd if wd is not None else self._weight_decay)
@@ -157,10 +172,11 @@ class AdamW(Adam):
                  epsilon=1e-8, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, amsgrad=False,
-                 name=None):
+                 moment_dtype=None, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          weight_decay, grad_clip, lazy_mode, multi_precision,
-                         amsgrad=amsgrad, name=name)
+                         amsgrad=amsgrad, moment_dtype=moment_dtype,
+                         name=name)
         self._apply_decay_param_fun = apply_decay_param_fun
         self._lr_ratio = lr_ratio
 

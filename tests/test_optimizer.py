@@ -176,3 +176,36 @@ def test_extended_optimizers_train():
         for _ in range(10):
             l1 = float(step(x, y).numpy())
         assert np.isfinite(l1) and l1 < l0, (cls_name, l0, l1)
+
+
+def test_adamw_bf16_moments():
+    """moment_dtype='bfloat16' halves optimizer-state memory (the round-4
+    HBM lever for the 1B bench config); update math stays fp32 and
+    convergence matches the fp32-moment run to bf16 tolerance."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    losses = {}
+    for mdt in [None, "bfloat16"]:
+        paddle.seed(0)
+        net = nn.Linear(16, 8)
+        opt = paddle.optimizer.AdamW(0.01, parameters=net.parameters(),
+                                     moment_dtype=mdt)
+        step = paddle.jit.TrainStep(net, nn.MSELoss(), opt)
+        x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+            (16, 16)).astype(np.float32))
+        y = paddle.to_tensor(np.zeros((16, 8), np.float32))
+        l0 = float(step(x, y).numpy())
+        for _ in range(20):
+            l1 = float(step(x, y).numpy())
+        assert np.isfinite(l1) and l1 < l0
+        losses[mdt] = l1
+        if mdt is not None:
+            st = step._opt_state
+            any_m = next(iter(st.values()))
+            assert str(any_m["moment1"].dtype) == "bfloat16"
+            assert str(any_m["moment2"].dtype) == "bfloat16"
+    # bf16 moments track the fp32 trajectory closely at this scale
+    assert abs(losses["bfloat16"] - losses[None]) < 0.1 * (
+        abs(losses[None]) + 1e-3)

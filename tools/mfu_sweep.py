@@ -1,0 +1,101 @@
+"""One MFU trial of the 1B-class bench model per process invocation.
+
+Round-4 tuning harness for the BASELINE.md config-4 headline: sweep
+batch size, recompute granularity, optimizer moment dtype, and Pallas
+flash-attention block shapes on the real chip, one subprocess per trial
+so HBM and the XLA client reset between configs. Prints one JSON line:
+
+    python tools/mfu_sweep.py --batch 8 --moments bfloat16 \
+        --recompute selective --bq 256 --bk 512
+
+The winning config goes into bench.py's bench_llama_1b.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--recompute", default="selective",
+                    choices=["none", "full", "selective", "selective_qkv"])
+    ap.add_argument("--moments", default="float32",
+                    choices=["float32", "bfloat16"])
+    ap.add_argument("--bq", type=int, default=0, help="flash BLOCK_Q override")
+    ap.add_argument("--bk", type=int, default=0, help="flash BLOCK_K override")
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--flash", type=int, default=1)
+    args = ap.parse_args()
+
+    from bench import _enable_compile_cache, _peak
+    _enable_compile_cache()
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.kernels import flash_attention as fa
+    from paddle_tpu.text.models import (LlamaConfig, LlamaForCausalLM,
+                                        llama_flops_per_token)
+
+    if args.bq:
+        fa.BLOCK_Q = args.bq
+    if args.bk:
+        fa.BLOCK_K = args.bk
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+        num_hidden_layers=args.layers, num_attention_heads=32,
+        num_key_value_heads=32, max_position_embeddings=args.seq,
+        recompute=args.recompute != "none",
+        recompute_granularity=(args.recompute
+                               if args.recompute != "none" else "selective"),
+        use_flash_attention=bool(args.flash))
+
+    paddle.seed(0)
+    net = LlamaForCausalLM(cfg)
+    loss_fn = nn.CrossEntropyLoss()
+    moment_dtype = None if args.moments == "float32" else args.moments
+    opt = paddle.optimizer.AdamW(3e-4, parameters=net.parameters(),
+                                 moment_dtype=moment_dtype)
+    step = paddle.jit.TrainStep(net, loss_fn, opt, amp_dtype="bfloat16")
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(
+        0, cfg.vocab_size, (args.batch, args.seq)).astype(np.int64))
+    labels = paddle.to_tensor(rng.integers(
+        0, cfg.vocab_size, (args.batch, args.seq)).astype(np.int64))
+
+    t0 = time.perf_counter()
+    step(ids, labels)                   # compile
+    compile_s = time.perf_counter() - t0
+    float(step(ids, labels).numpy())    # warm (fetch = the real sync)
+    best_dt = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            loss = step(ids, labels)
+        float(loss.numpy())
+        best_dt = min(best_dt, (time.perf_counter() - t0) / args.steps)
+    tokens_per_sec = args.batch * args.seq / best_dt
+    peak, _ = _peak()
+    mfu = tokens_per_sec * llama_flops_per_token(cfg) / peak
+    print(json.dumps({
+        "batch": args.batch, "seq": args.seq, "recompute": args.recompute,
+        "moments": args.moments, "bq": args.bq or fa.BLOCK_Q,
+        "bk": args.bk or fa.BLOCK_K, "layers": args.layers,
+        "tokens_per_sec": round(tokens_per_sec, 1), "mfu": round(mfu, 4),
+        "step_ms": round(best_dt * 1e3, 1), "compile_s": round(compile_s, 1),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
