@@ -61,9 +61,13 @@ def build_parser():
     return p
 
 
-def _run_pod(ns, nproc, world, master, restart_count):
+def _run_pod(ns, nproc, world, master, restart_count, rpc_authkey):
     """Spawn one generation of worker processes; wait for completion or
-    first failure. Returns (exit_code, n_alive_at_failure)."""
+    first failure. Returns (exit_code, n_healthy) where n_healthy counts
+    ranks that neither crashed nor wedged (cleanly-exited ranks count as
+    healthy — advisor r3: sizing the next elastic generation from the
+    still-running snapshot shrinks the world below the number of healthy
+    workers when a rank exits 0 just before another crashes)."""
     os.makedirs(ns.log_dir, exist_ok=True)
     procs = []
     logs = []
@@ -92,6 +96,9 @@ def _run_pod(ns, nproc, world, master, restart_count):
                 "MASTER_PORT": master.split(":")[-1],
                 "PADDLE_JOB_ID": ns.job_id,
                 "PADDLE_RESTART_COUNT": str(restart_count),
+                # per-job random RPC authkey: every rank shares it, no
+                # network peer outside the job knows it (advisor r3)
+                "PADDLE_RPC_AUTHKEY": rpc_authkey,
             })
             if wd_port is not None:
                 env["PADDLE_WATCHDOG_PORT"] = str(wd_port)
@@ -110,6 +117,7 @@ def _run_pod(ns, nproc, world, master, restart_count):
 
         # watcher: stop the pod on first failure (reference watcher role)
         exit_code = 0
+        failed = 0
         pod_start = time.time()
         rank_of = {id(p): ns.rank * nproc + i for i, p in enumerate(procs)}
         running = list(procs)
@@ -122,6 +130,7 @@ def _run_pod(ns, nproc, world, master, restart_count):
                     still.append(p)
                 elif rc != 0:
                     exit_code = rc
+                    failed += 1
             running = still
             if wd_store is not None and running:
                 from .. import watchdog as wd
@@ -132,6 +141,9 @@ def _run_pod(ns, nproc, world, master, restart_count):
                     wd_store, [rank_of[id(p)] for p in running],
                     ns.heartbeat_timeout, started_at=pod_start)
                 if wedged:
+                    # a wedged-but-running rank is NOT healthy: the next
+                    # generation must exclude it, not relaunch full-size
+                    failed += len(wedged)
                     # stacks into each rank's log before the kill
                     for p in running:
                         try:
@@ -140,7 +152,7 @@ def _run_pod(ns, nproc, world, master, restart_count):
                             pass
                     time.sleep(2.0)  # let faulthandler flush
                     exit_code = 124
-        alive = len(running)
+        healthy = nproc - failed
         if exit_code != 0:
             for p in procs:
                 if p.poll() is None:
@@ -150,7 +162,7 @@ def _run_pod(ns, nproc, world, master, restart_count):
                     p.wait(timeout=10)
                 except subprocess.TimeoutExpired:
                     p.kill()
-        return exit_code, alive
+        return exit_code, healthy
     finally:
         for f in logs:
             f.close()
@@ -173,16 +185,21 @@ def launch(args=None):
 
     nproc = ns.nproc_per_node
     restarts = 0
+    rpc_authkey = os.environ.get("PADDLE_RPC_AUTHKEY")
+    if not rpc_authkey:
+        import secrets
+        rpc_authkey = secrets.token_hex(16)
     while True:
         world = ns.nnodes * nproc
-        exit_code, alive = _run_pod(ns, nproc, world, master, restarts)
+        exit_code, healthy = _run_pod(ns, nproc, world, master, restarts,
+                                      rpc_authkey)
         if exit_code == 0 or not ns.elastic_level or \
                 restarts >= ns.max_restarts:
             return exit_code
         # elastic relaunch (reference manager.py:125: watch detects the
         # lost member, launcher restarts with the new world size; the
         # training script resumes from its latest checkpoint)
-        new_nproc = max(1, alive)
+        new_nproc = max(1, healthy)
         print(f"launch: rank failure (exit {exit_code}); elastic "
               f"relaunch {restarts + 1}/{ns.max_restarts} with "
               f"nproc {nproc} -> {new_nproc}", flush=True)

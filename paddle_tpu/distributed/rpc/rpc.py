@@ -40,7 +40,30 @@ _state = {
     "world_size": 0,
 }
 
-_AUTHKEY = b"paddle_tpu_rpc"
+def _resolve_authkey(store, rank: int, gen) -> bytes:
+    """Per-job connection authkey (advisor r3: a compile-time constant
+    key is no authentication at all on a routable interface). Priority:
+    explicit ``PADDLE_RPC_AUTHKEY`` env (the launcher generates one
+    random token per job and injects it into every rank's env — the
+    secure path); else rank 0 mints a random token and shares it
+    through the rendezvous store under the same generation scoping as
+    the worker infos. The fallback is only as trustworthy as the store:
+    a peer who can read the rendezvous store can read the token too, so
+    jobs on untrusted networks must provision the key out-of-band (env)
+    — the same trust model as the reference's store-rendezvoused
+    process groups."""
+    key = os.environ.get("PADDLE_RPC_AUTHKEY")
+    if key:
+        return key.encode()
+    skey = f"__rpc/{gen}/authkey"
+    if rank == 0:
+        import secrets
+        token = secrets.token_hex(16).encode()
+        store.set(skey, token)
+        return token
+    store.wait([skey])
+    token = store.get(skey)
+    return token if isinstance(token, bytes) else bytes(token)
 
 
 def _handle_one(conn):
@@ -154,7 +177,14 @@ def init_rpc(name: str, rank: Optional[int] = None,
                                    is_master=(rank == 0),
                                    world_size=world_size)
 
-    listener = Listener((_bind_ip(), 0), backlog=16, authkey=_AUTHKEY)
+    # generation-scoped keys: the k-th init_rpc on every rank gets
+    # the same generation number (each rank bumps its own counter),
+    # so a re-init on a shared store can never read a previous
+    # generation's stale listener ports — no deletion race either
+    gen = store.add(f"__rpc/seq/{rank}", 1)
+    authkey = _resolve_authkey(store, rank, gen)
+
+    listener = Listener((_bind_ip(), 0), backlog=16, authkey=authkey)
     my_ip, my_port = listener.address
     stop = threading.Event()
     th = threading.Thread(target=_serve, args=(listener, stop),
@@ -162,11 +192,6 @@ def init_rpc(name: str, rank: Optional[int] = None,
     th.start()
 
     try:
-        # generation-scoped keys: the k-th init_rpc on every rank gets
-        # the same generation number (each rank bumps its own counter),
-        # so a re-init on a shared store can never read a previous
-        # generation's stale listener ports — no deletion race either
-        gen = store.add(f"__rpc/seq/{rank}", 1)
         info = WorkerInfo(name, rank, my_ip, int(my_port))
         store.set(f"__rpc/{gen}/worker/{rank}", pickle.dumps(tuple(info)))
         workers = {}
@@ -188,7 +213,7 @@ def init_rpc(name: str, rank: Optional[int] = None,
 
     _state.update(store=store, self=info, workers=workers,
                   listener=listener, serve_thread=th, stop=stop,
-                  world_size=world_size, gen=gen)
+                  world_size=world_size, gen=gen, authkey=authkey)
 
 
 def _invoke(to: str, fn, args, kwargs, timeout):
@@ -196,7 +221,7 @@ def _invoke(to: str, fn, args, kwargs, timeout):
     if w is None:
         raise ValueError(f"unknown rpc worker {to!r}; known: "
                          f"{sorted(_state['workers'])}")
-    conn = Client((w.ip, w.port), authkey=_AUTHKEY)
+    conn = Client((w.ip, w.port), authkey=_state["authkey"])
     try:
         conn.send_bytes(pickle.dumps((fn, tuple(args or ()),
                                       dict(kwargs or {}))))

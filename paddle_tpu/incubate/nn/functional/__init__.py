@@ -162,7 +162,12 @@ def fused_linear_cross_entropy(hidden, weight, labels, ignore_index=-100,
             picked = jnp.take_along_axis(
                 logits, jnp.clip(lc, 0, logits.shape[-1] - 1)[:, None],
                 axis=-1)[:, 0]
-            valid = lc != ignore_index
+            # labels outside [0, V) are invalid, not silently clipped to
+            # the nearest class (advisor r3): they contribute no loss,
+            # matching the unfused CE path's validation semantics under
+            # jit (where raising on traced data is impossible)
+            valid = ((lc != ignore_index) & (lc >= 0)
+                     & (lc < logits.shape[-1]))
             nll = jnp.where(valid, lse - picked, 0.0)
             tot, cnt = carry
             return (tot + jnp.sum(nll),

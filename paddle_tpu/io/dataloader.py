@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import itertools
 import multiprocessing as mp
+import os
 from typing import Callable, Optional
 
 import numpy as np
@@ -86,13 +87,45 @@ class _ShmArray:
         return shm, arr
 
 
+_SHM_PREFIX = None
+_SHM_SEQ = itertools.count()
+
+
+def _ensure_shm_prefix():
+    """Parent-side: fix a per-job segment name prefix and register an
+    atexit sweep that unlinks any segment still carrying it. Workers
+    inherit the prefix by fork, so even if the parent dies between a
+    worker-side pack and the parent-side unlink (advisor r3: that
+    window leaked /dev/shm segments), a clean parent exit reclaims
+    everything this job ever created."""
+    global _SHM_PREFIX
+    if _SHM_PREFIX is not None:
+        return
+    _SHM_PREFIX = f"ptdl{os.getpid()}_"
+    if os.path.isdir("/dev/shm"):
+        import atexit
+        import glob
+
+        def _sweep(prefix=_SHM_PREFIX):
+            for path in glob.glob(f"/dev/shm/{prefix}*"):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        atexit.register(_sweep)
+
+
 def _shm_pack(obj):
     """Move every large ndarray in a collated batch into shared memory."""
     if isinstance(obj, Tensor):
         obj = np.asarray(obj.numpy())
     if isinstance(obj, np.ndarray) and obj.nbytes >= 1 << 16:
         from multiprocessing import resource_tracker, shared_memory
-        shm = shared_memory.SharedMemory(create=True, size=obj.nbytes)
+        name = None
+        if _SHM_PREFIX is not None:
+            name = f"{_SHM_PREFIX}{os.getpid()}_{next(_SHM_SEQ)}"
+        shm = shared_memory.SharedMemory(create=True, size=obj.nbytes,
+                                         name=name)
         dst = np.ndarray(obj.shape, dtype=obj.dtype, buffer=shm.buf)
         dst[...] = obj
         handle = _ShmArray(shm.name, obj.shape, obj.dtype)
@@ -242,6 +275,8 @@ class DataLoader:
             yield self.collate_fn([self.dataset[i] for i in indices])
 
     def _make_pool(self):
+        if self.use_shared_memory:
+            _ensure_shm_prefix()  # before fork so workers inherit it
         counter = mp.Value("i", 0)
         ctx = mp.get_context("fork")
         return ctx.Pool(

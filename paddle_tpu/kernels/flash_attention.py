@@ -22,6 +22,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.dispatch import run_op
 from ..core.flags import define_flag, get_flag
@@ -513,12 +514,22 @@ _flash_pallas.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 def _flash_xla(q, k, v, causal, scale):
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    out_mask = None
     if causal:
         sq, sk = logits.shape[-2], logits.shape[-1]
-        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        # static-shape mask built host-side so the fully-masked-row test
+        # below stays concrete under jit
+        mask = np.tril(np.ones((sq, sk), bool), k=sk - sq)
         logits = jnp.where(mask, logits, NEG_INF)
+        out_mask = mask.any(-1)  # rows with no visible key (sq > sk)
     p = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    if out_mask is not None and not out_mask.all():
+        # fully-masked rows: emit zeros like the Pallas kernel (flash-attn
+        # v2 convention) instead of softmax's uniform average of V, so the
+        # flag-gated fallback cannot silently change numerics
+        out = jnp.where(out_mask[:, None], out, jnp.zeros_like(out))
+    return out
 
 
 def _tileable(sq, sk, d):
