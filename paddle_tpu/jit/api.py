@@ -71,11 +71,17 @@ class StaticFunction:
             self._fn = fn.forward
         self._input_spec = input_spec
         self._cache = {}
-        # signatures that graph-broke; other signatures keep their
-        # compiled entries
-        self._eager_sigs = set()
+        # signatures that graph-broke -> eager calls since the pin; other
+        # signatures keep their compiled entries. A pin is dropped (and
+        # compilation retried) every _RETRY_AFTER fallback calls, so a
+        # signature that traced badly once — e.g. before a warmup flag
+        # flipped — is not condemned to eager forever
+        self._eager_sigs = {}
+        self._child_sf = None  # lazily-built per-sublayer compilers
         self._warned_break = False
         functools.update_wrapper(self, self._fn)
+
+    _RETRY_AFTER = 16
 
     @property
     def layer(self):
@@ -120,14 +126,58 @@ class StaticFunction:
         if not self._warned_break:
             import warnings
             name = getattr(self._fn, "__qualname__", repr(self._fn))
+            how = ("keeping each traceable sublayer compiled and running "
+                   "only the parent control flow eagerly"
+                   if self._layer is not None else
+                   "falling back to eager for this function")
             warnings.warn(
                 f"to_static({name}): value-dependent Python control flow "
-                f"cannot be traced ({type(exc).__name__}); falling back "
-                "to eager for this function. Use paddle.static.nn.cond / "
-                "while_loop to keep it compiled.", stacklevel=3)
+                f"cannot be traced ({type(exc).__name__}); {how}. Use "
+                "paddle.static.nn.cond / while_loop to keep the whole "
+                "graph compiled.", stacklevel=3)
             self._warned_break = True
-        target = self._layer if self._layer is not None else self._fn
-        return target(*args, **kwargs)
+        return self._fallback_call(args, kwargs)
+
+    def _fallback_call(self, args, kwargs):
+        """The reference's SOT breaks the graph at the un-traceable
+        opcode and keeps the regions on both sides compiled
+        (jit/sot/translate.py:91). The per-sublayer analog: run the
+        parent's forward as Python, but route every sublayer call that
+        originates from the eager region through its own StaticFunction
+        — a 10-layer model with one value-dependent branch keeps the
+        other layers compiled. Sublayer calls that happen *inside* an
+        enclosing trace inline their original forward, so the largest
+        traceable subtree compiles as one unit. Plain functions (no
+        layer tree to segment) run fully eager."""
+        if self._layer is None:
+            return self._fn(*args, **kwargs)
+        layer = self._layer
+        # the compiled sublayer path returns fresh (tape-less) Tensors,
+        # same as the whole-layer compiled path; when the caller is
+        # recording gradients the only correct fallback is full eager
+        if tape_mod.is_grad_enabled() and any(
+                not p.stop_gradient for p in layer.parameters()):
+            return layer(*args, **kwargs)
+        if self._child_sf is None:
+            self._child_sf = {}
+        patched = []
+        try:
+            for name, child in layer.named_sublayers():
+                if "forward" in child.__dict__:
+                    continue  # already patched (shared module)
+                sf = self._child_sf.get(name)
+                if sf is None:
+                    sf = StaticFunction(child)
+                    self._child_sf[name] = sf
+                child.forward = _child_compiled_forward(child, sf)
+                patched.append(child)
+            return layer(*args, **kwargs)
+        finally:
+            for child in patched:
+                try:
+                    del child.forward
+                except AttributeError:
+                    pass
 
     def __call__(self, *args, **kwargs):
         tensor_args = []
@@ -140,9 +190,14 @@ class StaticFunction:
             else:
                 static_kwargs[k] = v
         sig = _sig_of(tensor_args, static_kwargs)
-        if sig in self._eager_sigs:
-            target = self._layer if self._layer is not None else self._fn
-            return target(*args, **kwargs)
+        pinned = self._eager_sigs.get(sig)
+        if pinned is not None:
+            if pinned + 1 < self._RETRY_AFTER:
+                self._eager_sigs[sig] = pinned + 1
+                return self._fallback_call(args, kwargs)
+            # the branch value (or a warmup flag) may have changed since
+            # the pin: drop it and give the full graph another chance
+            del self._eager_sigs[sig]
         entry = self._cache.get(sig)
         if self._layer is None:
             if entry is None:
@@ -152,7 +207,7 @@ class StaticFunction:
                 # ONE tape op: compiled forward, vjp = compiled backward
                 return run_op("jit_fn", entry, tensor_args)
             except self._BREAK_ERRORS as exc:
-                self._eager_sigs.add(sig)
+                self._eager_sigs[sig] = 0
                 return self._graph_break(exc, args, kwargs)
 
         layer = self._layer
@@ -168,12 +223,43 @@ class StaticFunction:
             out_arrays, new_buf = entry(params, buffers, frozen, key,
                                         *arrays)
         except self._BREAK_ERRORS as exc:
-            self._eager_sigs.add(sig)
+            self._eager_sigs[sig] = 0
             return self._graph_break(exc, args, kwargs)
         write_back(layer, {}, new_buf)
         return jax.tree_util.tree_map(
             lambda a: wrap(a), out_arrays,
             is_leaf=lambda a: isinstance(a, (jax.Array, np.ndarray)))
+
+
+def _under_trace(args, kwargs):
+    leaves = jax.tree_util.tree_leaves(
+        (args, kwargs),
+        is_leaf=lambda t: isinstance(t, Tensor))
+    for leaf in leaves:
+        arr = leaf._data if isinstance(leaf, Tensor) else leaf
+        if isinstance(arr, jax.core.Tracer):
+            return True
+    return False
+
+
+def _child_compiled_forward(child, sf):
+    """Instance-level forward override used during a parent's partial
+    (graph-broken) call: the sublayer call goes through its own
+    StaticFunction. The override is lifted around the delegated call so
+    tracing (and any eager fallback inside ``sf``) reaches the real
+    forward instead of recursing into this wrapper. Calls arriving with
+    tracer inputs are already inside an enclosing sublayer's trace —
+    inline the original forward there (a nested StaticFunction would
+    write traced buffers back into live layers)."""
+    def wrapper(*a, **kw):
+        del child.forward
+        try:
+            if _under_trace(a, kw):
+                return child.forward(*a, **kw)
+            return sf(*a, **kw)
+        finally:
+            child.forward = wrapper
+    return wrapper
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
