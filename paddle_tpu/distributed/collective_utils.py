@@ -13,9 +13,22 @@ def ring_perm(n):
 
 def varying(tree, axis):
     """Mark a pytree of arrays as varying over the manual axis `axis`
-    (scan carries must have a loop-invariant varying-manual-axes type)."""
+    (scan carries must have a loop-invariant varying-manual-axes type).
+    Idempotent: leaves already varying over `axis` pass through."""
     pcast = getattr(lax, "pcast", None)
-    if pcast is not None:
-        return jax.tree_util.tree_map(
-            lambda a: pcast(a, axis, to="varying"), tree)
-    return jax.tree_util.tree_map(lambda a: lax.pvary(a, axis), tree)
+
+    def mark(a):
+        try:
+            if pcast is not None:
+                return pcast(a, axis, to="varying")
+            return lax.pvary(a, axis)
+        except ValueError as exc:
+            # only the already-varying case passes through ("Unsupported
+            # pcast from=varying, to='varying'"); any other ValueError
+            # (bad axis name, future API change) must surface here, not
+            # as a distant carry-mismatch in the scan
+            if "from=varying" in str(exc):
+                return a
+            raise
+
+    return jax.tree_util.tree_map(mark, tree)
