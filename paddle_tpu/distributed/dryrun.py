@@ -182,6 +182,8 @@ def run_dryrun(n_devices: int) -> None:
 
     _dryrun_pipeline(jax, n_devices)
     _dryrun_vpp(jax, n_devices)
+    _dryrun_zb(jax, n_devices)
+    _dryrun_het(jax, n_devices)
     _dryrun_moe(jax, n_devices)
     _dryrun_context_parallel(jax, n_devices)
     _dryrun_hybrid_3d(jax, n_devices)
@@ -318,6 +320,143 @@ def _dryrun_vpp(jax, n_devices: int) -> None:
             o1).numpy()) for _ in range(2)]
 
     _assert_aligned("vpp", [l0, l1],
+                    _single_device_losses(jax, single_run))
+
+
+def _dryrun_zb(jax, n_devices: int) -> None:
+    """Phase 2c: zero-bubble (ZBH1) schedule — the dX/dW-split backward
+    (zero_bubble.py) must train align-green with the single-device run
+    (VERDICT r3 missing #1)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        LayerDesc, PipelineLayer, PipelineParallel)
+
+    pp = 4 if n_devices % 4 == 0 else (2 if n_devices % 2 == 0 else 1)
+    if pp == 1:
+        print("dryrun zb: skipped (n_devices not divisible)")
+        return
+    dp = n_devices // pp
+    mesh_mod.set_mesh(mesh_mod.build_mesh({"pp": pp, "dp": dp}))
+
+    hidden, batch = 16, 8 * dp
+    paddle.seed(0)
+
+    class Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(hidden, hidden)
+
+        def forward(self, x):
+            return x + paddle.tanh(self.fc(x))
+
+    def build(num_stages):
+        paddle.seed(0)
+        return PipelineLayer(
+            layers=[LayerDesc(Block) for _ in range(2 * pp)],
+            num_stages=num_stages, loss_fn=nn.MSELoss())
+
+    strategy = fleet.DistributedStrategy()
+    strategy.pipeline_configs["accumulate_steps"] = 2 * pp
+    strategy.pipeline_configs["schedule_mode"] = "ZBH1"
+
+    rng = np.random.default_rng(3)
+    x_np = rng.standard_normal((batch, hidden)).astype(np.float32)
+    y_np = rng.standard_normal((batch, hidden)).astype(np.float32)
+
+    pl = build(pp)
+    model = PipelineParallel(pl, strategy=strategy)
+    assert model.schedule_mode == "ZBH1"
+    opt = paddle.optimizer.AdamW(1e-3, parameters=pl.parameters())
+    with jax.set_mesh(mesh_mod.get_mesh()):
+        l0 = float(model.train_batch(
+            (paddle.to_tensor(x_np), paddle.to_tensor(y_np)),
+            opt).numpy())
+        l1 = float(model.train_batch(
+            (paddle.to_tensor(x_np), paddle.to_tensor(y_np)),
+            opt).numpy())
+    assert np.isfinite(l0) and np.isfinite(l1), (l0, l1)
+    print(f"dryrun zb ok: pp={pp} dp={dp} loss0={l0:.4f} loss1={l1:.4f}")
+
+    def single_run():
+        pl1 = build(1)
+        m1 = PipelineParallel(pl1, strategy=strategy)
+        o1 = paddle.optimizer.AdamW(1e-3, parameters=pl1.parameters())
+        return [float(m1.train_batch(
+            (paddle.to_tensor(x_np), paddle.to_tensor(y_np)),
+            o1).numpy()) for _ in range(2)]
+
+    _assert_aligned("zb", [l0, l1], _single_device_losses(jax, single_run))
+
+
+def _dryrun_het(jax, n_devices: int) -> None:
+    """Phase 2d: heterogeneous stages — explicit non-uniform seg_method
+    bounds with stage-varying layer widths (het_pipeline.py; VERDICT r3
+    missing #3). Align-checked against the sequential run."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        PipelineLayer, PipelineParallel)
+
+    pp = 4 if n_devices % 4 == 0 else (2 if n_devices % 2 == 0 else 1)
+    if pp == 1:
+        print("dryrun het: skipped (n_devices not divisible)")
+        return
+    mesh_mod.set_mesh(mesh_mod.build_mesh({"pp": pp}))
+
+    class Wide(nn.Layer):
+        def __init__(self, din, dout):
+            super().__init__()
+            self.fc = nn.Linear(din, dout)
+
+        def forward(self, x):
+            return paddle.tanh(self.fc(x))
+
+    widths = [(8, 8)] * (pp - 1) + [(8, 12), (12, 8)] + [(8, 8)]
+    seg = [1] * (pp - 1) + [3]           # non-uniform: last stage gets 3
+
+    def build(num_stages, seg_method):
+        paddle.seed(0)
+        return PipelineLayer(
+            layers=[Wide(a, b) for a, b in widths],
+            num_stages=num_stages, loss_fn=nn.MSELoss(),
+            seg_method=seg_method)
+
+    strategy = fleet.DistributedStrategy()
+    strategy.pipeline_configs["accumulate_steps"] = pp
+
+    rng = np.random.default_rng(11)
+    x_np = rng.standard_normal((4 * pp, 8)).astype(np.float32)
+    y_np = rng.standard_normal((4 * pp, 8)).astype(np.float32)
+
+    pl = build(pp, seg)
+    model = PipelineParallel(pl, strategy=strategy)
+    assert model._het, "non-uniform bounds must select the het schedule"
+    opt = paddle.optimizer.AdamW(1e-3, parameters=pl.parameters())
+    with jax.set_mesh(mesh_mod.get_mesh()):
+        l0 = float(model.train_batch(
+            (paddle.to_tensor(x_np), paddle.to_tensor(y_np)),
+            opt).numpy())
+        l1 = float(model.train_batch(
+            (paddle.to_tensor(x_np), paddle.to_tensor(y_np)),
+            opt).numpy())
+    assert np.isfinite(l0) and np.isfinite(l1), (l0, l1)
+    print(f"dryrun het ok: pp={pp} seg={seg} loss0={l0:.4f} "
+          f"loss1={l1:.4f}")
+
+    def single_run():
+        pl1 = build(1, "uniform")
+        m1 = PipelineParallel(pl1, strategy=strategy)
+        o1 = paddle.optimizer.AdamW(1e-3, parameters=pl1.parameters())
+        return [float(m1.train_batch(
+            (paddle.to_tensor(x_np), paddle.to_tensor(y_np)),
+            o1).numpy()) for _ in range(2)]
+
+    _assert_aligned("het", [l0, l1],
                     _single_device_losses(jax, single_run))
 
 

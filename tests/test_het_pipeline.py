@@ -1,0 +1,129 @@
+"""Heterogeneous pipeline stages (reference pp_layers.py:114-119:
+custom seg_method bounds and non-uniform layer lists).
+
+The compiled schedule handles them via per-stage lax.switch bodies over
+flat-padded params/activations (het_pipeline.py); training must
+align-match the single-process sequential run.
+"""
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.meta_parallel import (LayerDesc,
+                                                        PipelineLayer,
+                                                        PipelineParallel)
+
+
+def _need(n):
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices")
+
+
+class Wide(nn.Layer):
+    def __init__(self, din, dout):
+        super().__init__()
+        self.fc = nn.Linear(din, dout)
+
+    def forward(self, x):
+        return paddle.tanh(self.fc(x))
+
+
+def _build(num_stages, seg):
+    paddle.seed(0)
+    # 6 layers, widths change mid-pipeline: 8->8, 8->8, 8->12, 12->12,
+    # 12->8, 8->8 — stages can neither share param shapes nor
+    # activation shapes
+    layers = [Wide(8, 8), Wide(8, 8), Wide(8, 12), Wide(12, 12),
+              Wide(12, 8), Wide(8, 8)]
+    return PipelineLayer(layers=layers, num_stages=num_stages,
+                         loss_fn=nn.MSELoss(), seg_method=seg)
+
+
+def test_het_pipeline_aligns_with_single():
+    _need(4)
+    pp = 4
+    mesh_mod.set_mesh(mesh_mod.build_mesh({"pp": pp}))
+    strategy = fleet.DistributedStrategy()
+    strategy.pipeline_configs["accumulate_steps"] = pp
+
+    rng = np.random.default_rng(0)
+    x_np = rng.standard_normal((8, 8)).astype(np.float32)
+    y_np = rng.standard_normal((8, 8)).astype(np.float32)
+
+    # non-uniform explicit bounds: [1, 2, 2, 1] layers per stage
+    pl = _build(pp, [1, 2, 2, 1])
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # the old forced-uniform warning
+        model = PipelineParallel(pl, strategy=strategy)
+    assert model._het
+    opt = paddle.optimizer.AdamW(1e-2, parameters=pl.parameters())
+    with jax.set_mesh(mesh_mod.get_mesh()):
+        dist = [float(model.train_batch(
+            (paddle.to_tensor(x_np), paddle.to_tensor(y_np)),
+            opt).numpy()) for _ in range(3)]
+    assert all(np.isfinite(v) for v in dist)
+    assert dist[2] < dist[0]  # training moves
+
+    # single-process sequential truth
+    mesh_mod.set_mesh(mesh_mod.build_mesh({"pp": 1}))
+    pl1 = _build(1, "uniform")
+    o1 = paddle.optimizer.AdamW(1e-2, parameters=pl1.parameters())
+    single = []
+    loss_fn = nn.MSELoss()
+    for _ in range(3):
+        out = pl1(paddle.to_tensor(x_np))
+        loss = loss_fn(out, paddle.to_tensor(y_np))
+        loss.backward()
+        o1.step()
+        o1.clear_grad()
+        single.append(float(loss.numpy()))
+    np.testing.assert_allclose(dist, single, rtol=2e-3, atol=1e-5)
+
+    # sync_to_model writes the trained vectors back into layer tensors
+    model.sync_to_model()
+    w_dist = np.asarray(pl._items[0].fc.weight.numpy())
+    assert np.isfinite(w_dist).all()
+
+
+def test_het_pipeline_frozen_params_stay_put():
+    _need(2)
+    pp = 2
+    mesh_mod.set_mesh(mesh_mod.build_mesh({"pp": pp}))
+    strategy = fleet.DistributedStrategy()
+    strategy.pipeline_configs["accumulate_steps"] = pp
+
+    paddle.seed(1)
+    layers = [Wide(8, 8), Wide(8, 8), Wide(8, 8)]
+    layers[0].fc.weight.stop_gradient = True
+    layers[0].fc.bias.stop_gradient = True
+    frozen_w = np.asarray(layers[0].fc.weight.numpy()).copy()
+    pl = PipelineLayer(layers=layers, num_stages=pp,
+                       loss_fn=nn.MSELoss(), seg_method=[1, 2])
+    model = PipelineParallel(pl, strategy=strategy)
+    assert model._het
+    opt = paddle.optimizer.AdamW(1e-2, parameters=pl.parameters())
+    rng = np.random.default_rng(1)
+    x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+    with jax.set_mesh(mesh_mod.get_mesh()):
+        for _ in range(3):
+            model.train_batch((x, y), opt)
+    model.sync_to_model()
+    np.testing.assert_array_equal(
+        np.asarray(pl._items[0].fc.weight.numpy()), frozen_w)
+    # trainable stage-1 weights did move
+    assert not np.allclose(
+        np.asarray(pl._items[2].fc.weight.numpy()),
+        np.asarray(_fresh_w(1)), atol=0)
+
+
+def _fresh_w(seed):
+    paddle.seed(seed)
+    layers = [Wide(8, 8), Wide(8, 8), Wide(8, 8)]
+    return layers[2].fc.weight.numpy()
