@@ -48,11 +48,26 @@ _role_maker = None
 
 
 def init(role_maker=None, is_collective=True, strategy=None, log_level=None):
-    """Reference fleet.py:218. Builds the global hybrid mesh."""
+    """Reference fleet.py:218. Builds the global hybrid mesh — or, when
+    the role maker carries parameter-server roles (TRAINING_ROLE env or
+    UserDefinedRoleMaker server_endpoints), enters PS training mode
+    (ps/fleet_ps.py): no device mesh, host-side tables over rpc."""
     global _fleet_initialized, _strategy, _role_maker
     _strategy = strategy or DistributedStrategy()
     _role_maker = role_maker or PaddleCloudRoleMaker(
         is_collective=is_collective)
+    # PS mode needs explicit intent: a server role, or server endpoints
+    # on a non-collective role maker (compat role makers may carry
+    # endpoints "for config compat" while meaning collective training —
+    # those must still get the mesh)
+    _ps_intent = _role_maker.is_server() or (
+        getattr(_role_maker, "_server_endpoints", None)
+        and not getattr(_role_maker, "_is_collective", is_collective))
+    if _ps_intent:
+        from ..ps import fleet_ps
+        fleet_ps.init_ps(_role_maker)
+        _fleet_initialized = True
+        return None
     env_mod.init_parallel_env()
     degrees = _strategy.hybrid_degrees()
     n_need = 1
@@ -103,8 +118,39 @@ def barrier_worker():
     barrier()
 
 
+# -- parameter-server roles (PS mode; reference fleet.py is_server /
+#    init_server / run_server / init_worker / stop_worker) -----------------
+
+def is_server() -> bool:
+    from ..ps import fleet_ps
+    return fleet_ps.is_server()
+
+
+def is_worker() -> bool:
+    from ..ps import fleet_ps
+    return not fleet_ps.is_server()
+
+
+def init_server(*args, **kwargs):
+    from ..ps import fleet_ps
+    fleet_ps.init_server()
+
+
+def run_server():
+    from ..ps import fleet_ps
+    fleet_ps.run_server()
+
+
+def init_worker(*args, **kwargs):
+    from ..ps import fleet_ps
+    if fleet_ps.ps_mode():
+        fleet_ps.init_worker()
+
+
 def stop_worker():
-    pass
+    from ..ps import fleet_ps
+    if fleet_ps.ps_mode():
+        fleet_ps.stop_worker()
 
 
 from . import utils  # noqa: F401,E402,F811  (the real subpackage)

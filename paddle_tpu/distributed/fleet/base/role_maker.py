@@ -22,6 +22,16 @@ class Role:
 class PaddleCloudRoleMaker:
     def __init__(self, is_collective=True, **kwargs):
         self._is_collective = is_collective
+        # PS-mode envs (reference role_maker.py:548 PaddleCloud
+        # convention): TRAINING_ROLE=PSERVER|TRAINER selects the role,
+        # PADDLE_PSERVERS_IP_PORT_LIST lists the server endpoints
+        self._training_role = os.environ.get("TRAINING_ROLE", "TRAINER")
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._server_endpoints = [e for e in eps.split(",") if e]
+        if self._server_endpoints:
+            self._is_collective = False
+        self._current_id = int(os.environ.get(
+            "PADDLE_PSERVER_ID", os.environ.get("PADDLE_TRAINER_ID", 0)))
 
     def _worker_index(self):
         env = os.environ.get("PADDLE_TRAINER_ID")
@@ -45,17 +55,18 @@ class PaddleCloudRoleMaker:
         return self._worker_index() == 0
 
     def _role(self):
-        return Role.WORKER
+        return Role.SERVER if self._training_role == "PSERVER" \
+            else Role.WORKER
 
     worker_index = _worker_index
     worker_num = _worker_num
     is_first_worker = _is_first_worker
 
     def is_worker(self):
-        return True
+        return self._training_role != "PSERVER"
 
     def is_server(self):
-        return False
+        return self._training_role == "PSERVER"
 
 
 UserDefinedRoleMaker = PaddleCloudRoleMaker

@@ -13,6 +13,11 @@ from .meta_optimizers import (DygraphShardingOptimizer,
 
 def distributed_optimizer(optimizer, strategy=None):
     from . import get_strategy
+    from ..ps import fleet_ps
+    if fleet_ps.ps_mode():
+        # PS training mode: step() pushes sparse embedding grads to the
+        # servers, then steps the local dense optimizer
+        return fleet_ps.PSOptimizer(optimizer)
     strategy = strategy or get_strategy()
     hcg = mesh_mod.get_hybrid_communicate_group()
     if mesh_mod.axis_degree("sharding") > 1 and strategy is not None:

@@ -1,4 +1,6 @@
 from .the_one_ps import (DenseTable, PSClient, PSServer,  # noqa: F401
                          SparseTable)
+from .fleet_ps import PSOptimizer, PSSparseEmbedding  # noqa: F401
 
-__all__ = ["DenseTable", "SparseTable", "PSServer", "PSClient"]
+__all__ = ["DenseTable", "SparseTable", "PSServer", "PSClient",
+           "PSSparseEmbedding", "PSOptimizer"]

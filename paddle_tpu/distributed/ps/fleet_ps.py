@@ -1,0 +1,182 @@
+"""PS training mode wired into fleet (VERDICT r3 missing #4 / weak #7).
+
+Reference flow (python/paddle/distributed/ps/the_one_ps.py + fleet):
+
+    fleet.init(role_maker)          # TRAINING_ROLE selects the role
+    if fleet.is_server():
+        fleet.init_server(); fleet.run_server()      # blocks, serving
+    else:
+        fleet.init_worker()
+        opt = fleet.distributed_optimizer(inner_opt)
+        ... train: forward pulls embedding rows, opt.step() pushes ...
+        fleet.stop_worker()
+
+TPU-native translation: the server hosts the host-side table set of
+``the_one_ps`` behind ``paddle.distributed.rpc``; trainers embed via
+:class:`PSSparseEmbedding`, whose forward pulls rows from the PS into a
+leaf Tensor (dense math then runs on device as usual) and whose
+gradient is pushed back row-wise by the :class:`PSOptimizer` wrapper
+returned from ``fleet.distributed_optimizer`` in PS mode. Sync mode
+only — geo/async staleness is documented out of scope (COMPONENTS.md).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+_state = {
+    "role_maker": None,
+    "client": None,
+    "server": None,
+    "n_servers": 0,
+    "n_workers": 0,
+    "embeddings": [],   # live PSSparseEmbedding layers (weak by design:
+                        # cleared on shutdown)
+}
+
+
+def _endpoint():
+    eps = getattr(_state["role_maker"], "_server_endpoints", None) or []
+    if eps:
+        return eps[0]
+    return os.environ.get("PADDLE_PS_MASTER", "127.0.0.1:8815")
+
+
+def init_ps(role_maker):
+    """Record the PS job layout (called from fleet.init when the role
+    maker carries server roles)."""
+    _state["role_maker"] = role_maker
+    _state["n_servers"] = max(
+        len(getattr(role_maker, "_server_endpoints", []) or []), 1)
+    _state["n_workers"] = int(role_maker.worker_num())
+
+
+def ps_mode() -> bool:
+    return _state["role_maker"] is not None
+
+
+def is_server() -> bool:
+    rm = _state["role_maker"]
+    return bool(rm and rm.is_server())
+
+
+def _rpc_world():
+    n_s, n_w = _state["n_servers"], _state["n_workers"]
+    return n_s + n_w
+
+
+def init_server():
+    """Join the job's rpc world as a server and host the table set."""
+    from .. import rpc
+    from .the_one_ps import PSServer
+    rm = _state["role_maker"]
+    idx = int(getattr(rm, "_current_id", 0))
+    rpc.init_rpc(f"ps{idx}", rank=idx, world_size=_rpc_world(),
+                 master_endpoint=_endpoint())
+    _state["server"] = PSServer()
+
+
+def run_server():
+    """Serve until every worker has called stop_worker (the rpc shutdown
+    barrier is the 'job done' signal, reference run_server blocking)."""
+    from .. import rpc
+    rpc.shutdown()
+    _state["server"] = None
+    _state["role_maker"] = None
+
+
+def init_worker():
+    """Join the rpc world as a trainer and connect a PSClient."""
+    from .. import rpc
+    from .the_one_ps import PSClient
+    rm = _state["role_maker"]
+    idx = int(rm.worker_index())
+    n_s = _state["n_servers"]
+    rpc.init_rpc(f"trainer{idx}", rank=n_s + idx,
+                 world_size=_rpc_world(), master_endpoint=_endpoint())
+    _state["client"] = PSClient([f"ps{i}" for i in range(n_s)])
+
+
+def stop_worker():
+    from .. import rpc
+    rpc.shutdown()
+    _state["client"] = None
+    _state["role_maker"] = None
+    _state["embeddings"] = []
+
+
+def client():
+    if _state["client"] is None:
+        raise RuntimeError("PS worker not initialized: call "
+                           "fleet.init_worker() first")
+    return _state["client"]
+
+
+class PSSparseEmbedding:
+    """An embedding whose table lives in the parameter server.
+
+    Forward pulls the batch's rows into a leaf Tensor (requires-grad)
+    and reshapes — downstream compute and backward run on device as
+    usual; ``push_grads`` (called by PSOptimizer.step) pushes the row
+    gradients back with the server-side SGD rule. Duplicate ids in a
+    batch accumulate server-side, matching dense embedding-grad
+    scatter-add semantics.
+    """
+
+    def __init__(self, num_embeddings, embedding_dim, name, lr=0.01):
+        self.name = name
+        self.dim = int(embedding_dim)
+        self.num = int(num_embeddings)
+        client().create_sparse_table(name, self.dim, lr=lr)
+        # every pulled batch since the last step — a model may call the
+        # same table several times per forward (user ids + item ids),
+        # and eval forwards between backward and step must not clobber
+        # pending gradients
+        self._pulled = []
+        _state["embeddings"].append(self)
+
+    def __call__(self, ids):
+        import paddle_tpu as paddle
+        ids_np = np.asarray(ids.numpy()).astype(np.int64)
+        flat = ids_np.reshape(-1)
+        rows = client().pull_sparse(self.name, flat)
+        t = paddle.to_tensor(rows)
+        t.stop_gradient = False
+        self._pulled.append((flat, t))
+        return t.reshape(list(ids_np.shape) + [self.dim])
+
+    def push_grads(self):
+        pulled, self._pulled = self._pulled, []
+        for flat, t in pulled:
+            if t.grad is not None:  # eval pulls carry no gradient
+                client().push_sparse(self.name, flat,
+                                     np.asarray(t.grad.numpy()))
+
+
+class PSOptimizer:
+    """fleet.distributed_optimizer wrapper for PS mode: step() pushes
+    every PS embedding's pulled-row gradients, then steps the inner
+    optimizer over the local (dense) parameters."""
+
+    def __init__(self, inner):
+        self._inner_opt = inner
+
+    def step(self):
+        for emb in _state["embeddings"]:
+            emb.push_grads()
+        if self._inner_opt is not None:
+            self._inner_opt.step()
+
+    def clear_grad(self):
+        if self._inner_opt is not None:
+            self._inner_opt.clear_grad()
+
+    def get_lr(self):
+        return self._inner_opt.get_lr() if self._inner_opt else 0.0
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()

@@ -91,13 +91,24 @@ class SparseTable:
 
 def _srv_register_dense(name, shape, lr, init):
     with _LOCK:
-        _TABLES[name] = DenseTable(name, shape, lr, init)
+        # idempotent for a matching spec: every worker registers the
+        # same tables at startup and must not reset trained state; a
+        # DIFFERENT spec under the same name is a new job's table
+        cur = _TABLES.get(name)
+        if not (isinstance(cur, DenseTable)
+                and cur.value.shape == tuple(shape)
+                and cur.lr == float(lr)):
+            # (init functions are not comparable; shape+lr is the spec)
+            _TABLES[name] = DenseTable(name, shape, lr, init)
     return True
 
 
 def _srv_register_sparse(name, dim, lr):
     with _LOCK:
-        _TABLES[name] = SparseTable(name, dim, lr)
+        cur = _TABLES.get(name)
+        if not (isinstance(cur, SparseTable) and cur.dim == int(dim)
+                and cur.lr == float(lr)):
+            _TABLES[name] = SparseTable(name, dim, lr)
     return True
 
 
