@@ -1,27 +1,196 @@
-"""ONNX export surface (reference: python/paddle/onnx/__init__.py).
+"""paddle.onnx.export — self-contained ONNX exporter.
 
-The reference delegates to the external paddle2onnx package; here export
-goes through ONNX's own python package when present. Without it, the
-portable interchange format on TPU is StableHLO via paddle.jit.save —
-export() raises with that guidance, mirroring the reference's behavior
-when paddle2onnx is absent.
+Reference: python/paddle/onnx/export.py:35 delegates to the external
+paddle2onnx package (and raises when it is missing). This build ships
+its own minimal exporter instead: a layer walk over Sequential-composed
+models emitting ONNX ModelProto directly in the protobuf wire format
+(`_proto.py`), with no dependency on the onnx package. Covered layers:
+Linear, Conv2D, BatchNorm2D, MaxPool2D/AvgPool2D, Flatten, Dropout
+(dropped at export — inference semantics), ReLU/Tanh/Sigmoid/Softmax/
+LeakyReLU. Anything else raises with guidance to use paddle.jit.save
+(StableHLO) — the portable compiled format on TPU.
+
+A Flatten node is inserted automatically when a rank>2 activation meets
+a Linear, so conv stacks like LeNet's Sequential export directly.
 """
 from __future__ import annotations
+
+import numpy as np
+
+from . import _proto as P
 
 __all__ = ["export"]
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    """Export a Layer to ONNX (reference: paddle.onnx.export, which
-    requires the optional paddle2onnx dependency)."""
-    try:
-        import onnx  # noqa: F401
-    except ImportError:
-        raise ImportError(
-            "paddle.onnx.export needs the 'onnx' package, which is not "
-            "installed in this environment. For a portable compiled "
-            "artifact on TPU use paddle.jit.save (StableHLO), the "
-            "cross-runtime format XLA toolchains consume.") from None
-    raise NotImplementedError(
-        "ONNX graph translation is not implemented for the TPU build; "
-        "use paddle.jit.save (StableHLO) for serialized programs")
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+def _pair(v):
+    if isinstance(v, (tuple, list)):
+        return [int(v[0]), int(v[1])]
+    return [int(v), int(v)]
+
+
+class _Exporter:
+    def __init__(self):
+        self.nodes = []
+        self.inits = []
+        self.n = 0
+
+    def name(self, kind):
+        self.n += 1
+        return f"{kind}_{self.n}"
+
+    def add_init(self, name, arr):
+        self.inits.append(P.tensor_proto(name, arr.astype(np.float32)))
+
+    def emit(self, op, inputs, attrs=b""):
+        out = self.name(op.lower())
+        self.nodes.append(P.node_proto(op, inputs, [out],
+                                       name=self.name(op), attrs=attrs))
+        return out
+
+    # -- per-layer emitters -------------------------------------------------
+    def linear(self, lyr, x, shape):
+        if len(shape) > 2:
+            x = self.emit("Flatten", [x],
+                          P._attr_wrap([P.attr_int("axis", 1)]))
+            shape = [shape[0], int(np.prod(shape[1:]))]
+        w = _np(lyr.weight)  # [in, out] — ONNX Gemm B, transB=0
+        wn = self.name("w")
+        self.add_init(wn, w)
+        ins = [x, wn]
+        if lyr.bias is not None:
+            bn = self.name("b")
+            self.add_init(bn, _np(lyr.bias))
+            ins.append(bn)
+        out = self.emit("Gemm", ins)
+        return out, [shape[0], w.shape[1]]
+
+    def conv2d(self, lyr, x, shape):
+        w = _np(lyr.weight)  # [out, in/g, kh, kw] — ONNX Conv layout
+        pad = lyr._padding
+        if isinstance(pad, str):
+            raise NotImplementedError(
+                f"onnx.export: string padding {pad!r} is not supported; "
+                "use explicit integer padding")
+        ph, pw = _pair(pad)
+        sh, sw = [int(s) for s in lyr._stride]
+        dh, dw = [int(d) for d in lyr._dilation]
+        kh, kw = w.shape[2], w.shape[3]
+        wn = self.name("w")
+        self.add_init(wn, w)
+        ins = [x, wn]
+        if lyr.bias is not None:
+            bn = self.name("b")
+            self.add_init(bn, _np(lyr.bias))
+            ins.append(bn)
+        attrs = P._attr_wrap([
+            P.attr_ints("kernel_shape", [kh, kw]),
+            P.attr_ints("strides", [sh, sw]),
+            P.attr_ints("pads", [ph, pw, ph, pw]),
+            P.attr_ints("dilations", [dh, dw]),
+            P.attr_int("group", int(lyr._groups)),
+        ])
+        out = self.emit("Conv", ins, attrs)
+        oh = (shape[2] + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        ow = (shape[3] + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        return out, [shape[0], w.shape[0], oh, ow]
+
+    def pool2d(self, lyr, x, shape, op):
+        if getattr(lyr, "ceil_mode", False):
+            raise NotImplementedError("onnx.export: ceil_mode pooling")
+        kh, kw = _pair(lyr.kernel_size)
+        sh, sw = _pair(lyr.stride if lyr.stride is not None
+                       else lyr.kernel_size)
+        ph, pw = _pair(lyr.padding)
+        attrs = P._attr_wrap([
+            P.attr_ints("kernel_shape", [kh, kw]),
+            P.attr_ints("strides", [sh, sw]),
+            P.attr_ints("pads", [ph, pw, ph, pw]),
+        ])
+        out = self.emit(op, [x], attrs)
+        oh = (shape[2] + 2 * ph - kh) // sh + 1
+        ow = (shape[3] + 2 * pw - kw) // sw + 1
+        return out, [shape[0], shape[1], oh, ow]
+
+    def batchnorm(self, lyr, x, shape):
+        names = []
+        for suffix, arr in [("scale", _np(lyr.weight)),
+                            ("bias", _np(lyr.bias)),
+                            ("mean", _np(lyr._mean)),
+                            ("var", _np(lyr._variance))]:
+            n = self.name(suffix)
+            self.add_init(n, arr)
+            names.append(n)
+        attrs = P._attr_wrap([P.attr_float("epsilon",
+                                           float(lyr._epsilon))])
+        return self.emit("BatchNormalization", [x] + names, attrs), shape
+
+    def walk(self, layer, x, shape):
+        kind = type(layer).__name__
+        simple = {"ReLU": "Relu", "Tanh": "Tanh", "Sigmoid": "Sigmoid",
+                  "LeakyReLU": "LeakyRelu"}
+        if kind == "Sequential":
+            for _, child in layer.named_children():
+                x, shape = self.walk(child, x, shape)
+            return x, shape
+        if kind == "Linear":
+            return self.linear(layer, x, shape)
+        if kind == "Conv2D":
+            return self.conv2d(layer, x, shape)
+        if kind == "MaxPool2D":
+            return self.pool2d(layer, x, shape, "MaxPool")
+        if kind == "AvgPool2D":
+            return self.pool2d(layer, x, shape, "AveragePool")
+        if kind == "BatchNorm2D":
+            return self.batchnorm(layer, x, shape)
+        if kind == "Flatten":
+            out = self.emit("Flatten", [x], P._attr_wrap(
+                [P.attr_int("axis", int(layer.start_axis))]))
+            ax = int(layer.start_axis)
+            return out, list(shape[:ax]) + [int(np.prod(shape[ax:]))]
+        if kind == "Softmax":
+            axis = int(getattr(layer, "axis", -1))
+            return self.emit("Softmax", [x], P._attr_wrap(
+                [P.attr_int("axis", axis)])), shape
+        if kind.startswith("Dropout"):
+            return x, shape  # inference export: identity
+        if kind in simple:
+            return self.emit(simple[kind], [x]), shape
+        raise NotImplementedError(
+            f"onnx.export: layer {kind} is not supported by the minimal "
+            "exporter; supported: Sequential/Linear/Conv2D/BatchNorm2D/"
+            "MaxPool2D/AvgPool2D/Flatten/Dropout/activations. For "
+            "arbitrary models use paddle.jit.save (StableHLO).")
+
+
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """Export a Sequential-composed Layer to ``path + '.onnx'``
+    (reference: paddle.onnx.export signature and file-naming behavior,
+    python/paddle/onnx/export.py:35).
+
+    input_spec: [InputSpec] or a [shape] list — the first entry fixes
+    the graph input shape. Returns the written filename.
+    """
+    if input_spec is None or not input_spec:
+        raise ValueError(
+            "onnx.export requires input_spec=[InputSpec([...])] to fix "
+            "the graph input shape")
+    spec = input_spec[0]
+    # None / -1 dims stay symbolic (ONNX dim_param): shape arithmetic
+    # below never consumes the batch dim, so it flows through untouched
+    shape = [int(d) if d is not None and int(d) > 0 else None
+             for d in getattr(spec, "shape", spec)]
+    ex = _Exporter()
+    out, out_shape = ex.walk(layer, "input", shape)
+    graph = P.graph_proto(
+        ex.nodes, "paddle_tpu_graph", ex.inits,
+        [P.value_info("input", P.FLOAT, shape)],
+        [P.value_info(out, P.FLOAT, out_shape)])
+    model = P.model_proto(graph, opset=int(opset_version))
+    fname = path if path.endswith(".onnx") else path + ".onnx"
+    with open(fname, "wb") as f:
+        f.write(model)
+    return fname
