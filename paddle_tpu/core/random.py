@@ -16,6 +16,12 @@ import numpy as np
 
 
 class Generator:
+    """Key creation is LAZY: ``jax.random.key`` initializes the XLA
+    backend, and the module-level default generator must not make
+    ``import paddle_tpu`` contact a device (the reference's
+    ``import paddle`` doesn't touch the GPU either — launchers, role
+    makers and pure-host tools all import the package)."""
+
     def __init__(self, seed: int | None = None):
         self._lock = threading.Lock()
         self.manual_seed(seed if seed is not None
@@ -23,9 +29,13 @@ class Generator:
 
     def manual_seed(self, seed: int):
         self._seed = int(seed)
-        self._key = jax.random.key(self._seed)
+        self._key = None  # materialized on first draw
         self._offset = 0
         return self
+
+    def _ensure_key(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
 
     def seed(self, seed=None):
         self.manual_seed(seed if seed is not None
@@ -48,6 +58,7 @@ class Generator:
     def next_key(self):
         """Return a fresh PRNG key, advancing the stream."""
         with self._lock:
+            self._ensure_key()
             self._key, sub = jax.random.split(self._key)
             self._offset += 1
             return sub

@@ -17,10 +17,23 @@ def _write_worker(tmp_path, body):
     return str(script)
 
 
-def _run_launch(tmp_path, script, extra=()):
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_launch(tmp_path, script, extra=(), env_extra=None):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
+    # keep the axon site plugin out of CPU-only subprocesses: its
+    # sitecustomize register() dials the TPU relay at interpreter start
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env.update(env_extra or {})
     cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
            "--log_dir", str(tmp_path / "log"), *extra, script]
     return subprocess.run(cmd, env=env, cwd=str(tmp_path),
@@ -75,6 +88,7 @@ def test_spawn_multi_process(tmp_path):
     script = _write_worker(tmp_path, """
         import os
         os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""
 
         def work(tag):
             import paddle_tpu.distributed as dist
@@ -88,6 +102,7 @@ def test_spawn_multi_process(tmp_path):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
     r = subprocess.run([sys.executable, script], env=env,
                        cwd=str(tmp_path), capture_output=True, text=True,
                        timeout=240)
@@ -95,21 +110,36 @@ def test_spawn_multi_process(tmp_path):
     assert "SPAWN DONE" in r.stdout
 
 
-@pytest.mark.nightly
 def test_elastic_relaunch_resumes_from_checkpoint(tmp_path):
     """Kill a rank mid-run: the launcher relaunches the survivors with
     the new world size and training resumes from the latest checkpoint
     with loss continuity (VERDICT r2 item 7; reference
-    fleet/elastic/manager.py:125,218-253)."""
+    fleet/elastic/manager.py:125,218-253).
+
+    Sync is store-based, not sleep-paced (VERDICT r3 weak #4): each
+    rank publishes a per-step key to a TCPStore and waits for its peer
+    before advancing, so the survivor deterministically parks on the
+    dead rank's next key — the pre-kill generation can never finish
+    early no matter how loaded the host is."""
     script = _write_worker(tmp_path, """
     import json, os, signal
     import numpy as np
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.store import TCPStore
 
     rank = int(os.environ["PADDLE_TRAINER_ID"])
     restart = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
     ckpt = "state.pdparams"
+
+    store = None
+    if restart == 0:
+        # fresh free port chosen by the test per run: a fixed port can
+        # be squatted by an orphan of a previous hard-killed run, which
+        # cascades into bind failures and bogus fresh-start relaunches
+        port = int(os.environ["PADDLE_SYNC_PORT"])
+        store = TCPStore("127.0.0.1", port, is_master=(rank == 0),
+                         world_size=2)
 
     paddle.seed(0)
     net = nn.Linear(8, 8)
@@ -125,7 +155,6 @@ def test_elastic_relaunch_resumes_from_checkpoint(tmp_path):
     x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
     y = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
     loss_fn = nn.MSELoss()
-    import time
     for step in range(start, 8):
         loss = loss_fn(net(x), y)
         loss.backward()
@@ -134,17 +163,19 @@ def test_elastic_relaunch_resumes_from_checkpoint(tmp_path):
         print(f"step {step} loss {float(loss.numpy()):.6f}", flush=True)
         if rank == 0:
             paddle.save({"net": net.state_dict(), "step": step + 1}, ckpt)
-        if restart == 0 and rank == 1 and step == 3:
-            os.kill(os.getpid(), signal.SIGKILL)  # simulate node loss
-        if restart == 0:
-            # pace the loop so the pre-kill generation cannot finish
-            # all 8 steps before the launcher detects the lost rank
-            time.sleep(0.5)
+        if store is not None:
+            store.set(f"s{step}/r{rank}", b"1")
+            if rank == 1 and step == 3:
+                os.kill(os.getpid(), signal.SIGKILL)  # simulate node loss
+            # lockstep: park on the peer's key — after the kill, rank 0
+            # blocks here until the launcher tears the generation down
+            store.wait([f"s{step}/r{1 - rank}"], timeout=120)
     print("DONE", flush=True)
     """)
     r = _run_launch(tmp_path, script,
                     extra=["--nproc_per_node", "2", "--elastic_level", "1",
-                           "--max_restarts", "2"])
+                           "--max_restarts", "2"],
+                    env_extra={"PADDLE_SYNC_PORT": str(_free_port())})
     assert r.returncode == 0, r.stdout + r.stderr
     assert "elastic relaunch 1/2 with nproc 2 -> 1" in r.stdout
     # the relaunched generation resumed from the checkpoint and finished
@@ -163,6 +194,32 @@ def test_elastic_relaunch_resumes_from_checkpoint(tmp_path):
     post = [float(m) for m in _re.findall(r"loss (\d+\.\d+)", log0)]
     assert post and pre and post[0] < pre[0]
     assert post == sorted(post, reverse=True)  # still decreasing
+
+
+def test_watchdog_smoke_flags_wedged_rank(tmp_path):
+    """Default-run watchdog smoke (VERDICT r3 weak #3: the aux paths
+    must be exercised by the default CI set): one rank wedges right
+    after its first heartbeat; the launcher flags it and kills the pod.
+    No model, minimal steps — the thorough variant stays nightly."""
+    script = _write_worker(tmp_path, """
+    import os, time
+    from paddle_tpu.distributed import watchdog
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    for i in range(200):
+        watchdog.maybe_start_and_tick()
+        if rank == 1 and i == 1:
+            time.sleep(3600)   # wedged
+        time.sleep(0.05)
+    print("DONE", flush=True)
+    """)
+    r = _run_launch(tmp_path, script,
+                    extra=["--nproc_per_node", "2",
+                           "--heartbeat_timeout", "4"])
+    assert r.returncode != 0
+    import re as _re
+    m = _re.search(r"wedged rank\(s\) \[([^\]]*)\]", r.stdout)
+    assert m is not None, r.stdout
+    assert "1" in m.group(1), r.stdout
 
 
 @pytest.mark.nightly

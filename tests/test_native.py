@@ -60,6 +60,7 @@ def test_store_blocking_get_across_processes(tmp_path):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""  # axon sitecustomize dials the TPU relay
     pm = subprocess.Popen([sys.executable, str(worker), "master"],
                           env=env, stdout=subprocess.PIPE, text=True)
     pw = subprocess.Popen([sys.executable, str(worker), "worker"],

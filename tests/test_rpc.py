@@ -79,6 +79,7 @@ def test_rpc_cross_process(tmp_path):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""  # axon sitecustomize dials the TPU relay
     p1 = subprocess.Popen([sys.executable, str(worker), "1"], env=env,
                           stdout=subprocess.PIPE, text=True)
     p0 = subprocess.Popen([sys.executable, str(worker), "0"], env=env,

@@ -32,11 +32,20 @@ def test_all_parity(rel, mod):
     assert not missing, missing
 
 
+# compile cost dominates the CI budget (80s densenet, 45s mobilenet_v3
+# cold): the default run keeps two representative archs; the rest are
+# nightly (the whole zoo still compiles there)
+_N = pytest.mark.nightly
+
+
 @pytest.mark.parametrize("factory,size", [
-    ("alexnet", 224), ("squeezenet1_1", 224), ("densenet121", 64),
-    ("mobilenet_v1", 64), ("mobilenet_v3_small", 64),
-    ("shufflenet_v2_x0_25", 64), ("resnext50_32x4d", 64),
-    ("wide_resnet50_2", 64),
+    ("alexnet", 224), ("resnext50_32x4d", 64),
+    pytest.param("squeezenet1_1", 224, marks=_N),
+    pytest.param("densenet121", 64, marks=_N),
+    pytest.param("mobilenet_v1", 64, marks=_N),
+    pytest.param("mobilenet_v3_small", 64, marks=_N),
+    pytest.param("shufflenet_v2_x0_25", 64, marks=_N),
+    pytest.param("wide_resnet50_2", 64, marks=_N),
 ])
 def test_model_zoo_forward(factory, size):
     net = getattr(paddle.vision.models, factory)(num_classes=7)
@@ -46,6 +55,7 @@ def test_model_zoo_forward(factory, size):
     assert net(x).shape == [1, 7]
 
 
+@pytest.mark.nightly
 def test_googlenet_heads():
     g = paddle.vision.models.googlenet(num_classes=5)
     x = paddle.to_tensor(RNG.standard_normal(
