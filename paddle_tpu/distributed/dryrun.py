@@ -183,6 +183,7 @@ def run_dryrun(n_devices: int) -> None:
     _dryrun_pipeline(jax, n_devices)
     _dryrun_vpp(jax, n_devices)
     _dryrun_zb(jax, n_devices)
+    _dryrun_zbvpp(jax, n_devices)
     _dryrun_het(jax, n_devices)
     _dryrun_moe(jax, n_devices)
     _dryrun_context_parallel(jax, n_devices)
@@ -389,6 +390,77 @@ def _dryrun_zb(jax, n_devices: int) -> None:
             o1).numpy()) for _ in range(2)]
 
     _assert_aligned("zb", [l0, l1], _single_device_losses(jax, single_run))
+
+
+def _dryrun_zbvpp(jax, n_devices: int) -> None:
+    """Phase 2c': zero-bubble interleaved (ZBVPP) — the dX/dW-split
+    backward over the VPP chunk placement, align-green vs single-device
+    (reference pipeline_zero_bubble.py ZBVPP)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        LayerDesc, PipelineLayer, PipelineParallel)
+
+    if n_devices % 4 != 0:
+        print("dryrun zbvpp: skipped (needs a multiple of 4 devices)")
+        return
+    pp, dp = 4, n_devices // 4
+    mesh_mod.set_mesh(mesh_mod.build_mesh({"pp": pp, "dp": dp}))
+
+    hidden, batch = 16, 8 * dp
+
+    class Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(hidden, hidden)
+
+        def forward(self, x):
+            return x + paddle.tanh(self.fc(x))
+
+    def build(num_stages, vpp):
+        paddle.seed(0)
+        return PipelineLayer(
+            layers=[LayerDesc(Block) for _ in range(2 * pp * 2)],
+            num_stages=num_stages, loss_fn=nn.MSELoss(),
+            num_virtual_pipeline_stages=vpp)
+
+    strategy = fleet.DistributedStrategy()
+    strategy.pipeline_configs["accumulate_steps"] = pp
+    strategy.pipeline_configs["schedule_mode"] = "ZBVPP"
+
+    rng = np.random.default_rng(5)
+    x_np = rng.standard_normal((batch, hidden)).astype(np.float32)
+    y_np = rng.standard_normal((batch, hidden)).astype(np.float32)
+
+    pl = build(pp, 2)
+    model = PipelineParallel(pl, strategy=strategy)
+    assert model.schedule_mode == "ZBVPP" and model.vpp_degree == 2
+    opt = paddle.optimizer.AdamW(1e-3, parameters=pl.parameters())
+    with jax.set_mesh(mesh_mod.get_mesh()):
+        l0 = float(model.train_batch(
+            (paddle.to_tensor(x_np), paddle.to_tensor(y_np)),
+            opt).numpy())
+        l1 = float(model.train_batch(
+            (paddle.to_tensor(x_np), paddle.to_tensor(y_np)),
+            opt).numpy())
+    assert np.isfinite(l0) and np.isfinite(l1), (l0, l1)
+    print(f"dryrun zbvpp ok: pp={pp} vpp=2 dp={dp} loss0={l0:.4f} "
+          f"loss1={l1:.4f}")
+
+    def single_run():
+        pl1 = build(1, 1)
+        strat1 = fleet.DistributedStrategy()
+        strat1.pipeline_configs["accumulate_steps"] = pp
+        m1 = PipelineParallel(pl1, strategy=strat1)
+        o1 = paddle.optimizer.AdamW(1e-3, parameters=pl1.parameters())
+        return [float(m1.train_batch(
+            (paddle.to_tensor(x_np), paddle.to_tensor(y_np)),
+            o1).numpy()) for _ in range(2)]
+
+    _assert_aligned("zbvpp", [l0, l1],
+                    _single_device_losses(jax, single_run))
 
 
 def _dryrun_het(jax, n_devices: int) -> None:

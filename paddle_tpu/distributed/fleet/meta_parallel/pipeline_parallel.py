@@ -33,7 +33,7 @@ from ....jit.functional import functional_call
 from ... import mesh as mesh_mod
 from ...pipeline import (merge_microbatches, pipeline_apply,
                          pipeline_apply_vpp, pipeline_apply_zb,
-                         split_microbatches)
+                         pipeline_apply_zbvpp, split_microbatches)
 from .meta_parallel_base import MetaParallelBase
 from .pp_layers import PipelineLayer
 
@@ -102,15 +102,22 @@ class PipelineParallel(MetaParallelBase):
         # microbatch's boundary activation after its backward tick).
         # "ZBH1" = zero-bubble: dX/dW split backward (zero_bubble.py).
         self.schedule_mode = str(cfg.get("schedule_mode", "")).upper()
-        if self.schedule_mode not in ("", "FTHENB", "1F1B", "VPP", "ZBH1"):
+        if self.schedule_mode not in ("", "FTHENB", "1F1B", "VPP", "ZBH1",
+                                      "ZBVPP"):
             raise ValueError(
                 f"unknown pipeline schedule_mode "
                 f"{cfg.get('schedule_mode')!r}: expected FThenB, 1F1B, "
-                "VPP or ZBH1")
+                "VPP, ZBH1 or ZBVPP")
         if self.schedule_mode == "ZBH1" and self.vpp_degree > 1:
             raise ValueError(
                 "schedule_mode='ZBH1' is incompatible with vpp_degree>1 "
-                "(ZBVPP is not implemented; use one or the other)")
+                "(use ZBVPP for the interleaved zero-bubble schedule)")
+        if self.schedule_mode == "ZBVPP" and self.vpp_degree <= 1:
+            raise ValueError(
+                "schedule_mode='ZBVPP' needs vpp_degree>1 (set "
+                "num_virtual_pipeline_stages or "
+                "pipeline_configs['vpp_degree']); use ZBH1 for the "
+                "non-interleaved zero-bubble schedule")
         self._compiled = {}
         self._state = None
         # heterogeneous mode (VERDICT r3 missing #3): explicit
@@ -431,7 +438,12 @@ class PipelineParallel(MetaParallelBase):
                     merged = {**{f"t:{k}": v for k, v in stacked.items()},
                               **{f"f:{k}": v
                                  for k, v in stacked_frozen.items()}}
-                    if V > 1:
+                    if V > 1 and self.schedule_mode == "ZBVPP":
+                        ys = pipeline_apply_zbvpp(
+                            block_fn_vpp, merged, xs,
+                            jax.random.fold_in(key, 2), vpp_degree=V,
+                            mesh=mesh, n_micro=M)
+                    elif V > 1:
                         ys = pipeline_apply_vpp(
                             block_fn_vpp, merged, xs,
                             jax.random.fold_in(key, 2), vpp_degree=V,

@@ -268,6 +268,32 @@ def pipeline_apply_zb(block_f: Callable, stacked_params: Any,
     return fn(stacked_params, xs, key)
 
 
+def pipeline_apply_zbvpp(block_f: Callable, stacked_params: Any,
+                         xs: jnp.ndarray, key, vpp_degree: int,
+                         mesh: Optional[Mesh] = None, axis: str = "pp",
+                         n_micro: Optional[int] = None):
+    """Run the zero-bubble interleaved (ZBVPP) schedule.
+
+    block_f(chunk_params, x, key, mb, chunk_idx) -> y, pure, NOT
+    remat-wrapped; stacked_params leaves have leading dims [S, V]
+    (round-robin chunk placement, same layout as pipeline_apply_vpp).
+    Reference: pipeline_zero_bubble.py ZBVPP.
+    """
+    from . import mesh as mesh_mod
+    from .zero_bubble import zbvpp_local
+    mesh = mesh or mesh_mod.ensure_mesh()
+    S = mesh.shape[axis]
+    M = int(n_micro if n_micro is not None else xs.shape[0])
+    local = zbvpp_local(block_f, S, M, vpp_degree, axis=axis)
+    spec_params = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(spec_params, P(), P()),
+        out_specs=P(),
+        axis_names={axis})
+    return fn(stacked_params, xs, key)
+
+
 def split_microbatches(x: jnp.ndarray, n_micro: int) -> jnp.ndarray:
     """[B, ...] -> [n_micro, B // n_micro, ...]."""
     b = x.shape[0]

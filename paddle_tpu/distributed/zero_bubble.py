@@ -349,3 +349,240 @@ def zb_local(block_f: Callable, n_stages: int, n_micro: int,
         return run(stacked_local, xs, key)
 
     return local_fn
+
+
+def zbvpp_schedule_info(n_stages: int, n_micro: int, vpp_degree: int):
+    """Wall/bubble accounting in forward-units (chunk F=1/V, B=2/V,
+    W=1/V). Forward: VM+S-1 lockstep ticks; backward: B sub-phase spans
+    VM+S-1 ticks at 2/V, then the residual W ticks at 1/V. Useful work
+    per stage = 4M units."""
+    S, M, V = n_stages, n_micro, vpp_degree
+    t_total = 2 * V * M + S - 1
+    wall = ((V * M + S - 1)             # fwd ticks @ 1/V
+            + 2 * (V * M + S - 1)       # any-B ticks @ 2/V
+            + (t_total - (V * M + S - 1))) / V  # W-only tail @ 1/V
+    useful = 4 * M
+    return {"wall_units": wall, "useful_units": useful,
+            "bubble_fraction": (wall - useful) / wall}
+
+
+def zbvpp_local(block_f: Callable, n_stages: int, n_micro: int,
+                vpp_degree: int, axis: str = "pp"):
+    """Zero-bubble + interleaved (ZBVPP) schedule body.
+
+    Reference: pipeline_zero_bubble.py ZBVPP registration — the VPP
+    interleave (V round-robin chunks per stage, bubble/V) combined with
+    the dX/dW-split backward. Forward mirrors vpp_local (with per-chunk
+    input stashing); backward reverses every edge of the interleaved
+    flow: the cotangent rides the reverse ring, the stage-(S-1) wrap
+    buffer mirrors forward's stage-0 inter-round buffer with the same
+    M-S+1 tick delay, B ticks run the dx half per (chunk, microbatch),
+    and W ticks drain the weight-grad stash afterwards.
+
+    block_f(chunk_params, x, key, m, chunk_idx) -> y, pure and NOT
+    remat-wrapped. stacked_local leaves are [1, V, ...].
+    """
+    S, M, V = n_stages, n_micro, vpp_degree
+    if M < S:
+        raise ValueError(
+            f"interleaved schedule needs accumulate_steps >= pp degree "
+            f"({M} < {S})")
+
+    def _chunk_params(vparams, v):
+        return jax.tree_util.tree_map(
+            lambda a: lax.dynamic_index_in_dim(a, v, 0, keepdims=False),
+            vparams)
+
+    def _forward(stacked, xs, key):
+        # NOTE: the interleave tick (stage-0 wrap buffer timing, tau/v/m
+        # math) deliberately mirrors pipeline.vpp_local, and run_bwd
+        # mirrors it again in reverse — a timing change in any of the
+        # three (esp. the M-S+1 wrap delay / store window) must be
+        # applied to all; the align tests catch divergence.
+        vparams = jax.tree_util.tree_map(lambda a: a[0], stacked)
+        stage = lax.axis_index(axis)
+        T = V * M + S - 1
+        y0 = _varying(jnp.zeros_like(xs[0]), axis)
+        outs0 = _varying(jnp.zeros_like(xs), axis)
+        buf0 = _varying(jnp.zeros_like(xs), axis)
+        # flat [V*M, ...] stash (slot = v*M + m): one dynamic update per
+        # tick instead of a gather-modify-scatter of a whole [M,...] row
+        inb0 = _varying(
+            jnp.zeros((V * M,) + tuple(xs.shape[1:]), xs.dtype), axis)
+
+        def tick(carry, t):
+            prev_y, buf, outs, inb = carry
+            recv = lax.ppermute(prev_y, axis, _ring_perm(S))
+
+            t_prod = t - jnp.int32(1) - (jnp.int32(S) - 1)
+            m_prod = jnp.clip(jnp.where(t_prod >= 0, t_prod % M, 0),
+                              0, M - 1)
+            store = (stage == 0) & (t_prod >= 0) & (t_prod < V * M)
+            cur_slot = lax.dynamic_index_in_dim(buf, m_prod, 0,
+                                                keepdims=False)
+            buf = lax.dynamic_update_index_in_dim(
+                buf, jnp.where(store, recv, cur_slot), m_prod, 0)
+
+            tau = jnp.clip(t - stage, 0, V * M - 1)
+            v = tau // M
+            m = tau % M
+            x_first = lax.dynamic_index_in_dim(xs, m, 0, keepdims=False)
+            x_loop = lax.dynamic_index_in_dim(buf, m, 0, keepdims=False)
+            x0 = jnp.where(v == 0, x_first, x_loop)
+            x_in = jnp.where(stage == 0, x0, recv)
+            valid = (t - stage >= 0) & (t - stage < V * M)
+
+            # stash this (v, m) input for the backward recompute
+            slot = v * M + m
+            cur_in = lax.dynamic_index_in_dim(inb, slot, 0,
+                                              keepdims=False)
+            inb = lax.dynamic_update_index_in_dim(
+                inb, jnp.where(valid, x_in, cur_in), slot, 0)
+
+            chunk_idx = v * S + stage
+            y = lax.cond(
+                valid,
+                lambda x: block_f(_chunk_params(vparams, v), x, key, m,
+                                  chunk_idx),
+                lambda x: jnp.zeros_like(x), x_in)
+
+            collect = valid & (stage == S - 1) & (v == V - 1)
+            cur = lax.dynamic_index_in_dim(outs, m, 0, keepdims=False)
+            outs = lax.dynamic_update_index_in_dim(
+                outs, jnp.where(collect, y, cur), m, 0)
+            return (y, buf, outs, inb), None
+
+        (_, _, outs, inb), _ = lax.scan(
+            tick, (y0, buf0, outs0, inb0),
+            jnp.arange(T, dtype=jnp.int32))
+        outs = lax.psum(
+            jnp.where(stage == S - 1, outs, jnp.zeros_like(outs)), axis)
+        return outs, inb
+
+    @jax.custom_vjp
+    def run(stacked, xs, key):
+        return _forward(stacked, xs, key)[0]
+
+    def run_fwd(stacked, xs, key):
+        outs, inb = _forward(stacked, xs, key)
+        return outs, (stacked, inb, key)
+
+    def run_bwd(res, d_outs):
+        stacked, inb, key = res
+        vparams = jax.tree_util.tree_map(lambda a: a[0], stacked)
+        stage = lax.axis_index(axis)
+        x_ex = inb[0]
+        nd_ex = (key, jnp.int32(0), jnp.int32(0))
+        bwd_x, bwd_w, stash_shapes = split_backward(
+            lambda p, x, k, m, c: block_f(p, x, k, m, c),
+            _chunk_params(vparams, 0), x_ex, jnp.zeros_like(x_ex),
+            nondiff=nd_ex)
+
+        T = 2 * V * M + S - 1
+        dy0 = _varying(jnp.zeros_like(x_ex), axis)
+        # per-microbatch [M, mb...] buffers (inb itself is flat [V*M,...])
+        mshape = (M,) + tuple(x_ex.shape)
+        dxs0 = _varying(jnp.zeros(mshape, x_ex.dtype), axis)
+        dbuf0 = _varying(jnp.zeros(mshape, x_ex.dtype), axis)
+        dP0 = _varying(jax.tree_util.tree_map(jnp.zeros_like, vparams),
+                       axis)
+        stash0 = _varying(
+            [jnp.zeros((V * M,) + tuple(s.shape), s.dtype)
+             for s in stash_shapes], axis)
+        rev = [(i, (i - 1) % S) for i in range(S)]
+
+        def tick(carry, u):
+            dy_prev, dbuf, dxs, dP, stash_buf = carry
+            recv = lax.ppermute(dy_prev, axis, rev)
+
+            # stage S-1's inter-round wrap buffer (mirror of forward's
+            # stage-0 buf): what stage 0's backward produced arrives
+            # here M-S+1 ticks before it is consumed
+            u_prod = u - jnp.int32(1) - (jnp.int32(S) - 1)
+            m_prod = jnp.clip(jnp.where(u_prod >= 0, u_prod % M, 0),
+                              0, M - 1)
+            store = (stage == S - 1) & (u_prod >= 0) & (u_prod < V * M)
+            cur_slot = lax.dynamic_index_in_dim(dbuf, m_prod, 0,
+                                                keepdims=False)
+            dbuf = lax.dynamic_update_index_in_dim(
+                dbuf, jnp.where(store, recv, cur_slot), m_prod, 0)
+
+            sig = u - (jnp.int32(S) - 1 - stage)
+            valid_b = (sig >= 0) & (sig < V * M)
+            sig_w = sig - V * M
+            valid_w = (sig_w >= 0) & (sig_w < V * M)
+            sig_c = jnp.clip(sig, 0, V * M - 1)
+            rv = sig_c // M
+            m = sig_c % M
+            v = (V - 1) - rv
+            sig_wc = jnp.clip(sig_w, 0, V * M - 1)
+            rv_w = sig_wc // M
+            m_w = sig_wc % M
+            v_w = (V - 1) - rv_w
+
+            dy_first = lax.dynamic_index_in_dim(d_outs, m, 0,
+                                                keepdims=False)
+            dy_loop = lax.dynamic_index_in_dim(dbuf, m, 0,
+                                               keepdims=False)
+            dy0_ = jnp.where(rv == 0, dy_first, dy_loop)
+            dy_in = jnp.where(stage == S - 1, dy0_, recv)
+            op = jnp.where(valid_b, 1, jnp.where(valid_w, 2, 0))
+
+            def do_idle(opnd):
+                dy_in, dxs, dP, stash_buf = opnd
+                return jnp.zeros_like(dy_in), dxs, dP, stash_buf
+
+            def do_b(opnd):
+                dy_in, dxs, dP, stash_buf = opnd
+                slot = v * M + m
+                x_m = lax.dynamic_index_in_dim(inb, slot, 0,
+                                               keepdims=False)
+                chunk_idx = v * S + stage
+                dx, stash = bwd_x(_chunk_params(vparams, v), x_m, dy_in,
+                                  key, m, chunk_idx)
+                stash_buf = [
+                    lax.dynamic_update_index_in_dim(buf, s, slot, 0)
+                    for buf, s in zip(stash_buf, stash)]
+                take = (stage == 0) & (v == 0)
+                cur = lax.dynamic_index_in_dim(dxs, m, 0, keepdims=False)
+                dxs = lax.dynamic_update_index_in_dim(
+                    dxs, jnp.where(take, dx, cur), m, 0)
+                return dx, dxs, dP, stash_buf
+
+            def do_w(opnd):
+                dy_in, dxs, dP, stash_buf = opnd
+                slot_w = v_w * M + m_w
+                stash = [
+                    lax.dynamic_index_in_dim(buf, slot_w, 0,
+                                             keepdims=False)
+                    for buf in stash_buf]
+                chunk_idx = v_w * S + stage
+                dp = bwd_w(_chunk_params(vparams, v_w), stash, key, m_w,
+                           chunk_idx)
+                dP = jax.tree_util.tree_map(
+                    lambda acc, g: lax.dynamic_update_index_in_dim(
+                        acc, lax.dynamic_index_in_dim(
+                            acc, v_w, 0, keepdims=False) + g, v_w, 0),
+                    dP, dp)
+                return jnp.zeros_like(dy_in), dxs, dP, stash_buf
+
+            out = lax.switch(op, [do_idle, do_b, do_w],
+                             (dy_in, dxs, dP, stash_buf))
+            dy_out, dxs, dP, stash_buf = out
+            return (dy_out, dbuf, dxs, dP, stash_buf), None
+
+        (_, _, dxs, dP, _), _ = lax.scan(
+            tick, (dy0, dbuf0, dxs0, dP0, stash0),
+            jnp.arange(T, dtype=jnp.int32))
+        dxs = lax.psum(
+            jnp.where(stage == 0, dxs, jnp.zeros_like(dxs)), axis)
+        d_stacked = jax.tree_util.tree_map(lambda a: a[None], dP)
+        d_key = np.zeros(key.shape, jax.dtypes.float0)
+        return d_stacked, dxs, d_key
+
+    run.defvjp(run_fwd, run_bwd)
+
+    def local_fn(stacked_local, xs, key):
+        return run(stacked_local, xs, key)
+
+    return local_fn
