@@ -16,8 +16,13 @@ def distributed_optimizer(optimizer, strategy=None):
     from ..ps import fleet_ps
     if fleet_ps.ps_mode():
         # PS training mode: step() pushes sparse embedding grads to the
-        # servers, then steps the local dense optimizer
-        return fleet_ps.PSOptimizer(optimizer)
+        # servers, then steps the local dense optimizer; a_sync k_steps
+        # selects the geo-async delta-merge mode
+        strat = strategy or get_strategy()
+        k = 0
+        if strat is not None and getattr(strat, "a_sync", False):
+            k = int((strat.a_sync_configs or {}).get("k_steps", 0))
+        return fleet_ps.PSOptimizer(optimizer, k_steps=k)
     strategy = strategy or get_strategy()
     hcg = mesh_mod.get_hybrid_communicate_group()
     if mesh_mod.axis_degree("sharding") > 1 and strategy is not None:

@@ -16,8 +16,11 @@ TPU-native translation: the server hosts the host-side table set of
 :class:`PSSparseEmbedding`, whose forward pulls rows from the PS into a
 leaf Tensor (dense math then runs on device as usual) and whose
 gradient is pushed back row-wise by the :class:`PSOptimizer` wrapper
-returned from ``fleet.distributed_optimizer`` in PS mode. Sync mode
-only — geo/async staleness is documented out of scope (COMPONENTS.md).
+returned from ``fleet.distributed_optimizer`` in PS mode. Setting
+``strategy.a_sync`` with ``a_sync_configs={'k_steps': K}`` selects the
+geo-async mode (reference the_one_ps.py:203 geo accessor): embeddings
+train in a worker-local cache and merge accumulated row deltas with the
+server every K steps.
 """
 from __future__ import annotations
 
@@ -114,6 +117,20 @@ def client():
     return _state["client"]
 
 
+def init_loopback(master_endpoint: str):
+    """Single-process PS job: this process is both the only server and
+    the only trainer (tables live in-process, calls still go through
+    the rpc layer). For tests, notebooks and local debugging."""
+    from .. import rpc
+    from .the_one_ps import PSClient, PSServer
+    rpc.init_rpc("ps0", rank=0, world_size=1,
+                 master_endpoint=master_endpoint)
+    _state["server"] = PSServer()
+    _state["client"] = PSClient(["ps0"])
+    _state["n_servers"] = 1
+    _state["n_workers"] = 1
+
+
 class PSSparseEmbedding:
     """An embedding whose table lives in the parameter server.
 
@@ -129,43 +146,105 @@ class PSSparseEmbedding:
         self.name = name
         self.dim = int(embedding_dim)
         self.num = int(num_embeddings)
+        self.lr = float(lr)
         client().create_sparse_table(name, self.dim, lr=lr)
         # every pulled batch since the last step — a model may call the
         # same table several times per forward (user ids + item ids),
         # and eval forwards between backward and step must not clobber
         # pending gradients
         self._pulled = []
+        # geo-async state (enabled by PSOptimizer when the strategy sets
+        # a_sync k_steps > 0): rows train in a local cache, deltas merge
+        # to the server every k steps (reference the_one_ps.py:203 geo)
+        self._geo = False
+        self._local = {}
+        self._base = {}
         _state["embeddings"].append(self)
 
     def __call__(self, ids):
         import paddle_tpu as paddle
         ids_np = np.asarray(ids.numpy()).astype(np.int64)
         flat = ids_np.reshape(-1)
-        rows = client().pull_sparse(self.name, flat)
+        if self._geo:
+            missing = [int(i) for i in np.unique(flat)
+                       if int(i) not in self._local]
+            if missing:
+                pulled = client().pull_sparse(self.name, missing)
+                for i, row in zip(missing, pulled):
+                    self._local[i] = np.array(row, np.float32)
+                    self._base[i] = np.array(row, np.float32)
+            rows = np.stack([self._local[int(i)] for i in flat]) \
+                if len(flat) else np.zeros((0, self.dim), np.float32)
+        else:
+            rows = client().pull_sparse(self.name, flat)
         t = paddle.to_tensor(rows)
         t.stop_gradient = False
         self._pulled.append((flat, t))
         return t.reshape(list(ids_np.shape) + [self.dim])
 
     def push_grads(self):
+        """Sync mode: push row grads to the server (server applies lr).
+        Geo mode: apply them to the local cache instead."""
         pulled, self._pulled = self._pulled, []
         for flat, t in pulled:
-            if t.grad is not None:  # eval pulls carry no gradient
-                client().push_sparse(self.name, flat,
-                                     np.asarray(t.grad.numpy()))
+            if t.grad is None:  # eval pulls carry no gradient
+                continue
+            g = np.asarray(t.grad.numpy())
+            if self._geo:
+                tv = np.asarray(t.numpy())
+                for idx, (i, gr) in enumerate(zip(flat, g)):
+                    ii = int(i)
+                    if ii not in self._local:
+                        # pulled before geo mode flipped on: the pulled
+                        # row IS the server value — adopt it as base
+                        self._local[ii] = np.array(tv[idx], np.float32)
+                        self._base[ii] = np.array(tv[idx], np.float32)
+                    self._local[ii] -= self.lr * gr
+            else:
+                client().push_sparse(self.name, flat, g)
+
+    def sync_geo(self):
+        """Merge local training into the server: push accumulated
+        deltas (server rows += delta), then adopt the merged rows as
+        the new base — other workers' deltas fold in here."""
+        if not self._geo or not self._local:
+            return
+        ids = sorted(self._local)
+        deltas = np.stack([self._local[i] - self._base[i] for i in ids])
+        client().add_sparse(self.name, ids, deltas)
+        merged = client().pull_sparse(self.name, ids)
+        for i, row in zip(ids, merged):
+            self._local[i] = np.array(row, np.float32)
+            self._base[i] = np.array(row, np.float32)
 
 
 class PSOptimizer:
     """fleet.distributed_optimizer wrapper for PS mode: step() pushes
     every PS embedding's pulled-row gradients, then steps the inner
-    optimizer over the local (dense) parameters."""
+    optimizer over the local (dense) parameters.
 
-    def __init__(self, inner):
+    k_steps > 0 selects geo-async mode (strategy.a_sync +
+    a_sync_configs['k_steps']): embeddings train in their local caches
+    and merge deltas with the server every k steps.
+    """
+
+    def __init__(self, inner, k_steps: int = 0):
         self._inner_opt = inner
+        self._k_steps = int(k_steps)
+        self._step_n = 0
+        if self._k_steps > 0:
+            for emb in _state["embeddings"]:
+                emb._geo = True
 
     def step(self):
         for emb in _state["embeddings"]:
+            if self._k_steps > 0:
+                emb._geo = True  # embeddings built after the optimizer
             emb.push_grads()
+        self._step_n += 1
+        if self._k_steps > 0 and self._step_n % self._k_steps == 0:
+            for emb in _state["embeddings"]:
+                emb.sync_geo()
         if self._inner_opt is not None:
             self._inner_opt.step()
 

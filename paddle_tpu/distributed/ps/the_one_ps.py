@@ -15,8 +15,8 @@ form for embedding-table workloads:
   serves pull/push through ``paddle.distributed.rpc`` (the stdlib-
   transport RPC layer; the reference uses brpc services).
 - ``PSClient``: pull_dense/push_dense/pull_sparse/push_sparse against
-  the server set, synchronous (the reference's sync mode; geo/async
-  staleness modes are out of scope).
+  the server set (sync mode), plus ``add_sparse`` raw delta merges —
+  the primitive fleet_ps's geo-async mode builds on.
 
 Trainers embed pulled rows on-host (or feed them to the jitted step)
 and push gradients back after the step.
@@ -86,6 +86,13 @@ class SparseTable:
         for i, g in zip(ids, grads):
             self._row(int(i))[...] -= self.lr * g
 
+    def add(self, ids: Sequence[int], deltas: np.ndarray) -> None:
+        """Raw row addition — the geo-async merge (reference geo
+        accessor: workers push accumulated deltas, the server sums)."""
+        deltas = np.asarray(deltas, np.float32)
+        for i, d in zip(ids, deltas):
+            self._row(int(i))[...] += d
+
 
 # ---- RPC handlers (execute in the server process) -------------------------
 
@@ -131,6 +138,12 @@ def _srv_pull_sparse(name, ids):
 def _srv_push_sparse(name, ids, grads):
     with _LOCK:
         _TABLES[name].push(ids, grads)
+    return True
+
+
+def _srv_add_sparse(name, ids, deltas):
+    with _LOCK:
+        _TABLES[name].add(ids, deltas)
     return True
 
 
@@ -202,16 +215,23 @@ class PSClient:
         return np.stack(rows)
 
     def push_sparse(self, name, ids, grads) -> None:
+        self._scatter(name, ids, grads, _srv_push_sparse)
+
+    def add_sparse(self, name, ids, deltas) -> None:
+        """Geo-async merge: server rows += delta (no lr applied)."""
+        self._scatter(name, ids, deltas, _srv_add_sparse)
+
+    def _scatter(self, name, ids, values, handler) -> None:
         from .. import rpc
         ids, owner = self._shard(ids)
-        grads = np.asarray(grads, np.float32)
+        values = np.asarray(values, np.float32)
         futures = []
         for s_idx, s in enumerate(self.servers):
             mask = owner == s_idx
             if not mask.any():
                 continue
             futures.append(rpc.rpc_async(
-                s, _srv_push_sparse,
-                args=(name, ids[mask].tolist(), grads[mask])))
+                s, handler,
+                args=(name, ids[mask].tolist(), values[mask])))
         for f in futures:
             f.wait()

@@ -89,18 +89,9 @@ def test_fleet_ps_mode_ctr_smoke():
         server_endpoints=[f"127.0.0.1:{port}"])
     fleet.init(rm)
     assert not fleet.is_server()
-    # loopback: host the server tables in-process (rank 0 of a 2-member
-    # rpc world would need a second process; world collapses to the
-    # trainer + in-process tables via a server-name alias)
-    dist.rpc.init_rpc("ps0", rank=0, world_size=1,
-                      master_endpoint=f"127.0.0.1:{port}")
+    from paddle_tpu.distributed.ps import fleet_ps
+    fleet_ps.init_loopback(f"127.0.0.1:{port}")
     try:
-        PSServer()
-        from paddle_tpu.distributed.ps import fleet_ps
-        fleet_ps._state["client"] = __import__(
-            "paddle_tpu.distributed.ps.the_one_ps", fromlist=["PSClient"]
-        ).PSClient(["ps0"])
-
         paddle.seed(0)
         vocab, dim = 50, 4
         emb = PSSparseEmbedding(vocab, dim, "ctr_emb", lr=0.1)
@@ -211,3 +202,62 @@ def test_fleet_ps_mode_two_process(tmp_path):
     assert ps.returncode == 0, out_s
     assert "TRAINER OK" in out_t
     assert "SERVER DONE" in out_s
+
+
+def test_fleet_ps_geo_async_mode():
+    """Geo-async PS (reference the_one_ps.py:203 geo accessor /
+    strategy.a_sync k_steps): embeddings train in a local cache and
+    merge deltas with the server every k steps — the server only moves
+    at sync boundaries, and training still converges."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.ps import PSSparseEmbedding, fleet_ps
+
+    port = _free_port()
+    rm = fleet.UserDefinedRoleMaker(
+        current_id=0, role=fleet.Role.WORKER, worker_num=1,
+        server_endpoints=[f"127.0.0.1:{port}"])
+    strategy = fleet.DistributedStrategy()
+    strategy.a_sync = True
+    strategy.a_sync_configs = {"k_steps": 4}
+    fleet.init(rm, strategy=strategy)
+    fleet_ps.init_loopback(f"127.0.0.1:{port}")
+    try:
+        paddle.seed(0)
+        vocab, dim = 20, 3
+        emb = PSSparseEmbedding(vocab, dim, "geo_emb", lr=0.2)
+        inner = paddle.optimizer.SGD(0.1, parameters=[])
+        opt = fleet.distributed_optimizer(inner, strategy)
+        assert opt._k_steps == 4 and emb._geo
+
+        rng = np.random.default_rng(0)
+        ids_np = rng.integers(0, vocab, (8, 2))
+        target = rng.standard_normal((8, 1)).astype(np.float32)
+        loss_fn = nn.MSELoss()
+        w = paddle.to_tensor(np.full((dim, 1), 0.5, np.float32))
+        losses, server_snapshots = [], []
+        uniq = sorted(np.unique(ids_np).tolist())
+        for i in range(12):
+            feat = emb(paddle.to_tensor(ids_np))      # local cache rows
+            pred = feat.sum(axis=1).matmul(w)
+            loss = loss_fn(pred, paddle.to_tensor(target))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+            server_snapshots.append(
+                fleet_ps.client().pull_sparse("geo_emb", uniq).copy())
+        assert losses[-1] < losses[0] * 0.7, losses
+        # server rows stand still between syncs and move at k boundaries
+        # (steps are 1-indexed: syncs fire after steps 4, 8, 12)
+        assert np.allclose(server_snapshots[0], server_snapshots[2])
+        assert not np.allclose(server_snapshots[2], server_snapshots[3])
+        assert np.allclose(server_snapshots[4], server_snapshots[6])
+        assert not np.allclose(server_snapshots[6], server_snapshots[7])
+        # after the final sync the server equals the local cache
+        merged = fleet_ps.client().pull_sparse("geo_emb", uniq)
+        local = np.stack([emb._local[i] for i in uniq])
+        np.testing.assert_allclose(merged, local, rtol=1e-6)
+    finally:
+        fleet.stop_worker()
