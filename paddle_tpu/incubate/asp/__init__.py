@@ -17,6 +17,7 @@ __all__ = ["calculate_density", "decorate", "prune_model",
 _excluded = set()
 _supported_types = None
 _masks = {}
+_custom_prune = {}  # layer_type -> pruning_func(weight, n, m) -> mask
 
 
 def _supported():
@@ -28,7 +29,13 @@ def _supported():
 
 
 def add_supported_layer(layer_type, pruning_func=None):
+    """Register a layer type for pruning; a custom ``pruning_func``
+    (reference: asp.add_supported_layer's per-type mask function)
+    receives ``(weight_ndarray, n, m)`` and returns a 0/1 mask of the
+    same shape, replacing the built-in n:m magnitude rule."""
     _supported().append(layer_type)
+    if pruning_func is not None:
+        _custom_prune[layer_type] = pruning_func
 
 
 def set_excluded_layers(param_names, main_program=None):
@@ -46,18 +53,18 @@ def calculate_density(x) -> float:
     return float((a != 0).sum()) / max(a.size, 1)
 
 
-def _mask_2to4(w: np.ndarray) -> np.ndarray:
-    """2:4 magnitude mask along the last axis (reference
-    create_mask(mask_algo='mask_1d', n=2, m=4))."""
+def _mask_2to4(w: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """n:m magnitude mask along the last axis (reference
+    create_mask(mask_algo='mask_1d'))."""
     flat = w.reshape(-1, w.shape[-1])
     cols = flat.shape[1]
-    pad = (-cols) % 4
+    pad = (-cols) % m
     if pad:
         flat = np.pad(flat, [(0, 0), (0, pad)])
-    groups = flat.reshape(flat.shape[0], -1, 4)
+    groups = flat.reshape(flat.shape[0], -1, m)
     order = np.argsort(-np.abs(groups), axis=-1)
     mask = np.zeros_like(groups)
-    np.put_along_axis(mask, order[..., :2], 1.0, axis=-1)
+    np.put_along_axis(mask, order[..., :n], 1.0, axis=-1)
     mask = mask.reshape(flat.shape)[:, :cols]
     return mask.reshape(w.shape)
 
@@ -73,7 +80,17 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
         w = getattr(sub, "weight", None)
         if w is None or w.name in _excluded or len(w.shape) < 2:
             continue
-        mask = _mask_2to4(np.asarray(w.numpy()))
+        custom = next((f for t, f in _custom_prune.items()
+                       if isinstance(sub, t)), None)
+        if custom is not None:
+            mask = np.asarray(custom(np.asarray(w.numpy()), n, m),
+                              np.float32)
+            if mask.shape != tuple(w.shape):
+                raise ValueError(
+                    f"pruning_func returned mask shape {mask.shape} "
+                    f"for weight shape {tuple(w.shape)}")
+        else:
+            mask = _mask_2to4(np.asarray(w.numpy()), n, m)
         w._data = w._data * jnp.asarray(mask, w._data.dtype)
         key = f"{name}.weight" if name else "weight"
         out[key] = mask

@@ -12,10 +12,53 @@ from ....nn.functional.activation import swiglu  # noqa: F401
 from ....nn.functional.norm import rms_norm
 
 
-def fused_moe(x, gate_weight, *args, **kwargs):
-    raise NotImplementedError(
-        "use paddle_tpu.incubate.distributed.models.moe.MoELayer — the "
-        "grouped-GEMM dispatch is the fused path on TPU")
+def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
+              ffn1_scale=None, ffn2_bias=None, ffn2_scale=None,
+              quant_method="None", moe_topk=2, norm_topk_prob=True):
+    """Fused mixture-of-experts FFN (reference
+    incubate/nn/functional/fused_moe.py — a CUDA grouped-GEMM kernel).
+
+    TPU-native: every expert runs on every token as ONE batched einsum
+    over the expert dim (maps to a single large MXU contraction — no
+    gather/scatter, no capacity truncation) and the top-k gate combines
+    the expert outputs. For expert-parallel sharded dispatch use
+    MoELayer; this is the single-chip fused path.
+
+    Shapes follow the reference: x [b, s, d]; gate_weight = per-token
+    gate logits [b, s, E]; ffn1_weight [E, d, 2*dff] (gated/SwiGLU
+    halves); ffn2_weight [E, dff, d]; biases [E, 1, 2*dff] / [E, 1, d].
+    """
+    if quant_method not in (None, "None", "none"):
+        raise NotImplementedError(
+            "fused_moe quant_method is not supported on TPU")
+
+    def fn(xx, gl, w1, w2, *rest):
+        b1 = rest[0] if ffn1_bias is not None else None
+        b2 = rest[-1] if ffn2_bias is not None else None
+        probs = jax.nn.softmax(gl.astype(jnp.float32), axis=-1)
+        topv, topi = jax.lax.top_k(probs, moe_topk)      # [b, s, k]
+        if norm_topk_prob:
+            topv = topv / jnp.maximum(
+                topv.sum(-1, keepdims=True), 1e-9)
+        h = jnp.einsum("bsd,edf->besf", xx, w1)
+        if b1 is not None:
+            h = h + b1.reshape(1, w1.shape[0], 1, -1)
+        a, g = jnp.split(h, 2, axis=-1)
+        h = jax.nn.silu(a) * g
+        y = jnp.einsum("besf,efd->besd", h, w2)
+        if b2 is not None:
+            y = y + b2.reshape(1, w2.shape[0], 1, -1)
+        comb = jnp.sum(
+            jax.nn.one_hot(topi, gl.shape[-1], dtype=topv.dtype)
+            * topv[..., None], axis=-2)                   # [b, s, E]
+        return jnp.einsum("bse,besd->bsd", comb.astype(y.dtype), y)
+
+    args = [x, gate_weight, ffn1_weight, ffn2_weight]
+    if ffn1_bias is not None:
+        args.append(ffn1_bias)
+    if ffn2_bias is not None:
+        args.append(ffn2_bias)
+    return run_op("fused_moe", fn, args)
 
 
 def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,

@@ -233,3 +233,83 @@ def test_check_layer_numerics_decorator():
         L()(x=bad)
     good = paddle.to_tensor(np.array([1.0], np.float32))
     assert L()(x=good) is good
+
+
+def test_fused_moe_matches_per_token_reference():
+    """fused_moe (dense batched-einsum MoE, reference
+    incubate/nn/functional/fused_moe.py): output equals a per-token
+    numpy loop over the top-k experts with SwiGLU FFNs."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn.functional import fused_moe
+
+    rng = np.random.default_rng(0)
+    b, s, d, dff, E, k = 2, 3, 8, 6, 4, 2
+    x = rng.standard_normal((b, s, d)).astype(np.float32)
+    gl = rng.standard_normal((b, s, E)).astype(np.float32)
+    w1 = (rng.standard_normal((E, d, 2 * dff)) * 0.3).astype(np.float32)
+    b1 = (rng.standard_normal((E, 1, 2 * dff)) * 0.1).astype(np.float32)
+    w2 = (rng.standard_normal((E, dff, d)) * 0.3).astype(np.float32)
+    b2 = (rng.standard_normal((E, 1, d)) * 0.1).astype(np.float32)
+
+    out = fused_moe(paddle.to_tensor(x), paddle.to_tensor(gl),
+                    paddle.to_tensor(w1), paddle.to_tensor(w2),
+                    ffn1_bias=paddle.to_tensor(b1),
+                    ffn2_bias=paddle.to_tensor(b2), moe_topk=k)
+
+    def silu(v):
+        return v / (1.0 + np.exp(-v))
+
+    want = np.zeros((b, s, d), np.float32)
+    for bi in range(b):
+        for si in range(s):
+            p = np.exp(gl[bi, si] - gl[bi, si].max())
+            p = p / p.sum()
+            top = np.argsort(-p)[:k]
+            tv = p[top]
+            tv = tv / tv.sum()
+            for e, wgt in zip(top, tv):
+                h = x[bi, si] @ w1[e] + b1[e, 0]
+                a, g = h[:dff], h[dff:]
+                y = (silu(a) * g) @ w2[e] + b2[e, 0]
+                want[bi, si] += wgt * y
+    np.testing.assert_allclose(np.asarray(out.numpy()), want,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_asp_custom_pruning_func():
+    """add_supported_layer(pruning_func=...) drives prune_model's mask
+    for that layer type (reference asp per-type mask registration)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.incubate import asp
+
+    class MyDense(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.weight = self.create_parameter([4, 8])
+
+        def forward(self, x):
+            return x @ self.weight
+
+    calls = {}
+
+    def halves(w, n, m):
+        calls["shape"] = w.shape
+        mask = np.zeros_like(w)
+        mask[:, : w.shape[1] // 2] = 1.0  # keep the left half
+        return mask
+
+    asp.add_supported_layer(MyDense, pruning_func=halves)
+    try:
+        paddle.seed(0)
+        net = MyDense()
+        masks = asp.prune_model(net)
+        assert calls["shape"] == (4, 8)
+        w = np.asarray(net.weight.numpy())
+        assert np.all(w[:, 4:] == 0) and np.any(w[:, :4] != 0)
+        assert list(masks.values())[0].shape == (4, 8)
+    finally:
+        asp._custom_prune.pop(MyDense, None)
+        asp._supported_types.remove(MyDense)
