@@ -81,11 +81,13 @@ def init_server():
     _state["server"] = PSServer()
 
 
-def run_server():
+def run_server(timeout: float = 7 * 24 * 3600):
     """Serve until every worker has called stop_worker (the rpc shutdown
-    barrier is the 'job done' signal, reference run_server blocking)."""
+    barrier is the 'job done' signal, reference run_server blocking).
+    The barrier wait must outlive the whole training job — default one
+    week, not the rpc layer's 60 s peer-teardown default."""
     from .. import rpc
-    rpc.shutdown()
+    rpc.shutdown(barrier_timeout=timeout)
     _state["server"] = None
     _state["role_maker"] = None
 

@@ -269,9 +269,12 @@ def rpc_async(to: str, fn, args=None, kwargs=None, timeout=-1):
     return fut
 
 
-def shutdown():
+def shutdown(barrier_timeout: float = 60):
     """Barrier with every worker, then stop the local service
-    (reference rpc.py:305)."""
+    (reference rpc.py:305). ``barrier_timeout`` bounds the wait for
+    peers; pass a large value for roles that must outlive a whole
+    training job (a parameter server's run_server blocks here until
+    every trainer has called shutdown)."""
     if _state["self"] is None:
         return
     store = _state["store"]
@@ -279,7 +282,7 @@ def shutdown():
         # generation-scoped barrier: a reused store must not satisfy a
         # later shutdown from this generation's counters
         store.barrier(f"__rpc/{_state.get('gen', 0)}/shutdown",
-                      timeout=60)
+                      timeout=barrier_timeout)
     except Exception:  # noqa: BLE001 — peers may already be gone
         pass
     _state["stop"].set()
