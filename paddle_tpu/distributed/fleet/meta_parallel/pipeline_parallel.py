@@ -546,7 +546,31 @@ class PipelineParallel(MetaParallelBase):
             loss, g = jax.value_and_grad(loss_of)(vec)
             g = g * mask  # frozen + padding lanes get no update
             if optimizer._grad_clip is not None:
-                g = _clip_pytree({"v": g}, optimizer._grad_clip)["v"]
+                from ....nn.clip import ClipGradByNorm
+                if isinstance(optimizer._grad_clip, ClipGradByNorm):
+                    # per-PARAMETER norms, matching the non-het path —
+                    # clipping the fused vector as one leaf would be
+                    # whole-model clipping (code-review r4 finding).
+                    # Static per-segment slices; the grads dict keys are
+                    # unique (stage, name) pairs
+                    segs_g = {}
+                    for s, segs_stage in enumerate(meta.stages):
+                        for _, _, segs in segs_stage:
+                            if segs is None:
+                                continue
+                            for nm, off, size, shape, _ in segs:
+                                segs_g[(s, nm, off)] = \
+                                    jax.lax.slice(g[s], (off,),
+                                                  (off + size,))
+                    clipped = _clip_pytree(segs_g, optimizer._grad_clip)
+                    for (s, nm, off), cg in clipped.items():
+                        g = g.at[s, off:off + cg.shape[0]].set(cg)
+                else:
+                    # ByValue is elementwise; ByGlobalNorm's norm over
+                    # the fused vector equals the per-param global norm
+                    # (padding/frozen lanes are zero) — both correct on
+                    # the vector directly
+                    g = _clip_pytree({"v": g}, optimizer._grad_clip)["v"]
             new_flat, new_state = optimizer.apply_gradients_pytree(
                 {"het": vec}, {"het": g}, opt_state, lr)
             # decoupled weight decay must not move frozen/padding lanes

@@ -119,14 +119,39 @@ def client():
     return _state["client"]
 
 
+class _LoopbackRoleMaker:
+    """Worker-role stand-in so ps_mode()/distributed_optimizer/
+    stop_worker all see a live PS job after init_loopback alone."""
+
+    _current_id = 0
+    _server_endpoints: list = []
+    _is_collective = False
+
+    def is_server(self):
+        return False
+
+    def is_worker(self):
+        return True
+
+    def worker_index(self):
+        return 0
+
+    def worker_num(self):
+        return 1
+
+
 def init_loopback(master_endpoint: str):
     """Single-process PS job: this process is both the only server and
     the only trainer (tables live in-process, calls still go through
-    the rpc layer). For tests, notebooks and local debugging."""
+    the rpc layer). Self-contained — fleet.distributed_optimizer and
+    fleet.stop_worker work after this call alone. For tests, notebooks
+    and local debugging."""
     from .. import rpc
     from .the_one_ps import PSClient, PSServer
     rpc.init_rpc("ps0", rank=0, world_size=1,
                  master_endpoint=master_endpoint)
+    if _state["role_maker"] is None:
+        _state["role_maker"] = _LoopbackRoleMaker()
     _state["server"] = PSServer()
     _state["client"] = PSClient(["ps0"])
     _state["n_servers"] = 1

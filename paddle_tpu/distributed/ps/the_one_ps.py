@@ -96,26 +96,41 @@ class SparseTable:
 
 # ---- RPC handlers (execute in the server process) -------------------------
 
+def _rpc_generation() -> int:
+    """The server's current rpc generation — a new init_rpc in this
+    process means a NEW JOB; its registrations must get fresh tables."""
+    from ..rpc import rpc as rpc_mod
+    return int(rpc_mod._state.get("gen", 0) or 0)
+
+
 def _srv_register_dense(name, shape, lr, init):
     with _LOCK:
-        # idempotent for a matching spec: every worker registers the
-        # same tables at startup and must not reset trained state; a
-        # DIFFERENT spec under the same name is a new job's table
+        # idempotent WITHIN one rpc generation for a matching spec:
+        # every worker of the job registers the same tables at startup
+        # and must not reset trained state. A register from a newer
+        # generation (a new job on a reused server process) or with a
+        # different spec always gets a fresh table — including a fresh
+        # init (code-review r4: stale rows must not leak across jobs)
+        gen = _rpc_generation()
         cur = _TABLES.get(name)
         if not (isinstance(cur, DenseTable)
+                and getattr(cur, "_gen", None) == gen
                 and cur.value.shape == tuple(shape)
                 and cur.lr == float(lr)):
-            # (init functions are not comparable; shape+lr is the spec)
             _TABLES[name] = DenseTable(name, shape, lr, init)
+            _TABLES[name]._gen = gen
     return True
 
 
 def _srv_register_sparse(name, dim, lr):
     with _LOCK:
+        gen = _rpc_generation()
         cur = _TABLES.get(name)
-        if not (isinstance(cur, SparseTable) and cur.dim == int(dim)
-                and cur.lr == float(lr)):
+        if not (isinstance(cur, SparseTable)
+                and getattr(cur, "_gen", None) == gen
+                and cur.dim == int(dim) and cur.lr == float(lr)):
             _TABLES[name] = SparseTable(name, dim, lr)
+            _TABLES[name]._gen = gen
     return True
 
 

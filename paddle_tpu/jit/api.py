@@ -154,9 +154,18 @@ class StaticFunction:
         layer = self._layer
         # the compiled sublayer path returns fresh (tape-less) Tensors,
         # same as the whole-layer compiled path; when the caller is
-        # recording gradients the only correct fallback is full eager
-        if tape_mod.is_grad_enabled() and any(
-                not p.stop_gradient for p in layer.parameters()):
+        # recording gradients — through the params OR through a
+        # grad-requiring input (frozen-model adversarial/inversion
+        # loops) — the only correct fallback is full eager
+        def _wants_grad(obj):
+            leaves = jax.tree_util.tree_leaves(
+                obj, is_leaf=lambda t: isinstance(t, Tensor))
+            return any(isinstance(t, Tensor) and not t.stop_gradient
+                       for t in leaves)
+
+        if tape_mod.is_grad_enabled() and (
+                any(not p.stop_gradient for p in layer.parameters())
+                or _wants_grad((args, kwargs))):
             return layer(*args, **kwargs)
         if self._child_sf is None:
             self._child_sf = {}

@@ -43,7 +43,10 @@ class _Exporter:
         return f"{kind}_{self.n}"
 
     def add_init(self, name, arr):
-        self.inits.append(P.tensor_proto(name, arr.astype(np.float32)))
+        arr = np.asarray(arr)
+        if arr.dtype not in (np.dtype(np.int64), np.dtype(np.int32)):
+            arr = arr.astype(np.float32)
+        self.inits.append(P.tensor_proto(name, arr))
 
     def emit(self, op, inputs, attrs=b""):
         out = self.name(op.lower())
@@ -147,10 +150,26 @@ class _Exporter:
         if kind == "BatchNorm2D":
             return self.batchnorm(layer, x, shape)
         if kind == "Flatten":
-            out = self.emit("Flatten", [x], P._attr_wrap(
-                [P.attr_int("axis", int(layer.start_axis))]))
-            ax = int(layer.start_axis)
-            return out, list(shape[:ax]) + [int(np.prod(shape[ax:]))]
+            r = len(shape)
+            s = int(layer.start_axis) % r
+            e = int(layer.stop_axis) % r
+            new_shape = list(shape[:s]) + \
+                [int(np.prod(shape[s:e + 1]))] + list(shape[e + 1:])
+            if s == 1 and e == r - 1:
+                # exactly ONNX Flatten(axis=1) semantics
+                out = self.emit("Flatten", [x], P._attr_wrap(
+                    [P.attr_int("axis", 1)]))
+                return out, new_shape
+            # general (start, stop) range: ONNX Flatten collapses the
+            # WHOLE tensor to 2-D around one axis — not the same op.
+            # Emit Reshape with a static target (0 = copy input dim,
+            # covering symbolic batch dims)
+            tgt = np.asarray([0 if d is None else int(d)
+                              for d in new_shape], np.int64)
+            sn = self.name("shape")
+            self.add_init(sn, tgt)
+            out = self.emit("Reshape", [x, sn])
+            return out, new_shape
         if kind == "Softmax":
             axis = int(getattr(layer, "axis", -1))
             return self.emit("Softmax", [x], P._attr_wrap(

@@ -72,6 +72,10 @@ def _run_onnx(model, x):
         elif op == "Flatten":
             ax = node["attrs"].get("axis", 1)
             y = ins[0].reshape(ins[0].shape[:ax] + (-1,))
+        elif op == "Reshape":
+            tgt = [ins[0].shape[i] if d == 0 else int(d)
+                   for i, d in enumerate(ins[1])]
+            y = ins[0].reshape(tgt)
         elif op == "Relu":
             y = np.maximum(ins[0], 0)
         elif op == "Tanh":
@@ -178,3 +182,24 @@ def test_onnx_export_dynamic_batch(tmp_path):
     got = _run_onnx(model, x)
     want = np.asarray(net(paddle.to_tensor(x)).numpy())
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_export_partial_flatten_reshape(tmp_path):
+    """Flatten(start,stop) that is NOT whole-tail collapse must export
+    as Reshape — ONNX Flatten(axis) always produces 2-D and would be
+    silently wrong (code-review r4 finding)."""
+    paddle.seed(4)
+    net = nn.Sequential(nn.Flatten(1, 2), nn.Flatten())
+    net.eval()
+    fname = paddle.onnx.export(
+        net, str(tmp_path / "pf"),
+        input_spec=[paddle.jit.InputSpec([2, 3, 4, 5], "float32")])
+    model = P.parse_model(open(fname, "rb").read())
+    ops = [n["op_type"] for n in model["graph"]["nodes"]]
+    assert ops == ["Reshape", "Flatten"]
+    x = np.random.default_rng(4).standard_normal(
+        (2, 3, 4, 5)).astype(np.float32)
+    got = _run_onnx(model, x)
+    want = np.asarray(net(paddle.to_tensor(x)).numpy())
+    assert got.shape == want.shape == (2, 60)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
