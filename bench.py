@@ -142,6 +142,73 @@ def bench_llama_small():
     return _llama_run(cfg, batch=32, seq=512, n_steps=20)
 
 
+def bench_bert(cfg=None, batch=32, seq=128, n_steps=8):
+    """BERT-base MLM train step (BASELINE config 3 family, single chip):
+    tokens/sec + approximate MFU via the 6N FLOPs/token rule."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.text.models import BertConfig, BertForPretraining
+
+    paddle.seed(0)
+    cfg = cfg or BertConfig.bert_base()
+    net = BertForPretraining(cfg)
+    ce = nn.CrossEntropyLoss()
+
+    def loss_fn(outs, labels):
+        return ce(outs[0], labels)
+
+    opt = paddle.optimizer.AdamW(1e-4, parameters=net.parameters(),
+                                 moment_dtype="bfloat16")
+    step = paddle.jit.TrainStep(net, loss_fn, opt, amp_dtype="bfloat16")
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(
+        0, cfg.vocab_size, (batch, seq)).astype(np.int64))
+    labels = paddle.to_tensor(rng.integers(
+        0, cfg.vocab_size, (batch, seq)).astype(np.int64))
+    step(ids, labels)
+    float(step(ids, labels).numpy())
+    dt = _time_steps(lambda: step(ids, labels), n_steps)
+    tokens_per_sec = batch * seq / dt
+    n_params = sum(int(np.prod(p.shape)) for p in net.parameters())
+    peak, _ = _peak()
+    mfu = tokens_per_sec * 6 * n_params / peak
+    return tokens_per_sec, mfu
+
+
+def bench_ernie_moe(cfg=None, batch=8, seq=512, n_steps=6):
+    """ERNIE-MoE causal LM step (BASELINE config 5 family, single chip):
+    tokens/sec; activated-params MFU is not well-defined single-chip, so
+    only throughput is reported."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.text.models import ErnieMoEConfig, ErnieMoEForCausalLM
+
+    paddle.seed(0)
+    cfg = cfg or ErnieMoEConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+        num_hidden_layers=8, num_attention_heads=16,
+        num_key_value_heads=16, num_experts=8, moe_every=2,
+        max_position_embeddings=max(seq, 512))
+    net = ErnieMoEForCausalLM(cfg)
+    ce = nn.CrossEntropyLoss()
+
+    def loss_fn(out, labels):
+        return ce(out, labels) + net.aux_loss()
+
+    opt = paddle.optimizer.AdamW(1e-4, parameters=net.parameters(),
+                                 moment_dtype="bfloat16")
+    step = paddle.jit.TrainStep(net, loss_fn, opt, amp_dtype="bfloat16")
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(
+        0, cfg.vocab_size, (batch, seq)).astype(np.int64))
+    labels = paddle.to_tensor(rng.integers(
+        0, cfg.vocab_size, (batch, seq)).astype(np.int64))
+    step(ids, labels)
+    float(step(ids, labels).numpy())
+    dt = _time_steps(lambda: step(ids, labels), n_steps)
+    return batch * seq / dt
+
+
 def bench_lenet():
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
@@ -237,14 +304,26 @@ def main():
         result["extras"]["lenet_train_steps_per_sec_b256"] = round(sps, 2)
         result["extras"]["lenet_compiled_vs_eager_speedup"] = round(speedup, 1)
 
+    def add_bert():
+        tok, mfu = bench_bert()
+        result["extras"]["bert_base_tokens_per_sec"] = round(tok, 1)
+        result["extras"]["bert_base_mfu_approx"] = round(mfu, 4)
+
+    def add_moe():
+        tok = bench_ernie_moe()
+        result["extras"]["ernie_moe_tokens_per_sec"] = round(tok, 1)
+
     # (name, runner, wall-clock cost estimate in seconds: compile+measure
-    # on the tunneled chip, cold cache)
+    # on the tunneled chip, cold cache). BASELINE config-3/4/5 points
+    # first; lenet and the small-model continuity point take leftovers
     extras = [
         ("llama_seq2048", lambda: add_llama("llama_seq2048",
                                             bench_llama_long_seq), 420),
         ("llama_small_seq512", lambda: add_llama("llama_small_seq512",
                                                  bench_llama_small), 240),
         ("lenet", add_lenet, 120),
+        ("bert_base", add_bert, 240),
+        ("ernie_moe", add_moe, 300),
     ]
     skipped = []
     for name, run, est in extras:

@@ -3,6 +3,9 @@ import numpy as np
 import pytest
 
 import jax
+import os
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
@@ -240,3 +243,20 @@ def test_llama_recompute_granularity_numerics(gran):
     got = run(True, gran)
     np.testing.assert_allclose(got, _RECOMPUTE_REF["ref"], rtol=1e-5,
                                atol=1e-6)
+
+
+def test_bench_extra_paths_smoke():
+    """bench.py's BERT / ERNIE-MoE extras (BASELINE configs 3 and 5)
+    must stay runnable — a broken extra records an error in the bench
+    line instead of a number."""
+    import sys
+    sys.path.insert(0, REPO_ROOT)
+    import bench
+    from paddle_tpu.text.models import BertConfig, ErnieMoEConfig
+
+    tok, mfu = bench.bench_bert(cfg=BertConfig.tiny(), batch=2, seq=16,
+                                n_steps=2)
+    assert tok > 0 and np.isfinite(mfu)
+    tok2 = bench.bench_ernie_moe(cfg=ErnieMoEConfig.tiny(), batch=2,
+                                 seq=16, n_steps=2)
+    assert tok2 > 0
