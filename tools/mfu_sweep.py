@@ -25,6 +25,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="llama",
+                    choices=["llama", "bert", "ernie_moe"],
+                    help="llama sweeps the 1B headline shape; bert / "
+                         "ernie_moe run bench.py's config-3/5 extras "
+                         "at the given batch/seq")
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--seq", type=int, default=1024)
     ap.add_argument("--recompute", default="selective",
@@ -38,8 +43,26 @@ def main():
     ap.add_argument("--flash", type=int, default=1)
     args = ap.parse_args()
 
-    from bench import _enable_compile_cache, _peak
+    from bench import (_enable_compile_cache, _peak, bench_bert,
+                       bench_ernie_moe)
     _enable_compile_cache()
+
+    if args.model != "llama":
+        t0 = time.time()
+        if args.model == "bert":
+            tok, mfu = bench_bert(batch=args.batch, seq=args.seq,
+                                  n_steps=args.steps)
+            extra = {"mfu_approx": round(mfu, 4)}
+        else:
+            tok = bench_ernie_moe(batch=args.batch, seq=args.seq,
+                                  n_steps=args.steps)
+            extra = {}
+        print(json.dumps({"model": args.model, "batch": args.batch,
+                          "seq": args.seq,
+                          "tokens_per_sec": round(tok, 1),
+                          "wall_s": round(time.time() - t0, 1), **extra}),
+              flush=True)
+        return
 
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
