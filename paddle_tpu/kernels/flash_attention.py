@@ -161,15 +161,42 @@ def _causal_q_lo(k_idx, bk, diag_off, block_q, nblocks):
                     jnp.int32(nblocks))
 
 
-def _band_mask(s, q_start, k_start, diag_off, neg_inf):
+def _window_k_lo(q_idx, bq, diag_off, block_k, window, nblocks):
+    """Inclusive lower bound on k-block index under a sliding window:
+    the earliest attended key for rows of q block q_idx is
+    q_pos_min + diag_off - window + 1."""
+    first_k = (q_idx.astype(jnp.int32) * jnp.int32(bq)
+               + jnp.int32(diag_off) - jnp.int32(window) + jnp.int32(1))
+    return jnp.clip(first_k // jnp.int32(block_k), jnp.int32(0),
+                    jnp.int32(nblocks))
+
+
+def _window_q_hi(k_idx, bk, diag_off, block_q, window, nblocks):
+    """Exclusive upper bound on q-block index under a sliding window:
+    the last query that sees any key of k block k_idx is
+    k_pos_max + window - 1 - diag_off."""
+    last_q = (k_idx.astype(jnp.int32) * jnp.int32(bk) + jnp.int32(bk)
+              - jnp.int32(1) + jnp.int32(window) - jnp.int32(1)
+              - jnp.int32(diag_off))
+    return jnp.clip(last_q // jnp.int32(block_q) + jnp.int32(1),
+                    jnp.int32(0), jnp.int32(nblocks))
+
+
+def _band_mask(s, q_start, k_start, diag_off, neg_inf, window=None):
     """Apply the bottom-right-aligned causal band to a [BQ, BK] score
     tile whose rows start at q_start and columns at k_start: query i
-    attends key j iff i + diag_off >= j. Shared by all three kernels so
-    fwd and bwd can never mask different patterns."""
+    attends key j iff i + diag_off >= j — and, under a sliding window,
+    iff i + diag_off - j < window (Mistral-style local attention).
+    Shared by all three kernels so fwd and bwd can never mask different
+    patterns."""
     bq, bk = s.shape
     q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return jnp.where(q_pos + jnp.int32(diag_off) >= k_pos, s, neg_inf)
+    keep = q_pos + jnp.int32(diag_off) >= k_pos
+    if window is not None:
+        keep = jnp.logical_and(
+            keep, q_pos + jnp.int32(diag_off) - k_pos < jnp.int32(window))
+    return jnp.where(keep, s, neg_inf)
 
 
 # rows with every key masked (causal with seq_q > seq_k) have lse pinned
@@ -184,7 +211,7 @@ ROW_INVALID_LSE = NEG_INF / 2
 # ---------------------------------------------------------------------------
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse, causal, scale,
-                      block_k, seq_k, seq_q, diag_off):
+                      block_k, seq_k, seq_q, diag_off, window=None):
     """One (batch*head, q_block) program: stream K/V tiles, online softmax.
 
     Refs are VMEM tiles: q [BQ, D], k/v [S_k, D] (full K/V rows for this
@@ -224,7 +251,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse, causal, scale,
             preferred_element_type=jnp.float32)  # [bq, block_k]
         if causal:
             s = _band_mask(s, q_idx.astype(jnp.int32) * bq, i * block_k,
-                           diag_off, neg_inf)
+                           diag_off, neg_inf, window=window)
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_cur[:, :1])
         alpha = jnp.exp(m_prev - m_cur)
@@ -234,10 +261,13 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse, causal, scale,
             preferred_element_type=jnp.float32)
         return m_cur, l_cur, acc_cur
 
-    # causal: only iterate k blocks that intersect the band
+    # causal: only iterate k blocks that intersect the band (and, under
+    # a sliding window, skip blocks entirely left of the window too)
     hi = _causal_k_hi(q_idx, bq, diag_off, block_k, nblocks) if causal \
         else jnp.int32(nblocks)
-    m, l, acc = jax.lax.fori_loop(jnp.int32(0), hi, body, (m, l, acc))
+    lo = _window_k_lo(q_idx, bq, diag_off, block_k, window, nblocks) \
+        if (causal and window is not None) else jnp.int32(0)
+    m, l, acc = jax.lax.fori_loop(lo, hi, body, (m, l, acc))
     l_safe = jnp.maximum(l, jnp.float32(1e-30))
     # fully-masked rows (causal, seq_q > seq_k) would otherwise emit the
     # mean of visited V (p = exp(s - m) = 1 when every s == m == NEG_INF)
@@ -251,7 +281,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse, causal, scale,
 
 
 def _flash_pallas_fwd(q, k, v, causal, scale, interpret=False,
-                      want_lse=True):
+                      want_lse=True, window=None):
     """q/k/v: [B, H, S, D] → (out [B, H, S, D], lse [B*H, S, STAT_LANES]).
 
     want_lse=False (inference / non-differentiated primal) skips the lse
@@ -269,7 +299,7 @@ def _flash_pallas_fwd(q, k, v, causal, scale, interpret=False,
 
     kernel = functools.partial(_flash_fwd_kernel, causal=causal, scale=scale,
                                block_k=bk, seq_k=sk, seq_q=sq,
-                               diag_off=sk - sq)
+                               diag_off=sk - sq, window=window)
     out_specs = [pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0))]
     out_shape = [jax.ShapeDtypeStruct((b * h, sq, d), q.dtype)]
     if want_lse:
@@ -302,7 +332,8 @@ def _flash_pallas_fwd(q, k, v, causal, scale, interpret=False,
 # ---------------------------------------------------------------------------
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, *, causal, scale, block_k, seq_k, diag_off):
+                         dq_ref, *, causal, scale, block_k, seq_k, diag_off,
+                         window=None):
     """One (batch*head, q_block) program accumulating dQ.
 
     dS = P ∘ (dO·Vᵀ − Δ) with P = exp(S − lse), Δ = rowsum(dO ∘ O);
@@ -329,7 +360,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)  # [bq, bk]
         if causal:
             s = _band_mask(s, q_idx.astype(jnp.int32) * bq, i * block_k,
-                           diag_off, neg_inf)
+                           diag_off, neg_inf, window=window)
         p = jnp.where(lse > jnp.float32(ROW_INVALID_LSE), jnp.exp(s - lse),
                       jnp.float32(0.0))
         dp = jax.lax.dot_general(
@@ -342,14 +373,16 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     hi = _causal_k_hi(q_idx, bq, diag_off, block_k, nblocks) if causal \
         else jnp.int32(nblocks)
+    lo = _window_k_lo(q_idx, bq, diag_off, block_k, window, nblocks) \
+        if (causal and window is not None) else jnp.int32(0)
     acc = jax.lax.fori_loop(
-        jnp.int32(0), hi, body, jnp.zeros((bq, d), jnp.float32))
+        lo, hi, body, jnp.zeros((bq, d), jnp.float32))
     dq_ref[...] = (acc * jnp.float32(scale)).astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, *, causal, scale, block_q, seq_q,
-                          diag_off):
+                          diag_off, window=None):
     """One (batch*head, k_block) program accumulating dK and dV.
 
     dV = Pᵀ·dO; dK = scale · dSᵀ·Q.
@@ -377,7 +410,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)  # [bq, bk]
         if causal:
             s = _band_mask(s, j * block_q, k_idx.astype(jnp.int32) * bk,
-                           diag_off, neg_inf)
+                           diag_off, neg_inf, window=window)
         p = jnp.where(lse > jnp.float32(ROW_INVALID_LSE), jnp.exp(s - lse),
                       jnp.float32(0.0))          # [bq, bk]
         dv_acc = dv_acc + jax.lax.dot_general(
@@ -392,18 +425,21 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)  # [bk, d]
         return dk_acc, dv_acc
 
-    # causal: q blocks entirely above the band see nothing
+    # causal: q blocks entirely above the band see nothing; under a
+    # sliding window, q blocks entirely past the window see nothing too
     lo = _causal_q_lo(k_idx, bk, diag_off, block_q, nblocks) if causal \
         else jnp.int32(0)
+    hi = _window_q_hi(k_idx, bk, diag_off, block_q, window, nblocks) \
+        if (causal and window is not None) else jnp.int32(nblocks)
     zeros = jnp.zeros((bk, d), jnp.float32)
     dk_acc, dv_acc = jax.lax.fori_loop(
-        lo, jnp.int32(nblocks), body, (zeros, zeros))
+        lo, hi, body, (zeros, zeros))
     dk_ref[...] = (dk_acc * jnp.float32(scale)).astype(dk_ref.dtype)
     dv_ref[...] = dv_acc.astype(dv_ref.dtype)
 
 
 def _flash_pallas_bwd(q, k, v, do, lse, delta, causal, scale,
-                      interpret=False):
+                      interpret=False, window=None):
     """All [B, H, S, D] (lse/delta [B*H, S, STAT_LANES]) → dq, dk, dv."""
     from jax.experimental import pallas as pl
 
@@ -418,7 +454,7 @@ def _flash_pallas_bwd(q, k, v, do, lse, delta, causal, scale,
 
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, causal=causal, scale=scale, block_k=bk,
-        seq_k=sk, diag_off=sk - sq)
+        seq_k=sk, diag_off=sk - sq, window=window)
     with _x32_trace():
         dq = pl.pallas_call(
             dq_kernel,
@@ -438,7 +474,7 @@ def _flash_pallas_bwd(q, k, v, do, lse, delta, causal, scale,
 
     dkv_kernel = functools.partial(
         _flash_bwd_dkv_kernel, causal=causal, scale=scale, block_q=bq,
-        seq_q=sq, diag_off=sk - sq)
+        seq_q=sq, diag_off=sk - sq, window=window)
     with _x32_trace():
         dk, dv = pl.pallas_call(
             dkv_kernel,
@@ -469,21 +505,22 @@ def _flash_pallas_bwd(q, k, v, do, lse, delta, causal, scale,
 # custom_vjp wrapper: the trainable Pallas path
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_pallas(q, k, v, causal, scale, interpret=False):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_pallas(q, k, v, causal, scale, interpret=False, window=None):
     """q/k/v: [B, H, S, D] → out [B, H, S, D]; differentiable."""
     # non-differentiated primal: skip the lse output (no HBM write)
     out, _ = _flash_pallas_fwd(q, k, v, causal, scale, interpret=interpret,
-                               want_lse=False)
+                               want_lse=False, window=window)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, causal, scale, interpret):
-    out, lse = _flash_pallas_fwd(q, k, v, causal, scale, interpret=interpret)
+def _flash_vjp_fwd(q, k, v, causal, scale, interpret, window):
+    out, lse = _flash_pallas_fwd(q, k, v, causal, scale,
+                                 interpret=interpret, window=window)
     return out, (q, k, v, out, lse)
 
 
-def _flash_vjp_bwd(causal, scale, interpret, res, g):
+def _flash_vjp_bwd(causal, scale, interpret, window, res, g):
     q, k, v, out, lse = res
     b, h, sq, d = q.shape
     try:
@@ -492,14 +529,16 @@ def _flash_vjp_bwd(causal, scale, interpret, res, g):
         delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                         axis=-1).reshape(b * h, sq, STAT_LANES)
         dq, dk, dv = _flash_pallas_bwd(
-            q, k, v, g, lse, delta, causal, scale, interpret=interpret)
+            q, k, v, g, lse, delta, causal, scale, interpret=interpret,
+            window=window)
     except Exception as exc:  # noqa: BLE001 — flag-gated, logged
         # the fwd gate in flash_attention_arrays cannot see failures in
         # the bwd kernels (they trace when the VJP is pulled); gate here
         # too so training degrades to the XLA path instead of crashing
         _log_fallback(exc, "bwd")
         _, xla_vjp = jax.vjp(
-            lambda q_, k_, v_: _flash_xla(q_, k_, v_, causal, scale),
+            lambda q_, k_, v_: _flash_xla(q_, k_, v_, causal, scale,
+                                          window=window),
             q, k, v)
         dq, dk, dv = xla_vjp(g)
     return dq, dk, dv
@@ -512,7 +551,7 @@ _flash_pallas.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 # XLA fallback + public entry points
 # ---------------------------------------------------------------------------
 
-def _flash_xla(q, k, v, causal, scale):
+def _flash_xla(q, k, v, causal, scale, window=None):
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     out_mask = None
     if causal:
@@ -520,6 +559,11 @@ def _flash_xla(q, k, v, causal, scale):
         # static-shape mask built host-side so the fully-masked-row test
         # below stays concrete under jit
         mask = np.tril(np.ones((sq, sk), bool), k=sk - sq)
+        if window is not None:
+            # sliding window: also drop keys more than `window`-1
+            # positions behind the (band-aligned) diagonal
+            mask &= ~np.tril(np.ones((sq, sk), bool),
+                             k=sk - sq - int(window))
         logits = jnp.where(mask, logits, NEG_INF)
         out_mask = mask.any(-1)  # rows with no visible key (sq > sk)
     p = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
@@ -540,8 +584,22 @@ def _tileable(sq, sk, d):
 
 
 def flash_attention_arrays(q, k, v, causal=False, scale=None,
-                           force_pallas=False, interpret=False):
-    """Array-level entry (paddle layout [B, S, H, D])."""
+                           force_pallas=False, interpret=False,
+                           window=None):
+    """Array-level entry (paddle layout [B, S, H, D]).
+
+    window: sliding-window (Mistral-style local) attention — each query
+    sees at most the `window` most recent keys up to the causal
+    diagonal. Requires causal=True; None = full attention.
+    """
+    if window is not None:
+        window = int(window)
+        if not causal:
+            raise ValueError(
+                "flash attention window requires causal=True (the "
+                "window is measured back from the causal diagonal)")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
@@ -557,17 +615,21 @@ def flash_attention_arrays(q, k, v, causal=False, scale=None,
         and _pallas_supported())
     if use_pallas:
         try:
-            out = _flash_pallas(qt, kt, vt, causal, s, interpret)
+            out = _flash_pallas(qt, kt, vt, causal, s, interpret, window)
         except Exception as exc:  # noqa: BLE001 — flag-gated, logged
             _log_fallback(exc, "fwd")
-            out = _flash_xla(qt, kt, vt, causal, s)
+            out = _flash_xla(qt, kt, vt, causal, s, window=window)
     else:
-        out = _flash_xla(qt, kt, vt, causal, s)
+        out = _flash_xla(qt, kt, vt, causal, s, window=window)
     return jnp.swapaxes(out, 1, 2)
 
 
-def flash_attention(query, key, value, causal=False, scale=None):
-    """Tensor-level entry used by nn.functional.flash_attention."""
+def flash_attention(query, key, value, causal=False, scale=None,
+                    window=None):
+    """Tensor-level entry used by nn.functional.flash_attention.
+    ``window`` selects sliding-window (local) attention; see
+    flash_attention_arrays."""
     def fn(q, k, v):
-        return flash_attention_arrays(q, k, v, causal=causal, scale=scale)
+        return flash_attention_arrays(q, k, v, causal=causal, scale=scale,
+                                      window=window)
     return run_op("flash_attention", fn, [query, key, value])
