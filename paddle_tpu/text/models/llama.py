@@ -45,6 +45,11 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-6
     tie_word_embeddings: bool = False
     use_flash_attention: bool = True
+    # Mistral-style sliding-window (local) attention: each query sees at
+    # most this many most-recent keys (None = full causal). Served by
+    # the Pallas flash kernel's banded k-loop, so attention compute
+    # scales with window * seq instead of seq^2
+    sliding_window: int | None = None
     sequence_parallel: bool = False
     # activation checkpointing per decoder layer (reference
     # recompute_interval semantics): required to fit 1B+ params at
@@ -99,6 +104,7 @@ class LlamaAttention(Layer):
         self.num_kv_heads = c.num_key_value_heads
         self.head_dim = c.hidden_size // c.num_attention_heads
         self.use_flash = c.use_flash_attention
+        self.window = c.sliding_window
         # checkpoint_name tags only matter inside a policy-bearing
         # jax.checkpoint; skip the per-op tape cost otherwise
         self._tag = (c.recompute
@@ -141,11 +147,17 @@ class LlamaAttention(Layer):
             k = ops.manipulation.repeat_interleave(k, rep, axis=2)
             v = ops.manipulation.repeat_interleave(v, rep, axis=2)
         if mesh_mod.axis_degree("sep") > 1:
+            if self.window is not None:
+                raise NotImplementedError(
+                    "sliding_window with sequence parallelism (sep>1) "
+                    "is not supported; the ring schedule assumes full "
+                    "causal attention")
             from ...kernels.ring_attention import ring_flash_attention
             out = ring_flash_attention(q, k, v, causal=True)
-        elif self.use_flash:
+        elif self.use_flash or self.window is not None:
             from ...kernels.flash_attention import flash_attention
-            out = flash_attention(q, k, v, causal=True)
+            out = flash_attention(q, k, v, causal=True,
+                                  window=self.window)
         else:
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
         out = out.reshape([b, s, self.num_heads * self.head_dim])
