@@ -217,3 +217,34 @@ def test_flash_window_entry_validation(rng):
     out = flash_attention_arrays(q, k, v, causal=True, window=64,
                                  force_pallas=True, interpret=True)
     assert out.shape == q.shape
+
+
+@pytest.mark.parametrize("window", [32, 100, 160])
+def test_flash_sliding_window_multiblock_bounds(rng, window, monkeypatch):
+    """Shrunk 64x64 blocks over seq 256 give a 4x4 block grid, so the
+    windowed k-loop lower bound (fwd/dq) and q-loop upper bound (dkv)
+    actually skip blocks — gradients must still match the XLA mask."""
+    import paddle_tpu.kernels.flash_attention as fa
+    monkeypatch.setattr(fa, "BLOCK_Q", 64)
+    monkeypatch.setattr(fa, "BLOCK_K", 64)
+    q, k, v = _mk(rng, s=256)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+
+    out = fa._flash_pallas(q, k, v, True, scale, True, window)
+    ref = fa._flash_xla(q, k, v, True, scale, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    def f_pallas(q, k, v):
+        return jnp.sum(fa._flash_pallas(q, k, v, True, scale, True,
+                                        window) ** 2)
+
+    def f_xla(q, k, v):
+        return jnp.sum(fa._flash_xla(q, k, v, True, scale,
+                                     window=window) ** 2)
+
+    gp = jax.grad(f_pallas, argnums=(0, 1, 2))(q, k, v)
+    gx = jax.grad(f_xla, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=3e-3, atol=3e-3)
