@@ -228,6 +228,7 @@ class DataLoader:
         self.prefetch_factor = max(int(prefetch_factor), 1)
         self.worker_init_fn = worker_init_fn
         self.use_shared_memory = bool(use_shared_memory)
+        self.use_buffer_reader = bool(use_buffer_reader)
         self.persistent_workers = bool(persistent_workers)
         self._pool = None
         self._iterable_ds = isinstance(dataset, IterableDataset)
@@ -339,12 +340,47 @@ class DataLoader:
                 pool.terminate()
                 pool.join()
 
+    def _prefetch_to_device(self, it):
+        """use_buffer_reader (reference: the C++ buffered reader that
+        stages batches onto the device ahead of compute): keep
+        prefetch_factor batches in flight — each batch's arrays are
+        pushed with jax.device_put (async dispatch) as soon as the
+        PREVIOUS batch is handed to the consumer, so host->device copies
+        overlap the training step instead of serializing with it."""
+        import collections
+
+        import jax
+
+        from ..core.tensor import Tensor
+
+        def stage(item):
+            return jax.tree_util.tree_map(
+                lambda t: Tensor._from_array(jax.device_put(t._data),
+                                             stop_gradient=t.stop_gradient)
+                if isinstance(t, Tensor) else t, item,
+                is_leaf=lambda t: isinstance(t, Tensor))
+
+        buf = collections.deque()
+        try:
+            for item in it:
+                buf.append(stage(item))
+                if len(buf) > self.prefetch_factor:
+                    yield buf.popleft()
+            while buf:
+                yield buf.popleft()
+        finally:
+            buf.clear()
+
     def __iter__(self):
         if self._iterable_ds:
-            return self._iter_iterable()
-        if self.num_workers > 0 and self.batch_sampler is not None:
-            return self._iter_workers()
-        return self._iter_single()
+            it = self._iter_iterable()
+        elif self.num_workers > 0 and self.batch_sampler is not None:
+            it = self._iter_workers()
+        else:
+            it = self._iter_single()
+        if self.use_buffer_reader:
+            return self._prefetch_to_device(it)
+        return it
 
     def __call__(self):
         return self.__iter__()
