@@ -150,7 +150,11 @@ def bench_bert(cfg=None, batch=32, seq=128, n_steps=8):
     from paddle_tpu.text.models import BertConfig, BertForPretraining
 
     paddle.seed(0)
-    cfg = cfg or BertConfig.bert_base()
+    if cfg is None:
+        # bert-base, with the position table stretched to cover the
+        # requested seq — JAX's clamped gather would otherwise silently
+        # reuse the last position row past max_position_embeddings
+        cfg = BertConfig(max_position_embeddings=max(512, seq))
     net = BertForPretraining(cfg)
     ce = nn.CrossEntropyLoss()
 

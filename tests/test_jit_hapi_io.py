@@ -355,10 +355,10 @@ def test_to_static_graph_break_frozen_model_input_grads():
     np.testing.assert_allclose(x.grad.numpy(), x2.grad.numpy(), rtol=1e-6)
 
 
-def test_dataloader_buffer_reader_prefetch():
+def test_dataloader_buffer_reader_prefetch(monkeypatch):
     """use_buffer_reader stages batches onto the device ahead of the
     consumer (the reference's buffered reader); values and order are
-    unchanged, and the data really lands as device arrays."""
+    unchanged, and device_put really runs once per staged tensor."""
     import jax
 
     from paddle_tpu.io import DataLoader, TensorDataset
@@ -367,14 +367,23 @@ def test_dataloader_buffer_reader_prefetch():
     ds = TensorDataset([X, Y])
     plain = [b for b in DataLoader(ds, batch_size=4,
                                    use_buffer_reader=False)]
+    calls = {"n": 0}
+    real_put = jax.device_put
+
+    def counting_put(x, *a_, **kw):
+        calls["n"] += 1
+        return real_put(x, *a_, **kw)
+
+    monkeypatch.setattr(jax, "device_put", counting_put)
     buffered = [b for b in DataLoader(ds, batch_size=4,
                                       use_buffer_reader=True,
                                       prefetch_factor=2)]
+    monkeypatch.undo()
     assert len(plain) == len(buffered) == 3
+    assert calls["n"] == 6  # 3 batches x 2 tensors actually staged
     for (px, py), (bx, by) in zip(plain, buffered):
         np.testing.assert_allclose(px.numpy(), bx.numpy())
         np.testing.assert_array_equal(py.numpy(), by.numpy())
-        assert isinstance(bx._data, jax.Array)
     # early abandonment doesn't wedge the prefetch buffer
     it = iter(DataLoader(ds, batch_size=4, use_buffer_reader=True))
     next(it)

@@ -29,7 +29,8 @@ def main():
                     choices=["llama", "bert", "ernie_moe"],
                     help="llama sweeps the 1B headline shape; bert / "
                          "ernie_moe run bench.py's config-3/5 extras "
-                         "at the given batch/seq")
+                         "at the given batch/seq (the llama-only tuning "
+                         "flags are ignored there, with a warning)")
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--seq", type=int, default=1024)
     ap.add_argument("--recompute", default="selective",
@@ -48,6 +49,15 @@ def main():
     _enable_compile_cache()
 
     if args.model != "llama":
+        ignored = [f for f, cur, dflt in [
+            ("--recompute", args.recompute, "selective"),
+            ("--moments", args.moments, "bfloat16"),
+            ("--bq", args.bq, 0), ("--bk", args.bk, 0),
+            ("--layers", args.layers, 4), ("--flash", args.flash, 1),
+        ] if cur != dflt]
+        if ignored:
+            print(f"note: {' '.join(ignored)} apply to --model llama "
+                  f"only; ignored for {args.model}", file=sys.stderr)
         t0 = time.time()
         if args.model == "bert":
             tok, mfu = bench_bert(batch=args.batch, seq=args.seq,
