@@ -39,7 +39,8 @@ _N = pytest.mark.nightly
 
 
 @pytest.mark.parametrize("factory,size", [
-    ("alexnet", 224), ("resnext50_32x4d", 64),
+    ("alexnet", 224),
+    pytest.param("resnext50_32x4d", 64, marks=_N),
     pytest.param("squeezenet1_1", 224, marks=_N),
     pytest.param("densenet121", 64, marks=_N),
     pytest.param("mobilenet_v1", 64, marks=_N),
