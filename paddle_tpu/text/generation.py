@@ -33,12 +33,21 @@ from ..jit.functional import functional_call, get_buffers, get_frozen, \
 
 def generate(model, input_ids, max_new_tokens: int,
              temperature: float = 0.0, top_k: int = 0,
-             eos_token_id: Optional[int] = None, seed: int = 0):
+             eos_token_id: Optional[int] = None, seed: int = 0,
+             use_cache: bool = True):
     """Generate ``max_new_tokens`` continuations for ``input_ids``
     [B, S] with the causal-LM ``model``. temperature == 0 → greedy;
     otherwise softmax sampling at that temperature, optionally top-k
     truncated. Rows that emit ``eos_token_id`` keep their eos and stop
-    changing. Returns a Tensor [B, S + max_new_tokens]."""
+    changing. Returns a Tensor [B, S + max_new_tokens].
+
+    use_cache=True runs the KV-cache decode: prefill writes the prompt
+    into per-layer caches, then each scan step feeds ONE token and
+    attends against the cache — O(L) per step instead of the padded
+    full-recompute path's O(L²). Requires the model to support
+    ``kv_caches``/``cache_index`` forward kwargs (the in-tree
+    LlamaForCausalLM does); use_cache=False is the model-agnostic
+    fallback."""
     ids = np.asarray(unwrap(input_ids))
     b, s = ids.shape
     total = s + int(max_new_tokens)
@@ -46,33 +55,40 @@ def generate(model, input_ids, max_new_tokens: int,
     buffers = get_buffers(model)
     frozen = get_frozen(model)
 
-    def fwd(p, tokens):
-        out, _ = functional_call(model, p, buffers, (tokens,), {},
+    def fwd(p, tokens, caches=None, index=None):
+        kwargs = {}
+        if caches is not None:
+            kwargs = {"kv_caches": caches, "cache_index": index}
+        out, _ = functional_call(model, p, buffers, (tokens,), kwargs,
                                  frozen=frozen, training=False)
         return out
 
-    def decode(p, tokens, key):
+    def pick_next(cur, done, key, dtype):
+        cur = cur.astype(jnp.float32)
+        if temperature and temperature > 0:
+            key, sub = jax.random.split(key)
+            scaled = cur / jnp.float32(temperature)
+            if top_k and top_k > 0:
+                kth = jnp.sort(scaled, axis=-1)[:, -int(top_k)]
+                scaled = jnp.where(scaled >= kth[:, None], scaled,
+                                   -jnp.inf)
+            nxt = jax.random.categorical(sub, scaled, axis=-1)
+        else:
+            nxt = jnp.argmax(cur, axis=-1)
+        nxt = nxt.astype(dtype)
+        if eos_token_id is not None:
+            pad = jnp.asarray(eos_token_id, dtype)
+            nxt = jnp.where(done, pad, nxt)
+            done = jnp.logical_or(done, nxt == pad)
+        return nxt, done, key
+
+    def decode_padded(p, tokens, key):
         def step(carry, i):
             tokens, done, key = carry
             logits = fwd(p, tokens)                     # [B, L, V]
             cur = jax.lax.dynamic_index_in_dim(
-                jnp.swapaxes(logits, 0, 1), i - 1, 0,
-                keepdims=False).astype(jnp.float32)     # [B, V]
-            if temperature and temperature > 0:
-                key, sub = jax.random.split(key)
-                scaled = cur / jnp.float32(temperature)
-                if top_k and top_k > 0:
-                    kth = jnp.sort(scaled, axis=-1)[:, -int(top_k)]
-                    scaled = jnp.where(scaled >= kth[:, None], scaled,
-                                       -jnp.inf)
-                nxt = jax.random.categorical(sub, scaled, axis=-1)
-            else:
-                nxt = jnp.argmax(cur, axis=-1)
-            nxt = nxt.astype(tokens.dtype)
-            if eos_token_id is not None:
-                pad = jnp.asarray(eos_token_id, tokens.dtype)
-                nxt = jnp.where(done, pad, nxt)
-                done = jnp.logical_or(done, nxt == pad)
+                jnp.swapaxes(logits, 0, 1), i - 1, 0, keepdims=False)
+            nxt, done, key = pick_next(cur, done, key, tokens.dtype)
             tokens = jax.lax.dynamic_update_slice(
                 tokens, nxt[:, None], (jnp.int32(0), i))
             return (tokens, done, key), None
@@ -83,10 +99,43 @@ def generate(model, input_ids, max_new_tokens: int,
             jnp.arange(s, total, dtype=jnp.int32))
         return tokens
 
+    def decode_cached(p, tokens, key):
+        cfg = model.config
+        hkv = cfg.num_key_value_heads
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        caches = [
+            (jnp.zeros((b, total, hkv, hd), jnp.float32),
+             jnp.zeros((b, total, hkv, hd), jnp.float32))
+            for _ in range(cfg.num_hidden_layers)]
+        # prefill the prompt (writes cache slots [0, s))
+        logits, caches = fwd(p, tokens[:, :s], caches, jnp.int32(0))
+        done0 = jnp.zeros((b,), bool)
+        nxt, done, key = pick_next(logits[:, -1], done0, key,
+                                   tokens.dtype)
+        tokens = jax.lax.dynamic_update_slice(
+            tokens, nxt[:, None], (jnp.int32(0), jnp.int32(s)))
+
+        def step(carry, i):
+            tokens, caches, done, key = carry
+            cur_tok = jax.lax.dynamic_slice(tokens, (jnp.int32(0), i),
+                                            (b, 1))
+            logits, caches = fwd(p, cur_tok, caches, i)
+            nxt, done, key = pick_next(logits[:, -1], done, key,
+                                       tokens.dtype)
+            tokens = jax.lax.dynamic_update_slice(
+                tokens, nxt[:, None], (jnp.int32(0), i + 1))
+            return (tokens, caches, done, key), None
+
+        (tokens, _, _, _), _ = jax.lax.scan(
+            step, (tokens, caches, done, key),
+            jnp.arange(s, total - 1, dtype=jnp.int32))
+        return tokens
+
     padded = jnp.concatenate(
         [jnp.asarray(ids),
          jnp.zeros((b, total - s), ids.dtype)], axis=1)
     key = jax.random.PRNGKey(int(seed))
+    decode = decode_cached if use_cache else decode_padded
     with tape_mod.no_grad_guard():
         out = jax.jit(decode)(params, padded, key)
     return wrap(out)

@@ -30,7 +30,12 @@ from ...nn import functional as F
 from ...nn.layer.common import Dropout, Embedding, Linear
 from ...nn.layer.layers import Layer
 
+import jax
 import jax.numpy as jnp
+
+from ...core.dispatch import wrap
+
+NEG_INF_ATTN = -1e30
 
 
 @dataclass
@@ -127,16 +132,24 @@ class LlamaAttention(Layer):
             self.v_proj = Linear(hs, kv, bias_attr=False)
             self.o_proj = Linear(hs, hs, bias_attr=False)
 
-    def forward(self, x, position_ids=None):
+    def forward(self, x, position_ids=None, kv_cache=None,
+                cache_index=None):
         b, s, _ = x.shape
         q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
         k = self.k_proj(x).reshape([b, s, self.num_kv_heads,
                                     self.head_dim])
         v = self.v_proj(x).reshape([b, s, self.num_kv_heads,
                                     self.head_dim])
+        if kv_cache is not None and position_ids is None:
+            # decode: rope positions continue from the cache write offset
+            position_ids = wrap(jnp.broadcast_to(
+                jnp.arange(s, dtype=jnp.int32)[None, :]
+                + jnp.asarray(cache_index, jnp.int32), (b, s)))
         q, k, _ = fused_rotary_position_embedding(
             q, k, None, position_ids=position_ids,
             use_neox_rotary_style=True)
+        if kv_cache is not None:
+            return self._cached_attention(q, k, v, kv_cache, cache_index)
         if self._tag:
             from ...distributed.fleet.recompute import checkpoint_name
             q = checkpoint_name(q, "attn_q")
@@ -165,6 +178,50 @@ class LlamaAttention(Layer):
             from ...distributed.fleet.recompute import checkpoint_name
             out = checkpoint_name(out, "attn_core")
         return self.o_proj(out)
+
+    def _cached_attention(self, q, k, v, kv_cache, cache_index):
+        """KV-cache decode: write this call's k/v at ``cache_index``,
+        attend q against the cache prefix (full causal; sliding_window
+        decode is not supported). One run_op so the cache update and
+        masked attention stay a single traced unit."""
+        if self.window is not None:
+            raise NotImplementedError(
+                "KV-cache decode with sliding_window is not supported")
+        rep = self.num_heads // self.num_kv_heads
+
+        def fn(qa, ka, va, ck, cv, idx):
+            b, s, hq, d = qa.shape
+            L = ck.shape[1]
+            idx = idx.astype(jnp.int32)
+            zero = jnp.int32(0)
+            ck = jax.lax.dynamic_update_slice(
+                ck, ka.astype(ck.dtype), (zero, idx, zero, zero))
+            cv = jax.lax.dynamic_update_slice(
+                cv, va.astype(cv.dtype), (zero, idx, zero, zero))
+            kk, vv = ck, cv
+            if rep != 1:
+                kk = jnp.repeat(kk, rep, axis=2)
+                vv = jnp.repeat(vv, rep, axis=2)
+            scale = 1.0 / jnp.sqrt(jnp.float32(d))
+            logits = jnp.einsum("bshd,bLhd->bhsL", qa.astype(jnp.float32),
+                                kk.astype(jnp.float32)) * scale
+            # query local position i sits at absolute idx + i; it sees
+            # cache slots <= that position
+            q_pos = idx + jnp.arange(s, dtype=jnp.int32)
+            k_pos = jnp.arange(L, dtype=jnp.int32)
+            mask = k_pos[None, :] <= q_pos[:, None]        # [s, L]
+            logits = jnp.where(mask[None, None], logits, NEG_INF_ATTN)
+            p = jax.nn.softmax(logits, axis=-1)
+            out = jnp.einsum("bhsL,bLhd->bshd", p,
+                             vv.astype(jnp.float32)).astype(qa.dtype)
+            return out, ck, cv
+
+        idx_t = wrap(jnp.asarray(cache_index, jnp.int32))
+        out, nck, ncv = run_op("cached_attention", fn,
+                               [q, k, v, kv_cache[0], kv_cache[1], idx_t])
+        b, s = out.shape[0], out.shape[1]
+        out = out.reshape([b, s, self.num_heads * self.head_dim])
+        return self.o_proj(out), (nck, ncv)
 
 
 class LlamaMLP(Layer):
@@ -208,7 +265,14 @@ class LlamaDecoderLayer(Layer):
                                                      config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x):
+    def forward(self, x, kv_cache=None, cache_index=None):
+        if kv_cache is not None:
+            attn, new_cache = self.self_attn(
+                self.input_layernorm(x), kv_cache=kv_cache,
+                cache_index=cache_index)
+            x = x + attn
+            x = x + self.mlp(self.post_attention_layernorm(x))
+            return x, new_cache
         x = x + self.self_attn(self.input_layernorm(x))
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
@@ -230,8 +294,14 @@ class LlamaModel(Layer):
              for _ in range(config.num_hidden_layers)])
         self.norm = LlamaRMSNorm(config.hidden_size, config.rms_norm_eps)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, kv_caches=None, cache_index=None):
         x = self.embed_tokens(input_ids)
+        if kv_caches is not None:
+            new_caches = []
+            for lyr, cache in zip(self.layers, kv_caches):
+                x, nc = lyr(x, kv_cache=cache, cache_index=cache_index)
+                new_caches.append(nc)
+            return self.norm(x), new_caches
         if self.config.sequence_parallel and \
                 mesh_mod.axis_degree("mp") > 1:
             from ...distributed.fleet.utils.sequence_parallel_utils import \
@@ -276,7 +346,18 @@ class LlamaForCausalLM(Layer):
             self.lm_head = Linear(config.hidden_size, config.vocab_size,
                                   bias_attr=False)
 
-    def forward(self, input_ids, labels=None):
+    def forward(self, input_ids, labels=None, kv_caches=None,
+                cache_index=None):
+        if kv_caches is not None:
+            h, new_caches = self.llama(input_ids, kv_caches=kv_caches,
+                                       cache_index=cache_index)
+            if self.lm_head is not None:
+                return self.lm_head(h), new_caches
+            w = self.llama.embed_tokens.weight
+
+            def tied(hh, ww):
+                return jnp.einsum("bsh,vh->bsv", hh, ww)
+            return run_op("tied_lm_head", tied, [h, w]), new_caches
         h = self.llama(input_ids)
         if labels is not None and self.config.fused_linear_ce:
             from ...incubate.nn.functional import fused_linear_cross_entropy

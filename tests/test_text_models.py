@@ -320,6 +320,13 @@ def test_generate_matches_eager_greedy_loop():
     assert got.shape == (2, 11)
     np.testing.assert_array_equal(got[:, :5], prompt)
 
+    # the KV-cache decode (default) and the padded full-recompute path
+    # must be token-exact
+    nocache = np.asarray(generate(net, paddle.to_tensor(prompt),
+                                  max_new_tokens=6,
+                                  use_cache=False).numpy())
+    np.testing.assert_array_equal(got, nocache)
+
     # eager reference loop
     toks = prompt.copy()
     for _ in range(6):
