@@ -47,10 +47,16 @@ def generate(model, input_ids, max_new_tokens: int,
     full-recompute path's O(L²). Requires the model to support
     ``kv_caches``/``cache_index`` forward kwargs (the in-tree
     LlamaForCausalLM does); use_cache=False is the model-agnostic
-    fallback."""
+    fallback, and sliding-window models take it automatically (the
+    cached attention supports full causal only)."""
     ids = np.asarray(unwrap(input_ids))
     b, s = ids.shape
     total = s + int(max_new_tokens)
+    if max_new_tokens <= 0:
+        return wrap(jnp.asarray(ids))
+    if use_cache and getattr(getattr(model, "config", None),
+                             "sliding_window", None) is not None:
+        use_cache = False
     params = get_params(model)
     buffers = get_buffers(model)
     frozen = get_frozen(model)
@@ -68,8 +74,9 @@ def generate(model, input_ids, max_new_tokens: int,
         if temperature and temperature > 0:
             key, sub = jax.random.split(key)
             scaled = cur / jnp.float32(temperature)
-            if top_k and top_k > 0:
-                kth = jnp.sort(scaled, axis=-1)[:, -int(top_k)]
+            k_eff = min(int(top_k), cur.shape[-1]) if top_k else 0
+            if k_eff > 0:
+                kth = jnp.sort(scaled, axis=-1)[:, -k_eff]
                 scaled = jnp.where(scaled >= kth[:, None], scaled,
                                    -jnp.inf)
             nxt = jax.random.categorical(sub, scaled, axis=-1)
@@ -136,6 +143,23 @@ def generate(model, input_ids, max_new_tokens: int,
          jnp.zeros((b, total - s), ids.dtype)], axis=1)
     key = jax.random.PRNGKey(int(seed))
     decode = decode_cached if use_cache else decode_padded
+    # jit cache keyed on the model + every trace-baked static: a fresh
+    # jax.jit(closure) per call would retrace the whole decode loop
+    # every generate() invocation
+    sig = (use_cache, b, s, total, float(temperature), int(top_k),
+           eos_token_id, str(ids.dtype))
+    per_model = _jit_cache.setdefault(model, {})
+    fn = per_model.get(sig)
+    if fn is None:
+        fn = jax.jit(decode)
+        per_model[sig] = fn
     with tape_mod.no_grad_guard():
-        out = jax.jit(decode)(params, padded, key)
+        out = fn(params, padded, key)
     return wrap(out)
+
+
+# model -> {static signature -> jitted decode}; weak keys so a dropped
+# model releases its compiled executables
+import weakref  # noqa: E402
+
+_jit_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()

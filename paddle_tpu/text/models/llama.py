@@ -346,18 +346,21 @@ class LlamaForCausalLM(Layer):
             self.lm_head = Linear(config.hidden_size, config.vocab_size,
                                   bias_attr=False)
 
+    def _head(self, h):
+        if self.lm_head is not None:
+            return self.lm_head(h)
+        w = self.llama.embed_tokens.weight
+
+        def tied(hh, ww):
+            return jnp.einsum("bsh,vh->bsv", hh, ww)
+        return run_op("tied_lm_head", tied, [h, w])
+
     def forward(self, input_ids, labels=None, kv_caches=None,
                 cache_index=None):
         if kv_caches is not None:
             h, new_caches = self.llama(input_ids, kv_caches=kv_caches,
                                        cache_index=cache_index)
-            if self.lm_head is not None:
-                return self.lm_head(h), new_caches
-            w = self.llama.embed_tokens.weight
-
-            def tied(hh, ww):
-                return jnp.einsum("bsh,vh->bsv", hh, ww)
-            return run_op("tied_lm_head", tied, [h, w]), new_caches
+            return self._head(h), new_caches
         h = self.llama(input_ids)
         if labels is not None and self.config.fused_linear_ce:
             from ...incubate.nn.functional import fused_linear_cross_entropy
@@ -367,13 +370,7 @@ class LlamaForCausalLM(Layer):
                 # tied head: Linear layout is [H, V]; embedding is [V, H]
                 w = self.llama.embed_tokens.weight.t()
             return fused_linear_cross_entropy(h, w, labels)
-        if self.lm_head is not None:
-            return self.lm_head(h)
-        w = self.llama.embed_tokens.weight
-
-        def tied(hh, ww):
-            return jnp.einsum("bsh,vh->bsv", hh, ww)
-        return run_op("tied_lm_head", tied, [h, w])
+        return self._head(h)
 
     def num_params(self):
         return sum(math.prod(p.shape) for _, p in self.named_parameters())
