@@ -57,6 +57,14 @@ def generate(model, input_ids, max_new_tokens: int,
     if use_cache and getattr(getattr(model, "config", None),
                              "sliding_window", None) is not None:
         use_cache = False
+    if use_cache:
+        import inspect
+        try:
+            sig = inspect.signature(model.forward)
+            if "kv_caches" not in sig.parameters:
+                use_cache = False  # model-agnostic padded fallback
+        except (TypeError, ValueError):
+            use_cache = False
     params = get_params(model)
     buffers = get_buffers(model)
     frozen = get_frozen(model)

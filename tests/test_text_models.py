@@ -394,3 +394,20 @@ def test_generate_edge_cases():
     netw.eval()
     out = np.asarray(generate(netw, prompt, 4).numpy())
     assert out.shape == (1, 8)
+
+
+def test_generate_cacheless_model_falls_back():
+    """A causal LM without kv_caches support (ErnieMoE) generates via
+    the padded path automatically."""
+    from paddle_tpu.text import generate
+
+    paddle.seed(14)
+    cfg = ErnieMoEConfig.tiny(vocab=16, hidden=64, layers=2, heads=2,
+                              experts=2)
+    cfg.use_flash_attention = False
+    net = ErnieMoEForCausalLM(cfg)
+    net.eval()
+    prompt = paddle.to_tensor(np.array([[1, 2, 3]], np.int64))
+    out = np.asarray(generate(net, prompt, 4).numpy())
+    assert out.shape == (1, 7)
+    np.testing.assert_array_equal(out[:, :3], [[1, 2, 3]])
