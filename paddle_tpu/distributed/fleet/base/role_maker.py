@@ -69,4 +69,10 @@ class PaddleCloudRoleMaker:
         return self._training_role == "PSERVER"
 
 
-UserDefinedRoleMaker = PaddleCloudRoleMaker
+def __getattr__(name):  # pragma: no cover — import-path guidance
+    if name == "UserDefinedRoleMaker":
+        raise ImportError(
+            "import UserDefinedRoleMaker from paddle_tpu.distributed."
+            "fleet (the compat class with explicit role/server_endpoints "
+            "args); the env-driven class here is PaddleCloudRoleMaker")
+    raise AttributeError(name)

@@ -138,6 +138,13 @@ class PipelineParallel(MetaParallelBase):
         S = self._pp
         if S <= 1 or self.vpp_degree > 1:
             return False
+        # only EXPLICIT per-stage size lists opt into the het schedule:
+        # it trades generality (float-only single input, no buffers, no
+        # shared layers) for honoring exact bounds. "layer:Cls" configs
+        # keep the homogeneous-run schedule (uniform chunks + warning) —
+        # models with integer inputs/embeddings rely on that path
+        if not isinstance(pl._seg_method, (list, tuple)):
+            return False
         return pl._stage_bounds != _uniform_bounds(len(pl._items), S)
 
     # -- functional state ----------------------------------------------------
@@ -167,17 +174,18 @@ class PipelineParallel(MetaParallelBase):
         # the stacked-param schedule always carves the homogeneous run
         # into uniform chunks; warn when the user asked for something else
         uniform = _uniform_bounds(len(pl._items), S)
-        if S > 1 and V > 1 and pl._stage_bounds != uniform and \
-                pl._seg_method != "uniform":
-            # V == 1 non-uniform bounds take the het_pipeline path and
-            # never reach here (self._het)
+        if S > 1 and pl._stage_bounds != uniform and \
+                pl._seg_method != "uniform" and \
+                (V > 1 or not isinstance(pl._seg_method, (list, tuple))):
+            # explicit list bounds at V == 1 take the het_pipeline path
+            # and never reach here (self._het)
             import warnings
             warnings.warn(
-                "interleaved (VPP) schedule uses uniform chunks over the "
+                "compiled schedule uses uniform chunks over the "
                 f"homogeneous run [{lo}:{hi}]; seg_method="
-                f"{pl._seg_method!r} stage bounds {pl._stage_bounds} "
-                "apply only with vpp_degree=1 (het schedule)",
-                stacklevel=3)
+                f"{pl._seg_method!r} stage bounds {pl._stage_bounds} are "
+                "honored only by the het schedule (explicit per-stage "
+                "size list, vpp_degree=1)", stacklevel=3)
         items = pl._items
         blocks = [items[i] for i in range(lo, hi)]
         chunk = len(blocks) // (S * V) if S and blocks else 0
@@ -587,9 +595,13 @@ class PipelineParallel(MetaParallelBase):
         if cached is None:
             entry = self._make_step_het(opt, loss_fn)
             self._compiled[sig] = (entry, opt, loss_fn)
-            if not hasattr(self, "_opt_state"):
+            if getattr(self, "_opt_state_owner", None) is not opt:
+                # fresh optimizer object -> fresh state (reusing the
+                # previous optimizer's pytree would feed e.g. SGD-shaped
+                # state into AdamW, or silently keep stale moments)
                 self._opt_state = opt.init_state_pytree(
                     {"het": self._het_vec})
+                self._opt_state_owner = opt
         else:
             entry = cached[0]
         key = random_mod.next_key()
@@ -632,8 +644,9 @@ class PipelineParallel(MetaParallelBase):
         if cached is None:
             entry = self._make_step(opt, loss_fn)
             self._compiled[sig] = (entry, opt, loss_fn)
-            if not hasattr(self, "_opt_state"):
+            if getattr(self, "_opt_state_owner", None) is not opt:
                 self._opt_state = opt.init_state_pytree(self._flat_params())
+                self._opt_state_owner = opt
         else:
             entry = cached[0]
         pre_p, stacked, post_p, frozen, meta = self._ensure_state()

@@ -255,22 +255,35 @@ class PSOptimizer:
     and merge deltas with the server every k steps.
     """
 
-    def __init__(self, inner, k_steps: int = 0):
+    def __init__(self, inner, k_steps: int = 0, embeddings=None):
         self._inner_opt = inner
         self._k_steps = int(k_steps)
         self._step_n = 0
-        if self._k_steps > 0:
-            for emb in _state["embeddings"]:
-                emb._geo = True
+        # each optimizer OWNS a set of embeddings: explicit list, else
+        # every unclaimed embedding in the process (and, in step(),
+        # unclaimed ones created later). Two models with different
+        # optimizers in one process must not flip each other's mode or
+        # push each other's rows.
+        self._embeddings = []
+        for emb in (embeddings if embeddings is not None
+                    else _state["embeddings"]):
+            self._claim(emb)
+
+    def _claim(self, emb):
+        if getattr(emb, "_owner", None) is None or emb._owner() is None:
+            import weakref
+            emb._owner = weakref.ref(self)
+            emb._geo = self._k_steps > 0
+            self._embeddings.append(emb)
 
     def step(self):
         for emb in _state["embeddings"]:
-            if self._k_steps > 0:
-                emb._geo = True  # embeddings built after the optimizer
+            self._claim(emb)  # embeddings built after the optimizer
+        for emb in self._embeddings:
             emb.push_grads()
         self._step_n += 1
         if self._k_steps > 0 and self._step_n % self._k_steps == 0:
-            for emb in _state["embeddings"]:
+            for emb in self._embeddings:
                 emb.sync_geo()
         if self._inner_opt is not None:
             self._inner_opt.step()

@@ -31,6 +31,10 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
     if quant_method not in (None, "None", "none"):
         raise NotImplementedError(
             "fused_moe quant_method is not supported on TPU")
+    if ffn1_scale is not None or ffn2_scale is not None:
+        raise NotImplementedError(
+            "fused_moe dequantization scales require a quant_method, "
+            "which is not supported on TPU")
 
     def fn(xx, gl, w1, w2, *rest):
         b1 = rest[0] if ffn1_bias is not None else None

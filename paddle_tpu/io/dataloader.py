@@ -364,7 +364,11 @@ class DataLoader:
         try:
             for item in it:
                 buf.append(stage(item))
-                if len(buf) > self.prefetch_factor:
+                # keep at most prefetch_factor batches IN FLIGHT beyond
+                # the one being yielded (>=, not >: fetching one extra
+                # before the first yield would add a whole batch of
+                # first-step latency on live/streaming datasets)
+                if len(buf) >= self.prefetch_factor:
                     yield buf.popleft()
             while buf:
                 yield buf.popleft()

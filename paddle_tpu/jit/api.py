@@ -75,13 +75,18 @@ class StaticFunction:
         # signatures keep their compiled entries. A pin is dropped (and
         # compilation retried) every _RETRY_AFTER fallback calls, so a
         # signature that traced badly once — e.g. before a warmup flag
-        # flipped — is not condemned to eager forever
+        # flipped — is not condemned to eager forever. After
+        # _MAX_RETRIES failed retries the pin becomes permanent — a
+        # genuinely value-dependent branch must not pay a guaranteed-to-
+        # fail re-trace every 16th call for the life of the process
         self._eager_sigs = {}
+        self._retry_counts = {}
         self._child_sf = None  # lazily-built per-sublayer compilers
         self._warned_break = False
         functools.update_wrapper(self, self._fn)
 
     _RETRY_AFTER = 16
+    _MAX_RETRIES = 3
 
     @property
     def layer(self):
@@ -201,12 +206,16 @@ class StaticFunction:
         sig = _sig_of(tensor_args, static_kwargs)
         pinned = self._eager_sigs.get(sig)
         if pinned is not None:
-            if pinned + 1 < self._RETRY_AFTER:
-                self._eager_sigs[sig] = pinned + 1
+            if (pinned + 1 < self._RETRY_AFTER
+                    or self._retry_counts.get(sig, 0)
+                    >= self._MAX_RETRIES):
+                if pinned + 1 < self._RETRY_AFTER:
+                    self._eager_sigs[sig] = pinned + 1
                 return self._fallback_call(args, kwargs)
             # the branch value (or a warmup flag) may have changed since
             # the pin: drop it and give the full graph another chance
             del self._eager_sigs[sig]
+            self._retry_counts[sig] = self._retry_counts.get(sig, 0) + 1
         entry = self._cache.get(sig)
         if self._layer is None:
             if entry is None:

@@ -56,11 +56,30 @@ class _Exporter:
 
     # -- per-layer emitters -------------------------------------------------
     def linear(self, lyr, x, shape):
+        w = _np(lyr.weight)  # [in, out]
+        in_f = w.shape[0]
         if len(shape) > 2:
+            tail = int(np.prod(shape[1:]))
+            if shape[-1] == in_f:
+                # paddle Linear contracts the LAST dim on rank>2 inputs:
+                # rank-preserving MatMul (+ Add for bias) — Gemm/Flatten
+                # would contract prod(shape[1:]) and be silently wrong
+                wn = self.name("w")
+                self.add_init(wn, w)
+                out = self.emit("MatMul", [x, wn])
+                if lyr.bias is not None:
+                    bn = self.name("b")
+                    self.add_init(bn, _np(lyr.bias))
+                    out = self.emit("Add", [out, bn])
+                return out, list(shape[:-1]) + [w.shape[1]]
+            if tail != in_f:
+                raise NotImplementedError(
+                    f"onnx.export: Linear(in={in_f}) fed a rank-"
+                    f"{len(shape)} activation of shape {shape}: neither "
+                    "the last dim nor the flattened width matches")
             x = self.emit("Flatten", [x],
                           P._attr_wrap([P.attr_int("axis", 1)]))
-            shape = [shape[0], int(np.prod(shape[1:]))]
-        w = _np(lyr.weight)  # [in, out] — ONNX Gemm B, transB=0
+            shape = [shape[0], tail]
         wn = self.name("w")
         self.add_init(wn, w)
         ins = [x, wn]

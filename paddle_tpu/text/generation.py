@@ -69,12 +69,13 @@ def generate(model, input_ids, max_new_tokens: int,
     buffers = get_buffers(model)
     frozen = get_frozen(model)
 
-    def fwd(p, tokens, caches=None, index=None):
+    def fwd(st, tokens, caches=None, index=None):
+        p, buf, frz = st
         kwargs = {}
         if caches is not None:
             kwargs = {"kv_caches": caches, "cache_index": index}
-        out, _ = functional_call(model, p, buffers, (tokens,), kwargs,
-                                 frozen=frozen, training=False)
+        out, _ = functional_call(model, p, buf, (tokens,), kwargs,
+                                 frozen=frz, training=False)
         return out
 
     def pick_next(cur, done, key, dtype):
@@ -97,10 +98,10 @@ def generate(model, input_ids, max_new_tokens: int,
             done = jnp.logical_or(done, nxt == pad)
         return nxt, done, key
 
-    def decode_padded(p, tokens, key):
+    def decode_padded(st, tokens, key):
         def step(carry, i):
             tokens, done, key = carry
-            logits = fwd(p, tokens)                     # [B, L, V]
+            logits = fwd(st, tokens)                     # [B, L, V]
             cur = jax.lax.dynamic_index_in_dim(
                 jnp.swapaxes(logits, 0, 1), i - 1, 0, keepdims=False)
             nxt, done, key = pick_next(cur, done, key, tokens.dtype)
@@ -114,7 +115,7 @@ def generate(model, input_ids, max_new_tokens: int,
             jnp.arange(s, total, dtype=jnp.int32))
         return tokens
 
-    def decode_cached(p, tokens, key):
+    def decode_cached(st, tokens, key):
         cfg = model.config
         hkv = cfg.num_key_value_heads
         hd = cfg.hidden_size // cfg.num_attention_heads
@@ -123,7 +124,7 @@ def generate(model, input_ids, max_new_tokens: int,
              jnp.zeros((b, total, hkv, hd), jnp.float32))
             for _ in range(cfg.num_hidden_layers)]
         # prefill the prompt (writes cache slots [0, s))
-        logits, caches = fwd(p, tokens[:, :s], caches, jnp.int32(0))
+        logits, caches = fwd(st, tokens[:, :s], caches, jnp.int32(0))
         done0 = jnp.zeros((b,), bool)
         nxt, done, key = pick_next(logits[:, -1], done0, key,
                                    tokens.dtype)
@@ -134,7 +135,7 @@ def generate(model, input_ids, max_new_tokens: int,
             tokens, caches, done, key = carry
             cur_tok = jax.lax.dynamic_slice(tokens, (jnp.int32(0), i),
                                             (b, 1))
-            logits, caches = fwd(p, cur_tok, caches, i)
+            logits, caches = fwd(st, cur_tok, caches, i)
             nxt, done, key = pick_next(logits[:, -1], done, key,
                                        tokens.dtype)
             tokens = jax.lax.dynamic_update_slice(
@@ -161,8 +162,12 @@ def generate(model, input_ids, max_new_tokens: int,
     if fn is None:
         fn = jax.jit(decode)
         per_model[sig] = fn
+    # params AND buffers AND frozen params ride as jit arguments —
+    # closure-captured state would bake the FIRST call's weights into
+    # the cached executable (stale after set_state_dict on a frozen
+    # model)
     with tape_mod.no_grad_guard():
-        out = fn(params, padded, key)
+        out = fn((params, buffers, frozen), padded, key)
     return wrap(out)
 
 

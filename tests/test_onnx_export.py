@@ -76,6 +76,10 @@ def _run_onnx(model, x):
             tgt = [ins[0].shape[i] if d == 0 else int(d)
                    for i, d in enumerate(ins[1])]
             y = ins[0].reshape(tgt)
+        elif op == "MatMul":
+            y = ins[0] @ ins[1]
+        elif op == "Add":
+            y = ins[0] + ins[1]
         elif op == "Relu":
             y = np.maximum(ins[0], 0)
         elif op == "Tanh":
@@ -203,3 +207,24 @@ def test_onnx_export_partial_flatten_reshape(tmp_path):
     want = np.asarray(net(paddle.to_tensor(x)).numpy())
     assert got.shape == want.shape == (2, 60)
     np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_onnx_export_rank3_linear_matmul(tmp_path):
+    """paddle Linear contracts the LAST dim of rank>2 inputs; the
+    exporter must emit a rank-preserving MatMul (+Add), not
+    Flatten+Gemm (code-review r4 finding)."""
+    paddle.seed(5)
+    net = nn.Sequential(nn.Linear(8, 4), nn.ReLU(), nn.Linear(4, 2))
+    net.eval()
+    fname = paddle.onnx.export(
+        net, str(tmp_path / "r3"),
+        input_spec=[paddle.jit.InputSpec([2, 3, 8], "float32")])
+    model = P.parse_model(open(fname, "rb").read())
+    ops = [n["op_type"] for n in model["graph"]["nodes"]]
+    assert ops == ["MatMul", "Add", "Relu", "MatMul", "Add"]
+    x = np.random.default_rng(5).standard_normal(
+        (2, 3, 8)).astype(np.float32)
+    got = _run_onnx(model, x)
+    want = np.asarray(net(paddle.to_tensor(x)).numpy())
+    assert got.shape == want.shape == (2, 3, 2)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
