@@ -261,3 +261,46 @@ def test_fleet_ps_geo_async_mode():
         np.testing.assert_allclose(merged, local, rtol=1e-6)
     finally:
         fleet.stop_worker()
+
+
+def test_fleet_ps_two_optimizers_do_not_cross():
+    """Each PSOptimizer owns its embeddings: a geo-async optimizer for
+    one model must not flip another model's embeddings into geo mode or
+    push their rows (code-review r4 finding)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.ps import PSSparseEmbedding, fleet_ps
+    from paddle_tpu.distributed.ps.fleet_ps import PSOptimizer
+
+    port = _free_port()
+    rm = fleet.UserDefinedRoleMaker(
+        current_id=0, role=fleet.Role.WORKER, worker_num=1,
+        server_endpoints=[f"127.0.0.1:{port}"])
+    fleet.init(rm)
+    fleet_ps.init_loopback(f"127.0.0.1:{port}")
+    try:
+        emb_a = PSSparseEmbedding(10, 2, "iso_a", lr=0.5)
+        opt_a = PSOptimizer(None, k_steps=4)        # geo, claims emb_a
+        emb_b = PSSparseEmbedding(10, 2, "iso_b", lr=0.5)
+        opt_b = PSOptimizer(None)                   # sync, claims emb_b
+        # claiming is exclusive and mode-correct
+        assert emb_a._geo and emb_a in opt_a._embeddings
+        opt_a.step()   # also sweeps unclaimed embeddings
+        assert not emb_b._geo, "geo optimizer flipped another model's emb"
+        assert emb_b not in opt_a._embeddings
+        assert emb_b in opt_b._embeddings
+
+        # a sync step on B pushes immediately; A's rows stay cached
+        ids = np.array([3], np.int64)
+        ta = emb_a(paddle.to_tensor(ids))
+        tb = emb_b(paddle.to_tensor(ids))
+        (ta.sum() + tb.sum()).backward()
+        opt_b.step()
+        opt_a.step()
+        rows_b = fleet_ps.client().pull_sparse("iso_b", [3])
+        rows_a = fleet_ps.client().pull_sparse("iso_a", [3])
+        assert np.abs(rows_b).sum() > 0        # B pushed to the server
+        np.testing.assert_allclose(rows_a, 0)  # A still local (geo)
+        assert np.abs(emb_a._local[3]).sum() > 0
+    finally:
+        fleet.stop_worker()
