@@ -109,7 +109,7 @@ def test_fleet_ps_mode_ctr_smoke():
         loss_fn = __import__("paddle_tpu.nn", fromlist=["BCEWithLogitsLoss"]
                              ).BCEWithLogitsLoss()
         losses = []
-        for _ in range(40):
+        for _ in range(25):
             ids = paddle.to_tensor(ids_np)
             feat = emb(ids)                      # [16, 3, dim] via PS
             logits = dense(feat.sum(axis=1))     # [16, 1]
@@ -118,7 +118,7 @@ def test_fleet_ps_mode_ctr_smoke():
             opt.step()
             opt.clear_grad()
             losses.append(float(loss.numpy()))
-        assert losses[-1] < losses[0] * 0.6, losses[::10]
+        assert losses[-1] < losses[0] * 0.7, losses[::8]
         # the embedding rows really live server-side and were trained
         rows = fleet_ps.client().pull_sparse(
             "ctr_emb", list(np.unique(ids_np)))

@@ -117,7 +117,11 @@ def test_bert_pretraining_heads():
     assert np.isfinite(l0)
 
 
+@pytest.mark.nightly
 def test_ernie_moe_train():
+    """Nightly: compile-heavy; default-run MoE coverage lives in
+    test_moe.py (gating/dispatch/TrainStep on the ep mesh) and the
+    ErnieMoE bench/generation smokes."""
     paddle.seed(4)
     prev = mesh_mod.get_mesh()
     mesh_mod.set_mesh(mesh_mod.build_mesh({"dp": 2, "ep": 4}))
