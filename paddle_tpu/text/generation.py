@@ -1,9 +1,11 @@
 """Autoregressive generation for the text model family.
 
-The reference core framework leaves generation to its NLP suite (the
-fused decode ops like masked_multihead_attention exist only as CUDA
-kernels, ops.yaml N/A set); here a TPU-idiomatic v1 ships with the
-models: the WHOLE decode loop is one compiled program — ``lax.scan``
+The reference core framework leaves generation to its NLP suite — it
+ships only the fused CUDA decode primitives (python/paddle/incubate/nn/
+functional/masked_multihead_attention.py:27, the KV-cache decode-step
+attention; ops.yaml N/A set here). TPU-native, generation ships with
+the models and the decode-step attention is the kv-cache branch of
+LlamaAttention: the WHOLE decode loop is one compiled program — ``lax.scan``
 over decode steps inside a single ``jax.jit``, operating on a
 statically padded token buffer. Each step runs the causal forward over
 the padded buffer and reads the logits at the current position; causal
