@@ -252,6 +252,12 @@ def pipeline_apply_zb(block_f: Callable, stacked_params: Any,
     at the vjp-jaxpr level and hides the weight-grad ticks under other
     stages' dx ticks — the compiled counterpart of the reference's
     pipeline_zero_bubble.py:62 ZBH1 pass.
+
+    Known cost: every stacked_params leaf is differentiated by the
+    custom_vjp, so FROZEN block params still get weight-grad W-tick
+    compute whose cotangents the outer graph then discards (the
+    autodiff FThenB/VPP paths differentiate only the trainable stack).
+    Prefer FThenB/VPP for pipelines with mostly-frozen blocks.
     """
     from . import mesh as mesh_mod
     from .zero_bubble import zb_local
