@@ -335,6 +335,12 @@ class TrainStep:
                 cast_params = jax.tree_util.tree_map(
                     lambda a: a.astype(amp_dtype)
                     if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
+                # O2 semantics: float inputs run in the compute dtype too
+                # (lax.conv rejects mixed fp32-input/bf16-weight; labels
+                # stay untouched for the loss)
+                inputs = jax.tree_util.tree_map(
+                    lambda a: a.astype(amp_dtype)
+                    if jnp.issubdtype(a.dtype, jnp.floating) else a, inputs)
             else:
                 cast_params = params
             out, new_buf = functional_call(
@@ -347,11 +353,7 @@ class TrainStep:
                 lab_t = jax.tree_util.tree_map(
                     lambda a: Tensor._from_array(a), labels,
                     is_leaf=lambda a: isinstance(a, jax.Array))
-                if isinstance(out_t, (list, tuple)) or \
-                        isinstance(lab_t, (list, tuple)):
-                    loss = loss_fn(out_t, lab_t)
-                else:
-                    loss = loss_fn(out_t, lab_t)
+                loss = loss_fn(out_t, lab_t)
             return unwrap(loss).astype(jnp.float32), new_buf
 
         def step(params, buffers, frozen, opt_state, key, lr, inputs,

@@ -388,3 +388,25 @@ def test_dataloader_buffer_reader_prefetch(monkeypatch):
     it = iter(DataLoader(ds, batch_size=4, use_buffer_reader=True))
     next(it)
     del it
+
+
+def test_train_step_amp_casts_float_inputs():
+    """amp_dtype must cast float INPUTS, not just params (O2 semantics):
+    lax.conv rejects a fp32 image against bf16 weights — the exact
+    failure bench_resnet50 hit on the real chip."""
+    paddle.seed(0)
+    net = nn.Sequential(
+        nn.Conv2D(3, 4, 3, padding=1), nn.ReLU(),
+        nn.AdaptiveAvgPool2D(1), nn.Flatten(), nn.Linear(4, 10))
+    opt = paddle.optimizer.Momentum(0.1, parameters=net.parameters())
+    step = paddle.jit.TrainStep(net, nn.CrossEntropyLoss(), opt,
+                                amp_dtype="bfloat16")
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((4, 3, 8, 8)).astype(
+        np.float32))
+    y = paddle.to_tensor(rng.integers(0, 10, 4).astype(np.int64))
+    l0 = float(step(x, y).numpy())
+    l1 = float(step(x, y).numpy())
+    assert np.isfinite(l0) and np.isfinite(l1)
+    # master params stay fp32
+    assert str(net[0].weight.dtype).endswith("float32")
