@@ -3,16 +3,17 @@
 Headline: 1B-class LLaMA causal-LM training on the real chip
 (BASELINE.md config-4 family): tokens/sec/chip and achieved MFU vs the
 north-star 50% target; vs_baseline = achieved_MFU / 0.50. The config is
-the measured-best shape for one v5e chip from the round-3 sweep —
-LLaMA-7B layer geometry (4096 hidden / 11008 FFN) at 4 layers, 1.07B
-params, AdamW fp32 + bf16 compute, selective recompute (attn_core +
-ffn_mid saved), the tuned Pallas flash-attention kernel (256x512 blocks;
-3.3x faster than the XLA softmax path at seq 4096, and the better path
-from seq 1024 up), whole-step jit with donated buffers.
+the measured-best shape for one v5e chip from the round-4 sweep
+(docs/PERF.md) — LLaMA-7B layer geometry (4096 hidden / 11008 FFN) at
+4 layers, 1.07B params, batch 12 / seq 1024, AdamW bf16 moments + bf16
+compute, NO recompute + chunked fused lm-head+CE (the logits tensor is
+never materialized), the tuned Pallas flash-attention kernel (256x512
+blocks), whole-step jit with donated buffers: 0.713 MFU measured.
 
 Extras carried in the same line: the long-sequence point (seq 2048),
-the round-2 small-model number (hidden 2048 x 4L @ seq 512), and the
-LeNet compiled-vs-eager pair (BASELINE config 1).
+the round-2 small-model number (hidden 2048 x 4L @ seq 512), the LeNet
+compiled-vs-eager pair (BASELINE config 1), BERT-base and ERNIE-MoE
+throughput (configs 3/5), and ResNet-50 images/sec (config 2).
 
 MFU = tokens/sec x train FLOPs/token / peak chip FLOP/s, FLOPs/token =
 6N (llama_flops_per_token). Peak per device kind below (bf16); unknown
@@ -69,30 +70,41 @@ def _time_steps(step_fn, n, groups=2):
     return best_dt
 
 
+def llama_step_io(cfg, ids, labels):
+    """(loss_fn, step-inputs) for a LlamaConfig — shared by the bench
+    and tools/mfu_sweep.py so both measure the identical path. With
+    fused_linear_ce the model computes its own chunked head-matmul+CE
+    loss (labels ride along as a forward input) and loss_fn passes the
+    scalar through."""
+    import paddle_tpu.nn as nn
+    if cfg.fused_linear_ce:
+        return (lambda out, lab: out), (ids, labels)
+    return nn.CrossEntropyLoss(), ids
+
+
 def _llama_run(cfg, batch, seq, n_steps=6, moment_dtype="bfloat16"):
     import paddle_tpu as paddle
-    import paddle_tpu.nn as nn
     from paddle_tpu.text.models import (LlamaForCausalLM,
                                         llama_flops_per_token)
 
     paddle.seed(0)
     net = LlamaForCausalLM(cfg)
-    loss_fn = nn.CrossEntropyLoss()
     # bf16 AdamW moments (fp32 master weights + update math): frees
-    # ~4.3 GB of HBM on the 1B config — the round-4 lever that bought
-    # batch 8 at seq 1024 (0.57 -> 0.64 MFU measured sweep)
-    opt = paddle.optimizer.AdamW(3e-4, parameters=net.parameters(),
-                                 moment_dtype=moment_dtype)
-    step = paddle.jit.TrainStep(net, loss_fn, opt, amp_dtype="bfloat16")
+    # ~4.3 GB of HBM on the 1B config (docs/PERF.md has the full
+    # round-4 sweep this config family came from)
     rng = np.random.default_rng(0)
     ids = paddle.to_tensor(
         rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
     labels = paddle.to_tensor(
         rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
+    loss_fn, inputs = llama_step_io(cfg, ids, labels)
+    opt = paddle.optimizer.AdamW(3e-4, parameters=net.parameters(),
+                                 moment_dtype=moment_dtype)
+    step = paddle.jit.TrainStep(net, loss_fn, opt, amp_dtype="bfloat16")
 
-    step(ids, labels)                       # compile
-    float(step(ids, labels).numpy())        # warm
-    dt = _time_steps(lambda: step(ids, labels), n_steps)
+    step(inputs, labels)                    # compile
+    float(step(inputs, labels).numpy())     # warm
+    dt = _time_steps(lambda: step(inputs, labels), n_steps)
     tokens_per_sec = batch * seq / dt
     peak, kind = _peak()
     mfu = tokens_per_sec * llama_flops_per_token(cfg) / peak
@@ -103,30 +115,34 @@ def _llama_run(cfg, batch, seq, n_steps=6, moment_dtype="bfloat16"):
 def bench_llama_1b():
     """Headline: 1.07B params (LLaMA-7B layer shapes), seq 1024.
 
-    Round-4 measured-best single-chip config: batch 8 (bf16 optimizer
-    moments buy the HBM headroom), selective_qkv recompute (backward
-    recomputes no matmuls), tuned Pallas flash blocks.
+    Round-4 measured-best single-chip config (tools/mfu_sweep.py, real
+    v5e): batch 12, NO recompute, chunked fused lm-head+CE
+    (fused_linear_ce — never materializes the [12288, 32000] logits),
+    bf16 optimizer moments. The fused CE frees enough HBM that backward
+    reuses every saved activation instead of recomputing: 0.650 (b8,
+    selective_qkv) -> 0.713 MFU measured.
     """
     from paddle_tpu.text.models import LlamaConfig
     cfg = LlamaConfig(
         vocab_size=32000, hidden_size=4096, intermediate_size=11008,
         num_hidden_layers=4, num_attention_heads=32,
         num_key_value_heads=32, max_position_embeddings=1024,
-        recompute=True, recompute_granularity="selective_qkv",
+        recompute=False, fused_linear_ce=True,
         use_flash_attention=True)
-    return _llama_run(cfg, batch=8, seq=1024)
+    return _llama_run(cfg, batch=12, seq=1024)
 
 
 def bench_llama_long_seq():
-    """Same 1.07B model at seq 2048 (long-context point, VERDICT r2 #2)."""
+    """Same 1.07B model at seq 2048 (long-context point, VERDICT r2 #2).
+    Measured-best: batch 6, no recompute, fused CE — 0.685 MFU."""
     from paddle_tpu.text.models import LlamaConfig
     cfg = LlamaConfig(
         vocab_size=32000, hidden_size=4096, intermediate_size=11008,
         num_hidden_layers=4, num_attention_heads=32,
         num_key_value_heads=32, max_position_embeddings=2048,
-        recompute=True, recompute_granularity="selective_qkv",
+        recompute=False, fused_linear_ce=True,
         use_flash_attention=True)
-    return _llama_run(cfg, batch=4, seq=2048)
+    return _llama_run(cfg, batch=6, seq=2048)
 
 
 def bench_llama_small():
@@ -211,6 +227,31 @@ def bench_ernie_moe(cfg=None, batch=8, seq=512, n_steps=6):
     float(step(ids, labels).numpy())
     dt = _time_steps(lambda: step(ids, labels), n_steps)
     return batch * seq / dt
+
+
+def bench_resnet50(batch=256, n_steps=10):
+    """ResNet-50 ImageNet-shape train step (BASELINE config 2 metric:
+    images/sec, single chip — the 8->64-chip scaling axis is covered by
+    the dryrun's dp config). bf16 AMP, momentum-SGD, NCHW 224x224
+    synthetic batch (XLA picks its own device layout)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    net = resnet50(num_classes=1000)
+    loss_fn = nn.CrossEntropyLoss()
+    opt = paddle.optimizer.Momentum(0.1, momentum=0.9,
+                                    parameters=net.parameters())
+    step = paddle.jit.TrainStep(net, loss_fn, opt, amp_dtype="bfloat16")
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal(
+        (batch, 3, 224, 224)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 1000, batch).astype(np.int64))
+    step(x, y)
+    float(step(x, y).numpy())
+    dt = _time_steps(lambda: step(x, y), n_steps)
+    return batch / dt
 
 
 def bench_lenet():
@@ -317,17 +358,23 @@ def main():
         tok = bench_ernie_moe()
         result["extras"]["ernie_moe_tokens_per_sec"] = round(tok, 1)
 
+    def add_resnet():
+        ips = bench_resnet50()
+        result["extras"]["resnet50_images_per_sec"] = round(ips, 1)
+
     # (name, runner, wall-clock cost estimate in seconds: compile+measure
-    # on the tunneled chip, cold cache). BASELINE config-3/4/5 points
-    # first; lenet and the small-model continuity point take leftovers
+    # on the tunneled chip, cold cache — estimates from the round-4
+    # dress-rehearsal runs). Ordered so every BASELINE config (4-long-ctx,
+    # 3, 2, 5, 1) gets a point before the round-2 continuity shape.
     extras = [
         ("llama_seq2048", lambda: add_llama("llama_seq2048",
-                                            bench_llama_long_seq), 420),
+                                            bench_llama_long_seq), 300),
+        ("bert_base", add_bert, 180),
+        ("resnet50", add_resnet, 240),
+        ("ernie_moe", add_moe, 240),
+        ("lenet", add_lenet, 100),
         ("llama_small_seq512", lambda: add_llama("llama_small_seq512",
-                                                 bench_llama_small), 240),
-        ("lenet", add_lenet, 120),
-        ("bert_base", add_bert, 240),
-        ("ernie_moe", add_moe, 300),
+                                                 bench_llama_small), 180),
     ]
     skipped = []
     for name, run, est in extras:

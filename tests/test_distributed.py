@@ -266,6 +266,9 @@ def test_distributed_train_step_matches_single(hybrid_mesh):
     np.testing.assert_allclose(d_losses, ref_losses, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.nightly  # the driver runs this exact dryrun every round
+# (MULTICHIP_r0N.json); the default suite keeps the cheaper per-axis
+# mesh tests above as its multichip representatives.
 def test_dryrun_multichip_8():
     from paddle_tpu.distributed.dryrun import run_dryrun
     run_dryrun(8)

@@ -204,6 +204,8 @@ def test_fleet_ps_mode_two_process(tmp_path):
     assert "SERVER DONE" in out_s
 
 
+@pytest.mark.nightly  # sync-mode fleet PS smoke stays default;
+# geo-async adds ~7s of step pacing on the 1-core host
 def test_fleet_ps_geo_async_mode():
     """Geo-async PS (reference the_one_ps.py:203 geo accessor /
     strategy.a_sync k_steps): embeddings train in a local cache and

@@ -306,6 +306,9 @@ def test_llama_sliding_window_trains():
     assert np.isfinite(l1) and l1 < l0
 
 
+@pytest.mark.nightly  # the heaviest generate test (eager loop x
+# compiled scan); sampling/edge-case/cacheless generate tests stay
+# default
 def test_generate_matches_eager_greedy_loop():
     """The compiled decode scan (text.generation.generate) produces
     exactly the tokens a python loop of eager greedy steps produces."""
@@ -415,3 +418,35 @@ def test_generate_cacheless_model_falls_back():
     out = np.asarray(generate(net, prompt, 4).numpy())
     assert out.shape == (1, 7)
     np.testing.assert_array_equal(out[:, :3], [[1, 2, 3]])
+
+
+def test_llama_fused_ce_trainstep_matches_unfused():
+    """The headline-bench path: LlamaForCausalLM(fused_linear_ce=True)
+    computes its own loss in forward (labels become a model input and
+    loss_fn is a pass-through); the first TrainStep loss must match the
+    unfused lm_head + CrossEntropyLoss step bit-for-bit shape-wise and
+    numerically to fp32 tolerance."""
+    rng = np.random.default_rng(7)
+    x = _ids(rng, 2, 12, 128)
+    y = _ids(rng, 2, 12, 128)
+
+    paddle.seed(11)
+    net_u = LlamaForCausalLM(LlamaConfig.tiny())
+    opt_u = paddle.optimizer.AdamW(1e-3, parameters=net_u.parameters())
+    step_u = paddle.jit.TrainStep(net_u, nn.CrossEntropyLoss(), opt_u)
+    lu0 = float(step_u(x, y).numpy())
+    lu1 = float(step_u(x, y).numpy())
+
+    paddle.seed(11)
+    cfg = LlamaConfig.tiny()
+    cfg.fused_linear_ce = True
+    net_f = LlamaForCausalLM(cfg)
+    opt_f = paddle.optimizer.AdamW(1e-3, parameters=net_f.parameters())
+    step_f = paddle.jit.TrainStep(net_f, lambda out, lab: out, opt_f)
+    lf0 = float(step_f((x, y), y).numpy())
+    lf1 = float(step_f((x, y), y).numpy())
+
+    assert abs(lu0 - lf0) < 1e-4, (lu0, lf0)
+    # the second step sees grads through the fused path — the whole
+    # update (hidden AND head-weight grads) must match too
+    assert abs(lu1 - lf1) < 1e-3, (lu1, lf1)

@@ -157,6 +157,9 @@ def test_zb_bubble_below_gpipe():
     assert zb["bubble_fraction"] < 4 * vpp["bubble_fraction"]
 
 
+@pytest.mark.nightly  # ZBH1 autodiff-parity + bubble-accounting
+# tests stay default; the interleaved variant re-checks the same
+# dX/dW split over chunk placement
 def test_zbvpp_matches_reference_autodiff():
     """ZBVPP (interleaved + dX/dW split backward): loss and grads equal
     plain jax.grad through the sequential chunk composition."""

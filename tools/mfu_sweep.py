@@ -42,6 +42,8 @@ def main():
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--steps", type=int, default=6)
     ap.add_argument("--flash", type=int, default=1)
+    ap.add_argument("--fused_ce", type=int, default=0,
+                    help="1 = chunked fused lm-head+CE (no [T,V] logits)")
     args = ap.parse_args()
 
     from bench import (_enable_compile_cache, _peak, bench_bert,
@@ -51,9 +53,10 @@ def main():
     if args.model != "llama":
         ignored = [f for f, cur, dflt in [
             ("--recompute", args.recompute, "selective"),
-            ("--moments", args.moments, "bfloat16"),
+            ("--moments", args.moments, "float32"),
             ("--bq", args.bq, 0), ("--bk", args.bk, 0),
             ("--layers", args.layers, 4), ("--flash", args.flash, 1),
+            ("--fused_ce", args.fused_ce, 0),
         ] if cur != dflt]
         if ignored:
             print(f"note: {' '.join(ignored)} apply to --model llama "
@@ -75,7 +78,6 @@ def main():
         return
 
     import paddle_tpu as paddle
-    import paddle_tpu.nn as nn
     from paddle_tpu.kernels import flash_attention as fa
     from paddle_tpu.text.models import (LlamaConfig, LlamaForCausalLM,
                                         llama_flops_per_token)
@@ -92,30 +94,32 @@ def main():
         recompute=args.recompute != "none",
         recompute_granularity=(args.recompute
                                if args.recompute != "none" else "selective"),
-        use_flash_attention=bool(args.flash))
+        use_flash_attention=bool(args.flash),
+        fused_linear_ce=bool(args.fused_ce))
 
     paddle.seed(0)
     net = LlamaForCausalLM(cfg)
-    loss_fn = nn.CrossEntropyLoss()
-    moment_dtype = None if args.moments == "float32" else args.moments
-    opt = paddle.optimizer.AdamW(3e-4, parameters=net.parameters(),
-                                 moment_dtype=moment_dtype)
-    step = paddle.jit.TrainStep(net, loss_fn, opt, amp_dtype="bfloat16")
     rng = np.random.default_rng(0)
     ids = paddle.to_tensor(rng.integers(
         0, cfg.vocab_size, (args.batch, args.seq)).astype(np.int64))
     labels = paddle.to_tensor(rng.integers(
         0, cfg.vocab_size, (args.batch, args.seq)).astype(np.int64))
+    from bench import llama_step_io
+    loss_fn, inputs = llama_step_io(cfg, ids, labels)
+    moment_dtype = None if args.moments == "float32" else args.moments
+    opt = paddle.optimizer.AdamW(3e-4, parameters=net.parameters(),
+                                 moment_dtype=moment_dtype)
+    step = paddle.jit.TrainStep(net, loss_fn, opt, amp_dtype="bfloat16")
 
     t0 = time.perf_counter()
-    step(ids, labels)                   # compile
+    step(inputs, labels)                # compile
     compile_s = time.perf_counter() - t0
-    float(step(ids, labels).numpy())    # warm (fetch = the real sync)
+    float(step(inputs, labels).numpy())  # warm (fetch = the real sync)
     best_dt = float("inf")
     for _ in range(2):
         t0 = time.perf_counter()
         for _ in range(args.steps):
-            loss = step(ids, labels)
+            loss = step(inputs, labels)
         float(loss.numpy())
         best_dt = min(best_dt, (time.perf_counter() - t0) / args.steps)
     tokens_per_sec = args.batch * args.seq / best_dt
@@ -123,6 +127,7 @@ def main():
     mfu = tokens_per_sec * llama_flops_per_token(cfg) / peak
     print(json.dumps({
         "batch": args.batch, "seq": args.seq, "recompute": args.recompute,
+        "fused_ce": args.fused_ce,
         "moments": args.moments, "bq": args.bq or fa.BLOCK_Q,
         "bk": args.bk or fa.BLOCK_K, "layers": args.layers,
         "tokens_per_sec": round(tokens_per_sec, 1), "mfu": round(mfu, 4),
