@@ -280,9 +280,23 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse, causal, scale,
         lse_ref[...] = lse[:, :STAT_LANES].astype(lse_ref.dtype)
 
 
+def _kv_index_map(h, h_kv):
+    """Grid index (batch*q_head) → flat (batch*kv_head) block index.
+
+    GQA/MQA: q head ``qh`` reads kv head ``qh // rep`` — the kernels
+    never materialize the repeated K/V heads the way the XLA path (and
+    the reference's repeat_interleave) must. Identity when h == h_kv.
+    """
+    if h == h_kv:
+        return lambda i, j: (i, 0, 0)
+    rep = h // h_kv
+    return lambda i, j: ((i // h) * h_kv + (i % h) // rep, 0, 0)
+
+
 def _flash_pallas_fwd(q, k, v, causal, scale, interpret=False,
                       want_lse=True, window=None):
-    """q/k/v: [B, H, S, D] → (out [B, H, S, D], lse [B*H, S, STAT_LANES]).
+    """q: [B, H, S, D], k/v: [B, H_kv, S, D] (H_kv divides H; GQA served
+    in-kernel) → (out [B, H, S, D], lse [B*H, S, STAT_LANES]).
 
     want_lse=False (inference / non-differentiated primal) skips the lse
     output entirely — no extra HBM write; returns (out, None).
@@ -290,12 +304,13 @@ def _flash_pallas_fwd(q, k, v, causal, scale, interpret=False,
     from jax.experimental import pallas as pl
 
     b, h, sq, d = q.shape
-    sk = k.shape[2]
+    h_kv, sk = k.shape[1], k.shape[2]
     bq = _pick_block(BLOCK_Q, sq)
     bk = _pick_block(BLOCK_K, sk)
     qr = q.reshape(b * h, sq, d)
-    kr = k.reshape(b * h, sk, d)
-    vr = v.reshape(b * h, sk, d)
+    kr = k.reshape(b * h_kv, sk, d)
+    vr = v.reshape(b * h_kv, sk, d)
+    kv_map = _kv_index_map(h, h_kv)
 
     kernel = functools.partial(_flash_fwd_kernel, causal=causal, scale=scale,
                                block_k=bk, seq_k=sk, seq_q=sq,
@@ -314,8 +329,8 @@ def _flash_pallas_fwd(q, k, v, causal, scale, interpret=False,
             in_specs=[
                 # None squeezes the batch*head dim so refs are [S, D] tiles
                 pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
-                pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
-                pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((None, sk, d), kv_map),
+                pl.BlockSpec((None, sk, d), kv_map),
             ],
             out_specs=out_specs,
             out_shape=out_shape,
@@ -440,17 +455,19 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_pallas_bwd(q, k, v, do, lse, delta, causal, scale,
                       interpret=False, window=None):
-    """All [B, H, S, D] (lse/delta [B*H, S, STAT_LANES]) → dq, dk, dv."""
+    """q/do [B, H, S, D], k/v [B, H_kv, S, D] (lse/delta
+    [B*H, S, STAT_LANES]) → dq, dk, dv (dk/dv in the k/v GQA shape)."""
     from jax.experimental import pallas as pl
 
     b, h, sq, d = q.shape
-    sk = k.shape[2]
+    h_kv, sk = k.shape[1], k.shape[2]
     bq = _pick_block(BLOCK_Q, sq)
     bk = _pick_block(BLOCK_K, sk)
     qr = q.reshape(b * h, sq, d)
-    kr = k.reshape(b * h, sk, d)
-    vr = v.reshape(b * h, sk, d)
+    kr = k.reshape(b * h_kv, sk, d)
+    vr = v.reshape(b * h_kv, sk, d)
     dor = do.reshape(b * h, sq, d)
+    kv_map = _kv_index_map(h, h_kv)
 
     dq_kernel = functools.partial(
         _flash_bwd_dq_kernel, causal=causal, scale=scale, block_k=bk,
@@ -461,8 +478,8 @@ def _flash_pallas_bwd(q, k, v, do, lse, delta, causal, scale,
             grid=(b * h, sq // bq),
             in_specs=[
                 pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
-                pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
-                pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((None, sk, d), kv_map),
+                pl.BlockSpec((None, sk, d), kv_map),
                 pl.BlockSpec((None, bq, d), lambda i, j: (i, j, 0)),
                 pl.BlockSpec((None, bq, STAT_LANES), lambda i, j: (i, j, 0)),
                 pl.BlockSpec((None, bq, STAT_LANES), lambda i, j: (i, j, 0)),
@@ -481,12 +498,18 @@ def _flash_pallas_bwd(q, k, v, do, lse, delta, causal, scale,
             grid=(b * h, sk // bk),
             in_specs=[
                 pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),
-                pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
-                pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((None, bk, d),
+                             lambda i, j, _m=kv_map: (_m(i, j)[0], j, 0)),
+                pl.BlockSpec((None, bk, d),
+                             lambda i, j, _m=kv_map: (_m(i, j)[0], j, 0)),
                 pl.BlockSpec((None, sq, d), lambda i, j: (i, 0, 0)),
                 pl.BlockSpec((None, sq, STAT_LANES), lambda i, j: (i, 0, 0)),
                 pl.BlockSpec((None, sq, STAT_LANES), lambda i, j: (i, 0, 0)),
             ],
+            # per-q-head partials: rep programs share a kv head, so each
+            # writes its own (b*h)-indexed slot; the group-sum happens
+            # below in fp32 (exactly what repeat_interleave's VJP does,
+            # minus ever materializing repeated K/V in forward)
             out_specs=[
                 pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
                 pl.BlockSpec((None, bk, d), lambda i, j: (i, j, 0)),
@@ -497,8 +520,15 @@ def _flash_pallas_bwd(q, k, v, do, lse, delta, causal, scale,
             ],
             interpret=interpret,
         )(qr, kr, vr, dor, lse, delta)
-    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
-            dv.reshape(b, h, sk, d))
+    dq = dq.reshape(b, h, sq, d)
+    if h_kv != h:
+        rep = h // h_kv
+        dk = dk.reshape(b, h_kv, rep, sk, d).astype(jnp.float32) \
+            .sum(axis=2).astype(k.dtype)
+        dv = dv.reshape(b, h_kv, rep, sk, d).astype(jnp.float32) \
+            .sum(axis=2).astype(v.dtype)
+        return dq, dk, dv
+    return dq, dk.reshape(b, h, sk, d), dv.reshape(b, h, sk, d)
 
 
 # ---------------------------------------------------------------------------
@@ -552,6 +582,13 @@ _flash_pallas.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 # ---------------------------------------------------------------------------
 
 def _flash_xla(q, k, v, causal, scale, window=None):
+    if k.shape[1] != q.shape[1]:
+        # GQA on the fallback path: XLA has to materialize the repeated
+        # heads (the Pallas kernels index kv = qh // rep instead);
+        # repeat's VJP sums the group's cotangents for free
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     out_mask = None
     if causal:
@@ -588,10 +625,23 @@ def flash_attention_arrays(q, k, v, causal=False, scale=None,
                            window=None):
     """Array-level entry (paddle layout [B, S, H, D]).
 
+    GQA/MQA: k/v may carry fewer heads than q (H_kv dividing H) — the
+    Pallas kernels serve the group by index (no repeated-K/V
+    materialization, reference capability flash_attn GQA:
+    paddle/phi/kernels/gpu/flash_attn_kernel.cu num_heads_k); the XLA
+    fallback repeats internally.
+
     window: sliding-window (Mistral-style local) attention — each query
     sees at most the `window` most recent keys up to the causal
     diagonal. Requires causal=True; None = full attention.
     """
+    if k.shape[2] != v.shape[2]:
+        raise ValueError(
+            f"key heads ({k.shape[2]}) != value heads ({v.shape[2]})")
+    if k.shape[2] < 1 or q.shape[2] % k.shape[2] != 0:
+        raise ValueError(
+            f"GQA requires query heads ({q.shape[2]}) to be a multiple "
+            f"of key/value heads ({k.shape[2]})")
     if window is not None:
         window = int(window)
         if not causal:

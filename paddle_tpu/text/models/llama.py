@@ -155,11 +155,20 @@ class LlamaAttention(Layer):
             q = checkpoint_name(q, "attn_q")
             k = checkpoint_name(k, "attn_k")
             v = checkpoint_name(v, "attn_v")
-        if self.num_kv_heads != self.num_heads:
+        # decide the attention path ONCE: the flash entry serves GQA
+        # in-kernel (kv head = q head // rep); every other path needs
+        # the kv heads materialized via repeat
+        if mesh_mod.axis_degree("sep") > 1:
+            path = "ring"
+        elif self.use_flash or self.window is not None:
+            path = "flash"
+        else:
+            path = "sdpa"
+        if self.num_kv_heads != self.num_heads and path != "flash":
             rep = self.num_heads // self.num_kv_heads
             k = ops.manipulation.repeat_interleave(k, rep, axis=2)
             v = ops.manipulation.repeat_interleave(v, rep, axis=2)
-        if mesh_mod.axis_degree("sep") > 1:
+        if path == "ring":
             if self.window is not None:
                 raise NotImplementedError(
                     "sliding_window with sequence parallelism (sep>1) "
@@ -167,7 +176,7 @@ class LlamaAttention(Layer):
                     "causal attention")
             from ...kernels.ring_attention import ring_flash_attention
             out = ring_flash_attention(q, k, v, causal=True)
-        elif self.use_flash or self.window is not None:
+        elif path == "flash":
             from ...kernels.flash_attention import flash_attention
             out = flash_attention(q, k, v, causal=True,
                                   window=self.window)

@@ -248,3 +248,60 @@ def test_flash_sliding_window_multiblock_bounds(rng, window, monkeypatch):
     for a, b_ in zip(gp, gx):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=3e-3, atol=3e-3)
+
+
+@pytest.mark.parametrize("h_kv", [1, 2])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gqa_forward_matches_repeated(rng, causal, h_kv):
+    """GQA/MQA: the kernel's kv-by-index path == attention against
+    explicitly repeated K/V heads."""
+    q, _, _ = _mk(rng, h=4)
+    kg = jnp.asarray(rng.standard_normal(
+        (1, h_kv, 256, 128)).astype(np.float32) * 0.3)
+    vg = jnp.asarray(rng.standard_normal(
+        (1, h_kv, 256, 128)).astype(np.float32) * 0.3)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    out = _flash_pallas(q, kg, vg, causal, scale, True)
+    rep = 4 // h_kv
+    ref = _flash_xla(q, jnp.repeat(kg, rep, axis=1),
+                     jnp.repeat(vg, rep, axis=1), causal, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("h_kv", [1, 2])
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gqa_backward_matches_repeated(rng, causal, h_kv):
+    """dk/dv come back in the GQA shape and equal the group-sum of the
+    repeated-head gradients; dq matches per-head."""
+    q, _, _ = _mk(rng, h=4)
+    rep = 4 // h_kv
+    kg = jnp.asarray(rng.standard_normal(
+        (1, h_kv, 256, 128)).astype(np.float32) * 0.3)
+    vg = jnp.asarray(rng.standard_normal(
+        (1, h_kv, 256, 128)).astype(np.float32) * 0.3)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    w = jnp.asarray(rng.standard_normal(q.shape).astype(np.float32))
+
+    def loss_pl(q, kg, vg):
+        return jnp.sum(_flash_pallas(q, kg, vg, causal, scale, True) * w)
+
+    def loss_ref(q, kg, vg):
+        return jnp.sum(_flash_xla(q, jnp.repeat(kg, rep, axis=1),
+                                  jnp.repeat(vg, rep, axis=1),
+                                  causal, scale) * w)
+
+    g_pl = jax.grad(loss_pl, argnums=(0, 1, 2))(q, kg, vg)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, kg, vg)
+    assert g_pl[1].shape == (1, h_kv, 256, 128)
+    for got, want, name in zip(g_pl, g_ref, ["dq", "dk", "dv"]):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-3, atol=2e-3,
+            err_msg=f"{name} mismatch (causal={causal})")
+
+
+def test_flash_gqa_entry_validation(rng):
+    q = jnp.zeros((1, 256, 4, 128), jnp.float32)   # paddle layout BSHD
+    k = jnp.zeros((1, 256, 3, 128), jnp.float32)
+    with pytest.raises(ValueError, match="multiple"):
+        flash_attention_arrays(q, k, k, causal=True)

@@ -450,3 +450,26 @@ def test_llama_fused_ce_trainstep_matches_unfused():
     # the second step sees grads through the fused path — the whole
     # update (hidden AND head-weight grads) must match too
     assert abs(lu1 - lf1) < 1e-3, (lu1, lf1)
+
+
+def test_llama_gqa_trains():
+    """GQA config (num_key_value_heads < num_attention_heads) trains
+    through both the flash entry (kernel-served GQA) and the sdpa path
+    (model-side repeat), and the two agree on the first loss."""
+    losses = {}
+    for flash in (True, False):
+        paddle.seed(3)
+        cfg = LlamaConfig.tiny()
+        cfg.num_key_value_heads = 2   # 4 q heads -> rep 2
+        cfg.use_flash_attention = flash
+        net = LlamaForCausalLM(cfg)
+        rng = np.random.default_rng(3)
+        x = _ids(rng, 2, 16, cfg.vocab_size)
+        y = _ids(rng, 2, 16, cfg.vocab_size)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=net.parameters())
+        step = paddle.jit.TrainStep(net, nn.CrossEntropyLoss(), opt)
+        l0 = float(step(x, y).numpy())
+        l1 = float(step(x, y).numpy())
+        assert np.isfinite(l0) and l1 < l0
+        losses[flash] = l0
+    assert abs(losses[True] - losses[False]) < 1e-4, losses
