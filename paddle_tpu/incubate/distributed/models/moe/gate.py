@@ -48,6 +48,15 @@ def _one_round(logits, probs, expert_idx, position_from, capacity):
     return dispatch, gate_prob, new_totals
 
 
+def apply_router_jitter(logits, jitter: float, train: bool, key):
+    """Additive uniform router noise (Switch-style). The ONE definition
+    both the single-group and group-wise dispatch paths share."""
+    if jitter and train and key is not None:
+        logits = logits + jitter * jax.random.uniform(
+            key, logits.shape, logits.dtype, -1.0, 1.0)
+    return logits
+
+
 def topk_gating(logits, top_k: int, capacity: int, train: bool = True,
                 key=None, switch_jitter: float = 0.0):
     """Compute (dispatch [N,E,C], combine [N,E,C], aux_loss).
@@ -56,9 +65,7 @@ def topk_gating(logits, top_k: int, capacity: int, train: bool = True,
     E * sum_e mean_tokens(router_prob_e) * mean_tokens(is_routed_e).
     """
     n, e = logits.shape
-    if switch_jitter and train and key is not None:
-        logits = logits + switch_jitter * jax.random.uniform(
-            key, logits.shape, logits.dtype, -1.0, 1.0)
+    logits = apply_router_jitter(logits, switch_jitter, train, key)
     probs = jax.nn.softmax(logits, axis=-1)
 
     dispatches = []

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from .....core.dispatch import run_op, unwrap, wrap
@@ -86,6 +87,12 @@ class MoELayer(Layer):
         top_k / capacity_factor: routing config for the named gates.
         experts: optional custom GroupedExpertsFFN-like Layer taking
             [E, C, h] -> [E, C, h].
+        group_size: dispatch tokens in routing groups of ~this many
+            tokens (GShard's group-wise dispatch). The dense dispatch
+            einsum costs N*E*C*H with C proportional to N/E, i.e.
+            QUADRATIC in tokens for a single group; per-group capacity
+            makes it linear (cost ~ N * group_size * top_k * cf * H).
+            None = one group (exact legacy semantics).
 
     After forward, `self.l_aux` holds the load-balancing auxiliary loss
     (add `layer.l_aux * coeff` to the training loss, as the reference's
@@ -96,10 +103,12 @@ class MoELayer(Layer):
                  gate="gshard", top_k: Optional[int] = None,
                  capacity_factor: Optional[float] = None,
                  experts: Optional[Layer] = None, moe_group=None,
-                 ep_axis: str = "ep", name=None):
+                 ep_axis: str = "ep", group_size: Optional[int] = None,
+                 name=None):
         super().__init__()
         self.d_model = d_model
         self.num_experts = num_experts
+        self._group_size = group_size
         self.gate_weight = self.create_parameter([d_model, num_experts])
         if isinstance(gate, BaseGate):
             self.gate = gate
@@ -123,30 +132,64 @@ class MoELayer(Layer):
         self._ep_axis = ep_axis
         self.l_aux = None
 
+    def _n_groups(self, n):
+        gs = self._group_size
+        if not gs or n <= gs:
+            return 1
+        g = max(1, n // int(gs))
+        while n % g:            # largest divisor of n at most n // gs
+            g -= 1
+        if n // g > 2 * int(gs):
+            # e.g. a prime token count: the divisor search collapsed
+            # toward one group and the dispatch einsum degrades back
+            # toward quadratic — visible, not silent
+            import logging
+            logging.getLogger(__name__).warning(
+                "MoE group-wise dispatch: %d tokens has no divisor near "
+                "group_size=%d (using %d groups of %d); pad batch*seq "
+                "to a rounder number to keep dispatch cost linear",
+                n, gs, g, n // g)
+        return g
+
     def forward(self, x):
         """x: [batch, seq, h] or [N, h]."""
         orig_shape = list(x.shape)
         h = orig_shape[-1]
         tokens = x.reshape([-1, h])
         n = tokens.shape[0]
-        cap = self.gate.capacity(int(n))
         top_k = self.gate.top_k
+        ng = self._n_groups(int(n))
+        cap = self.gate.capacity(int(n) // ng)
         jitter = getattr(self.gate, "jitter", 0.0)
         training = self.training
         key = random_mod.next_key() if (jitter and training) else None
+        e = self.num_experts
 
         def gating(tok, wg):
             from .gate import topk_gating
             logits = tok @ wg
-            return topk_gating(logits, top_k, cap, train=training,
-                               key=key, switch_jitter=jitter)
+            if ng == 1:
+                return topk_gating(logits, top_k, cap, train=training,
+                                   key=key, switch_jitter=jitter)
+            # group-wise dispatch: jitter once over all tokens, then
+            # route each group with its own capacity (aux = group mean)
+            from .gate import apply_router_jitter
+            logits = apply_router_jitter(logits, jitter, training, key)
+            lg = logits.reshape(ng, n // ng, e)
+            d, c, aux = jax.vmap(
+                lambda l: topk_gating(l, top_k, cap, train=training))(lg)
+            return d, c, jnp.mean(aux)
 
         dispatch, combine, aux = run_op(
             "moe_gate", gating, [tokens, self.gate_weight])
         self.l_aux = aux
 
         def dispatch_fn(tok, d):
-            return jnp.einsum("nh,nec->ech", tok, d)
+            if ng == 1:
+                return jnp.einsum("nh,nec->ech", tok, d)
+            tg = tok.reshape(ng, n // ng, h)
+            ei = jnp.einsum("gnh,gnec->gech", tg, d)      # [G,E,c,h]
+            return ei.transpose(1, 0, 2, 3).reshape(e, ng * cap, h)
 
         expert_in = run_op("moe_dispatch", dispatch_fn, [tokens, dispatch])
         # commit the all-to-all: expert dim sharded over 'ep' (only when
@@ -159,7 +202,10 @@ class MoELayer(Layer):
         expert_out = mark_sharding(expert_out, ep_entry, None, None)
 
         def combine_fn(eo, c):
-            return jnp.einsum("ech,nec->nh", eo, c)
+            if ng == 1:
+                return jnp.einsum("ech,nec->nh", eo, c)
+            eg = eo.reshape(e, ng, cap, h).transpose(1, 0, 2, 3)
+            return jnp.einsum("gech,gnec->gnh", eg, c).reshape(n, h)
 
         out = run_op("moe_combine", combine_fn, [expert_out, combine])
         return out.reshape(orig_shape)
