@@ -14,6 +14,9 @@ reference's limit_by_capacity.
 """
 from __future__ import annotations
 
+import functools
+import logging
+
 from typing import Optional
 
 import jax
@@ -75,6 +78,26 @@ class GroupedExpertsFFN(Layer):
                       [x, self.w1, self.b1, self.w2, self.b2])
 
 
+@functools.lru_cache(maxsize=None)
+def _n_groups_cached(n, gs):
+    """Largest divisor of n giving groups of >= gs tokens; warns ONCE
+    per (n, gs) when the divisor search collapses toward one group (a
+    prime-ish token count degrades the dispatch einsum back toward
+    quadratic — visible, not silent)."""
+    if not gs or n <= gs:
+        return 1
+    g = max(1, n // int(gs))
+    while n % g:                # largest divisor of n at most n // gs
+        g -= 1
+    if n // g > 2 * int(gs):
+        logging.getLogger(__name__).warning(
+            "MoE group-wise dispatch: %d tokens has no divisor near "
+            "group_size=%d (using %d groups of %d); pad batch*seq "
+            "to a rounder number to keep dispatch cost linear",
+            n, gs, g, n // g)
+    return g
+
+
 class MoELayer(Layer):
     """Mixture of experts (reference moe_layer.py:263).
 
@@ -133,23 +156,7 @@ class MoELayer(Layer):
         self.l_aux = None
 
     def _n_groups(self, n):
-        gs = self._group_size
-        if not gs or n <= gs:
-            return 1
-        g = max(1, n // int(gs))
-        while n % g:            # largest divisor of n at most n // gs
-            g -= 1
-        if n // g > 2 * int(gs):
-            # e.g. a prime token count: the divisor search collapsed
-            # toward one group and the dispatch einsum degrades back
-            # toward quadratic — visible, not silent
-            import logging
-            logging.getLogger(__name__).warning(
-                "MoE group-wise dispatch: %d tokens has no divisor near "
-                "group_size=%d (using %d groups of %d); pad batch*seq "
-                "to a rounder number to keep dispatch cost linear",
-                n, gs, g, n // g)
-        return g
+        return _n_groups_cached(n, self._group_size)
 
     def forward(self, x):
         """x: [batch, seq, h] or [N, h]."""

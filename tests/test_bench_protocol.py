@@ -36,6 +36,7 @@ def stubbed(monkeypatch):
     monkeypatch.setattr(bench, "bench_bert", lambda: (50000.0, 0.4))
     monkeypatch.setattr(bench, "bench_ernie_moe", lambda: 20000.0)
     monkeypatch.setattr(bench, "bench_resnet50", lambda: 2500.0)
+    monkeypatch.setattr(bench, "bench_llama_decode", lambda: 900.0)
     return monkeypatch
 
 
@@ -54,7 +55,8 @@ def test_headline_prints_first_and_extras_append(stubbed, capsys,
     for key in ["llama_seq2048_mfu", "llama_small_seq512_mfu",
                 "lenet_train_steps_per_sec_b256",
                 "bert_base_tokens_per_sec", "ernie_moe_tokens_per_sec",
-                "resnet50_images_per_sec"]:
+                "resnet50_images_per_sec",
+                "llama_1b_decode_tokens_per_sec"]:
         assert key in last, key
     assert "skipped" not in last
 
@@ -67,7 +69,7 @@ def test_budget_skips_extras_but_headline_survives(stubbed, capsys,
     assert lines[0]["value"] == 17000.0
     assert set(lines[-1]["extras"]["skipped"]) == {
         "llama_seq2048", "llama_small_seq512", "lenet", "bert_base",
-        "ernie_moe", "resnet50"}
+        "ernie_moe", "resnet50", "llama_decode"}
     assert "llama_seq2048_mfu" not in lines[-1]["extras"]
 
 
