@@ -189,6 +189,8 @@ def fused_linear_cross_entropy(hidden, weight, labels, ignore_index=-100,
         hs = h.reshape(-1, h.shape[-1])
         ls = lab.reshape(-1)
         n = hs.shape[0]
+        if int(n_chunks) < 1:
+            raise ValueError(f"n_chunks must be >= 1, got {n_chunks}")
         chunks = int(min(n_chunks, n))
         if n % chunks != 0:
             # pad with ignored rows to the next multiple so chunking (the

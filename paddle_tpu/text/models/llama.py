@@ -70,6 +70,9 @@ class LlamaConfig:
     # (incubate fused_linear_cross_entropy) instead of materializing the
     # [tokens, vocab] logits; forward(ids, labels) then returns the loss
     fused_linear_ce: bool = False
+    # row chunks for the fused CE scan: peak loss memory is one
+    # [tokens/chunks, vocab] f32 tile
+    fused_ce_chunks: int = 8
     dtype: str = "float32"
 
     @staticmethod
@@ -378,7 +381,8 @@ class LlamaForCausalLM(Layer):
             else:
                 # tied head: Linear layout is [H, V]; embedding is [V, H]
                 w = self.llama.embed_tokens.weight.t()
-            return fused_linear_cross_entropy(h, w, labels)
+            return fused_linear_cross_entropy(
+                h, w, labels, n_chunks=self.config.fused_ce_chunks)
         return self._head(h)
 
     def num_params(self):

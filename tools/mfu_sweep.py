@@ -44,6 +44,8 @@ def main():
     ap.add_argument("--flash", type=int, default=1)
     ap.add_argument("--fused_ce", type=int, default=0,
                     help="1 = chunked fused lm-head+CE (no [T,V] logits)")
+    ap.add_argument("--ce_chunks", type=int, default=8,
+                    help="row chunks for the fused CE scan")
     args = ap.parse_args()
 
     from bench import (_enable_compile_cache, _peak, bench_bert,
@@ -95,7 +97,8 @@ def main():
         recompute_granularity=(args.recompute
                                if args.recompute != "none" else "selective"),
         use_flash_attention=bool(args.flash),
-        fused_linear_ce=bool(args.fused_ce))
+        fused_linear_ce=bool(args.fused_ce),
+        fused_ce_chunks=args.ce_chunks)
 
     paddle.seed(0)
     net = LlamaForCausalLM(cfg)
