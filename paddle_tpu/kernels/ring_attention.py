@@ -31,17 +31,27 @@ def _ring_local(axis: str, n: int, causal: bool, scale: float):
     """Per-device ring attention body (under shard_map manual on axis)."""
 
     def local(q, k, v):
-        # q,k,v: [b, h, s_local, d]
+        # q: [b, h, s_local, d]; k/v: [b, h_kv, s_local, d] with h_kv
+        # dividing h (GQA/MQA): only the GROUPED k/v rotate around the
+        # ring, so ICI traffic shrinks by h/h_kv. The q heads of a group
+        # fold into the row dim (attention rows are independent), which
+        # keeps the body MHA-shaped.
         idx = lax.axis_index(axis)
-        s_local = q.shape[2]
+        b, h, s_local, d = q.shape
+        h_kv = k.shape[1]
+        rep = h // h_kv
+        in_dtype = q.dtype
         q32 = q.astype(jnp.float32) * scale
         pos_q = idx * s_local + jnp.arange(s_local)
+        if rep > 1:
+            q32 = q32.reshape(b, h_kv, rep * s_local, d)
+            pos_q = jnp.tile(pos_q, rep)   # row r*s+j sits at pos_q[j]
 
         from ..distributed.collective_utils import varying
-        acc0 = varying(jnp.zeros(q.shape[:3] + (v.shape[3],),
+        acc0 = varying(jnp.zeros(q32.shape[:3] + (v.shape[3],),
                                  jnp.float32), axis)
-        m0 = varying(jnp.full(q.shape[:3], NEG_INF, jnp.float32), axis)
-        l0 = varying(jnp.zeros(q.shape[:3], jnp.float32), axis)
+        m0 = varying(jnp.full(q32.shape[:3], NEG_INF, jnp.float32), axis)
+        l0 = varying(jnp.zeros(q32.shape[:3], jnp.float32), axis)
 
         def body(carry, step):
             kv_k, kv_v, acc, m, l = carry
@@ -70,7 +80,9 @@ def _ring_local(axis: str, n: int, causal: bool, scale: float):
         (_, _, acc, m, l), _ = lax.scan(
             body, (k, v, acc0, m0, l0), jnp.arange(n))
         out = acc / jnp.maximum(l, 1e-30)[..., None]
-        return out.astype(q.dtype)
+        if rep > 1:
+            out = out.reshape(b, h, s_local, d)
+        return out.astype(in_dtype)
 
     return local
 
@@ -101,6 +113,11 @@ def ring_attention_arrays(q, k, v, mesh=None, axis: str = "sep",
     if q.shape[2] % n:
         raise ValueError(
             f"seq len {q.shape[2]} not divisible by {axis} degree {n}")
+    if k.shape[1] != v.shape[1] or k.shape[1] < 1 \
+            or q.shape[1] % k.shape[1] != 0:
+        raise ValueError(
+            f"GQA requires query heads ({q.shape[1]}) to be a multiple "
+            f"of key/value heads ({k.shape[1]}, v {v.shape[1]})")
     spec = P(None, None, axis, None)
     fn = jax.shard_map(
         _ring_local(axis, n, causal, float(scale)), mesh=mesh,

@@ -158,16 +158,17 @@ class LlamaAttention(Layer):
             q = checkpoint_name(q, "attn_q")
             k = checkpoint_name(k, "attn_k")
             v = checkpoint_name(v, "attn_v")
-        # decide the attention path ONCE: the flash entry serves GQA
-        # in-kernel (kv head = q head // rep); every other path needs
-        # the kv heads materialized via repeat
+        # decide the attention path ONCE: flash serves GQA in-kernel
+        # (kv head = q head // rep) and ring rotates only the grouped
+        # k/v heads (rep-times less ICI traffic); only the XLA sdpa
+        # path needs the kv heads materialized via repeat
         if mesh_mod.axis_degree("sep") > 1:
             path = "ring"
         elif self.use_flash or self.window is not None:
             path = "flash"
         else:
             path = "sdpa"
-        if self.num_kv_heads != self.num_heads and path != "flash":
+        if self.num_kv_heads != self.num_heads and path == "sdpa":
             rep = self.num_heads // self.num_kv_heads
             k = ops.manipulation.repeat_interleave(k, rep, axis=2)
             v = ops.manipulation.repeat_interleave(v, rep, axis=2)
