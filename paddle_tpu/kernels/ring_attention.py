@@ -27,8 +27,15 @@ from jax.sharding import PartitionSpec as P
 NEG_INF = -1e30
 
 
-def _ring_local(axis: str, n: int, causal: bool, scale: float):
-    """Per-device ring attention body (under shard_map manual on axis)."""
+def _ring_local(axis: str, n: int, causal: bool, scale: float,
+                window=None):
+    """Per-device ring attention body (under shard_map manual on axis).
+
+    window: sliding-window (local) attention — query i sees keys in
+    [i - window + 1, i]. Applied as an extra band on the mask; hops
+    whose k block lies entirely outside every local band still rotate
+    (the ring is a fixed scan) but contribute nothing.
+    """
 
     def local(q, k, v):
         # q: [b, h, s_local, d]; k/v: [b, h_kv, s_local, d] with h_kv
@@ -62,6 +69,8 @@ def _ring_local(axis: str, n: int, causal: bool, scale: float):
                            kv_k.astype(jnp.float32))
             if causal:
                 mask = pos_q[:, None] >= pos_k[None, :]
+                if window is not None:
+                    mask &= (pos_q[:, None] - pos_k[None, :]) < window
                 s = jnp.where(mask[None, None], s, NEG_INF)
             m_new = jnp.maximum(m, jnp.max(s, axis=-1))
             # guard fully-masked rows (exp(NEG_INF - NEG_INF) would be 1)
@@ -89,17 +98,25 @@ def _ring_local(axis: str, n: int, causal: bool, scale: float):
 
 def ring_attention_arrays(q, k, v, mesh=None, axis: str = "sep",
                           causal: bool = False,
-                          scale: Optional[float] = None):
+                          scale: Optional[float] = None,
+                          window: Optional[int] = None):
     """Exact attention with q/k/v sequence-sharded over `axis`.
 
     q,k,v: global [b, h, s, d] arrays (sharding on s over `axis` is
     committed by the shard_map specs). Differentiable; jax.grad reverses
     the ring (the cotangent blocks counter-rotate via ppermute's
-    transpose).
+    transpose). window: sliding-window local attention (requires
+    causal=True, like the flash entry).
     """
     from ..distributed import mesh as mesh_mod
     mesh = mesh or mesh_mod.ensure_mesh()
     n = mesh.shape[axis] if axis in mesh.axis_names else 1
+    if window is not None:
+        window = int(window)
+        if not causal:
+            raise ValueError("ring attention window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if n <= 1:
@@ -108,7 +125,8 @@ def ring_attention_arrays(q, k, v, mesh=None, axis: str = "sep",
         from .flash_attention import flash_attention_arrays
         out = flash_attention_arrays(
             jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
-            jnp.swapaxes(v, 1, 2), causal=causal, scale=scale)
+            jnp.swapaxes(v, 1, 2), causal=causal, scale=scale,
+            window=window)
         return jnp.swapaxes(out, 1, 2)
     if q.shape[2] % n:
         raise ValueError(
@@ -120,14 +138,14 @@ def ring_attention_arrays(q, k, v, mesh=None, axis: str = "sep",
             f"of key/value heads ({k.shape[1]}, v {v.shape[1]})")
     spec = P(None, None, axis, None)
     fn = jax.shard_map(
-        _ring_local(axis, n, causal, float(scale)), mesh=mesh,
-        in_specs=(spec, spec, spec), out_specs=spec,
+        _ring_local(axis, n, causal, float(scale), window=window),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         axis_names={axis})
     return fn(q, k, v)
 
 
 def ring_flash_attention(query, key, value, causal=False, scale=None,
-                         axis="sep"):
+                         axis="sep", window=None):
     """Tensor-level API ([b, s, h, d] like paddle flash_attention;
     transposed internally to [b, h, s, d])."""
     from ..core.dispatch import run_op
@@ -137,7 +155,7 @@ def ring_flash_attention(query, key, value, causal=False, scale=None,
         kt = jnp.swapaxes(k, 1, 2)
         vt = jnp.swapaxes(v, 1, 2)
         out = ring_attention_arrays(qt, kt, vt, axis=axis, causal=causal,
-                                    scale=scale)
+                                    scale=scale, window=window)
         return jnp.swapaxes(out, 1, 2)
 
     return run_op("ring_flash_attention", fn, [query, key, value])

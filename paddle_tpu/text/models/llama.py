@@ -173,13 +173,9 @@ class LlamaAttention(Layer):
             k = ops.manipulation.repeat_interleave(k, rep, axis=2)
             v = ops.manipulation.repeat_interleave(v, rep, axis=2)
         if path == "ring":
-            if self.window is not None:
-                raise NotImplementedError(
-                    "sliding_window with sequence parallelism (sep>1) "
-                    "is not supported; the ring schedule assumes full "
-                    "causal attention")
             from ...kernels.ring_attention import ring_flash_attention
-            out = ring_flash_attention(q, k, v, causal=True)
+            out = ring_flash_attention(q, k, v, causal=True,
+                                       window=self.window)
         elif path == "flash":
             from ...kernels.flash_attention import flash_attention
             out = flash_attention(q, k, v, causal=True,
