@@ -48,17 +48,14 @@ def generate(model, input_ids, max_new_tokens: int,
     attends against the cache — O(L) per step instead of the padded
     full-recompute path's O(L²). Requires the model to support
     ``kv_caches``/``cache_index`` forward kwargs (the in-tree
-    LlamaForCausalLM does); use_cache=False is the model-agnostic
-    fallback, and sliding-window models take it automatically (the
-    cached attention supports full causal only)."""
+    LlamaForCausalLM does, including sliding-window configs — the
+    cached attention applies the window band to its mask);
+    use_cache=False is the model-agnostic padded fallback."""
     ids = np.asarray(unwrap(input_ids))
     b, s = ids.shape
     total = s + int(max_new_tokens)
     if max_new_tokens <= 0:
         return wrap(jnp.asarray(ids))
-    if use_cache and getattr(getattr(model, "config", None),
-                             "sliding_window", None) is not None:
-        use_cache = False
     if use_cache:
         import inspect
         try:

@@ -112,6 +112,12 @@ class LlamaAttention(Layer):
         self.num_kv_heads = c.num_key_value_heads
         self.head_dim = c.hidden_size // c.num_attention_heads
         self.use_flash = c.use_flash_attention
+        if c.sliding_window is not None and int(c.sliding_window) < 1:
+            # validate ONCE at construction: every attention path (flash
+            # band, ring band, cached-decode band) assumes window >= 1 —
+            # a 0/negative window would silently mask every key
+            raise ValueError(
+                f"sliding_window must be >= 1, got {c.sliding_window}")
         self.window = c.sliding_window
         # checkpoint_name tags only matter inside a policy-bearing
         # jax.checkpoint; skip the per-op tape cost otherwise
@@ -190,12 +196,13 @@ class LlamaAttention(Layer):
 
     def _cached_attention(self, q, k, v, kv_cache, cache_index):
         """KV-cache decode: write this call's k/v at ``cache_index``,
-        attend q against the cache prefix (full causal; sliding_window
-        decode is not supported). One run_op so the cache update and
-        masked attention stay a single traced unit."""
-        if self.window is not None:
-            raise NotImplementedError(
-                "KV-cache decode with sliding_window is not supported")
+        attend q against the cache prefix. sliding_window adds its band
+        to the cache mask (the cache stays full-length — generate()
+        allocates prompt+new_tokens slots either way; a Mistral-style
+        rolling buffer would shrink memory to O(window) but not change
+        numerics). One run_op so the cache update and masked attention
+        stay a single traced unit."""
+        window = self.window
         rep = self.num_heads // self.num_kv_heads
 
         def fn(qa, ka, va, ck, cv, idx):
@@ -219,6 +226,8 @@ class LlamaAttention(Layer):
             q_pos = idx + jnp.arange(s, dtype=jnp.int32)
             k_pos = jnp.arange(L, dtype=jnp.int32)
             mask = k_pos[None, :] <= q_pos[:, None]        # [s, L]
+            if window is not None:
+                mask &= (q_pos[:, None] - k_pos[None, :]) < window
             logits = jnp.where(mask[None, None], logits, NEG_INF_ATTN)
             p = jax.nn.softmax(logits, axis=-1)
             out = jnp.einsum("bhsL,bLhd->bshd", p,

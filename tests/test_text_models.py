@@ -379,8 +379,8 @@ def test_generate_sampling_and_eos():
 def test_generate_edge_cases():
     """max_new_tokens=0 returns the prompt untouched (the cached
     prefill must not clamp-write into the last prompt slot); oversized
-    top_k clamps to vocab; sliding-window models silently take the
-    padded path (the cached attention is full-causal only)."""
+    top_k clamps to vocab; sliding-window models decode through the
+    cache (banded mask) token-identically to the padded path."""
     from paddle_tpu.text import generate
 
     paddle.seed(13)
@@ -404,6 +404,9 @@ def test_generate_edge_cases():
     netw.eval()
     out = np.asarray(generate(netw, prompt, 4).numpy())
     assert out.shape == (1, 8)
+    out_padded = np.asarray(
+        generate(netw, prompt, 4, use_cache=False).numpy())
+    np.testing.assert_array_equal(out, out_padded)
 
 
 def test_generate_cacheless_model_falls_back():
