@@ -52,15 +52,38 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,
                     return_softmax=False, fixed_seed_offset=None, rng_name="",
-                    training=True, name=None):
+                    training=True, name=None, *, window=None):
     """Reference flash_attention.py:195. Routes to the Pallas flash kernel
     on TPU when shapes allow, else the XLA-fused softmax-attention above.
-    Returns (out, softmax) like paddle; softmax is None unless requested."""
+    Returns (out, softmax) like paddle; softmax is None unless requested.
+
+    TPU extensions beyond the reference signature: key/value may carry
+    fewer heads than query (GQA/MQA — served in-kernel by index), and
+    the keyword-only ``window`` enables sliding-window local attention
+    (requires causal=True; not combinable with return_softmax)."""
     from ...kernels import flash_attention as kernel_mod
-    out = kernel_mod.flash_attention(query, key, value, causal=causal)
+    if window is not None and return_softmax:
+        raise ValueError("return_softmax with window is not supported")
+    out = kernel_mod.flash_attention(query, key, value, causal=causal,
+                                     window=window)
     if return_softmax:
-        sm = scaled_dot_product_attention(query, key, value,
-                                          is_causal=causal)
+        # the paddle contract returns the [B, H, Sq, Sk] probability
+        # matrix (recomputed densely — the flash kernel never holds it)
+        def probs_fn(q, k):
+            qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+            kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+            if kt.shape[1] != qt.shape[1]:       # GQA: repeat kv heads
+                kt = jnp.repeat(kt, qt.shape[1] // kt.shape[1], axis=1)
+            logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt)
+            logits = logits / jnp.sqrt(jnp.float32(qt.shape[-1]))
+            if causal:
+                sq, sk = logits.shape[-2], logits.shape[-1]
+                mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+                logits = jnp.where(mask, logits, -1e30)
+            import jax
+            return jax.nn.softmax(logits, axis=-1)
+
+        sm = run_op("flash_attention_softmax", probs_fn, [query, key])
         return out, sm
     return out, None
 

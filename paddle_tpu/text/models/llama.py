@@ -118,7 +118,8 @@ class LlamaAttention(Layer):
             # a 0/negative window would silently mask every key
             raise ValueError(
                 f"sliding_window must be >= 1, got {c.sliding_window}")
-        self.window = c.sliding_window
+        self.window = None if c.sliding_window is None \
+            else int(c.sliding_window)
         # checkpoint_name tags only matter inside a policy-bearing
         # jax.checkpoint; skip the per-op tape cost otherwise
         self._tag = (c.recompute

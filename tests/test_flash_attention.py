@@ -305,3 +305,35 @@ def test_flash_gqa_entry_validation(rng):
     k = jnp.zeros((1, 256, 3, 128), jnp.float32)
     with pytest.raises(ValueError, match="multiple"):
         flash_attention_arrays(q, k, k, causal=True)
+
+
+def test_public_functional_gqa_and_window(rng):
+    """paddle.nn.functional.flash_attention TPU extensions: GQA head
+    counts and the keyword-only sliding window."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    q = paddle.to_tensor(rng.standard_normal((1, 32, 4, 16)).astype(
+        np.float32))
+    kg = paddle.to_tensor(rng.standard_normal((1, 32, 2, 16)).astype(
+        np.float32))
+    out, sm = F.flash_attention(q, kg, kg, causal=True)
+    assert list(out.shape) == [1, 32, 4, 16] and sm is None
+    out_w, _ = F.flash_attention(q, kg, kg, causal=True, window=8)
+    assert list(out_w.shape) == [1, 32, 4, 16]
+    # windowed == full when the window covers the whole sequence
+    out_full, _ = F.flash_attention(q, kg, kg, causal=True, window=32)
+    np.testing.assert_allclose(np.asarray(out_full.numpy()),
+                               np.asarray(out.numpy()), rtol=1e-5,
+                               atol=1e-6)
+    with pytest.raises(ValueError, match="return_softmax"):
+        F.flash_attention(q, kg, kg, causal=True, window=8,
+                          return_softmax=True)
+    # return_softmax yields the [B, H, Sq, Sk] probability matrix (GQA
+    # heads repeated), causal rows summing to 1
+    _, sm2 = F.flash_attention(q, kg, kg, causal=True,
+                               return_softmax=True)
+    p = np.asarray(sm2.numpy())
+    assert p.shape == (1, 4, 32, 32)
+    np.testing.assert_allclose(p.sum(-1), 1.0, rtol=1e-5)
+    assert np.allclose(np.triu(p[0, 0], 1), 0, atol=1e-6)
