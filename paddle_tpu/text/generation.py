@@ -118,10 +118,22 @@ def generate(model, input_ids, max_new_tokens: int,
         cfg = model.config
         hkv = cfg.num_key_value_heads
         hd = cfg.hidden_size // cfg.num_attention_heads
-        caches = [
-            (jnp.zeros((b, total, hkv, hd), jnp.float32),
-             jnp.zeros((b, total, hkv, hd), jnp.float32))
-            for _ in range(cfg.num_hidden_layers)]
+        win = getattr(cfg, "sliding_window", None)
+        if win is not None and int(win) < total:
+            # Mistral-style rolling buffer: C = window slots per layer
+            # (plus a slot-position track), KV memory O(window) not
+            # O(prompt + new_tokens)
+            C = int(win)
+            caches = [
+                (jnp.zeros((b, C, hkv, hd), jnp.float32),
+                 jnp.zeros((b, C, hkv, hd), jnp.float32),
+                 jnp.full((C,), -1, jnp.int32))
+                for _ in range(cfg.num_hidden_layers)]
+        else:
+            caches = [
+                (jnp.zeros((b, total, hkv, hd), jnp.float32),
+                 jnp.zeros((b, total, hkv, hd), jnp.float32))
+                for _ in range(cfg.num_hidden_layers)]
         # prefill the prompt (writes cache slots [0, s))
         logits, caches = fwd(st, tokens[:, :s], caches, jnp.int32(0))
         done0 = jnp.zeros((b,), bool)

@@ -38,6 +38,22 @@ from ...core.dispatch import wrap
 NEG_INF_ATTN = -1e30
 
 
+def _attend_cache(qa, kk, vv, mask, rep):
+    """Shared decode-attention core: masked softmax of qa against the
+    (kv-shaped) cache keys/values, GQA heads repeated. qa [b, s, h, d];
+    kk/vv [b, L, h_kv, d]; mask [s, L]."""
+    if rep != 1:
+        kk = jnp.repeat(kk, rep, axis=2)
+        vv = jnp.repeat(vv, rep, axis=2)
+    scale = 1.0 / jnp.sqrt(jnp.float32(qa.shape[-1]))
+    logits = jnp.einsum("bshd,bLhd->bhsL", qa.astype(jnp.float32),
+                        kk.astype(jnp.float32)) * scale
+    logits = jnp.where(mask[None, None], logits, NEG_INF_ATTN)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhsL,bLhd->bshd", p,
+                      vv.astype(jnp.float32)).astype(qa.dtype)
+
+
 @dataclass
 class LlamaConfig:
     vocab_size: int = 32000
@@ -198,16 +214,21 @@ class LlamaAttention(Layer):
     def _cached_attention(self, q, k, v, kv_cache, cache_index):
         """KV-cache decode: write this call's k/v at ``cache_index``,
         attend q against the cache prefix. sliding_window adds its band
-        to the cache mask (the cache stays full-length — generate()
-        allocates prompt+new_tokens slots either way; a Mistral-style
-        rolling buffer would shrink memory to O(window) but not change
-        numerics). One run_op so the cache update and masked attention
-        stay a single traced unit."""
+        to the cache mask. A 2-tuple (k, v) cache is full-length; a
+        3-tuple (k, v, pos) cache is a Mistral-style ROLLING buffer of
+        C = min(window, total) slots — writes land at pos % C, evicting
+        the oldest, and pos[] tracks each slot's absolute position for
+        the mask, so long-generation KV memory is O(window) not O(L).
+        One run_op so the cache update and masked attention stay a
+        single traced unit."""
+        if len(kv_cache) == 3:
+            return self._rolling_cached_attention(q, k, v, kv_cache,
+                                                  cache_index)
         window = self.window
         rep = self.num_heads // self.num_kv_heads
 
         def fn(qa, ka, va, ck, cv, idx):
-            b, s, hq, d = qa.shape
+            s = qa.shape[1]
             L = ck.shape[1]
             idx = idx.astype(jnp.int32)
             zero = jnp.int32(0)
@@ -215,24 +236,14 @@ class LlamaAttention(Layer):
                 ck, ka.astype(ck.dtype), (zero, idx, zero, zero))
             cv = jax.lax.dynamic_update_slice(
                 cv, va.astype(cv.dtype), (zero, idx, zero, zero))
-            kk, vv = ck, cv
-            if rep != 1:
-                kk = jnp.repeat(kk, rep, axis=2)
-                vv = jnp.repeat(vv, rep, axis=2)
-            scale = 1.0 / jnp.sqrt(jnp.float32(d))
-            logits = jnp.einsum("bshd,bLhd->bhsL", qa.astype(jnp.float32),
-                                kk.astype(jnp.float32)) * scale
             # query local position i sits at absolute idx + i; it sees
-            # cache slots <= that position
+            # cache slots <= that position (within the window band)
             q_pos = idx + jnp.arange(s, dtype=jnp.int32)
             k_pos = jnp.arange(L, dtype=jnp.int32)
             mask = k_pos[None, :] <= q_pos[:, None]        # [s, L]
             if window is not None:
                 mask &= (q_pos[:, None] - k_pos[None, :]) < window
-            logits = jnp.where(mask[None, None], logits, NEG_INF_ATTN)
-            p = jax.nn.softmax(logits, axis=-1)
-            out = jnp.einsum("bhsL,bLhd->bshd", p,
-                             vv.astype(jnp.float32)).astype(qa.dtype)
+            out = _attend_cache(qa, ck, cv, mask, rep)
             return out, ck, cv
 
         idx_t = wrap(jnp.asarray(cache_index, jnp.int32))
@@ -241,6 +252,57 @@ class LlamaAttention(Layer):
         b, s = out.shape[0], out.shape[1]
         out = out.reshape([b, s, self.num_heads * self.head_dim])
         return self.o_proj(out), (nck, ncv)
+
+    def _rolling_cached_attention(self, q, k, v, kv_cache, cache_index):
+        """Rolling-buffer decode (see _cached_attention): the C-slot
+        cache holds the window's K/V; slot j's absolute position lives
+        in pos[j] (-1 = never written), making the band mask a direct
+        position compare with no modular arithmetic."""
+        window = self.window
+        rep = self.num_heads // self.num_kv_heads
+        if window is None:
+            raise ValueError(
+                "rolling (k, v, pos) caches require sliding_window")
+
+        def fn(qa, ka, va, ck, cv, pos, idx):
+            b, s, hq, d = qa.shape
+            C = ck.shape[1]
+            idx = idx.astype(jnp.int32)
+            cur_pos = idx + jnp.arange(s, dtype=jnp.int32)
+            # Attend against PRE-update cache + the current chunk, so a
+            # long prefill's intermediate rows still see the (not yet
+            # evicted) keys just left of the kept window. Stale cache
+            # slots that this chunk will overwrite hold positions
+            # <= idx - C <= q_pos - window, so the band mask hides them
+            # without any explicit eviction logic; cache and chunk
+            # positions never collide (old < idx <= new).
+            kk = jnp.concatenate([ck, ka.astype(ck.dtype)], axis=1)
+            vv = jnp.concatenate([cv, va.astype(cv.dtype)], axis=1)
+            pos_cat = jnp.concatenate([pos, cur_pos])     # [C + s]
+            mask = (pos_cat[None, :] >= 0) \
+                & (pos_cat[None, :] <= cur_pos[:, None]) \
+                & ((cur_pos[:, None] - pos_cat[None, :]) < window)
+            out = _attend_cache(qa, kk, vv, mask, rep)
+            # roll the chunk in: only its last min(s, C) tokens survive
+            if s > C:
+                ka_w, va_w = ka[:, s - C:], va[:, s - C:]
+                new_pos = idx + jnp.arange(s - C, s, dtype=jnp.int32)
+            else:
+                ka_w, va_w = ka, va
+                new_pos = cur_pos
+            slots = new_pos % C
+            ck = ck.at[:, slots].set(ka_w.astype(ck.dtype))
+            cv = cv.at[:, slots].set(va_w.astype(cv.dtype))
+            pos = pos.at[slots].set(new_pos)
+            return out, ck, cv, pos
+
+        idx_t = wrap(jnp.asarray(cache_index, jnp.int32))
+        out, nck, ncv, npos = run_op(
+            "rolling_cached_attention", fn,
+            [q, k, v, kv_cache[0], kv_cache[1], kv_cache[2], idx_t])
+        b, s = out.shape[0], out.shape[1]
+        out = out.reshape([b, s, self.num_heads * self.head_dim])
+        return self.o_proj(out), (nck, ncv, npos)
 
 
 class LlamaMLP(Layer):

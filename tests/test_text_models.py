@@ -479,3 +479,30 @@ def test_llama_gqa_trains():
         assert np.isfinite(l0) and l1 < l0
         losses[flash] = l0
     assert abs(losses[True] - losses[False]) < 1e-4, losses
+
+
+def test_generate_rolling_window_cache_matches_padded():
+    """Mistral-style rolling KV buffer: windowed models decode with
+    C = window cache slots (O(window) memory), token-identical to the
+    padded full-recompute path — including prompts longer than the
+    window, where prefill rows must still see the keys just left of
+    the kept window."""
+    from paddle_tpu.text import generate
+
+    for layers, win, plen, new, kv in [(2, 3, 5, 6, 2), (1, 2, 4, 4, 1)]:
+        paddle.seed(13)
+        cfg = LlamaConfig.tiny(vocab=16, hidden=64, layers=layers,
+                               heads=2)
+        cfg.num_key_value_heads = kv   # kv < heads covers GQA rolling
+        cfg.use_flash_attention = False
+        cfg.sliding_window = win
+        net = LlamaForCausalLM(cfg)
+        net.eval()
+        prompt = paddle.to_tensor(np.stack(
+            [np.arange(1, 1 + plen), np.arange(3, 3 + plen)]).astype(
+                np.int64))              # batch 2
+        out_c = np.asarray(generate(net, prompt, new).numpy())
+        out_p = np.asarray(
+            generate(net, prompt, new, use_cache=False).numpy())
+        np.testing.assert_array_equal(out_c, out_p,
+                                      err_msg=f"layers={layers} win={win}")
