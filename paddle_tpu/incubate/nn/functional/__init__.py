@@ -592,12 +592,35 @@ def variable_length_memory_efficient_attention(query, key, value,
     return run_op("varlen_mem_efficient_attention", fn, args)
 
 
+def paged_attention(query, k_cache, v_cache, block_tables, context_lens,
+                    scale=None):
+    """TPU-native paged-KV decode attention (the capability behind the
+    reference's block_multihead_attention, minus its CUDA-runtime arg
+    plumbing): one decode step against fixed-size cache pages addressed
+    through per-sequence block tables. See kernels/paged_attention.py."""
+    from ....kernels.paged_attention import paged_attention as _pa
+    return _pa(query, k_cache, v_cache, block_tables, context_lens,
+               scale=scale)
+
+
+def paged_write(key, value, k_cache, v_cache, block_tables, positions):
+    """Append one token's k/v per sequence into the paged cache (the
+    write half of the paged-decode loop)."""
+    from ....kernels.paged_attention import paged_write as _pw
+    return _pw(key, value, k_cache, v_cache, block_tables, positions)
+
+
 def block_multihead_attention(*args, **kwargs):
     """(reference: block_multihead_attention — paged-KV CUDA decoding
-    kernel)."""
+    kernel). The capability is paddle.incubate.nn.functional.
+    paged_attention / paged_write; this exact entry keeps the
+    CUDA-serving arg layout (qkv-packed rows, rotary tables, cum
+    offsets) that has no TPU counterpart."""
     raise NotImplementedError(
-        "paged-attention decoding is a CUDA-runtime kernel; TPU decoding "
-        "uses dense cache_kv attention under jit")
+        "use paddle.incubate.nn.functional.paged_attention (+ "
+        "paged_write) — the TPU-native paged-KV decode over block "
+        "tables; this entry's CUDA-serving argument layout (packed qkv "
+        "rows, cum_offsets, rope tables) is runtime-specific")
 
 
 def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size=None,

@@ -1,0 +1,124 @@
+"""Paged-KV decode attention — TPU-native block-table serving cache.
+
+Reference capability: block_multihead_attention
+(/root/reference/python/paddle/incubate/nn/functional/blha_get_max_len.py
+family and paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu)
+— the paged-attention decode kernel behind PaddleNLP serving, where each
+sequence's KV cache lives in non-contiguous fixed-size blocks addressed
+through a block table, so cache memory is allocated block-by-block as
+sequences grow instead of max-length-per-sequence up front.
+
+TPU-native design: the block gather is ONE XLA gather
+(``cache[block_tables]``), attention over the gathered pages is a dense
+masked softmax — XLA fuses gather + QK + softmax + PV into a handful of
+kernels, with no CUDA-style hand scheduling. Shapes stay static
+(max_blocks_per_seq bounds the gather); per-sequence validity comes from
+``context_lens`` masking, the standard Pallas/serving pattern on TPU.
+
+GQA/MQA: caches carry ``h_kv`` heads; query heads map to kv head
+``h // rep`` exactly like kernels/flash_attention.py.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import run_op
+
+NEG_INF = -1e30
+
+
+def paged_attention_arrays(q, k_cache, v_cache, block_tables, context_lens,
+                           scale: Optional[float] = None):
+    """One decode step of attention against a paged KV cache.
+
+    q:            [b, h, d]           — this step's query (one token/seq).
+    k_cache/v_cache: [num_blocks, block_size, h_kv, d] — the global page
+                  pool; h_kv may divide h (GQA).
+    block_tables: [b, max_blocks] int — page ids per sequence, in order;
+                  entries past the sequence's pages may be any valid id
+                  (masked out by context_lens).
+    context_lens: [b] int             — tokens (incl. this step's, if
+                  already written) visible per sequence.
+    Returns [b, h, d].
+    """
+    b, h, d = q.shape
+    nb, bs, h_kv, _ = k_cache.shape
+    if h_kv < 1 or h % h_kv:
+        raise ValueError(
+            f"GQA requires query heads ({h}) to be a multiple of cache "
+            f"kv heads ({h_kv})")
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    rep = h // h_kv
+
+    # gather each sequence's pages: [b, max_blocks, bs, h_kv, d]
+    k = jnp.take(k_cache, block_tables, axis=0)
+    v = jnp.take(v_cache, block_tables, axis=0)
+    L = block_tables.shape[1] * bs
+    k = k.reshape(b, L, h_kv, d)
+    v = v.reshape(b, L, h_kv, d)
+    # GQA served by grouped einsum — no rep-times K/V copy over the
+    # gathered pages (same idea as flash_attention's kv index map)
+    qg = q.reshape(b, h_kv, rep, d).astype(jnp.float32)
+    logits = jnp.einsum("bgrd,bLgd->bgrL", qg,
+                        k.astype(jnp.float32)) * jnp.float32(scale)
+    valid = jnp.arange(L)[None, :] < context_lens[:, None]      # [b, L]
+    logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bgrL,bLgd->bgrd", p, v.astype(jnp.float32))
+    # padded slots (context_len 0) emit zeros, not a uniform average of
+    # whatever pages their block table points at
+    out = jnp.where(context_lens[:, None, None, None] > 0, out, 0.0)
+    return out.reshape(b, h, d).astype(q.dtype)
+
+
+def paged_write_arrays(k, v, k_cache, v_cache, block_tables, positions):
+    """Append one token's k/v per sequence into the paged cache.
+
+    k/v:        [b, h_kv, d] — this step's keys/values.
+    positions:  [b] int      — each sequence's token position (the page
+                is block_tables[seq, pos // block_size], the slot
+                pos % block_size).
+    Returns the updated (k_cache, v_cache).
+    """
+    nb, bs, h_kv, d = k_cache.shape
+    b = k.shape[0]
+    capacity = block_tables.shape[1] * bs
+    if not isinstance(positions, jax.core.Tracer):
+        pmax = int(jnp.max(positions))
+        if pmax >= capacity:
+            # take_along_axis would silently CLIP the page index and
+            # overwrite the last page's slots — corrupting cached
+            # tokens; fail loudly instead (traced positions skip this
+            # concrete check; serving loops run it eagerly)
+            raise ValueError(
+                f"position {pmax} exceeds the sequence's block-table "
+                f"capacity {capacity} ({block_tables.shape[1]} pages x "
+                f"block_size {bs}) — grow the block table first")
+    page = jnp.take_along_axis(
+        block_tables, (positions // bs)[:, None], axis=1)[:, 0]   # [b]
+    slot = positions % bs
+    k_cache = k_cache.at[page, slot].set(k.astype(k_cache.dtype))
+    v_cache = v_cache.at[page, slot].set(v.astype(v_cache.dtype))
+    return k_cache, v_cache
+
+
+def paged_attention(query, k_cache, v_cache, block_tables, context_lens,
+                    scale=None):
+    """Tensor-level entry (see paged_attention_arrays)."""
+    def fn(q, kc, vc, bt, cl):
+        return paged_attention_arrays(q, kc, vc, bt, cl, scale=scale)
+    return run_op("paged_attention", fn,
+                  [query, k_cache, v_cache, block_tables, context_lens])
+
+
+def paged_write(key, value, k_cache, v_cache, block_tables, positions):
+    """Tensor-level entry (see paged_write_arrays)."""
+    def fn(k, v, kc, vc, bt, pos):
+        return paged_write_arrays(k, v, kc, vc, bt, pos)
+    return run_op("paged_write", fn,
+                  [key, value, k_cache, v_cache, block_tables, positions])

@@ -337,3 +337,85 @@ def test_public_functional_gqa_and_window(rng):
     assert p.shape == (1, 4, 32, 32)
     np.testing.assert_allclose(p.sum(-1), 1.0, rtol=1e-5)
     assert np.allclose(np.triu(p[0, 0], 1), 0, atol=1e-6)
+
+
+def test_paged_attention_matches_dense(rng):
+    """Paged-KV decode (block tables over a page pool) == dense masked
+    attention over each sequence's contiguous KV, incl. GQA and ragged
+    context lengths; paged_write lands the token where paged_attention
+    reads it."""
+    from paddle_tpu.kernels.paged_attention import (paged_attention_arrays,
+                                                    paged_write_arrays)
+
+    b, h, h_kv, d, bs, max_blocks = 2, 4, 2, 8, 4, 3
+    nb = 8
+    rep = h // h_kv
+    kc = jnp.asarray(rng.standard_normal((nb, bs, h_kv, d)).astype(
+        np.float32))
+    vc = jnp.asarray(rng.standard_normal((nb, bs, h_kv, d)).astype(
+        np.float32))
+    # seq 0 uses pages [5, 1, 2] with 9 tokens; seq 1 pages [0, 7, 3],
+    # 5 tokens
+    bt = jnp.asarray(np.array([[5, 1, 2], [0, 7, 3]], np.int32))
+    cl = jnp.asarray(np.array([9, 5], np.int32))
+    q = jnp.asarray(rng.standard_normal((b, h, d)).astype(np.float32))
+
+    out = np.asarray(paged_attention_arrays(q, kc, vc, bt, cl))
+
+    for s in range(b):
+        L = int(cl[s])
+        k_seq = np.concatenate([np.asarray(kc)[int(p)] for p in bt[s]])[:L]
+        v_seq = np.concatenate([np.asarray(vc)[int(p)] for p in bt[s]])[:L]
+        k_rep = np.repeat(k_seq, rep, axis=1)       # [L, h, d]
+        v_rep = np.repeat(v_seq, rep, axis=1)
+        logits = np.einsum("hd,Lhd->hL", np.asarray(q)[s],
+                           k_rep) / np.sqrt(d)
+        p_ = np.exp(logits - logits.max(-1, keepdims=True))
+        p_ /= p_.sum(-1, keepdims=True)
+        want = np.einsum("hL,Lhd->hd", p_, v_rep)
+        np.testing.assert_allclose(out[s], want, rtol=1e-4, atol=1e-5,
+                                   err_msg=f"seq {s}")
+
+    # write this step's k/v at each sequence's next position, then
+    # attend with context_lens+1: the new token must be visible
+    k_new = jnp.asarray(rng.standard_normal((b, h_kv, d)).astype(
+        np.float32))
+    v_new = jnp.asarray(rng.standard_normal((b, h_kv, d)).astype(
+        np.float32))
+    kc2, vc2 = paged_write_arrays(k_new, v_new, kc, vc, bt, cl)
+    out2 = np.asarray(paged_attention_arrays(q, kc2, vc2, bt, cl + 1))
+    # seq 0 pos 9 -> page bt[0, 2]=2 slot 1; seq 1 pos 5 -> page 7 slot 1
+    assert np.allclose(np.asarray(kc2)[2, 1], np.asarray(k_new)[0])
+    assert np.allclose(np.asarray(kc2)[7, 1], np.asarray(k_new)[1])
+    assert not np.allclose(out2, out)   # the new token changed attention
+
+
+def test_paged_attention_validation(rng):
+    from paddle_tpu.kernels.paged_attention import paged_attention_arrays
+    q = jnp.zeros((1, 4, 8), jnp.float32)
+    kc = jnp.zeros((2, 4, 3, 8), jnp.float32)   # 3 kv heads !| 4
+    bt = jnp.zeros((1, 1), jnp.int32)
+    cl = jnp.ones((1,), jnp.int32)
+    with pytest.raises(ValueError, match="multiple"):
+        paged_attention_arrays(q, kc, kc, bt, cl)
+
+
+def test_paged_attention_padded_and_capacity(rng):
+    """Padded slots (context_len 0) emit zeros; an over-capacity write
+    raises instead of silently clipping into the last page."""
+    from paddle_tpu.kernels.paged_attention import (paged_attention_arrays,
+                                                    paged_write_arrays)
+    b, h, h_kv, d, bs = 2, 4, 2, 8, 4
+    kc = jnp.asarray(rng.standard_normal((4, bs, h_kv, d)).astype(
+        np.float32))
+    bt = jnp.asarray(np.array([[0, 1], [2, 3]], np.int32))
+    cl = jnp.asarray(np.array([3, 0], np.int32))
+    q = jnp.asarray(rng.standard_normal((b, h, d)).astype(np.float32))
+    out = np.asarray(paged_attention_arrays(q, kc, kc, bt, cl))
+    np.testing.assert_array_equal(out[1], 0.0)
+    assert np.abs(out[0]).sum() > 0
+
+    k1 = jnp.zeros((b, h_kv, d), jnp.float32)
+    with pytest.raises(ValueError, match="capacity"):
+        paged_write_arrays(k1, k1, kc, kc, bt,
+                           jnp.asarray(np.array([8, 2], np.int32)))
