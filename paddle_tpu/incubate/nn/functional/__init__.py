@@ -552,12 +552,101 @@ def fused_multi_transformer(*args, **kwargs):
 
 
 def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
-                               **kwargs):
-    """(reference: masked_multihead_attention — the CUDA decoding
-    kernel)."""
-    raise NotImplementedError(
-        "decode with nn.MultiHeadAttention(cache=...) under jit; the "
-        "masked single-query kernel is a CUDA-runtime specialization")
+                               cum_offsets=None, sequence_lengths=None,
+                               rotary_tensor=None, beam_cache_offset=None,
+                               qkv_out_scale=None, out_shift=None,
+                               out_smooth=None, seq_len=1,
+                               rotary_emb_dims=0,
+                               use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0):
+    """Single-token decode attention against a dense KV cache
+    (reference: masked_multihead_attention — the CUDA decode kernel,
+    incubate/nn/functional/masked_multihead_attention.py:74). TPU-native
+    subset: x [b, 3*h*d] packed qkv for ONE step, cache_kv
+    [2, b, h, max_seq, d], optional bias [3, h, d], optional ADDITIVE
+    src_mask [b, 1, 1, L], sequence_lengths [b, 1] = each sequence's
+    write position (defaults to src_mask's length - 1, the reference's
+    common call shape). Returns (out [b, h*d], updated cache_kv).
+    Beam offsets, rotary application and the int8-quant plumbing are
+    CUDA-runtime specifics and stay unsupported."""
+    if cum_offsets is not None or beam_cache_offset is not None:
+        raise NotImplementedError(
+            "cum_offsets/beam_cache_offset are CUDA-serving specifics")
+    if rotary_tensor is not None or rotary_emb_dims:
+        raise NotImplementedError(
+            "apply rotary embeddings before the call (fused_rotary_"
+            "position_embedding); the in-kernel rotary path is "
+            "CUDA-specific")
+    if qkv_out_scale is not None or out_shift is not None \
+            or out_smooth is not None or (out_scale is not None
+                                          and out_scale > 0):
+        raise NotImplementedError(
+            "int8-quant scales are CUDA-kernel specifics")
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention requires cache_kv")
+    if src_mask is None and sequence_lengths is None:
+        raise ValueError(
+            "pass sequence_lengths (write positions) or src_mask "
+            "(whose last dim implies position = L - 1)")
+    max_seq = cache_kv.shape[3]
+    if sequence_lengths is not None:
+        import numpy as _np
+        from ....core.dispatch import unwrap as _unw
+        lens_v = _unw(sequence_lengths)
+        if not isinstance(lens_v, jax.core.Tracer):
+            pmax = int(_np.max(_np.asarray(lens_v)))
+            if pmax >= max_seq:
+                # the scatter would silently DROP an out-of-range write
+                # while the mask unmasks every slot — fail loudly (same
+                # contract as paged_write's capacity check)
+                raise ValueError(
+                    f"sequence_lengths position {pmax} exceeds the "
+                    f"cache's max_seq_len {max_seq}")
+    elif src_mask.shape[-1] > max_seq:
+        raise ValueError(
+            f"src_mask length {src_mask.shape[-1]} exceeds the cache's "
+            f"max_seq_len {max_seq}")
+
+    def fn(xa, ck, *rest):
+        it = iter(rest)
+        bias_a = next(it) if bias is not None else None
+        mask_a = next(it) if src_mask is not None else None
+        lens_a = next(it) if sequence_lengths is not None else None
+        _, b, h, L, d = ck.shape
+        qkv = xa.reshape(b, 3, h, d).astype(jnp.float32)
+        if bias_a is not None:
+            qkv = qkv + bias_a.astype(jnp.float32)[None]
+        qa, ka, va = qkv[:, 0], qkv[:, 1], qkv[:, 2]   # [b, h, d]
+        if lens_a is not None:
+            pos = lens_a.reshape(b).astype(jnp.int32)
+        else:
+            pos = jnp.full((b,), mask_a.shape[-1] - 1, jnp.int32)
+        bi = jnp.arange(b)
+        ck = ck.at[0, bi, :, pos].set(ka.astype(ck.dtype))
+        ck = ck.at[1, bi, :, pos].set(va.astype(ck.dtype))
+        logits = jnp.einsum("bhd,bhLd->bhL", qa,
+                            ck[0].astype(jnp.float32))
+        logits = logits / jnp.sqrt(jnp.float32(d))
+        valid = jnp.arange(L)[None, :] <= pos[:, None]      # [b, L]
+        logits = jnp.where(valid[:, None, :], logits, -1e30)
+        if mask_a is not None:
+            lm = mask_a.reshape(b, 1, -1).astype(jnp.float32)
+            logits = logits.at[:, :, :lm.shape[-1]].add(lm)
+        p = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhL,bhLd->bhd", p,
+                         ck[1].astype(jnp.float32))
+        return out.reshape(b, h * d).astype(xa.dtype), ck
+
+    args = [x, cache_kv]
+    if bias is not None:
+        args.append(bias)
+    if src_mask is not None:
+        args.append(src_mask)
+    if sequence_lengths is not None:
+        args.append(sequence_lengths)
+    return run_op("masked_multihead_attention", fn, args)
 
 
 def variable_length_memory_efficient_attention(query, key, value,

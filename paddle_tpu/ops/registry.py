@@ -105,7 +105,7 @@ _NOT_APPLICABLE_PREFIXES = (
     # CUDA-runtime-specific paths
     "cudnn_lstm", "npu_identity", "sync_calc_stream", "depend", "data",
     "llm_int8_linear", "weight_only_linear", "weight_quantize",
-    "weight_dequantize", "masked_multihead_attention_",
+    "weight_dequantize",
     "apply_per_channel_scale", "coalesce_tensor", "merge_selected_rows",
     "copy_to", "sparse_attention", "calc_reduced_attn_scores",
     # IO ops handled by the Python data pipeline
@@ -207,6 +207,8 @@ _COVERED_BY = {
     "unpool3d": "nn.functional.max_unpool3d",
     "graph_khop_sampler": "incubate.graph_khop_sampler",
     "graph_sample_neighbors": "incubate.graph_sample_neighbors",
+    "masked_multihead_attention_":
+        "incubate.nn.functional.masked_multihead_attention",
 }
 
 

@@ -419,3 +419,81 @@ def test_paged_attention_padded_and_capacity(rng):
     with pytest.raises(ValueError, match="capacity"):
         paged_write_arrays(k1, k1, kc, kc, bt,
                            jnp.asarray(np.array([8, 2], np.int32)))
+
+
+def test_masked_multihead_attention_decode(rng):
+    """incubate masked_multihead_attention (single-token decode vs a
+    dense [2, b, h, L, d] cache): matches a numpy reference, writes
+    this step's k/v at each sequence's position, honors bias and the
+    additive src_mask, and supports per-sequence lengths."""
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn.functional import masked_multihead_attention
+
+    b, h, d, L = 2, 2, 8, 6
+    x = rng.standard_normal((b, 3 * h * d)).astype(np.float32)
+    cache = rng.standard_normal((2, b, h, L, d)).astype(np.float32)
+    bias = (rng.standard_normal((3, h, d)) * 0.1).astype(np.float32)
+    lens = np.array([[3], [5]], np.int32)    # write positions per seq
+
+    out, new_cache = masked_multihead_attention(
+        paddle.to_tensor(x), paddle.to_tensor(cache.copy()),
+        bias=paddle.to_tensor(bias),
+        sequence_lengths=paddle.to_tensor(lens))
+    out = np.asarray(out.numpy())
+    nc = np.asarray(new_cache.numpy())
+
+    qkv = x.reshape(b, 3, h, d) + bias[None]
+    for s in range(b):
+        pos = int(lens[s, 0])
+        kref = cache[0, s].copy()
+        vref = cache[1, s].copy()
+        kref[:, pos] = qkv[s, 1]
+        vref[:, pos] = qkv[s, 2]
+        np.testing.assert_allclose(nc[0, s], kref, rtol=1e-5, atol=1e-6)
+        logits = np.einsum("hd,hLd->hL", qkv[s, 0], kref) / np.sqrt(d)
+        logits[:, pos + 1:] = -1e30
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        want = np.einsum("hL,hLd->hd", p, vref).reshape(h * d)
+        np.testing.assert_allclose(out[s], want, rtol=1e-4, atol=1e-5,
+                                   err_msg=f"seq {s}")
+
+    # src_mask path: position from the mask length, additive bias on
+    # visible slots
+    mask = np.zeros((b, 1, 1, 4), np.float32)
+    mask[0, ..., 1] = -1e30                  # hide slot 1 for seq 0
+    out2, _ = masked_multihead_attention(
+        paddle.to_tensor(x), paddle.to_tensor(cache.copy()),
+        src_mask=paddle.to_tensor(mask))
+    out2 = np.asarray(out2.numpy())
+    qkv2 = x.reshape(b, 3, h, d)
+    kref = cache[0, 0].copy(); vref = cache[1, 0].copy()
+    kref[:, 3] = qkv2[0, 1]; vref[:, 3] = qkv2[0, 2]
+    logits = np.einsum("hd,hLd->hL", qkv2[0, 0], kref) / np.sqrt(d)
+    logits[:, 4:] = -1e30
+    logits[:, 1] += -1e30
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    want0 = np.einsum("hL,hLd->hd", p, vref).reshape(h * d)
+    np.testing.assert_allclose(out2[0], want0, rtol=1e-4, atol=1e-5)
+
+    import pytest as _pytest
+    with _pytest.raises(NotImplementedError):
+        masked_multihead_attention(paddle.to_tensor(x),
+                                   paddle.to_tensor(cache.copy()),
+                                   src_mask=paddle.to_tensor(mask),
+                                   rotary_emb_dims=1)
+
+
+def test_masked_multihead_attention_bounds(rng):
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.nn.functional import masked_multihead_attention
+
+    x = paddle.to_tensor(rng.standard_normal((1, 3 * 2 * 8)).astype(
+        np.float32))
+    cache = paddle.to_tensor(rng.standard_normal((2, 1, 2, 4, 8)).astype(
+        np.float32))
+    with pytest.raises(ValueError, match="max_seq_len"):
+        masked_multihead_attention(
+            x, cache, sequence_lengths=paddle.to_tensor(
+                np.array([[4]], np.int32)))
