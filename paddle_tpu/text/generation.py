@@ -35,13 +35,17 @@ from ..jit.functional import functional_call, get_buffers, get_frozen, \
 
 def generate(model, input_ids, max_new_tokens: int,
              temperature: float = 0.0, top_k: int = 0,
+             top_p: float = 0.0,
              eos_token_id: Optional[int] = None, seed: int = 0,
              use_cache: bool = True):
     """Generate ``max_new_tokens`` continuations for ``input_ids``
     [B, S] with the causal-LM ``model``. temperature == 0 → greedy;
     otherwise softmax sampling at that temperature, optionally top-k
-    truncated. Rows that emit ``eos_token_id`` keep their eos and stop
-    changing. Returns a Tensor [B, S + max_new_tokens].
+    truncated and/or nucleus-filtered (``0 < top_p <= 1`` keeps the
+    smallest set of tokens whose probability mass reaches top_p; both
+    filters compose, top-k first). Rows that emit ``eos_token_id`` keep
+    their eos and stop changing. Returns a Tensor
+    [B, S + max_new_tokens].
 
     use_cache=True runs the KV-cache decode: prefill writes the prompt
     into per-layer caches, then each scan step feeds ONE token and
@@ -83,10 +87,30 @@ def generate(model, input_ids, max_new_tokens: int,
             key, sub = jax.random.split(key)
             scaled = cur / jnp.float32(temperature)
             k_eff = min(int(top_k), cur.shape[-1]) if top_k else 0
-            if k_eff > 0:
-                kth = jnp.sort(scaled, axis=-1)[:, -k_eff]
-                scaled = jnp.where(scaled >= kth[:, None], scaled,
-                                   -jnp.inf)
+            p_on = bool(top_p) and 0.0 < float(top_p) < 1.0
+            if k_eff > 0 or p_on:
+                # ONE descending argsort serves both filters (a second
+                # full-vocab sort per decode step would double the
+                # compiled loop's sort work)
+                order = jnp.argsort(-scaled, axis=-1)
+                svals = jnp.take_along_axis(scaled, order, axis=-1)
+                keep_sorted = jnp.ones(svals.shape, bool)
+                if k_eff > 0:
+                    keep_sorted &= jnp.arange(
+                        svals.shape[-1])[None, :] < k_eff
+                if p_on:
+                    # nucleus: the smallest descending-prob prefix whose
+                    # mass reaches top_p (the first token always
+                    # survives, so the filter never empties a row);
+                    # renormalize within the top-k survivors
+                    probs = jax.nn.softmax(
+                        jnp.where(keep_sorted, svals, -jnp.inf), -1)
+                    csum = jnp.cumsum(probs, axis=-1)
+                    keep_sorted &= (csum - probs) < jnp.float32(top_p)
+                keep = jnp.zeros_like(keep_sorted).at[
+                    jnp.arange(order.shape[0])[:, None], order
+                ].set(keep_sorted)
+                scaled = jnp.where(keep, scaled, -jnp.inf)
             nxt = jax.random.categorical(sub, scaled, axis=-1)
         else:
             nxt = jnp.argmax(cur, axis=-1)
@@ -167,7 +191,7 @@ def generate(model, input_ids, max_new_tokens: int,
     # jax.jit(closure) per call would retrace the whole decode loop
     # every generate() invocation
     sig = (use_cache, b, s, total, float(temperature), int(top_k),
-           eos_token_id, str(ids.dtype))
+           float(top_p), eos_token_id, str(ids.dtype))
     per_model = _jit_cache.setdefault(model, {})
     fn = per_model.get(sig)
     if fn is None:

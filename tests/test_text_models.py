@@ -506,3 +506,38 @@ def test_generate_rolling_window_cache_matches_padded():
             generate(net, prompt, new, use_cache=False).numpy())
         np.testing.assert_array_equal(out_c, out_p,
                                       err_msg=f"layers={layers} win={win}")
+
+
+def test_generate_top_p_nucleus_sampling():
+    """top_p keeps only the smallest probability-mass prefix: with a
+    tiny nucleus every sample must coincide with greedy argmax; with
+    top_p=1-eps the distribution is unfiltered (sampling still varies
+    by seed); cached and padded paths agree under the same seed."""
+    from paddle_tpu.text import generate
+
+    paddle.seed(21)
+    cfg = LlamaConfig.tiny(vocab=32, hidden=64, layers=1, heads=2)
+    cfg.use_flash_attention = False
+    net = LlamaForCausalLM(cfg)
+    net.eval()
+    prompt = paddle.to_tensor(np.array([[1, 2, 3]], np.int64))
+
+    greedy = np.asarray(generate(net, prompt, 5).numpy())
+    # a near-zero nucleus keeps only the argmax token -> equals greedy
+    tiny_p = np.asarray(generate(net, prompt, 5, temperature=1.0,
+                                 top_p=1e-6, seed=7).numpy())
+    np.testing.assert_array_equal(tiny_p, greedy)
+
+    # same seed, same filter -> cached == padded
+    a = np.asarray(generate(net, prompt, 5, temperature=0.9, top_p=0.8,
+                            seed=3).numpy())
+    b = np.asarray(generate(net, prompt, 5, temperature=0.9, top_p=0.8,
+                            seed=3, use_cache=False).numpy())
+    np.testing.assert_array_equal(a, b)
+
+    # top_p composes with top_k (shape sanity + varies from greedy for
+    # SOME seed at high temperature)
+    outs = {tuple(np.asarray(generate(
+        net, prompt, 5, temperature=2.0, top_k=8, top_p=0.95,
+        seed=s).numpy())[0]) for s in range(6)}
+    assert len(outs) > 1
