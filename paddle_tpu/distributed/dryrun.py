@@ -189,6 +189,8 @@ def run_dryrun(n_devices: int) -> None:
     _dryrun_context_parallel(jax, n_devices)
     _dryrun_hybrid_3d(jax, n_devices)
     _dryrun_dcn(jax, n_devices)
+    _dryrun_llama_4d(jax, n_devices)
+    _dryrun_llama_sep(jax, n_devices)
 
 
 def _dryrun_pipeline(jax, n_devices: int) -> None:
@@ -773,3 +775,132 @@ def _dryrun_hybrid_3d(jax, n_devices: int) -> None:
             o1).numpy()) for _ in range(2)]
 
     _assert_aligned("3d", [l0, l1], _single_device_losses(jax, single_run))
+
+
+def _llama_tiny_cfg(layers=4):
+    """The flagship model at dryrun geometry: every feature the bench
+    config exercises — GQA (4 q heads over 2 kv heads), sliding window,
+    flash attention (XLA fallback under shard_map on CPU) — at sizes
+    that divide mp=2 / sharding=2 cleanly."""
+    from paddle_tpu.text.models import LlamaConfig
+    return LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=layers, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+        sliding_window=6, use_flash_attention=True)
+
+
+def _dryrun_llama_4d(jax, n_devices: int) -> None:
+    """Phase 7: flagship composition — the REAL LlamaForCausalLM module
+    tree (GQA + sliding window + flash fallback + TP layers) trained
+    through the compiled pipeline on a pp x dp x sharding x mp mesh,
+    stacked block params ZeRO-3-sharded over 'sharding', acc-aligned
+    vs the single-device run (VERDICT r4 next #1; reference
+    test/auto_parallel/hybrid_strategy/semi_auto_parallel_llama_model.py
+    + fleet/base/topology.py:306 axis order)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed.fleet.meta_parallel import PipelineParallel
+    from paddle_tpu.text.models import build_llama_pipe, force_tp_layers
+
+    if n_devices % 8 != 0:
+        print("dryrun llama4d: skipped (needs a multiple of 8 devices)")
+        return
+    pp, sh, mp = 2, 2, 2
+    dp = n_devices // 8
+    mesh_mod.set_mesh(mesh_mod.build_mesh(
+        {"pp": pp, "dp": dp, "sharding": sh, "mp": mp}))
+
+    cfg = _llama_tiny_cfg(layers=4)
+    batch, seq = 4 * dp, 16
+    strategy = fleet.DistributedStrategy()
+    strategy.pipeline_configs["accumulate_steps"] = 2
+
+    rng = np.random.default_rng(21)
+    ids_np = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+    lab_np = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+
+    def run(num_stages):
+        paddle.seed(0)
+        with force_tp_layers():
+            pl = build_llama_pipe(cfg, num_stages=num_stages)
+        model = PipelineParallel(pl, strategy=strategy)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=pl.parameters())
+        with jax.set_mesh(mesh_mod.get_mesh()):
+            return [float(model.train_batch(
+                (paddle.to_tensor(ids_np), paddle.to_tensor(lab_np)),
+                opt).numpy()) for _ in range(2)]
+
+    losses = run(pp)
+    assert all(np.isfinite(v) for v in losses), losses
+    print(f"dryrun llama4d ok: pp={pp} dp={dp} sharding={sh} mp={mp} "
+          f"gqa=4/2 window=6 loss0={losses[0]:.4f} loss1={losses[1]:.4f}")
+    _assert_aligned("llama 4d", losses,
+                    _single_device_losses(jax, lambda: run(1)))
+
+
+def _dryrun_llama_sep(jax, n_devices: int) -> None:
+    """Phase 8: flagship long-context composition — the REAL
+    LlamaForCausalLM with ring attention over 'sep' composed with
+    ZeRO-3 'sharding' + mp (+dp), fused linear CE loss head, acc-aligned
+    vs the single-device run (VERDICT r4 next #1 second point; the
+    reference snapshot has no CP — SURVEY §2.3 requires it here)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed.parallel_step import DistributedTrainStep
+    from paddle_tpu.text.models import LlamaForCausalLM, force_tp_layers
+
+    if n_devices % 8 != 0:
+        print("dryrun llama-sep: skipped (needs a multiple of 8 devices)")
+        return
+    dp = n_devices // 8
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": dp, "mp_degree": 2, "sharding_degree": 2,
+        "sep_degree": 2}
+    strategy.sharding_configs = dict(strategy.sharding_configs, stage=3,
+                                     degree=2)
+    fleet.init(is_collective=True, strategy=strategy)
+
+    cfg = _llama_tiny_cfg(layers=2)
+    cfg.fused_linear_ce = True
+    cfg.fused_ce_chunks = 2
+    batch, seq = 2 * dp, 16   # seq divides sep=2; window=6 crosses shards
+
+    rng = np.random.default_rng(22)
+    ids_np = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+    lab_np = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+
+    def loss_fn(out, _):
+        return out   # fused_linear_ce: forward(ids, labels) IS the loss
+
+    def dist_run():
+        paddle.seed(0)
+        net = LlamaForCausalLM(cfg)
+        fleet.distributed_model(net)
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.AdamW(1e-3, parameters=net.parameters()))
+        step = DistributedTrainStep(net, loss_fn, opt, sharding_stage=3)
+        return [float(step(
+            (paddle.to_tensor(ids_np), paddle.to_tensor(lab_np)),
+            paddle.to_tensor(0.0)).numpy()) for _ in range(2)]
+
+    losses = dist_run()
+    assert all(np.isfinite(v) for v in losses), losses
+    print(f"dryrun llama-sep ok: dp={dp} sharding=2 sep=2 mp=2 "
+          f"fused_ce=on loss0={losses[0]:.4f} loss1={losses[1]:.4f}")
+
+    def single_run():
+        paddle.seed(0)
+        with force_tp_layers():
+            net1 = LlamaForCausalLM(cfg)
+        opt1 = paddle.optimizer.AdamW(1e-3, parameters=net1.parameters())
+        step1 = paddle.jit.TrainStep(net1, loss_fn, opt1)
+        return [float(step1(
+            (paddle.to_tensor(ids_np), paddle.to_tensor(lab_np)),
+            paddle.to_tensor(0.0)).numpy()) for _ in range(2)]
+
+    _assert_aligned("llama sep", losses,
+                    _single_device_losses(jax, single_run))

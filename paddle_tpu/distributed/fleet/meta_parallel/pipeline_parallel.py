@@ -23,6 +23,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
 
 from ....core import random as random_mod
 from ....core import tape as tape_mod
@@ -46,6 +47,28 @@ def _uniform_bounds(n_items: int, n_stages: int):
     for st in range(n_stages):
         bounds.append(bounds[-1] + per + (1 if st < rem else 0))
     return bounds
+
+
+def _zero_spec(shape, V, shard_deg):
+    """PartitionSpec for a stacked block-param leaf at rest under ZeRO-3
+    over 'sharding': dim 0 (the [S] stage stack) on 'pp', the largest
+    remaining divisible dim split over 'sharding'. None = leave as is.
+    Shared by the in-step constraints AND the initial device_put in
+    _split_state so the arrays never arrive in a conflicting layout
+    (an XLA 'involuntary full rematerialization' + a second compile of
+    the donated step otherwise)."""
+    nlead = 2 if V > 1 else 1
+    ndim = len(shape)
+    if ndim <= nlead:
+        return None
+    dims = [d for d in range(nlead, ndim) if shape[d] % shard_deg == 0]
+    if not dims:
+        return None
+    d = max(dims, key=lambda i: shape[i])
+    entries = [None] * ndim
+    entries[0] = "pp"
+    entries[d] = "sharding"
+    return PartitionSpec(*entries)
 
 
 def _params_of(layer, trainable=True):
@@ -254,6 +277,19 @@ class PipelineParallel(MetaParallelBase):
 
         stacked = _stack_sv(sv_dicts)
         stacked_frozen = _stack_sv(sv_frozen)
+        shard_deg = mesh_mod.axis_degree("sharding")
+        if shard_deg > 1 and S > 1 and stacked:
+            # place the initial stack straight into the ZeRO at-rest
+            # layout the compiled step maintains (see _zero_spec)
+            mesh = self._mesh
+
+            def _place(a):
+                spec = _zero_spec(a.shape, V, shard_deg)
+                if spec is None:
+                    return a
+                return jax.device_put(a, NamedSharding(mesh, spec))
+
+            stacked = jax.tree_util.tree_map(_place, stacked)
         meta = dict(lo=lo, hi=hi, chunk=chunk, templates=templates,
                     stacked_frozen=stacked_frozen,
                     block_prefixes=[(pos, prefix)
@@ -430,10 +466,63 @@ class PipelineParallel(MetaParallelBase):
                     a, NamedSharding(mesh, _P("pp")))
                 if _pp_shardable(a) else a, tree)
 
+        shard_deg = mesh_mod.axis_degree("sharding")
+
+        def _zero_shard_tree(tree):
+            """ZeRO-3 over the 'sharding' axis for the stacked block
+            params (and, by application at the step's outputs, their
+            grads-at-rest and optimizer state).
+
+            Stacked leaves are [S, ...] (or [S, V, ...] interleaved);
+            dim 0 stays on 'pp' (the shard_map manual axis) and the
+            largest remaining divisible dim is stored split over
+            'sharding'. Inside the schedule GSPMD all-gathers the slice
+            transiently where a stage computes with it — the compiled
+            counterpart of the reference's stage-3 param gather
+            (group_sharded_stage3.py), composing pp x sharding in ONE
+            program. Storage-only: the constraint sets the at-rest
+            layout; compute layouts remain GSPMD's choice.
+            """
+            if shard_deg <= 1 or S <= 1:
+                return tree
+
+            def f(a):
+                spec = _zero_spec(getattr(a, "shape", ()), V, shard_deg)
+                if spec is None:
+                    return a
+                return jax.lax.with_sharding_constraint(
+                    a, NamedSharding(mesh, spec))
+
+            return jax.tree_util.tree_map(f, tree)
+
+        def _zero_gather_tree(tree):
+            """Replicate the stacked params over 'sharding' at one point
+            BEFORE the schedule's shard_map: ZeRO-3 gathers params once
+            per step (and reduce-scatters their grads at the same point
+            in backward, via the constraint's transpose). Placing the
+            all-gather here is also a hard requirement: a GSPMD-chosen
+            gather inside the schedule would sit in the lax.cond bubble
+            branch that only some pp stages execute, and a collective
+            executed by a subset of the devices in the program deadlocks
+            the rendezvous."""
+            if shard_deg <= 1 or S <= 1:
+                return tree
+
+            def f(a):
+                if getattr(a, "ndim", 0) < 1:
+                    return a
+                entries = [None] * a.ndim
+                entries[0] = "pp"
+                return jax.lax.with_sharding_constraint(
+                    a, NamedSharding(mesh, _P(*entries)))
+
+            return jax.tree_util.tree_map(f, tree)
+
         def step(pre_p, stacked, post_p, opt_state, key, lr, inputs,
                  labels):
             pre_p = _pp_shard_tree(pre_p)
             post_p = _pp_shard_tree(post_p)
+            stacked = _zero_shard_tree(stacked)
             def loss_of(trainable):
                 pre_p, stacked, post_p = trainable
                 pool = dict(pre_p)
@@ -443,7 +532,9 @@ class PipelineParallel(MetaParallelBase):
                               jax.random.fold_in(key, 1))
                 if chunk:
                     xs = split_microbatches(x, M)
-                    merged = {**{f"t:{k}": v for k, v in stacked.items()},
+                    stacked_g = _zero_gather_tree(stacked)
+                    merged = {**{f"t:{k}": v
+                                 for k, v in stacked_g.items()},
                               **{f"f:{k}": v
                                  for k, v in stacked_frozen.items()}}
                     if V > 1 and self.schedule_mode == "ZBVPP":
@@ -489,14 +580,16 @@ class PipelineParallel(MetaParallelBase):
             n_pre = _pp_shard_tree(
                 {k[len("pre."):]: v for k, v in new_flat.items()
                  if k.startswith("pre.")})
-            n_blk = {k[len("blk."):]: v for k, v in new_flat.items()
-                     if k.startswith("blk.")}
+            n_blk = _zero_shard_tree(
+                {k[len("blk."):]: v for k, v in new_flat.items()
+                 if k.startswith("blk.")})
             n_post = _pp_shard_tree(
                 {k[len("post."):]: v for k, v in new_flat.items()
                  if k.startswith("post.")})
             new_state = {
                 k: _pp_shard_tree(v)
-                if (k.startswith("pre.") or k.startswith("post.")) else v
+                if (k.startswith("pre.") or k.startswith("post.")) else
+                (_zero_shard_tree(v) if k.startswith("blk.") else v)
                 for k, v in new_state.items()}
             return n_pre, n_blk, n_post, new_state, loss
 

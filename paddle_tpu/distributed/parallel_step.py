@@ -35,6 +35,8 @@ def _shard_leaf_over(arr, axis: str, mesh):
 
 
 def _batch_sharding(mesh, ndim):
+    if ndim == 0:
+        return None   # scalars (e.g. a dummy label) have no batch dim
     axes = [ax for ax in ("dp", "sharding")
             if mesh_mod.axis_degree(ax) > 1]
     if not axes:

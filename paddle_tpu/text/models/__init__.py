@@ -1,4 +1,5 @@
 from .bert import BertConfig, BertForPretraining, BertModel  # noqa: F401
 from .ernie_moe import ErnieMoEConfig, ErnieMoEForCausalLM  # noqa: F401
 from .llama import (LlamaConfig, LlamaDecoderLayer,  # noqa: F401
-                    LlamaForCausalLM, LlamaModel, llama_flops_per_token)
+                    LlamaForCausalLM, LlamaModel, build_llama_pipe,
+                    force_tp_layers, llama_flops_per_token)

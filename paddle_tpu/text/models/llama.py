@@ -116,8 +116,34 @@ class LlamaRMSNorm(Layer):
         return F.rms_norm(x, self.weight, epsilon=self.eps)
 
 
+# When True, the parallel layer classes (VocabParallelEmbedding,
+# Column/RowParallelLinear) are used even on an mp=1 mesh. They hold
+# GLOBAL weights whose sharding degrades to Replicate at degree 1, so
+# numerics and RNG draw order are identical to the plain classes — the
+# knob exists so a single-device alignment run can build the exact same
+# module tree as a TP run (reference counterpart: the dist/single
+# acc-align tests in test/auto_parallel/hybrid_strategy).
+_FORCE_TP = False
+
+
+class force_tp_layers:
+    """Context manager: build LLaMA modules with the parallel layer
+    classes regardless of the current mesh's 'mp' degree."""
+
+    def __enter__(self):
+        global _FORCE_TP
+        self._prev = _FORCE_TP
+        _FORCE_TP = True
+        return self
+
+    def __exit__(self, *exc):
+        global _FORCE_TP
+        _FORCE_TP = self._prev
+        return False
+
+
 def _use_tp():
-    return mesh_mod.axis_degree("mp") > 1
+    return _FORCE_TP or mesh_mod.axis_degree("mp") > 1
 
 
 class LlamaAttention(Layer):
@@ -456,6 +482,67 @@ class LlamaForCausalLM(Layer):
 
     def num_params(self):
         return sum(math.prod(p.shape) for _, p in self.named_parameters())
+
+
+def _tied_head(embed_layer, x):
+    """Tied lm head for the pipeline build: logits = h @ E^T, reading
+    the (possibly vocab-sharded) embedding weight; the feature dim is
+    gathered like ColumnParallelLinear(gather_output=True)."""
+    out = x.matmul(embed_layer.weight.t())
+    from ...distributed.fleet.layers.mpu.mp_ops import UNSET, mark_sharding
+    entries = [UNSET] * (len(out.shape) - 1) + [None]
+    return mark_sharding(out, *entries)
+
+
+def build_llama_pipe(config: LlamaConfig, num_stages=None, loss_fn=None):
+    """PipelineLayer view of LlamaForCausalLM for pipeline-parallel
+    training: [embedding] + num_hidden_layers homogeneous
+    LlamaDecoderLayer blocks + [final RMSNorm, lm head].
+
+    The decoder blocks form the homogeneous run PipelineParallel
+    stacks-and-pipelines; embedding and norm+head are the prefix/suffix
+    (pp-sharded by _pp_shard_tree). Construction order matches
+    LlamaForCausalLM so paddle.seed(k) yields identical initial weights
+    — the basis for the dist/single acc-align dryrun.
+
+    config.tie_word_embeddings maps to a SharedLayerDesc pair (the
+    embedding weight is ONE Parameter used at both ends — its gradient
+    is the summed cotangent, the compiled analog of the reference's
+    shared-weight allreduce); config.recompute maps to the schedule's
+    per-stage remat (PipelineLayer recompute_interval).
+
+    Reference: the PipelineLayer LLaMA used by the reference's hybrid
+    acc-align suite (test/auto_parallel/hybrid_strategy/
+    semi_auto_parallel_llama_model.py with pp>1 via
+    fleet/meta_parallel/parallel_layers/pp_layers.py segmentation).
+    """
+    from ...distributed.fleet.meta_parallel import (PipelineLayer,
+                                                    SharedLayerDesc)
+    from ...nn import CrossEntropyLoss
+    c = config
+    embed_cls = VocabParallelEmbedding if _use_tp() else Embedding
+    # build the embedding FIRST either way: SharedLayerDesc is lazy, and
+    # a deferred build would consume RNG draws after the blocks, breaking
+    # same-seed parity with LlamaForCausalLM
+    embed = embed_cls(c.vocab_size, c.hidden_size)
+    if c.tie_word_embeddings:
+        first = SharedLayerDesc("tok_embed", lambda: embed)
+    else:
+        first = embed
+    blocks = [LlamaDecoderLayer(c) for _ in range(c.num_hidden_layers)]
+    norm = LlamaRMSNorm(c.hidden_size, c.rms_norm_eps)
+    if c.tie_word_embeddings:
+        head = SharedLayerDesc("tok_embed", lambda: embed,
+                               forward_func=_tied_head)
+    elif _use_tp():
+        head = ColumnParallelLinear(c.hidden_size, c.vocab_size,
+                                    has_bias=False, gather_output=True)
+    else:
+        head = Linear(c.hidden_size, c.vocab_size, bias_attr=False)
+    return PipelineLayer([first] + blocks + [norm, head],
+                         num_stages=num_stages,
+                         recompute_interval=1 if c.recompute else 0,
+                         loss_fn=loss_fn or CrossEntropyLoss())
 
 
 def llama_flops_per_token(config: LlamaConfig) -> float:
