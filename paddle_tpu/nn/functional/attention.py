@@ -168,39 +168,33 @@ def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
 
 
 def flashmask_attention(query, key, value, startend_row_indices=None,
-                        dropout=0.0, causal=False, name=None):
-    """reference nn/functional/flash_attention.py:1098 flashmask_attention:
-    sparse attention masks described by per-column start/end row indices.
-    Lowered to a dense additive mask + the flash kernel (XLA fuses the
-    mask into the attention computation)."""
-    import jax
-    import jax.numpy as jnp
+                        dropout=0.0, causal=False, window_size=None,
+                        name=None):
+    """reference nn/functional/flash_attention.py:1098 flashmask_attention
+    (FlashMask, arXiv:2410.01359): sparse attention masks described by
+    per-key-column start/end row indices, [b, h_se, s_k, {1,2,4}] int32.
 
-    from ...core.dispatch import run_op
+    TPU-native: the indices stream into the Pallas flash kernel as
+    per-column row BANDS — mask memory is O(S), never the [b,h,s,s]
+    dense tensor, and key tiles whose rows are fully covered by a band
+    are skipped entirely (the column-sparsity win, e.g. cross-document
+    blocks in causal document masking). Shapes that don't tile fall back
+    to the XLA dense-mask path with identical semantics.
+
+    window_size: int sliding window composed with the mask (causal only,
+    like the reference's flashmask window_size).
+    """
+    from ...kernels import flash_attention as kernel_mod
 
     if startend_row_indices is None:
         out, _ = flash_attention(query, key, value, dropout=dropout,
-                                 causal=causal)
+                                 causal=causal, window=window_size)
         return out
-
-    def fn(q, k, v, se):
-        # q,k,v: [b, s, h, d]; se: [b, kv_heads, s_k, {1,2}]
-        s_q, s_k = q.shape[1], k.shape[1]
-        # rows broadcast against per-COLUMN start/end indices:
-        # mask shape [b, h, s_q, s_k]
-        rows = jnp.arange(s_q)[None, None, :, None]
-        if se.shape[-1] == 1:
-            # LT-start: key column j is masked for query rows
-            # q >= start[j] (the flashmask causal-document pattern)
-            start = se[..., 0][..., None, :]        # [b, h, 1, s_k]
-            masked = rows >= start
-        else:
-            # [start, end) band per column masked
-            start = se[..., 0][..., None, :]
-            end = se[..., 1][..., None, :]
-            masked = (rows >= start) & (rows < end)
-        mask = jnp.where(masked, -jnp.inf, 0.0).astype(q.dtype)
-        return _sdpa_core(q, k, v, mask=mask, causal=causal)
-
-    return run_op("flashmask_attention", fn,
-                  [query, key, value, startend_row_indices])
+    if window_size is not None:
+        window_size = int(window_size)
+        if not causal:
+            raise ValueError(
+                "flashmask window_size requires causal=True")
+    return kernel_mod.flash_attention(
+        query, key, value, causal=causal, window=window_size,
+        startend_row_indices=startend_row_indices)
