@@ -235,13 +235,20 @@ def bench_ernie_moe(cfg=None, batch=32, seq=512, n_steps=6):
     return batch * seq / dt
 
 
-def bench_llama_decode(batch=32, prompt=128, new_tokens=256):
+def bench_llama_decode(batch=32, prompt=128, new_tokens=256,
+                       quantize=False, cache_impl="auto", window=None):
     """Compiled KV-cache decode throughput on the 1B model (inference
     axis of BASELINE config 4): greedy text.generate — prefill + one
     lax.scan of single-token cached steps — new tokens/sec across the
     batch. Decode is weight-bandwidth bound, so throughput scales with
     batch (measured: 1.6K @ b8, 5.9K @ b32, 7.9K @ b64); b32 is the
-    reported point."""
+    reported point.
+
+    quantize=True converts the model to int8 weight-only execution
+    (quantization.quantize_for_inference) — half the weight bytes, the
+    lever that matters on a bandwidth-bound decode. cache_impl/window
+    select the serving-cache layout points (paged block-table, rolling
+    sliding-window buffer)."""
     import paddle_tpu as paddle
     from paddle_tpu.text import generate
     from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
@@ -252,18 +259,26 @@ def bench_llama_decode(batch=32, prompt=128, new_tokens=256):
         num_hidden_layers=4, num_attention_heads=32,
         num_key_value_heads=32,
         max_position_embeddings=prompt + new_tokens,
+        sliding_window=window,
         use_flash_attention=True)
     net = LlamaForCausalLM(cfg)
     net.eval()
+    if quantize:
+        from paddle_tpu.quantization import quantize_for_inference
+        quantize_for_inference(net)
     rng = np.random.default_rng(0)
     ids = paddle.to_tensor(
         rng.integers(0, cfg.vocab_size, (batch, prompt)).astype(np.int64))
-    out = generate(net, ids, max_new_tokens=new_tokens)   # compile
-    np.asarray(out.numpy())
+
+    def run():
+        return generate(net, ids, max_new_tokens=new_tokens,
+                        cache_impl=cache_impl)
+
+    np.asarray(run().numpy())                             # compile
     best = float("inf")
     for _ in range(2):
         t0 = time.perf_counter()
-        out = generate(net, ids, max_new_tokens=new_tokens)
+        out = run()
         np.asarray(out.numpy())
         best = min(best, time.perf_counter() - t0)
     return batch * new_tokens / best
@@ -406,6 +421,22 @@ def main():
         tok = bench_llama_decode()
         result["extras"]["llama_1b_decode_tokens_per_sec"] = round(tok, 1)
 
+    def add_decode_int8():
+        tok = bench_llama_decode(quantize=True)
+        result["extras"]["llama_1b_decode_int8_tokens_per_sec"] = \
+            round(tok, 1)
+
+    def add_decode_paged():
+        tok = bench_llama_decode(cache_impl="paged")
+        result["extras"]["llama_1b_decode_paged_tokens_per_sec"] = \
+            round(tok, 1)
+
+    def add_decode_window():
+        # sliding_window 128 < total 384: the rolling O(window) buffer
+        tok = bench_llama_decode(window=128)
+        result["extras"]["llama_1b_decode_rolling_tokens_per_sec"] = \
+            round(tok, 1)
+
     # (name, runner, wall-clock cost estimate in seconds: compile+measure
     # on the tunneled chip, cold cache — estimates from the round-4
     # dress-rehearsal runs). Ordered so every BASELINE config (4-long-ctx,
@@ -420,6 +451,9 @@ def main():
         ("llama_small_seq512", lambda: add_llama("llama_small_seq512",
                                                  bench_llama_small), 180),
         ("llama_decode", add_decode, 240),
+        ("llama_decode_int8", add_decode_int8, 240),
+        ("llama_decode_paged", add_decode_paged, 240),
+        ("llama_decode_rolling", add_decode_window, 240),
     ]
     skipped = []
     for name, run, est in extras:

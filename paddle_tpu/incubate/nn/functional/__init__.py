@@ -595,6 +595,11 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
         import numpy as _np
         from ....core.dispatch import unwrap as _unw
         lens_v = _unw(sequence_lengths)
+        # NOTE: this concrete check forces a host sync (device->host
+        # fetch of the positions) on every EAGER decode step — wrap the
+        # serving loop in jit to skip it (traced positions bypass the
+        # check, and the scatter then silently drops out-of-range
+        # writes; keep capacity invariants in the caller).
         if not isinstance(lens_v, jax.core.Tracer):
             pmax = int(_np.max(_np.asarray(lens_v)))
             if pmax >= max_seq:

@@ -36,8 +36,10 @@ def paged_attention_arrays(q, k_cache, v_cache, block_tables, context_lens,
     """One decode step of attention against a paged KV cache.
 
     q:            [b, h, d]           — this step's query (one token/seq).
-    k_cache/v_cache: [num_blocks, block_size, h_kv, d] — the global page
-                  pool; h_kv may divide h (GQA).
+    k_cache/v_cache: [num_blocks, h_kv, block_size, d] — the global page
+                  pool; h_kv may divide h (GQA). Head-major layout so
+                  the Pallas decode kernel's [block_size, d] page tiles
+                  are the (tile-aligned) trailing dims.
     block_tables: [b, max_blocks] int — page ids per sequence, in order;
                   entries past the sequence's pages may be any valid id
                   (masked out by context_lens).
@@ -46,7 +48,7 @@ def paged_attention_arrays(q, k_cache, v_cache, block_tables, context_lens,
     Returns [b, h, d].
     """
     b, h, d = q.shape
-    nb, bs, h_kv, _ = k_cache.shape
+    nb, h_kv, bs, _ = k_cache.shape
     if h_kv < 1 or h % h_kv:
         raise ValueError(
             f"GQA requires query heads ({h}) to be a multiple of cache "
@@ -55,12 +57,9 @@ def paged_attention_arrays(q, k_cache, v_cache, block_tables, context_lens,
         scale = 1.0 / math.sqrt(d)
     rep = h // h_kv
 
-    # gather each sequence's pages: [b, max_blocks, bs, h_kv, d]
-    k = jnp.take(k_cache, block_tables, axis=0)
-    v = jnp.take(v_cache, block_tables, axis=0)
+    k = gather_pages(k_cache, block_tables)
+    v = gather_pages(v_cache, block_tables)
     L = block_tables.shape[1] * bs
-    k = k.reshape(b, L, h_kv, d)
-    v = v.reshape(b, L, h_kv, d)
     # GQA served by grouped einsum — no rep-times K/V copy over the
     # gathered pages (same idea as flash_attention's kv index map)
     qg = q.reshape(b, h_kv, rep, d).astype(jnp.float32)
@@ -76,20 +75,44 @@ def paged_attention_arrays(q, k_cache, v_cache, block_tables, context_lens,
     return out.reshape(b, h, d).astype(q.dtype)
 
 
-def paged_write_arrays(k, v, k_cache, v_cache, block_tables, positions):
-    """Append one token's k/v per sequence into the paged cache.
+def gather_pages(cache, block_tables):
+    """Materialize each sequence's pages as a contiguous [b, L, h_kv, d]
+    view (L = max_blocks * block_size) from the head-major pool. ONE
+    XLA gather — but it COPIES the visible cache, which is why the
+    decode hot path uses paged_decode_pallas instead."""
+    nb, h_kv, bs, d = cache.shape
+    b = block_tables.shape[0]
+    L = block_tables.shape[1] * bs
+    g = jnp.take(cache, block_tables, axis=0)   # [b, mb, h_kv, bs, d]
+    return jnp.swapaxes(g, 2, 3).reshape(b, L, h_kv, d)
 
-    k/v:        [b, h_kv, d] — this step's keys/values.
-    positions:  [b] int      — each sequence's token position (the page
-                is block_tables[seq, pos // block_size], the slot
-                pos % block_size).
+
+def paged_write_arrays(k, v, k_cache, v_cache, block_tables, positions):
+    """Append token k/v per sequence into the paged cache.
+
+    k/v:        [b, h_kv, d] (one token/seq) or [b, s, h_kv, d] (a
+                prefill chunk of s consecutive tokens/seq). The pool is
+                head-major [num_blocks, h_kv, block_size, d].
+    positions:  [b] int — each sequence's (FIRST) token position; chunk
+                token i lands at position + i. The page is
+                block_tables[seq, pos // block_size], the slot
+                pos % block_size.
     Returns the updated (k_cache, v_cache).
     """
-    nb, bs, h_kv, d = k_cache.shape
+    nb, h_kv, bs, d = k_cache.shape
     b = k.shape[0]
+    squeeze = k.ndim == 3
+    if squeeze:
+        k, v = k[:, None], v[:, None]
+    s = k.shape[1]
     capacity = block_tables.shape[1] * bs
+    # NOTE: the concrete capacity check below costs a host sync per
+    # EAGER call (jnp.max fetch); jit-compiled serving loops trace past
+    # it. Contract not validated here: block-table rows must not alias
+    # the same page across sequences — aliased pages are silently
+    # last-write-wins.
     if not isinstance(positions, jax.core.Tracer):
-        pmax = int(jnp.max(positions))
+        pmax = int(jnp.max(positions)) + s - 1
         if pmax >= capacity:
             # take_along_axis would silently CLIP the page index and
             # overwrite the last page's slots — corrupting cached
@@ -99,12 +122,138 @@ def paged_write_arrays(k, v, k_cache, v_cache, block_tables, positions):
                 f"position {pmax} exceeds the sequence's block-table "
                 f"capacity {capacity} ({block_tables.shape[1]} pages x "
                 f"block_size {bs}) — grow the block table first")
-    page = jnp.take_along_axis(
-        block_tables, (positions // bs)[:, None], axis=1)[:, 0]   # [b]
-    slot = positions % bs
-    k_cache = k_cache.at[page, slot].set(k.astype(k_cache.dtype))
-    v_cache = v_cache.at[page, slot].set(v.astype(v_cache.dtype))
+    pos = positions[:, None] + jnp.arange(s, dtype=positions.dtype)[None]
+    page = jnp.take_along_axis(block_tables, pos // bs, axis=1)  # [b, s]
+    slot = pos % bs
+    # advanced indices (page, slot) straddle the ':' head slice, so the
+    # result axes are [b, s, h_kv, d] — exactly k/v's layout
+    k_cache = k_cache.at[page, :, slot].set(k.astype(k_cache.dtype))
+    v_cache = v_cache.at[page, :, slot].set(v.astype(v_cache.dtype))
     return k_cache, v_cache
+
+
+def _paged_decode_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_ref, l_ref, acc_ref, *, bs, nblocks,
+                         scale, window):
+    """One (batch, page) program of single-token paged decode over ALL
+    heads of the sequence.
+
+    Scalar-prefetched block tables drive the K/V BlockSpec index maps,
+    so each page streams HBM→VMEM directly from the global pool — the
+    XLA path's per-step gather (a full cache copy) never happens. All
+    h heads are processed in one program (grid b x pages, NOT
+    b*h*pages: at serving shapes the per-program dispatch overhead of
+    thousands of tiny programs costs more than the attention itself).
+    Scores are VPU broadcast-multiply-reduce, not MXU dots — decode
+    attention is HBM-bandwidth bound and the per-head matvecs are too
+    skinny to feed the systolic array anyway. Online-softmax state per
+    q head accumulates in VMEM scratch across the page-minor grid dim.
+
+    Refs: q [h, d] (h = h_kv * rep, GQA rows grouped kv-head-major),
+    k/v [h_kv, bs, d], o [h, d]; scratch m/l [h, 128], acc [h, d].
+    """
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    neg_inf = jnp.float32(NEG_INF)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, neg_inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[...].astype(jnp.float32) * jnp.float32(scale)   # [h, d]
+    k = k_ref[...].astype(jnp.float32)                        # [hkv,bs,d]
+    v = v_ref[...].astype(jnp.float32)
+    h, d = q.shape
+    h_kv = k.shape[0]
+    rep = h // h_kv
+    if rep > 1:
+        # repeat kv heads to per-q-head rows INSIDE VMEM (bs*d per head
+        # — tiny); keeps every elementwise shape 3-D kv-head-major
+        k = jnp.repeat(k, rep, axis=0)                        # [h,bs,d]
+        v = jnp.repeat(v, rep, axis=0)
+    s = jnp.sum(q[:, None, :] * k, axis=-1)                   # [h, bs]
+    pos = cl_ref[i].astype(jnp.int32) - jnp.int32(1)
+    k_pos = (j.astype(jnp.int32) * jnp.int32(bs)
+             + jax.lax.broadcasted_iota(jnp.int32, (h, bs), 1))
+    keep = k_pos <= pos
+    if window is not None:
+        keep = jnp.logical_and(keep, pos - k_pos < jnp.int32(window))
+    s = jnp.where(keep, s, neg_inf)
+
+    m_prev = m_ref[:, :1]
+    l_prev = l_ref[:, :1]
+    m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.exp(s - m_cur)
+    p = jnp.where(s > neg_inf * 0.5, p, 0.0)
+    alpha = jnp.exp(m_prev - m_cur)
+    l_cur = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jnp.sum(
+        p[:, :, None] * v, axis=1)                            # [h, d]
+    m_ref[...] = jnp.broadcast_to(m_cur, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_cur, l_ref.shape)
+
+    @pl.when(j == nblocks - 1)
+    def _fin():
+        l_safe = jnp.maximum(l_ref[:, :1], jnp.float32(1e-30))
+        valid = m_ref[:, :1] > neg_inf * 0.5
+        o_ref[...] = jnp.where(valid, acc_ref[...] / l_safe,
+                               0.0).astype(o_ref.dtype)
+
+
+def paged_decode_pallas(q, k_cache, v_cache, block_tables, context_lens,
+                        scale=None, window=None, interpret=False):
+    """Pallas single-token paged decode: q [b, h, d] against the page
+    pool, masked to context_lens (and a sliding window). Returns
+    [b, h, d]. Requires d % 128 == 0 and block_size % 8 == 0."""
+    import functools
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from .flash_attention import _x32_trace
+
+    b, h, d = q.shape
+    nb, h_kv, bs, _ = k_cache.shape
+    nblocks = block_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    bt = jnp.asarray(block_tables, jnp.int32)
+    cl = jnp.asarray(context_lens, jnp.int32)
+
+    kernel = functools.partial(
+        _paged_decode_kernel, bs=bs, nblocks=nblocks,
+        scale=float(scale),
+        window=None if window is None else int(window))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nblocks),
+        in_specs=[
+            pl.BlockSpec((None, h, d), lambda i, j, bt, cl: (i, 0, 0)),
+            pl.BlockSpec((None, h_kv, bs, d),
+                         lambda i, j, bt, cl: (bt[i, j], 0, 0, 0)),
+            pl.BlockSpec((None, h_kv, bs, d),
+                         lambda i, j, bt, cl: (bt[i, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, h, d),
+                               lambda i, j, bt, cl: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, d), jnp.float32),
+        ],
+    )
+    with _x32_trace():
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+            interpret=interpret,
+        )(bt, cl, q, k_cache, v_cache)
+    return out
 
 
 def paged_attention(query, k_cache, v_cache, block_tables, context_lens,

@@ -10,6 +10,9 @@ from .functional import (  # noqa: F401
     fake_quantize_abs_max, fake_quantize_dequantize_abs_max,
     quantize_linear, dequantize_linear)
 from .qat import QAT, PTQ, QuantConfig  # noqa: F401
+from .layers import (  # noqa: F401
+    WeightOnlyLinear, quantize_for_inference,
+)
 from . import observers  # noqa: F401,E402
 from . import quanters  # noqa: F401,E402
 from .observers import AbsmaxObserver, BaseObserver  # noqa: F401,E402

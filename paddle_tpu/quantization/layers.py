@@ -1,0 +1,158 @@
+"""Quantized execution layers — the output of PTQ.convert /
+quantize_for_inference.
+
+Reference capability: python/paddle/nn/quant/qat + the serving-side
+quantized layers that execute weight_only_linear / llm_int8_linear
+(paddle/phi/kernels/funcs/weight_only_gemv.cu,
+gpu/llm_int8_linear_kernel.cu). TPU-native: int8 weights live in HBM at
+half the bf16 bytes and the dequant fuses into the matmul (see
+nn/quant.weight_only_linear) — the uplift target is weight-bandwidth-
+bound decode.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dispatch import unwrap, wrap
+from ..nn.layer.layers import Layer
+
+
+class WeightOnlyLinear(Layer):
+    """Linear executing with int8 (or int4-in-int8) weights + per-column
+    scales. Built from a float Linear via ``from_linear``; forward runs
+    nn.quant.weight_only_linear (fused post-matmul dequant).
+
+    The quantized weight and scale are registered as BUFFERS: they are
+    not trainable, must survive state_dict round trips, and ride through
+    functional_call (so a converted model works inside the compiled
+    decode loop of text.generate).
+    """
+
+    def __init__(self, in_features, out_features, has_bias=True,
+                 weight_dtype="int8"):
+        super().__init__()
+        self._in_features = int(in_features)
+        self._out_features = int(out_features)
+        self.weight_dtype = weight_dtype
+        self.register_buffer(
+            "weight", wrap(np.zeros((in_features, out_features), np.int8)))
+        self.register_buffer(
+            "weight_scale",
+            wrap(np.ones((out_features,), np.float32)))
+        if has_bias:
+            self.register_buffer(
+                "bias", wrap(np.zeros((out_features,), np.float32)))
+        else:
+            self.bias = None
+
+    @classmethod
+    def from_linear(cls, linear, weight_dtype="int8"):
+        """Quantize a float Linear-layout layer (weight [in, out]) into
+        an executing WeightOnlyLinear. When the source is a TP layer
+        (Column/RowParallelLinear) the int8 weight and scales are
+        committed to the SAME 'mp' sharding the float weight carried —
+        otherwise every chip would hold a replicated int8 copy,
+        defeating the halve-the-HBM-bytes point of the conversion."""
+        from ..distributed.fleet.layers.mpu import (ColumnParallelLinear,
+                                                    RowParallelLinear)
+        from .functional import weight_quantize
+        algo = ("weight_only_int4" if weight_dtype == "int4"
+                else "weight_only_int8")
+        w = linear.weight
+        q, scale = weight_quantize(w, algo=algo)
+        in_f, out_f = w.shape
+        bias = getattr(linear, "bias", None)
+        lyr = cls(in_f, out_f, has_bias=bias is not None,
+                  weight_dtype=weight_dtype)
+        lyr._buffers["weight"] = wrap(unwrap(q))
+        lyr._buffers["weight_scale"] = wrap(unwrap(scale))
+        if bias is not None:
+            lyr._buffers["bias"] = wrap(unwrap(bias))
+        if isinstance(linear, ColumnParallelLinear):
+            lyr._tp_kind = ("col", bool(linear.gather_output))
+            lyr._shard_buffers(weight_dim=1, scale_dim=0)
+        elif isinstance(linear, RowParallelLinear):
+            lyr._tp_kind = ("row", bool(linear.input_is_parallel))
+            lyr._shard_buffers(weight_dim=0, scale_dim=None)
+        return lyr
+
+    # TP conversion state: how forward must mark activations, mirroring
+    # the source parallel layer (mp_ops mark_sharding); None = plain
+    _tp_kind = None
+
+    def _shard_buffers(self, weight_dim, scale_dim):
+        """Commit the int8 weight (and per-out-channel scales) to the
+        'mp' mesh axis, mirroring mpu._shard_param."""
+        from ..distributed import mesh as mesh_mod
+        from ..distributed.auto_parallel import (Replicate, Shard,
+                                                 shard_tensor)
+        from ..distributed.auto_parallel.process_mesh import ProcessMesh
+        if mesh_mod.axis_degree("mp") <= 1:
+            return
+        mesh = ProcessMesh(mesh_mod.ensure_mesh())
+        mp_idx = mesh.dim_names.index("mp")
+
+        def commit(name, dim):
+            t = self._buffers[name]
+            placements = [Replicate() for _ in mesh.dim_names]
+            placements[mp_idx] = Shard(dim)
+            self._buffers[name] = shard_tensor(t, mesh, placements,
+                                               stop_gradient=True)
+
+        commit("weight", weight_dim)
+        if scale_dim is not None:
+            commit("weight_scale", scale_dim)
+            if self._buffers.get("bias") is not None:
+                commit("bias", scale_dim)
+
+    def forward(self, x):
+        from ..nn.quant import weight_only_linear
+        if self._tp_kind is not None:
+            from ..distributed.fleet.layers.mpu.mp_ops import (
+                UNSET, mark_sharding)
+            kind, flag = self._tp_kind
+            if kind == "row" and flag:      # input_is_parallel
+                x = mark_sharding(
+                    x, *([UNSET] * (len(x.shape) - 1) + ["mp"]))
+        out = weight_only_linear(x, self.weight, self.bias,
+                                 self.weight_scale,
+                                 weight_dtype=self.weight_dtype)
+        if self._tp_kind is not None:
+            kind, flag = self._tp_kind
+            # column: gather_output=False keeps the feature dim
+            # mp-sharded; True (and row) replicate it
+            last = "mp" if (kind == "col" and not flag) else None
+            out = mark_sharding(
+                out, *([UNSET] * (len(out.shape) - 1) + [last]))
+        return out
+
+    def extra_repr(self):
+        return (f"in={self._in_features}, out={self._out_features}, "
+                f"dtype={self.weight_dtype}")
+
+
+def quantize_for_inference(model, weight_dtype="int8", targets=None):
+    """Swap every Linear-layout sublayer for an executing
+    WeightOnlyLinear (weights become int8 in HBM). IN PLACE; returns the
+    model. The serving entry used for quantized decode
+    (text.generate on a converted LlamaForCausalLM).
+
+    targets: layer classes to convert (default: nn.Linear and the
+    Column/RowParallel TP linears, which share the [in, out] weight
+    layout)."""
+    from ..distributed.fleet.layers.mpu import (ColumnParallelLinear,
+                                                RowParallelLinear)
+    from ..nn.layer.common import Linear
+    if targets is None:
+        targets = (Linear, ColumnParallelLinear, RowParallelLinear)
+
+    def walk(layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, targets):
+                layer._sub_layers[name] = WeightOnlyLinear.from_linear(
+                    sub, weight_dtype=weight_dtype)
+            else:
+                walk(sub)
+
+    walk(model)
+    return model

@@ -65,6 +65,15 @@ def _wrap_model(model: Layer, config: QuantConfig, quant_weights):
     return model
 
 
+def _maybe_copy(model: Layer, inplace: bool) -> Layer:
+    """Reference qat.py/ptq.py contract: inplace=False (the default)
+    leaves the caller's model untouched and returns a converted copy."""
+    if inplace:
+        return model
+    import copy
+    return copy.deepcopy(model)
+
+
 class QAT:
     """Quant-aware training (reference quantization/qat.py)."""
 
@@ -72,18 +81,45 @@ class QAT:
         self.config = config
 
     def quantize(self, model: Layer, inplace=False):
-        return _wrap_model(model, self.config, quant_weights=True)
+        return _wrap_model(_maybe_copy(model, inplace), self.config,
+                           quant_weights=True)
 
 
 class PTQ:
     """Post-training quantization (reference quantization/ptq.py):
-    activation observers only."""
+    ``quantize`` inserts calibration-time fake-quant wrappers;
+    ``convert`` emits a model that EXECUTES quantized — each wrapped
+    Linear becomes a WeightOnlyLinear holding real int8 weights +
+    per-channel scales (reference convert produces the
+    weight_only_linear/llm_int8 serving graph). Conv wrappers are
+    unwrapped back to float (the TPU quantized-execution surface
+    targets the matmul family)."""
 
     def __init__(self, config: QuantConfig):
         self.config = config
 
     def quantize(self, model: Layer, inplace=False):
-        return _wrap_model(model, self.config, quant_weights=False)
+        return _wrap_model(_maybe_copy(model, inplace), self.config,
+                           quant_weights=False)
 
     def convert(self, model: Layer, inplace=False):
+        from ..nn.layer.common import Linear
+        from .layers import WeightOnlyLinear
+
+        model = _maybe_copy(model, inplace)
+        weight_dtype = "int4" if self.config.bit_length == 4 else "int8"
+
+        def walk(layer):
+            for name, sub in list(layer._sub_layers.items()):
+                if isinstance(sub, QuantedWrapper):
+                    if isinstance(sub.inner, Linear):
+                        layer._sub_layers[name] = \
+                            WeightOnlyLinear.from_linear(
+                                sub.inner, weight_dtype=weight_dtype)
+                    else:
+                        layer._sub_layers[name] = sub.inner
+                else:
+                    walk(sub)
+
+        walk(model)
         return model

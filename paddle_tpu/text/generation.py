@@ -37,15 +37,16 @@ def generate(model, input_ids, max_new_tokens: int,
              temperature: float = 0.0, top_k: int = 0,
              top_p: float = 0.0,
              eos_token_id: Optional[int] = None, seed: int = 0,
-             use_cache: bool = True):
+             use_cache: bool = True, cache_impl: str = "auto",
+             page_size: int = 32):
     """Generate ``max_new_tokens`` continuations for ``input_ids``
     [B, S] with the causal-LM ``model``. temperature == 0 → greedy;
     otherwise softmax sampling at that temperature, optionally top-k
-    truncated and/or nucleus-filtered (``0 < top_p <= 1`` keeps the
-    smallest set of tokens whose probability mass reaches top_p; both
-    filters compose, top-k first). Rows that emit ``eos_token_id`` keep
-    their eos and stop changing. Returns a Tensor
-    [B, S + max_new_tokens].
+    truncated and/or nucleus-filtered (``0 < top_p < 1`` keeps the
+    smallest set of tokens whose probability mass reaches top_p —
+    top_p=1.0 applies no filtering; both filters compose, top-k first).
+    Rows that emit ``eos_token_id`` keep their eos and stop changing.
+    Returns a Tensor [B, S + max_new_tokens].
 
     use_cache=True runs the KV-cache decode: prefill writes the prompt
     into per-layer caches, then each scan step feeds ONE token and
@@ -54,7 +55,15 @@ def generate(model, input_ids, max_new_tokens: int,
     ``kv_caches``/``cache_index`` forward kwargs (the in-tree
     LlamaForCausalLM does, including sliding-window configs — the
     cached attention applies the window band to its mask);
-    use_cache=False is the model-agnostic padded fallback."""
+    use_cache=False is the model-agnostic padded fallback.
+
+    cache_impl selects the cache layout: "auto" = dense [B, total]
+    buffers, or a rolling O(window) buffer when the model's
+    sliding_window is shorter than the output; "dense"/"rolling" force
+    those; "paged" uses the serving block-table layout
+    (kernels/paged_attention.py) with ``page_size``-token pages —
+    numerics identical, memory allocated page-wise like the reference's
+    block_multihead_attention serving cache."""
     ids = np.asarray(unwrap(input_ids))
     b, s = ids.shape
     total = s + int(max_new_tokens)
@@ -143,7 +152,31 @@ def generate(model, input_ids, max_new_tokens: int,
         hkv = cfg.num_key_value_heads
         hd = cfg.hidden_size // cfg.num_attention_heads
         win = getattr(cfg, "sliding_window", None)
-        if win is not None and int(win) < total:
+        impl = cache_impl
+        if impl == "auto":
+            impl = ("rolling" if win is not None and int(win) < total
+                    else "dense")
+        elif impl == "rolling" and win is None:
+            raise ValueError(
+                "cache_impl='rolling' needs the model's sliding_window "
+                "set (the rolling buffer holds exactly `window` slots)")
+        if impl == "rolling" and int(win) >= total:
+            impl = "dense"   # window covers everything: dense == rolling
+        if impl == "paged":
+            # serving block-table layout: per-seq pages of `page_size`
+            # tokens from a global pool; ONE shared block table (the
+            # pool is sized exactly, so tables are just arange here —
+            # a real server hands out pages dynamically)
+            bs_ = int(page_size)
+            nblocks = -(-total // bs_)
+            bt = jnp.arange(b * nblocks, dtype=jnp.int32).reshape(
+                b, nblocks)
+            caches = [
+                (jnp.zeros((b * nblocks, hkv, bs_, hd), jnp.float32),
+                 jnp.zeros((b * nblocks, hkv, bs_, hd), jnp.float32),
+                 bt)
+                for _ in range(cfg.num_hidden_layers)]
+        elif impl == "rolling":
             # Mistral-style rolling buffer: C = window slots per layer
             # (plus a slot-position track), KV memory O(window) not
             # O(prompt + new_tokens)
@@ -189,9 +222,20 @@ def generate(model, input_ids, max_new_tokens: int,
     decode = decode_cached if use_cache else decode_padded
     # jit cache keyed on the model + every trace-baked static: a fresh
     # jax.jit(closure) per call would retrace the whole decode loop
-    # every generate() invocation
-    sig = (use_cache, b, s, total, float(temperature), int(top_k),
-           float(top_p), eos_token_id, str(ids.dtype))
+    # every generate() invocation. Config fields that shape the decode
+    # trace (cache layout, head geometry) are part of the key — mutating
+    # model.config between calls must NOT silently reuse a stale
+    # executable (e.g. toggling sliding_window flips rolling vs dense).
+    cfg = getattr(model, "config", None)
+    cfg_key = tuple(
+        (f, repr(getattr(cfg, f, None)))
+        for f in ("sliding_window", "num_hidden_layers",
+                  "num_key_value_heads", "num_attention_heads",
+                  "hidden_size", "use_flash_attention")) \
+        if cfg is not None else ()
+    sig = (use_cache, cache_impl, int(page_size), b, s, total,
+           float(temperature), int(top_k),
+           float(top_p), eos_token_id, str(ids.dtype), cfg_key)
     per_model = _jit_cache.setdefault(model, {})
     fn = per_model.get(sig)
     if fn is None:

@@ -350,9 +350,10 @@ def test_paged_attention_matches_dense(rng):
     b, h, h_kv, d, bs, max_blocks = 2, 4, 2, 8, 4, 3
     nb = 8
     rep = h // h_kv
-    kc = jnp.asarray(rng.standard_normal((nb, bs, h_kv, d)).astype(
+    # head-major page pool [nb, h_kv, bs, d]
+    kc = jnp.asarray(rng.standard_normal((nb, h_kv, bs, d)).astype(
         np.float32))
-    vc = jnp.asarray(rng.standard_normal((nb, bs, h_kv, d)).astype(
+    vc = jnp.asarray(rng.standard_normal((nb, h_kv, bs, d)).astype(
         np.float32))
     # seq 0 uses pages [5, 1, 2] with 9 tokens; seq 1 pages [0, 7, 3],
     # 5 tokens
@@ -364,8 +365,12 @@ def test_paged_attention_matches_dense(rng):
 
     for s in range(b):
         L = int(cl[s])
-        k_seq = np.concatenate([np.asarray(kc)[int(p)] for p in bt[s]])[:L]
-        v_seq = np.concatenate([np.asarray(vc)[int(p)] for p in bt[s]])[:L]
+        k_seq = np.concatenate(
+            [np.asarray(kc)[int(p)].transpose(1, 0, 2)
+             for p in bt[s]])[:L]
+        v_seq = np.concatenate(
+            [np.asarray(vc)[int(p)].transpose(1, 0, 2)
+             for p in bt[s]])[:L]
         k_rep = np.repeat(k_seq, rep, axis=1)       # [L, h, d]
         v_rep = np.repeat(v_seq, rep, axis=1)
         logits = np.einsum("hd,Lhd->hL", np.asarray(q)[s],
@@ -385,15 +390,15 @@ def test_paged_attention_matches_dense(rng):
     kc2, vc2 = paged_write_arrays(k_new, v_new, kc, vc, bt, cl)
     out2 = np.asarray(paged_attention_arrays(q, kc2, vc2, bt, cl + 1))
     # seq 0 pos 9 -> page bt[0, 2]=2 slot 1; seq 1 pos 5 -> page 7 slot 1
-    assert np.allclose(np.asarray(kc2)[2, 1], np.asarray(k_new)[0])
-    assert np.allclose(np.asarray(kc2)[7, 1], np.asarray(k_new)[1])
+    assert np.allclose(np.asarray(kc2)[2, :, 1], np.asarray(k_new)[0])
+    assert np.allclose(np.asarray(kc2)[7, :, 1], np.asarray(k_new)[1])
     assert not np.allclose(out2, out)   # the new token changed attention
 
 
 def test_paged_attention_validation(rng):
     from paddle_tpu.kernels.paged_attention import paged_attention_arrays
     q = jnp.zeros((1, 4, 8), jnp.float32)
-    kc = jnp.zeros((2, 4, 3, 8), jnp.float32)   # 3 kv heads !| 4
+    kc = jnp.zeros((2, 3, 4, 8), jnp.float32)   # 3 kv heads !| 4
     bt = jnp.zeros((1, 1), jnp.int32)
     cl = jnp.ones((1,), jnp.int32)
     with pytest.raises(ValueError, match="multiple"):
@@ -406,7 +411,8 @@ def test_paged_attention_padded_and_capacity(rng):
     from paddle_tpu.kernels.paged_attention import (paged_attention_arrays,
                                                     paged_write_arrays)
     b, h, h_kv, d, bs = 2, 4, 2, 8, 4
-    kc = jnp.asarray(rng.standard_normal((4, bs, h_kv, d)).astype(
+    # head-major pool [nb, h_kv, bs, d]
+    kc = jnp.asarray(rng.standard_normal((4, h_kv, bs, d)).astype(
         np.float32))
     bt = jnp.asarray(np.array([[0, 1], [2, 3]], np.int32))
     cl = jnp.asarray(np.array([3, 0], np.int32))
@@ -743,3 +749,70 @@ def test_flashmask_per_kv_head_masks(rng):
     for got, want in zip(g_pl, g_ref):
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-3, atol=2e-3)
+
+
+def test_paged_decode_pallas_matches_gather(rng):
+    """The Pallas paged-decode kernel (scalar-prefetched block tables,
+    interpret mode) matches the XLA gather path exactly, incl. GQA,
+    permuted tables, ragged context lengths and a sliding window."""
+    from paddle_tpu.kernels.paged_attention import (paged_attention_arrays,
+                                                    paged_decode_pallas)
+
+    b, h, h_kv, d, bs, nblocks = 3, 8, 4, 128, 8, 5
+    q = jnp.asarray(rng.standard_normal((b, h, d)).astype(np.float32))
+    kc = jnp.asarray(rng.standard_normal(
+        (b * nblocks, h_kv, bs, d)).astype(np.float32))
+    vc = jnp.asarray(rng.standard_normal(
+        (b * nblocks, h_kv, bs, d)).astype(np.float32))
+    bt = jnp.asarray(rng.permutation(b * nblocks).astype(
+        np.int32).reshape(b, nblocks))
+    cl = jnp.asarray(np.array([13, 29, 40], np.int32))
+
+    ref = paged_attention_arrays(q, kc, vc, bt, cl)
+    out = paged_decode_pallas(q, kc, vc, bt, cl, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    # windowed: only the last `window` positions stay visible
+    win = 9
+    L = nblocks * bs
+    kk = jnp.swapaxes(jnp.take(kc, bt, axis=0), 2, 3).reshape(
+        b, L, h_kv, d)
+    vv = jnp.swapaxes(jnp.take(vc, bt, axis=0), 2, 3).reshape(
+        b, L, h_kv, d)
+    qg = q.reshape(b, h_kv, 2, d).astype(jnp.float32)
+    logits = jnp.einsum("bgrd,bLgd->bgrL", qg,
+                        kk.astype(jnp.float32)) * (d ** -0.5)
+    kpos = jnp.arange(L)
+    valid = (kpos[None] < cl[:, None]) & \
+        ((cl[:, None] - 1 - kpos[None]) < win)
+    logits = jnp.where(valid[:, None, None], logits, -1e30)
+    p = jax.nn.softmax(logits, -1)
+    want = jnp.einsum("bgrL,bLgd->bgrd", p,
+                      vv.astype(jnp.float32)).reshape(b, h, d)
+    got = paged_decode_pallas(q, kc, vc, bt, cl, window=win,
+                              interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_generate_cache_impls_token_exact(rng):
+    """dense / paged / rolling cache layouts produce IDENTICAL greedy
+    tokens through the compiled generate() loop (windowed model)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.text.generation import generate
+    from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab=64, hidden=64, layers=2, heads=4)
+    cfg.sliding_window = 6
+    net = LlamaForCausalLM(cfg)
+    net.eval()
+    ids = paddle.to_tensor(rng.integers(0, 64, (3, 9)).astype(np.int64))
+    dense = np.asarray(generate(net, ids, 10,
+                                cache_impl="dense").numpy())
+    rolling = np.asarray(generate(net, ids, 10).numpy())   # auto
+    paged = np.asarray(generate(net, ids, 10, cache_impl="paged",
+                                page_size=4).numpy())
+    np.testing.assert_array_equal(rolling, dense)
+    np.testing.assert_array_equal(paged, dense)
