@@ -44,7 +44,11 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
         mask_shape = [s if i in axes else 1 for i, s in enumerate(shape)]
     else:
         mask_shape = shape
-    mask = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+    # explicit f32 uniform, NOT jax.random.bernoulli: the package runs
+    # with x64 enabled, under which bernoulli draws float64 uniforms —
+    # double the RNG bits and f64 VPU compare on every mask element
+    mask = jax.random.uniform(
+        key, mask_shape, jnp.float32) < jnp.float32(1.0 - p)
 
     def fn(a):
         if mode == "upscale_in_train":

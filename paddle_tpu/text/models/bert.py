@@ -35,6 +35,13 @@ class BertConfig:
     hidden_dropout_prob: float = 0.1
     attention_probs_dropout_prob: float = 0.1
     layer_norm_eps: float = 1e-12
+    # compute the MLM loss as a chunked fused head-matmul + softmax-CE
+    # (incubate fused_linear_cross_entropy) instead of materializing the
+    # [b, s, vocab] logits (2 GB bf16 at b64/s512 — the HBM tensor that
+    # caps the trainable batch); forward(ids, labels=...) then returns
+    # (loss, nsp_logits)
+    fused_mlm_ce: bool = False
+    fused_ce_chunks: int = 8
 
     @staticmethod
     def bert_base():
@@ -161,14 +168,31 @@ class BertForPretraining(Layer):
         # decoder tied to word embeddings
         self.nsp_head = Linear(config.hidden_size, 2)
 
-    def forward(self, input_ids, token_type_ids=None):
+    def forward(self, input_ids, token_type_ids=None, labels=None):
         seq, pooled = self.bert(input_ids, token_type_ids)
         h = self.transform_norm(F.gelu(self.transform(seq)))
         w = self.bert.embeddings.word_embeddings.weight
+        nsp_logits = self.nsp_head(pooled)
+        cfg = self.bert.config
+        if labels is not None and cfg.fused_mlm_ce:
+            from ...incubate.nn.functional import \
+                fused_linear_cross_entropy
+            # tied decoder: embedding is [V, H]; the fused CE takes the
+            # nn.Linear [H, V] layout
+            loss = fused_linear_cross_entropy(
+                h, w.t(), labels, n_chunks=cfg.fused_ce_chunks)
+            return loss, nsp_logits
 
         def decode(hh, ww):
             return jnp.einsum("bsh,vh->bsv", hh, ww)
 
         mlm_logits = run_op("mlm_decode", decode, [h, w])
-        nsp_logits = self.nsp_head(pooled)
+        if labels is not None:
+            # labels always mean "return the loss" — the dense branch
+            # computes the same mean CE over the materialized logits,
+            # so the return contract never depends on fused_mlm_ce
+            loss = F.cross_entropy(
+                mlm_logits.reshape([-1, mlm_logits.shape[-1]]),
+                labels.reshape([-1])).mean()
+            return loss, nsp_logits
         return mlm_logits, nsp_logits

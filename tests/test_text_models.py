@@ -541,3 +541,31 @@ def test_generate_top_p_nucleus_sampling():
         net, prompt, 5, temperature=2.0, top_k=8, top_p=0.95,
         seed=s).numpy())[0]) for s in range(6)}
     assert len(outs) > 1
+
+
+def test_bert_fused_mlm_ce_matches_dense():
+    """fused_mlm_ce computes the same MLM loss as dense logits + CE."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.text.models import BertConfig, BertForPretraining
+
+    rng = np.random.default_rng(3)
+    paddle.seed(5)
+    cfg = BertConfig.tiny()
+    cfg.fused_mlm_ce = True
+    cfg.fused_ce_chunks = 2
+    net = BertForPretraining(cfg)
+    net.eval()   # identical (no-dropout) forwards for the comparison
+    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size,
+                                        (2, 16)).astype(np.int64))
+    tt = paddle.to_tensor(np.zeros((2, 16), np.int64))
+    labels = paddle.to_tensor(rng.integers(0, cfg.vocab_size,
+                                           (2, 16)).astype(np.int64))
+    loss, nsp = net(ids, tt, labels)
+    # dense reference: same weights, no labels -> logits
+    logits, nsp2 = net(ids, tt)
+    ref = float(nn.CrossEntropyLoss()(logits, labels).numpy())
+    np.testing.assert_allclose(float(loss.numpy()), ref, rtol=1e-4)
+    assert tuple(nsp.shape) == (2, 2)
