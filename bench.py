@@ -210,11 +210,14 @@ def bench_bert(cfg=None, batch=256, seq=128, n_steps=10):
 
 def bench_ernie_moe(cfg=None, batch=32, seq=512, n_steps=6):
     """ERNIE-MoE causal LM step (BASELINE config 5 family, single chip):
-    tokens/sec; activated-params MFU is not well-defined single-chip, so
-    only throughput is reported. batch 32 is the measured peak with
-    GShard group-wise dispatch (71.7K tok/s — 1.9x the ungrouped
-    dispatch at the same shape, whose einsum cost is quadratic in
-    tokens; 64 regresses)."""
+    (tokens/sec, routed MFU). The MFU numerator is ACTIVE-params FLOPs
+    (top_k experts/token + router, ernie_moe_flops_per_token) — the
+    honest MoE utilization number; dense-equivalent params would
+    overstate it by num_experts/top_k on the expert FFNs. batch 32 is
+    the measured peak with GShard group-wise dispatch (71.7K tok/s —
+    1.9x the ungrouped dispatch at the same shape, whose einsum cost is
+    quadratic in tokens; 64 regresses). The einsum-vs-scatter dispatch
+    study at E 8/32/64 lives in docs/PERF.md."""
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     from paddle_tpu.text.models import ErnieMoEConfig, ErnieMoEForCausalLM
@@ -242,7 +245,13 @@ def bench_ernie_moe(cfg=None, batch=32, seq=512, n_steps=6):
     step(ids, labels)
     float(step(ids, labels).numpy())
     dt = _time_steps(lambda: step(ids, labels), n_steps)
-    return batch * seq / dt
+    tokens_per_sec = batch * seq / dt
+    from paddle_tpu.text.models.ernie_moe import ernie_moe_flops_per_token
+    peak, _ = _peak()
+    # ROUTED FLOPs (active params: top_k experts/token), not the
+    # dense-equivalent count — the honest MoE utilization number
+    mfu = tokens_per_sec * ernie_moe_flops_per_token(cfg) / peak
+    return tokens_per_sec, mfu
 
 
 def bench_llama_decode(batch=32, prompt=128, new_tokens=256,
@@ -420,8 +429,9 @@ def main():
         result["extras"]["bert_base_mfu_approx"] = round(mfu, 4)
 
     def add_moe():
-        tok = bench_ernie_moe()
+        tok, mfu = bench_ernie_moe()
         result["extras"]["ernie_moe_tokens_per_sec"] = round(tok, 1)
+        result["extras"]["ernie_moe_mfu_routed"] = round(mfu, 4)
 
     def add_resnet():
         ips = bench_resnet50()

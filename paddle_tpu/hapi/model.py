@@ -37,9 +37,15 @@ class Model:
         self._optimizer = optimizer
         self._loss = loss
         if metrics is not None:
-            self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+            metrics = metrics if isinstance(metrics, (list, tuple)) \
                 else [metrics]
-            self._metrics = list(self._metrics)
+            for m in metrics:
+                if not isinstance(m, Metric):
+                    raise TypeError(
+                        f"metric should be an instance of paddle.metric."
+                        f"Metric, got {type(m).__name__} (reference "
+                        "hapi/model.py prepare has the same check)")
+            self._metrics = list(metrics)
         amp_dtype = None
         if amp_configs:
             level = amp_configs.get("level", "O0") \
@@ -79,9 +85,7 @@ class Model:
         if self._loss is not None and labels is not None:
             loss = self._loss(out, labels)
             metrics_out.append(float(loss.numpy()))
-        for m in self._metrics:
-            c = m.compute(out, labels)
-            m.update(c)
+        self._update_metrics(out, labels)
         return metrics_out
 
     def predict_batch(self, inputs):
@@ -154,9 +158,7 @@ class Model:
                                  else [ins]))
             if self._loss is not None and labs is not None:
                 losses.append(float(self._loss(out, labs).numpy()))
-            for m in self._metrics:
-                c = m.compute(out, labs)
-                m.update(c)
+            self._update_metrics(out, labs)
         logs = {}
         if losses:
             logs["loss"] = float(np.mean(losses))
@@ -221,6 +223,18 @@ class Model:
         return _summary(self.network, input_size, dtypes=dtype)
 
     # -- helpers -------------------------------------------------------------
+    def _update_metrics(self, out, labels):
+        """Multi-output metric feeding (reference hapi/model.py: each
+        network output and each label is a SEPARATE positional arg to
+        Metric.compute — a multi-output model's metric sees
+        compute(out0, out1, ..., label0, ...))."""
+        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+        labs = (list(labels) if isinstance(labels, (list, tuple))
+                else ([] if labels is None else [labels]))
+        for m in self._metrics:
+            c = m.compute(*outs, *labs)
+            m.update(c)
+
     def _lr_scheduler(self):
         if self._optimizer is None:
             return None

@@ -100,6 +100,57 @@ def topk_gating(logits, top_k: int, capacity: int, train: bool = True,
     return dispatch.astype(logits.dtype), combine.astype(logits.dtype), aux
 
 
+def topk_gating_sparse(logits, top_k: int, capacity: int,
+                       train: bool = True, key=None,
+                       switch_jitter: float = 0.0):
+    """Sparse routing result for the scatter/gather dispatch path:
+    (expert_idx [k, N], pos [k, N], keep [k, N], combine_w [k, N], aux).
+
+    Identical routing decisions (argmax rounds, running per-expert
+    occupancy, capacity drop, Switch/GShard combine weights, aux loss)
+    to ``topk_gating`` — only the OUTPUT representation differs: indices
+    instead of the dense [N, E, C] one-hot tensors, for the
+    sort/segment dispatch whose cost is O(N * k * H) instead of the
+    dispatch einsum's O(N * E * C * H).
+    """
+    n, e = logits.shape
+    logits = apply_router_jitter(logits, switch_jitter, train, key)
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    masked = probs
+    occupancy = jnp.zeros((1, e), logits.dtype)
+    idxs, poss, keeps, gates = [], [], [], []
+    first_choice = None
+    for r in range(top_k):
+        idx = jnp.argmax(masked, axis=-1)
+        if r == 0:
+            first_choice = idx
+        onehot = jax.nn.one_hot(idx, e, dtype=logits.dtype)    # [N, E]
+        pos_in = jnp.cumsum(onehot, axis=0) - onehot + occupancy
+        pos = jnp.sum(pos_in * onehot, axis=1).astype(jnp.int32)
+        keep = pos < capacity
+        g = jnp.sum(probs * onehot, axis=1) * keep
+        occupancy = occupancy + jnp.sum(onehot, axis=0, keepdims=True)
+        idxs.append(idx.astype(jnp.int32))
+        poss.append(pos)
+        keeps.append(keep)
+        gates.append(g)
+        masked = masked * (1.0 - onehot)
+
+    if top_k == 1:
+        weights = gates
+    else:
+        denom = jnp.maximum(sum(gates), 1e-9)
+        weights = [g / denom for g in gates]
+
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(first_choice, e, dtype=probs.dtype),
+                  axis=0)
+    aux = e * jnp.sum(me * ce)
+    return (jnp.stack(idxs), jnp.stack(poss), jnp.stack(keeps),
+            jnp.stack(weights).astype(logits.dtype), aux)
+
+
 class BaseGate:
     def __init__(self, num_experts: int, top_k: int,
                  capacity_factor: float = 1.25, jitter: float = 0.0):

@@ -116,6 +116,12 @@ class MoELayer(Layer):
             QUADRATIC in tokens for a single group; per-group capacity
             makes it linear (cost ~ N * group_size * top_k * cf * H).
             None = one group (exact legacy semantics).
+        dispatch_mode: "einsum" (dense one-hot dispatch/combine, the
+            GShard formulation) or "scatter" (sparse routing indices +
+            scatter-add dispatch / gather combine, O(N * k * H) with no
+            E- or C-proportional term — the winning layout at large
+            expert counts; group_size is ignored, the cost is already
+            linear in tokens). Routing decisions are identical.
 
     After forward, `self.l_aux` holds the load-balancing auxiliary loss
     (add `layer.l_aux * coeff` to the training loss, as the reference's
@@ -127,11 +133,16 @@ class MoELayer(Layer):
                  capacity_factor: Optional[float] = None,
                  experts: Optional[Layer] = None, moe_group=None,
                  ep_axis: str = "ep", group_size: Optional[int] = None,
-                 name=None):
+                 dispatch_mode: str = "einsum", name=None):
         super().__init__()
+        if dispatch_mode not in ("einsum", "scatter"):
+            raise ValueError(
+                f"dispatch_mode must be 'einsum' or 'scatter', got "
+                f"{dispatch_mode!r}")
         self.d_model = d_model
         self.num_experts = num_experts
         self._group_size = group_size
+        self._dispatch_mode = dispatch_mode
         self.gate_weight = self.create_parameter([d_model, num_experts])
         if isinstance(gate, BaseGate):
             self.gate = gate
@@ -158,11 +169,65 @@ class MoELayer(Layer):
     def _n_groups(self, n):
         return _n_groups_cached(n, self._group_size)
 
+    def _forward_scatter(self, tokens, orig_shape):
+        """Sparse dispatch: scatter tokens into the [E*C, h] expert
+        buffer by flat (expert, slot) index, gather+weight on the way
+        back. No [N, E, C] tensors anywhere — cost O(N*k*H) vs the
+        einsum's O(N*E*C*H)."""
+        n, h = tokens.shape
+        e = self.num_experts
+        top_k = self.gate.top_k
+        cap = self.gate.capacity(int(n))
+        jitter = getattr(self.gate, "jitter", 0.0)
+        training = self.training
+        key = random_mod.next_key() if (jitter and training) else None
+
+        def route(tok, wg):
+            from .gate import topk_gating_sparse
+            return topk_gating_sparse(tok @ wg, top_k, cap,
+                                      train=training, key=key,
+                                      switch_jitter=jitter)
+
+        idx, pos, keep, w, aux = run_op(
+            "moe_gate_sparse", route, [tokens, self.gate_weight])
+        self.l_aux = aux
+
+        def dispatch_fn(tok, idx, pos, keep):
+            # flat slot id; dropped tokens land in a trash slot e*cap
+            dst = jnp.where(keep, idx * cap + pos, e * cap)  # [k, N]
+            buf = jnp.zeros((e * cap + 1, tok.shape[1]), tok.dtype)
+            for r in range(top_k):
+                buf = buf.at[dst[r]].add(tok)
+            return buf[:e * cap].reshape(e, cap, tok.shape[1])
+
+        expert_in = run_op("moe_dispatch_scatter", dispatch_fn,
+                           [tokens, idx, pos, keep])
+        deg = mesh_mod.axis_degree(self._ep_axis)
+        ep_entry = self._ep_axis if (
+            deg > 1 and e % deg == 0) else None
+        expert_in = mark_sharding(expert_in, ep_entry, None, None)
+        expert_out = self.experts(expert_in)
+        expert_out = mark_sharding(expert_out, ep_entry, None, None)
+
+        def combine_fn(eo, idx, pos, keep, w):
+            flat = eo.reshape(e * cap, eo.shape[-1])
+            dst = jnp.where(keep, idx * cap + pos, 0)
+            out = 0.0
+            for r in range(top_k):
+                out = out + flat[dst[r]] * (w[r] * keep[r])[:, None]
+            return out.astype(eo.dtype)
+
+        out = run_op("moe_combine_gather", combine_fn,
+                     [expert_out, idx, pos, keep, w])
+        return out.reshape(orig_shape)
+
     def forward(self, x):
         """x: [batch, seq, h] or [N, h]."""
         orig_shape = list(x.shape)
         h = orig_shape[-1]
         tokens = x.reshape([-1, h])
+        if self._dispatch_mode == "scatter":
+            return self._forward_scatter(tokens, orig_shape)
         n = tokens.shape[0]
         top_k = self.gate.top_k
         ng = self._n_groups(int(n))

@@ -37,6 +37,9 @@ class _Exporter:
         self.nodes = []
         self.inits = []
         self.n = 0
+        # lowest opset the emitted op set is valid under (Gelu: 20,
+        # LayerNormalization: 17); export() stamps max(this, requested)
+        self.min_opset = 13
 
     def name(self, kind):
         self.n += 1
@@ -49,6 +52,8 @@ class _Exporter:
         self.inits.append(P.tensor_proto(name, arr))
 
     def emit(self, op, inputs, attrs=b""):
+        if op == "Gelu":
+            self.min_opset = max(self.min_opset, 20)
         out = self.name(op.lower())
         self.nodes.append(P.node_proto(op, inputs, [out],
                                        name=self.name(op), attrs=attrs))
@@ -150,14 +155,100 @@ class _Exporter:
                                            float(lyr._epsilon))])
         return self.emit("BatchNormalization", [x] + names, attrs), shape
 
+    def layer_norm(self, lyr, x, shape):
+        """ONNX LayerNormalization (opset 17) over the trailing dims."""
+        self.min_opset = max(self.min_opset, 17)
+        parts = [x]
+        for t, fill in ((lyr.weight, 1.0), (lyr.bias, 0.0)):
+            n = self.name("ln")
+            self.add_init(n, _np(t) if t is not None
+                          else np.full(lyr._normalized_shape, fill,
+                                       np.float32))
+            parts.append(n)
+        axis = -len(lyr._normalized_shape)
+        attrs = P._attr_wrap([
+            P.attr_int("axis", axis),
+            P.attr_float("epsilon", float(lyr._epsilon))])
+        return self.emit("LayerNormalization", parts, attrs), shape
+
+    def embedding(self, lyr, x, shape):
+        """int ids -> Gather over the [num, dim] table (axis 0)."""
+        wn = self.name("embed")
+        self.add_init(wn, _np(lyr.weight))
+        out = self.emit("Gather", [wn, x],
+                        P._attr_wrap([P.attr_int("axis", 0)]))
+        return out, list(shape) + [int(lyr.weight.shape[1])]
+
+    def _transpose(self, x, perm):
+        return self.emit("Transpose", [x],
+                         P._attr_wrap([P.attr_ints("perm", perm)]))
+
+    def _reshape(self, x, tgt):
+        sn = self.name("shape")
+        self.add_init(sn, np.asarray(
+            [0 if d is None else int(d) for d in tgt], np.int64))
+        return self.emit("Reshape", [x, sn])
+
+    def bert_attention(self, lyr, x, shape):
+        """BertSelfAttention decomposed to MatMul/Reshape/Transpose/
+        Softmax primitives: the fused qkv weight is SLICED into per-head
+        q/k/v mats at export time, scores = softmax(q k^T / sqrt(d))."""
+        b, s, hmod = shape
+        heads, hd = lyr.num_heads, lyr.head_dim
+        w = _np(lyr.qkv.weight)                   # [h, 3h]
+        bias = _np(lyr.qkv.bias) if lyr.qkv.bias is not None else None
+        pieces = []
+        for i, nm in enumerate(("q", "k", "v")):
+            wn = self.name(f"w{nm}")
+            self.add_init(wn, w[:, i * hmod:(i + 1) * hmod])
+            part = self.emit("MatMul", [x, wn])
+            if bias is not None:
+                bn = self.name(f"b{nm}")
+                self.add_init(bn, bias[i * hmod:(i + 1) * hmod])
+                part = self.emit("Add", [part, bn])
+            part = self._reshape(part, [None, s, heads, hd])
+            pieces.append(self._transpose(part, [0, 2, 1, 3]))
+        q, k, v = pieces                         # [b, heads, s, hd]
+        kt = self._transpose(k, [0, 1, 3, 2])
+        scores = self.emit("MatMul", [q, kt])
+        sc = self.name("scale")
+        self.add_init(sc, np.float32(1.0 / np.sqrt(hd)))
+        scores = self.emit("Mul", [scores, sc])
+        probs = self.emit("Softmax", [scores],
+                          P._attr_wrap([P.attr_int("axis", -1)]))
+        ctx = self.emit("MatMul", [probs, v])    # [b, heads, s, hd]
+        ctx = self._transpose(ctx, [0, 2, 1, 3])
+        ctx = self._reshape(ctx, [None, s, hmod])
+        return self.linear(lyr.out, ctx, [b, s, hmod])
+
+    def bert_layer(self, lyr, x, shape):
+        """BertEncoderLayer: post-LN residual attention + GELU FFN
+        (dropout dropped — inference export)."""
+        attn, _ = self.bert_attention(lyr.attention, x, shape)
+        x = self.emit("Add", [x, attn])
+        x, _ = self.layer_norm(lyr.attn_norm, x, shape)
+        h, hshape = self.linear(lyr.fc1, x, shape)
+        h = self.emit("Gelu", [h])
+        h, _ = self.linear(lyr.fc2, h, hshape)
+        x = self.emit("Add", [x, h])
+        return self.layer_norm(lyr.ffn_norm, x, shape)
+
     def walk(self, layer, x, shape):
         kind = type(layer).__name__
         simple = {"ReLU": "Relu", "Tanh": "Tanh", "Sigmoid": "Sigmoid",
-                  "LeakyReLU": "LeakyRelu"}
-        if kind == "Sequential":
+                  "LeakyReLU": "LeakyRelu", "GELU": "Gelu"}
+        if kind in ("Sequential", "LayerList"):
             for _, child in layer.named_children():
                 x, shape = self.walk(child, x, shape)
             return x, shape
+        if kind == "LayerNorm":
+            return self.layer_norm(layer, x, shape)
+        if kind == "Embedding":
+            return self.embedding(layer, x, shape)
+        if kind == "BertSelfAttention":
+            return self.bert_attention(layer, x, shape)
+        if kind == "BertEncoderLayer":
+            return self.bert_layer(layer, x, shape)
         if kind == "Linear":
             return self.linear(layer, x, shape)
         if kind == "Conv2D":
@@ -199,9 +290,10 @@ class _Exporter:
             return self.emit(simple[kind], [x]), shape
         raise NotImplementedError(
             f"onnx.export: layer {kind} is not supported by the minimal "
-            "exporter; supported: Sequential/Linear/Conv2D/BatchNorm2D/"
-            "MaxPool2D/AvgPool2D/Flatten/Dropout/activations. For "
-            "arbitrary models use paddle.jit.save (StableHLO).")
+            "exporter; supported: Sequential/LayerList/Linear/Conv2D/"
+            "BatchNorm2D/MaxPool2D/AvgPool2D/Flatten/Dropout/LayerNorm/"
+            "Embedding/BertSelfAttention/BertEncoderLayer/activations. "
+            "For arbitrary models use paddle.jit.save (StableHLO).")
 
 
 def export(layer, path, input_spec=None, opset_version=13, **configs):
@@ -221,13 +313,19 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
     # below never consumes the batch dim, so it flows through untouched
     shape = [int(d) if d is not None and int(d) > 0 else None
              for d in getattr(spec, "shape", spec)]
+    in_dtype = str(getattr(spec, "dtype", "float32"))
+    in_elem = P.INT64 if "int64" in in_dtype else (
+        P.INT32 if "int32" in in_dtype else P.FLOAT)
     ex = _Exporter()
     out, out_shape = ex.walk(layer, "input", shape)
     graph = P.graph_proto(
         ex.nodes, "paddle_tpu_graph", ex.inits,
-        [P.value_info("input", P.FLOAT, shape)],
+        [P.value_info("input", in_elem, shape)],
         [P.value_info(out, P.FLOAT, out_shape)])
-    model = P.model_proto(graph, opset=int(opset_version))
+    # never stamp an opset the emitted ops are invalid under (Gelu
+    # needs 20, LayerNormalization 17 — onnx.checker would reject)
+    model = P.model_proto(graph, opset=max(int(opset_version),
+                                           ex.min_opset))
     fname = path if path.endswith(".onnx") else path + ".onnx"
     with open(fname, "wb") as f:
         f.write(model)

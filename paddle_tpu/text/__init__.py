@@ -3,7 +3,7 @@
 test/auto_parallel/hybrid_strategy/semi_auto_parallel_llama_model.py)."""
 from . import datasets  # noqa: F401
 from . import models  # noqa: F401
-from .generation import generate  # noqa: F401
+from .generation import beam_search, generate  # noqa: F401
 from .datasets import (  # noqa: F401
     Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
 )

@@ -250,6 +250,118 @@ def generate(model, input_ids, max_new_tokens: int,
     return wrap(out)
 
 
+def beam_search(model, input_ids, max_new_tokens: int, num_beams: int = 4,
+                length_penalty: float = 1.0,
+                eos_token_id: Optional[int] = None):
+    """Compiled beam-search decode: the k beams fold into the batch dim
+    inside ONE ``lax.scan`` (B = batch * num_beams rows), per-beam KV
+    caches are reordered by a batched gather at every step, and the
+    final beam is picked by length-normalized score
+    ``score / len ** length_penalty`` (eos ends a beam; finished beams
+    carry their score unchanged). Returns [batch, S + max_new_tokens]
+    (the best beam per sequence).
+
+    The reference core framework ships no beam search (its serving
+    stack's domain); this is the text-family counterpart of
+    ``generate`` for search decoding — deterministic, so token-exact
+    against an eager reference loop (tests/test_utils_text.py).
+    """
+    ids = np.asarray(unwrap(input_ids))
+    b, s = ids.shape
+    k = int(num_beams)
+    total = s + int(max_new_tokens)
+    if max_new_tokens <= 0:
+        return wrap(jnp.asarray(ids))
+    if k == 1:
+        return generate(model, input_ids, max_new_tokens,
+                        eos_token_id=eos_token_id)
+    params = get_params(model)
+    buffers = get_buffers(model)
+    frozen = get_frozen(model)
+    cfg = model.config
+    V = cfg.vocab_size
+    NEG = jnp.float32(-1e30)
+
+    def fwd(st, tokens, caches, index):
+        p, buf, frz = st
+        out, _ = functional_call(
+            model, p, buf, (tokens,),
+            {"kv_caches": caches, "cache_index": index},
+            frozen=frz, training=False)
+        return out
+
+    def decode(st, prompt):
+        hkv = cfg.num_key_value_heads
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        caches = [
+            (jnp.zeros((b, total, hkv, hd), jnp.float32),
+             jnp.zeros((b, total, hkv, hd), jnp.float32))
+            for _ in range(cfg.num_hidden_layers)]
+        logits, caches = fwd(st, prompt, caches, jnp.int32(0))
+        lp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), -1)
+        scores, tok0 = jax.lax.top_k(lp, k)          # [b, k]
+        # fold beams into batch: row r = b_i * k + beam
+        tokens = jnp.repeat(prompt, k, axis=0)       # [B, s]
+        tokens = jnp.concatenate(
+            [tokens, jnp.zeros((b * k, total - s), prompt.dtype)], 1)
+        tokens = tokens.at[:, s].set(tok0.reshape(-1))
+        caches = jax.tree_util.tree_map(
+            lambda a: jnp.repeat(a, k, axis=0), caches)
+        done0 = (tok0.reshape(-1) == eos_token_id) if eos_token_id \
+            is not None else jnp.zeros((b * k,), bool)
+        # length of generated part per beam (stops growing at eos)
+        len0 = jnp.ones((b * k,), jnp.int32)
+
+        def step(carry, i):
+            tokens, caches, scores, done, lens = carry
+            cur = jax.lax.dynamic_slice(tokens, (jnp.int32(0), i),
+                                        (b * k, 1))
+            logits, caches = fwd(st, cur, caches, i)
+            lp = jax.nn.log_softmax(
+                logits[:, -1].astype(jnp.float32), -1)   # [B, V]
+            if eos_token_id is not None:
+                # finished beams may only extend with eos at zero cost
+                eos_only = jnp.full((V,), NEG).at[eos_token_id].set(0.0)
+                lp = jnp.where(done[:, None], eos_only[None], lp)
+            cand = scores.reshape(b, k, 1) + lp.reshape(b, k, V)
+            scores, flat = jax.lax.top_k(cand.reshape(b, k * V), k)
+            beam = flat // V                              # [b, k]
+            tok = (flat % V).astype(tokens.dtype)
+            rows = (jnp.arange(b, dtype=jnp.int32)[:, None] * k
+                    + beam).reshape(-1)
+            tokens = tokens[rows]
+            caches = jax.tree_util.tree_map(lambda a: a[rows], caches)
+            done = done[rows]
+            lens = lens[rows]
+            tokens = jax.lax.dynamic_update_slice(
+                tokens, tok.reshape(-1, 1), (jnp.int32(0), i + 1))
+            lens = jnp.where(done, lens, lens + 1)
+            if eos_token_id is not None:
+                done = jnp.logical_or(done,
+                                      tok.reshape(-1) == eos_token_id)
+            return (tokens, caches, scores.reshape(-1), done, lens), None
+
+        (tokens, _, scores, done, lens), _ = jax.lax.scan(
+            step, (tokens, caches, scores.reshape(-1), done0, len0),
+            jnp.arange(s, total - 1, dtype=jnp.int32))
+        norm = scores / jnp.power(lens.astype(jnp.float32),
+                                  jnp.float32(length_penalty))
+        best = jnp.argmax(norm.reshape(b, k), axis=-1)   # [b]
+        rows = jnp.arange(b) * k + best
+        return tokens[rows]
+
+    sig = ("beam", b, s, total, k, float(length_penalty), eos_token_id,
+           str(ids.dtype))
+    per_model = _jit_cache.setdefault(model, {})
+    fn = per_model.get(sig)
+    if fn is None:
+        fn = jax.jit(decode)
+        per_model[sig] = fn
+    with tape_mod.no_grad_guard():
+        out = fn((params, buffers, frozen), jnp.asarray(ids))
+    return wrap(out)
+
+
 # model -> {static signature -> jitted decode}; weak keys so a dropped
 # model releases its compiled executables
 import weakref  # noqa: E402

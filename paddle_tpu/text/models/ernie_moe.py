@@ -26,6 +26,9 @@ class ErnieMoEConfig(LlamaConfig):
     # linear in tokens (see MoELayer.group_size); ~2K tokens per routing
     # group is the measured sweet spot on v5e
     moe_group_size: int = 2048
+    # "einsum" (grouped dense dispatch) or "scatter" (sparse indices,
+    # O(N*k*H) — wins at large expert counts; see docs/PERF.md study)
+    moe_dispatch_mode: str = "einsum"
 
     @staticmethod
     def tiny(vocab=128, hidden=64, layers=2, heads=4, experts=4):
@@ -51,7 +54,8 @@ class ErnieMoEDecoderLayer(Layer):
                 num_experts=config.num_experts, gate="gshard",
                 top_k=config.top_k,
                 capacity_factor=config.capacity_factor,
-                group_size=config.moe_group_size)
+                group_size=config.moe_group_size,
+                dispatch_mode=config.moe_dispatch_mode)
         else:
             from .llama import LlamaMLP
             self.mlp = LlamaMLP(config)
@@ -103,3 +107,28 @@ class ErnieMoEForCausalLM(Layer):
         if total is None:
             raise RuntimeError("aux_loss read before any forward")
         return total * self.config.aux_loss_coeff
+
+
+def ernie_moe_flops_per_token(config: ErnieMoEConfig) -> float:
+    """Approximate training FLOPs/token with ROUTED expert accounting
+    (6 x ACTIVE params): dense blocks count their full FFN, MoE blocks
+    count only the top_k experts a token actually visits (plus the
+    router matmul) — the honest numerator for an MoE "MFU"
+    (dense-equivalent params would overstate utilization by
+    num_experts / top_k on the expert FFNs)."""
+    c = config
+    L = c.num_hidden_layers
+    n_moe = sum(1 for i in range(L)
+                if i % c.moe_every == c.moe_every - 1)
+    n_dense = L - n_moe
+    attn = 4 * c.hidden_size * c.hidden_size
+    dense_ffn = 3 * c.hidden_size * c.intermediate_size   # SwiGLU
+    # GroupedExpertsFFN: two mats (w1 [H,F], w2 [F,H]) per expert;
+    # a token runs top_k of them, plus the H x E router
+    expert_ffn = c.top_k * 2 * c.hidden_size * c.intermediate_size
+    router = c.hidden_size * c.num_experts
+    embed_head = 2 * c.vocab_size * c.hidden_size
+    active = (embed_head
+              + n_dense * (attn + dense_ffn)
+              + n_moe * (attn + expert_ffn + router))
+    return 6.0 * active

@@ -34,7 +34,8 @@ def stubbed(monkeypatch):
                         lambda: (40000.0, 0.70, "TPU v5 lite", 1))
     monkeypatch.setattr(bench, "bench_lenet", lambda: (900.0, 30.0))
     monkeypatch.setattr(bench, "bench_bert", lambda: (50000.0, 0.4))
-    monkeypatch.setattr(bench, "bench_ernie_moe", lambda: 20000.0)
+    monkeypatch.setattr(bench, "bench_ernie_moe",
+                        lambda: (20000.0, 0.3))
     monkeypatch.setattr(bench, "bench_resnet50", lambda: 2500.0)
     monkeypatch.setattr(bench, "bench_llama_decode", lambda: 900.0)
     return monkeypatch
@@ -69,7 +70,8 @@ def test_budget_skips_extras_but_headline_survives(stubbed, capsys,
     assert lines[0]["value"] == 17000.0
     assert set(lines[-1]["extras"]["skipped"]) == {
         "llama_seq2048", "llama_small_seq512", "lenet", "bert_base",
-        "ernie_moe", "resnet50", "llama_decode"}
+        "ernie_moe", "resnet50", "llama_decode", "llama_decode_int8",
+        "llama_decode_paged", "llama_decode_rolling"}
     assert "llama_seq2048_mfu" not in lines[-1]["extras"]
 
 

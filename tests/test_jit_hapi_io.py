@@ -410,3 +410,103 @@ def test_train_step_amp_casts_float_inputs():
     assert np.isfinite(l0) and np.isfinite(l1)
     # master params stay fp32
     assert str(net[0].weight.dtype).endswith("float32")
+
+
+def test_summary_output_shapes_nested():
+    """summary(input_size=...) runs a hooked forward and reports
+    per-layer OUTPUT shapes, including nested (tuple) container outputs
+    (VERDICT r4 next #9; reference hapi/model_summary.py)."""
+    import io
+    from contextlib import redirect_stdout
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    class TwoHead(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.backbone = nn.Linear(8, 16)
+            self.head_a = nn.Linear(16, 4)
+            self.head_b = nn.Linear(16, 2)
+
+        def forward(self, x):
+            h = self.backbone(x)
+            return self.head_a(h), self.head_b(h)
+
+    paddle.seed(0)
+    net = TwoHead()
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        info = paddle.summary(net, (3, 8))
+    text = buf.getvalue()
+    assert "[3, 16]" in text            # backbone output shape
+    assert "[3, 4], [3, 2]" in text     # nested tuple output (root)
+    assert info["total_params"] == 8 * 16 + 16 + 16 * 4 + 4 + 16 * 2 + 2
+
+
+def test_model_multi_output_metrics():
+    """Model.prepare metric containers feed EACH network output and
+    label as separate Metric.compute args (reference multi-output
+    contract)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.metric import Metric
+
+    class TwoHead(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+            self.aux = nn.Linear(4, 2)
+
+        def forward(self, x):
+            h = self.fc(x)
+            return h, self.aux(h)
+
+    class CountingMetric(Metric):
+        def __init__(self):
+            self.seen = []
+            self.n = 0
+
+        def name(self):
+            return "counting"
+
+        def reset(self):
+            self.n = 0
+
+        def compute(self, out_a, out_b, label):
+            self.seen.append((tuple(out_a.shape), tuple(out_b.shape),
+                              tuple(label.shape)))
+            return 1.0
+
+        def update(self, c):
+            self.n += 1
+
+        def accumulate(self):
+            return float(self.n)
+
+    paddle.seed(1)
+    m = CountingMetric()
+    model = paddle.Model(TwoHead())
+
+    def loss(outs, label):
+        return (outs[0].mean() - label.mean()) ** 2
+
+    model.prepare(optimizer=paddle.optimizer.SGD(
+        0.1, parameters=model.parameters()), loss=loss, metrics=m)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((6, 4)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((6, 1)).astype(np.float32))
+    model.eval_batch([x], y)
+    assert m.n == 1
+    assert m.seen[0] == ((6, 4), (6, 2), (6, 1))
+    logs = model.evaluate([(np.asarray(x.numpy()), np.asarray(y.numpy()))],
+                          batch_size=6, verbose=0)
+    assert logs["counting"] >= 1.0
+
+    import pytest
+    with pytest.raises(TypeError, match="Metric"):
+        model.prepare(metrics="accuracy")

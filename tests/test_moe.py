@@ -184,3 +184,69 @@ def test_grouped_dispatch_trains():
     (out.sum() + layer.l_aux).backward()
     assert np.isfinite(x.grad.numpy()).all()
     assert np.abs(x.grad.numpy()).sum() > 0
+
+
+def test_scatter_dispatch_matches_einsum():
+    """dispatch_mode='scatter' (sparse indices + scatter/gather) makes
+    IDENTICAL routing decisions to the dense einsum dispatch: same
+    outputs, same aux loss (VERDICT r4 next #6)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+    rng = np.random.default_rng(7)
+    x_np = rng.standard_normal((2, 16, 32)).astype(np.float32)
+
+    outs = {}
+    for mode in ("einsum", "scatter"):
+        paddle.seed(3)
+        layer = MoELayer(d_model=32, d_hidden=64, num_experts=4,
+                         gate="gshard", top_k=2, dispatch_mode=mode)
+        layer.eval()
+        out = layer(paddle.to_tensor(x_np))
+        outs[mode] = (np.asarray(out.numpy()),
+                      float(layer.l_aux.numpy()))
+    np.testing.assert_allclose(outs["scatter"][0], outs["einsum"][0],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(outs["scatter"][1], outs["einsum"][1],
+                               rtol=1e-5)
+
+
+def test_scatter_dispatch_trains():
+    """Scatter dispatch is differentiable end to end (scatter-add and
+    gather have exact VJPs)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+    paddle.seed(0)
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.moe = MoELayer(d_model=16, d_hidden=32, num_experts=4,
+                                gate="gshard", top_k=2,
+                                dispatch_mode="scatter")
+            self.head = nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.head(self.moe(x))
+
+    net = Net()
+    ce = nn.CrossEntropyLoss()
+
+    def loss_fn(out, y):
+        return ce(out, y) + 0.01 * net.moe.l_aux
+
+    opt = paddle.optimizer.Adam(1e-2, parameters=net.parameters())
+    step = paddle.jit.TrainStep(net, loss_fn, opt)
+    rng = np.random.default_rng(1)
+    x = paddle.to_tensor(rng.standard_normal((4, 8, 16)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 4, (4, 8)))
+    l0 = float(step(x, y).numpy())
+    for _ in range(6):
+        l1 = float(step(x, y).numpy())
+    assert np.isfinite(l0) and l1 < l0

@@ -90,6 +90,27 @@ def _run_onnx(model, x):
             ax = node["attrs"].get("axis", -1)
             e = np.exp(ins[0] - ins[0].max(axis=ax, keepdims=True))
             y = e / e.sum(axis=ax, keepdims=True)
+        elif op == "Mul":
+            y = ins[0] * ins[1]
+        elif op == "Transpose":
+            y = ins[0].transpose(node["attrs"]["perm"])
+        elif op == "Gelu":
+            import math
+            erf = np.vectorize(math.erf)
+            xg = ins[0].astype(np.float64)
+            y = (0.5 * xg * (1.0 + erf(xg / np.sqrt(2.0)))).astype(
+                np.float32)
+        elif op == "Gather":
+            ax = node["attrs"].get("axis", 0)
+            y = np.take(ins[0], ins[1].astype(np.int64), axis=ax)
+        elif op == "LayerNormalization":
+            ax = node["attrs"].get("axis", -1)
+            eps = node["attrs"].get("epsilon", 1e-5)
+            x_, scale, bias = ins
+            axes = tuple(range(ax % x_.ndim, x_.ndim))
+            mean = x_.mean(axis=axes, keepdims=True)
+            var = x_.var(axis=axes, keepdims=True)
+            y = (x_ - mean) / np.sqrt(var + eps) * scale + bias
         else:
             raise AssertionError(f"unexpected op {op}")
         env[node["outputs"][0]] = y
@@ -227,4 +248,49 @@ def test_onnx_export_rank3_linear_matmul(tmp_path):
     got = _run_onnx(model, x)
     want = np.asarray(net(paddle.to_tensor(x)).numpy())
     assert got.shape == want.shape == (2, 3, 2)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_onnx_export_bert_encoder_roundtrip(tmp_path):
+    """A 2-layer BERT encoder (attention/LayerNorm/softmax/GELU) exports
+    to the wire format and re-executes to the framework's numerics —
+    VERDICT r4 next #7: the transformer family, not just conv stacks."""
+    from paddle_tpu.text.models.bert import BertConfig, BertEncoderLayer
+
+    paddle.seed(4)
+    cfg = BertConfig.tiny(vocab=64, hidden=32, layers=2, heads=4)
+    net = nn.Sequential(BertEncoderLayer(cfg), BertEncoderLayer(cfg))
+    net.eval()
+    b, s = 2, 10
+    fname = paddle.onnx.export(
+        net, str(tmp_path / "bert_enc"),
+        input_spec=[paddle.jit.InputSpec([b, s, cfg.hidden_size],
+                                         "float32")])
+    model = P.parse_model(open(fname, "rb").read())
+    ops = [n["op_type"] for n in model["graph"]["nodes"]]
+    assert "Softmax" in ops and "LayerNormalization" in ops \
+        and "Gelu" in ops and "Transpose" in ops
+
+    x = np.random.default_rng(4).standard_normal(
+        (b, s, cfg.hidden_size)).astype(np.float32)
+    got = _run_onnx(model, x)
+    want = np.asarray(net(paddle.to_tensor(x)).numpy())
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_onnx_export_embedding_gather_roundtrip(tmp_path):
+    """Embedding exports as Gather with an int input (the 'gather' leg
+    of the transformer op set)."""
+    paddle.seed(5)
+    net = nn.Sequential(nn.Embedding(50, 16), nn.Linear(16, 4))
+    net.eval()
+    fname = paddle.onnx.export(
+        net, str(tmp_path / "embed"),
+        input_spec=[paddle.jit.InputSpec([2, 7], "int64")])
+    model = P.parse_model(open(fname, "rb").read())
+    ops = [n["op_type"] for n in model["graph"]["nodes"]]
+    assert ops[0] == "Gather"
+    ids = np.random.default_rng(5).integers(0, 50, (2, 7))
+    got = _run_onnx(model, ids)
+    want = np.asarray(net(paddle.to_tensor(ids)).numpy())
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

@@ -261,9 +261,9 @@ def test_bench_extra_paths_smoke():
     tok, mfu = bench.bench_bert(cfg=BertConfig.tiny(), batch=2, seq=16,
                                 n_steps=2)
     assert tok > 0 and np.isfinite(mfu)
-    tok2 = bench.bench_ernie_moe(cfg=ErnieMoEConfig.tiny(), batch=2,
-                                 seq=16, n_steps=2)
-    assert tok2 > 0
+    tok2, mfu2 = bench.bench_ernie_moe(cfg=ErnieMoEConfig.tiny(), batch=2,
+                                       seq=16, n_steps=2)
+    assert tok2 > 0 and np.isfinite(mfu2)
     # bench_resnet50 is deliberately NOT smoked here: a batch-2 ResNet-50
     # still costs ~80s of CPU compile; the vision zoo forward test covers
     # the model and the protocol test covers the extra's wiring.

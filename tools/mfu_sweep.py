@@ -69,9 +69,9 @@ def main():
                                   n_steps=args.steps)
             extra = {"mfu_approx": round(mfu, 4)}
         else:
-            tok = bench_ernie_moe(batch=args.batch, seq=args.seq,
-                                  n_steps=args.steps)
-            extra = {}
+            tok, mfu = bench_ernie_moe(batch=args.batch, seq=args.seq,
+                                       n_steps=args.steps)
+            extra = {"mfu_routed": round(mfu, 4)}
         print(json.dumps({"model": args.model, "batch": args.batch,
                           "seq": args.seq,
                           "tokens_per_sec": round(tok, 1),
