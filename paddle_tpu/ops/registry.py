@@ -101,11 +101,9 @@ _NOT_APPLICABLE_PREFIXES = (
     "pyramid_hash", "tdm_", "shuffle_batch", "cvm", "batch_fc",
     "rank_attention", "match_matrix_tensor", "lookup_table_dequant",
     "attention_lstm", "im2sequence", "sequence_conv", "sequence_pool",
-    "beam_search", "crf_decoding", "ctc_align",
+    "crf_decoding", "ctc_align",
     # CUDA-runtime-specific paths
     "cudnn_lstm", "npu_identity", "sync_calc_stream", "depend", "data",
-    "llm_int8_linear", "weight_only_linear", "weight_quantize",
-    "weight_dequantize",
     "apply_per_channel_scale", "coalesce_tensor", "merge_selected_rows",
     "copy_to", "sparse_attention", "calc_reduced_attn_scores",
     # IO ops handled by the Python data pipeline
@@ -116,6 +114,15 @@ _NOT_APPLICABLE_PREFIXES = (
 # framework (the reference exposes them as kernel-level ops because its
 # optimizer/amp/moe/fft run op-by-op; here they are module APIs)
 _COVERED_BY = {
+    # quantized execution (round 5): real int8 weight-only / llm.int8
+    # matmuls + the weight (de)quantizers behind PTQ.convert
+    "weight_only_linear": "nn.quant.weight_only_linear",
+    "llm_int8_linear": "nn.quant.llm_int8_linear",
+    "weight_quantize": "quantization.functional.weight_quantize",
+    "weight_dequantize": "quantization.functional.weight_dequantize",
+    # compiled search decoding
+    "beam_search": "text.beam_search",
+    "beam_search_decode": "text.beam_search",
     # optimizer update kernels -> paddle_tpu.optimizer classes
     "sgd_": "optimizer.SGD", "momentum_": "optimizer.Momentum",
     "adam_": "optimizer.Adam", "adamw_": "optimizer.AdamW",
