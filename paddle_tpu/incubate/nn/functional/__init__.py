@@ -707,14 +707,18 @@ def paged_write(key, value, k_cache, v_cache, block_tables, positions):
 def block_multihead_attention(*args, **kwargs):
     """(reference: block_multihead_attention — paged-KV CUDA decoding
     kernel). The capability is paddle.incubate.nn.functional.
-    paged_attention / paged_write; this exact entry keeps the
-    CUDA-serving arg layout (qkv-packed rows, rotary tables, cum
-    offsets) that has no TPU counterpart."""
+    paged_attention / paged_write (XLA path) and the Pallas
+    paged-decode kernel behind text.generate(cache_impl="paged") —
+    MEASURED at ~3.0K new-tok/s on the 1B model at b32 (docs/PERF.md
+    serving ladder). This exact entry keeps the CUDA-serving arg layout
+    (qkv-packed rows, rotary tables, cum offsets) that has no TPU
+    counterpart."""
     raise NotImplementedError(
         "use paddle.incubate.nn.functional.paged_attention (+ "
-        "paged_write) — the TPU-native paged-KV decode over block "
-        "tables; this entry's CUDA-serving argument layout (packed qkv "
-        "rows, cum_offsets, rope tables) is runtime-specific")
+        "paged_write), or text.generate(cache_impl='paged') for the "
+        "measured Pallas paged-decode path — this entry's CUDA-serving "
+        "argument layout (packed qkv rows, cum_offsets, rope tables) is "
+        "runtime-specific")
 
 
 def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size=None,

@@ -37,7 +37,9 @@ def stubbed(monkeypatch):
     monkeypatch.setattr(bench, "bench_ernie_moe",
                         lambda: (20000.0, 0.3))
     monkeypatch.setattr(bench, "bench_resnet50", lambda: 2500.0)
-    monkeypatch.setattr(bench, "bench_llama_decode", lambda: 900.0)
+    monkeypatch.setattr(bench, "bench_llama_decode",
+                        lambda **kw: 900.0)
+    monkeypatch.setattr(bench, "bench_flashmask_8k", lambda: 9.0)
     return monkeypatch
 
 
@@ -71,7 +73,7 @@ def test_budget_skips_extras_but_headline_survives(stubbed, capsys,
     assert set(lines[-1]["extras"]["skipped"]) == {
         "llama_seq2048", "llama_small_seq512", "lenet", "bert_base",
         "ernie_moe", "resnet50", "llama_decode", "llama_decode_int8",
-        "llama_decode_paged", "llama_decode_rolling"}
+        "llama_decode_paged", "llama_decode_rolling", "flashmask_8k"}
     assert "llama_seq2048_mfu" not in lines[-1]["extras"]
 
 
