@@ -56,6 +56,30 @@ def _peak():
     return PEAK_FLOPS.get(kind, 197e12), kind
 
 
+def _telemetry_extras(result):
+    """PADDLE_TPU_MONITOR=1: fold the runtime counters (XLA compile
+    count/seconds fed by the always-on listener in profiler/stats.py,
+    eager dispatch count, device-memory watermark) into extras — a
+    compile count that grows across re-printed lines means some extra
+    is recompiling per step (shape churn), exactly the thing the
+    headline MFU number can't show."""
+    from paddle_tpu import monitor
+    if not monitor.enabled():
+        return
+    from paddle_tpu.profiler.stats import read_memory
+    snap = monitor.snapshot()
+    tel = {
+        "xla_compiles": int(snap.get("xla.compiles", 0)),
+        "xla_compile_secs": round(float(snap.get("xla.compile_secs",
+                                                 0.0)), 2),
+        "eager_op_dispatches": int(snap.get("dispatch.ops", 0)),
+    }
+    mem = read_memory()
+    if mem["peak_bytes_in_use"]:
+        tel[f"peak_bytes_{mem['source']}"] = mem["peak_bytes_in_use"]
+    result["extras"]["telemetry"] = tel
+
+
 def _time_steps(step_fn, n, groups=2):
     """Best-of-groups steps/sec with a forced sync each group (the
     tunneled chip shows +-4% run-to-run noise and block_until_ready is
@@ -449,6 +473,7 @@ def main():
             "device_kind": kind,
         },
     }
+    _telemetry_extras(result)
     print(json.dumps(result), flush=True)
 
     def add_llama(prefix, fn):
@@ -529,6 +554,7 @@ def main():
             result["extras"][f"{name}_error"] = f"{type(exc).__name__}: {exc}"[:200]
         if skipped:
             result["extras"]["skipped"] = skipped
+        _telemetry_extras(result)
         print(json.dumps(result), flush=True)
     if skipped:
         result["extras"]["skipped"] = skipped

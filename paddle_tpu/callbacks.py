@@ -2,9 +2,9 @@
 re-exports the hapi callback classes)."""
 from .hapi.callbacks import (  # noqa: F401
     Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
-    ReduceLROnPlateau, VisualDL, WandbCallback,
+    ReduceLROnPlateau, TelemetryLogger, VisualDL, WandbCallback,
 )
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-           "EarlyStopping", "ReduceLROnPlateau", "VisualDL",
-           "WandbCallback"]
+           "EarlyStopping", "ReduceLROnPlateau", "TelemetryLogger",
+           "VisualDL", "WandbCallback"]

@@ -11,6 +11,7 @@ executable per (op, shapes, dtypes) signature; eager calls hit that cache.
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Sequence
 
 import jax
@@ -112,6 +113,15 @@ def _check_nan_inf(name, arrays):
 # registered by amp.debugging (op stats collection, accuracy dumps)
 OP_OBSERVERS = []
 
+# timing hooks called as hook(op_name, seconds, input_sig) after every
+# dispatch — registered by profiler.stats.OpDispatchTracer. seconds is
+# host dispatch wall time (XLA execution is async; on a cache hit this
+# is the launch cost, on a miss it includes the trace+compile — exactly
+# the shape-churn signal the recompile tracker wants). input_sig is a
+# tuple of "shape:dtype" strings for the array inputs, the same key XLA
+# caches executables under. Empty list = zero overhead on the hot path.
+OP_TIMING_HOOKS = []
+
 
 def _notify(name, out):
     if OP_OBSERVERS:
@@ -121,7 +131,35 @@ def _notify(name, out):
             obs(name, leaves)
 
 
+def input_signature(tensor_args) -> tuple:
+    """(shape:dtype, ...) signature of the array inputs — the eager-op
+    analog of the key jax.jit caches compiled executables under."""
+    sig = []
+    for x in tensor_args:
+        a = unwrap(x)
+        if isinstance(a, (jax.Array, np.ndarray)):
+            sig.append(f"{tuple(a.shape)}:{a.dtype}")
+    return tuple(sig)
+
+
+def _timed(runner, name, fn, tensor_args, attrs):
+    t0 = time.perf_counter()
+    try:
+        return runner(name, fn, tensor_args, **attrs)
+    finally:
+        dt = time.perf_counter() - t0
+        sig = input_signature(tensor_args)
+        for hook in list(OP_TIMING_HOOKS):
+            hook(name, dt, sig)
+
+
 def run_op(name: str, fn: Callable, tensor_args: Sequence[Any], **attrs):
+    if OP_TIMING_HOOKS:
+        return _timed(_run_op, name, fn, tensor_args, attrs)
+    return _run_op(name, fn, tensor_args, **attrs)
+
+
+def _run_op(name: str, fn: Callable, tensor_args: Sequence[Any], **attrs):
     """Execute op `fn(*arrays, **attrs)` eagerly, recording the tape.
 
     tensor_args: positional inputs that may be Tensors (differentiable if
@@ -207,6 +245,13 @@ def run_op(name: str, fn: Callable, tensor_args: Sequence[Any], **attrs):
 def run_op_nodiff(name: str, fn: Callable, tensor_args: Sequence[Any],
                   **attrs):
     """Execute a non-differentiable op (comparisons, argmax, ...)."""
+    if OP_TIMING_HOOKS:
+        return _timed(_run_op_nodiff, name, fn, tensor_args, attrs)
+    return _run_op_nodiff(name, fn, tensor_args, **attrs)
+
+
+def _run_op_nodiff(name: str, fn: Callable, tensor_args: Sequence[Any],
+                   **attrs):
     arrays = [unwrap(x) for x in tensor_args]
     out = fn(*arrays, **attrs)
     _notify(name, out)

@@ -42,6 +42,15 @@ def is_initialized():
     return _initialized
 
 
+def process_label() -> dict:
+    """Rank identity for telemetry consumers (chrome-trace pid tagging,
+    monitor lines): {'rank', 'world_size', 'initialized'}. Safe to call
+    before init_parallel_env — falls back to the env-var/JAX view, rank
+    0 of 1 single-process."""
+    return dict(rank=get_rank(), world_size=get_world_size(),
+                initialized=is_initialized())
+
+
 def init_parallel_env(coordinator_address=None, num_processes=None,
                       process_id=None):
     """Reference: parallel.py:978 init_parallel_env. Maps to

@@ -2,6 +2,7 @@
 from . import callbacks  # noqa: F401
 from .callbacks import (  # noqa: F401
     Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+    TelemetryLogger,
 )
 from .model import Model  # noqa: F401
 from .summary import summary  # noqa: F401

@@ -5,6 +5,8 @@ import time
 
 import numpy as np
 
+from .. import monitor
+
 
 class Callback:
     def __init__(self):
@@ -50,6 +52,11 @@ class CallbackList:
             self.callbacks.insert(0, ProgBarLogger(
                 log_freq=params.get("log_freq", 10),
                 verbose=params.get("verbose", 2)))
+        # PADDLE_TPU_MONITOR=1 (or monitor.enable()): per-epoch
+        # step-time/recompile telemetry lines ride along automatically
+        if monitor.enabled() and not any(
+                isinstance(c, TelemetryLogger) for c in self.callbacks):
+            self.callbacks.append(TelemetryLogger())
         for c in self.callbacks:
             c.set_model(model)
             c.set_params(params)
@@ -105,6 +112,65 @@ class ProgBarLogger(Callback):
             msg = " - ".join(f"{k}: {v:.4f}" for k, v in (logs or {}).items()
                              if isinstance(v, (int, float)))
             print(f"Epoch {epoch + 1} done ({dt:.1f}s): {msg}")
+
+
+class TelemetryLogger(Callback):
+    """Per-epoch runtime telemetry through the paddle_tpu.monitor
+    registry: step-time stats measured here, XLA recompile count/seconds
+    fed by the always-on compile listener (profiler/stats.py). Inserted
+    automatically by CallbackList when PADDLE_TPU_MONITOR=1 so every
+    Model.fit emits one line per epoch like
+
+        [telemetry] epoch 1: steps 50 avg_step_ms 12.4 (min 11.0 max
+        31.2) recompiles 3 compile_s 1.84
+
+    A steady recompiles > 0 after the first epoch is the shape-churn
+    signature — run a Profiler and read shape_churn_report() to find
+    the op."""
+
+    def __init__(self, verbose=1):
+        super().__init__()
+        self.verbose = verbose
+        self.last_line = None
+
+    def _compiles(self):
+        return (monitor.counter("xla.compiles").get(),
+                monitor.gauge("xla.compile_secs").get())
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._steps = 0
+        self._dt_total = 0.0
+        self._dt_min = float("inf")
+        self._dt_max = 0.0
+        self._compiles0 = self._compiles()
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._t0 = time.perf_counter()
+
+    def on_train_batch_end(self, step, logs=None):
+        dt = time.perf_counter() - getattr(self, "_t0", time.perf_counter())
+        self._steps += 1
+        self._dt_total += dt
+        self._dt_min = min(self._dt_min, dt)
+        self._dt_max = max(self._dt_max, dt)
+        monitor.counter("train.steps").increase()
+        monitor.gauge("train.step_ms").set(dt * 1e3)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not getattr(self, "_steps", 0):
+            return
+        c1, s1 = self._compiles()
+        c0, s0 = self._compiles0
+        avg = self._dt_total / self._steps * 1e3
+        monitor.gauge("train.epoch_recompiles").set(c1 - c0)
+        self.last_line = (
+            f"[telemetry] epoch {epoch + 1}: steps {self._steps} "
+            f"avg_step_ms {avg:.1f} (min {self._dt_min * 1e3:.1f} "
+            f"max {self._dt_max * 1e3:.1f}) "
+            f"recompiles {c1 - c0} compile_s {s1 - s0:.2f}")
+        if self.verbose:
+            print(self.last_line)
 
 
 class ModelCheckpoint(Callback):

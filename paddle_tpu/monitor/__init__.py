@@ -1,0 +1,184 @@
+"""paddle_tpu.monitor — lightweight always-on runtime counter registry.
+
+Reference: Paddle's monitor/statistics surface (StatRegistry in
+paddle/utils/stats.h, exposed through paddle.fluid.monitor): named
+integer/float counters any layer can bump without pulling in the full
+profiler. TPU-native role: the substrate bench.py, hapi callbacks, and
+the distributed layers report through — step times, XLA compile counts,
+shape-churn flags — with near-zero cost when nobody reads them.
+
+The registry itself is always live (a counter bump is two dict ops);
+``PADDLE_TPU_MONITOR=1`` gates only the *emission* side — the per-epoch
+telemetry lines hapi prints and the telemetry block bench.py attaches
+to its JSON result. ``enable()``/``disable()`` override the env var
+programmatically.
+
+    from paddle_tpu import monitor
+    monitor.counter("train.steps").increase()
+    monitor.gauge("train.step_ms").set(12.5)
+    monitor.snapshot()   # {'train.steps': 1, 'train.step_ms': 12.5, ...}
+
+(The C++-backed named monitors behind the paddle parity surface live in
+paddle_tpu.device.monitor — monitor_add/monitor_get over csrc. This is
+the pure-Python layer the telemetry stack reports through; it needs no
+native lib and is safe from any thread.)
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+_lock = threading.Lock()
+_counters: Dict[str, "Counter"] = {}
+_gauges: Dict[str, "Gauge"] = {}
+_enabled_override: Optional[bool] = None
+
+
+class Counter:
+    """Monotonic counter (reference StatRegistry int stat)."""
+
+    __slots__ = ("name", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+
+    def increase(self, n: int = 1) -> int:
+        # locked: jax.monitoring can fire from background compile
+        # threads, and read-modify-write on an attribute is not atomic
+        with _lock:
+            self._value += n
+            return self._value
+
+    # paddle-style alias
+    add = increase
+
+    def get(self) -> int:
+        return self._value
+
+    def reset(self):
+        self._value = 0
+
+    def __repr__(self):
+        return f"Counter({self.name}={self._value})"
+
+
+class Gauge:
+    """Last-value gauge with running min/max/mean (for step times,
+    memory watermarks)."""
+
+    __slots__ = ("name", "_value", "_count", "_total", "_min", "_max")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0.0
+        self._count = 0
+        self._total = 0.0
+        self._min = float("inf")
+        self._max = float("-inf")
+
+    def set(self, v: float) -> float:
+        v = float(v)
+        with _lock:
+            self._value = v
+            self._count += 1
+            self._total += v
+            self._min = min(self._min, v)
+            self._max = max(self._max, v)
+        return v
+
+    # observation-style alias (set + running stats are one operation)
+    update = set
+
+    def add(self, v: float) -> float:
+        """Accumulate into the last value (for duration totals fed from
+        multiple threads — one locked read-modify-write)."""
+        v = float(v)
+        with _lock:
+            self._value += v
+            self._count += 1
+            self._total += v
+            self._min = min(self._min, v)
+            self._max = max(self._max, v)
+            return self._value
+
+    def get(self) -> float:
+        return self._value
+
+    def stats(self) -> Dict[str, float]:
+        if not self._count:
+            return dict(last=0.0, count=0, mean=0.0, min=0.0, max=0.0)
+        return dict(last=self._value, count=self._count,
+                    mean=self._total / self._count,
+                    min=self._min, max=self._max)
+
+    def reset(self):
+        self.__init__(self.name)
+
+    def __repr__(self):
+        return f"Gauge({self.name}={self._value})"
+
+
+def counter(name: str) -> Counter:
+    """Get-or-create the named counter."""
+    c = _counters.get(name)
+    if c is None:
+        with _lock:
+            c = _counters.setdefault(name, Counter(name))
+    return c
+
+
+def gauge(name: str) -> Gauge:
+    """Get-or-create the named gauge."""
+    g = _gauges.get(name)
+    if g is None:
+        with _lock:
+            g = _gauges.setdefault(name, Gauge(name))
+    return g
+
+
+def snapshot(detail: bool = False) -> Dict[str, object]:
+    """One flat dict of every counter/gauge value. With ``detail=True``
+    gauges expand to their running stats dict instead of the last
+    value."""
+    out: Dict[str, object] = {}
+    for name, c in sorted(_counters.items()):
+        out[name] = c.get()
+    for name, g in sorted(_gauges.items()):
+        out[name] = g.stats() if detail else g.get()
+    return out
+
+
+def reset():
+    """Zero every registered counter/gauge (registry keys survive so
+    held references stay valid)."""
+    for c in _counters.values():
+        c.reset()
+    for g in _gauges.values():
+        g.reset()
+
+
+def enabled() -> bool:
+    """True when telemetry *emission* is on: ``PADDLE_TPU_MONITOR=1``
+    in the environment, or an explicit ``enable()`` call."""
+    if _enabled_override is not None:
+        return _enabled_override
+    return os.environ.get("PADDLE_TPU_MONITOR", "0").lower() in (
+        "1", "true", "yes", "on")
+
+
+def enable():
+    global _enabled_override
+    _enabled_override = True
+
+
+def disable():
+    global _enabled_override
+    _enabled_override = False
+
+
+def _clear_override():
+    """Test hook: fall back to the env var."""
+    global _enabled_override
+    _enabled_override = None

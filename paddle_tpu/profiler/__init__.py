@@ -18,3 +18,12 @@ from .timer import benchmark  # noqa: F401
 from .profiler_statistic import SortedKeys, StatisticData  # noqa: F401
 from .profiler_statistic import SummaryView  # noqa: F401,E402
 from .profiler import load_profiler_result  # noqa: F401,E402
+from . import chrome_trace  # noqa: F401,E402
+from . import stats  # noqa: F401,E402
+from .stats import (CompileTracker, MemorySampler,  # noqa: F401,E402
+                    OpDispatchTracer, RuntimeStats)
+
+# always-on XLA compile counting into paddle_tpu.monitor (xla.compiles /
+# xla.compile_secs) — bench.py and hapi's TelemetryLogger read these
+# with no Profiler in the loop
+stats.install_compile_listener()

@@ -130,13 +130,19 @@ class Profiler:
             self._scheduler = _default_scheduler
         self._on_trace_ready = on_trace_ready
         self._timer_only = timer_only
+        self._record_shapes = record_shapes
         self._log_dir = log_dir or os.environ.get(
             "PADDLE_PROFILER_LOG_DIR", "./profiler_log")
         self.step_num = 0
         self._state = ProfilerState.CLOSED
         self._tracing = False
+        self._recording = False
         self._fired_in_step = False
         self._store = _HostEventStore()
+        from .stats import RuntimeStats
+        self._runtime_stats = RuntimeStats(record_timeline=True,
+                                           profile_memory=profile_memory)
+        self.last_trace_path = None  # set by export_chrome_tracing
 
     # -- lifecycle -----------------------------------------------------------
     def start(self):
@@ -147,19 +153,36 @@ class Profiler:
 
     def stop(self):
         global _current_store
-        had_trace = self._tracing
-        if self._tracing:
-            self._stop_trace()
-        # fire only for a cycle still open at stop(); completed cycles
-        # already fired in step()
-        if self._on_trace_ready is not None and (
-                had_trace or (self._timer_only
-                              and not self._fired_in_step)):
-            self._on_trace_ready(self)
-        _current_store = None
+        # batched NaN checking must not leave queued flags unreported
+        # past the end of a profiled run — but a raised NaN report must
+        # not leak an open device trace either
+        from ..core.dispatch import flush_nan_checks
+        try:
+            flush_nan_checks()
+        finally:
+            had_trace = self._tracing
+            if self._tracing:
+                self._stop_trace()
+            self._runtime_stats.stop()
+            self._recording = False
+            # fire only for a cycle still open at stop(); completed
+            # cycles already fired in step()
+            if self._on_trace_ready is not None and (
+                    had_trace or (self._timer_only
+                                  and not self._fired_in_step)):
+                self._on_trace_ready(self)
+            _current_store = None
 
     def step(self, num_samples: Optional[int] = None):
         prev = self._state
+        # step boundary housekeeping BEFORE the state transition so the
+        # closing step's compiles/memory land in its own bucket — and
+        # queued batched NaN flags (FLAGS_check_nan_inf_batch > 1) are
+        # reported against the step that produced them
+        from ..core.dispatch import flush_nan_checks
+        flush_nan_checks()
+        if self._recording:
+            self._runtime_stats.on_step(self.step_num)
         self.step_num += 1
         new_state = self._scheduler(self.step_num)
         if prev == ProfilerState.RECORD_AND_RETURN:
@@ -171,15 +194,32 @@ class Profiler:
             if self._on_trace_ready is not None:
                 self._on_trace_ready(self)
                 self._fired_in_step = True
+            # host telemetry must not merge across cycles either: the
+            # next cycle starts with fresh collectors and a fresh host
+            # event store (the exported trace above owns this window)
+            self._runtime_stats.reset_window()
+            self._recording = False
+            self._store = _HostEventStore()
+            global _current_store
+            _current_store = self._store
         if new_state != self._state or prev == \
                 ProfilerState.RECORD_AND_RETURN:
             self._state = new_state
             self._transit()
 
     def _transit(self):
-        want_trace = self._state in (ProfilerState.RECORD,
-                                     ProfilerState.RECORD_AND_RETURN) \
-            and not self._timer_only
+        recording = self._state in (ProfilerState.RECORD,
+                                    ProfilerState.RECORD_AND_RETURN)
+        # host-side telemetry (op dispatch, XLA compiles, memory) runs
+        # whenever the schedule says RECORD — including timer_only mode,
+        # which skips only the heavyweight device tracer below
+        if recording and not self._recording:
+            self._runtime_stats.start()
+            self._recording = True
+        elif not recording and self._recording:
+            self._runtime_stats.stop()
+            self._recording = False
+        want_trace = recording and not self._timer_only
         if want_trace and not self._tracing:
             self._start_trace()
         elif not want_trace and self._tracing:
@@ -211,19 +251,29 @@ class Profiler:
 
     # -- reporting -----------------------------------------------------------
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
-                time_unit="ms"):
-        """Host-side event table (reference profiler_statistic report)."""
-        agg = self._store.aggregate()
-        if not agg:
-            return "no host events recorded (wrap code in RecordEvent)"
-        lines = [f"{'name':<40}{'calls':>8}{'total(ms)':>12}"
-                 f"{'avg(ms)':>12}{'max(ms)':>12}"]
-        for name, st in sorted(agg.items(), key=lambda kv:
-                               -kv[1]["total_ms"]):
-            lines.append(f"{name:<40}{st['calls']:>8}"
-                         f"{st['total_ms']:>12.3f}{st['avg_ms']:>12.3f}"
-                         f"{st['max_ms']:>12.3f}")
-        return "\n".join(lines)
+                time_unit="ms", views=None, row_limit=100):
+        """Multi-view report (reference profiler_statistic _build_table):
+        OverView + OperatorView + MemoryView + UDFView by default, any
+        subset via ``views=SummaryView.* | [SummaryView.*, ...]``, rows
+        ordered by ``sorted_by`` (a SortedKeys member)."""
+        from .profiler_statistic import StatisticData
+        return StatisticData(self).build_table(
+            sorted_by=sorted_by, views=views, row_limit=row_limit,
+            time_unit=time_unit)
+
+    @property
+    def statistic_data(self):
+        from .profiler_statistic import StatisticData
+        return StatisticData(self)
+
+    @property
+    def runtime_stats(self):
+        """The window's RuntimeStats (op tracer, compile tracker,
+        memory samples) — see profiler/stats.py."""
+        return self._runtime_stats
+
+    def shape_churn_report(self, min_signatures: int = 8):
+        return self._runtime_stats.ops.shape_churn_report(min_signatures)
 
     @property
     def profiler_result_dir(self):
@@ -231,14 +281,25 @@ class Profiler:
 
 
 def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
-    """on_trace_ready factory (reference profiler.py:227). The XPlane
-    files jax.profiler writes under log_dir are viewable in
-    TensorBoard/perfetto; this callback records where they landed."""
+    """on_trace_ready factory (reference profiler.py:227): writes a
+    chrome://tracing / perfetto-loadable JSON of the HOST events
+    (RecordEvent annotations, eager op-dispatch spans, memory counters,
+    per-rank pid tagging) that load_profiler_result round-trips. The
+    XPlane files jax.profiler writes under log_dir carry the DEVICE
+    timeline for TensorBoard; TRACE_LOCATION.txt records where those
+    landed, as before."""
     def handler(prof: Profiler):
+        from . import chrome_trace
         os.makedirs(dir_name, exist_ok=True)
         marker = os.path.join(dir_name, "TRACE_LOCATION.txt")
         with open(marker, "w") as f:
             f.write(prof.profiler_result_dir + "\n")
+        rank, _ = chrome_trace._rank_info()
+        name = worker_name or f"rank{rank}"
+        path = os.path.join(dir_name,
+                            f"{name}_step{prof.step_num}.json")
+        prof.last_trace_path = chrome_trace.export_chrome_trace(
+            prof, path, worker_name=name)
     return handler
 
 
@@ -247,9 +308,14 @@ def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
 
 
 def load_profiler_result(filename: str):
-    """Load an exported chrome-trace file back into a dict (reference:
+    """Load an exported chrome-trace JSON back into its dict (reference:
     profiler.load_profiler_result over the protobuf dump; ours exports
-    chrome-trace JSON, so that's what loads)."""
+    chrome-trace JSON, so that's what loads). Raises ValueError for a
+    file that isn't a chrome trace."""
     import json
     with open(filename) as f:
-        return json.load(f)
+        data = json.load(f)
+    if not isinstance(data, dict) or "traceEvents" not in data:
+        raise ValueError(
+            f"{filename} is not a chrome-trace export (no traceEvents)")
+    return data

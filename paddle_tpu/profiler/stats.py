@@ -1,0 +1,343 @@
+"""Runtime telemetry: op-dispatch stats, XLA compile tracking, memory.
+
+Reference: python/paddle/profiler/profiler_statistic.py builds its
+OperatorView/MemoryView tables from the C++ host tracer's event tree.
+TPU-native rebuild: there is no per-op kernel launch to trace — eager
+ops dispatch through core/dispatch.py and XLA caches one executable per
+(op, shapes, dtypes) signature — so the telemetry that matters is
+
+* per-op dispatch counts/wall-time/INPUT SIGNATURES (OpDispatchTracer,
+  hooked into dispatch.OP_TIMING_HOOKS + OP_OBSERVERS): an op whose
+  signature set keeps growing is re-tracing and re-compiling every new
+  shape — the silent step-time killer jit caches can't save you from;
+* XLA compile count + cumulative seconds (CompileTracker, fed by
+  jax.monitoring's /jax/core/compile/backend_compile_duration events —
+  covers eager cache misses AND jit/TrainStep compiles);
+* device memory watermarks sampled at Profiler.step() (MemorySampler;
+  device.memory_stats() where the backend reports it, host RSS as the
+  CPU-CI fallback).
+
+The module-level jax.monitoring listener is installed once at import
+and always feeds the paddle_tpu.monitor counters (xla.compiles,
+xla.compile_secs) — bench.py and hapi's TelemetryLogger read those with
+no profiler in the loop.
+"""
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from .. import monitor
+
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class OpStat:
+    """Aggregate for one op name across a tracing window."""
+
+    __slots__ = ("name", "calls", "total_s", "min_s", "max_s",
+                 "signatures", "out_dtypes")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.calls = 0
+        self.total_s = 0.0
+        self.min_s = float("inf")
+        self.max_s = 0.0
+        self.signatures: Dict[tuple, int] = OrderedDict()
+        self.out_dtypes: Dict[str, int] = {}
+
+    def record(self, dt: float, sig: tuple):
+        self.calls += 1
+        self.total_s += dt
+        self.min_s = min(self.min_s, dt)
+        self.max_s = max(self.max_s, dt)
+        self.signatures[sig] = self.signatures.get(sig, 0) + 1
+
+    @property
+    def avg_s(self) -> float:
+        return self.total_s / max(self.calls, 1)
+
+    def as_dict(self) -> dict:
+        return dict(calls=self.calls, total_ms=self.total_s * 1e3,
+                    avg_ms=self.avg_s * 1e3,
+                    min_ms=(0.0 if self.calls == 0 else self.min_s * 1e3),
+                    max_ms=self.max_s * 1e3,
+                    distinct_signatures=len(self.signatures))
+
+
+class OpDispatchTracer:
+    """Observes the eager dispatch path via dispatch.OP_TIMING_HOOKS
+    (counts, wall time, input signatures) and dispatch.OP_OBSERVERS
+    (output dtypes). start()/stop() are idempotent; with
+    record_timeline=True every dispatch also lands as a span for the
+    chrome-trace exporter."""
+
+    def __init__(self, record_timeline: bool = False,
+                 timeline_limit: int = 100_000):
+        self.stats: Dict[str, OpStat] = {}
+        self.record_timeline = record_timeline
+        self.timeline_limit = timeline_limit
+        self.spans: List[Tuple[str, float, float]] = []  # (name, start, end)
+        self.timeline_dropped = 0
+        self._active = False
+
+    # -- hook bodies ---------------------------------------------------------
+    def _on_op(self, name: str, dt: float, sig: tuple):
+        st = self.stats.get(name)
+        if st is None:
+            st = self.stats[name] = OpStat(name)
+        st.record(dt, sig)
+        monitor.counter("dispatch.ops").increase()
+        if self.record_timeline:
+            end = time.perf_counter()
+            if len(self.spans) < self.timeline_limit:
+                self.spans.append((name, end - dt, end))
+            else:
+                self.timeline_dropped += 1
+
+    def _on_out(self, name: str, leaves):
+        st = self.stats.get(name)
+        if st is None:
+            st = self.stats[name] = OpStat(name)
+        for a in leaves:
+            key = str(a.dtype)
+            st.out_dtypes[key] = st.out_dtypes.get(key, 0) + 1
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        from ..core import dispatch
+        if self._active:
+            return self
+        dispatch.OP_TIMING_HOOKS.append(self._on_op)
+        dispatch.OP_OBSERVERS.append(self._on_out)
+        self._active = True
+        return self
+
+    def stop(self):
+        from ..core import dispatch
+        if not self._active:
+            return self
+        for lst, h in ((dispatch.OP_TIMING_HOOKS, self._on_op),
+                       (dispatch.OP_OBSERVERS, self._on_out)):
+            try:
+                lst.remove(h)
+            except ValueError:
+                pass
+        self._active = False
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- reports -------------------------------------------------------------
+    def shape_churn_report(self, min_signatures: int = 8) -> List[dict]:
+        """Ops whose input-signature set keeps growing — each distinct
+        signature is one XLA executable, so an unbounded set means a
+        compile per step (dynamic seq lengths, growing caches, python
+        scalars re-wrapped every iteration). Sorted worst-first."""
+        rows = []
+        for name, st in self.stats.items():
+            nsig = len(st.signatures)
+            if nsig >= min_signatures:
+                rows.append(dict(
+                    op=name, calls=st.calls, distinct_signatures=nsig,
+                    signatures_per_call=nsig / max(st.calls, 1),
+                    example_signatures=[
+                        "x".join(s) if isinstance(s, tuple) else str(s)
+                        for s in list(st.signatures)[:3]],
+                ))
+        rows.sort(key=lambda r: -r["distinct_signatures"])
+        return rows
+
+
+class CompileTracker:
+    """Counts XLA backend compiles and cumulative compile seconds inside
+    a window (fed by the module-level jax.monitoring listener). Also
+    keeps a per-step series so Profiler.step() can attribute recompiles
+    to steps: steady-state training should show 0 after warmup."""
+
+    def __init__(self):
+        self.compiles = 0
+        self.compile_secs = 0.0
+        self.per_step: List[int] = []
+        self._step_base = 0
+        self._active = False
+
+    def _on_compile(self, dur: float):
+        if not self._active:
+            return
+        self.compiles += 1
+        self.compile_secs += dur
+
+    def start(self):
+        if not self._active:
+            self._active = True
+            _active_trackers.append(self)
+        return self
+
+    def stop(self):
+        if self._active:
+            self._active = False
+            try:
+                _active_trackers.remove(self)
+            except ValueError:
+                pass
+        return self
+
+    def on_step(self):
+        """Close the current step's attribution window."""
+        self.per_step.append(self.compiles - self._step_base)
+        self._step_base = self.compiles
+
+    def steady_state_recompiles(self, warmup_steps: int = 1) -> int:
+        """Compiles that happened after the warmup steps — the number
+        that should be zero in a healthy fixed-shape loop."""
+        return sum(self.per_step[warmup_steps:]) + (
+            self.compiles - self._step_base if len(self.per_step)
+            >= warmup_steps else 0)
+
+    def as_dict(self) -> dict:
+        return dict(compiles=self.compiles,
+                    compile_secs=round(self.compile_secs, 4),
+                    per_step=list(self.per_step))
+
+
+def read_memory() -> dict:
+    """One memory snapshot: {'source', 'bytes_in_use',
+    'peak_bytes_in_use', 'bytes_limit'}. TPU/GPU backends report
+    allocator stats through device.memory_stats(); the CPU CI backend
+    reports none, so host max-RSS stands in (clearly labeled). Public —
+    bench.py and external telemetry consumers read through this."""
+    # device.monitor owns the jax memory_stats key mapping (and the
+    # paddle.device.cuda.* parity surface) — read through it
+    from ..device import monitor as device_monitor
+    stats = device_monitor._device_stats(0)
+    if stats:
+        return dict(
+            source="device",
+            bytes_in_use=int(stats.get("bytes_in_use", 0)),
+            peak_bytes_in_use=int(stats.get("peak_bytes_in_use", 0)),
+            bytes_limit=int(stats.get("bytes_limit", 0)))
+    rss = device_monitor.host_memory_rss()  # native /proc reader
+    peak = device_monitor.host_memory_peak()
+    if rss <= 0:
+        try:
+            import resource
+            rss = resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss * 1024
+        except Exception:  # noqa: BLE001 — non-POSIX
+            rss = 0
+    return dict(source="host_rss" if rss > 0 else "none",
+                bytes_in_use=max(rss, 0),
+                peak_bytes_in_use=max(peak, rss, 0), bytes_limit=0)
+
+
+class MemorySampler:
+    """Device memory watermarks per profiler step (read_memory() per
+    Profiler.step() when profile_memory=True)."""
+
+    def __init__(self):
+        self.samples: List[dict] = []
+
+    def sample(self, step: int):
+        s = read_memory()
+        s["step"] = step
+        s["t"] = time.perf_counter()
+        self.samples.append(s)
+        monitor.gauge("memory.bytes_in_use").set(s["bytes_in_use"])
+        return s
+
+    def peak(self) -> int:
+        return max((s["peak_bytes_in_use"] for s in self.samples),
+                   default=0)
+
+
+class RuntimeStats:
+    """The bundle a Profiler owns: one op tracer + compile tracker +
+    memory sampler sharing a lifecycle."""
+
+    def __init__(self, record_timeline: bool = True,
+                 profile_memory: bool = False):
+        self.ops = OpDispatchTracer(record_timeline=record_timeline)
+        self.compiles = CompileTracker()
+        self.memory = MemorySampler()
+        self.profile_memory = profile_memory
+        self._t0: Optional[float] = None
+        self._t1: Optional[float] = None
+
+    def start(self):
+        if self._t0 is None:
+            self._t0 = time.perf_counter()
+        self._t1 = None  # window reopened: wall_s runs live again
+        self.ops.start()
+        self.compiles.start()
+        return self
+
+    def stop(self):
+        self.ops.stop()
+        self.compiles.stop()
+        self._t1 = time.perf_counter()
+        return self
+
+    def on_step(self, step: int):
+        self.compiles.on_step()
+        if self.profile_memory:
+            self.memory.sample(step)
+
+    def reset_window(self):
+        """Fresh collectors for the next scheduler cycle — cycles must
+        not merge in the exported host trace any more than they do in
+        the device trace (each RECORD_AND_RETURN hands on_trace_ready a
+        self-contained window)."""
+        record_timeline = self.ops.record_timeline
+        self.ops.stop()
+        self.compiles.stop()
+        self.ops = OpDispatchTracer(record_timeline=record_timeline)
+        self.compiles = CompileTracker()
+        self.memory = MemorySampler()
+        self._t0 = None
+        self._t1 = None
+
+    @property
+    def wall_s(self) -> float:
+        if self._t0 is None:
+            return 0.0
+        return (self._t1 or time.perf_counter()) - self._t0
+
+
+# ---------------------------------------------------------------------------
+# module-level jax.monitoring listener: jax has no per-listener
+# deregistration, so ONE listener is installed on first import and
+# fan-outs to whatever trackers are currently active; it always bumps
+# the monitor counters so compile telemetry exists with no profiler.
+_active_trackers: List[CompileTracker] = []
+_listener_installed = False
+
+
+def _jax_compile_listener(event: str, duration: float, **kw):
+    if event != COMPILE_EVENT:
+        return
+    monitor.counter("xla.compiles").increase()
+    monitor.gauge("xla.compile_secs").add(duration)
+    for t in list(_active_trackers):
+        t._on_compile(duration)
+
+
+def install_compile_listener():
+    """Idempotent; called at paddle_tpu.profiler import."""
+    global _listener_installed
+    if _listener_installed:
+        return
+    try:
+        import jax.monitoring
+        jax.monitoring.register_event_duration_secs_listener(
+            _jax_compile_listener)
+        _listener_installed = True
+    except Exception:  # noqa: BLE001 — ancient jax without monitoring
+        pass

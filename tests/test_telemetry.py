@@ -1,0 +1,325 @@
+"""Runtime telemetry: monitor registry, op-dispatch tracer, recompile
+tracking, chrome-trace export/load, trace_summary CLI, hapi telemetry
+callback (ISSUE 1 tentpole)."""
+import importlib.util
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import monitor
+from paddle_tpu.core import dispatch
+from paddle_tpu.core.flags import set_flags
+from paddle_tpu.profiler import (Profiler, RecordEvent, SortedKeys,
+                                 SummaryView, export_chrome_tracing,
+                                 load_profiler_result)
+from paddle_tpu.profiler.stats import OpDispatchTracer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_trace_summary():
+    spec = importlib.util.spec_from_file_location(
+        "trace_summary", os.path.join(REPO, "tools", "trace_summary.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- monitor registry --------------------------------------------------------
+
+def test_monitor_counter_gauge_snapshot():
+    monitor.counter("t.hits").reset()
+    monitor.gauge("t.ms").reset()
+    assert monitor.counter("t.hits").increase() == 1
+    monitor.counter("t.hits").increase(4)
+    monitor.gauge("t.ms").set(2.0)
+    monitor.gauge("t.ms").set(4.0)
+    snap = monitor.snapshot()
+    assert snap["t.hits"] == 5
+    assert snap["t.ms"] == 4.0
+    detail = monitor.snapshot(detail=True)["t.ms"]
+    assert detail["mean"] == 3.0 and detail["min"] == 2.0
+    # same name -> same object (registry, not constructor)
+    assert monitor.counter("t.hits") is monitor.counter("t.hits")
+
+
+def test_monitor_env_gate(monkeypatch):
+    monitor._clear_override()
+    monkeypatch.delenv("PADDLE_TPU_MONITOR", raising=False)
+    assert not monitor.enabled()
+    monkeypatch.setenv("PADDLE_TPU_MONITOR", "1")
+    assert monitor.enabled()
+    monitor.disable()
+    assert not monitor.enabled()
+    monitor._clear_override()
+
+
+# -- op dispatch tracer ------------------------------------------------------
+
+def test_op_tracer_counts_and_timing():
+    x = paddle.to_tensor(np.ones((2, 3), np.float32))
+    with OpDispatchTracer() as tr:
+        _ = x * 2.0
+        _ = x * 3.0
+        _ = paddle.matmul(x, paddle.to_tensor(np.ones((3, 2), np.float32)))
+    assert not dispatch.OP_TIMING_HOOKS  # unhooked on exit
+    mul = tr.stats["multiply"]
+    assert mul.calls == 2
+    assert mul.total_s > 0 and mul.min_s <= mul.max_s
+    assert len(mul.signatures) == 1  # same shapes both calls
+    assert "matmul" in tr.stats
+    # OP_OBSERVERS leg saw the output dtypes
+    assert mul.out_dtypes.get("float32", 0) >= 2
+
+
+def test_shape_churn_flagged_fixed_loop_clean():
+    """Acceptance: a shape-churning eager loop is flagged by the
+    recompile tracker while a fixed-shape loop is not."""
+    with Profiler(timer_only=True) as prof:
+        for n in range(10):
+            x = paddle.to_tensor(np.ones(n + 1, np.float32))
+            _ = x * 2.0
+            prof.step()
+    churn = prof.shape_churn_report(min_signatures=8)
+    assert churn and churn[0]["op"] == "multiply"
+    assert churn[0]["distinct_signatures"] == 10
+    # every post-warmup step recompiled — the tracker sees it
+    assert prof.runtime_stats.compiles.steady_state_recompiles() > 0
+
+    with Profiler(timer_only=True) as prof2:
+        x = paddle.to_tensor(np.ones(4, np.float32))
+        for _ in range(10):
+            _ = x * 2.0
+            prof2.step()
+    assert prof2.shape_churn_report(min_signatures=8) == []
+    assert prof2.runtime_stats.compiles.steady_state_recompiles() == 0
+
+
+def test_monitor_xla_compile_counter_always_on():
+    """The module-level jax.monitoring listener feeds monitor counters
+    with no Profiler in the loop."""
+    import jax.numpy as jnp
+    before = monitor.counter("xla.compiles").get()
+    x = paddle.to_tensor(np.ones((5, 7), np.float32))
+    _ = x + 1.5  # fresh shape for this test -> at least one compile
+    _ = jnp.sum(jnp.ones((11, 13)))
+    assert monitor.counter("xla.compiles").get() > before
+
+
+# -- profiler summary views --------------------------------------------------
+
+def _profiled_run(**kw):
+    paddle.seed(0)
+    net = nn.Linear(16, 16)
+    x = paddle.to_tensor(np.ones((4, 16), np.float32))
+    prof = Profiler(timer_only=True, **kw)
+    with prof:
+        for _ in range(3):
+            with RecordEvent("fwd"):
+                net(x)
+            prof.step()
+    return prof
+
+
+def test_summary_views_and_min_column():
+    prof = _profiled_run(profile_memory=True)
+    s = prof.summary()
+    for section in ("Overview", "Operator Summary", "Memory Summary",
+                    "UserDefined Summary"):
+        assert section in s
+    assert "min(ms)" in s and "fwd" in s and "calls" in s
+    assert "linear" in s  # the op tracer saw the dispatch
+    # single view selection
+    only_mem = prof.summary(views=SummaryView.MemoryView)
+    assert "Memory Summary" in only_mem and "Overview" not in only_mem
+    assert prof.runtime_stats.memory.samples  # profile_memory sampled
+
+
+def test_summary_honors_sorted_by():
+    prof = Profiler(timer_only=True)
+    with prof:
+        for _ in range(5):
+            with RecordEvent("many_cheap"):
+                pass
+        with RecordEvent("one_slow"):
+            import time
+            time.sleep(0.01)
+        prof.step()
+    from paddle_tpu.profiler.profiler_statistic import sort_items
+    agg = prof._store.aggregate()
+    by_total = [n for n, _ in sort_items(agg, SortedKeys.CPUTotal)]
+    by_max = [n for n, _ in sort_items(agg, SortedKeys.CPUMax)]
+    assert by_total[0] == "one_slow" and by_max[0] == "one_slow"
+    by_min = [n for n, _ in sort_items(agg, SortedKeys.CPUMin)]
+    assert by_min[0] == "one_slow"  # largest min first
+    # the table itself reorders without error
+    s = prof.summary(sorted_by=SortedKeys.CPUAvg,
+                     views=SummaryView.UDFView)
+    assert s.index("one_slow") < s.index("many_cheap")
+
+
+def test_nan_flush_at_step_and_stop():
+    """Batched NaN checking can't leave queued flags unreported at
+    profiler step/stop boundaries (ISSUE 1 satellite)."""
+    set_flags({"check_nan_inf": True, "check_nan_inf_batch": 64})
+    try:
+        with Profiler(timer_only=True) as prof:
+            bad = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+            _ = paddle.to_tensor(np.array([1.0, 1.0], np.float32)) / bad
+            with pytest.raises(FloatingPointError, match="divide"):
+                prof.step()
+        assert not dispatch._nan_pending
+        # stop() boundary too
+        prof2 = Profiler(timer_only=True)
+        prof2.start()
+        _ = paddle.to_tensor(np.array([1.0, 1.0], np.float32)) / bad
+        with pytest.raises(FloatingPointError, match="divide"):
+            prof2.stop()
+        assert not dispatch._nan_pending
+    finally:
+        set_flags({"check_nan_inf": False, "check_nan_inf_batch": 1})
+        dispatch._nan_pending.clear()
+
+
+# -- chrome trace export/load ------------------------------------------------
+
+def test_chrome_trace_round_trip(tmp_path):
+    """Acceptance: export_chrome_tracing output loads via
+    load_profiler_result and tools/trace_summary.py."""
+    out = str(tmp_path / "chrome")
+    prof = _profiled_run(profile_memory=True,
+                         on_trace_ready=export_chrome_tracing(out))
+    assert prof.last_trace_path and os.path.exists(prof.last_trace_path)
+    trace = load_profiler_result(prof.last_trace_path)
+    evs = trace["traceEvents"]
+    names = {e["name"] for e in evs if e.get("ph") == "X"}
+    assert "fwd" in names and "linear" in names
+    # pid tagging: single-process fallback = rank 0 of 1
+    assert trace["metadata"]["rank"] == 0
+    assert trace["metadata"]["world_size"] == 1
+    procs = [e for e in evs if e.get("ph") == "M"
+             and e["name"] == "process_name"]
+    assert procs and procs[0]["pid"] == 0
+    assert "rank0" in procs[0]["args"]["name"]
+    # memory counter track rode along
+    assert any(e.get("ph") == "C" for e in evs)
+    # durations are in microseconds and non-negative
+    assert all(e["dur"] >= 0 for e in evs if e.get("ph") == "X")
+
+    # the CLI summarizes the same file
+    ts = _load_trace_summary()
+    agg = ts.summarize(trace)
+    assert agg["fwd"]["calls"] == 3
+    table = ts.format_table(agg, top=5)
+    assert "fwd" in table and "linear" in table
+    assert ts.main([prof.last_trace_path, "--top", "3",
+                    "--cat", "op"]) == 0
+
+
+def test_multi_cycle_traces_do_not_merge(tmp_path):
+    """Each RECORD_AND_RETURN hands on_trace_ready a self-contained
+    window: the second cycle's export must not re-contain the first
+    cycle's events/spans (code-review finding)."""
+    from paddle_tpu.profiler import make_scheduler
+    out = str(tmp_path / "cycles")
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    paths = []
+    prof = Profiler(
+        timer_only=True,
+        scheduler=make_scheduler(closed=1, ready=0, record=2, repeat=2),
+        on_trace_ready=lambda p, _paths=paths: _paths.append(
+            export_chrome_tracing(out)(p) or p.last_trace_path))
+    with prof:
+        for _ in range(6):
+            with RecordEvent("cyc"):
+                _ = x * 2.0
+            prof.step()
+    assert len(paths) == 2
+    t1, t2 = (load_profiler_result(p) for p in paths)
+
+    def count(tr, name):
+        return sum(1 for e in tr["traceEvents"]
+                   if e.get("ph") == "X" and e["name"] == name)
+    # 3 steps per cycle land in each file — not 3 then 6
+    assert count(t1, "cyc") == 3
+    assert count(t2, "cyc") == 3
+    assert count(t2, "multiply") == count(t1, "multiply")
+
+
+def test_summary_time_unit():
+    prof = _profiled_run()
+    s = prof.summary(time_unit="s", views=SummaryView.UDFView)
+    assert "total(s)" in s and "total(ms)" not in s
+    with pytest.raises(ValueError, match="time_unit"):
+        prof.summary(time_unit="parsec")
+
+
+def test_load_profiler_result_rejects_non_trace(tmp_path):
+    p = tmp_path / "junk.json"
+    p.write_text(json.dumps([1, 2, 3]))
+    with pytest.raises(ValueError, match="traceEvents"):
+        load_profiler_result(str(p))
+
+
+def test_chrome_trace_rank_tagging_env(tmp_path, monkeypatch):
+    """Per-rank pid tagging follows paddle_tpu.distributed's view."""
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "3")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "8")
+    out = str(tmp_path / "chrome")
+    prof = _profiled_run(on_trace_ready=export_chrome_tracing(out))
+    trace = load_profiler_result(prof.last_trace_path)
+    assert trace["metadata"]["rank"] == 3
+    assert trace["metadata"]["world_size"] == 8
+    assert os.path.basename(prof.last_trace_path).startswith("rank3")
+    procs = [e for e in trace["traceEvents"] if e.get("ph") == "M"
+             and e["name"] == "process_name"]
+    assert procs[0]["pid"] == 3
+
+
+# -- hapi / fit integration --------------------------------------------------
+
+def test_fit_emits_telemetry_line(capsys):
+    from paddle_tpu.hapi.callbacks import TelemetryLogger
+    monitor.enable()
+    try:
+        paddle.seed(0)
+        net = nn.Linear(8, 2)
+        model = paddle.hapi.Model(net)
+        model.prepare(paddle.optimizer.SGD(0.1,
+                                           parameters=net.parameters()),
+                      nn.CrossEntropyLoss())
+        xs = np.ones((16, 8), np.float32)
+        ys = np.zeros((16, 1), np.int64)
+        cb = TelemetryLogger()
+        model.fit(list(zip(xs, ys)), batch_size=4, epochs=1, verbose=0,
+                  callbacks=[cb])
+        assert cb.last_line is not None
+        assert "avg_step_ms" in cb.last_line
+        assert "recompiles" in cb.last_line
+        out = capsys.readouterr().out
+        assert "[telemetry] epoch 1:" in out
+        assert monitor.counter("train.steps").get() >= 4
+    finally:
+        monitor._clear_override()
+
+
+def test_callback_list_auto_inserts_when_enabled():
+    from paddle_tpu.hapi.callbacks import CallbackList, TelemetryLogger
+    monitor.enable()
+    try:
+        cbks = CallbackList([], model=None, verbose=0)
+        assert any(isinstance(c, TelemetryLogger) for c in cbks.callbacks)
+    finally:
+        monitor._clear_override()
+    monitor.disable()
+    try:
+        cbks = CallbackList([], model=None, verbose=0)
+        assert not any(isinstance(c, TelemetryLogger)
+                       for c in cbks.callbacks)
+    finally:
+        monitor._clear_override()

@@ -1,0 +1,100 @@
+"""Summarize an exported chrome-trace JSON: top-N ops/events table.
+
+Run: python tools/trace_summary.py <trace.json> [--top 20]
+                                   [--sort total|avg|max|calls]
+                                   [--cat op|user|all]
+
+Works on anything paddle_tpu.profiler.export_chrome_tracing wrote (and
+on any trace_event-format file with complete "X" events). The table
+mirrors the Profiler.summary() OperatorView so a saved trace from a
+production run reads the same as a live profile.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def load_trace(path: str) -> dict:
+    """Same contract as paddle_tpu.profiler.load_profiler_result, but
+    dependency-free — the summarizer works anywhere the trace file
+    exists, with no jax/framework import cost."""
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "traceEvents" not in data:
+        raise ValueError(
+            f"{path} is not a chrome-trace export (no traceEvents)")
+    return data
+
+
+def summarize(trace: dict, cat: str = "all") -> dict:
+    """{name: {calls, total_ms, avg_ms, min_ms, max_ms, cat}} over the
+    complete ("X") events, durations in ms."""
+    agg: dict = {}
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        if cat != "all" and ev.get("cat", "") != cat:
+            continue
+        dur_ms = float(ev.get("dur", 0.0)) / 1e3
+        a = agg.get(ev["name"])
+        if a is None:
+            a = agg[ev["name"]] = dict(
+                calls=0, total_ms=0.0, min_ms=float("inf"), max_ms=0.0,
+                cat=ev.get("cat", "?"))
+        a["calls"] += 1
+        a["total_ms"] += dur_ms
+        a["min_ms"] = min(a["min_ms"], dur_ms)
+        a["max_ms"] = max(a["max_ms"], dur_ms)
+    for a in agg.values():
+        a["avg_ms"] = a["total_ms"] / max(a["calls"], 1)
+    return agg
+
+
+_SORT = {"total": "total_ms", "avg": "avg_ms", "max": "max_ms",
+         "calls": "calls"}
+
+
+def format_table(agg: dict, top: int = 20, sort: str = "total") -> str:
+    field = _SORT[sort]
+    header = (f"{'name':<36}{'cat':>6}{'calls':>8}{'total(ms)':>12}"
+              f"{'avg(ms)':>12}{'min(ms)':>12}{'max(ms)':>12}")
+    lines = [header, "-" * len(header)]
+    for name, a in sorted(agg.items(),
+                          key=lambda kv: -kv[1][field])[:top]:
+        lines.append(
+            f"{name[:35]:<36}{a['cat']:>6}{a['calls']:>8}"
+            f"{a['total_ms']:>12.3f}{a['avg_ms']:>12.3f}"
+            f"{a['min_ms']:>12.3f}{a['max_ms']:>12.3f}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="chrome-trace JSON file")
+    ap.add_argument("--top", type=int, default=20)
+    ap.add_argument("--sort", choices=sorted(_SORT), default="total")
+    ap.add_argument("--cat", default="all",
+                    help="event category filter (op, user, all)")
+    args = ap.parse_args(argv)
+
+    trace = load_trace(args.trace)
+    agg = summarize(trace, cat=args.cat)
+    if not agg:
+        print(f"{args.trace}: no complete events"
+              + (f" in category '{args.cat}'" if args.cat != "all" else ""))
+        return 1
+    meta = trace.get("metadata", {})
+    if meta:
+        bits = [f"rank {meta.get('rank', '?')}/"
+                f"{meta.get('world_size', '?')}"]
+        if "xla_compiles" in meta:
+            bits.append(f"xla compiles {meta['xla_compiles']} "
+                        f"({meta.get('xla_compile_secs', 0)}s)")
+        print(f"# {args.trace}: " + ", ".join(bits))
+    print(format_table(agg, top=args.top, sort=args.sort))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
