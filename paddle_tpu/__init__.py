@@ -80,6 +80,7 @@ from . import vision  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import monitor  # noqa: F401,E402
+from . import analysis  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import text  # noqa: F401,E402
 from . import utils  # noqa: F401,E402

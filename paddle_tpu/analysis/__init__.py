@@ -1,0 +1,67 @@
+"""paddle_tpu.analysis — trace-time program linting (static analysis).
+
+The static half of the correctness tooling: where `paddle_tpu.monitor`
+and the profiler report graph breaks, recompiles, and waste *after*
+they happen (docs/OBSERVABILITY.md), this package finds them before
+anything executes:
+
+* `ast_lint`   — the dy2static analog: walks `forward` / `to_static`
+  bodies and flags code that will break (or silently poison) a trace,
+  with the exact `_BREAK_ERRORS` member it will raise.
+* `jaxpr_lint` — abstractly traces a function / StaticFunction /
+  TrainStep via `jax.make_jaxpr` over `InputSpec`-derived shape structs
+  (no device execution) and lints the staged program: dtype promotion,
+  baked-in constants, dead computation, unused (donated) inputs,
+  unrolled Python loops, recompile-risk static args.
+
+Surfaces: `StaticFunction.inspect()` / `TrainStep.inspect()` /
+`Model.inspect()`, the opt-in `PADDLE_TPU_LINT=1` first-compile hook,
+and the dependency-free `tools/paddle_lint.py` CLI. Rule catalog:
+docs/ANALYSIS.md.
+"""
+from __future__ import annotations
+
+import os
+
+from .ast_lint import (lint_callable, lint_file, lint_paths,  # noqa: F401
+                       lint_source)
+from .findings import (AST_RULES, ERROR, INFO, JAXPR_RULES,  # noqa: F401
+                       WARNING, Finding, Report)
+from .jaxpr_lint import (lint_closed_jaxpr, lint_static_args,  # noqa: F401
+                         lint_static_function, lint_train_step,
+                         lint_traceable, to_shape_struct)
+
+
+def lint_enabled() -> bool:
+    """True when the opt-in first-compile lint hook is on
+    (``PADDLE_TPU_LINT=1``)."""
+    return os.environ.get("PADDLE_TPU_LINT", "0").lower() in (
+        "1", "true", "yes", "on")
+
+
+def lint_on_first_compile(inspect_fn, *args, **kwargs):
+    """Shared first-compile hook body for StaticFunction and TrainStep:
+    opt-in via PADDLE_TPU_LINT=1, and never allowed to break the
+    compiling call."""
+    if not lint_enabled():
+        return
+    try:
+        emit_findings(inspect_fn(*args, **kwargs))
+    except Exception:
+        pass
+
+
+def emit_findings(report: Report) -> Report:
+    """Route a lint report through paddle_tpu.monitor (counters per
+    rule) and warn once with the formatted findings. Used by the
+    first-compile hook; cheap no-op for an empty report."""
+    if not report:
+        return report
+    from .. import monitor
+    monitor.counter("lint.findings").increase(len(report))
+    for rule, fs in report.by_rule().items():
+        monitor.counter(f"lint.{rule}").increase(len(fs))
+    import warnings
+    warnings.warn(f"[paddle_tpu.analysis]\n{report.format()}",
+                  stacklevel=3)
+    return report

@@ -1,0 +1,515 @@
+"""AST trace-safety linter — the dy2static analog as a diagnostic pass.
+
+The reference stack *rewrites* un-stageable Python (dy2static AST
+transforms turn `if tensor:` into cond ops, PIR passes reject the
+rest). On TPU jax.jit traces by execution, so there is nothing to
+rewrite — but the same constructs still break the trace, at runtime,
+after a compile has already been paid for. This pass finds them ahead
+of time by walking `forward` / `to_static` bodies and tracking which
+names hold traced values.
+
+Value inference is a three-level lattice, deliberately conservative —
+the shipped model zoo must lint clean:
+
+* ``STATIC`` (0) — host-side Python: config knobs, ``.shape``-derived
+  ints, ``len()``, identity checks (``x is None``);
+* ``TENSOR`` (1) — a traced value: branching on it / concretizing it
+  breaks the trace;
+* ``CONTAINER`` (2) — a Python tuple/list/dict *of* tensors
+  (``*args``, spec lists): truth-testing it is a static length check
+  (safe), but indexing yields a TENSOR.
+
+Function parameters are tensor-likely, EXCEPT ``self``/``cls`` and
+parameters whose default is a bool/int/float/str literal; ``*args``
+and ``**kwargs`` seed as containers. Operations/calls involving a
+tensor produce tensors; everything else is host-side Python.
+
+Stdlib-only on purpose: tools/paddle_lint.py loads this module without
+paddle_tpu or jax installed.
+"""
+from __future__ import annotations
+
+import ast
+import inspect as _inspect
+import os
+import textwrap
+from typing import Dict, List, Optional, Set
+
+try:
+    from .findings import (ERROR, HOST_RNG, TENSOR_BOOL_BRANCH,
+                           TENSOR_HOST_SYNC, TENSOR_INPLACE, TENSOR_PY_CAST,
+                           WARNING, Finding)
+except ImportError:  # loaded file-directly by tools/paddle_lint.py
+    from findings import (ERROR, HOST_RNG, TENSOR_BOOL_BRANCH,  # type: ignore
+                          TENSOR_HOST_SYNC, TENSOR_INPLACE, TENSOR_PY_CAST,
+                          WARNING, Finding)
+
+STATIC, TENSOR, CONTAINER = 0, 1, 2
+
+# attributes/methods of a Tensor that are host-side Python values even
+# under a trace (shapes are static in XLA): branching on these is safe
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "place", "name", "size",
+                 "stop_gradient", "is_leaf", "persistable"}
+_STATIC_METHODS = {"dim", "ndimension", "numel", "element_size"}
+
+# host-sync methods: concretize a tracer -> _BREAK_ERRORS at trace time
+_HOST_SYNC_METHODS = {"numpy": "TracerArrayConversionError",
+                      "item": "TracerArrayConversionError",
+                      "tolist": "TracerArrayConversionError"}
+
+_PY_CASTS = {"bool": "TracerBoolConversionError",
+             "int": "TracerIntegerConversionError",
+             "float": "ConcretizationTypeError"}
+
+# builtins whose result is host-side regardless of tensor arguments
+_STATIC_BUILTINS = {"len", "isinstance", "issubclass", "hasattr", "getattr",
+                    "setattr", "print", "repr", "str", "id", "type",
+                    "callable", "format"}
+
+# module roots whose calls are host-side effects baked into the trace
+# as constants (same value on every compiled-step execution)
+_HOST_RNG_ROOTS = ("time.", "random.", "np.random.", "numpy.random.")
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_static_default(node: Optional[ast.AST]) -> bool:
+    return (isinstance(node, ast.Constant)
+            and isinstance(node.value, (bool, int, float, str)))
+
+
+class _FunctionLinter:
+    """Lints one function body, tracking value levels per name."""
+
+    def __init__(self, fn: ast.FunctionDef, filename: str,
+                 line_offset: int = 0):
+        self.fn = fn
+        self.filename = filename
+        self.line_offset = line_offset
+        self.findings: List[Finding] = []
+        self.level: Dict[str, int] = {}
+        self.params: Set[str] = set()
+        self.declared: Set[str] = set()
+        self._seed_params()
+
+    def _seed_params(self):
+        a = self.fn.args
+        positional = list(a.posonlyargs) + list(a.args)
+        defaults = list(a.defaults)
+        # right-align defaults against positional params
+        pad = [None] * (len(positional) - len(defaults))
+        for arg, default in zip(positional, pad + defaults):
+            self.declared.add(arg.arg)
+            if arg.arg in ("self", "cls"):
+                continue
+            if _is_static_default(default):
+                continue  # training=False / axis=1 style config knob
+            self.level[arg.arg] = TENSOR
+            self.params.add(arg.arg)
+        for arg, default in zip(a.kwonlyargs, a.kw_defaults):
+            self.declared.add(arg.arg)
+            if not _is_static_default(default):
+                self.level[arg.arg] = TENSOR
+                self.params.add(arg.arg)
+        # *args / **kwargs: Python containers whose ELEMENTS are
+        # tensor-likely — `if args:` is a static length check, args[0]
+        # is a tensor
+        if a.vararg is not None:
+            self.declared.add(a.vararg.arg)
+            self.level[a.vararg.arg] = CONTAINER
+        if a.kwarg is not None:
+            self.declared.add(a.kwarg.arg)
+            self.level[a.kwarg.arg] = CONTAINER
+
+    def _inherit(self, outer: "_FunctionLinter"):
+        """Layer the enclosing scope's knowledge under this function's
+        own parameters (nested trace helpers see enclosing locals)."""
+        for name, lvl in outer.level.items():
+            if name not in self.declared:
+                self.level.setdefault(name, lvl)
+        self.params |= outer.params
+
+    # -- reporting -----------------------------------------------------------
+    def _flag(self, rule, severity, node, message, breaks_with="",
+              suggestion=""):
+        self.findings.append(Finding(
+            rule=rule, severity=severity, message=message,
+            file=self.filename,
+            line=getattr(node, "lineno", 0) + self.line_offset,
+            breaks_with=breaks_with, suggestion=suggestion))
+
+    # -- statements ----------------------------------------------------------
+    def run(self) -> List[Finding]:
+        self.block(self.fn.body)
+        return self.findings
+
+    def block(self, stmts):
+        for s in stmts:
+            self.stmt(s)
+
+    def stmt(self, s):
+        if isinstance(s, ast.Assign):
+            t = self.expr(s.value)
+            for target in s.targets:
+                self.bind(target, t)
+        elif isinstance(s, ast.AugAssign):
+            t = self.expr(s.value)
+            if isinstance(s.target, ast.Name):
+                t = max(t, self.level.get(s.target.id, STATIC))
+            self.bind(s.target, t)
+        elif isinstance(s, ast.AnnAssign):
+            t = self.expr(s.value) if s.value is not None else STATIC
+            self.bind(s.target, t)
+        elif isinstance(s, ast.If):
+            if self.expr(s.test) == TENSOR:
+                self._flag(
+                    TENSOR_BOOL_BRANCH, ERROR, s.test,
+                    "`if` on a tensor value forces a host sync",
+                    breaks_with="TracerBoolConversionError",
+                    suggestion="use paddle.static.nn.cond (lax.cond) to "
+                               "keep the branch compiled")
+            self.block(s.body)
+            self.block(s.orelse)
+        elif isinstance(s, ast.While):
+            if self.expr(s.test) == TENSOR:
+                self._flag(
+                    TENSOR_BOOL_BRANCH, ERROR, s.test,
+                    "`while` on a tensor value forces a host sync per "
+                    "iteration",
+                    breaks_with="TracerBoolConversionError",
+                    suggestion="use paddle.static.nn.while_loop "
+                               "(lax.while_loop) to keep the loop compiled")
+            self.block(s.body)
+            self.block(s.orelse)
+        elif isinstance(s, ast.For):
+            t = self.expr(s.iter)
+            self.bind(s.target, TENSOR if t else STATIC,
+                      flag_inplace=False)
+            self.block(s.body)
+            self.block(s.orelse)
+        elif isinstance(s, ast.Assert):
+            if self.expr(s.test) == TENSOR:
+                self._flag(
+                    TENSOR_BOOL_BRANCH, ERROR, s.test,
+                    "`assert` on a tensor value forces a host sync",
+                    breaks_with="TracerBoolConversionError",
+                    suggestion="assert on .shape/.dtype (static), or move "
+                               "value checks out of the traced body")
+            if s.msg is not None:
+                self.expr(s.msg)
+        elif isinstance(s, ast.Return):
+            if s.value is not None:
+                self.expr(s.value)
+        elif isinstance(s, ast.Expr):
+            self.expr(s.value)
+        elif isinstance(s, ast.With):
+            for item in s.items:
+                self.expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self.bind(item.optional_vars, STATIC)
+            self.block(s.body)
+        elif isinstance(s, ast.Try):
+            self.block(s.body)
+            for h in s.handlers:
+                self.block(h.body)
+            self.block(s.orelse)
+            self.block(s.finalbody)
+        elif isinstance(s, ast.FunctionDef):
+            # nested helper: its parameters receive values from the
+            # traced enclosing body, so seed them tensor-likely (same
+            # default-value rule) layered over the enclosing scope
+            sub = _FunctionLinter(s, self.filename, self.line_offset)
+            sub._inherit(self)
+            self.findings.extend(sub.run())
+        elif isinstance(s, (ast.Raise, ast.Delete)):
+            for child in ast.iter_child_nodes(s):
+                if isinstance(child, ast.expr):
+                    self.expr(child)
+        # Pass/Break/Continue/Import/Global/Nonlocal: nothing to track
+
+    # -- binding -------------------------------------------------------------
+    def bind(self, target, level: int, flag_inplace: bool = True):
+        if isinstance(target, ast.Name):
+            if level:
+                self.level[target.id] = level
+            else:
+                self.level.pop(target.id, None)
+                self.params.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            # unpacking a container yields its (tensor) elements
+            elt = TENSOR if level else STATIC
+            for e in target.elts:
+                self.bind(e, elt, flag_inplace)
+        elif isinstance(target, ast.Starred):
+            self.bind(target.value,
+                      CONTAINER if level else STATIC, flag_inplace)
+        elif isinstance(target, ast.Subscript):
+            base = target.value
+            if (flag_inplace and isinstance(base, ast.Name)
+                    and base.id in self.params):
+                self._flag(
+                    TENSOR_INPLACE, WARNING, target,
+                    f"in-place subscript store into argument "
+                    f"'{base.id}'",
+                    suggestion="functional update (paddle.scatter / "
+                               "jnp .at[].set) — mutating a traced "
+                               "argument leaks tracers or bakes stale "
+                               "values")
+            self.expr(base)
+        # Attribute target (self.x = ...): host-side state, skip
+
+    # -- expressions ---------------------------------------------------------
+    def expr(self, e) -> int:
+        """Evaluate the value level of `e`, flagging hazards on the
+        way. Always walks every subexpression (no short-circuit) so
+        nested defects are reported."""
+        if e is None:
+            return STATIC
+        if isinstance(e, ast.Name):
+            return self.level.get(e.id, STATIC)
+        if isinstance(e, ast.Constant):
+            return STATIC
+        if isinstance(e, ast.Attribute):
+            base = self.expr(e.value)
+            if e.attr in _STATIC_ATTRS:
+                return STATIC
+            return TENSOR if base == TENSOR else STATIC
+        if isinstance(e, ast.Call):
+            return self._call(e)
+        if isinstance(e, ast.BinOp):
+            return max(self.expr(e.left), self.expr(e.right))
+        if isinstance(e, ast.UnaryOp):
+            return self.expr(e.operand)
+        if isinstance(e, ast.BoolOp):
+            levels = [self.expr(v) for v in e.values]
+            # every operand of and/or is truth-tested: a TENSOR operand
+            # is the hazard even if another operand is a container
+            if TENSOR in levels:
+                return TENSOR
+            return max(levels, default=STATIC)
+        if isinstance(e, ast.Compare):
+            parts = [self.expr(e.left)] + [self.expr(c)
+                                           for c in e.comparators]
+            identity_only = all(isinstance(op, (ast.Is, ast.IsNot, ast.In,
+                                                ast.NotIn))
+                                for op in e.ops)
+            if TENSOR in parts and not identity_only:
+                return TENSOR
+            return STATIC
+        if isinstance(e, ast.Subscript):
+            base = self.expr(e.value)
+            self.expr(e.slice)
+            if base == CONTAINER:
+                # slicing a container keeps it a container; indexing
+                # yields an element (tensor)
+                return CONTAINER if isinstance(e.slice, ast.Slice) \
+                    else TENSOR
+            return base
+        if isinstance(e, ast.IfExp):
+            if self.expr(e.test) == TENSOR:
+                self._flag(
+                    TENSOR_BOOL_BRANCH, ERROR, e.test,
+                    "conditional expression on a tensor value forces a "
+                    "host sync",
+                    breaks_with="TracerBoolConversionError",
+                    suggestion="use paddle.where / static.nn.cond")
+            return max(self.expr(e.body), self.expr(e.orelse))
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            levels = [self.expr(x) for x in e.elts]
+            return CONTAINER if any(levels) else STATIC
+        if isinstance(e, ast.Dict):
+            for k in e.keys:
+                if k is not None:
+                    self.expr(k)
+            return CONTAINER if any([self.expr(v) for v in e.values]) \
+                else STATIC
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            for gen in e.generators:
+                t = self.expr(gen.iter)
+                self.bind(gen.target, TENSOR if t else STATIC,
+                          flag_inplace=False)
+                for cond in gen.ifs:
+                    self.expr(cond)
+            return CONTAINER if self.expr(e.elt) else STATIC
+        if isinstance(e, ast.DictComp):
+            for gen in e.generators:
+                t = self.expr(gen.iter)
+                self.bind(gen.target, TENSOR if t else STATIC,
+                          flag_inplace=False)
+            self.expr(e.key)
+            return CONTAINER if self.expr(e.value) else STATIC
+        if isinstance(e, ast.JoinedStr):
+            for v in e.values:
+                if isinstance(v, ast.FormattedValue):
+                    self.expr(v.value)
+            return STATIC
+        if isinstance(e, ast.Starred):
+            return self.expr(e.value)
+        if isinstance(e, ast.Lambda):
+            return STATIC
+        if isinstance(e, ast.Slice):
+            for part in (e.lower, e.upper, e.step):
+                if part is not None:
+                    self.expr(part)
+            return STATIC
+        if isinstance(e, (ast.Await, ast.NamedExpr)):
+            inner = self.expr(e.value)
+            if isinstance(e, ast.NamedExpr):
+                self.bind(e.target, inner)
+            return inner
+        return STATIC
+
+    def _call(self, e: ast.Call) -> int:
+        arg_levels = [self.expr(a) for a in e.args]
+        arg_levels += [self.expr(kw.value) for kw in e.keywords]
+        any_tensorish = any(arg_levels)
+        f = e.func
+        if isinstance(f, ast.Name):
+            name = f.id
+            if name in _PY_CASTS and TENSOR in arg_levels:
+                self._flag(
+                    TENSOR_PY_CAST, ERROR, e,
+                    f"{name}() on a tensor value forces a host sync",
+                    breaks_with=_PY_CASTS[name],
+                    suggestion="keep the value a tensor (.astype for "
+                               "dtype changes); convert outside the "
+                               "traced body")
+                return STATIC
+            if name == "range" and TENSOR in arg_levels:
+                self._flag(
+                    TENSOR_PY_CAST, ERROR, e,
+                    "range() over a tensor value forces a host sync",
+                    breaks_with="TracerIntegerConversionError",
+                    suggestion="loop bounds must be Python ints under a "
+                               "trace; use lax.fori_loop/scan for traced "
+                               "bounds")
+                return STATIC
+            if name in _STATIC_BUILTINS:
+                return STATIC
+            return TENSOR if any_tensorish else STATIC
+        if isinstance(f, ast.Attribute):
+            base = self.expr(f.value)
+            if base == TENSOR and f.attr in _HOST_SYNC_METHODS:
+                self._flag(
+                    TENSOR_HOST_SYNC, ERROR, e,
+                    f".{f.attr}() on a tensor inside a traced body",
+                    breaks_with=_HOST_SYNC_METHODS[f.attr],
+                    suggestion="stay in tensor ops, or mark the function "
+                               "not_to_static and accept eager execution")
+                return STATIC
+            if base == TENSOR and f.attr in _STATIC_METHODS:
+                return STATIC
+            if base == TENSOR and (
+                    f.attr == "set_value"
+                    or (f.attr.endswith("_")
+                        and not f.attr.startswith("_"))):
+                # trailing-underscore = the framework's in-place family
+                # (fill_/zero_/add_/cast_/..., plus set_value/copy_)
+                self._flag(
+                    TENSOR_INPLACE, WARNING, e,
+                    f"in-place .{f.attr}() on a traced value",
+                    suggestion="use the out-of-place variant; in-place "
+                               "mutation of values captured from outside "
+                               "the trace leaks tracers "
+                               "(UnexpectedTracerError)")
+                return TENSOR
+            dotted = _dotted(f)
+            if dotted and any(dotted.startswith(root)
+                              for root in _HOST_RNG_ROOTS):
+                self._flag(
+                    HOST_RNG, WARNING, e,
+                    f"host-side call {dotted}() is evaluated ONCE at "
+                    f"trace time and baked into the executable",
+                    suggestion="use paddle.rand/randn (traced RNG) or "
+                               "pass the value as an input")
+                return STATIC
+            if base == TENSOR or any_tensorish:
+                return TENSOR
+            return STATIC
+        self.expr(f)
+        return TENSOR if any_tensorish else STATIC
+
+
+def _is_to_static_decorator(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    name = _dotted(dec) if not isinstance(dec, ast.Name) else dec.id
+    return bool(name) and name.split(".")[-1] == "to_static"
+
+
+def lint_source(src: str, filename: str = "<string>",
+                line_offset: int = 0,
+                all_functions: bool = False) -> List[Finding]:
+    """Lint every `forward` method and `to_static`-decorated function in
+    `src`. With all_functions=True, lint every function (used when the
+    caller knows the code runs under a trace, e.g. inspect())."""
+    try:
+        tree = ast.parse(textwrap.dedent(src))
+    except SyntaxError as exc:
+        return [Finding(rule="syntax-error", severity=ERROR,
+                        message=str(exc), file=filename,
+                        line=exc.lineno or 0)]
+    findings: List[Finding] = []
+    linted: Set[int] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef) or id(node) in linted:
+            continue
+        if not (all_functions or node.name == "forward"
+                or any(_is_to_static_decorator(d)
+                       for d in node.decorator_list)):
+            continue
+        # nested defs are linted (with scope) by their enclosing linter
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.FunctionDef):
+                linted.add(id(sub))
+        findings.extend(
+            _FunctionLinter(node, filename, line_offset).run())
+    findings.sort(key=lambda f: (f.file, f.line))
+    return findings
+
+
+def lint_file(path: str, all_functions: bool = False) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), filename=path,
+                           all_functions=all_functions)
+
+
+def lint_paths(paths, all_functions: bool = False) -> List[Finding]:
+    """Lint files and (recursively) directories of .py files."""
+    findings: List[Finding] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for root, _dirs, files in os.walk(path):
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        findings.extend(lint_file(
+                            os.path.join(root, name), all_functions))
+        else:
+            findings.extend(lint_file(path, all_functions))
+    return findings
+
+
+def lint_callable(fn, name: Optional[str] = None) -> List[Finding]:
+    """Lint a live function/method/Layer-forward (inspect() path)."""
+    target = fn
+    if hasattr(fn, "forward") and not _inspect.isfunction(fn):
+        target = fn.forward
+    target = _inspect.unwrap(target)
+    target = getattr(target, "__func__", target)
+    try:
+        src = _inspect.getsource(target)
+        filename = _inspect.getsourcefile(target) or "<unknown>"
+        _lines, first = _inspect.getsourcelines(target)
+    except (OSError, TypeError):
+        return []
+    return lint_source(src, filename=filename, line_offset=first - 1,
+                       all_functions=True)

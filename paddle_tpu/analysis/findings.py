@@ -1,0 +1,131 @@
+"""Finding/Report data model shared by both linters and the CLI.
+
+Stdlib-only on purpose: tools/paddle_lint.py loads this file (and
+ast_lint.py) directly, without importing paddle_tpu or jax, so the CLI
+works on a machine that has neither installed.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, Iterator, List, Optional
+
+# -- rule catalog (docs/ANALYSIS.md documents each one) ----------------------
+
+# AST (trace-safety) rules — what dy2static's transforms reject/rewrite
+TENSOR_BOOL_BRANCH = "tensor-bool-branch"
+TENSOR_HOST_SYNC = "tensor-host-sync"
+TENSOR_PY_CAST = "tensor-py-cast"
+TENSOR_INPLACE = "tensor-inplace"
+HOST_RNG = "host-rng"
+
+# jaxpr (staged-program) rules — what the abstract trace reveals
+GRAPH_BREAK = "graph-break"
+TRACE_FAILED = "trace-failed"
+DTYPE_PROMOTION = "dtype-promotion"
+LARGE_CONSTANT = "large-constant"
+DEAD_COMPUTATION = "dead-computation"
+UNUSED_INPUT = "unused-input"
+CONSTANT_OUTPUT = "constant-output"
+UNROLLED_LOOP = "unrolled-loop"
+STATIC_ARG_RECOMPILE = "static-arg-recompile"
+
+AST_RULES = (TENSOR_BOOL_BRANCH, TENSOR_HOST_SYNC, TENSOR_PY_CAST,
+             TENSOR_INPLACE, HOST_RNG)
+JAXPR_RULES = (GRAPH_BREAK, TRACE_FAILED, DTYPE_PROMOTION,
+               LARGE_CONSTANT, DEAD_COMPUTATION, UNUSED_INPUT,
+               CONSTANT_OUTPUT, UNROLLED_LOOP, STATIC_ARG_RECOMPILE)
+
+ERROR = "error"      # will raise at trace time (a _BREAK_ERRORS member)
+WARNING = "warning"  # traces, but recompiles / wastes memory / is wrong
+INFO = "info"
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    severity: str
+    message: str
+    file: str = "<unknown>"
+    line: int = 0
+    # the exact jit.api.StaticFunction._BREAK_ERRORS member this defect
+    # raises at trace time ("" for defects that trace but misbehave)
+    breaks_with: str = ""
+    suggestion: str = ""
+
+    def format(self) -> str:
+        loc = f"{self.file}:{self.line}" if self.line else self.file
+        out = f"{loc}: {self.severity}: [{self.rule}] {self.message}"
+        if self.breaks_with:
+            out += f" (raises {self.breaks_with} at trace time)"
+        if self.suggestion:
+            out += f" — {self.suggestion}"
+        return out
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+class Report:
+    """An ordered collection of findings with formatting helpers.
+
+    Truthiness is "has findings", so `if report:` reads naturally in
+    both the CLI (exit nonzero) and the first-compile hook."""
+
+    def __init__(self, findings: Optional[List[Finding]] = None,
+                 subject: str = ""):
+        self.findings: List[Finding] = list(findings or [])
+        self.subject = subject
+
+    def add(self, finding: Finding):
+        self.findings.append(finding)
+
+    def extend(self, findings) -> "Report":
+        for f in findings:
+            self.findings.append(f)
+        return self
+
+    def __bool__(self) -> bool:
+        return bool(self.findings)
+
+    def __len__(self) -> int:
+        return len(self.findings)
+
+    def __iter__(self) -> Iterator[Finding]:
+        return iter(self.findings)
+
+    def rules(self) -> List[str]:
+        seen: List[str] = []
+        for f in self.findings:
+            if f.rule not in seen:
+                seen.append(f.rule)
+        return seen
+
+    def by_rule(self) -> Dict[str, List[Finding]]:
+        out: Dict[str, List[Finding]] = {}
+        for f in self.findings:
+            out.setdefault(f.rule, []).append(f)
+        return out
+
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == ERROR]
+
+    def format(self) -> str:
+        if not self.findings:
+            head = self.subject or "program"
+            return f"{head}: no findings"
+        lines = []
+        if self.subject:
+            lines.append(f"== {self.subject}: {len(self.findings)} "
+                         f"finding(s) ==")
+        lines.extend(f.format() for f in self.findings)
+        return "\n".join(lines)
+
+    def to_json(self) -> str:
+        return json.dumps({"subject": self.subject,
+                           "findings": [f.to_dict() for f in self.findings]},
+                          indent=2)
+
+    def __repr__(self):
+        return (f"Report(subject={self.subject!r}, "
+                f"findings={len(self.findings)})")

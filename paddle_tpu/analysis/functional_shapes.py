@@ -1,0 +1,32 @@
+"""Shape-only views of layer/optimizer state for abstract tracing.
+
+The jaxpr linter traces the same pure functions jit.api compiles, but
+with every array replaced by a `jax.ShapeDtypeStruct` — shapes and
+dtypes in, no buffers touched, nothing executed on device.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def tree_structs(tree):
+    """Replace every array leaf of a pytree with its ShapeDtypeStruct."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
+        if hasattr(a, "shape") else a, tree)
+
+
+def rng_key_struct():
+    """ShapeDtypeStruct of a framework PRNG key WITHOUT consuming one:
+    inspect() must not advance the random stream (a lint must never
+    change the program's numbers)."""
+    return jax.eval_shape(lambda: jax.random.key(0))
+
+
+def layer_state_structs(layer):
+    """(params, buffers, frozen) as ShapeDtypeStruct pytrees, matching
+    jit.functional.get_params/get_buffers/get_frozen."""
+    from ..jit.functional import get_buffers, get_frozen, get_params
+    return (tree_structs(get_params(layer)),
+            tree_structs(get_buffers(layer)),
+            tree_structs(get_frozen(layer)))

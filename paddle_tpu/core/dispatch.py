@@ -254,6 +254,11 @@ def _run_op_nodiff(name: str, fn: Callable, tensor_args: Sequence[Any],
                    **attrs):
     arrays = [unwrap(x) for x in tensor_args]
     out = fn(*arrays, **attrs)
+    # nodiff ops with inexact outputs (sort, cumsum variants routed
+    # here) must not bypass the NaN/Inf scan the diff path runs —
+    # _check_nan_inf already skips integer/bool outputs itself
+    if get_flag("check_nan_inf"):
+        _check_nan_inf(name, jax.tree_util.tree_leaves(out))
     _notify(name, out)
     return jax.tree_util.tree_map(
         lambda a: wrap(a, stop_gradient=True), out,

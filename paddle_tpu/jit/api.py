@@ -13,6 +13,7 @@ reused in place on TPU.
 from __future__ import annotations
 
 import functools
+import inspect as _inspect
 from typing import Any, Callable, Optional
 
 import jax
@@ -35,6 +36,28 @@ class InputSpec:
         self.dtype = dtype
         self.name = name
 
+    def matches(self, shape, dtype) -> Optional[str]:
+        """None if (shape, dtype) satisfies the spec, else the reason.
+        None/-1 spec dims are wildcards (dynamic batch)."""
+        shape = tuple(shape)
+        if len(shape) != len(self.shape):
+            return (f"rank mismatch: got {list(shape)}, spec expects "
+                    f"{self.shape}")
+        for got, want in zip(shape, self.shape):
+            if want not in (None, -1) and got != want:
+                return (f"shape mismatch: got {list(shape)}, spec expects "
+                        f"{self.shape}")
+        from ..core import dtype as dtype_mod
+        try:
+            want_np = dtype_mod.dtype(self.dtype).np_dtype
+        except Exception:
+            # a typo'd spec dtype must not silently disable the check
+            return (f"spec dtype {self.dtype!r} is not a known dtype "
+                    "(typo in the InputSpec?)")
+        if np.dtype(dtype) != np.dtype(want_np):
+            return f"dtype mismatch: got {dtype}, spec expects {self.dtype}"
+        return None
+
     def __repr__(self):
         return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
 
@@ -52,6 +75,11 @@ def _sig_of(args, kwargs):
         v = kwargs[k]
         if isinstance(v, Tensor):
             parts.append((k, tuple(v._data.shape), str(v._data.dtype)))
+        elif isinstance(v, (jnp.ndarray, jax.Array, np.ndarray)):
+            # shape/dtype only — repr(v) would bake element VALUES into
+            # the cache key (a new entry per batch of data, and keys the
+            # size of the array's print form)
+            parts.append((k, tuple(v.shape), str(v.dtype)))
         else:
             parts.append((k, repr(v)))
     return tuple(parts)
@@ -92,26 +120,84 @@ class StaticFunction:
     def layer(self):
         return self._layer
 
+    @property
+    def input_spec(self):
+        return self._input_spec
+
     def concrete_program(self):
         return None  # no program world on TPU
+
+    def _spec_list(self):
+        if self._input_spec is None:
+            return None
+        return list(self._input_spec) \
+            if isinstance(self._input_spec, (list, tuple)) \
+            else [self._input_spec]
+
+    def _validate_input_spec(self, tensor_args):
+        """Honor the stored InputSpec: reject calls whose array shapes/
+        dtypes contradict the declared signature (the reference's
+        dy2static does this at Program build; here the check is the
+        only thing standing between a typo and a silent recompile)."""
+        specs = self._spec_list()
+        if not specs:
+            return
+        for i, (spec, a) in enumerate(zip(specs, tensor_args)):
+            if not isinstance(spec, InputSpec):
+                continue
+            arr = a._data if isinstance(a, Tensor) else a
+            if not isinstance(arr, (jnp.ndarray, jax.Array, np.ndarray)):
+                continue
+            why = spec.matches(arr.shape, arr.dtype)
+            if why is not None:
+                name = getattr(self._fn, "__qualname__", "to_static fn")
+                raise ValueError(
+                    f"{name}: input #{i} violates input_spec: {why}")
+
+    def inspect(self, *args, **kwargs):
+        """Statically lint this function at the given example inputs —
+        AST trace-safety pass plus jaxpr rule passes over an abstract
+        trace (jax.make_jaxpr on ShapeDtypeStructs; nothing runs on
+        device). With no arguments, shapes come from the stored
+        InputSpec list. Returns an analysis.Report."""
+        from ..analysis import lint_static_function
+        return lint_static_function(self, args if args else None, kwargs)
+
+    def _maybe_lint_first_compile(self, args, kwargs):
+        """Opt-in (PADDLE_TPU_LINT=1) hook run when a signature first
+        compiles: findings go through paddle_tpu.monitor counters and
+        one warning. Never allowed to break the call."""
+        from ..analysis import lint_on_first_compile
+        lint_on_first_compile(self.inspect, *args, **kwargs)
 
     def _pure(self, static_kwargs):
         layer = self._layer
         fn = self._fn
 
+        # array-valued kwargs ride along as one traced dict pytree,
+        # re-wrapped and bound BY NAME — positional-tail binding would
+        # attach them to the wrong parameter, and leaving them in
+        # static_kwargs would bake their values into the closure while
+        # the cache key only carries shape/dtype
+        def wrap_kw(arr_kwargs):
+            kw = dict(static_kwargs)
+            for k, a in arr_kwargs.items():
+                kw[k] = Tensor._from_array(a)
+            return kw
+
         if layer is None:
-            def pure(*arrays):
+            def pure(arr_kwargs, *arrays):
                 with tape_mod.no_grad_guard():
                     targs = [Tensor._from_array(a) for a in arrays]
-                    out = fn(*targs, **static_kwargs)
+                    out = fn(*targs, **wrap_kw(arr_kwargs))
                 return jax.tree_util.tree_map(
                     lambda t: t._data if isinstance(t, Tensor) else t, out,
                     is_leaf=lambda t: isinstance(t, Tensor))
             return pure
 
-        def pure(params, buffers, frozen, key, *arrays):
+        def pure(params, buffers, frozen, key, arr_kwargs, *arrays):
             out, new_buf = functional_call(
-                layer, params, buffers, arrays, static_kwargs,
+                layer, params, buffers, arrays, wrap_kw(arr_kwargs),
                 frozen=frozen, rng_key=key)
             return out, new_buf
         return pure
@@ -193,17 +279,64 @@ class StaticFunction:
                 except AttributeError:
                     pass
 
+    def _positionalize(self, tensor_args, kwargs):
+        """Move keyword-passed arrays into their positional slots (by
+        the function's signature) while the slots stay contiguous.
+        Positional arrays get the full treatment — gradient flow, spec
+        validation, _sig_of keying; only non-contiguous array kwargs
+        are left to the (non-differentiable) traced-dict path."""
+        if not kwargs:
+            return kwargs
+        try:
+            params = list(_inspect.signature(
+                self._fn).parameters.values())
+        except (TypeError, ValueError):
+            return kwargs
+        kwargs = dict(kwargs)
+        for p in params[len(tensor_args):]:
+            if (p.kind != p.POSITIONAL_OR_KEYWORD
+                    or p.name not in kwargs
+                    or not isinstance(kwargs[p.name],
+                                      (Tensor, jnp.ndarray, jax.Array,
+                                       np.ndarray))):
+                break
+            tensor_args.append(kwargs.pop(p.name))
+        return kwargs
+
     def __call__(self, *args, **kwargs):
-        tensor_args = []
+        tensor_args = list(args)
+        kwargs = self._positionalize(tensor_args, kwargs)
+        # the positionalized form IS the call from here on — the
+        # graph-break fallback and the lint hook must see the same
+        # program the trace saw, not the original kwargs (a moved
+        # kwarg would silently fall back to its default)
+        args = tuple(tensor_args)
+        tensor_kwargs = {}
         static_kwargs = {}
-        for a in args:
-            tensor_args.append(a)
         for k, v in kwargs.items():
-            if isinstance(v, Tensor):
-                tensor_args.append(v)  # rare; treat as positional tail
+            if isinstance(v, Tensor) and not v.stop_gradient \
+                    and tape_mod.is_grad_enabled():
+                import warnings
+                warnings.warn(
+                    f"to_static: tensor kwarg '{k}' requires grad but "
+                    "cannot take a positional slot (keyword-only, or "
+                    "behind a non-tensor kwarg); gradients do NOT flow "
+                    "through keyword tensors in the compiled path — "
+                    "pass it positionally.", stacklevel=2)
+            if isinstance(v, (Tensor, jnp.ndarray, jax.Array, np.ndarray)):
+                # traced by name through _pure's arr_kwargs dict: in
+                # static_kwargs the VALUES would be baked into the
+                # jitted closure while the cache key only carries
+                # shape/dtype (stale replay); on the positional tail
+                # they would bind to the wrong parameter. Gradients do
+                # NOT flow through this dict — only through positional
+                # (incl. positionalized) tensors
+                tensor_kwargs[k] = v
             else:
                 static_kwargs[k] = v
-        sig = _sig_of(tensor_args, static_kwargs)
+        self._validate_input_spec(tensor_args)
+        sig = _sig_of(tensor_args, {**static_kwargs, **tensor_kwargs})
+        kw_arrays = {k: unwrap(v) for k, v in tensor_kwargs.items()}
         pinned = self._eager_sigs.get(sig)
         if pinned is not None:
             if (pinned + 1 < self._RETRY_AFTER
@@ -221,9 +354,11 @@ class StaticFunction:
             if entry is None:
                 entry = jax.jit(self._pure(static_kwargs))
                 self._cache[sig] = entry
+                self._maybe_lint_first_compile(args, kwargs)
             try:
                 # ONE tape op: compiled forward, vjp = compiled backward
-                return run_op("jit_fn", entry, tensor_args)
+                # (kwarg arrays ride in the leading dict — non-diff)
+                return run_op("jit_fn", entry, [kw_arrays] + tensor_args)
             except self._BREAK_ERRORS as exc:
                 self._eager_sigs[sig] = 0
                 return self._graph_break(exc, args, kwargs)
@@ -235,11 +370,12 @@ class StaticFunction:
         if entry is None:
             entry = jax.jit(self._pure(static_kwargs))
             self._cache[sig] = entry
+            self._maybe_lint_first_compile(args, kwargs)
         key = random_mod.next_key()
         arrays = [unwrap(a) for a in tensor_args]
         try:
             out_arrays, new_buf = entry(params, buffers, frozen, key,
-                                        *arrays)
+                                        kw_arrays, *arrays)
         except self._BREAK_ERRORS as exc:
             self._eager_sigs[sig] = 0
             return self._graph_break(exc, args, kwargs)
@@ -326,7 +462,9 @@ class TrainStep:
         from .functional import _tensor_registry
         self._registry = _tensor_registry(model)
 
-    def _make_step(self):
+    def _build_step(self):
+        """The raw python step function (un-jitted) — also traced
+        abstractly by analysis.lint_train_step."""
         model, loss_fn, opt = self._model, self._loss_fn, self._opt
         amp_dtype = self._amp_dtype
 
@@ -367,8 +505,27 @@ class TrainStep:
                 params, grads, opt_state, lr)
             return new_params, new_buf, new_opt_state, loss
 
+        return step
+
+    def _make_step(self):
         donate = (0, 1, 3) if self._donate else ()
-        return jax.jit(step, donate_argnums=donate)
+        return jax.jit(self._build_step(), donate_argnums=donate)
+
+    @staticmethod
+    def _leaf_sig(tree):
+        return tuple(
+            (tuple(a.shape), str(a.dtype))
+            if isinstance(a, (jnp.ndarray, jax.Array, np.ndarray))
+            else ("S", repr(a))
+            for a in jax.tree_util.tree_leaves(tree))
+
+    def inspect(self, inputs, labels):
+        """Statically lint the fused train step at the given example
+        inputs/labels (Tensors, arrays, or InputSpecs — only shapes and
+        dtypes are read; nothing executes on device). Returns an
+        analysis.Report."""
+        from ..analysis import lint_train_step
+        return lint_train_step(self, inputs, labels)
 
     def __call__(self, inputs, labels):
         if not isinstance(inputs, (list, tuple)):
@@ -377,11 +534,16 @@ class TrainStep:
         lab_arrays = jax.tree_util.tree_map(
             lambda t: unwrap(t), labels,
             is_leaf=lambda t: isinstance(t, Tensor))
-        sig = tuple((a.shape, str(a.dtype)) for a in in_arrays)
+        # label leaves are part of the executable's signature too: a
+        # label shape/dtype change must not silently reuse (and retrace
+        # under) the executable cached for the old labels
+        sig = (self._leaf_sig(in_arrays), self._leaf_sig(lab_arrays))
         fn = self._compiled.get(sig)
         if fn is None:
             fn = self._make_step()
             self._compiled[sig] = fn
+            from ..analysis import lint_on_first_compile
+            lint_on_first_compile(self.inspect, inputs, labels)
         key = random_mod.next_key()
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
         self._params, self._buffers, self._opt_state, loss = fn(

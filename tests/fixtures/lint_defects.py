@@ -1,0 +1,68 @@
+"""Seeded trace-safety defects for the analysis-linter tests.
+
+Every class here carries exactly the defect its name says, at a known
+rule id. tests/test_analysis.py and tools/paddle_lint.py must flag all
+of them; the shipped model zoo must stay clean. This module is linted
+as SOURCE — it is never imported or executed.
+"""
+import time
+
+import numpy as np
+
+
+class BranchOnTensor:
+    def forward(self, x):
+        # tensor-bool-branch: value-dependent Python control flow
+        if x.mean() > 0:
+            return x * 2
+        while x.sum() > 1:
+            x = x * 0.5
+        return x
+
+
+class HostSyncInForward:
+    def forward(self, x):
+        # tensor-host-sync: concretizes the tracer mid-graph
+        stats = x.numpy()
+        return x - stats.mean()
+
+
+class PyCastOnTensor:
+    def forward(self, x):
+        # tensor-py-cast: float()/int() force a host sync
+        scale = float(x.abs().max())
+        steps = int(x.sum())
+        return x / scale + steps
+
+
+class InplaceOnTraced:
+    def forward(self, x, mask):
+        # tensor-inplace: mutating traced values
+        x[0] = 0.0
+        mask.zero_()
+        return x * mask
+
+
+class HostRandomInForward:
+    def forward(self, x):
+        # host-rng: baked into the executable at trace time
+        noise = np.random.normal(size=4)
+        t0 = time.time()
+        return x + noise[0] + (t0 - t0)
+
+
+class CleanModel:
+    """Trace-safe patterns that must NOT be flagged."""
+
+    def forward(self, x, y=None, training=False):
+        b, c = x.shape                    # static under trace
+        if y is not None:                 # identity check: safe
+            x = x + y
+        if training:                      # config knob: safe
+            x = x * 0.9
+        if b > 1 and c % 2 == 0:          # shape math: safe
+            x = x.reshape([b, c])
+        for _ in range(c):                # static bound: safe
+            pass
+        n = int(x.shape[0])               # int() of static: safe
+        return x, n

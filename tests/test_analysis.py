@@ -1,0 +1,497 @@
+"""paddle_tpu.analysis: trace-time program linting (ISSUE 2 tentpole).
+
+Jaxpr linter (abstract trace, no device execution), AST trace-safety
+linter, StaticFunction/TrainStep/Model.inspect(), InputSpec honoring,
+the PADDLE_TPU_LINT first-compile hook, the paddle_lint CLI, plus the
+satellite fixes (TrainStep label sig, _sig_of array kwargs, nodiff
+NaN check)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import analysis, monitor
+from paddle_tpu.analysis import findings as F
+from paddle_tpu.jit.api import InputSpec, TrainStep, _sig_of, to_static
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "lint_defects.py")
+MODEL_DIRS = [os.path.join(REPO, "paddle_tpu", "vision", "models"),
+              os.path.join(REPO, "paddle_tpu", "text", "models")]
+
+
+def a(*shape, dtype=np.float32):
+    return np.random.default_rng(0).standard_normal(shape).astype(dtype)
+
+
+# -- AST linter --------------------------------------------------------------
+
+def test_ast_lint_detects_all_seeded_defects():
+    found = analysis.lint_file(FIXTURE)
+    rules = {f.rule for f in found}
+    assert rules >= {F.TENSOR_BOOL_BRANCH, F.TENSOR_HOST_SYNC,
+                     F.TENSOR_PY_CAST, F.TENSOR_INPLACE, F.HOST_RNG}
+    # each finding names the exact _BREAK_ERRORS member where one applies
+    by_rule = {}
+    for f in found:
+        by_rule.setdefault(f.rule, f)
+    assert by_rule[F.TENSOR_BOOL_BRANCH].breaks_with == \
+        "TracerBoolConversionError"
+    assert by_rule[F.TENSOR_HOST_SYNC].breaks_with == \
+        "TracerArrayConversionError"
+    from paddle_tpu.jit.api import StaticFunction
+    break_names = {e.__name__ for e in StaticFunction._BREAK_ERRORS}
+    for f in found:
+        if f.breaks_with:
+            assert f.breaks_with in break_names
+    # every finding carries a real file:line
+    assert all(f.file.endswith("lint_defects.py") and f.line > 0
+               for f in found)
+
+
+def test_ast_lint_clean_patterns_not_flagged():
+    # CleanModel (tail of the fixture) exercises identity checks,
+    # shape-derived branching, config-knob defaults, int() of statics
+    found = analysis.lint_file(FIXTURE)
+    with open(FIXTURE) as fh:
+        src = fh.read()
+    clean_start = src[:src.index("class CleanModel")].count("\n") + 1
+    assert not [f for f in found if f.line >= clean_start]
+
+
+def test_ast_lint_nested_helper_params_seeded():
+    """Defects on a nested helper's own parameters are caught in the
+    default (forward-only) mode, with enclosing-scope knowledge."""
+    src = (
+        "class M:\n"
+        "    def forward(self, x, *states):\n"
+        "        n = x.shape[0]\n"
+        "        def helper(y):\n"
+        "            if y.sum() > 0:\n"
+        "                return y.numpy()\n"
+        "            if n > 1:        # enclosing static: safe\n"
+        "                y = y * 2\n"
+        "            return y\n"
+        "        if states:           # container length check: safe\n"
+        "            x = x + states[0]\n"
+        "        return helper(x)\n")
+    found = analysis.lint_source(src, "m.py")
+    rules = sorted(f.rule for f in found)
+    assert rules == [F.TENSOR_BOOL_BRANCH, F.TENSOR_HOST_SYNC]
+
+
+def test_ast_zero_false_positives_on_model_zoo():
+    assert analysis.lint_paths(MODEL_DIRS) == []
+
+
+def test_ast_lint_whole_package_self_check():
+    # the shipped package must lint clean (regression guard: a defect
+    # introduced into any forward/to_static body fails tier-1 here)
+    found = analysis.lint_paths([os.path.join(REPO, "paddle_tpu")])
+    assert found == [], "\n".join(f.format() for f in found)
+
+
+# -- jaxpr linter ------------------------------------------------------------
+
+def test_jaxpr_dead_computation_and_unrolled_loop_and_static_arg():
+    def messy(x, w, scale):
+        dead = paddle.cumsum(x)  # noqa: F841 — seeded dead compute
+        for _ in range(16):
+            x = paddle.tanh(paddle.matmul(x, w))
+        return x * scale
+
+    rep = to_static(messy).inspect(
+        InputSpec([4, 4]), InputSpec([4, 4]), 0.5)
+    rules = rep.rules()
+    assert F.DEAD_COMPUTATION in rules
+    assert F.UNROLLED_LOOP in rules
+    assert F.STATIC_ARG_RECOMPILE in rules
+    unroll = rep.by_rule()[F.UNROLLED_LOOP][0]
+    assert "16x" in unroll.message and "scan" in unroll.suggestion
+    static = rep.by_rule()[F.STATIC_ARG_RECOMPILE][0]
+    assert "#2" in static.message and static.severity == F.WARNING
+
+
+def test_jaxpr_dtype_promotion():
+    def promo(x):
+        return x * np.float32(1.5)  # widens the f16 compute to f32
+
+    rep = to_static(promo).inspect(InputSpec([8], "float16"))
+    found = rep.by_rule()[F.DTYPE_PROMOTION]
+    assert any("float16 -> float32" in f.message for f in found)
+
+
+def test_jaxpr_large_constant():
+    big = paddle.to_tensor(np.ones((512, 512), np.float32))
+
+    def withconst(x):
+        return paddle.matmul(x, big)
+
+    rep = to_static(withconst).inspect(InputSpec([4, 512]))
+    found = rep.by_rule()[F.LARGE_CONSTANT]
+    assert found and "1024 KiB" in found[0].message
+
+
+def test_jaxpr_graph_break_reported_not_raised():
+    """A genuine graph break must come back as a finding — inspect()
+    stays total on exactly the programs it exists to diagnose — and
+    must name the same _BREAK_ERRORS member the runtime call hits."""
+    class Gated(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8)
+
+        def forward(self, x):
+            y = self.fc(x)
+            if y.mean() > 0:  # value-dependent branch
+                y = y * 2
+            return y
+
+    rep = to_static(Gated()).inspect(InputSpec([4, 8]))
+    found = rep.by_rule()[F.GRAPH_BREAK]
+    assert found[0].severity == F.ERROR
+    assert found[0].breaks_with == "TracerArrayConversionError"
+
+
+def test_jaxpr_unused_input_and_constant_output():
+    def unused(x, y):
+        return x + 1.0, paddle.zeros([3])
+
+    rep = to_static(unused).inspect(InputSpec([4]), InputSpec([4]))
+    assert F.UNUSED_INPUT in rep.rules()
+    assert F.CONSTANT_OUTPUT in rep.rules()
+
+
+def test_jaxpr_sweep_zero_findings_on_model_zoo():
+    """Abstract-trace (no device execution, no compile) sweep over
+    representative shipped models: the linter must stay silent."""
+    from paddle_tpu.text.models import bert, llama
+    from paddle_tpu.vision import models as V
+    cases = [
+        (V.LeNet(), [InputSpec([None, 1, 28, 28])]),
+        (V.resnet18(), [InputSpec([None, 3, 32, 32])]),
+        (V.squeezenet1_0(), [InputSpec([None, 3, 64, 64])]),
+        (V.shufflenet_v2_x1_0(), [InputSpec([None, 3, 64, 64])]),
+        (V.mobilenet_v3_small(), [InputSpec([None, 3, 64, 64])]),
+        (bert.BertForPretraining(bert.BertConfig.tiny()),
+         [InputSpec([2, 16], "int64")]),
+        (llama.LlamaForCausalLM(llama.LlamaConfig.tiny()),
+         [InputSpec([2, 16], "int64")]),
+    ]
+    for net, spec in cases:
+        rep = to_static(net, input_spec=spec).inspect()
+        assert not rep, (type(net).__name__, rep.format())
+
+
+# -- inspect surfaces --------------------------------------------------------
+
+def test_inspect_without_sample_inputs_uses_input_spec():
+    net = paddle.vision.models.LeNet()
+    sf = to_static(net)
+    # no spec, no args: AST-only report (still a Report, empty here)
+    assert isinstance(sf.inspect(), analysis.Report)
+    sf2 = to_static(net, input_spec=[InputSpec([None, 1, 28, 28])])
+    rep = sf2.inspect()
+    assert isinstance(rep, analysis.Report) and not rep
+
+
+def test_train_step_inspect_and_model_inspect():
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = paddle.optimizer.Momentum(learning_rate=0.1,
+                                    parameters=net.parameters())
+    loss = nn.CrossEntropyLoss()
+    ts = TrainStep(net, loss, opt)
+    rep = ts.inspect([InputSpec([4, 8])], InputSpec([4], "int64"))
+    assert isinstance(rep, analysis.Report) and not rep
+
+    m = paddle.Model(net, inputs=[InputSpec([4, 8])],
+                     labels=[InputSpec([4], "int64")])
+    m.prepare(optimizer=opt, loss=loss)
+    rep2 = m.inspect()
+    assert isinstance(rep2, analysis.Report) and not rep2
+
+
+def test_lint_hook_emits_through_monitor(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_LINT", "1")
+    monitor.counter("lint.findings").reset()
+    monitor.counter(f"lint.{F.DEAD_COMPUTATION}").reset()
+
+    @to_static
+    def leaky(x):
+        dead = paddle.cumsum(x)  # noqa: F841
+        return x * 2.0
+
+    x = paddle.to_tensor(a(4))
+    with pytest.warns(UserWarning, match="dead-computation"):
+        leaky(x)
+    assert monitor.counter("lint.findings").get() >= 1
+    assert monitor.counter(f"lint.{F.DEAD_COMPUTATION}").get() >= 1
+    n = monitor.counter("lint.findings").get()
+    leaky(paddle.to_tensor(a(4)))  # cached sig: hook must not re-fire
+    assert monitor.counter("lint.findings").get() == n
+
+
+def test_lint_hook_off_by_default(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_LINT", raising=False)
+    monitor.counter("lint.findings").reset()
+
+    @to_static
+    def leaky(x):
+        dead = paddle.cumsum(x)  # noqa: F841
+        return x * 2.0
+
+    leaky(paddle.to_tensor(a(4)))
+    assert monitor.counter("lint.findings").get() == 0
+
+
+# -- InputSpec honoring (satellite) ------------------------------------------
+
+def test_input_spec_validates_calls():
+    net = nn.Linear(8, 4)
+    sf = to_static(net, input_spec=[InputSpec([None, 8], "float32")])
+    out = sf(paddle.to_tensor(a(3, 8)))  # None dim: any batch
+    assert out.shape == [3, 4]
+    with pytest.raises(ValueError, match="input_spec"):
+        sf(paddle.to_tensor(a(3, 9)))
+    with pytest.raises(ValueError, match="input_spec"):
+        sf(paddle.to_tensor(np.zeros((3, 8), np.int32)))
+    with pytest.raises(ValueError, match="input_spec"):
+        sf(paddle.to_tensor(a(8)))  # rank mismatch
+
+
+def test_inspect_does_not_consume_rng():
+    """inspect() must not advance the random stream — a lint can never
+    change the program's numbers (PADDLE_TPU_LINT=1 runs would
+    otherwise diverge from lint-off runs)."""
+    net = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+    sf = to_static(net, input_spec=[InputSpec([2, 4])])
+    paddle.seed(123)
+    want = paddle.rand([4]).numpy()
+    paddle.seed(123)
+    sf.inspect()
+    got = paddle.rand([4]).numpy()
+    np.testing.assert_array_equal(want, got)
+
+
+def test_input_spec_skips_keyword_tensors():
+    class Two(nn.Layer):
+        def __init__(self):
+            super().__init__()
+
+        def forward(self, x, y):
+            return paddle.matmul(x, y)
+
+    sf = to_static(Two(), input_spec=[InputSpec([None, 8]),
+                                      InputSpec([8, 4])])
+    # keyword-passed tensor: validated positionally it would be zipped
+    # against spec #1's slot correctly here, but the spec list cannot
+    # know call-site keyword order in general — only positional args
+    # are validated
+    out = sf(paddle.to_tensor(a(2, 8)), y=paddle.to_tensor(a(8, 4)))
+    assert out.shape == [2, 4]
+    with pytest.raises(ValueError, match="input_spec"):
+        sf(paddle.to_tensor(a(2, 9)), y=paddle.to_tensor(a(8, 4)))
+
+
+# -- compile-cache signature fixes (satellites) ------------------------------
+
+def test_array_kwargs_traced_not_baked_into_closure():
+    """A raw-array kwarg must be traced like a positional array: baked
+    into the jitted closure (old behavior) its VALUES would be replayed
+    on every same-shape call."""
+    @to_static
+    def f(x, w=None):
+        return paddle.matmul(x, w)
+
+    x = paddle.to_tensor(np.eye(2, dtype=np.float32))
+    out1 = f(x, w=np.full((2, 2), 1.0, np.float32))
+    out2 = f(x, w=np.full((2, 2), 5.0, np.float32))
+    np.testing.assert_allclose(out1.numpy(), np.full((2, 2), 1.0))
+    np.testing.assert_allclose(out2.numpy(), np.full((2, 2), 5.0))
+
+
+def test_array_kwargs_bind_by_name_not_position():
+    """A kwarg that is NOT the next positional slot must still reach
+    its named parameter (positional-tail appending would bind it to
+    `scale`)."""
+    @to_static
+    def f(x, scale=None, bias=None):
+        if scale is not None:
+            x = x * scale
+        if bias is not None:
+            x = x + bias
+        return x
+
+    x = paddle.to_tensor(np.ones(3, np.float32))
+    out = f(x, bias=np.full(3, 5.0, np.float32))
+    np.testing.assert_allclose(out.numpy(), np.full(3, 6.0))
+    # and Tensor kwargs take the same named path
+    out2 = f(x, bias=paddle.to_tensor(np.full(3, 7.0, np.float32)))
+    np.testing.assert_allclose(out2.numpy(), np.full(3, 8.0))
+
+
+def test_tensor_kwarg_gradient_flows_through_compiled_path():
+    """A trainable tensor passed by keyword must keep its gradient in
+    the compiled path (contiguous kwargs are positionalized by
+    signature, restoring diff-eligibility)."""
+    @to_static
+    def f(x, scale=None):
+        return (x * scale).sum()
+
+    x = paddle.to_tensor(np.ones(3, np.float32))
+    w = paddle.to_tensor(np.full(3, 2.0, np.float32))
+    w.stop_gradient = False
+    f(x, scale=w).backward()
+    assert w.grad is not None
+    np.testing.assert_allclose(w.grad.numpy(), np.ones(3))
+
+
+def test_input_spec_covers_keyword_calls():
+    def h(p, q):
+        return p + q
+
+    sf = to_static(h, input_spec=[InputSpec([2]), InputSpec([2])])
+    with pytest.raises(ValueError, match="input_spec"):
+        sf(paddle.to_tensor(a(2)), q=paddle.to_tensor(a(5)))
+
+
+def test_graph_break_fallback_keeps_positionalized_kwargs():
+    """After a graph break, the eager fallback must run the same
+    positionalized call the trace saw — a moved kwarg must not
+    silently revert to its default."""
+    @to_static
+    def f(x, y=None):
+        if float(x.sum()) > 0:  # forces a graph break
+            x = x * 1.0
+        return x + (y if y is not None else 0.0)
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    y = paddle.to_tensor(np.array([10.0, 20.0], np.float32))
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        out = f(x, y=y)
+    np.testing.assert_allclose(out.numpy(), [11.0, 22.0])
+
+
+def test_keyword_only_grad_tensor_kwarg_warns():
+    @to_static
+    def f(x, *, scale=None):
+        return (x * scale).sum()
+
+    x = paddle.to_tensor(np.ones(3, np.float32))
+    w = paddle.to_tensor(np.full(3, 2.0, np.float32))
+    w.stop_gradient = False
+    with pytest.warns(UserWarning, match="keyword tensors"):
+        f(x, scale=w)
+
+
+def test_input_spec_unknown_dtype_rejected():
+    sf = to_static(nn.Linear(8, 4),
+                   input_spec=[InputSpec([None, 8], "float23")])
+    with pytest.raises(ValueError, match="not a known dtype"):
+        sf(paddle.to_tensor(a(2, 8)))
+
+
+def test_sig_of_array_kwargs_use_shape_not_values():
+    arr1 = np.arange(6, dtype=np.float32).reshape(2, 3)
+    arr2 = arr1 + 100.0  # same shape/dtype, different values
+    s1 = _sig_of([], {"w": arr1})
+    s2 = _sig_of([], {"w": arr2})
+    assert s1 == s2 == (("w", (2, 3), "float32"),)
+    assert "100" not in repr(s1)
+
+
+def test_train_step_cache_keyed_by_labels():
+    net = nn.Linear(4, 3)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+
+    class FlexibleLoss(nn.Layer):
+        def forward(self, out, label):
+            if label.dtype.name == "int64":
+                return nn.functional.cross_entropy(out, label)
+            return ((out - label) ** 2).mean()
+
+    ts = TrainStep(net, FlexibleLoss(), opt)
+    x = paddle.to_tensor(a(2, 4))
+    ts(x, paddle.to_tensor(np.array([0, 2], np.int64)))
+    assert len(ts._compiled) == 1
+    # same input sig, different LABEL dtype/shape: must not reuse (or
+    # retrace under) the cached executable
+    ts(x, paddle.to_tensor(a(2, 3)))
+    assert len(ts._compiled) == 2
+
+
+# -- nodiff NaN check (satellite) --------------------------------------------
+
+def test_check_nan_inf_covers_nodiff_ops():
+    from paddle_tpu.core import dispatch
+    from paddle_tpu.core.flags import set_flags
+    set_flags({"check_nan_inf": True})
+    try:
+        bad = paddle.to_tensor(np.array([1.0, np.inf], np.float32))
+        with pytest.raises(FloatingPointError, match="cast"):
+            paddle.cast(bad, "float32")  # cast routes run_op_nodiff
+    finally:
+        set_flags({"check_nan_inf": False})
+        dispatch._nan_pending.clear()
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "paddle_lint.py"),
+         *args],
+        capture_output=True, text=True, cwd=REPO)
+
+
+def test_cli_detects_fixture_defects_nonzero_exit():
+    res = _run_cli(FIXTURE)
+    assert res.returncode == 1, res.stderr
+    for rule in (F.TENSOR_BOOL_BRANCH, F.TENSOR_HOST_SYNC,
+                 F.TENSOR_PY_CAST, F.TENSOR_INPLACE, F.HOST_RNG):
+        assert rule in res.stdout
+    assert "TracerBoolConversionError" in res.stdout
+
+
+def test_cli_clean_on_model_zoo_and_json():
+    res = _run_cli(*MODEL_DIRS)
+    assert res.returncode == 0, res.stdout + res.stderr
+    res = _run_cli("--format", "json", FIXTURE)
+    import json
+    data = json.loads(res.stdout)
+    assert len(data["findings"]) >= 5
+
+
+def test_cli_self_check_package_clean():
+    """tier-1 regression guard: the whole shipped package lints clean
+    through the CLI (same sweep CI would run)."""
+    res = _run_cli("--self-check")
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_cli_rule_filter():
+    res = _run_cli("--rules", F.HOST_RNG, FIXTURE)
+    assert res.returncode == 1
+    assert F.HOST_RNG in res.stdout
+    assert F.TENSOR_BOOL_BRANCH not in res.stdout
+
+
+def test_cli_needs_no_framework_import():
+    """The CLI must work on a checkout without jax/paddle: poison the
+    imports and lint the fixture."""
+    cli = os.path.join(REPO, "tools", "paddle_lint.py")
+    code = ("import sys, runpy; sys.modules['jax'] = None; "
+            "sys.modules['paddle_tpu'] = None; "
+            f"sys.argv = ['paddle_lint', {FIXTURE!r}]; "
+            f"runpy.run_path({cli!r}, run_name='__main__')")
+    res = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, cwd=REPO)
+    assert res.returncode == 1, res.stderr
+    assert "tensor-bool-branch" in res.stdout

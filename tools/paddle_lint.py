@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""paddle_lint — static trace-safety linter for paddle_tpu programs.
+
+Run: python tools/paddle_lint.py path/to/model.py [more paths...]
+                                 [--format text|json] [--rules r1,r2]
+                                 [--all-functions] [--self-check]
+
+Walks the given files/directories (every `forward` method and
+`to_static`-decorated function) and reports code that will break — or
+silently poison — a jax trace, each finding tagged with the exact
+error `to_static` would raise at trace time. Exits nonzero when
+anything is found, so it slots into CI next to a formatter.
+
+Dependency-free by design (same contract as tools/trace_summary.py):
+only the stdlib AST pass runs here, so the CLI works on a checkout
+with no jax/paddle installed. The deeper jaxpr rules (dead
+computation, dtype promotion, recompile risk...) need an abstract
+trace — use `StaticFunction.inspect()` / `TrainStep.inspect()` /
+`Model.inspect()` or `PADDLE_TPU_LINT=1` for those; docs/ANALYSIS.md
+has the full rule catalog.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_ANALYSIS_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "paddle_tpu", "analysis")
+
+
+def _load(name: str):
+    """Load an analysis module straight from its file — importing the
+    paddle_tpu package would pull in jax."""
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ANALYSIS_DIR, name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    # ast_lint's `from findings import ...` fallback resolves here
+    sys.path.insert(0, _ANALYSIS_DIR)
+    try:
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(_ANALYSIS_DIR)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="paddle_lint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", help=".py files or directories")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule ids to keep "
+                         "(default: all)")
+    ap.add_argument("--all-functions", action="store_true",
+                    help="lint every function, not just forward/"
+                         "to_static ones")
+    ap.add_argument("--self-check", action="store_true",
+                    help="lint the whole shipped paddle_tpu package "
+                         "(CI regression guard: must be clean)")
+    args = ap.parse_args(argv)
+
+    findings_mod = _load("findings")
+    ast_lint = _load("ast_lint")
+
+    paths = list(args.paths)
+    if args.self_check:
+        paths.append(os.path.dirname(_ANALYSIS_DIR))
+    if not paths:
+        ap.error("no paths given (or use --self-check)")
+
+    findings = []
+    for path in paths:
+        if not os.path.exists(path):
+            print(f"paddle_lint: no such path: {path}", file=sys.stderr)
+            return 2
+        findings.extend(ast_lint.lint_paths(
+            [path], all_functions=args.all_functions))
+
+    if args.rules:
+        keep = {r.strip() for r in args.rules.split(",") if r.strip()}
+        findings = [f for f in findings if f.rule in keep]
+
+    report = findings_mod.Report(findings, subject="paddle_lint")
+    if args.format == "json":
+        print(report.to_json())
+    else:
+        print(report.format())
+        if findings:
+            rules = ", ".join(report.rules())
+            print(f"\n{len(findings)} finding(s) across rules: {rules}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
