@@ -25,11 +25,15 @@ import os
 
 from .ast_lint import (lint_callable, lint_file, lint_paths,  # noqa: F401
                        lint_source)
+from .cost_model import CostEstimate, estimate_jaxpr  # noqa: F401
 from .findings import (AST_RULES, ERROR, INFO, JAXPR_RULES,  # noqa: F401
-                       WARNING, Finding, Report)
+                       PIPELINE_RULES, SHARD_RULES, WARNING, Finding,
+                       Report)
 from .jaxpr_lint import (lint_closed_jaxpr, lint_static_args,  # noqa: F401
                          lint_static_function, lint_train_step,
                          lint_traceable, to_shape_struct)
+from .shard_lint import (lint_pipeline, lint_records,  # noqa: F401
+                         lint_sharded)
 
 
 def lint_enabled() -> bool:
@@ -53,8 +57,12 @@ def lint_on_first_compile(inspect_fn, *args, **kwargs):
 
 def emit_findings(report: Report) -> Report:
     """Route a lint report through paddle_tpu.monitor (counters per
-    rule) and warn once with the formatted findings. Used by the
-    first-compile hook; cheap no-op for an empty report."""
+    rule, lint.cost.* gauges for an attached cost estimate) and warn
+    once with the formatted findings. Used by the first-compile hook;
+    cheap no-op for an empty cost-less report."""
+    if report.cost is not None:
+        from .cost_model import emit_cost
+        emit_cost(report.cost)
     if not report:
         return report
     from .. import monitor

@@ -30,11 +30,32 @@ CONSTANT_OUTPUT = "constant-output"
 UNROLLED_LOOP = "unrolled-loop"
 STATIC_ARG_RECOMPILE = "static-arg-recompile"
 
+# shard (SPMD/collective) rules — what shard_lint's device-free trace
+# under a fake mesh reveals (docs/ANALYSIS.md "shard_lint")
+BAD_AXIS_NAME = "bad-axis-name"
+UNALIGNED_GROUP = "unaligned-group"
+INDIVISIBLE_COLLECTIVE = "indivisible-collective"
+UNEVEN_SPLIT = "uneven-split"
+TENSOR_LIST_ARITY = "tensor-list-arity"
+P2P_IN_TRACE = "p2p-in-trace"
+NON_RING_PERMUTE = "non-ring-permute"
+
+# pipeline-schedule rules — static checks over PipelineLayer metadata
+STAGE_IMBALANCE = "stage-imbalance"
+BUBBLE_FRACTION = "bubble-fraction"
+SEGMENT_MISMATCH = "segment-mismatch"
+MICROBATCH_ARITY = "microbatch-arity"
+
 AST_RULES = (TENSOR_BOOL_BRANCH, TENSOR_HOST_SYNC, TENSOR_PY_CAST,
              TENSOR_INPLACE, HOST_RNG)
 JAXPR_RULES = (GRAPH_BREAK, TRACE_FAILED, DTYPE_PROMOTION,
                LARGE_CONSTANT, DEAD_COMPUTATION, UNUSED_INPUT,
                CONSTANT_OUTPUT, UNROLLED_LOOP, STATIC_ARG_RECOMPILE)
+SHARD_RULES = (BAD_AXIS_NAME, UNALIGNED_GROUP, INDIVISIBLE_COLLECTIVE,
+               UNEVEN_SPLIT, TENSOR_LIST_ARITY, P2P_IN_TRACE,
+               NON_RING_PERMUTE)
+PIPELINE_RULES = (STAGE_IMBALANCE, BUBBLE_FRACTION, SEGMENT_MISMATCH,
+                  MICROBATCH_ARITY)
 
 ERROR = "error"      # will raise at trace time (a _BREAK_ERRORS member)
 WARNING = "warning"  # traces, but recompiles / wastes memory / is wrong
@@ -76,6 +97,11 @@ class Report:
                  subject: str = ""):
         self.findings: List[Finding] = list(findings or [])
         self.subject = subject
+        # optional static cost estimate (analysis.cost_model.CostEstimate
+        # duck-typed: .format_table() / .to_dict()) — attached by
+        # shard_lint-aware inspect paths, never required. Kept as a bare
+        # attribute so this file stays stdlib-only.
+        self.cost = None
 
     def add(self, finding: Finding):
         self.findings.append(finding)
@@ -110,21 +136,31 @@ class Report:
     def errors(self) -> List[Finding]:
         return [f for f in self.findings if f.severity == ERROR]
 
-    def format(self) -> str:
+    def format(self, cost: bool = True) -> str:
         if not self.findings:
             head = self.subject or "program"
-            return f"{head}: no findings"
-        lines = []
-        if self.subject:
-            lines.append(f"== {self.subject}: {len(self.findings)} "
-                         f"finding(s) ==")
-        lines.extend(f.format() for f in self.findings)
-        return "\n".join(lines)
+            out = f"{head}: no findings"
+        else:
+            lines = []
+            if self.subject:
+                lines.append(f"== {self.subject}: {len(self.findings)} "
+                             f"finding(s) ==")
+            lines.extend(f.format() for f in self.findings)
+            out = "\n".join(lines)
+        if cost and self.cost is not None:
+            out += "\n" + self.cost.format_table()
+        return out
 
     def to_json(self) -> str:
-        return json.dumps({"subject": self.subject,
-                           "findings": [f.to_dict() for f in self.findings]},
-                          indent=2)
+        # machine contract (CI / editors): one finding per object, every
+        # Finding field present, stable rule ids, plus per-rule counts
+        payload = {"subject": self.subject,
+                   "findings": [f.to_dict() for f in self.findings],
+                   "counts": {r: len(fs)
+                              for r, fs in self.by_rule().items()}}
+        if self.cost is not None:
+            payload["cost"] = self.cost.to_dict()
+        return json.dumps(payload, indent=2)
 
     def __repr__(self):
         return (f"Report(subject={self.subject!r}, "

@@ -480,11 +480,45 @@ def lint_traceable(fn, args=(), kwargs=None, *,
     return report
 
 
-def lint_static_function(sf, args=None, kwargs=None) -> Report:
+def _with_mesh(lint_impl, mesh, *args, **kwargs) -> Report:
+    """Run a lint entry point with shard_lint's collective recorder and
+    a (fake) mesh installed: the same abstract trace then also yields
+    SPMD/collective findings and a static cost estimate."""
+    from . import cost_model
+    from .shard_lint import (as_mesh, lint_jaxpr_collectives,
+                             lint_records, recording)
+    mesh = as_mesh(mesh)
+    with recording(mesh) as rec:
+        report, closed = lint_impl(*args, **kwargs)
+    report.extend(lint_records(rec.records, mesh))
+    if closed is not None:
+        report.extend(lint_jaxpr_collectives(closed, mesh))
+        report.cost = cost_model.estimate_jaxpr(closed, mesh)
+        # this trace is a plain jit program, not a shard_map manual
+        # region: GSPMD-auto partitioning will insert collectives (and
+        # shrink per-rank shapes) at compile time — counts here cover
+        # the explicit collectives only, and FLOPs/HBM are global-shape
+        report.cost.note = (
+            "GSPMD-auto trace: explicit collectives only; the XLA "
+            "partitioner adds resharding traffic at compile time, and "
+            "FLOPs/HBM are global (undivided) shapes")
+    return report
+
+
+def lint_static_function(sf, args=None, kwargs=None, mesh=None) -> Report:
     """Lint a jit.StaticFunction exactly as __call__ would stage it.
 
     With no sample `args`, the stored InputSpec list supplies the
-    shapes — fully ahead-of-time inspection."""
+    shapes — fully ahead-of-time inspection. With `mesh` (a Mesh,
+    AbstractMesh, or {axis: degree} dict — no devices needed) the same
+    trace additionally runs the shard_lint collective rules and
+    attaches a static cost estimate."""
+    if mesh is not None:
+        return _with_mesh(_lint_static_function, mesh, sf, args, kwargs)
+    return _lint_static_function(sf, args, kwargs)[0]
+
+
+def _lint_static_function(sf, args=None, kwargs=None):
     from .ast_lint import lint_callable
 
     name = getattr(sf._fn, "__qualname__", repr(sf._fn))
@@ -496,7 +530,8 @@ def lint_static_function(sf, args=None, kwargs=None) -> Report:
     if args is None:
         spec = sf._input_spec
         if spec is None:
-            return report  # nothing to trace against: AST findings only
+            # nothing to trace against: AST findings only
+            return report, None
         args = list(spec) if isinstance(spec, (list, tuple)) else [spec]
 
     tensor_args, kw_structs, static_kwargs = list(args), {}, {}
@@ -540,11 +575,11 @@ def lint_static_function(sf, args=None, kwargs=None) -> Report:
     if sf._layer is None:
         traced = _abstract_trace(report, pure, kw_structs, *arr_structs)
         if traced is None:
-            return report
+            return report, None
         closed, _out_shape = traced
         labels = user_labels(0)
         report.extend(lint_closed_jaxpr(closed, invar_labels=labels))
-        return report
+        return report, closed
 
     from .functional_shapes import layer_state_structs, rng_key_struct
     params_s, buffers_s, frozen_s = layer_state_structs(sf._layer)
@@ -552,7 +587,7 @@ def lint_static_function(sf, args=None, kwargs=None) -> Report:
     traced = _abstract_trace(report, pure, params_s, buffers_s, frozen_s,
                              key_s, kw_structs, *arr_structs)
     if traced is None:
-        return report
+        return report, None
     closed, out_shape = traced
     n_state = sum(len(jax.tree_util.tree_leaves(t))
                   for t in (params_s, buffers_s, frozen_s)) + 1
@@ -563,14 +598,22 @@ def lint_static_function(sf, args=None, kwargs=None) -> Report:
     report.extend(lint_closed_jaxpr(
         closed, user_invar_idx=user_idx, invar_labels=labels,
         n_user_out=n_user_out))
-    return report
+    return report, closed
 
 
-def lint_train_step(ts, inputs, labels) -> Report:
+def lint_train_step(ts, inputs, labels, mesh=None) -> Report:
     """Lint a jit.TrainStep's fused step program at the given specs.
 
     Checks the same jaxpr rules plus unused *donated* inputs: a donated
-    buffer no output depends on is memory freed for nothing."""
+    buffer no output depends on is memory freed for nothing. With
+    `mesh`, shard_lint collective rules + the cost model run over the
+    same trace (device-free)."""
+    if mesh is not None:
+        return _with_mesh(_lint_train_step, mesh, ts, inputs, labels)
+    return _lint_train_step(ts, inputs, labels)[0]
+
+
+def _lint_train_step(ts, inputs, labels):
     import jax.numpy as jnp
 
     from .ast_lint import lint_callable
@@ -596,7 +639,7 @@ def lint_train_step(ts, inputs, labels) -> Report:
     traced = _abstract_trace(report, step, params_s, buffers_s, frozen_s,
                              opt_s, key_s, lr_s, in_structs, lab_structs)
     if traced is None:
-        return report
+        return report, None
     closed, out_shape = traced
 
     counts = [len(jax.tree_util.tree_leaves(t))
@@ -618,4 +661,4 @@ def lint_train_step(ts, inputs, labels) -> Report:
     report.extend(lint_closed_jaxpr(
         closed, user_invar_idx=check_idx, invar_labels=labels_map,
         donated_idx=donated))
-    return report
+    return report, closed

@@ -30,7 +30,9 @@ def _as_process_mesh(mesh) -> ProcessMesh:
     if isinstance(mesh, ProcessMesh):
         return mesh
     from jax.sharding import Mesh
-    if isinstance(mesh, Mesh):
+    abstract_cls = getattr(jax.sharding, "AbstractMesh", None)
+    if isinstance(mesh, Mesh) or (
+            abstract_cls is not None and isinstance(mesh, abstract_cls)):
         return ProcessMesh(mesh)
     raise TypeError(f"expected ProcessMesh/Mesh, got {type(mesh)}")
 
@@ -54,11 +56,28 @@ def shard_tensor(data, mesh, placements, dtype=None, place=None,
     mesh = _as_process_mesh(mesh)
     t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
     arr = t._data
-    sharding = _named_sharding(mesh, placements, arr.ndim)
-    if _in_trace(arr):
-        new = jax.lax.with_sharding_constraint(arr, sharding)
+    abstract_cls = getattr(jax.sharding, "AbstractMesh", None)
+    if abstract_cls is not None and isinstance(mesh.jax_mesh,
+                                               abstract_cls):
+        # device-free fake mesh (analysis.shard_lint): still VALIDATE
+        # the placements against the mesh/tensor (a bad spec must fail
+        # the lint trace exactly like the real path, where NamedSharding
+        # rejects a spec longer than the tensor rank), then keep the
+        # metadata and skip the data movement — there is nothing to put
+        # the array on, and layouts don't change shapes
+        spec = placements_to_spec(placements, mesh.dim_names, arr.ndim)
+        if len(spec) > arr.ndim:
+            raise ValueError(
+                f"shard_tensor: placements {list(placements)} shard "
+                f"tensor dim {len(spec) - 1} but the tensor has only "
+                f"{arr.ndim} dim(s)")
+        new = arr
+    elif _in_trace(arr):
+        new = jax.lax.with_sharding_constraint(
+            arr, _named_sharding(mesh, placements, arr.ndim))
     else:
-        new = jax.device_put(arr, sharding)
+        new = jax.device_put(arr, _named_sharding(mesh, placements,
+                                                  arr.ndim))
     out = Tensor._from_array(new, stop_gradient=t.stop_gradient
                              if stop_gradient is None else stop_gradient,
                              name=t.name)

@@ -23,6 +23,16 @@ class ProcessMesh:
             self._dim_names = list(mesh.axis_names)
             self._process_ids = list(range(mesh.devices.size))
             return
+        abstract_cls = getattr(jax.sharding, "AbstractMesh", None)
+        if abstract_cls is not None and isinstance(mesh, abstract_cls):
+            # device-free fake mesh (analysis.shard_lint): same topology
+            # introspection, logical ranks in place of device ids
+            self._jax_mesh = mesh
+            sizes = [int(v) for v in dict(mesh.shape).values()]
+            self._shape = sizes
+            self._dim_names = list(mesh.axis_names)
+            self._process_ids = list(range(int(np.prod(sizes or [1]))))
+            return
         if mesh is None and shape is not None:
             ids = np.asarray(process_ids if process_ids is not None
                              else np.arange(int(np.prod(shape))))

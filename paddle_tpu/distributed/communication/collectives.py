@@ -39,6 +39,88 @@ class ReduceOp:
     AVG = 4
 
 
+# analysis.shard_lint installs a CollectiveRecorder here during its
+# device-free abstract traces; every collective entry point reports
+# (op, group, operand shape, list arity, splits) through it so the
+# linter can validate the call against the fake mesh without executing
+# anything. None in production — the hot path pays one global read.
+_collective_recorder = None
+
+
+def _record(op: str, group, data=None, n_list=None, splits=None):
+    rec = _collective_recorder
+    if rec is None:
+        return False
+    rec.add(op=op, group=group,
+            shape=tuple(getattr(data, "shape", ()) or ()),
+            dtype=str(getattr(data, "dtype", "")),
+            n_list=n_list, splits=splits)
+    return True
+
+
+def _axis_arg(axes):
+    """Normalize a Group's axis-name tuple to the form lax collectives
+    expect: the bare name for one axis, a TUPLE for several (jax treats
+    a tuple of hashables as a sequence of axis names; a list is
+    unhashable in several lax paths and must never leak through)."""
+    axes = tuple(axes)
+    return axes[0] if len(axes) == 1 else axes
+
+
+def _check_list_arity(op: str, tensor_list, g) -> None:
+    """Shared validation for per-rank tensor lists: one entry per group
+    rank. Skipped (reported as a finding instead) while the shard_lint
+    recorder is active, like _check_divisible."""
+    n = max(1, g.nranks)
+    if tensor_list and len(tensor_list) != n \
+            and _collective_recorder is None:
+        raise ValueError(
+            f"{op}: tensor list has {len(tensor_list)} entries but the "
+            f"group has {n} ranks — one entry per rank required")
+
+
+def _lint_fallback(data, g, need_equal: bool = False) -> bool:
+    """True when the shard_lint recorder is active and this call's dim-0
+    split is invalid for the group size: the linter has already recorded
+    the defect, so the collective degrades to identity instead of
+    letting lax abort the abstract trace at the FIRST bad call — later
+    defects in the same program still get found."""
+    if _collective_recorder is None:
+        return False
+    n = max(1, g.nranks)
+    shape = getattr(data, "shape", None)
+    if n <= 1 or not shape:
+        return False
+    return shape[0] != n if need_equal else shape[0] % n != 0
+
+
+def _group_axes(g):
+    """Group.axis_names, tolerating unaligned groups while the lint
+    recorder is active: the recorder reports the unaligned group as a
+    finding and the call falls back to the eager identity path instead
+    of aborting the whole abstract trace at the first defect."""
+    try:
+        return g.axis_names
+    except ValueError:
+        if _collective_recorder is not None:
+            return ()
+        raise
+
+
+def _check_divisible(op: str, dim0: int, g) -> None:
+    """Shared arg validation for the dim-0-splitting collectives: the
+    group size must divide the leading dim, else lax fails with an
+    opaque shape error deep in the trace. Skipped while the shard_lint
+    recorder is active (the linter reports the same defect as a finding
+    with file:line instead of aborting the trace at the first one)."""
+    n = max(1, g.nranks)
+    if n > 1 and dim0 % n != 0 and _collective_recorder is None:
+        raise ValueError(
+            f"{op}: input dim 0 ({dim0}) must be divisible by the group "
+            f"size ({n}, axes {getattr(g, '_axes', None) or 'world'}) — "
+            "pad the tensor or change the mesh degree")
+
+
 def _axes_bound(axes) -> bool:
     """True when every axis name is bound in the current trace context."""
     if not axes:
@@ -69,7 +151,7 @@ def _multi_process() -> bool:
 
 
 def _reduce_traced(data, op, axes):
-    name = axes if len(axes) > 1 else axes[0]
+    name = _axis_arg(axes)
     if op == ReduceOp.SUM:
         return lax.psum(data, name)
     if op == ReduceOp.MAX:
@@ -89,7 +171,8 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """Reference: communication/all_reduce.py. Traced → lax.psum family."""
     g = _resolve(group)
     data = _data(tensor)
-    axes = g.axis_names
+    _record("all_reduce", g, data)
+    axes = _group_axes(g)
     if _axes_bound(axes):
         return _ret(tensor, _reduce_traced(data, op, axes))
     if _multi_process():
@@ -116,10 +199,11 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     """
     g = _resolve(group)
     data = _data(tensor)
-    axes = g.axis_names
+    _record("all_gather", g, data,
+            n_list=len(tensor_list) if tensor_list else 0)
+    axes = _group_axes(g)
     if _axes_bound(axes):
-        name = axes if len(axes) > 1 else axes[0]
-        out = lax.all_gather(data, name)
+        out = lax.all_gather(data, _axis_arg(axes))
         if tensor_list is not None:
             tensor_list.extend(
                 Tensor._from_array(out[i]) for i in range(out.shape[0]))
@@ -155,11 +239,12 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     to a broadcast). Eager single-controller: identity."""
     g = _resolve(group)
     data = _data(tensor)
-    axes = g.axis_names
+    _record("broadcast", g, data)
+    axes = _group_axes(g)
     if _axes_bound(axes):
-        name = axes if len(axes) > 1 else axes[0]
         # paddle's src is a GLOBAL rank: convert to the group-local index
-        out = lax.all_gather(data, name)[g.global_rank_to_group_rank(src)]
+        out = lax.all_gather(data, _axis_arg(axes))[
+            g.global_rank_to_group_rank(src)]
         return _ret(tensor, out)
     if _multi_process():
         from jax.experimental import multihost_utils
@@ -170,9 +255,12 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     g = _resolve(group)
     data = _data(tensor)
-    axes = g.axis_names
+    _record("scatter", g, data,
+            n_list=len(tensor_list) if tensor_list else None)
+    axes = _group_axes(g)
+    _check_list_arity("scatter", tensor_list, g)
     if _axes_bound(axes):
-        name = axes if len(axes) > 1 else axes[0]
+        name = _axis_arg(axes)
         idx = lax.axis_index(name)
         stacked = jnp.stack([_data(t) for t in tensor_list], 0) \
             if tensor_list else data
@@ -188,14 +276,21 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
                    group=None, sync_op=True):
     """Reference: communication/reduce_scatter.py. Traced → lax.psum_scatter."""
     g = _resolve(group)
-    axes = g.axis_names
+    axes = _group_axes(g)
     inp = tensor_or_tensor_list
     if isinstance(inp, (list, tuple)):
+        _check_list_arity("reduce_scatter", inp, g)
         data = jnp.concatenate([_data(t) for t in inp], axis=0)
     else:
         data = _data(inp)
+    _record("reduce_scatter", g, data,
+            n_list=len(inp) if isinstance(inp, (list, tuple)) else None)
+    if data.shape:
+        _check_divisible("reduce_scatter", data.shape[0], g)
     if _axes_bound(axes):
-        name = axes if len(axes) > 1 else axes[0]
+        if _lint_fallback(data, g):
+            return _ret(tensor, data)
+        name = _axis_arg(axes)
         if op == ReduceOp.AVG:
             out = lax.psum_scatter(data, name, tiled=True) / g.nranks
         elif op == ReduceOp.SUM:
@@ -212,24 +307,50 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     """Reference: communication/all_to_all.py. Traced: lax.all_to_all on a
     stacked leading axis."""
     g = _resolve(group)
-    axes = g.axis_names
+    axes = _group_axes(g)
     if isinstance(in_tensor_list, (list, tuple)):
+        _check_list_arity("all_to_all", in_tensor_list, g)
         data = jnp.stack([_data(t) for t in in_tensor_list], 0)
     else:
         data = _data(in_tensor_list)
+        n = max(1, g.nranks)
+        # the traced lowering is UNTILED lax.all_to_all: dim 0 must
+        # EQUAL the group size (divisible-but-larger still fails deep
+        # in lax) — alltoall_single is the tiled even-split form
+        if n > 1 and data.shape and data.shape[0] != n \
+                and _collective_recorder is None:
+            raise ValueError(
+                f"all_to_all: single-tensor input dim 0 "
+                f"({data.shape[0]}) must equal the group size ({n}) — "
+                "pass one slice per rank, or use alltoall_single for "
+                "the tiled even-split form")
+    _record("all_to_all", g, data,
+            n_list=len(in_tensor_list)
+            if isinstance(in_tensor_list, (list, tuple)) else None)
     if _axes_bound(axes):
-        name = axes if len(axes) > 1 else axes[0]
-        out = lax.all_to_all(data, name, split_axis=0, concat_axis=0,
-                             tiled=False)
+        if _lint_fallback(data, g, need_equal=True):
+            if out_tensor_list is not None and \
+                    isinstance(in_tensor_list, (list, tuple)):
+                out_tensor_list.extend(in_tensor_list)
+            return data
+        out = lax.all_to_all(data, _axis_arg(axes), split_axis=0,
+                             concat_axis=0, tiled=False)
         if out_tensor_list is not None:
             out_tensor_list.extend(
                 Tensor._from_array(out[i]) for i in range(out.shape[0]))
         return out
     if _multi_process():
         raise NotImplementedError("multi-host eager all_to_all")
-    if out_tensor_list is not None and \
-            isinstance(in_tensor_list, (list, tuple)):
-        out_tensor_list.extend(in_tensor_list)
+    if out_tensor_list is not None:
+        if isinstance(in_tensor_list, (list, tuple)):
+            out_tensor_list.extend(in_tensor_list)
+        elif data.shape and data.shape[0] == max(1, g.nranks):
+            # single-tensor input: one dim-0 slice per rank, the same
+            # entry shapes the traced (untiled) path produces
+            # (previously left empty — silent API asymmetry)
+            out_tensor_list.extend(
+                Tensor._from_array(data[i])
+                for i in range(data.shape[0]))
     return data
 
 
@@ -242,16 +363,27 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
     """Even-split all-to-all on dim 0 (reference alltoall_single)."""
     g = _resolve(group)
     data = _data(in_tensor)
-    axes = g.axis_names
-    for sizes in (in_split_sizes, out_split_sizes):
-        if sizes and len(set(sizes)) > 1:
-            raise NotImplementedError(
-                "alltoall_single supports even splits only on TPU "
-                f"(got split sizes {list(sizes)}); lax.all_to_all is tiled")
+    axes = _group_axes(g)
+    _record("alltoall_single", g, data,
+            splits=(tuple(in_split_sizes) if in_split_sizes else None,
+                    tuple(out_split_sizes) if out_split_sizes else None))
+    if _collective_recorder is None:
+        for sizes in (in_split_sizes, out_split_sizes):
+            if sizes and len(set(sizes)) > 1:
+                raise NotImplementedError(
+                    "alltoall_single supports even splits only on TPU "
+                    f"(got split sizes {list(sizes)}); lax.all_to_all is "
+                    "tiled")
+        if data.shape:
+            _check_divisible("alltoall_single", data.shape[0], g)
     if _axes_bound(axes):
-        name = axes if len(axes) > 1 else axes[0]
-        out = lax.all_to_all(data, name, split_axis=0, concat_axis=0,
-                             tiled=True)
+        uneven = any(s and len(set(s)) > 1
+                     for s in (in_split_sizes, out_split_sizes))
+        if _lint_fallback(data, g) or \
+                (_collective_recorder is not None and uneven):
+            return _ret(out_tensor, data)
+        out = lax.all_to_all(data, _axis_arg(axes), split_axis=0,
+                             concat_axis=0, tiled=True)
         return _ret(out_tensor, out)
     return _ret(out_tensor, data)
 
@@ -261,7 +393,8 @@ def send(tensor, dst=0, group=None, sync_op=True):
     ppermute by the pipeline runtime (p2p_communication); eager p2p has no
     meaning on a single controller."""
     g = _resolve(group)
-    if _axes_bound(g.axis_names):
+    _record("send", g, _data(tensor))
+    if _collective_recorder is None and _axes_bound(_group_axes(g)):
         raise RuntimeError(
             "send/recv inside traced code must go through "
             "paddle_tpu.distributed.fleet.meta_parallel p2p (ppermute)")
@@ -269,6 +402,8 @@ def send(tensor, dst=0, group=None, sync_op=True):
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
+    g = _resolve(group)
+    _record("recv", g, _data(tensor))
     return None
 
 
@@ -282,7 +417,11 @@ def p2p_shift(data, axis_name: str, shift: int = 1):
     This is the TPU p2p primitive the pipeline/ring-attention runtimes use
     instead of NCCL send/recv pairs (reference:
     fleet/meta_parallel/pp_utils/p2p_communication.py:573)."""
-    n = lax.axis_size(axis_name)
+    size = getattr(lax, "axis_size", None)
+    # psum of a literal 1 folds to the axis size at trace time — the
+    # portable spelling on jax builds without lax.axis_size
+    n = int(size(axis_name)) if callable(size) else int(
+        lax.psum(1, axis_name))
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(data, axis_name, perm)
 

@@ -93,6 +93,143 @@ def _assert_aligned(tag, dist_losses, single_losses,
           f"{[round(v, 4) for v in single_losses]}")
 
 
+# ---------------------------------------------------------------------------
+# shard-lint model zoo (device-free)
+# ---------------------------------------------------------------------------
+# The dryrun phases above need n real (virtual) devices; these builders
+# expose the same program SHAPES to `analysis.shard_lint` with zero
+# devices — consumed by `tools/paddle_lint.py --shard-check` and the
+# tier-1 regression test, which expect every case to lint clean.
+
+def _zoo_collectives(x):
+    """Representative well-formed collective program: every op family
+    shard_lint validates, at divisible shapes on the zoo mesh."""
+    import jax.numpy as jnp
+
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.communication.collectives import p2p_shift
+    from paddle_tpu.distributed.communication.group import Group
+
+    mp, dp = Group(axis_name="mp"), Group(axis_name="dp")
+    y = dist.all_reduce(x, group=mp)
+    gathered = dist.all_gather(None, y, group=dp)
+    scattered = dist.reduce_scatter(None, y, group=mp)
+    single = dist.alltoall_single(None, y, group=mp)
+    ring = p2p_shift(y, "dp", 1)
+    return (jnp.sum(gathered) + jnp.sum(scattered) + jnp.sum(single)
+            + jnp.sum(ring))
+
+
+class _ZooBlock:
+    """Placeholder so type names in lint output read well."""
+
+
+def shard_lint_zoo(n_devices: int = 8):
+    """Build the shard-lint cases: a list of (name, kind, payload) where
+    kind is "sharded" (payload: fn, arg shapes, mesh degrees — run
+    through `analysis.lint_sharded`) or "pipeline" (payload:
+    PipelineLayer, lint_pipeline kwargs). Everything is constructed
+    device-free under a fake mesh; nothing executes."""
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        ColumnParallelLinear, LayerDesc, PipelineLayer, RowParallelLinear)
+    from paddle_tpu.jit.api import InputSpec
+
+    pp = 4 if n_devices % 4 == 0 else 2
+    dp, mp = 2, n_devices // 2
+    hidden = 16
+
+    cases = []
+    cases.append(("collectives", "sharded", {
+        "fn": _zoo_collectives,
+        "args": [jax.ShapeDtypeStruct((mp * 2, 4), np.float32)],
+        "mesh": {"dp": dp, "mp": mp},
+    }))
+
+    prev = mesh_mod.get_mesh()
+    mesh_mod._global_mesh = mesh_mod.fake_mesh({"dp": dp, "mp": mp})
+    try:
+        class TPBlock(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.up = ColumnParallelLinear(hidden, 4 * hidden,
+                                               gather_output=False)
+                self.down = RowParallelLinear(4 * hidden, hidden,
+                                              input_is_parallel=True)
+
+            def forward(self, x):
+                return x + self.down(
+                    paddle.nn.functional.gelu(self.up(x)))
+
+        tp_net = TPBlock()
+    finally:
+        mesh_mod._global_mesh = prev
+    cases.append(("tp-mlp", "inspect", {
+        "net": tp_net,
+        "input_spec": [InputSpec([4, hidden])],
+        "mesh": {"dp": dp, "mp": mp},
+    }))
+
+    class Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(hidden, hidden)
+
+        def forward(self, x):
+            return x + paddle.tanh(self.fc(x))
+
+    def pipe(n_layers, **kw):
+        return PipelineLayer(
+            layers=[LayerDesc(Block) for _ in range(n_layers)],
+            num_stages=pp, loss_fn=nn.MSELoss(), **kw)
+
+    spec = InputSpec([4, hidden])
+    cases.append(("pipeline-gpipe", "pipeline", {
+        "pipe": pipe(2 * pp),
+        "kwargs": {"n_micro": 2 * pp, "input_spec": spec},
+    }))
+    cases.append(("pipeline-vpp", "pipeline", {
+        "pipe": pipe(2 * pp * 2, num_virtual_pipeline_stages=2),
+        "kwargs": {"n_micro": 2 * pp, "vpp_degree": 2,
+                   "schedule_mode": "VPP", "input_spec": spec},
+    }))
+    cases.append(("pipeline-zb", "pipeline", {
+        "pipe": pipe(2 * pp),
+        "kwargs": {"n_micro": 2 * pp, "schedule_mode": "ZBH1",
+                   "input_spec": spec},
+    }))
+    return cases
+
+
+def shard_lint_zoo_reports(n_devices: int = 8):
+    """Run shard_lint over the zoo; returns [(name, Report)]. The
+    regression contract (tier-1 + `paddle_lint --shard-check`): every
+    report is empty."""
+    from paddle_tpu import analysis
+    from paddle_tpu.jit.api import to_static
+
+    out = []
+    for name, kind, payload in shard_lint_zoo(n_devices):
+        if kind == "sharded":
+            rep = analysis.lint_sharded(
+                payload["fn"], payload["args"], mesh=payload["mesh"],
+                subject=name)
+        elif kind == "inspect":
+            rep = to_static(payload["net"],
+                            input_spec=payload["input_spec"]).inspect(
+                mesh=payload["mesh"])
+            rep.subject = name
+        else:
+            rep = analysis.lint_pipeline(
+                payload["pipe"], subject=name, **payload["kwargs"])
+        out.append((name, rep))
+    return out
+
+
 def run_dryrun(n_devices: int) -> None:
     jax = _ensure_devices(n_devices)
 

@@ -29,23 +29,45 @@ def _norm_entry(e, mesh):
     return e if e in mesh.axis_names else UNSET
 
 
+def _ambient_mesh_nonempty() -> bool:
+    get = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get is None:  # older jax: no mesh-context tracking
+        return False
+    return not get().empty
+
+
+def _is_abstract(mesh) -> bool:
+    """True for a device-free AbstractMesh (AbstractMesh.devices raises,
+    so a getattr probe won't do)."""
+    abstract_cls = getattr(jax.sharding, "AbstractMesh", None)
+    return abstract_cls is not None and isinstance(mesh, abstract_cls)
+
+
 def _constrain(arr, *entries):
     """Apply a PartitionSpec constraint (traced) or device_put (eager)."""
     mesh = mesh_mod.get_mesh()
     if mesh is None:
         return arr
     entries = [_norm_entry(e, mesh) for e in list(entries)[:arr.ndim]]
+    # a device-free AbstractMesh (analysis.shard_lint's fake mesh) has
+    # no devices to constrain onto; layouts don't change shapes, so the
+    # abstract trace sees the same program without the constraint
+    abstract = _is_abstract(mesh)
     if isinstance(arr, jax.core.Tracer):
         # a bare PartitionSpec resolves against the AMBIENT mesh, whose
         # axis types reflect shard_map manual regions (a concrete
         # NamedSharding would mark e.g. 'pp' Auto and fail inside the
         # compiled pipeline body); with no ambient mesh (plain jit
         # without jax.set_mesh) use the concrete NamedSharding
-        if not jax.sharding.get_abstract_mesh().empty:
+        if _ambient_mesh_nonempty():
             return jax.lax.with_sharding_constraint(
                 arr, PartitionSpec(*entries))
+        if abstract:
+            return arr
         sharding = NamedSharding(mesh, PartitionSpec(*entries))
         return jax.lax.with_sharding_constraint(arr, sharding)
+    if abstract:
+        return arr
     # device_put can't take UNCONSTRAINED: replicate those dims eagerly
     entries = [None if e is UNSET else e for e in entries]
     return jax.device_put(arr, NamedSharding(mesh, PartitionSpec(*entries)))

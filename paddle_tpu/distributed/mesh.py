@@ -108,18 +108,48 @@ def ensure_mesh() -> Mesh:
     return _global_mesh
 
 
+def fake_mesh(degrees: Dict[str, int],
+              axis_order: Sequence[str] = HYBRID_AXES):
+    """Device-free mesh for ahead-of-time analysis: an
+    `jax.sharding.AbstractMesh` with the hybrid axis order, buildable on
+    a machine with ONE device (or none). `analysis.shard_lint` traces
+    under it; it can also be `set_mesh()`-installed so Group/axis_degree
+    introspection resolves without hardware. Unlike build_mesh, missing
+    axes are NOT padded to degree 1 — the analyzer should see exactly
+    the axes the plan names."""
+    from jax.sharding import AbstractMesh
+    named = [(ax, int(degrees[ax])) for ax in axis_order if ax in degrees]
+    named += [(ax, int(d)) for ax, d in degrees.items()
+              if ax not in axis_order]
+    return AbstractMesh(tuple(named))
+
+
+def mesh_axis_sizes(mesh=None) -> Dict[str, int]:
+    """{axis: degree} for a concrete Mesh OR AbstractMesh (introspection
+    helper shared by shard_lint and the cost model)."""
+    mesh = mesh if mesh is not None else get_mesh()
+    if mesh is None:
+        return {}
+    shape = getattr(mesh, "shape", None)
+    if shape is not None and hasattr(shape, "items"):
+        return {str(k): int(v) for k, v in shape.items()}
+    return {ax: int(d) for ax, d in zip(mesh.axis_names,
+                                        mesh.devices.shape)}
+
+
 def axis_degree(name: str) -> int:
     mesh = get_mesh()
     if mesh is None or name not in mesh.axis_names:
         return 1
-    return int(mesh.devices.shape[mesh.axis_names.index(name)])
+    return mesh_axis_sizes(mesh).get(name, 1)
 
 
 def data_axes(mesh: Optional[Mesh] = None) -> List[str]:
     """Axes the global batch is sharded over (dp + sharding)."""
     mesh = mesh or ensure_mesh()
-    return [ax for ax in ("dp", "sharding") if ax in mesh.axis_names
-            and mesh.devices.shape[mesh.axis_names.index(ax)] > 1] or ["dp"]
+    sizes = mesh_axis_sizes(mesh)
+    return [ax for ax in ("dp", "sharding")
+            if sizes.get(ax, 1) > 1] or ["dp"]
 
 
 class CommunicateTopology:

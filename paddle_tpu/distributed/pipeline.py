@@ -192,6 +192,26 @@ def schedule_info(n_stages: int, n_micro: int, vpp_degree: int = 1):
     }
 
 
+def schedule_stats(schedule_mode: str, n_stages: int, n_micro: int,
+                   vpp_degree: int = 1):
+    """Tick/bubble accounting for ANY supported schedule mode — the one
+    dispatch point `analysis.shard_lint` (and tooling) uses so its
+    numbers can never drift from the compiled schedules' own
+    schedule_info/zb_schedule_info formulas."""
+    mode = (schedule_mode or "FThenB").upper()
+    S, M, V = n_stages, n_micro, max(1, vpp_degree)
+    if mode in ("", "FTHENB", "1F1B"):
+        return schedule_info(S, M, 1)
+    if mode == "VPP":
+        return schedule_info(S, M, V)
+    from .zero_bubble import zb_schedule_info, zbvpp_schedule_info
+    if mode == "ZBH1":
+        return zb_schedule_info(S, M)
+    if mode == "ZBVPP":
+        return zbvpp_schedule_info(S, M, V)
+    raise ValueError(f"unknown schedule_mode {schedule_mode!r}")
+
+
 def pipeline_apply(block_fn: Callable, stacked_params: Any, xs: jnp.ndarray,
                    key, mesh: Optional[Mesh] = None, axis: str = "pp",
                    n_micro: Optional[int] = None, remat: bool = True):

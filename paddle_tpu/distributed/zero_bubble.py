@@ -206,6 +206,11 @@ def zb_schedule_info(n_stages: int, n_micro: int):
     wall = (M + S - 1) + 2 * (M + S - 1) + M
     useful = 4 * M
     return {"wall_units": wall, "useful_units": useful,
+            # forward-phase schedule ticks (one ppermute hop each) —
+            # the cross-schedule comparable count shard_lint's cost
+            # model uses; wall_units above are weighted COST units
+            # (B ticks count 2), not hops
+            "ticks": M + S - 1,
             "bubble_fraction": (wall - useful) / wall}
 
 
@@ -363,6 +368,8 @@ def zbvpp_schedule_info(n_stages: int, n_micro: int, vpp_degree: int):
             + (t_total - (V * M + S - 1))) / V  # W-only tail @ 1/V
     useful = 4 * M
     return {"wall_units": wall, "useful_units": useful,
+            # forward-phase schedule ticks (see zb_schedule_info)
+            "ticks": V * M + S - 1,
             "bubble_fraction": (wall - useful) / wall}
 
 

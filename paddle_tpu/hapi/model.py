@@ -60,7 +60,7 @@ class Model:
             except Exception:
                 self._train_step = None
 
-    def inspect(self, inputs=None, labels=None):
+    def inspect(self, inputs=None, labels=None, mesh=None):
         """Static lint of the model's compiled program (paddle_tpu.
         analysis): AST trace-safety pass over forward plus jaxpr rule
         passes over an abstract trace — nothing runs on device.
@@ -68,17 +68,20 @@ class Model:
         Shapes come from `inputs`/`labels` (InputSpecs, Tensors, or
         arrays), defaulting to the specs given at construction. After
         prepare(), the *fused train step* (forward + loss + grad +
-        update) is linted; before, just the forward. Returns an
-        analysis.Report."""
+        update) is linted; before, just the forward. `mesh` (a Mesh,
+        AbstractMesh, or {axis: degree} dict — still device-free)
+        additionally runs the shard_lint SPMD/collective rules and
+        attaches a static cost estimate. Returns an analysis.Report."""
         inputs = inputs if inputs is not None else self._inputs
         labels = labels if labels is not None else self._labels
         if isinstance(labels, (list, tuple)) and len(labels) == 1:
             labels = labels[0]  # fit() feeds the loss one label tensor
         if (self._train_step is not None and inputs is not None
                 and labels is not None):
-            return self._train_step.inspect(inputs, labels)
+            return self._train_step.inspect(inputs, labels, mesh=mesh)
         from ..jit.api import StaticFunction
-        return StaticFunction(self.network, input_spec=inputs).inspect()
+        return StaticFunction(self.network,
+                              input_spec=inputs).inspect(mesh=mesh)
 
     # -- single-batch APIs ---------------------------------------------------
     def train_batch(self, inputs, labels=None, update=True):

@@ -154,14 +154,18 @@ class StaticFunction:
                 raise ValueError(
                     f"{name}: input #{i} violates input_spec: {why}")
 
-    def inspect(self, *args, **kwargs):
+    def inspect(self, *args, mesh=None, **kwargs):
         """Statically lint this function at the given example inputs —
         AST trace-safety pass plus jaxpr rule passes over an abstract
         trace (jax.make_jaxpr on ShapeDtypeStructs; nothing runs on
         device). With no arguments, shapes come from the stored
-        InputSpec list. Returns an analysis.Report."""
+        InputSpec list. `mesh` (a Mesh, AbstractMesh, or {axis: degree}
+        dict — still device-free) additionally runs the shard_lint
+        SPMD/collective rules and attaches a static cost estimate.
+        Returns an analysis.Report."""
         from ..analysis import lint_static_function
-        return lint_static_function(self, args if args else None, kwargs)
+        return lint_static_function(self, args if args else None, kwargs,
+                                    mesh=mesh)
 
     def _maybe_lint_first_compile(self, args, kwargs):
         """Opt-in (PADDLE_TPU_LINT=1) hook run when a signature first
@@ -519,13 +523,14 @@ class TrainStep:
             else ("S", repr(a))
             for a in jax.tree_util.tree_leaves(tree))
 
-    def inspect(self, inputs, labels):
+    def inspect(self, inputs, labels, mesh=None):
         """Statically lint the fused train step at the given example
         inputs/labels (Tensors, arrays, or InputSpecs — only shapes and
-        dtypes are read; nothing executes on device). Returns an
-        analysis.Report."""
+        dtypes are read; nothing executes on device). `mesh` adds the
+        shard_lint collective rules + cost model over the same trace.
+        Returns an analysis.Report."""
         from ..analysis import lint_train_step
-        return lint_train_step(self, inputs, labels)
+        return lint_train_step(self, inputs, labels, mesh=mesh)
 
     def __call__(self, inputs, labels):
         if not isinstance(inputs, (list, tuple)):

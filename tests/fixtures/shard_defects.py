@@ -1,0 +1,110 @@
+"""Seeded SPMD/collective/pipeline defects for test_shard_lint.py.
+
+Each function/class is ONE defect the shard linter must catch with this
+file's file:line — mirroring tests/fixtures/lint_defects.py for the
+single-device rules. Nothing here ever executes on a device; the tests
+only abstract-trace these under a fake mesh.
+"""
+from jax import lax
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.communication import collectives as C
+from paddle_tpu.distributed.communication.group import Group
+
+
+def bad_axis_name(x):
+    # 'mpp' is a typo for 'mp': at runtime the axis never binds and the
+    # all_reduce silently becomes the identity
+    return dist.all_reduce(x, group=Group(axis_name="mpp"))
+
+
+def unaligned_group(x):
+    g = Group(axis_name=None, ranks=[0, 3, 5], unaligned=True)
+    return dist.all_reduce(x, group=g)
+
+
+def indivisible_all_to_all(x):
+    # x dim 0 (6) does not divide the mp axis (4)
+    out = []
+    C.all_to_all(out, x, group=Group(axis_name="mp"))
+    return x
+
+
+def indivisible_reduce_scatter(x):
+    # x dim 0 (6) does not divide the mp axis (4)
+    return C.reduce_scatter(None, x, group=Group(axis_name="mp"))
+
+
+def uneven_split(x):
+    return C.alltoall_single(None, x, in_split_sizes=[1, 2, 2, 3],
+                             group=Group(axis_name="mp"))
+
+
+def wrong_tensor_list_arity(x):
+    out = []
+    C.all_to_all(out, [x, x, x], group=Group(axis_name="mp"))  # mp is 4
+    return x
+
+
+def p2p_in_trace(x):
+    C.send(x, dst=1)
+    return C.recv(x, src=0) or x
+
+
+def non_ring_ppermute(x):
+    # covers only 2 of the 4 'mp' ranks: the others receive zeros
+    return lax.ppermute(x, "mp", [(0, 1), (1, 2)])
+
+
+class _Block(nn.Layer):
+    def __init__(self, din=16, dout=16):
+        super().__init__()
+        self.fc = nn.Linear(din, dout)
+
+    def forward(self, x):
+        return paddle.tanh(self.fc(x))
+
+
+class _HeavyBlock(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 64)
+        self.fc2 = nn.Linear(64, 64)
+        self.fc3 = nn.Linear(64, 16)
+
+    def forward(self, x):
+        return paddle.tanh(self.fc3(self.fc2(self.fc1(x))))
+
+
+def imbalanced_pipeline():
+    """Stage 3 carries ~6x the parameters/FLOPs of the others."""
+    from paddle_tpu.distributed.fleet.meta_parallel import PipelineLayer
+    return PipelineLayer(
+        layers=[_Block(), _Block(), _Block(), _HeavyBlock()],
+        num_stages=4, loss_fn=nn.MSELoss())
+
+
+def bubbly_pipeline():
+    """Uniform stages, but linted at M == S: 43% bubble."""
+    from paddle_tpu.distributed.fleet.meta_parallel import PipelineLayer
+    return PipelineLayer(layers=[_Block() for _ in range(8)],
+                         num_stages=4, loss_fn=nn.MSELoss())
+
+
+def shape_mismatched_pipeline():
+    """Stage 1 widens the activation: the homogeneous ppermute ring
+    cannot carry it."""
+    from paddle_tpu.distributed.fleet.meta_parallel import PipelineLayer
+    return PipelineLayer(
+        layers=[_Block(), _Block(16, 24), _Block(24, 24), _Block(24, 24)],
+        num_stages=4, loss_fn=nn.MSELoss())
+
+
+def het_zb_pipeline():
+    """Explicit non-uniform segments + ZBH1: raises at construction."""
+    from paddle_tpu.distributed.fleet.meta_parallel import PipelineLayer
+    return PipelineLayer(layers=[_Block() for _ in range(5)],
+                         num_stages=4, loss_fn=nn.MSELoss(),
+                         seg_method=[1, 1, 1, 2])

@@ -1,0 +1,358 @@
+"""analysis.shard_lint: ahead-of-time SPMD/collective analyzer + static
+cost model (ISSUE 3 tentpole), plus the collective-validation satellites.
+
+Everything here is device-free: abstract traces under a fake
+(AbstractMesh) 8-device mesh, no shard_map execution, no collectives
+actually run."""
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import analysis, monitor
+from paddle_tpu.analysis import findings as F
+from paddle_tpu.analysis import shard_lint
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.jit.api import InputSpec, TrainStep, to_static
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXDIR = os.path.join(REPO, "tests", "fixtures")
+sys.path.insert(0, FIXDIR)
+import shard_defects as D  # noqa: E402
+
+MESH = {"dp": 2, "mp": 4}
+
+
+def s(*shape, dtype=np.float32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def one(rep, rule):
+    found = rep.by_rule().get(rule)
+    assert found, f"expected {rule}, got {rep.format()}"
+    return found[0]
+
+
+# -- the 8 seeded defect classes ---------------------------------------------
+
+def test_bad_axis_name():
+    rep = shard_lint.lint_sharded(D.bad_axis_name, [s(8, 4)], mesh=MESH)
+    f = one(rep, F.BAD_AXIS_NAME)
+    assert f.severity == F.ERROR and "mpp" in f.message
+    assert "SILENTLY" in f.message  # names the silent-identity hazard
+    assert f.file.endswith("shard_defects.py") and f.line > 0
+
+
+def test_unaligned_group():
+    rep = shard_lint.lint_sharded(D.unaligned_group, [s(4,)], mesh=MESH)
+    f = one(rep, F.UNALIGNED_GROUP)
+    assert "[0, 3, 5]" in f.message
+    assert f.file.endswith("shard_defects.py")
+
+
+def test_indivisible_all_to_all():
+    rep = shard_lint.lint_sharded(D.indivisible_all_to_all, [s(6, 3)],
+                                  mesh=MESH)
+    f = one(rep, F.INDIVISIBLE_COLLECTIVE)
+    assert "dim 0 (6)" in f.message and "(4)" in f.message
+    assert f.file.endswith("shard_defects.py")
+    # the defective call degrades to identity under lint: no secondary
+    # trace-failed noise
+    assert F.TRACE_FAILED not in rep.rules()
+
+
+def test_all_to_all_divisible_but_unequal_still_flagged():
+    """Untiled single-tensor all_to_all needs dim 0 == group size; a
+    divisible-but-larger dim 0 (8 on mp=4) still fails at lax and must
+    be a finding, not masked by the lint fallback."""
+    def f(x):
+        from paddle_tpu.distributed.communication import collectives as C
+        from paddle_tpu.distributed.communication.group import Group
+        C.all_to_all([], x, group=Group(axis_name="mp"))
+        return x
+
+    rep = shard_lint.lint_sharded(f, [s(8, 2)], mesh=MESH)
+    fd = one(rep, F.INDIVISIBLE_COLLECTIVE)
+    assert "must equal" in fd.message and "alltoall_single" in fd.suggestion
+
+
+def test_all_to_all_single_tensor_equality_validated_eagerly():
+    import paddle_tpu.distributed as dist
+    prev = mesh_mod.get_mesh()
+    mesh_mod.set_mesh(mesh_mod.build_mesh({"mp": 4, "dp": 2}))
+    try:
+        g = dist.Group(axis_name="mp")
+        with pytest.raises(ValueError, match="must equal"):
+            dist.all_to_all([], paddle.to_tensor(
+                np.ones((8, 2), np.float32)), group=g)
+    finally:
+        mesh_mod.set_mesh(prev)
+
+
+def test_indivisible_reduce_scatter():
+    rep = shard_lint.lint_sharded(D.indivisible_reduce_scatter, [s(6, 3)],
+                                  mesh=MESH)
+    assert one(rep, F.INDIVISIBLE_COLLECTIVE).severity == F.ERROR
+
+
+def test_uneven_split():
+    rep = shard_lint.lint_sharded(D.uneven_split, [s(8, 3)], mesh=MESH)
+    f = one(rep, F.UNEVEN_SPLIT)
+    assert "[1, 2, 2, 3]" in f.message
+    assert "NotImplementedError" in f.message
+
+
+def test_wrong_tensor_list_arity():
+    rep = shard_lint.lint_sharded(D.wrong_tensor_list_arity, [s(4,)],
+                                  mesh=MESH)
+    f = one(rep, F.TENSOR_LIST_ARITY)
+    assert "3 entries" in f.message and "4 rank" in f.message
+
+
+def test_p2p_in_trace():
+    rep = shard_lint.lint_sharded(D.p2p_in_trace, [s(4,)], mesh=MESH)
+    found = rep.by_rule()[F.P2P_IN_TRACE]
+    assert {f.message.split("(")[0] for f in found} == {"send", "recv"}
+    assert all(f.severity == F.ERROR for f in found)
+
+
+def test_non_ring_ppermute():
+    rep = shard_lint.lint_sharded(D.non_ring_ppermute, [s(4,)], mesh=MESH)
+    f = one(rep, F.NON_RING_PERMUTE)
+    assert "rank(s) [0, 3]" in f.message  # the uncovered ranks
+    assert "ring_perm" in f.suggestion
+    assert f.file.endswith("shard_defects.py") and f.line > 0
+
+
+def test_stage_imbalance():
+    rep = analysis.lint_pipeline(D.imbalanced_pipeline(), n_micro=8,
+                                 input_spec=InputSpec([4, 16]))
+    found = rep.by_rule()[F.STAGE_IMBALANCE]
+    # both the parameter-count and the FLOP variants fire
+    assert any("parameter counts" in f.message for f in found)
+    assert any("FLOPs" in f.message for f in found)
+    assert all(f.file.endswith("shard_defects.py") and f.line > 0
+               for f in found)
+
+
+def test_bubble_fraction_warning():
+    rep = analysis.lint_pipeline(D.bubbly_pipeline(), n_micro=4)
+    f = one(rep, F.BUBBLE_FRACTION)
+    assert "43%" in f.message
+    assert "accumulate_steps" in f.suggestion
+    # the same pipeline at M=8 is under the threshold
+    assert F.BUBBLE_FRACTION not in analysis.lint_pipeline(
+        D.bubbly_pipeline(), n_micro=8).rules()
+
+
+def test_segment_shape_mismatch():
+    rep = analysis.lint_pipeline(D.shape_mismatched_pipeline(), n_micro=8,
+                                 input_spec=InputSpec([4, 16]))
+    f = one(rep, F.SEGMENT_MISMATCH)
+    assert "(4, 16) -> (4, 24)" in f.message and f.severity == F.ERROR
+
+
+def test_het_zb_segment_mismatch():
+    rep = analysis.lint_pipeline(D.het_zb_pipeline(), n_micro=8,
+                                 schedule_mode="ZBH1")
+    f = one(rep, F.SEGMENT_MISMATCH)
+    assert "ZBH1" in f.message
+    # the same non-uniform pipeline under FThenB (the het path) is legal
+    rep2 = analysis.lint_pipeline(D.het_zb_pipeline(), n_micro=8,
+                                  schedule_mode="FThenB")
+    assert F.SEGMENT_MISMATCH not in rep2.rules()
+
+
+def test_microbatch_arity():
+    pipe = D.bubbly_pipeline()
+    rep = analysis.lint_pipeline(pipe, n_micro=2, vpp_degree=2,
+                                 schedule_mode="VPP")
+    f = one(rep, F.MICROBATCH_ARITY)
+    assert "M=2 < S=4" in f.message and f.severity == F.ERROR
+
+
+# -- cost model --------------------------------------------------------------
+
+def test_cost_model_collective_bytes_formulas():
+    def comm(x):
+        y = paddle.distributed.all_reduce(
+            x, group=paddle.distributed.Group(axis_name="mp"))
+        from paddle_tpu.distributed.communication.collectives import \
+            p2p_shift
+        return p2p_shift(y, "dp", 1)
+
+    rep = shard_lint.lint_sharded(comm, [s(8, 4)], mesh=MESH)
+    assert not rep, rep.format()
+    cost = rep.cost
+    b = 8 * 4 * 4  # operand bytes
+    # ring all-reduce over mp=4 moves 2*(n-1)/n * b per rank
+    assert cost.collective_bytes["all_reduce"] == pytest.approx(
+        2 * 3 / 4 * b)
+    # one ppermute hop moves the full operand
+    assert cost.collective_bytes["ppermute"] == pytest.approx(b)
+    assert cost.collective_calls == {"all_reduce": 1, "ppermute": 1}
+    assert cost.peak_hbm_bytes >= b
+    table = cost.format_table()
+    assert "all_reduce" in table and "per rank" in table
+
+
+def test_cost_model_flops_and_scan_multiplier():
+    def body(x, w):
+        def tick(carry, _):
+            return jax.numpy.tanh(carry @ w), None
+        out, _ = jax.lax.scan(tick, x, None, length=5)
+        return out
+
+    closed = jax.make_jaxpr(body)(s(8, 16), s(16, 16))
+    est = analysis.estimate_jaxpr(closed)
+    # 5 scan iterations x (2*8*16*16 matmul + 8*16 tanh)
+    assert est.flops == pytest.approx(5 * (2 * 8 * 16 * 16 + 8 * 16))
+
+
+def test_inspect_mesh_attaches_cost_and_emits_gauges():
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    sf = to_static(net, input_spec=[InputSpec([4, 8])])
+    rep = sf.inspect(mesh=MESH)
+    assert not rep and rep.cost is not None
+    assert rep.cost.flops > 0
+    analysis.emit_findings(rep)  # empty report but cost gauges still set
+    assert monitor.gauge("lint.cost.flops").get() == rep.cost.flops
+    assert monitor.gauge("lint.cost.peak_hbm_bytes").get() == \
+        rep.cost.peak_hbm_bytes
+    # json carries the cost block
+    assert json.loads(rep.to_json())["cost"]["flops"] == rep.cost.flops
+
+
+def test_train_step_inspect_mesh():
+    net = nn.Linear(8, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    ts = TrainStep(net, nn.CrossEntropyLoss(), opt)
+    rep = ts.inspect([InputSpec([4, 8])], InputSpec([4], "int64"),
+                     mesh={"dp": 8})
+    assert isinstance(rep, analysis.Report) and not rep
+    assert rep.cost is not None and rep.cost.flops > 0
+
+
+def test_model_inspect_mesh():
+    net = nn.Linear(8, 4)
+    m = paddle.Model(net, inputs=[InputSpec([4, 8])])
+    rep = m.inspect(mesh=MESH)
+    assert not rep and rep.cost is not None
+
+
+def test_lint_never_leaks_mesh_or_recorder():
+    from paddle_tpu.distributed.communication import collectives as C
+    prev_mesh = mesh_mod.get_mesh()
+    shard_lint.lint_sharded(D.bad_axis_name, [s(8, 4)], mesh=MESH)
+    assert mesh_mod.get_mesh() is prev_mesh
+    assert C._collective_recorder is None
+
+
+# -- zero false positives on the dryrun zoo (tier-1 guard) -------------------
+
+def test_shard_lint_zoo_zero_findings():
+    from paddle_tpu.distributed.dryrun import shard_lint_zoo_reports
+    reports = shard_lint_zoo_reports(8)
+    assert len(reports) >= 5
+    for name, rep in reports:
+        assert not rep, f"{name}: {rep.format()}"
+        assert rep.cost is not None, name
+    # the zoo exercises real cross-device traffic, not trivia
+    by_name = dict(reports)
+    assert by_name["collectives"].cost.total_collective_bytes > 0
+    assert by_name["pipeline-gpipe"].cost.collective_bytes["ppermute"] > 0
+
+
+# -- collective validation satellites ----------------------------------------
+
+def test_all_to_all_validates_list_arity_eagerly():
+    import paddle_tpu.distributed as dist
+    prev = mesh_mod.get_mesh()
+    mesh_mod.set_mesh(mesh_mod.build_mesh({"mp": 4, "dp": 2}))
+    try:
+        g = dist.Group(axis_name="mp")
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        with pytest.raises(ValueError, match="4 ranks"):
+            dist.all_to_all([], [x, x, x], group=g)
+        with pytest.raises(ValueError, match="divisible"):
+            dist.alltoall_single(None, paddle.to_tensor(
+                np.ones((6, 2), np.float32)), group=g)
+        with pytest.raises(ValueError, match="divisible"):
+            dist.reduce_scatter(None, paddle.to_tensor(
+                np.ones((6, 2), np.float32)), group=g)
+    finally:
+        mesh_mod.set_mesh(prev)
+
+
+def test_eager_all_to_all_single_tensor_populates_out_list():
+    import paddle_tpu.distributed as dist
+    prev = mesh_mod.get_mesh()
+    mesh_mod.set_mesh(mesh_mod.build_mesh({"mp": 4, "dp": 2}))
+    try:
+        g = dist.Group(axis_name="mp")
+        x = paddle.to_tensor(np.arange(4, dtype=np.float32).reshape(4, 1))
+        out = []
+        dist.all_to_all(out, x, group=g)  # eager: axis not bound
+        # one dim-0 slice per rank, same entry shapes as the traced path
+        assert len(out) == 4
+        np.testing.assert_allclose(out[0].numpy(), [0.0])
+        np.testing.assert_allclose(out[3].numpy(), [3.0])
+    finally:
+        mesh_mod.set_mesh(prev)
+
+
+def test_multi_axis_group_collectives_trace():
+    """all_gather/all_reduce/broadcast over a TWO-axis group must lower
+    (tuple-of-names normalization) — the traced gather stacks
+    prod(degrees) entries."""
+    import paddle_tpu.distributed as dist
+
+    def body(x):
+        g = dist.Group(axis_name=("dp", "mp"))
+        y = dist.all_reduce(x, group=g)
+        gathered = dist.all_gather(None, y, group=g)
+        b = dist.broadcast(y, src=0, group=g)
+        return gathered, b
+
+    rep = shard_lint.lint_sharded(body, [s(4,)], mesh=MESH)
+    assert not rep, rep.format()
+    assert rep.cost.collective_calls["all_gather"] >= 2  # gather+bcast
+    assert rep.cost.n_devices == 8
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "paddle_lint.py"),
+         *args],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+def test_cli_shard_check_zoo_clean():
+    """tier-1 regression guard: paddle_lint --shard-check over the
+    dryrun zoo under the fake 8-device mesh must be clean."""
+    res = _run_cli("--shard-check")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "no findings" in res.stdout
+
+
+def test_cli_shard_check_cost_table_and_json():
+    res = _run_cli("--shard-check", "--cost")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "[zoo:pipeline-gpipe]" in res.stdout
+    assert "collective bytes" in res.stdout
+    res = _run_cli("--shard-check", "--cost", "--format", "json")
+    data = json.loads(res.stdout)
+    assert data["findings"] == []
+    assert data["costs"]["collectives"]["total_collective_bytes"] > 0
+    assert set(data["costs"]["pipeline-gpipe"]["collective_bytes"]) == \
+        {"ppermute"}

@@ -1,0 +1,159 @@
+"""distributed.watchdog: heartbeat, timeout, and recovery paths
+(ISSUE 3 satellite — previously untested)."""
+import json
+import time
+
+import pytest
+
+from paddle_tpu.distributed import watchdog
+
+
+class FakeStore:
+    """Dict-backed stand-in for the TCPStore key/value surface the
+    watchdog uses (set/get/check)."""
+
+    def __init__(self, fail=False):
+        self.kv = {}
+        self.fail = fail
+
+    def set(self, key, value):
+        if self.fail:
+            raise ConnectionError("store down")
+        self.kv[key] = value
+
+    def get(self, key):
+        return self.kv[key]
+
+    def check(self, key):
+        return key in self.kv
+
+
+@pytest.fixture(autouse=True)
+def _reset_watchdog():
+    yield
+    watchdog.stop()
+    watchdog._state.update(store=None, rank=0, thread=None, stop=None,
+                           ticks=0, last_tick=0.0, enabled=False)
+
+
+def _wait_for(pred, timeout=2.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+# -- worker side -------------------------------------------------------------
+
+def test_tick_is_noop_when_disabled():
+    before = dict(watchdog._state)
+    watchdog.tick()
+    assert watchdog._state["ticks"] == before["ticks"]
+    assert not watchdog.enabled()
+
+
+def test_start_without_launcher_env_returns_false(monkeypatch):
+    monkeypatch.delenv("PADDLE_WATCHDOG_PORT", raising=False)
+    assert watchdog.start() is False
+    assert not watchdog.enabled()
+
+
+def test_start_publishes_heartbeats_and_tick_advances():
+    store = FakeStore()
+    assert watchdog.start(store=store, rank=3, interval=0.01) is True
+    assert watchdog.enabled()
+    # idempotent second start
+    assert watchdog.start(store=store, rank=3) is True
+
+    watchdog.tick()
+    watchdog.tick()
+    key = "__watchdog/rank/3"
+    assert _wait_for(lambda: store.check(key)
+                     and json.loads(store.get(key))["ticks"] == 2)
+    rec = json.loads(store.get(key))
+    assert rec["ts"] == watchdog._state["last_tick"]
+
+
+def test_publisher_survives_store_failures():
+    store = FakeStore(fail=True)
+    watchdog.start(store=store, rank=0, interval=0.01)
+    watchdog.tick()
+    time.sleep(0.05)  # a raising store must not kill the daemon thread
+    assert watchdog._state["thread"].is_alive()
+    store.fail = False
+    assert _wait_for(lambda: store.check("__watchdog/rank/0"))
+
+
+def test_stop_disables_and_halts_publisher():
+    store = FakeStore()
+    watchdog.start(store=store, rank=1, interval=0.01)
+    th = watchdog._state["thread"]
+    watchdog.stop()
+    assert not watchdog.enabled()
+    assert _wait_for(lambda: not th.is_alive())
+    watchdog.tick()  # must be a no-op again
+    assert watchdog._state["ticks"] == 0
+
+
+def test_maybe_start_and_tick_without_env_is_noop(monkeypatch):
+    monkeypatch.delenv("PADDLE_WATCHDOG_PORT", raising=False)
+    watchdog.maybe_start_and_tick()
+    assert not watchdog.enabled()
+
+
+def test_maybe_start_and_tick_when_already_enabled():
+    store = FakeStore()
+    watchdog.start(store=store, rank=0, interval=0.01)
+    watchdog.maybe_start_and_tick()
+    assert watchdog._state["ticks"] == 1
+
+
+def test_register_faulthandler_noop_without_env(monkeypatch):
+    monkeypatch.delenv("PADDLE_WATCHDOG_PORT", raising=False)
+    watchdog.register_faulthandler_if_enabled()  # must not raise
+
+
+# -- launcher side -----------------------------------------------------------
+
+def _hb(store, rank, ts, ticks=5):
+    store.set(f"__watchdog/rank/{rank}",
+              json.dumps({"ticks": ticks, "ts": ts}).encode())
+
+
+def test_monitor_dump_fresh_ranks_not_wedged(capsys):
+    store = FakeStore()
+    now = time.time()
+    _hb(store, 0, now)
+    _hb(store, 1, now)
+    assert watchdog.monitor_dump(store, [0, 1], timeout=5.0) == []
+    assert "wedged" not in capsys.readouterr().out
+
+
+def test_monitor_dump_flags_stale_rank_and_prints_store_state(capsys):
+    store = FakeStore()
+    now = time.time()
+    _hb(store, 0, now)
+    _hb(store, 1, now - 60.0)  # stale: no progress for a minute
+    wedged = watchdog.monitor_dump(store, [0, 1], timeout=5.0)
+    assert wedged == [1]
+    out = capsys.readouterr().out
+    assert "wedged rank(s) [1]" in out
+    assert "rank 0: ticks=5" in out   # full store state in the dump
+    assert "rank 1: ticks=5" in out
+
+
+def test_monitor_dump_startup_grace_for_first_tick():
+    store = FakeStore()  # rank never heartbeat
+    # pod younger than 10x timeout: still in the first-compile grace
+    assert watchdog.monitor_dump(store, [0], timeout=5.0,
+                                 started_at=time.time() - 10.0) == []
+    # pod older than the grace: a rank with no FIRST tick is wedged
+    assert watchdog.monitor_dump(store, [0], timeout=5.0,
+                                 started_at=time.time() - 51.0) == [0]
+
+
+def test_monitor_dump_no_started_at_never_flags_missing_rank():
+    store = FakeStore()
+    assert watchdog.monitor_dump(store, [7], timeout=0.01) == []

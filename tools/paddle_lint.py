@@ -18,6 +18,13 @@ computation, dtype promotion, recompile risk...) need an abstract
 trace — use `StaticFunction.inspect()` / `TrainStep.inspect()` /
 `Model.inspect()` or `PADDLE_TPU_LINT=1` for those; docs/ANALYSIS.md
 has the full rule catalog.
+
+`--shard-check` is the one flag that DOES import paddle_tpu + jax: it
+shard-lints the dryrun model zoo (distributed/dryrun.py builders)
+under a fake 8-device mesh — still zero devices, abstract traces only
+— and must come back clean (the CI regression guard for the
+SPMD/collective rules). `--cost` adds each case's static cost table
+(bytes moved / FLOPs / peak HBM per rank).
 """
 from __future__ import annotations
 
@@ -63,6 +70,15 @@ def main(argv=None) -> int:
     ap.add_argument("--self-check", action="store_true",
                     help="lint the whole shipped paddle_tpu package "
                          "(CI regression guard: must be clean)")
+    ap.add_argument("--shard-check", action="store_true",
+                    help="shard-lint the dryrun model zoo under a fake "
+                         "8-device mesh (imports paddle_tpu+jax; still "
+                         "device-free; must be clean)")
+    ap.add_argument("--cost", action="store_true",
+                    help="with --shard-check: print each zoo case's "
+                         "static cost table (bytes/FLOPs/peak HBM)")
+    ap.add_argument("--devices", type=int, default=8,
+                    help="fake mesh size for --shard-check (default 8)")
     args = ap.parse_args(argv)
 
     findings_mod = _load("findings")
@@ -71,8 +87,8 @@ def main(argv=None) -> int:
     paths = list(args.paths)
     if args.self_check:
         paths.append(os.path.dirname(_ANALYSIS_DIR))
-    if not paths:
-        ap.error("no paths given (or use --self-check)")
+    if not paths and not args.shard_check:
+        ap.error("no paths given (or use --self-check / --shard-check)")
 
     findings = []
     for path in paths:
@@ -82,15 +98,47 @@ def main(argv=None) -> int:
         findings.extend(ast_lint.lint_paths(
             [path], all_functions=args.all_functions))
 
+    zoo_costs = {}
+    if args.shard_check or args.self_check:
+        # the ONE mode that needs the real package: abstract traces
+        # under a fake mesh, still no devices. --self-check also runs it
+        # when paddle_tpu/jax import (the full regression guard), but
+        # keeps its works-on-a-bare-checkout contract when they don't.
+        sys.path.insert(0, os.path.dirname(os.path.dirname(_ANALYSIS_DIR)))
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            from paddle_tpu.distributed.dryrun import shard_lint_zoo_reports
+        except Exception as exc:  # noqa: BLE001
+            if args.shard_check:
+                raise
+            shard_lint_zoo_reports = None
+            print(f"paddle_lint: shard zoo check skipped — paddle_tpu/"
+                  f"jax unavailable ({type(exc).__name__}: {exc})",
+                  file=sys.stderr)
+        if shard_lint_zoo_reports is not None:
+            for name, rep in shard_lint_zoo_reports(args.devices):
+                for f in rep:
+                    f.message = f"[zoo:{name}] {f.message}"
+                    findings.append(f)
+                if rep.cost is not None:
+                    zoo_costs[name] = rep.cost
+
     if args.rules:
         keep = {r.strip() for r in args.rules.split(",") if r.strip()}
         findings = [f for f in findings if f.rule in keep]
 
     report = findings_mod.Report(findings, subject="paddle_lint")
     if args.format == "json":
-        print(report.to_json())
+        out = json.loads(report.to_json())
+        if args.cost and zoo_costs:
+            out["costs"] = {k: v.to_dict() for k, v in zoo_costs.items()}
+        print(json.dumps(out, indent=2))
     else:
         print(report.format())
+        if args.cost and zoo_costs:
+            for name, cost in sorted(zoo_costs.items()):
+                print(f"\n[zoo:{name}]")
+                print(cost.format_table())
         if findings:
             rules = ", ".join(report.rules())
             print(f"\n{len(findings)} finding(s) across rules: {rules}")
