@@ -56,28 +56,65 @@ def _peak():
     return PEAK_FLOPS.get(kind, 197e12), kind
 
 
+# decode-bench name -> attention path it traced ("pallas" /
+# "xla-gather" / "xla-dense" / ...), read off the kernels.decode.*
+# counter deltas around each decode bench (the counters bump at TRACE
+# time, so they name the path the compiled loop actually baked in)
+_decode_paths = {}
+
+
+def _record_decode_path(name, fn):
+    """Run a decode bench and attribute which attention path its
+    compiled loop took from the kernels.decode.* counter deltas."""
+    from paddle_tpu import monitor
+    before = monitor.snapshot()
+    tok = fn()
+    after = monitor.snapshot()
+
+    def delta(c):
+        return int(after.get(c, 0)) - int(before.get(c, 0))
+
+    if delta("kernels.decode.paged_pallas") > 0:
+        path = "pallas"
+    elif delta("kernels.decode.paged_xla_gather_step") > 0:
+        path = "xla-gather"
+    elif delta("kernels.decode.rolling_xla") > 0:
+        path = "xla-rolling"
+    elif delta("kernels.decode.dense_xla") > 0:
+        path = "xla-dense"
+    else:
+        path = "cached-executable"   # no retrace: path decided earlier
+    _decode_paths[name] = path
+    return tok
+
+
 def _telemetry_extras(result):
     """PADDLE_TPU_MONITOR=1: fold the runtime counters (XLA compile
     count/seconds fed by the always-on listener in profiler/stats.py,
     eager dispatch count, device-memory watermark) into extras — a
     compile count that grows across re-printed lines means some extra
     is recompiling per step (shape churn), exactly the thing the
-    headline MFU number can't show."""
+    headline MFU number can't show. The decode-path attribution rides
+    along unconditionally (the counter registry is always live)."""
     from paddle_tpu import monitor
+    tel = result["extras"].setdefault("telemetry", {})
+    if _decode_paths:
+        tel["decode_attention_path"] = dict(_decode_paths)
     if not monitor.enabled():
+        if not tel:
+            result["extras"].pop("telemetry", None)
         return
     from paddle_tpu.profiler.stats import read_memory
     snap = monitor.snapshot()
-    tel = {
+    tel.update({
         "xla_compiles": int(snap.get("xla.compiles", 0)),
         "xla_compile_secs": round(float(snap.get("xla.compile_secs",
                                                  0.0)), 2),
         "eager_op_dispatches": int(snap.get("dispatch.ops", 0)),
-    }
+    })
     mem = read_memory()
     if mem["peak_bytes_in_use"]:
         tel[f"peak_bytes_{mem['source']}"] = mem["peak_bytes_in_use"]
-    result["extras"]["telemetry"] = tel
 
 
 def _time_steps(step_fn, n, groups=2):
@@ -279,7 +316,8 @@ def bench_ernie_moe(cfg=None, batch=32, seq=512, n_steps=6):
 
 
 def bench_llama_decode(batch=32, prompt=128, new_tokens=256,
-                       quantize=False, cache_impl="auto", window=None):
+                       quantize=False, cache_impl="auto", window=None,
+                       cache_dtype="auto"):
     """Compiled KV-cache decode throughput on the 1B model (inference
     axis of BASELINE config 4): greedy text.generate — prefill + one
     lax.scan of single-token cached steps — new tokens/sec across the
@@ -291,7 +329,9 @@ def bench_llama_decode(batch=32, prompt=128, new_tokens=256,
     (quantization.quantize_for_inference) — half the weight bytes, the
     lever that matters on a bandwidth-bound decode. cache_impl/window
     select the serving-cache layout points (paged block-table, rolling
-    sliding-window buffer)."""
+    sliding-window buffer); cache_dtype the KV-cache precision ladder
+    ("auto" = model compute dtype → bf16 on TPU; "int8" = quantized
+    KV, a quarter of the f32 cache bytes — docs/DECODE.md)."""
     import paddle_tpu as paddle
     from paddle_tpu.text import generate
     from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
@@ -315,7 +355,7 @@ def bench_llama_decode(batch=32, prompt=128, new_tokens=256,
 
     def run():
         return generate(net, ids, max_new_tokens=new_tokens,
-                        cache_impl=cache_impl)
+                        cache_impl=cache_impl, cache_dtype=cache_dtype)
 
     np.asarray(run().numpy())                             # compile
     best = float("inf")
@@ -501,22 +541,41 @@ def main():
         result["extras"]["resnet50_images_per_sec"] = round(ips, 1)
 
     def add_decode():
-        tok = bench_llama_decode()
+        # default cache_dtype="auto" → bf16 KV caches on TPU
+        tok = _record_decode_path("decode", bench_llama_decode)
         result["extras"]["llama_1b_decode_tokens_per_sec"] = round(tok, 1)
 
     def add_decode_int8():
-        tok = bench_llama_decode(quantize=True)
+        tok = _record_decode_path(
+            "decode_int8w", lambda: bench_llama_decode(quantize=True))
         result["extras"]["llama_1b_decode_int8_tokens_per_sec"] = \
             round(tok, 1)
 
+    def add_decode_bf16kv():
+        tok = _record_decode_path(
+            "decode_bf16kv",
+            lambda: bench_llama_decode(cache_dtype="bfloat16"))
+        result["extras"]["llama_1b_decode_bf16kv_tokens_per_sec"] = \
+            round(tok, 1)
+
+    def add_decode_int8kv():
+        tok = _record_decode_path(
+            "decode_int8kv",
+            lambda: bench_llama_decode(cache_dtype="int8"))
+        result["extras"]["llama_1b_decode_int8kv_tokens_per_sec"] = \
+            round(tok, 1)
+
     def add_decode_paged():
-        tok = bench_llama_decode(cache_impl="paged")
+        tok = _record_decode_path(
+            "decode_paged",
+            lambda: bench_llama_decode(cache_impl="paged"))
         result["extras"]["llama_1b_decode_paged_tokens_per_sec"] = \
             round(tok, 1)
 
     def add_decode_window():
         # sliding_window 128 < total 384: the rolling O(window) buffer
-        tok = bench_llama_decode(window=128)
+        tok = _record_decode_path(
+            "decode_rolling", lambda: bench_llama_decode(window=128))
         result["extras"]["llama_1b_decode_rolling_tokens_per_sec"] = \
             round(tok, 1)
 
@@ -538,6 +597,8 @@ def main():
         ("llama_small_seq512", lambda: add_llama("llama_small_seq512",
                                                  bench_llama_small), 180),
         ("llama_decode", add_decode, 240),
+        ("llama_decode_bf16kv", add_decode_bf16kv, 240),
+        ("llama_decode_int8kv", add_decode_int8kv, 240),
         ("llama_decode_int8", add_decode_int8, 240),
         ("llama_decode_paged", add_decode_paged, 240),
         ("llama_decode_rolling", add_decode_window, 240),

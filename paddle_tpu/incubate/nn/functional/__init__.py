@@ -687,14 +687,16 @@ def variable_length_memory_efficient_attention(query, key, value,
 
 
 def paged_attention(query, k_cache, v_cache, block_tables, context_lens,
-                    scale=None):
+                    scale=None, k_scale=None, v_scale=None):
     """TPU-native paged-KV decode attention (the capability behind the
     reference's block_multihead_attention, minus its CUDA-runtime arg
     plumbing): one decode step against fixed-size cache pages addressed
-    through per-sequence block tables. See kernels/paged_attention.py."""
+    through per-sequence block tables. Pass k_scale/v_scale
+    [num_blocks, h_kv, block_size] for an int8 page pool (per-slot
+    dequant scales — docs/DECODE.md). See kernels/paged_attention.py."""
     from ....kernels.paged_attention import paged_attention as _pa
     return _pa(query, k_cache, v_cache, block_tables, context_lens,
-               scale=scale)
+               scale=scale, k_scale=k_scale, v_scale=v_scale)
 
 
 def paged_write(key, value, k_cache, v_cache, block_tables, positions):
@@ -702,6 +704,22 @@ def paged_write(key, value, k_cache, v_cache, block_tables, positions):
     write half of the paged-decode loop)."""
     from ....kernels.paged_attention import paged_write as _pw
     return _pw(key, value, k_cache, v_cache, block_tables, positions)
+
+
+def paged_quant_write(key, value, k_cache, v_cache, k_scale, v_scale,
+                      block_tables, positions):
+    """paged_write for an int8 page pool: quantizes the float chunk per
+    (token, kv_head) and writes values AND per-slot scales (the write
+    half of the int8 paged-decode loop — serving schedulers that manage
+    their own pools call this; text.generate(cache_dtype="int8") does
+    it in-loop)."""
+    from ....kernels.paged_attention import paged_write_quant_arrays
+
+    def fn(k, v, kc, vc, ks, vs, bt, pos):
+        return paged_write_quant_arrays(k, v, kc, vc, ks, vs, bt, pos)
+    return run_op("paged_quant_write", fn,
+                  [key, value, k_cache, v_cache, k_scale, v_scale,
+                   block_tables, positions])
 
 
 def block_multihead_attention(*args, **kwargs):

@@ -84,6 +84,11 @@ def _x32_trace():
 def _log_fallback(exc, site):
     if not get_flag("flash_allow_fallback"):
         raise exc
+    from .. import monitor
+    # trace-time counter: bench/serving telemetry can tell a run that
+    # silently degraded to XLA from one that stayed on the kernels
+    # (docs/OBSERVABILITY.md "attention path counters")
+    monitor.counter(f"kernels.flash.fallback.{site}").increase()
     key = (site, type(exc).__name__)
     if key not in _warned_keys:
         logger.warning(

@@ -31,8 +31,24 @@ from ..core.dispatch import run_op
 NEG_INF = -1e30
 
 
+def paged_pallas_eligible(head_dim, block_size, cache_dtype):
+    """Static eligibility of the Pallas decode kernel for a page-pool
+    geometry: the [block_size, head_dim] page tile must meet the dtype's
+    minimum (sublane, lane) tile — (8, 128) f32, (16, 128) bf16/f16,
+    (32, 128) int8. The caller falls back to the XLA gather path (and
+    bumps the `kernels.decode.paged_xla_*` counter) when this is False,
+    so a bench line showing the gather path names the constraint that
+    was missed."""
+    if head_dim % 128:
+        return False
+    name = jnp.dtype(cache_dtype).name
+    sublane = {"int8": 32, "bfloat16": 16, "float16": 16}.get(name, 8)
+    return block_size % sublane == 0
+
+
 def paged_attention_arrays(q, k_cache, v_cache, block_tables, context_lens,
-                           scale: Optional[float] = None):
+                           scale: Optional[float] = None,
+                           k_scale=None, v_scale=None):
     """One decode step of attention against a paged KV cache.
 
     q:            [b, h, d]           — this step's query (one token/seq).
@@ -45,6 +61,9 @@ def paged_attention_arrays(q, k_cache, v_cache, block_tables, context_lens,
                   (masked out by context_lens).
     context_lens: [b] int             — tokens (incl. this step's, if
                   already written) visible per sequence.
+    k_scale/v_scale: [num_blocks, h_kv, block_size] f32 — per-slot
+                  dequant scales for an int8 pool (kv_quantize_arrays
+                  granularity); None for float pools.
     Returns [b, h, d].
     """
     b, h, d = q.shape
@@ -59,6 +78,11 @@ def paged_attention_arrays(q, k_cache, v_cache, block_tables, context_lens,
 
     k = gather_pages(k_cache, block_tables)
     v = gather_pages(v_cache, block_tables)
+    if k_scale is not None:
+        ks = gather_page_scales(k_scale, block_tables)
+        vs = gather_page_scales(v_scale, block_tables)
+        k = k.astype(jnp.float32) * ks[..., None]
+        v = v.astype(jnp.float32) * vs[..., None]
     L = block_tables.shape[1] * bs
     # GQA served by grouped einsum — no rep-times K/V copy over the
     # gathered pages (same idea as flash_attention's kv index map)
@@ -87,6 +111,17 @@ def gather_pages(cache, block_tables):
     return jnp.swapaxes(g, 2, 3).reshape(b, L, h_kv, d)
 
 
+def gather_page_scales(scales, block_tables):
+    """gather_pages for a per-slot scale pool [num_blocks, h_kv,
+    block_size] → [b, L, h_kv] (the kv_quantize_arrays layout of the
+    gathered token axis)."""
+    nb, h_kv, bs = scales.shape
+    b = block_tables.shape[0]
+    L = block_tables.shape[1] * bs
+    g = jnp.take(scales, block_tables, axis=0)  # [b, mb, h_kv, bs]
+    return jnp.swapaxes(g, 2, 3).reshape(b, L, h_kv)
+
+
 def paged_write_arrays(k, v, k_cache, v_cache, block_tables, positions):
     """Append token k/v per sequence into the paged cache.
 
@@ -100,11 +135,20 @@ def paged_write_arrays(k, v, k_cache, v_cache, block_tables, positions):
     Returns the updated (k_cache, v_cache).
     """
     nb, h_kv, bs, d = k_cache.shape
-    b = k.shape[0]
     squeeze = k.ndim == 3
     if squeeze:
         k, v = k[:, None], v[:, None]
-    s = k.shape[1]
+    page, slot = _page_slots(block_tables, positions, k.shape[1], bs)
+    # advanced indices (page, slot) straddle the ':' head slice, so the
+    # result axes are [b, s, h_kv, d] — exactly k/v's layout
+    k_cache = k_cache.at[page, :, slot].set(k.astype(k_cache.dtype))
+    v_cache = v_cache.at[page, :, slot].set(v.astype(v_cache.dtype))
+    return k_cache, v_cache
+
+
+def _page_slots(block_tables, positions, s, bs):
+    """(page, slot) [b, s] for a chunk of s consecutive tokens starting
+    at per-sequence ``positions``, with the eager-only capacity check."""
     capacity = block_tables.shape[1] * bs
     # NOTE: the concrete capacity check below costs a host sync per
     # EAGER call (jnp.max fetch); jit-compiled serving loops trace past
@@ -124,24 +168,49 @@ def paged_write_arrays(k, v, k_cache, v_cache, block_tables, positions):
                 f"block_size {bs}) — grow the block table first")
     pos = positions[:, None] + jnp.arange(s, dtype=positions.dtype)[None]
     page = jnp.take_along_axis(block_tables, pos // bs, axis=1)  # [b, s]
-    slot = pos % bs
-    # advanced indices (page, slot) straddle the ':' head slice, so the
-    # result axes are [b, s, h_kv, d] — exactly k/v's layout
-    k_cache = k_cache.at[page, :, slot].set(k.astype(k_cache.dtype))
-    v_cache = v_cache.at[page, :, slot].set(v.astype(v_cache.dtype))
-    return k_cache, v_cache
+    return page, pos % bs
 
 
-def _paged_decode_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
-                         m_ref, l_ref, acc_ref, *, bs, nblocks,
-                         scale, window):
+def paged_write_quant_arrays(k, v, k_cache, v_cache, k_scale, v_scale,
+                             block_tables, positions):
+    """paged_write_arrays for an int8 pool: quantizes the float chunk
+    per (token, kv_head) (quantization.kv_quantize_arrays) and writes
+    values AND scales. k/v: [b, h_kv, d] or [b, s, h_kv, d] float;
+    k_cache/v_cache int8 pools; k_scale/v_scale f32
+    [num_blocks, h_kv, block_size]. Returns the four updated pools."""
+    from ..quantization.functional import kv_quantize_arrays
+
+    nb, h_kv, bs, d = k_cache.shape
+    squeeze = k.ndim == 3
+    if squeeze:
+        k, v = k[:, None], v[:, None]
+    qk, sk = kv_quantize_arrays(k)     # [b, s, h_kv, d] / [b, s, h_kv]
+    qv, sv = kv_quantize_arrays(v)
+    page, slot = _page_slots(block_tables, positions, k.shape[1], bs)
+    k_cache = k_cache.at[page, :, slot].set(qk)
+    v_cache = v_cache.at[page, :, slot].set(qv)
+    k_scale = k_scale.at[page, :, slot].set(sk)
+    v_scale = v_scale.at[page, :, slot].set(sv)
+    return k_cache, v_cache, k_scale, v_scale
+
+
+def _paged_decode_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, *refs,
+                         bs, nblocks, scale, window, quant):
     """One (batch, page) program of single-token paged decode over ALL
     heads of the sequence.
 
     Scalar-prefetched block tables drive the K/V BlockSpec index maps,
     so each page streams HBM→VMEM directly from the global pool — the
-    XLA path's per-step gather (a full cache copy) never happens. All
-    h heads are processed in one program (grid b x pages, NOT
+    XLA path's per-step gather (a full cache copy) never happens. The
+    index maps CLAMP the page index to the last live page of the
+    sequence (ceil(context_len / bs) - 1): grid steps past the live
+    prefix re-request the same block, which Pallas recognizes and skips
+    the HBM→VMEM copy — a growing sequence only ever streams the pages
+    it has actually written, while the grid stays static. The liveness
+    guard below additionally skips the VPU work for those dead steps
+    (their masked contribution would be zero anyway).
+
+    All h heads are processed in one program (grid b x pages, NOT
     b*h*pages: at serving shapes the per-program dispatch overhead of
     thousands of tiny programs costs more than the attention itself).
     Scores are VPU broadcast-multiply-reduce, not MXU dots — decode
@@ -149,10 +218,21 @@ def _paged_decode_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
     skinny to feed the systolic array anyway. Online-softmax state per
     q head accumulates in VMEM scratch across the page-minor grid dim.
 
+    quant=True adds per-slot scale refs (int8 pool): pages stream at a
+    QUARTER of the f32 bytes and dequantize HBM→VMEM-side, inside this
+    kernel — the XLA path would materialize the dequantized cache.
+
     Refs: q [h, d] (h = h_kv * rep, GQA rows grouped kv-head-major),
-    k/v [h_kv, bs, d], o [h, d]; scratch m/l [h, 128], acc [h, d].
+    k/v [h_kv, bs, d], [k/v scales [h_kv, bs] when quant], o [h, d];
+    scratch m/l [h, 128], acc [h, d].
     """
     from jax.experimental import pallas as pl
+
+    if quant:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = refs
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_ref, l_ref, acc_ref = refs
 
     i = pl.program_id(0)
     j = pl.program_id(1)
@@ -164,37 +244,45 @@ def _paged_decode_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[...].astype(jnp.float32) * jnp.float32(scale)   # [h, d]
-    k = k_ref[...].astype(jnp.float32)                        # [hkv,bs,d]
-    v = v_ref[...].astype(jnp.float32)
-    h, d = q.shape
-    h_kv = k.shape[0]
-    rep = h // h_kv
-    if rep > 1:
-        # repeat kv heads to per-q-head rows INSIDE VMEM (bs*d per head
-        # — tiny); keeps every elementwise shape 3-D kv-head-major
-        k = jnp.repeat(k, rep, axis=0)                        # [h,bs,d]
-        v = jnp.repeat(v, rep, axis=0)
-    s = jnp.sum(q[:, None, :] * k, axis=-1)                   # [h, bs]
     pos = cl_ref[i].astype(jnp.int32) - jnp.int32(1)
-    k_pos = (j.astype(jnp.int32) * jnp.int32(bs)
-             + jax.lax.broadcasted_iota(jnp.int32, (h, bs), 1))
-    keep = k_pos <= pos
-    if window is not None:
-        keep = jnp.logical_and(keep, pos - k_pos < jnp.int32(window))
-    s = jnp.where(keep, s, neg_inf)
+    page_live = j.astype(jnp.int32) * jnp.int32(bs) <= pos
 
-    m_prev = m_ref[:, :1]
-    l_prev = l_ref[:, :1]
-    m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-    p = jnp.exp(s - m_cur)
-    p = jnp.where(s > neg_inf * 0.5, p, 0.0)
-    alpha = jnp.exp(m_prev - m_cur)
-    l_cur = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
-    acc_ref[...] = acc_ref[...] * alpha + jnp.sum(
-        p[:, :, None] * v, axis=1)                            # [h, d]
-    m_ref[...] = jnp.broadcast_to(m_cur, m_ref.shape)
-    l_ref[...] = jnp.broadcast_to(l_cur, l_ref.shape)
+    @pl.when(page_live)
+    def _accumulate():
+        q = q_ref[...].astype(jnp.float32) * jnp.float32(scale)  # [h, d]
+        k = k_ref[...].astype(jnp.float32)                    # [hkv,bs,d]
+        v = v_ref[...].astype(jnp.float32)
+        if quant:
+            k = k * ks_ref[...][:, :, None]
+            v = v * vs_ref[...][:, :, None]
+        h, d = q.shape
+        h_kv = k.shape[0]
+        rep = h // h_kv
+        if rep > 1:
+            # repeat kv heads to per-q-head rows INSIDE VMEM (bs*d per
+            # head — tiny); keeps every elementwise shape 3-D
+            # kv-head-major
+            k = jnp.repeat(k, rep, axis=0)                    # [h,bs,d]
+            v = jnp.repeat(v, rep, axis=0)
+        s = jnp.sum(q[:, None, :] * k, axis=-1)               # [h, bs]
+        k_pos = (j.astype(jnp.int32) * jnp.int32(bs)
+                 + jax.lax.broadcasted_iota(jnp.int32, (h, bs), 1))
+        keep = k_pos <= pos
+        if window is not None:
+            keep = jnp.logical_and(keep, pos - k_pos < jnp.int32(window))
+        s = jnp.where(keep, s, neg_inf)
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_cur)
+        p = jnp.where(s > neg_inf * 0.5, p, 0.0)
+        alpha = jnp.exp(m_prev - m_cur)
+        l_cur = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jnp.sum(
+            p[:, :, None] * v, axis=1)                        # [h, d]
+        m_ref[...] = jnp.broadcast_to(m_cur, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_cur, l_ref.shape)
 
     @pl.when(j == nblocks - 1)
     def _fin():
@@ -205,10 +293,13 @@ def _paged_decode_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_decode_pallas(q, k_cache, v_cache, block_tables, context_lens,
-                        scale=None, window=None, interpret=False):
+                        scale=None, window=None, interpret=False,
+                        k_scale=None, v_scale=None):
     """Pallas single-token paged decode: q [b, h, d] against the page
     pool, masked to context_lens (and a sliding window). Returns
-    [b, h, d]. Requires d % 128 == 0 and block_size % 8 == 0."""
+    [b, h, d]. Pass k_scale/v_scale [num_blocks, h_kv, block_size] f32
+    for an int8 pool (in-kernel dequant). Geometry must satisfy
+    paged_pallas_eligible(d, block_size, k_cache.dtype)."""
     import functools
 
     from jax.experimental import pallas as pl
@@ -219,25 +310,42 @@ def paged_decode_pallas(q, k_cache, v_cache, block_tables, context_lens,
     b, h, d = q.shape
     nb, h_kv, bs, _ = k_cache.shape
     nblocks = block_tables.shape[1]
+    quant = k_scale is not None
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     bt = jnp.asarray(block_tables, jnp.int32)
     cl = jnp.asarray(context_lens, jnp.int32)
 
+    def page_map(i, j, bt, cl):
+        # clamp to the sequence's last live page: dead grid steps
+        # re-request the previous block, so Pallas skips their HBM copy
+        # (the kernel skips their compute via the same predicate)
+        last = jnp.maximum((cl[i] - jnp.int32(1)) // jnp.int32(bs),
+                           jnp.int32(0))
+        return (bt[i, jnp.minimum(j, last)], 0, 0, 0)
+
+    def scale_map(i, j, bt, cl):
+        return page_map(i, j, bt, cl)[:3]
+
     kernel = functools.partial(
         _paged_decode_kernel, bs=bs, nblocks=nblocks,
         scale=float(scale),
-        window=None if window is None else int(window))
+        window=None if window is None else int(window),
+        quant=quant)
+    in_specs = [
+        pl.BlockSpec((None, h, d), lambda i, j, bt, cl: (i, 0, 0)),
+        pl.BlockSpec((None, h_kv, bs, d), page_map),
+        pl.BlockSpec((None, h_kv, bs, d), page_map),
+    ]
+    inputs = [q, k_cache, v_cache]
+    if quant:
+        in_specs += [pl.BlockSpec((None, h_kv, bs), scale_map),
+                     pl.BlockSpec((None, h_kv, bs), scale_map)]
+        inputs += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, nblocks),
-        in_specs=[
-            pl.BlockSpec((None, h, d), lambda i, j, bt, cl: (i, 0, 0)),
-            pl.BlockSpec((None, h_kv, bs, d),
-                         lambda i, j, bt, cl: (bt[i, j], 0, 0, 0)),
-            pl.BlockSpec((None, h_kv, bs, d),
-                         lambda i, j, bt, cl: (bt[i, j], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((None, h, d),
                                lambda i, j, bt, cl: (i, 0, 0)),
         scratch_shapes=[
@@ -252,13 +360,22 @@ def paged_decode_pallas(q, k_cache, v_cache, block_tables, context_lens,
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
             interpret=interpret,
-        )(bt, cl, q, k_cache, v_cache)
+        )(bt, cl, *inputs)
     return out
 
 
 def paged_attention(query, k_cache, v_cache, block_tables, context_lens,
-                    scale=None):
-    """Tensor-level entry (see paged_attention_arrays)."""
+                    scale=None, k_scale=None, v_scale=None):
+    """Tensor-level entry (see paged_attention_arrays); pass
+    k_scale/v_scale pools for an int8 cache."""
+    if k_scale is not None:
+        def fnq(q, kc, vc, bt, cl, ks, vs):
+            return paged_attention_arrays(q, kc, vc, bt, cl, scale=scale,
+                                          k_scale=ks, v_scale=vs)
+        return run_op("paged_attention", fnq,
+                      [query, k_cache, v_cache, block_tables,
+                       context_lens, k_scale, v_scale])
+
     def fn(q, kc, vc, bt, cl):
         return paged_attention_arrays(q, kc, vc, bt, cl, scale=scale)
     return run_op("paged_attention", fn,

@@ -8,7 +8,8 @@ observers the same way the reference's imperative quantization does.
 from .functional import (  # noqa: F401
     fake_channel_wise_quantize_dequantize_abs_max,
     fake_quantize_abs_max, fake_quantize_dequantize_abs_max,
-    quantize_linear, dequantize_linear)
+    quantize_linear, dequantize_linear,
+    kv_quantize_arrays, kv_dequantize_arrays)
 from .qat import QAT, PTQ, QuantConfig  # noqa: F401
 from .layers import (  # noqa: F401
     WeightOnlyLinear, quantize_for_inference,

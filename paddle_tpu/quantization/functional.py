@@ -74,6 +74,31 @@ def dequantize_linear(x, scale, zero_point=0.0, bit_length=8,
     return run_op("dequantize_linear", fn, [x, scale])
 
 
+def kv_quantize_arrays(x, bound=127.0):
+    """Symmetric int8 quantization of a KV-cache chunk along its LAST
+    axis (head_dim): one scale per (token, kv_head) — the granularity
+    the decode caches store, so a new token's absmax never forces
+    re-scaling already-written entries. Array-level (runs inside traced
+    decode steps; the tensor-level PTQ surface stays in quantize_linear).
+
+    x: [..., d] float → (q int8 [..., d], scale f32 [...]).
+    """
+    xf = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xf), axis=-1) / jnp.float32(bound)
+    scale = jnp.maximum(scale, jnp.float32(1e-8))
+    q = jnp.clip(jnp.round(xf / scale[..., None]),
+                 -bound, bound).astype(jnp.int8)
+    return q, scale
+
+
+def kv_dequantize_arrays(q, scale, dtype=jnp.float32):
+    """Inverse of kv_quantize_arrays: q int8 [..., d], scale [...] →
+    float [..., d]. Multiplies in f32 (the decode attention accumulates
+    in f32 regardless of cache dtype)."""
+    return (q.astype(jnp.float32)
+            * scale[..., None].astype(jnp.float32)).astype(dtype)
+
+
 def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1,
                     name=None):
     """Quantize a weight [K, N] to int8/int4 values with per-column (or

@@ -33,12 +33,49 @@ from ..jit.functional import functional_call, get_buffers, get_frozen, \
     get_params
 
 
+# decode-length bucket: max_new_tokens rounds up to a multiple of this
+# before shaping the compiled loop, so nearby lengths share ONE
+# executable (the tail past the requested length is generated and
+# sliced off; the bucketed cache tail is causally unreachable)
+CACHE_BUCKET = 64
+
+
+def _bucketed(n: int) -> int:
+    return -(-int(n) // CACHE_BUCKET) * CACHE_BUCKET
+
+
+def _resolve_cache_dtype(cache_dtype, params):
+    """Resolve the cache_dtype knob to a concrete dtype. "auto" = the
+    model's compute dtype: the params' floating dtype when it is
+    half-precision, else bf16 on TPU backends (decode attention
+    accumulates in f32 regardless, and the flash/paged kernels read
+    bf16 natively) and f32 elsewhere (keeps CPU CI token-exact against
+    the f32 reference paths)."""
+    if cache_dtype in (None, "auto"):
+        leaves = [l for l in jax.tree_util.tree_leaves(params)
+                  if hasattr(l, "dtype")
+                  and jnp.issubdtype(l.dtype, jnp.floating)]
+        if leaves and leaves[0].dtype in (jnp.bfloat16, jnp.float16):
+            return jnp.dtype(leaves[0].dtype)
+        if jax.default_backend() in ("tpu", "axon"):
+            return jnp.dtype(jnp.bfloat16)
+        return jnp.dtype(jnp.float32)
+    dt = jnp.dtype(cache_dtype)
+    allowed = (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16),
+               jnp.dtype(jnp.float16), jnp.dtype(jnp.int8))
+    if dt not in allowed:
+        raise ValueError(
+            f"cache_dtype must be one of 'auto', 'float32', 'bfloat16',"
+            f" 'float16', 'int8'; got {cache_dtype!r}")
+    return dt
+
+
 def generate(model, input_ids, max_new_tokens: int,
              temperature: float = 0.0, top_k: int = 0,
              top_p: float = 0.0,
              eos_token_id: Optional[int] = None, seed: int = 0,
              use_cache: bool = True, cache_impl: str = "auto",
-             page_size: int = 32):
+             page_size: int = 32, cache_dtype: str = "auto"):
     """Generate ``max_new_tokens`` continuations for ``input_ids``
     [B, S] with the causal-LM ``model``. temperature == 0 → greedy;
     otherwise softmax sampling at that temperature, optionally top-k
@@ -63,10 +100,23 @@ def generate(model, input_ids, max_new_tokens: int,
     those; "paged" uses the serving block-table layout
     (kernels/paged_attention.py) with ``page_size``-token pages —
     numerics identical, memory allocated page-wise like the reference's
-    block_multihead_attention serving cache."""
+    block_multihead_attention serving cache.
+
+    cache_dtype selects KV-cache precision (docs/DECODE.md): "auto" =
+    the model's compute dtype (bf16 on TPU — decode attention is
+    HBM-bandwidth bound, and attention accumulates in f32 either way);
+    "float32"/"bfloat16"/"float16" force a dtype; "int8" stores
+    quantized K/V with per (token, kv_head) scales — a quarter of the
+    f32 cache bytes, dequantized inside the attention step (in-VMEM for
+    the Pallas paged-decode kernel).
+
+    max_new_tokens is bucketed (multiples of 64) when shaping the
+    compiled loop, so nearby lengths reuse one executable instead of
+    retracing; the returned tensor is exactly
+    [B, S + max_new_tokens]."""
     ids = np.asarray(unwrap(input_ids))
     b, s = ids.shape
-    total = s + int(max_new_tokens)
+    total = s + _bucketed(max_new_tokens)
     if max_new_tokens <= 0:
         return wrap(jnp.asarray(ids))
     if use_cache:
@@ -97,7 +147,16 @@ def generate(model, input_ids, max_new_tokens: int,
             scaled = cur / jnp.float32(temperature)
             k_eff = min(int(top_k), cur.shape[-1]) if top_k else 0
             p_on = bool(top_p) and 0.0 < float(top_p) < 1.0
-            if k_eff > 0 or p_on:
+            if k_eff > 0 and not p_on:
+                # top-k only: lax.top_k + threshold is O(V·k) per row —
+                # no reason to pay the full-vocab O(V log V) argsort
+                # the composed top-k+top-p filter below needs. (Exact
+                # threshold ties keep every tied token; the argsort
+                # path would keep the first k by index — a measure-zero
+                # difference for float logits.)
+                kth = jax.lax.top_k(scaled, k_eff)[0][:, -1:]
+                scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+            elif k_eff > 0 or p_on:
                 # ONE descending argsort serves both filters (a second
                 # full-vocab sort per decode step would double the
                 # compiled loop's sort work)
@@ -152,6 +211,8 @@ def generate(model, input_ids, max_new_tokens: int,
         hkv = cfg.num_key_value_heads
         hd = cfg.hidden_size // cfg.num_attention_heads
         win = getattr(cfg, "sliding_window", None)
+        vdt = _resolve_cache_dtype(cache_dtype, st[0])
+        quant = vdt == jnp.dtype(jnp.int8)
         impl = cache_impl
         if impl == "auto":
             impl = ("rolling" if win is not None and int(win) < total
@@ -172,9 +233,12 @@ def generate(model, input_ids, max_new_tokens: int,
             bt = jnp.arange(b * nblocks, dtype=jnp.int32).reshape(
                 b, nblocks)
             caches = [
-                (jnp.zeros((b * nblocks, hkv, bs_, hd), jnp.float32),
-                 jnp.zeros((b * nblocks, hkv, bs_, hd), jnp.float32),
+                (jnp.zeros((b * nblocks, hkv, bs_, hd), vdt),
+                 jnp.zeros((b * nblocks, hkv, bs_, hd), vdt),
                  bt)
+                + ((jnp.zeros((b * nblocks, hkv, bs_), jnp.float32),
+                    jnp.zeros((b * nblocks, hkv, bs_), jnp.float32))
+                   if quant else ())
                 for _ in range(cfg.num_hidden_layers)]
         elif impl == "rolling":
             # Mistral-style rolling buffer: C = window slots per layer
@@ -182,14 +246,20 @@ def generate(model, input_ids, max_new_tokens: int,
             # O(prompt + new_tokens)
             C = int(win)
             caches = [
-                (jnp.zeros((b, C, hkv, hd), jnp.float32),
-                 jnp.zeros((b, C, hkv, hd), jnp.float32),
+                (jnp.zeros((b, C, hkv, hd), vdt),
+                 jnp.zeros((b, C, hkv, hd), vdt),
                  jnp.full((C,), -1, jnp.int32))
+                + ((jnp.zeros((b, C, hkv), jnp.float32),
+                    jnp.zeros((b, C, hkv), jnp.float32))
+                   if quant else ())
                 for _ in range(cfg.num_hidden_layers)]
         else:
             caches = [
-                (jnp.zeros((b, total, hkv, hd), jnp.float32),
-                 jnp.zeros((b, total, hkv, hd), jnp.float32))
+                (jnp.zeros((b, total, hkv, hd), vdt),
+                 jnp.zeros((b, total, hkv, hd), vdt))
+                + ((jnp.zeros((b, total, hkv), jnp.float32),
+                    jnp.zeros((b, total, hkv), jnp.float32))
+                   if quant else ())
                 for _ in range(cfg.num_hidden_layers)]
         # prefill the prompt (writes cache slots [0, s))
         logits, caches = fwd(st, tokens[:, :s], caches, jnp.int32(0))
@@ -233,9 +303,13 @@ def generate(model, input_ids, max_new_tokens: int,
                   "num_key_value_heads", "num_attention_heads",
                   "hidden_size", "use_flash_attention")) \
         if cfg is not None else ()
+    # `total` is the BUCKETED length: every max_new_tokens in the same
+    # 64-bucket maps to the same sig and reuses one compiled loop
+    # (tests assert steady_state_recompiles() == 0 across such calls)
     sig = (use_cache, cache_impl, int(page_size), b, s, total,
            float(temperature), int(top_k),
-           float(top_p), eos_token_id, str(ids.dtype), cfg_key)
+           float(top_p), eos_token_id, str(ids.dtype),
+           str(_resolve_cache_dtype(cache_dtype, params)), cfg_key)
     per_model = _jit_cache.setdefault(model, {})
     fn = per_model.get(sig)
     if fn is None:
@@ -247,12 +321,17 @@ def generate(model, input_ids, max_new_tokens: int,
     # model)
     with tape_mod.no_grad_guard():
         out = fn((params, buffers, frozen), padded, key)
-    return wrap(out)
+    # slice the bucket tail off HOST-side: a device-side slice would
+    # compile one (tiny) executable per distinct max_new_tokens, which
+    # is exactly the per-length churn the bucketing removes — and every
+    # generate caller fetches the tokens next anyway
+    return wrap(jnp.asarray(np.asarray(out)[:, :s + int(max_new_tokens)]))
 
 
 def beam_search(model, input_ids, max_new_tokens: int, num_beams: int = 4,
                 length_penalty: float = 1.0,
-                eos_token_id: Optional[int] = None):
+                eos_token_id: Optional[int] = None,
+                cache_dtype: str = "auto"):
     """Compiled beam-search decode: the k beams fold into the batch dim
     inside ONE ``lax.scan`` (B = batch * num_beams rows), per-beam KV
     caches are reordered by a batched gather at every step, and the
@@ -274,7 +353,8 @@ def beam_search(model, input_ids, max_new_tokens: int, num_beams: int = 4,
         return wrap(jnp.asarray(ids))
     if k == 1:
         return generate(model, input_ids, max_new_tokens,
-                        eos_token_id=eos_token_id)
+                        eos_token_id=eos_token_id,
+                        cache_dtype=cache_dtype)
     params = get_params(model)
     buffers = get_buffers(model)
     frozen = get_frozen(model)
@@ -293,9 +373,17 @@ def beam_search(model, input_ids, max_new_tokens: int, num_beams: int = 4,
     def decode(st, prompt):
         hkv = cfg.num_key_value_heads
         hd = cfg.hidden_size // cfg.num_attention_heads
+        # beam caches follow the same cache_dtype ladder as generate
+        # (dense layout only — beams reorder by gather, and tree_map
+        # moves int8 values and their scales together)
+        vdt = _resolve_cache_dtype(cache_dtype, st[0])
+        quant = vdt == jnp.dtype(jnp.int8)
         caches = [
-            (jnp.zeros((b, total, hkv, hd), jnp.float32),
-             jnp.zeros((b, total, hkv, hd), jnp.float32))
+            (jnp.zeros((b, total, hkv, hd), vdt),
+             jnp.zeros((b, total, hkv, hd), vdt))
+            + ((jnp.zeros((b, total, hkv), jnp.float32),
+                jnp.zeros((b, total, hkv), jnp.float32))
+               if quant else ())
             for _ in range(cfg.num_hidden_layers)]
         logits, caches = fwd(st, prompt, caches, jnp.int32(0))
         lp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), -1)
@@ -351,7 +439,7 @@ def beam_search(model, input_ids, max_new_tokens: int, num_beams: int = 4,
         return tokens[rows]
 
     sig = ("beam", b, s, total, k, float(length_penalty), eos_token_id,
-           str(ids.dtype))
+           str(ids.dtype), str(_resolve_cache_dtype(cache_dtype, params)))
     per_model = _jit_cache.setdefault(model, {})
     fn = per_model.get(sig)
     if fn is None:

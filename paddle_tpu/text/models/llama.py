@@ -41,17 +41,27 @@ NEG_INF_ATTN = -1e30
 def _attend_cache(qa, kk, vv, mask, rep):
     """Shared decode-attention core: masked softmax of qa against the
     (kv-shaped) cache keys/values, GQA heads repeated. qa [b, s, h, d];
-    kk/vv [b, L, h_kv, d]; mask [s, L]."""
+    kk/vv [b, L, h_kv, d]; mask [s, L].
+
+    Decode attention is HBM-bandwidth bound, so a half-precision cache
+    stays half-precision INTO the dots (MXU-native bf16 operands) with
+    f32 accumulation via preferred_element_type — casting the cache to
+    f32 first would make XLA materialize a full-width copy of the
+    hottest tensor in the loop. Softmax stays f32 like the flash
+    kernels."""
     if rep != 1:
         kk = jnp.repeat(kk, rep, axis=2)
         vv = jnp.repeat(vv, rep, axis=2)
+    cdt = kk.dtype if kk.dtype in (jnp.bfloat16, jnp.float16) \
+        else jnp.float32
     scale = 1.0 / jnp.sqrt(jnp.float32(qa.shape[-1]))
-    logits = jnp.einsum("bshd,bLhd->bhsL", qa.astype(jnp.float32),
-                        kk.astype(jnp.float32)) * scale
+    logits = jnp.einsum("bshd,bLhd->bhsL", qa.astype(cdt),
+                        kk.astype(cdt),
+                        preferred_element_type=jnp.float32) * scale
     logits = jnp.where(mask[None, None], logits, NEG_INF_ATTN)
     p = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bhsL,bLhd->bshd", p,
-                      vv.astype(jnp.float32)).astype(qa.dtype)
+    return jnp.einsum("bhsL,bLhd->bshd", p.astype(cdt), vv.astype(cdt),
+                      preferred_element_type=jnp.float32).astype(qa.dtype)
 
 
 @dataclass
@@ -240,33 +250,67 @@ class LlamaAttention(Layer):
     def _cached_attention(self, q, k, v, kv_cache, cache_index):
         """KV-cache decode: write this call's k/v at ``cache_index``,
         attend q against the cache prefix. sliding_window adds its band
-        to the cache mask. A 2-tuple (k, v) cache is full-length; a
-        3-tuple (k, v, pos) with 1-D pos is a Mistral-style ROLLING
-        buffer of C = min(window, total) slots — writes land at pos % C,
-        evicting the oldest, and pos[] tracks each slot's absolute
-        position for the mask, so long-generation KV memory is O(window)
-        not O(L); a 3-tuple (k_pool, v_pool, block_tables) with 2-D
-        block_tables is a PAGED cache (serving block-table layout, see
-        kernels/paged_attention.py). One run_op so the cache update and
-        masked attention stay a single traced unit."""
-        if len(kv_cache) == 3 and kv_cache[2].ndim == 2:
+        to the cache mask. Cache tuple shapes (see docs/DECODE.md):
+
+        - (k, v): DENSE full-length cache, any float dtype (the decode
+          stack allocates the model's compute dtype by default);
+        - (k, v, k_scale, v_scale): dense INT8 cache with per
+          (token, kv_head) scales (quantization.kv_quantize_arrays);
+        - (k, v, pos) with 1-D pos: Mistral-style ROLLING buffer of
+          C = min(window, total) slots — writes land at pos % C,
+          evicting the oldest, and pos[] tracks each slot's absolute
+          position for the mask, so long-generation KV memory is
+          O(window) not O(L); (k, v, pos, k_scale, v_scale) is its
+          int8 form;
+        - (k_pool, v_pool, block_tables) with 2-D block_tables: PAGED
+          cache (serving block-table layout, kernels/
+          paged_attention.py); (k_pool, v_pool, block_tables, k_scale,
+          v_scale) is its int8 form (per-slot scale pools).
+
+        One run_op so the cache update and masked attention stay a
+        single traced unit."""
+        if len(kv_cache) in (3, 5) and kv_cache[2].ndim == 2:
             return self._paged_cached_attention(q, k, v, kv_cache,
                                                 cache_index)
-        if len(kv_cache) == 3:
+        if len(kv_cache) in (3, 5):
             return self._rolling_cached_attention(q, k, v, kv_cache,
                                                   cache_index)
         window = self.window
         rep = self.num_heads // self.num_kv_heads
+        quant = len(kv_cache) == 4
+        from ... import monitor
+        monitor.counter("kernels.decode.dense_xla").increase()
 
-        def fn(qa, ka, va, ck, cv, idx):
+        def fn(qa, ka, va, ck, cv, *rest):
+            if quant:
+                ks, vs, idx = rest
+            else:
+                (idx,) = rest
+                ks = vs = None
             s = qa.shape[1]
             L = ck.shape[1]
             idx = idx.astype(jnp.int32)
             zero = jnp.int32(0)
-            ck = jax.lax.dynamic_update_slice(
-                ck, ka.astype(ck.dtype), (zero, idx, zero, zero))
-            cv = jax.lax.dynamic_update_slice(
-                cv, va.astype(cv.dtype), (zero, idx, zero, zero))
+            if quant:
+                from ...quantization.functional import kv_quantize_arrays
+                qk, sk = kv_quantize_arrays(ka)
+                qv, sv = kv_quantize_arrays(va)
+                ck = jax.lax.dynamic_update_slice(
+                    ck, qk, (zero, idx, zero, zero))
+                cv = jax.lax.dynamic_update_slice(
+                    cv, qv, (zero, idx, zero, zero))
+                ks = jax.lax.dynamic_update_slice(ks, sk,
+                                                  (zero, idx, zero))
+                vs = jax.lax.dynamic_update_slice(vs, sv,
+                                                  (zero, idx, zero))
+                kk = ck.astype(jnp.float32) * ks[..., None]
+                vv = cv.astype(jnp.float32) * vs[..., None]
+            else:
+                ck = jax.lax.dynamic_update_slice(
+                    ck, ka.astype(ck.dtype), (zero, idx, zero, zero))
+                cv = jax.lax.dynamic_update_slice(
+                    cv, va.astype(cv.dtype), (zero, idx, zero, zero))
+                kk, vv = ck, cv
             # query local position i sits at absolute idx + i; it sees
             # cache slots <= that position (within the window band)
             q_pos = idx + jnp.arange(s, dtype=jnp.int32)
@@ -274,15 +318,18 @@ class LlamaAttention(Layer):
             mask = k_pos[None, :] <= q_pos[:, None]        # [s, L]
             if window is not None:
                 mask &= (q_pos[:, None] - k_pos[None, :]) < window
-            out = _attend_cache(qa, ck, cv, mask, rep)
+            out = _attend_cache(qa, kk, vv, mask, rep)
+            if quant:
+                return out, ck, cv, ks, vs
             return out, ck, cv
 
         idx_t = wrap(jnp.asarray(cache_index, jnp.int32))
-        out, nck, ncv = run_op("cached_attention", fn,
-                               [q, k, v, kv_cache[0], kv_cache[1], idx_t])
+        args = [q, k, v] + list(kv_cache) + [idx_t]
+        res = run_op("cached_attention", fn, args)
+        out, new_cache = res[0], tuple(res[1:])
         b, s = out.shape[0], out.shape[1]
         out = out.reshape([b, s, self.num_heads * self.head_dim])
-        return self.o_proj(out), (nck, ncv)
+        return self.o_proj(out), new_cache
 
     def _paged_cached_attention(self, q, k, v, kv_cache, cache_index):
         """Paged-KV decode (reference block_multihead_attention,
@@ -291,71 +338,139 @@ class LlamaAttention(Layer):
         block table. Writes land in page pos // block_size, slot
         pos % block_size; attention gathers the sequence's pages with
         ONE XLA gather and applies the same causal(+window) band as the
-        dense cache — numerics identical, memory allocated page-wise."""
+        dense cache — numerics identical, memory allocated page-wise.
+        A 5-tuple cache carries int8 pools + per-slot scale pools; the
+        Pallas kernel dequantizes in VMEM so int8 pages stream at a
+        quarter of the f32 bytes."""
+        from ... import monitor
         from ...kernels.flash_attention import (_log_fallback,
                                                 _pallas_supported)
         from ...kernels.paged_attention import (gather_pages,
+                                                gather_page_scales,
                                                 paged_decode_pallas,
-                                                paged_write_arrays)
+                                                paged_pallas_eligible,
+                                                paged_write_arrays,
+                                                paged_write_quant_arrays)
         window = self.window
         rep = self.num_heads // self.num_kv_heads
+        quant = len(kv_cache) == 5
 
-        def fn(qa, ka, va, kc, vc, bt, idx):
+        def fn(qa, ka, va, kc, vc, bt, *rest):
+            if quant:
+                ks, vs, idx = rest
+            else:
+                (idx,) = rest
+                ks = vs = None
             b, s = qa.shape[0], qa.shape[1]
             _, hkv, bs_, d = kc.shape       # head-major page pool
             idx = idx.astype(jnp.int32)
             pos0 = jnp.full((b,), idx, jnp.int32)
-            kc, vc = paged_write_arrays(ka, va, kc, vc, bt, pos0)
+            if quant:
+                kc, vc, ks, vs = paged_write_quant_arrays(
+                    ka, va, kc, vc, ks, vs, bt, pos0)
+            else:
+                kc, vc = paged_write_arrays(ka, va, kc, vc, bt, pos0)
+
+            def done(out):
+                if quant:
+                    return out, kc, vc, ks, vs
+                return out, kc, vc
+
             # single-token decode steps take the Pallas kernel: pages
             # stream from the pool via scalar-prefetched block tables —
             # the XLA path below re-gathers (copies) the WHOLE cache
-            # every step, which measured 2.8x slower at b32
+            # every step, which measured 2.8x slower at b32. The
+            # counters record, at trace time, which path the compiled
+            # loop actually baked in (bench extras.telemetry reads the
+            # deltas — docs/OBSERVABILITY.md).
             on_tpu = jax.default_backend() in ("tpu", "axon")
-            if (s == 1 and on_tpu and d % 128 == 0 and bs_ % 8 == 0
-                    and _pallas_supported()):
+            if (s == 1 and on_tpu and _pallas_supported()
+                    and paged_pallas_eligible(d, bs_, kc.dtype)):
                 try:
                     out = paged_decode_pallas(
                         qa[:, 0], kc, vc, bt,
                         jnp.full((b,), idx + 1, jnp.int32),
-                        window=window)
-                    return out[:, None], kc, vc
+                        window=window, k_scale=ks, v_scale=vs)
+                    monitor.counter(
+                        "kernels.decode.paged_pallas").increase()
+                    return done(out[:, None])
                 except Exception as exc:  # noqa: BLE001 — flag-gated
                     _log_fallback(exc, "paged-decode")
+            monitor.counter(
+                "kernels.decode.paged_xla_gather_step" if s == 1
+                else "kernels.decode.paged_xla_gather").increase()
             L = bt.shape[1] * bs_
             kk = gather_pages(kc, bt)
             vv = gather_pages(vc, bt)
+            if quant:
+                kk = kk.astype(jnp.float32) \
+                    * gather_page_scales(ks, bt)[..., None]
+                vv = vv.astype(jnp.float32) \
+                    * gather_page_scales(vs, bt)[..., None]
             q_pos = idx + jnp.arange(s, dtype=jnp.int32)
             k_pos = jnp.arange(L, dtype=jnp.int32)
             mask = k_pos[None, :] <= q_pos[:, None]        # [s, L]
             if window is not None:
                 mask &= (q_pos[:, None] - k_pos[None, :]) < window
             out = _attend_cache(qa, kk, vv, mask, rep)
-            return out, kc, vc
+            return done(out)
 
         idx_t = wrap(jnp.asarray(cache_index, jnp.int32))
-        out, nkc, nvc = run_op(
-            "paged_cached_attention", fn,
-            [q, k, v, kv_cache[0], kv_cache[1], kv_cache[2], idx_t])
+        if quant:
+            args = [q, k, v, kv_cache[0], kv_cache[1], kv_cache[2],
+                    kv_cache[3], kv_cache[4], idx_t]
+        else:
+            args = [q, k, v, kv_cache[0], kv_cache[1], kv_cache[2],
+                    idx_t]
+        res = run_op("paged_cached_attention", fn, args)
+        out = res[0]
+        if quant:
+            new_cache = (res[1], res[2], kv_cache[2], res[3], res[4])
+        else:
+            new_cache = (res[1], res[2], kv_cache[2])
         b, s = out.shape[0], out.shape[1]
         out = out.reshape([b, s, self.num_heads * self.head_dim])
-        return self.o_proj(out), (nkc, nvc, kv_cache[2])
+        return self.o_proj(out), new_cache
 
     def _rolling_cached_attention(self, q, k, v, kv_cache, cache_index):
         """Rolling-buffer decode (see _cached_attention): the C-slot
         cache holds the window's K/V; slot j's absolute position lives
         in pos[j] (-1 = never written), making the band mask a direct
-        position compare with no modular arithmetic."""
+        position compare with no modular arithmetic. A 5-tuple cache
+        adds int8 slots + per (slot, kv_head) scales; the current chunk
+        attends through its own quantize→dequantize round trip so
+        rolling stays bit-consistent with the dense int8 layout."""
+        from ... import monitor
         window = self.window
         rep = self.num_heads // self.num_kv_heads
         if window is None:
             raise ValueError(
                 "rolling (k, v, pos) caches require sliding_window")
+        quant = len(kv_cache) == 5
+        monitor.counter("kernels.decode.rolling_xla").increase()
 
-        def fn(qa, ka, va, ck, cv, pos, idx):
+        def fn(qa, ka, va, ck, cv, pos, *rest):
+            if quant:
+                ks, vs, idx = rest
+            else:
+                (idx,) = rest
+                ks = vs = None
             b, s, hq, d = qa.shape
             C = ck.shape[1]
             idx = idx.astype(jnp.int32)
             cur_pos = idx + jnp.arange(s, dtype=jnp.int32)
+            if quant:
+                from ...quantization.functional import (
+                    kv_dequantize_arrays, kv_quantize_arrays)
+                qk, sk = kv_quantize_arrays(ka)
+                qv, sv = kv_quantize_arrays(va)
+                ka_c = kv_dequantize_arrays(qk, sk)
+                va_c = kv_dequantize_arrays(qv, sv)
+                ckf = ck.astype(jnp.float32) * ks[..., None]
+                cvf = cv.astype(jnp.float32) * vs[..., None]
+            else:
+                ka_c, va_c = ka.astype(ck.dtype), va.astype(cv.dtype)
+                ckf, cvf = ck, cv
             # Attend against PRE-update cache + the current chunk, so a
             # long prefill's intermediate rows still see the (not yet
             # evicted) keys just left of the kept window. Stale cache
@@ -363,33 +478,39 @@ class LlamaAttention(Layer):
             # <= idx - C <= q_pos - window, so the band mask hides them
             # without any explicit eviction logic; cache and chunk
             # positions never collide (old < idx <= new).
-            kk = jnp.concatenate([ck, ka.astype(ck.dtype)], axis=1)
-            vv = jnp.concatenate([cv, va.astype(cv.dtype)], axis=1)
+            kk = jnp.concatenate([ckf, ka_c.astype(ckf.dtype)], axis=1)
+            vv = jnp.concatenate([cvf, va_c.astype(cvf.dtype)], axis=1)
             pos_cat = jnp.concatenate([pos, cur_pos])     # [C + s]
             mask = (pos_cat[None, :] >= 0) \
                 & (pos_cat[None, :] <= cur_pos[:, None]) \
                 & ((cur_pos[:, None] - pos_cat[None, :]) < window)
             out = _attend_cache(qa, kk, vv, mask, rep)
             # roll the chunk in: only its last min(s, C) tokens survive
-            if s > C:
-                ka_w, va_w = ka[:, s - C:], va[:, s - C:]
-                new_pos = idx + jnp.arange(s - C, s, dtype=jnp.int32)
+            lo = s - C if s > C else 0
+            if quant:
+                ka_w, va_w = qk[:, lo:], qv[:, lo:]
             else:
-                ka_w, va_w = ka, va
-                new_pos = cur_pos
+                ka_w, va_w = ka[:, lo:], va[:, lo:]
+            new_pos = idx + jnp.arange(lo, s, dtype=jnp.int32)
             slots = new_pos % C
             ck = ck.at[:, slots].set(ka_w.astype(ck.dtype))
             cv = cv.at[:, slots].set(va_w.astype(cv.dtype))
             pos = pos.at[slots].set(new_pos)
+            if quant:
+                ks = ks.at[:, slots].set(sk[:, lo:])
+                vs = vs.at[:, slots].set(sv[:, lo:])
+                return out, ck, cv, pos, ks, vs
             return out, ck, cv, pos
 
         idx_t = wrap(jnp.asarray(cache_index, jnp.int32))
-        out, nck, ncv, npos = run_op(
-            "rolling_cached_attention", fn,
-            [q, k, v, kv_cache[0], kv_cache[1], kv_cache[2], idx_t])
+        args = [q, k, v, kv_cache[0], kv_cache[1], kv_cache[2]]
+        if quant:
+            args += [kv_cache[3], kv_cache[4]]
+        res = run_op("rolling_cached_attention", fn, args + [idx_t])
+        out, new_cache = res[0], tuple(res[1:])
         b, s = out.shape[0], out.shape[1]
         out = out.reshape([b, s, self.num_heads * self.head_dim])
-        return self.o_proj(out), (nck, ncv, npos)
+        return self.o_proj(out), new_cache
 
 
 class LlamaMLP(Layer):

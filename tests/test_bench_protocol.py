@@ -72,7 +72,8 @@ def test_budget_skips_extras_but_headline_survives(stubbed, capsys,
     assert lines[0]["value"] == 17000.0
     assert set(lines[-1]["extras"]["skipped"]) == {
         "llama_seq2048", "llama_small_seq512", "lenet", "bert_base",
-        "ernie_moe", "resnet50", "llama_decode", "llama_decode_int8",
+        "ernie_moe", "resnet50", "llama_decode", "llama_decode_bf16kv",
+        "llama_decode_int8kv", "llama_decode_int8",
         "llama_decode_paged", "llama_decode_rolling", "flashmask_8k"}
     assert "llama_seq2048_mfu" not in lines[-1]["extras"]
 

@@ -1,0 +1,142 @@
+"""Decode KV-cache precision ladder (docs/DECODE.md).
+
+Covers the cache_dtype knob end to end: the bf16 cache-layout
+equivalence matrix (dense == rolling == paged, token-exact greedy), the
+int8 quantized-KV quality gate (greedy top-1 agreement vs f32 caches),
+the decode-length bucketing recompile contract, and the top-k-only
+sampling fast path. The Pallas paged-decode kernel's int8/clamp paths
+are exercised in interpret mode in tests/test_flash_attention.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text.generation import CACHE_BUCKET, generate
+from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
+
+
+def _tiny_net(seed=0, layers=2, heads=4, vocab=64, window=6, kv=None):
+    paddle.seed(seed)
+    cfg = LlamaConfig.tiny(vocab=vocab, hidden=64, layers=layers,
+                           heads=heads)
+    if kv is not None:
+        cfg.num_key_value_heads = kv
+    cfg.sliding_window = window
+    cfg.use_flash_attention = False
+    net = LlamaForCausalLM(cfg)
+    net.eval()
+    return net
+
+
+def _prompts(rng, b=3, s=9, vocab=64):
+    return paddle.to_tensor(
+        rng.integers(0, vocab, (b, s)).astype(np.int64))
+
+
+def test_cache_layout_matrix_bf16_token_exact(rng):
+    """bf16 caches: dense / rolling / paged greedy-decode the IDENTICAL
+    tokens (the write-side cast is the only rounding, shared by all
+    three layouts; attention accumulates in f32)."""
+    net = _tiny_net()
+    ids = _prompts(rng)
+    outs = {impl: np.asarray(generate(
+        net, ids, 10, cache_impl=impl, page_size=4,
+        cache_dtype="bfloat16").numpy())
+        for impl in ("dense", "rolling", "paged")}
+    np.testing.assert_array_equal(outs["rolling"], outs["dense"])
+    np.testing.assert_array_equal(outs["paged"], outs["dense"])
+
+
+def test_cache_layout_matrix_int8_token_exact(rng):
+    """int8 caches: all three layouts share the per (token, kv_head)
+    quantize→dequantize round trip, so greedy tokens stay identical
+    across layouts (incl. GQA)."""
+    net = _tiny_net(kv=2)
+    ids = _prompts(rng)
+    outs = {impl: np.asarray(generate(
+        net, ids, 10, cache_impl=impl, page_size=4,
+        cache_dtype="int8").numpy())
+        for impl in ("dense", "rolling", "paged")}
+    np.testing.assert_array_equal(outs["rolling"], outs["dense"])
+    np.testing.assert_array_equal(outs["paged"], outs["dense"])
+
+
+def test_int8_kv_quality_gate(rng):
+    """The int8 KV cache must track f32 caches at >= 99% greedy top-1
+    agreement over a fixed prompt set (the serving acceptance gate for
+    shipping quantized caches by default-off)."""
+    net = _tiny_net(window=None)
+    total, agree = 0, 0
+    for b, s, new in [(4, 9, 32), (2, 5, 16)]:
+        ids = _prompts(rng, b=b, s=s)
+        ref = np.asarray(generate(net, ids, new,
+                                  cache_dtype="float32").numpy())
+        got = np.asarray(generate(net, ids, new,
+                                  cache_dtype="int8").numpy())
+        total += b * new
+        agree += int(np.sum(got[:, s:] == ref[:, s:]))
+    assert agree / total >= 0.99, (agree, total)
+
+
+def test_cache_dtype_auto_is_f32_on_cpu(rng):
+    """cache_dtype='auto' resolves to the model's compute dtype — f32
+    on the CPU CI backend — so the default path stays token-exact
+    against the padded full-recompute reference."""
+    net = _tiny_net(window=None, layers=1)
+    ids = _prompts(rng, b=2, s=5)
+    auto = np.asarray(generate(net, ids, 6).numpy())
+    f32 = np.asarray(generate(net, ids, 6,
+                              cache_dtype="float32").numpy())
+    padded = np.asarray(generate(net, ids, 6, use_cache=False).numpy())
+    np.testing.assert_array_equal(auto, f32)
+    np.testing.assert_array_equal(auto, padded)
+    with pytest.raises(ValueError):
+        generate(net, ids, 4, cache_dtype="int16")
+
+
+def test_generate_bucketed_no_recompile(rng):
+    """max_new_tokens values in one CACHE_BUCKET share a single
+    compiled decode loop: the second/third calls must trigger ZERO XLA
+    compiles (profiler.stats.steady_state_recompiles) — and the shared
+    loop's tokens agree on the common prefix."""
+    from paddle_tpu.profiler.stats import CompileTracker
+
+    net = _tiny_net(window=None, layers=1, heads=2, vocab=32)
+    ids = _prompts(rng, b=2, s=5, vocab=32)
+    assert CACHE_BUCKET == 64
+    tr = CompileTracker().start()
+    try:
+        a = generate(net, ids, 33)
+        tr.on_step()
+        b = generate(net, ids, 47)
+        tr.on_step()
+        c = generate(net, ids, 12)
+        tr.on_step()
+    finally:
+        tr.stop()
+    assert tr.steady_state_recompiles(warmup_steps=1) == 0, tr.per_step
+    a, b, c = (np.asarray(t.numpy()) for t in (a, b, c))
+    assert a.shape == (2, 38) and b.shape == (2, 52) and c.shape == (2, 17)
+    np.testing.assert_array_equal(a, b[:, :38])
+    np.testing.assert_array_equal(c, a[:, :17])
+
+
+def test_topk_only_fast_path(rng):
+    """The top-k-only filter (lax.top_k + threshold, no full-vocab
+    argsort): top_k=1 collapses sampling to greedy at any temperature;
+    top-k-only sampling is seed-deterministic and actually samples."""
+    net = _tiny_net(window=None, layers=1, heads=2, vocab=32)
+    ids = _prompts(rng, b=2, s=5, vocab=32)
+    greedy = np.asarray(generate(net, ids, 8).numpy())
+    k1 = np.asarray(generate(net, ids, 8, temperature=1.3, top_k=1,
+                             seed=5).numpy())
+    np.testing.assert_array_equal(k1, greedy)
+    a = np.asarray(generate(net, ids, 8, temperature=0.9, top_k=5,
+                            seed=3).numpy())
+    b = np.asarray(generate(net, ids, 8, temperature=0.9, top_k=5,
+                            seed=3).numpy())
+    np.testing.assert_array_equal(a, b)
+    outs = {tuple(np.asarray(generate(
+        net, ids, 8, temperature=1.5, top_k=5, seed=sd).numpy())[0])
+        for sd in range(4)}
+    assert len(outs) > 1

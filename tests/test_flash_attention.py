@@ -796,6 +796,82 @@ def test_paged_decode_pallas_matches_gather(rng):
                                rtol=2e-4, atol=2e-4)
 
 
+def test_paged_decode_pallas_int8_interpret(rng):
+    """The int8 paged-decode kernel (per-slot scale refs, in-VMEM
+    dequant) matches the XLA gather+dequant path, incl. GQA, permuted
+    tables, ragged context lengths and a window — interpret mode, so
+    the quantized kernel is tier-1-covered with no TPU."""
+    from paddle_tpu.kernels.paged_attention import (paged_attention_arrays,
+                                                    paged_decode_pallas,
+                                                    paged_pallas_eligible)
+    from paddle_tpu.quantization.functional import kv_quantize_arrays
+
+    b, h, h_kv, d, bs, nblocks = 3, 8, 4, 128, 32, 5
+    assert paged_pallas_eligible(d, bs, jnp.int8)
+    q = jnp.asarray(rng.standard_normal((b, h, d)).astype(np.float32))
+    kq, ks = kv_quantize_arrays(jnp.asarray(rng.standard_normal(
+        (b * nblocks, h_kv, bs, d)).astype(np.float32)))
+    vq, vs = kv_quantize_arrays(jnp.asarray(rng.standard_normal(
+        (b * nblocks, h_kv, bs, d)).astype(np.float32)))
+    bt = jnp.asarray(rng.permutation(b * nblocks).astype(
+        np.int32).reshape(b, nblocks))
+    cl = jnp.asarray(np.array([13, 129, 160], np.int32))
+    ref = paged_attention_arrays(q, kq, vq, bt, cl,
+                                 k_scale=ks, v_scale=vs)
+    out = paged_decode_pallas(q, kq, vq, bt, cl, interpret=True,
+                              k_scale=ks, v_scale=vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    # windowed: dequantized dense reference with the window band
+    win, L, rep = 9, nblocks * bs, h // h_kv
+    kk = jnp.swapaxes(jnp.take(kq.astype(jnp.float32)
+                               * ks[..., None], bt, axis=0), 2, 3
+                      ).reshape(b, L, h_kv, d)
+    vv = jnp.swapaxes(jnp.take(vq.astype(jnp.float32)
+                               * vs[..., None], bt, axis=0), 2, 3
+                      ).reshape(b, L, h_kv, d)
+    qg = q.reshape(b, h_kv, rep, d).astype(jnp.float32)
+    logits = jnp.einsum("bgrd,bLgd->bgrL", qg, kk) * (d ** -0.5)
+    kpos = jnp.arange(L)
+    valid = (kpos[None] < cl[:, None]) & \
+        ((cl[:, None] - 1 - kpos[None]) < win)
+    logits = jnp.where(valid[:, None, None], logits, -1e30)
+    want = jnp.einsum("bgrL,bLgd->bgrd", jax.nn.softmax(logits, -1),
+                      vv).reshape(b, h, d)
+    got = paged_decode_pallas(q, kq, vq, bt, cl, window=win,
+                              interpret=True, k_scale=ks, v_scale=vs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+    # ineligible geometry must be reported, not crash downstream
+    assert not paged_pallas_eligible(d, 16, jnp.int8)
+    assert not paged_pallas_eligible(64, bs, jnp.float32)
+
+
+def test_paged_decode_pallas_page_clamp_short_context(rng):
+    """Contexts much shorter than the block table: the clamped index
+    maps re-request the last live page for dead grid steps (no fresh
+    HBM copy on device) and the liveness guard skips their compute —
+    output must still match the full-gather reference exactly,
+    including a context that ends mid-page and a 1-token context."""
+    from paddle_tpu.kernels.paged_attention import (paged_attention_arrays,
+                                                    paged_decode_pallas)
+
+    b, h, h_kv, d, bs, nblocks = 3, 4, 4, 128, 8, 6
+    q = jnp.asarray(rng.standard_normal((b, h, d)).astype(np.float32))
+    kc = jnp.asarray(rng.standard_normal(
+        (b * nblocks, h_kv, bs, d)).astype(np.float32))
+    vc = jnp.asarray(rng.standard_normal(
+        (b * nblocks, h_kv, bs, d)).astype(np.float32))
+    bt = jnp.asarray(rng.permutation(b * nblocks).astype(
+        np.int32).reshape(b, nblocks))
+    cl = jnp.asarray(np.array([1, 5, 17], np.int32))   # 1, 1, 3 pages
+    ref = paged_attention_arrays(q, kc, vc, bt, cl)
+    out = paged_decode_pallas(q, kc, vc, bt, cl, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_generate_cache_impls_token_exact(rng):
     """dense / paged / rolling cache layouts produce IDENTICAL greedy
     tokens through the compiled generate() loop (windowed model)."""
