@@ -251,3 +251,22 @@ def _get_phi_kernel_name(op_name):
     """(reference: maps fluid op name -> phi kernel name; ops here keep
     one name)"""
     return op_name
+
+
+# -- serving engine (continuous batching over the paged KV stack) -----------
+# Lazy re-exports (PEP 562): the engine pulls in the text model stack,
+# which must not load during `paddle_tpu` package init (this module is
+# imported early for the Predictor parity surface).
+
+_ENGINE_EXPORTS = ("Engine", "SamplingParams", "Output", "Request")
+
+
+def __getattr__(name):
+    if name in _ENGINE_EXPORTS:
+        from . import engine as _engine
+        return getattr(_engine, name)
+    if name == "PageAllocator":
+        from .allocator import PageAllocator
+        return PageAllocator
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

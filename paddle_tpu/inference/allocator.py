@@ -1,0 +1,94 @@
+"""Paged-KV block allocator — the free list under the serving engine.
+
+Reference capability: the block manager behind PaddleNLP's
+block_multihead_attention serving cache (and vLLM's BlockAllocator):
+KV memory is a global pool of fixed-size pages; each sequence owns an
+ordered list of page ids recorded in its block-table row, pages are
+handed out as sequences grow and returned the moment a sequence
+finishes (EOS or budget) — not at the end of the serving call.
+
+This is pure host-side bookkeeping (python ints in a deque); the pool
+arrays themselves live in kernels/paged_attention.py's head-major
+layout and are updated functionally inside the compiled steps. Both
+the serving engine (inference/engine.py) and the one-shot
+``generate(cache_impl="paged")`` path allocate through here, so pool
+exhaustion is ONE loud RuntimeError naming the pool geometry and the
+requesting sequence — never a clipped page index silently overwriting
+another sequence's tokens.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional
+
+
+class PageAllocator:
+    """FIFO free list over ``num_pages`` page ids starting at ``base``.
+
+    ``base=1`` is the serving engine's convention: page 0 is the shared
+    scratch page every inactive slot's block-table row points at, so
+    masked lanes of the fixed-shape decode step write garbage somewhere
+    harmless instead of into a live sequence.
+    """
+
+    def __init__(self, num_pages: int, base: int = 0):
+        if num_pages < 1:
+            raise ValueError(f"num_pages must be >= 1, got {num_pages}")
+        self.num_pages = int(num_pages)
+        self.base = int(base)
+        self._free = deque(range(self.base, self.base + self.num_pages))
+        self._owner: Dict[int, Optional[object]] = {}
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def live_pages(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def can_alloc(self, n: int, watermark: int = 0) -> bool:
+        """True when ``n`` pages fit while leaving ``watermark`` pages
+        free — the admission-control check: headroom for RUNNING
+        sequences to grow before a new one is let in."""
+        return len(self._free) - int(watermark) >= int(n)
+
+    def alloc(self, n: int, seq=None) -> List[int]:
+        """Hand out ``n`` page ids (oldest-freed first), owned by
+        ``seq``. Raises RuntimeError naming the pool geometry when the
+        pool can't cover the request — the caller either preempts a
+        sequence and retries, or surfaces the error."""
+        n = int(n)
+        if n > len(self._free):
+            raise RuntimeError(
+                f"paged KV pool exhausted: sequence {seq!r} requested "
+                f"{n} page(s) but only {len(self._free)} of "
+                f"{self.num_pages} are free ({self.live_pages} live) — "
+                f"grow pool_pages, lower max_slots, or let the "
+                f"scheduler preempt")
+        pages = [self._free.popleft() for _ in range(n)]
+        for p in pages:
+            self._owner[p] = seq
+        return pages
+
+    def free(self, pages) -> None:
+        """Return pages to the free list (EOS/finish/preemption time —
+        not end-of-call). Double-frees and foreign ids fail loudly:
+        both corrupt the pool silently if let through."""
+        for p in pages:
+            p = int(p)
+            if p not in self._owner:
+                lo, hi = self.base, self.base + self.num_pages
+                raise RuntimeError(
+                    f"freeing page {p} that is not live (pool ids "
+                    f"[{lo}, {hi}), {self.live_pages} live) — "
+                    f"double-free or foreign page id")
+            del self._owner[p]
+            self._free.append(p)
+
+    def owner(self, page: int):
+        return self._owner.get(int(page))
+
+    def __repr__(self):
+        return (f"PageAllocator({self.live_pages} live / "
+                f"{self.num_pages} pages, base={self.base})")

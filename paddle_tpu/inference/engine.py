@@ -1,0 +1,645 @@
+"""paddle_tpu.inference.engine — in-process continuous-batching serving.
+
+Reference capability: the serving layer the reference framework ships
+around ``block_multihead_attention`` (PaddleNLP's dynamic-batch
+predictor over paged KV blocks). PR 4 built every serving *primitive*
+— head-major page pools with block tables, the scalar-prefetched
+Pallas paged-decode kernel, int8 KV — but ``text.generate`` is a
+static-batch API: all requests arrive together, pad to one length,
+finish together. This module adds the missing host-side scheduler that
+multiplexes DYNAMIC requests onto a SMALL FIXED SET of XLA executables
+(the JaxPP split: a schedule-driven host driver over fixed compiled
+per-stage programs).
+
+Design (docs/SERVING.md has the full lifecycle):
+
+* Request state machine: WAITING → PREFILL → DECODE → FINISHED, with
+  PREEMPTED looping back into the waiting queue (pages freed, tokens
+  and the RNG key kept, cache rebuilt by a resume prefill on
+  re-admission — token-for-token identical to the uninterrupted run).
+* Slot scheduler: ``max_slots`` decode lanes; every ``step()`` admits
+  waiting requests into free slots while the page pool keeps
+  ``watermark_pages`` of headroom (admission control: running
+  sequences must be able to grow before new ones join).
+* Paged allocator: allocator.PageAllocator over the shared pool; page
+  0 is the scratch page every INACTIVE slot's block-table row points
+  at, so masked lanes write garbage harmlessly. A sequence's pages are
+  freed the step it finishes — not at end-of-call.
+* Exactly TWO compiled step families, so steady-state recompiles are
+  zero under any arrival mix: length-bucketed prefill executables
+  (prompt padded to a ``prefill_bucket`` multiple, ``paged_write`` of
+  the prompt KV, first token sampled) and ONE ``[max_slots]`` decode
+  executable (single-token step through the paged attention stack —
+  the Pallas kernel on TPU — with per-slot sampling params as traced
+  arrays). ``steady_state_recompiles()`` reads 0 after warmup.
+* Token-exactness: a request decoded through the engine emits the
+  SAME tokens as a ``batch=1 text.generate`` with the same seed —
+  the sampler (generation.sample_token_arrays) mirrors pick_next's
+  filter semantics and per-request RNG chains, and inactive lanes
+  cannot perturb active rows (row-independent attention + scratch
+  page). tests/test_serving_engine.py holds this exact.
+
+``monitor`` surface (docs/OBSERVABILITY.md): gauges
+``serving.slots_active`` / ``serving.pages_free`` /
+``serving.queue_depth`` / ``serving.ttft_ms`` / ``serving.tpot_ms``,
+counters ``serving.requests`` / ``serving.tokens`` /
+``serving.finished`` / ``serving.preemptions`` / ``serving.steps``.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import monitor
+from ..core import tape as tape_mod
+from ..core.dispatch import unwrap
+from ..jit.functional import get_buffers, get_frozen, get_params
+from ..profiler.stats import CompileTracker
+from ..text.generation import (_model_forward, _resolve_cache_dtype,
+                               sample_token_arrays)
+from .allocator import PageAllocator
+
+# request lifecycle states
+WAITING = "WAITING"
+PREFILL = "PREFILL"
+DECODE = "DECODE"
+FINISHED = "FINISHED"
+PREEMPTED = "PREEMPTED"
+
+
+@dataclass
+class SamplingParams:
+    """Per-request generation config (the engine analog of generate's
+    kwargs; every field may differ per request inside one batch)."""
+
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 0.0
+    eos_token_id: Optional[int] = None
+    seed: int = 0
+
+    def validate(self):
+        if int(self.max_new_tokens) < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
+        if float(self.temperature) < 0:
+            raise ValueError(
+                f"temperature must be >= 0, got {self.temperature}")
+
+
+@dataclass
+class Output:
+    """One finished request: the generated continuation (including the
+    eos token when one was emitted) plus serving latencies."""
+
+    req_id: int
+    prompt_ids: List[int]
+    token_ids: List[int]
+    finish_reason: str            # "eos" | "length"
+    ttft_ms: float                # arrival -> first token
+    tpot_ms: float                # mean inter-token latency after that
+    preemptions: int = 0
+
+
+@dataclass
+class Request:
+    req_id: int
+    prompt: List[int]
+    params: SamplingParams
+    state: str = WAITING
+    generated: List[int] = field(default_factory=list)
+    key: Optional[np.ndarray] = None      # [2] uint32 rng chain state
+    slot: Optional[int] = None
+    pages: List[int] = field(default_factory=list)
+    written: int = 0                      # tokens in the paged cache
+    admit_seq: int = -1                   # admission order (preemption)
+    preemptions: int = 0
+    arrival_t: float = 0.0
+    first_token_t: float = 0.0
+    finish_t: float = 0.0
+    finish_reason: Optional[str] = None
+
+    def resume_tokens(self) -> List[int]:
+        """The prefix a (re-)prefill must write into the cache: the
+        prompt plus every generated token except the newest (which is
+        consumed — and written — by the next decode step)."""
+        if self.generated:
+            return self.prompt + self.generated[:-1]
+        return self.prompt
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-int(a) // int(b))
+
+
+class Engine:
+    """In-process continuous-batching engine over the paged KV stack.
+
+        eng = Engine(model, max_slots=8, page_size=16, pool_pages=256)
+        rid = eng.add_request(ids, SamplingParams(max_new_tokens=32))
+        while ...:
+            for out in eng.step():
+                ...                      # finished requests
+        # or offline:
+        outs = eng.run([(ids_a, pa), (ids_b, pb)])
+
+    The model must support the ``kv_caches``/``cache_index`` forward
+    kwargs (the in-tree LlamaForCausalLM does). Weights are snapshotted
+    at construction (the executables close over nothing — params ride
+    as arguments — but the engine reads them once; rebuild the engine
+    after mutating the model).
+    """
+
+    def __init__(self, model, max_slots: int = 8, page_size: int = 16,
+                 pool_pages: Optional[int] = None,
+                 cache_dtype: str = "auto",
+                 max_context: Optional[int] = None,
+                 prefill_bucket: int = 32,
+                 watermark_pages: Optional[int] = None):
+        import inspect
+        try:
+            fsig = inspect.signature(model.forward)
+        except (TypeError, ValueError):
+            fsig = None
+        if fsig is None or "kv_caches" not in fsig.parameters:
+            raise ValueError(
+                "Engine requires a model with kv_caches/cache_index "
+                "forward kwargs (KV-cache decode support); "
+                f"{type(model).__name__}.forward has none — use "
+                "text.generate(use_cache=False) for padded one-shot "
+                "generation instead")
+        cfg = model.config
+        self.model = model
+        self.max_slots = int(max_slots)
+        self.page_size = int(page_size)
+        self.prefill_bucket = int(prefill_bucket)
+        self.max_context = int(max_context
+                               or cfg.max_position_embeddings)
+        self.max_blocks = _ceil_div(self._pbucket(self.max_context),
+                                    self.page_size)
+        if pool_pages is None:
+            # default: every slot can hold a max-context sequence — no
+            # preemption unless the caller sizes the pool tighter
+            pool_pages = self.max_slots * self.max_blocks
+        self.pool_pages = int(pool_pages)
+        self.watermark_pages = (max(1, self.pool_pages // 50)
+                                if watermark_pages is None
+                                else int(watermark_pages))
+        self._st = (get_params(model), get_buffers(model),
+                    get_frozen(model))
+        self.cache_dtype = _resolve_cache_dtype(cache_dtype, self._st[0])
+        self._quant = self.cache_dtype == jnp.dtype(jnp.int8)
+        hkv = cfg.num_key_value_heads
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        # pool row 0 is the scratch page (inactive lanes) — the
+        # allocator hands out ids [1, pool_pages]
+        rows = self.pool_pages + 1
+        self._alloc = PageAllocator(self.pool_pages, base=1)
+        self._pools = [
+            (jnp.zeros((rows, hkv, self.page_size, hd),
+                       self.cache_dtype),
+             jnp.zeros((rows, hkv, self.page_size, hd),
+                       self.cache_dtype))
+            + ((jnp.zeros((rows, hkv, self.page_size), jnp.float32),
+                jnp.zeros((rows, hkv, self.page_size), jnp.float32))
+               if self._quant else ())
+            for _ in range(cfg.num_hidden_layers)]
+        S, MB = self.max_slots, self.max_blocks
+        self._bt = np.zeros((S, MB), np.int32)
+        self._pos = np.zeros((S,), np.int32)
+        self._last = np.zeros((S,), np.int32)
+        self._temps = np.zeros((S,), np.float32)
+        self._topks = np.zeros((S,), np.int32)
+        self._topps = np.zeros((S,), np.float32)
+        self._keys = np.zeros((S, 2), np.uint32)
+        self._slots: List[Optional[Request]] = [None] * S
+        self._waiting: "deque[Request]" = deque()
+        self.requests: Dict[int, Request] = {}
+        self._next_id = 0
+        self._admit_counter = 0
+        self._steps = 0
+        self._last_compile_step = 0
+        self._compiles = 0        # compiles inside OUR step() calls
+        self._warm_compiles = 0
+        self._prefill_fns: Dict[int, object] = {}
+        self._decode_fns: Dict[bool, object] = {}
+        self._tracker = CompileTracker().start()
+
+    # -- compiled step shapes ------------------------------------------------
+
+    def _pbucket(self, n: int) -> int:
+        return _ceil_div(n, self.prefill_bucket) * self.prefill_bucket
+
+    def _inject_bt(self, caches, bt):
+        """Pool tuples -> the model's per-layer paged cache tuples:
+        (k, v, bt[, ks, vs]) — the block table is engine state, shared
+        by every layer, injected at call time."""
+        return [(c[0], c[1], bt) + tuple(c[2:]) for c in caches]
+
+    def _strip_bt(self, kv):
+        return [(t[0], t[1]) + tuple(t[3:]) for t in kv]
+
+    def _get_decode_fn(self, greedy: bool):
+        """The [max_slots] decode executable — keyed STATICALLY on
+        whether any active slot samples: the all-greedy hot loop (the
+        common serving default) is a plain argmax, while a single
+        sampling request switches to the per-slot sampler (full-vocab
+        argsort per slot — work XLA can't dead-code out when
+        temperature rides as a traced array). Two variants, both
+        compiled once: still a fixed executable set."""
+        fn = self._decode_fns.get(greedy)
+        if fn is not None:
+            return fn
+        model = self.model
+
+        def body(st, caches, bt, tokens, positions, temps, topks,
+                 topps, keys):
+            kv = self._inject_bt(caches, bt)
+            logits, new_kv = _model_forward(model, st, tokens, kv,
+                                            positions)
+            last = logits[:, -1].astype(jnp.float32)
+            if greedy:
+                # greedy consumes no rng (pick_next semantics): keys
+                # pass through untouched, exactly like the sampler's
+                # temp==0 rows
+                nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                keys2 = keys
+            else:
+                nxt, keys2 = sample_token_arrays(last, keys, temps,
+                                                 topks, topps)
+            return nxt, keys2, self._strip_bt(new_kv)
+
+        fn = jax.jit(body, donate_argnums=(1,))
+        self._decode_fns[greedy] = fn
+        self._last_compile_step = self._steps
+        return fn
+
+    def _get_prefill_fn(self, pb: int):
+        fn = self._prefill_fns.get(pb)
+        if fn is not None:
+            return fn
+        model = self.model
+
+        def body(st, caches, bt_row, prompt, plen, temps, topks, topps,
+                 keys):
+            kv = self._inject_bt(caches, bt_row)
+            logits, new_kv = _model_forward(model, st, prompt, kv,
+                                            jnp.int32(0))
+            # last REAL prompt position's logits (the prompt is padded
+            # to the bucket; causality keeps the pad out of this row)
+            idx = jnp.reshape(plen - 1, (1, 1, 1)).astype(jnp.int32)
+            last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
+            nxt, keys2 = sample_token_arrays(
+                last.astype(jnp.float32), keys, temps, topks, topps)
+            return nxt, keys2, self._strip_bt(new_kv)
+
+        fn = jax.jit(body, donate_argnums=(1,))
+        self._prefill_fns[pb] = fn
+        self._last_compile_step = self._steps
+        return fn
+
+    # -- public API ----------------------------------------------------------
+
+    def add_request(self, ids, sampling_params=None) -> int:
+        """Queue a prompt (1-D token ids, or a [1, s] Tensor/array) for
+        generation under ``sampling_params``. Returns the request id;
+        the request is admitted to a slot by a later ``step()``."""
+        params = sampling_params or SamplingParams()
+        if isinstance(params, dict):
+            params = SamplingParams(**params)
+        params.validate()
+        arr = np.asarray(unwrap(ids))
+        if arr.ndim == 2 and arr.shape[0] == 1:
+            arr = arr[0]
+        if arr.ndim != 1:
+            raise ValueError(
+                f"add_request takes ONE prompt ([s] or [1, s] ids); got "
+                f"shape {arr.shape} — queue a batch as separate "
+                f"requests (silently concatenating the rows would "
+                f"decode from a nonsense combined context)")
+        prompt = [int(t) for t in arr]
+        if not prompt:
+            raise ValueError("empty prompt")
+        need = len(prompt) + int(params.max_new_tokens)
+        cap = self.max_blocks * self.page_size
+        if self._pbucket(need) > cap:
+            raise ValueError(
+                f"request needs {need} token slots (prompt "
+                f"{len(prompt)} + {params.max_new_tokens} new), beyond "
+                f"the engine's max_context capacity {cap}")
+        worst_pages = _ceil_div(self._pbucket(need), self.page_size)
+        if worst_pages > self.pool_pages:
+            raise RuntimeError(
+                f"request can never be scheduled: it needs up to "
+                f"{worst_pages} page(s) but the pool has "
+                f"{self.pool_pages} — grow pool_pages or shrink the "
+                f"request")
+        req = Request(req_id=self._next_id, prompt=prompt, params=params,
+                      arrival_t=time.perf_counter())
+        req.key = np.asarray(jax.random.PRNGKey(int(params.seed)),
+                             np.uint32)
+        self._next_id += 1
+        self.requests[req.req_id] = req    # LIVE requests only (see _finish)
+        self._waiting.append(req)
+        monitor.counter("serving.requests").increase()
+        return req.req_id
+
+    def step(self) -> List[Output]:
+        """One scheduler tick: admit + prefill new requests, grow/
+        preempt for page demand, run ONE batched decode step, retire
+        finished requests. Returns the requests that finished during
+        this tick."""
+        outputs: List[Output] = []
+        c0 = self._tracker.compiles
+        with tape_mod.no_grad_guard():
+            for req in self._admit():
+                out = self._prefill(req)
+                if out is not None:
+                    outputs.append(out)
+            self._ensure_pages()
+            outputs.extend(self._decode())
+        monitor.counter("serving.steps").increase()
+        self._publish_gauges()
+        # O(1) warmup accounting, attributed to THIS engine: only
+        # compiles that land inside this step() count (the jax
+        # listener is process-global — another engine or a generate()
+        # call between ticks must not read as our recompile), and a
+        # tick that introduced a new executable folds its compiles
+        # into warmup. (Not tracker.on_step(): its per-step list
+        # would grow one entry per tick forever in a serving process.)
+        self._compiles += self._tracker.compiles - c0
+        if self._last_compile_step == self._steps:
+            self._warm_compiles = self._compiles
+        self._steps += 1
+        return outputs
+
+    def run(self, requests: Sequence, max_steps: int = 100_000
+            ) -> List[Output]:
+        """Offline driver: queue every (ids, SamplingParams) pair —
+        bare ids get default params — then step until all finish.
+        Returns Outputs ordered by request id. Drains only its own
+        requests; drive a shared/online engine with step() instead
+        (other requests' outputs surfacing mid-run would be dropped
+        here)."""
+        ids_list = []
+        for item in requests:
+            if isinstance(item, (tuple, list)) and len(item) == 2 and \
+                    isinstance(item[1], (SamplingParams, dict)):
+                ids_list.append(self.add_request(item[0], item[1]))
+            else:
+                ids_list.append(self.add_request(item))
+        want = set(ids_list)
+        outs: List[Output] = []
+        for _ in range(max_steps):
+            outs.extend(o for o in self.step() if o.req_id in want)
+            if len(outs) == len(want):
+                break
+        else:
+            raise RuntimeError(
+                f"engine did not drain in {max_steps} steps "
+                f"({len(outs)}/{len(want)} finished)")
+        return sorted(outs, key=lambda o: o.req_id)
+
+    def steady_state_recompiles(self) -> int:
+        """XLA compiles INSIDE this engine's step() calls after the
+        last step that legitimately introduced a new executable (a new
+        prefill bucket or a decode variant) — the number that must be
+        0 under steady-state mixed traffic. Compiles by other code in
+        the process (another engine, a generate() call) don't count."""
+        return self._compiles - self._warm_compiles
+
+    def close(self):
+        """Detach the engine's compile tracker from the global
+        jax.monitoring fan-out (listener hygiene for processes that
+        build many engines; also runs at garbage collection)."""
+        self._tracker.stop()
+
+    def __del__(self):
+        try:
+            self._tracker.stop()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self._waiting)
+
+    @property
+    def num_active(self) -> int:
+        return sum(1 for r in self._slots
+                   if r is not None and r.state == DECODE)
+
+    @property
+    def pages_free(self) -> int:
+        return self._alloc.free_pages
+
+    # -- scheduler internals -------------------------------------------------
+
+    def _admit(self) -> List[Request]:
+        admitted = []
+        reserved = 0          # pages already promised this tick: the
+        while self._waiting:  # prefills run AFTER the admit loop
+            slot = next((i for i, r in enumerate(self._slots)
+                         if r is None), None)
+            if slot is None:
+                break
+            req = self._waiting[0]
+            need = _ceil_div(self._pbucket(len(req.resume_tokens())),
+                             self.page_size)
+            # the watermark reserves growth headroom for RUNNING
+            # sequences; an otherwise-empty engine admits with the
+            # whole pool (a big request must not starve behind
+            # headroom nobody needs)
+            busy = any(r is not None for r in self._slots)
+            wm = self.watermark_pages if busy else 0
+            if not self._alloc.can_alloc(need + reserved, wm):
+                break
+            reserved += need
+            self._waiting.popleft()
+            req.slot = slot
+            req.state = PREFILL
+            req.admit_seq = self._admit_counter
+            self._admit_counter += 1
+            self._slots[slot] = req
+            admitted.append(req)
+        return admitted
+
+    def _prefill(self, req: Request) -> Optional[Output]:
+        """Write the request's prefix into the pool (bucketed chunk);
+        fresh requests also sample their first token here (TTFT).
+        Resumed (preempted) requests only rebuild their cache — the
+        sampled token and key are discarded, so the request's RNG
+        chain continues exactly where it stopped."""
+        toks = req.resume_tokens()
+        fresh = not req.generated
+        P = len(toks)
+        pb = self._pbucket(P)
+        npages = _ceil_div(pb, self.page_size)
+        req.pages = self._alloc.alloc(npages, seq=req.req_id)
+        bt_row = np.zeros((1, self.max_blocks), np.int32)
+        bt_row[0, :npages] = req.pages
+        prompt = np.zeros((1, pb), np.int32)
+        prompt[0, :P] = toks
+        p = req.params
+        fn = self._get_prefill_fn(pb)
+        tok, key2, self._pools = fn(
+            self._st, self._pools, jnp.asarray(bt_row),
+            jnp.asarray(prompt), jnp.asarray([P], jnp.int32),
+            jnp.asarray([p.temperature], jnp.float32),
+            jnp.asarray([p.top_k], jnp.int32),
+            jnp.asarray([p.top_p], jnp.float32),
+            jnp.asarray(req.key[None]))
+        req.written = P
+        # trim the bucket-padding pages the real prefix doesn't need
+        keep = _ceil_div(P, self.page_size)
+        if keep < len(req.pages):
+            self._alloc.free(req.pages[keep:])
+            req.pages = req.pages[:keep]
+        if fresh:
+            t = int(np.asarray(tok)[0])
+            req.key = np.asarray(key2)[0].astype(np.uint32)
+            req.generated.append(t)
+            req.first_token_t = time.perf_counter()
+            monitor.counter("serving.tokens").increase()
+            reason = self._finish_reason(req, t)
+            if reason:
+                return self._finish(req, reason)
+        self._activate(req)
+        return None
+
+    def _activate(self, req: Request):
+        i = req.slot
+        self._bt[i] = 0
+        self._bt[i, :len(req.pages)] = req.pages
+        self._pos[i] = req.written
+        self._last[i] = req.generated[-1]
+        self._temps[i] = req.params.temperature
+        self._topks[i] = req.params.top_k
+        self._topps[i] = req.params.top_p
+        self._keys[i] = req.key
+        req.state = DECODE
+
+    def _ensure_pages(self):
+        """Before the decode step, every active slot must own the page
+        its next write lands in; allocate lazily, preempting the
+        YOUNGEST sequence when the pool runs dry."""
+        for i in range(self.max_slots):
+            req = self._slots[i]
+            if req is None or req.state != DECODE:
+                continue
+            while len(req.pages) <= req.written // self.page_size:
+                page = self._alloc_or_preempt(req)
+                if page is None:      # req itself got preempted
+                    break
+                req.pages.extend(page)
+                self._bt[i, :len(req.pages)] = req.pages
+
+    def _alloc_or_preempt(self, req: Request):
+        while True:
+            try:
+                return self._alloc.alloc(1, seq=req.req_id)
+            except RuntimeError:
+                victims = [r for r in self._slots
+                           if r is not None and r.state == DECODE]
+                if not victims:
+                    raise
+                victim = max(victims, key=lambda r: r.admit_seq)
+                self._preempt(victim)
+                if victim is req:
+                    return None
+
+    def _preempt(self, req: Request):
+        """Evict back to the waiting queue (front): pages freed, tokens
+        and RNG chain kept — a resume prefill rebuilds the cache."""
+        monitor.counter("serving.preemptions").increase()
+        req.preemptions += 1
+        self._clear_slot(req)
+        req.state = PREEMPTED
+        self._waiting.appendleft(req)
+
+    def _decode(self) -> List[Output]:
+        active = [i for i in range(self.max_slots)
+                  if self._slots[i] is not None
+                  and self._slots[i].state == DECODE]
+        if not active:
+            return []
+        greedy = all(self._temps[i] == 0.0 for i in active)
+        fn = self._get_decode_fn(greedy)
+        nxt, keys2, self._pools = fn(
+            self._st, self._pools, jnp.asarray(self._bt),
+            jnp.asarray(self._last[:, None]), jnp.asarray(self._pos),
+            jnp.asarray(self._temps), jnp.asarray(self._topks),
+            jnp.asarray(self._topps), jnp.asarray(self._keys))
+        nxt = np.asarray(nxt)
+        keys2 = np.asarray(keys2).astype(np.uint32)
+        outs: List[Output] = []
+        for i in active:
+            req = self._slots[i]
+            tok = int(nxt[i])
+            req.key = keys2[i]
+            self._keys[i] = keys2[i]
+            req.written += 1          # the step wrote last_token
+            self._pos[i] = req.written
+            req.generated.append(tok)
+            self._last[i] = tok
+            if req.first_token_t == 0.0:
+                req.first_token_t = time.perf_counter()
+            monitor.counter("serving.tokens").increase()
+            reason = self._finish_reason(req, tok)
+            if reason:
+                outs.append(self._finish(req, reason))
+        return outs
+
+    def _finish_reason(self, req: Request, tok: int) -> Optional[str]:
+        p = req.params
+        if p.eos_token_id is not None and tok == int(p.eos_token_id):
+            return "eos"
+        if len(req.generated) >= int(p.max_new_tokens):
+            return "length"
+        return None
+
+    def _clear_slot(self, req: Request):
+        i = req.slot
+        if i is not None:
+            self._bt[i] = 0
+            self._pos[i] = 0
+            self._last[i] = 0
+            self._slots[i] = None
+            req.slot = None
+        if req.pages:
+            self._alloc.free(req.pages)
+            req.pages = []
+
+    def _finish(self, req: Request, reason: str) -> Output:
+        req.finish_t = time.perf_counter()
+        req.state = FINISHED
+        req.finish_reason = reason
+        self._clear_slot(req)         # pages freed NOW, not end-of-call
+        # `requests` tracks LIVE requests only — retaining finished
+        # ones (full token lists) would grow without bound in a
+        # long-running serving process; the Output carries everything
+        self.requests.pop(req.req_id, None)
+        n = len(req.generated)
+        ttft_ms = (req.first_token_t - req.arrival_t) * 1e3
+        tpot_ms = ((req.finish_t - req.first_token_t)
+                   / (n - 1) * 1e3) if n > 1 else 0.0
+        monitor.gauge("serving.ttft_ms").set(ttft_ms)
+        if n > 1:
+            monitor.gauge("serving.tpot_ms").set(tpot_ms)
+        monitor.counter("serving.finished").increase()
+        return Output(req_id=req.req_id, prompt_ids=list(req.prompt),
+                      token_ids=list(req.generated),
+                      finish_reason=reason, ttft_ms=ttft_ms,
+                      tpot_ms=tpot_ms, preemptions=req.preemptions)
+
+    def _publish_gauges(self):
+        monitor.gauge("serving.slots_active").set(self.num_active)
+        monitor.gauge("serving.pages_free").set(self._alloc.free_pages)
+        monitor.gauge("serving.queue_depth").set(len(self._waiting))

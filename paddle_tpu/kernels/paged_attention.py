@@ -160,12 +160,16 @@ def _page_slots(block_tables, positions, s, bs):
         if pmax >= capacity:
             # take_along_axis would silently CLIP the page index and
             # overwrite the last page's slots — corrupting cached
-            # tokens; fail loudly instead (traced positions skip this
-            # concrete check; serving loops run it eagerly)
+            # tokens; fail loudly instead, naming the offending row
+            # (traced positions skip this concrete check; the engine's
+            # allocator raises the pool-exhaustion RuntimeError before
+            # a write can ever get here)
+            seq = int(jnp.argmax(positions))
             raise ValueError(
-                f"position {pmax} exceeds the sequence's block-table "
-                f"capacity {capacity} ({block_tables.shape[1]} pages x "
-                f"block_size {bs}) — grow the block table first")
+                f"position {pmax} (sequence {seq}) exceeds the "
+                f"block-table capacity {capacity} "
+                f"({block_tables.shape[1]} pages x block_size {bs}) — "
+                f"grow the block table / allocate more pages first")
     pos = positions[:, None] + jnp.arange(s, dtype=positions.dtype)[None]
     page = jnp.take_along_axis(block_tables, pos // bs, axis=1)  # [b, s]
     return page, pos % bs

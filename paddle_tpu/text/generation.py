@@ -44,6 +44,77 @@ def _bucketed(n: int) -> int:
     return -(-int(n) // CACHE_BUCKET) * CACHE_BUCKET
 
 
+def _model_forward(model, st, tokens, caches=None, index=None):
+    """One functional forward over the (possibly traced) state triple
+    ``st = (params, buffers, frozen)`` — the step primitive that
+    ``generate``, ``beam_search`` and the serving engine
+    (inference/engine.py) all build their compiled loops on. ``caches``
+    /``index`` ride through as the model's ``kv_caches``/``cache_index``
+    kwargs; ``index`` may be a scalar or a per-sequence [b] array (the
+    engine's continuous batches)."""
+    p, buf, frz = st
+    kwargs = {}
+    if caches is not None:
+        kwargs = {"kv_caches": caches, "cache_index": index}
+    out, _ = functional_call(model, p, buf, (tokens,), kwargs,
+                             frozen=frz, training=False)
+    return out
+
+
+def sample_token_arrays(logits, keys, temperature, top_k, top_p):
+    """Per-row token sampling with PER-ROW (traced) parameters — the
+    serving engine's sampler, where every slot carries its own request's
+    settings inside ONE fixed-shape executable.
+
+    logits [b, V] float; keys [b, 2] uint32 (raw jax.random key data);
+    temperature/top_p [b] float, top_k [b] int (0 = filter off).
+    Returns (tokens [b] int32, new_keys [b, 2]).
+
+    Row semantics mirror ``generate``'s pick_next exactly, so a request
+    decoded in any engine slot is token-identical to a b=1 ``generate``
+    with the same seed: temperature 0 = greedy and consumes NO rng (the
+    key passes through unchanged, like pick_next's untouched key);
+    top-k-only keeps threshold ties; a composed top-k+top-p uses the
+    rank rule and renormalizes within the top-k survivors before the
+    nucleus cut — the same two filter variants pick_next traces."""
+    V = logits.shape[-1]
+
+    def row(logit, key, temp, k, p):
+        logit = logit.astype(jnp.float32)
+        greedy = jnp.argmax(logit).astype(jnp.int32)
+        key2, sub = jax.random.split(key)
+        scaled = logit / jnp.maximum(temp, jnp.float32(1e-6))
+        k_on = k > 0
+        p_on = (p > 0.0) & (p < 1.0)
+        order = jnp.argsort(-scaled)
+        svals = scaled[order]
+        # pick_next's top-k-only rule: threshold at the k-th value
+        # (exact ties keep every tied token)
+        kth = svals[jnp.clip(k - 1, 0, V - 1)]
+        keep_thresh = jnp.where(k_on, scaled >= kth, True)
+        # pick_next's composed rule: rank < k, nucleus over the
+        # renormalized survivors (first survivor always kept)
+        keep_sorted = jnp.where(
+            k_on, jnp.arange(V, dtype=jnp.int32) < k, True)
+        probs = jax.nn.softmax(jnp.where(keep_sorted, svals, -jnp.inf))
+        csum = jnp.cumsum(probs)
+        keep_sorted &= jnp.where(p_on, (csum - probs) < p, True)
+        keep_rank = jnp.zeros((V,), bool).at[order].set(keep_sorted)
+        keep = jnp.where(p_on, keep_rank, keep_thresh)
+        filt = jnp.where(keep, scaled, -jnp.inf)
+        sampled = jax.random.categorical(
+            sub, filt[None, :], axis=-1)[0].astype(jnp.int32)
+        do_sample = temp > 0
+        tok = jnp.where(do_sample, sampled, greedy)
+        new_key = jnp.where(do_sample, key2, key)
+        return tok, new_key
+
+    return jax.vmap(row)(logits, keys,
+                         jnp.asarray(temperature, jnp.float32),
+                         jnp.asarray(top_k, jnp.int32),
+                         jnp.asarray(top_p, jnp.float32))
+
+
 def _resolve_cache_dtype(cache_dtype, params):
     """Resolve the cache_dtype knob to a concrete dtype. "auto" = the
     model's compute dtype: the params' floating dtype when it is
@@ -70,10 +141,10 @@ def _resolve_cache_dtype(cache_dtype, params):
     return dt
 
 
-def generate(model, input_ids, max_new_tokens: int,
+def generate(model, input_ids, max_new_tokens,
              temperature: float = 0.0, top_k: int = 0,
              top_p: float = 0.0,
-             eos_token_id: Optional[int] = None, seed: int = 0,
+             eos_token_id=None, seed: int = 0,
              use_cache: bool = True, cache_impl: str = "auto",
              page_size: int = 32, cache_dtype: str = "auto"):
     """Generate ``max_new_tokens`` continuations for ``input_ids``
@@ -113,11 +184,40 @@ def generate(model, input_ids, max_new_tokens: int,
     max_new_tokens is bucketed (multiples of 64) when shaping the
     compiled loop, so nearby lengths reuse one executable instead of
     retracing; the returned tensor is exactly
-    [B, S + max_new_tokens]."""
+    [B, S + max_new_tokens].
+
+    max_new_tokens and eos_token_id also accept PER-ROW arrays of
+    length B (per-request generation config — the serving engine's
+    contract, available on the one-shot path too): row r generates at
+    most max_new_tokens[r] tokens and freezes on eos_token_id[r]; past
+    its own budget a row emits its eos (or 0 when no eos is set). The
+    returned tensor is [B, S + max(max_new_tokens)]; the budgets ride
+    as traced arguments, so varying them reuses the same executable."""
     ids = np.asarray(unwrap(input_ids))
     b, s = ids.shape
-    total = s + _bucketed(max_new_tokens)
-    if max_new_tokens <= 0:
+    mx = np.asarray(unwrap(max_new_tokens))
+    if mx.ndim > 1 or (mx.ndim == 1 and mx.shape[0] != b):
+        raise ValueError(
+            f"max_new_tokens must be a scalar or a [batch] vector; got "
+            f"shape {mx.shape} for batch {b}")
+    eos_np = None if eos_token_id is None \
+        else np.asarray(unwrap(eos_token_id))
+    if eos_np is not None:
+        if eos_np.ndim == 0:
+            # normalize 0-dim arrays to a python int: the scalar path
+            # bakes eos into the hashed jit-cache sig
+            eos_token_id = int(eos_np)
+            eos_np = np.asarray(eos_token_id)
+        elif eos_np.ndim > 1 or eos_np.shape[0] != b:
+            raise ValueError(
+                f"eos_token_id must be a scalar or a [batch] vector; "
+                f"got shape {eos_np.shape} for batch {b}")
+    # per-row mode: budgets/eos ride as TRACED [b] vectors so the same
+    # executable serves any per-request config mix
+    per_row = mx.ndim == 1 or (eos_np is not None and eos_np.ndim == 1)
+    max_req = int(np.max(mx)) if mx.size else 0
+    total = s + _bucketed(max_req)
+    if max_req <= 0:
         return wrap(jnp.asarray(ids))
     if use_cache:
         import inspect
@@ -130,15 +230,10 @@ def generate(model, input_ids, max_new_tokens: int,
     params = get_params(model)
     buffers = get_buffers(model)
     frozen = get_frozen(model)
+    has_eos = eos_np is not None
 
     def fwd(st, tokens, caches=None, index=None):
-        p, buf, frz = st
-        kwargs = {}
-        if caches is not None:
-            kwargs = {"kv_caches": caches, "cache_index": index}
-        out, _ = functional_call(model, p, buf, (tokens,), kwargs,
-                                 frozen=frz, training=False)
-        return out
+        return _model_forward(model, st, tokens, caches, index)
 
     def pick_next(cur, done, key, dtype):
         cur = cur.astype(jnp.float32)
@@ -183,19 +278,36 @@ def generate(model, input_ids, max_new_tokens: int,
         else:
             nxt = jnp.argmax(cur, axis=-1)
         nxt = nxt.astype(dtype)
-        if eos_token_id is not None:
+        if has_eos and not per_row:
             pad = jnp.asarray(eos_token_id, dtype)
             nxt = jnp.where(done, pad, nxt)
             done = jnp.logical_or(done, nxt == pad)
         return nxt, done, key
 
-    def decode_padded(st, tokens, key):
+    def pick_next_rows(cur, done, key, dtype, g, mxv, padv):
+        """Per-row variant: sampling is pick_next's, then row r freezes
+        past its own budget (g > mxv[r], g = 1-based index of the token
+        being generated) or after its own eos; frozen rows emit padv[r]
+        (the row's eos, or 0 with no eos set)."""
+        nxt, _, key = pick_next(cur, done, key, dtype)
+        done = jnp.logical_or(done, g > mxv)
+        pad = padv.astype(dtype)
+        nxt = jnp.where(done, pad, nxt)
+        if has_eos:
+            done = jnp.logical_or(done, nxt == pad)
+        return nxt, done, key
+
+    def decode_padded(st, tokens, key, *extra):
         def step(carry, i):
             tokens, done, key = carry
             logits = fwd(st, tokens)                     # [B, L, V]
             cur = jax.lax.dynamic_index_in_dim(
                 jnp.swapaxes(logits, 0, 1), i - 1, 0, keepdims=False)
-            nxt, done, key = pick_next(cur, done, key, tokens.dtype)
+            if per_row:
+                nxt, done, key = pick_next_rows(
+                    cur, done, key, tokens.dtype, i - s + 1, *extra)
+            else:
+                nxt, done, key = pick_next(cur, done, key, tokens.dtype)
             tokens = jax.lax.dynamic_update_slice(
                 tokens, nxt[:, None], (jnp.int32(0), i))
             return (tokens, done, key), None
@@ -206,7 +318,7 @@ def generate(model, input_ids, max_new_tokens: int,
             jnp.arange(s, total, dtype=jnp.int32))
         return tokens
 
-    def decode_cached(st, tokens, key):
+    def decode_cached(st, tokens, key, *extra):
         cfg = model.config
         hkv = cfg.num_key_value_heads
         hd = cfg.hidden_size // cfg.num_attention_heads
@@ -225,9 +337,14 @@ def generate(model, input_ids, max_new_tokens: int,
             impl = "dense"   # window covers everything: dense == rolling
         if impl == "paged":
             # serving block-table layout: per-seq pages of `page_size`
-            # tokens from a global pool; ONE shared block table (the
-            # pool is sized exactly, so tables are just arange here —
-            # a real server hands out pages dynamically)
+            # tokens from a global pool. This one-shot pool is sized
+            # EXACTLY for the bucketed total, so the tables are a
+            # plain arange and exhaustion is impossible by
+            # construction; dynamic page accounting (free lists,
+            # watermarks, loud pool-exhaustion errors) lives in
+            # inference/allocator.PageAllocator under the serving
+            # engine, and an over-capacity write here fails loudly in
+            # _page_slots's capacity check
             bs_ = int(page_size)
             nblocks = -(-total // bs_)
             bt = jnp.arange(b * nblocks, dtype=jnp.int32).reshape(
@@ -264,8 +381,12 @@ def generate(model, input_ids, max_new_tokens: int,
         # prefill the prompt (writes cache slots [0, s))
         logits, caches = fwd(st, tokens[:, :s], caches, jnp.int32(0))
         done0 = jnp.zeros((b,), bool)
-        nxt, done, key = pick_next(logits[:, -1], done0, key,
-                                   tokens.dtype)
+        if per_row:
+            nxt, done, key = pick_next_rows(logits[:, -1], done0, key,
+                                            tokens.dtype, 1, *extra)
+        else:
+            nxt, done, key = pick_next(logits[:, -1], done0, key,
+                                       tokens.dtype)
         tokens = jax.lax.dynamic_update_slice(
             tokens, nxt[:, None], (jnp.int32(0), jnp.int32(s)))
 
@@ -274,8 +395,13 @@ def generate(model, input_ids, max_new_tokens: int,
             cur_tok = jax.lax.dynamic_slice(tokens, (jnp.int32(0), i),
                                             (b, 1))
             logits, caches = fwd(st, cur_tok, caches, i)
-            nxt, done, key = pick_next(logits[:, -1], done, key,
-                                       tokens.dtype)
+            if per_row:
+                nxt, done, key = pick_next_rows(
+                    logits[:, -1], done, key, tokens.dtype,
+                    i + 2 - s, *extra)
+            else:
+                nxt, done, key = pick_next(logits[:, -1], done, key,
+                                           tokens.dtype)
             tokens = jax.lax.dynamic_update_slice(
                 tokens, nxt[:, None], (jnp.int32(0), i + 1))
             return (tokens, caches, done, key), None
@@ -305,27 +431,38 @@ def generate(model, input_ids, max_new_tokens: int,
         if cfg is not None else ()
     # `total` is the BUCKETED length: every max_new_tokens in the same
     # 64-bucket maps to the same sig and reuses one compiled loop
-    # (tests assert steady_state_recompiles() == 0 across such calls)
+    # (tests assert steady_state_recompiles() == 0 across such calls).
+    # In per-row mode the budgets/eos ride as TRACED vectors, so the
+    # sig carries only the flags — any per-request mix shares one
+    # executable too.
+    eos_sig = ("per_row", has_eos) if per_row else eos_token_id
     sig = (use_cache, cache_impl, int(page_size), b, s, total,
            float(temperature), int(top_k),
-           float(top_p), eos_token_id, str(ids.dtype),
+           float(top_p), eos_sig, str(ids.dtype),
            str(_resolve_cache_dtype(cache_dtype, params)), cfg_key)
     per_model = _jit_cache.setdefault(model, {})
     fn = per_model.get(sig)
     if fn is None:
         fn = jax.jit(decode)
         per_model[sig] = fn
+    extra_dev = ()
+    if per_row:
+        padv = np.broadcast_to(
+            eos_np if has_eos else np.zeros((), ids.dtype), (b,))
+        extra_dev = (jnp.asarray(np.broadcast_to(mx, (b,)),
+                                 jnp.int32),
+                     jnp.asarray(padv.astype(ids.dtype)))
     # params AND buffers AND frozen params ride as jit arguments —
     # closure-captured state would bake the FIRST call's weights into
     # the cached executable (stale after set_state_dict on a frozen
     # model)
     with tape_mod.no_grad_guard():
-        out = fn((params, buffers, frozen), padded, key)
+        out = fn((params, buffers, frozen), padded, key, *extra_dev)
     # slice the bucket tail off HOST-side: a device-side slice would
     # compile one (tiny) executable per distinct max_new_tokens, which
     # is exactly the per-length churn the bucketing removes — and every
     # generate caller fetches the tokens next anyway
-    return wrap(jnp.asarray(np.asarray(out)[:, :s + int(max_new_tokens)]))
+    return wrap(jnp.asarray(np.asarray(out)[:, :s + max_req]))
 
 
 def beam_search(model, input_ids, max_new_tokens: int, num_beams: int = 4,
@@ -363,12 +500,7 @@ def beam_search(model, input_ids, max_new_tokens: int, num_beams: int = 4,
     NEG = jnp.float32(-1e30)
 
     def fwd(st, tokens, caches, index):
-        p, buf, frz = st
-        out, _ = functional_call(
-            model, p, buf, (tokens,),
-            {"kv_caches": caches, "cache_index": index},
-            frozen=frz, training=False)
-        return out
+        return _model_forward(model, st, tokens, caches, index)
 
     def decode(st, prompt):
         hkv = cfg.num_key_value_heads

@@ -41,7 +41,9 @@ NEG_INF_ATTN = -1e30
 def _attend_cache(qa, kk, vv, mask, rep):
     """Shared decode-attention core: masked softmax of qa against the
     (kv-shaped) cache keys/values, GQA heads repeated. qa [b, s, h, d];
-    kk/vv [b, L, h_kv, d]; mask [s, L].
+    kk/vv [b, L, h_kv, d]; mask [s, L] shared across the batch, or
+    [b, s, L] when sequences sit at different positions (the serving
+    engine's continuous batches).
 
     Decode attention is HBM-bandwidth bound, so a half-precision cache
     stays half-precision INTO the dots (MXU-native bf16 operands) with
@@ -58,7 +60,8 @@ def _attend_cache(qa, kk, vv, mask, rep):
     logits = jnp.einsum("bshd,bLhd->bhsL", qa.astype(cdt),
                         kk.astype(cdt),
                         preferred_element_type=jnp.float32) * scale
-    logits = jnp.where(mask[None, None], logits, NEG_INF_ATTN)
+    mexp = mask[None, None] if mask.ndim == 2 else mask[:, None]
+    logits = jnp.where(mexp, logits, NEG_INF_ATTN)
     p = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bhsL,bLhd->bshd", p.astype(cdt), vv.astype(cdt),
                       preferred_element_type=jnp.float32).astype(qa.dtype)
@@ -204,9 +207,12 @@ class LlamaAttention(Layer):
                                     self.head_dim])
         if kv_cache is not None and position_ids is None:
             # decode: rope positions continue from the cache write offset
+            # (a scalar for one-shot generate; [b] per-slot offsets for
+            # the serving engine's continuous batches)
+            idx = jnp.asarray(cache_index, jnp.int32)
             position_ids = wrap(jnp.broadcast_to(
                 jnp.arange(s, dtype=jnp.int32)[None, :]
-                + jnp.asarray(cache_index, jnp.int32), (b, s)))
+                + jnp.reshape(idx, (-1, 1)), (b, s)))
         q, k, _ = fused_rotary_position_embedding(
             q, k, None, position_ids=position_ids,
             use_neox_rotary_style=True)
@@ -339,6 +345,9 @@ class LlamaAttention(Layer):
         pos % block_size; attention gathers the sequence's pages with
         ONE XLA gather and applies the same causal(+window) band as the
         dense cache — numerics identical, memory allocated page-wise.
+        ``cache_index`` may be per-sequence ([b]) — the layout the
+        serving engine (inference/engine.py) drives, where every slot
+        sits at a different position in its own block-table row.
         A 5-tuple cache carries int8 pools + per-slot scale pools; the
         Pallas kernel dequantizes in VMEM so int8 pages stream at a
         quarter of the f32 bytes."""
@@ -363,8 +372,11 @@ class LlamaAttention(Layer):
                 ks = vs = None
             b, s = qa.shape[0], qa.shape[1]
             _, hkv, bs_, d = kc.shape       # head-major page pool
+            # cache_index may be a scalar (one-shot generate: every row
+            # at the same offset) or [b] (serving engine: each slot at
+            # its own position) — everything below is per-sequence
             idx = idx.astype(jnp.int32)
-            pos0 = jnp.full((b,), idx, jnp.int32)
+            pos0 = jnp.broadcast_to(jnp.atleast_1d(idx), (b,))
             if quant:
                 kc, vc, ks, vs = paged_write_quant_arrays(
                     ka, va, kc, vc, ks, vs, bt, pos0)
@@ -388,8 +400,7 @@ class LlamaAttention(Layer):
                     and paged_pallas_eligible(d, bs_, kc.dtype)):
                 try:
                     out = paged_decode_pallas(
-                        qa[:, 0], kc, vc, bt,
-                        jnp.full((b,), idx + 1, jnp.int32),
+                        qa[:, 0], kc, vc, bt, pos0 + 1,
                         window=window, k_scale=ks, v_scale=vs)
                     monitor.counter(
                         "kernels.decode.paged_pallas").increase()
@@ -407,11 +418,11 @@ class LlamaAttention(Layer):
                     * gather_page_scales(ks, bt)[..., None]
                 vv = vv.astype(jnp.float32) \
                     * gather_page_scales(vs, bt)[..., None]
-            q_pos = idx + jnp.arange(s, dtype=jnp.int32)
+            q_pos = pos0[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
             k_pos = jnp.arange(L, dtype=jnp.int32)
-            mask = k_pos[None, :] <= q_pos[:, None]        # [s, L]
+            mask = k_pos[None, None, :] <= q_pos[:, :, None]  # [b, s, L]
             if window is not None:
-                mask &= (q_pos[:, None] - k_pos[None, :]) < window
+                mask &= (q_pos[:, :, None] - k_pos[None, None, :]) < window
             out = _attend_cache(qa, kk, vv, mask, rep)
             return done(out)
 

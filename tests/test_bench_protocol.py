@@ -39,6 +39,8 @@ def stubbed(monkeypatch):
     monkeypatch.setattr(bench, "bench_resnet50", lambda: 2500.0)
     monkeypatch.setattr(bench, "bench_llama_decode",
                         lambda **kw: 900.0)
+    monkeypatch.setattr(bench, "bench_llama_serving",
+                        lambda **kw: 1200.0)
     monkeypatch.setattr(bench, "bench_flashmask_8k", lambda: 9.0)
     return monkeypatch
 
@@ -59,7 +61,8 @@ def test_headline_prints_first_and_extras_append(stubbed, capsys,
                 "lenet_train_steps_per_sec_b256",
                 "bert_base_tokens_per_sec", "ernie_moe_tokens_per_sec",
                 "resnet50_images_per_sec",
-                "llama_1b_decode_tokens_per_sec"]:
+                "llama_1b_decode_tokens_per_sec",
+                "llama_1b_serving_tokens_per_sec"]:
         assert key in last, key
     assert "skipped" not in last
 
@@ -74,7 +77,8 @@ def test_budget_skips_extras_but_headline_survives(stubbed, capsys,
         "llama_seq2048", "llama_small_seq512", "lenet", "bert_base",
         "ernie_moe", "resnet50", "llama_decode", "llama_decode_bf16kv",
         "llama_decode_int8kv", "llama_decode_int8",
-        "llama_decode_paged", "llama_decode_rolling", "flashmask_8k"}
+        "llama_decode_paged", "llama_decode_rolling", "llama_serving",
+        "flashmask_8k"}
     assert "llama_seq2048_mfu" not in lines[-1]["extras"]
 
 

@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""serving_replay — replay a JSONL arrival trace against the engine.
+
+Run: python tools/serving_replay.py trace.jsonl [--max-slots 4]
+         [--page-size 8] [--pool-pages 64] [--layers 2] [--hidden 64]
+         [--heads 4] [--vocab 64] [--seed 0] [--step-ms 5]
+         [--temperature 0] [--cache-dtype auto] [--json]
+
+Each trace line is one request:
+
+    {"arrival_ms": 0, "prompt_len": 7, "new_tokens": 9}
+
+The tool builds a tiny in-memory LLaMA on the CPU backend (geometry
+from the flags — this measures the SCHEDULER, not the model), drives
+``paddle_tpu.inference.Engine`` on a virtual clock that advances
+``--step-ms`` per engine step (deterministic: the same trace always
+yields the same admission schedule and the same percentiles), and
+prints TTFT / TPOT / throughput percentiles plus the decode-path and
+``serving.*`` counters (docs/OBSERVABILITY.md) — the first thing to
+read when a serving number regresses is whether the compiled loop left
+the expected attention path or started recompiling.
+
+A tiny fixture trace lives at tests/fixtures/serving_trace.jsonl.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _percentiles(vals):
+    import numpy as np
+    if not vals:
+        return {"p50": 0.0, "p90": 0.0, "p99": 0.0}
+    return {p: round(float(np.percentile(vals, q)), 2)
+            for p, q in (("p50", 50), ("p90", 90), ("p99", 99))}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="serving_replay",
+                                 description=__doc__)
+    ap.add_argument("trace", help="JSONL arrival trace")
+    ap.add_argument("--max-slots", type=int, default=4)
+    ap.add_argument("--page-size", type=int, default=8)
+    ap.add_argument("--pool-pages", type=int, default=64)
+    ap.add_argument("--prefill-bucket", type=int, default=16)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--step-ms", type=float, default=5.0,
+                    help="virtual clock advance per engine step")
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--cache-dtype", default="auto")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-readable JSON line instead "
+                         "of the text report")
+    args = ap.parse_args(argv)
+
+    if not os.path.exists(args.trace):
+        print(f"serving_replay: no such trace: {args.trace}",
+              file=sys.stderr)
+        return 2
+    trace = []
+    with open(args.trace) as fh:
+        for ln in fh:
+            ln = ln.strip()
+            if ln:
+                trace.append(json.loads(ln))
+    trace.sort(key=lambda r: r["arrival_ms"])
+    if not trace:
+        print("serving_replay: empty trace", file=sys.stderr)
+        return 2
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # runnable straight from a checkout: tools/ is sys.path[0], the
+    # package root is one level up
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import monitor
+    from paddle_tpu.inference.engine import Engine, SamplingParams
+    from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(args.seed)
+    max_ctx = max(r["prompt_len"] + r["new_tokens"] for r in trace)
+    cfg = LlamaConfig.tiny(vocab=args.vocab, hidden=args.hidden,
+                           layers=args.layers, heads=args.heads)
+    cfg.max_position_embeddings = max(cfg.max_position_embeddings,
+                                      max_ctx)
+    cfg.use_flash_attention = False
+    net = LlamaForCausalLM(cfg)
+    net.eval()
+    eng = Engine(net, max_slots=args.max_slots,
+                 page_size=args.page_size, pool_pages=args.pool_pages,
+                 prefill_bucket=args.prefill_bucket,
+                 cache_dtype=args.cache_dtype, max_context=max_ctx)
+
+    rng = np.random.default_rng(args.seed)
+    prompts = [rng.integers(0, args.vocab,
+                            (r["prompt_len"],)).astype(np.int64)
+               for r in trace]
+    before = monitor.snapshot()
+    vt = 0.0                       # virtual clock, ms
+    arrival_vt = {}
+    first_vt = {}
+    finish = {}
+    i = 0
+    t0 = time.perf_counter()
+    steps = 0
+    while len(finish) < len(trace):
+        while i < len(trace) and trace[i]["arrival_ms"] <= vt:
+            rid = eng.add_request(
+                prompts[i],
+                SamplingParams(max_new_tokens=trace[i]["new_tokens"],
+                               temperature=args.temperature,
+                               seed=args.seed + i))
+            arrival_vt[rid] = trace[i]["arrival_ms"]
+            i += 1
+        if i < len(trace) and eng.num_active == 0 \
+                and eng.num_waiting == 0:
+            # idle gap: fast-forward to the next arrival
+            vt = max(vt, float(trace[i]["arrival_ms"]))
+            continue
+        for out in eng.step():
+            finish[out.req_id] = (out, vt + args.step_ms)
+            # a request can finish the same tick it got its first
+            # token (max_new_tokens=1) — the engine prunes finished
+            # requests, so record its TTFT here
+            first_vt.setdefault(out.req_id, vt + args.step_ms)
+        steps += 1
+        vt += args.step_ms
+        # eng.requests holds only LIVE requests (waiting/active)
+        for rid, req in eng.requests.items():
+            if rid not in first_vt and req.generated:
+                first_vt[rid] = vt
+        if steps > 100_000:
+            print("serving_replay: engine did not drain",
+                  file=sys.stderr)
+            return 3
+    wall_s = time.perf_counter() - t0
+    after = monitor.snapshot()
+
+    ttft = [first_vt[r] - arrival_vt[r] for r in sorted(first_vt)]
+    tpot = []
+    total_tokens = 0
+    preempts = 0
+    for rid, (out, end_vt) in sorted(finish.items()):
+        n = len(out.token_ids)
+        total_tokens += n
+        preempts += out.preemptions
+        if n > 1:
+            tpot.append((end_vt - first_vt[rid]) / (n - 1))
+    deltas = {k: int(after.get(k, 0)) - int(before.get(k, 0))
+              for k in after
+              if k.startswith(("kernels.decode.", "kernels.flash.",
+                               "serving.preemptions", "xla.compiles"))
+              and int(after.get(k, 0)) - int(before.get(k, 0))}
+    report = {
+        "requests": len(trace),
+        "steps": steps,
+        "total_tokens": total_tokens,
+        "wall_s": round(wall_s, 3),
+        "tokens_per_sec": round(total_tokens / max(wall_s, 1e-9), 1),
+        "preemptions": preempts,
+        "ttft_ms": _percentiles(ttft),
+        "tpot_ms": _percentiles(tpot),
+        "counters": deltas,
+        "steady_state_recompiles": eng.steady_state_recompiles(),
+    }
+    if args.json:
+        print(json.dumps(report))
+        return 0
+    print(f"replayed {report['requests']} requests / "
+          f"{report['total_tokens']} tokens in {report['steps']} steps "
+          f"({report['wall_s']}s wall) — "
+          f"{report['tokens_per_sec']} tokens_per_sec")
+    for name in ("ttft_ms", "tpot_ms"):
+        ps = report[name]
+        print(f"  {name:8s} p50 {ps['p50']:8.2f}  p90 {ps['p90']:8.2f}"
+              f"  p99 {ps['p99']:8.2f}   (virtual clock)")
+    print(f"  preemptions {report['preemptions']}  "
+          f"steady_state_recompiles "
+          f"{report['steady_state_recompiles']}")
+    for k in sorted(report["counters"]):
+        print(f"  {k} +{report['counters'][k]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
