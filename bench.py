@@ -369,7 +369,7 @@ def bench_llama_decode(batch=32, prompt=128, new_tokens=256,
 
 def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
                         prompt_hi=192, new_tokens=128,
-                        arrival_rate_hz=40.0):
+                        arrival_rate_hz=40.0, cache_dtype="auto"):
     """Continuous-batching serving throughput on the 1B model
     (paddle_tpu.inference.Engine over the paged KV stack,
     docs/SERVING.md): a fixed-seed Poisson-ish arrival trace
@@ -408,9 +408,11 @@ def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
     # back inside the timed region. A drained engine is reusable —
     # all pages free, all slots empty.
     # page_size 128 keeps the [page, head_dim] tiles Pallas-eligible
-    # for bf16 KV (docs/DECODE.md)
+    # for every cache_dtype (docs/DECODE.md); cache_dtype="int8"
+    # serves quantized KV pools dequantized inside the decode kernel
     eng = Engine(net, max_slots=max_slots, page_size=128,
-                 prefill_bucket=64, max_context=prompt_hi + new_tokens)
+                 prefill_bucket=64, max_context=prompt_hi + new_tokens,
+                 cache_dtype=cache_dtype)
 
     def run_trace():
         t0 = time.perf_counter()
@@ -644,6 +646,17 @@ def main():
         result["extras"]["llama_1b_decode_paged_tokens_per_sec"] = \
             round(tok, 1)
 
+    def add_decode_paged_int8():
+        # int8 KV pools through the paged layout: pages stream at a
+        # quarter of the f32 bytes, dequantized in-VMEM by the
+        # multi-sequence decode kernel
+        tok = _record_decode_path(
+            "decode_paged_int8",
+            lambda: bench_llama_decode(cache_impl="paged",
+                                       cache_dtype="int8"))
+        result["extras"]["llama_1b_decode_paged_int8_tokens_per_sec"] = \
+            round(tok, 1)
+
     def add_decode_window():
         # sliding_window 128 < total 384: the rolling O(window) buffer
         tok = _record_decode_path(
@@ -654,6 +667,16 @@ def main():
     def add_serving():
         tok = _record_decode_path("serving", bench_llama_serving)
         result["extras"]["llama_1b_serving_tokens_per_sec"] = \
+            round(tok, 1)
+
+    def add_serving_int8kv():
+        # the engine bench finally exercises int8-KV: same arrival
+        # trace, quantized page pools end to end (per-slot scale pools
+        # consumed inside the decode executable)
+        tok = _record_decode_path(
+            "serving_int8kv",
+            lambda: bench_llama_serving(cache_dtype="int8"))
+        result["extras"]["llama_1b_serving_int8kv_tokens_per_sec"] = \
             round(tok, 1)
 
     def add_flashmask():
@@ -678,8 +701,10 @@ def main():
         ("llama_decode_int8kv", add_decode_int8kv, 240),
         ("llama_decode_int8", add_decode_int8, 240),
         ("llama_decode_paged", add_decode_paged, 240),
+        ("llama_decode_paged_int8", add_decode_paged_int8, 240),
         ("llama_decode_rolling", add_decode_window, 240),
         ("llama_serving", add_serving, 300),
+        ("llama_serving_int8kv", add_serving_int8kv, 300),
         ("flashmask_8k", add_flashmask, 90),
     ]
     skipped = []

@@ -28,10 +28,16 @@ Design (docs/SERVING.md has the full lifecycle):
 * Exactly TWO compiled step families, so steady-state recompiles are
   zero under any arrival mix: length-bucketed prefill executables
   (prompt padded to a ``prefill_bucket`` multiple, ``paged_write`` of
-  the prompt KV, first token sampled) and ONE ``[max_slots]`` decode
-  executable (single-token step through the paged attention stack —
-  the Pallas kernel on TPU — with per-slot sampling params as traced
-  arrays). ``steady_state_recompiles()`` reads 0 after warmup.
+  the prompt KV, first token sampled) and the FUSED ``[max_slots]``
+  decode step (single-token forward through the paged attention stack
+  — the multi-sequence Pallas kernel on TPU — plus per-slot sampling,
+  all in one executable over DEVICE-RESIDENT state: last tokens,
+  cache positions, sampling params and rng keys stay on device
+  between ticks, advanced in-graph; the host fetches only the emitted
+  tokens and uploads only scheduler-touched slot rows. Three static
+  sampler variants — all-greedy argmax, no-filter, full-filter —
+  each compiled once). ``steady_state_recompiles()`` reads 0 after
+  warmup.
 * Token-exactness: a request decoded through the engine emits the
   SAME tokens as a ``batch=1 text.generate`` with the same seed —
   the sampler (generation.sample_token_arrays) mirrors pick_next's
@@ -43,11 +49,14 @@ Design (docs/SERVING.md has the full lifecycle):
 ``serving.slots_active`` / ``serving.pages_free`` /
 ``serving.queue_depth`` / ``serving.ttft_ms`` / ``serving.tpot_ms``,
 counters ``serving.requests`` / ``serving.tokens`` /
-``serving.finished`` / ``serving.preemptions`` / ``serving.steps``.
+``serving.finished`` / ``serving.preemptions`` / ``serving.steps`` /
+``serving.decode_fallback`` (engine built with a Pallas-ineligible
+page geometry — validated ONCE at construction, docs/DECODE.md).
 """
 from __future__ import annotations
 
 import time
+import warnings
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
@@ -60,6 +69,7 @@ from .. import monitor
 from ..core import tape as tape_mod
 from ..core.dispatch import unwrap
 from ..jit.functional import get_buffers, get_frozen, get_params
+from ..kernels.paged_attention import paged_pallas_requirements
 from ..profiler.stats import CompileTracker
 from ..text.generation import (_model_forward, _resolve_cache_dtype,
                                sample_token_arrays)
@@ -137,6 +147,21 @@ class Request:
 
 def _ceil_div(a: int, b: int) -> int:
     return -(-int(a) // int(b))
+
+
+@jax.jit
+def _merge_rows(dev, host, mask):
+    """Fold host-updated slot rows (admissions, preemptions, finishes)
+    into the device-resident decode state: row i comes from ``host``
+    where ``mask[i]`` (the scheduler touched the slot since the last
+    decode step), else from the state the last decode executable
+    produced. ONE fixed-shape executable whatever the number of dirty
+    slots — a per-index scatter would compile a fresh tiny program per
+    dirty-set shape and show up as steady-state recompiles."""
+    def pick(d, h):
+        m = mask.reshape((-1,) + (1,) * (d.ndim - 1))
+        return jnp.where(m, h.astype(d.dtype), d)
+    return jax.tree_util.tree_map(pick, dev, host)
 
 
 class Engine:
@@ -219,6 +244,21 @@ class Engine:
         self._topks = np.zeros((S,), np.int32)
         self._topps = np.zeros((S,), np.float32)
         self._keys = np.zeros((S, 2), np.uint32)
+        self._live = np.zeros((S,), np.int32)
+        # the decode state — (last, pos, temps, topks, topps, keys,
+        # live) — LIVES ON DEVICE between ticks: the fused decode
+        # executable advances it in place (donated), so a steady-state
+        # tick ships nothing host→device and fetches only the emitted
+        # tokens. The numpy mirrors above are the scheduler's view;
+        # rows the scheduler touches are marked dirty and merged in
+        # before the next decode step (_flush_state).
+        self._dev = (jnp.asarray(self._last), jnp.asarray(self._pos),
+                     jnp.asarray(self._temps), jnp.asarray(self._topks),
+                     jnp.asarray(self._topps), jnp.asarray(self._keys),
+                     jnp.asarray(self._live))
+        self._dirty: set = set()
+        self._bt_dev = jnp.asarray(self._bt)
+        self._bt_dirty = False
         self._slots: List[Optional[Request]] = [None] * S
         self._waiting: "deque[Request]" = deque()
         self.requests: Dict[int, Request] = {}
@@ -229,8 +269,26 @@ class Engine:
         self._compiles = 0        # compiles inside OUR step() calls
         self._warm_compiles = 0
         self._prefill_fns: Dict[int, object] = {}
-        self._decode_fns: Dict[bool, object] = {}
+        self._decode_fns: Dict[str, object] = {}
         self._tracker = CompileTracker().start()
+        # Pallas paged-decode eligibility is a STATIC property of
+        # (head_dim, page_size, cache_dtype) — validate it once here
+        # instead of letting every decode step silently gather: an
+        # ineligible geometry on a TPU backend costs a full-cache copy
+        # per token and previously only showed up as slow numbers.
+        self.decode_fallback_reason = paged_pallas_requirements(
+            hd, self.page_size, self.cache_dtype)
+        self.pallas_eligible = self.decode_fallback_reason is None
+        if not self.pallas_eligible:
+            monitor.counter("serving.decode_fallback").increase()
+            if jax.default_backend() in ("tpu", "axon"):
+                warnings.warn(
+                    f"Engine decode steps will take the XLA gather "
+                    f"path (full-cache copy per token): "
+                    f"{self.decode_fallback_reason}. Pick a page_size/"
+                    f"cache_dtype from docs/DECODE.md's eligibility "
+                    f"table to serve on the Pallas kernel.",
+                    RuntimeWarning, stacklevel=2)
 
     # -- compiled step shapes ------------------------------------------------
 
@@ -246,38 +304,64 @@ class Engine:
     def _strip_bt(self, kv):
         return [(t[0], t[1]) + tuple(t[3:]) for t in kv]
 
-    def _get_decode_fn(self, greedy: bool):
-        """The [max_slots] decode executable — keyed STATICALLY on
-        whether any active slot samples: the all-greedy hot loop (the
-        common serving default) is a plain argmax, while a single
-        sampling request switches to the per-slot sampler (full-vocab
-        argsort per slot — work XLA can't dead-code out when
-        temperature rides as a traced array). Two variants, both
-        compiled once: still a fixed executable set."""
-        fn = self._decode_fns.get(greedy)
+    def _get_decode_fn(self, variant: str):
+        """The fused [max_slots] decode executable — ONE compiled step
+        that consumes the device-resident state (last tokens, cache
+        positions, per-slot sampling params, rng keys), runs the model
+        forward, samples every slot's next token IN-GRAPH, and returns
+        the advanced state. The host fetches only the emitted tokens;
+        nothing else crosses per tick.
+
+        Keyed STATICALLY on the cheapest sampler the active slots
+        need — three variants, each compiled once, so any greedy/
+        sampled arrival mix bounces between fixed executables with
+        zero steady-state recompiles:
+
+        * ``"greedy"``  — every active slot at temperature 0: plain
+          argmax, no rng consumed (keys pass through untouched,
+          pick_next semantics).
+        * ``"plain"``   — sampling slots but NO top-k/top-p anywhere:
+          the no-filter sampler (``use_filters=False``) skips the
+          full-vocab argsort the traced filters would force. Greedy
+          rows ride inside it unchanged, so mixed greedy+temperature
+          traffic collapses onto this one executable.
+        * ``"filtered"`` — some slot filters: the full per-slot
+          argsort sampler (work XLA can't dead-code out when top_k/
+          top_p ride as traced arrays).
+        """
+        fn = self._decode_fns.get(variant)
         if fn is not None:
             return fn
         model = self.model
 
-        def body(st, caches, bt, tokens, positions, temps, topks,
-                 topps, keys):
+        def body(st, caches, bt, state):
+            last, pos, temps, topks, topps, keys, live = state
             kv = self._inject_bt(caches, bt)
-            logits, new_kv = _model_forward(model, st, tokens, kv,
-                                            positions)
-            last = logits[:, -1].astype(jnp.float32)
-            if greedy:
-                # greedy consumes no rng (pick_next semantics): keys
-                # pass through untouched, exactly like the sampler's
-                # temp==0 rows
-                nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            # idle lanes ride at cache_index -1: their context_lens
+            # (pos + 1) is then 0, so the multi-sequence decode kernel
+            # treats them as DEAD slots — no page DMA, no compute —
+            # and their scratch write clips into page 0. Only live
+            # lanes advance their position; an idle lane's pos must
+            # not drift upward tick over tick (it would re-enter the
+            # kernel as a growing fake context and stream scratch
+            # pages forever).
+            idx = jnp.where(live > 0, pos, -jnp.ones_like(pos))
+            logits, new_kv = _model_forward(model, st, last[:, None],
+                                            kv, idx)
+            cur = logits[:, -1].astype(jnp.float32)
+            if variant == "greedy":
+                nxt = jnp.argmax(cur, axis=-1).astype(jnp.int32)
                 keys2 = keys
             else:
-                nxt, keys2 = sample_token_arrays(last, keys, temps,
-                                                 topks, topps)
-            return nxt, keys2, self._strip_bt(new_kv)
+                nxt, keys2 = sample_token_arrays(
+                    cur, keys, temps, topks, topps,
+                    use_filters=variant == "filtered")
+            state2 = (nxt, pos + live, temps, topks, topps, keys2,
+                      live)
+            return nxt, state2, self._strip_bt(new_kv)
 
-        fn = jax.jit(body, donate_argnums=(1,))
-        self._decode_fns[greedy] = fn
+        fn = jax.jit(body, donate_argnums=(1, 3))
+        self._decode_fns[variant] = fn
         self._last_compile_step = self._steps
         return fn
 
@@ -524,6 +608,9 @@ class Engine:
         self._topks[i] = req.params.top_k
         self._topps[i] = req.params.top_p
         self._keys[i] = req.key
+        self._live[i] = 1
+        self._dirty.add(i)
+        self._bt_dirty = True
         req.state = DECODE
 
     def _ensure_pages(self):
@@ -540,6 +627,7 @@ class Engine:
                     break
                 req.pages.extend(page)
                 self._bt[i, :len(req.pages)] = req.pages
+                self._bt_dirty = True
 
     def _alloc_or_preempt(self, req: Request):
         while True:
@@ -560,9 +648,39 @@ class Engine:
         and RNG chain kept — a resume prefill rebuilds the cache."""
         monitor.counter("serving.preemptions").increase()
         req.preemptions += 1
+        i = req.slot
+        if i is not None and i not in self._dirty:
+            # the RNG chain lives device-side between decode steps;
+            # pull this slot's key down so the resumed request
+            # continues it exactly. (A dirty slot was just activated —
+            # req.key is already the freshest value. Fetch the whole
+            # array, slice host-side: a device-side row gather would
+            # compile a tiny executable per slot index.)
+            req.key = np.asarray(self._dev[5])[i].astype(np.uint32)
+            self._keys[i] = req.key
         self._clear_slot(req)
         req.state = PREEMPTED
         self._waiting.appendleft(req)
+
+    def _flush_state(self) -> None:
+        """Host→device sync of the slot rows the scheduler touched
+        since the last decode step (admissions, preemptions,
+        finishes) plus the block table when a sequence crossed a page
+        boundary. A steady-state decode tick — no scheduling events,
+        no page growth — uploads NOTHING."""
+        if self._dirty:
+            mask = np.zeros((self.max_slots,), bool)
+            mask[list(self._dirty)] = True
+            host = (jnp.asarray(self._last), jnp.asarray(self._pos),
+                    jnp.asarray(self._temps),
+                    jnp.asarray(self._topks),
+                    jnp.asarray(self._topps), jnp.asarray(self._keys),
+                    jnp.asarray(self._live))
+            self._dev = _merge_rows(self._dev, host, jnp.asarray(mask))
+            self._dirty.clear()
+        if self._bt_dirty:
+            self._bt_dev = jnp.asarray(self._bt)
+            self._bt_dirty = False
 
     def _decode(self) -> List[Output]:
         active = [i for i in range(self.max_slots)
@@ -570,22 +688,29 @@ class Engine:
                   and self._slots[i].state == DECODE]
         if not active:
             return []
-        greedy = all(self._temps[i] == 0.0 for i in active)
-        fn = self._get_decode_fn(greedy)
-        nxt, keys2, self._pools = fn(
-            self._st, self._pools, jnp.asarray(self._bt),
-            jnp.asarray(self._last[:, None]), jnp.asarray(self._pos),
-            jnp.asarray(self._temps), jnp.asarray(self._topks),
-            jnp.asarray(self._topps), jnp.asarray(self._keys))
+        sampling = [i for i in active if self._temps[i] > 0.0]
+        if not sampling:
+            variant = "greedy"
+        elif any(self._topks[i] > 0 or 0.0 < self._topps[i] < 1.0
+                 for i in sampling):
+            variant = "filtered"
+        else:
+            variant = "plain"
+        fn = self._get_decode_fn(variant)
+        self._flush_state()
+        # the fused step: forward + per-slot sampling + state advance
+        # in ONE executable; only the emitted tokens come back
+        nxt, self._dev, self._pools = fn(self._st, self._pools,
+                                         self._bt_dev, self._dev)
         nxt = np.asarray(nxt)
-        keys2 = np.asarray(keys2).astype(np.uint32)
         outs: List[Output] = []
         for i in active:
             req = self._slots[i]
             tok = int(nxt[i])
-            req.key = keys2[i]
-            self._keys[i] = keys2[i]
             req.written += 1          # the step wrote last_token
+            # mirror the device-side advance (NOT marked dirty: the
+            # device already holds these values; the mirrors keep the
+            # scheduler's view coherent for later dirty merges)
             self._pos[i] = req.written
             req.generated.append(tok)
             self._last[i] = tok
@@ -611,7 +736,13 @@ class Engine:
             self._bt[i] = 0
             self._pos[i] = 0
             self._last[i] = 0
+            self._temps[i] = 0.0
+            self._topks[i] = 0
+            self._topps[i] = 0.0
+            self._live[i] = 0
             self._slots[i] = None
+            self._dirty.add(i)
+            self._bt_dirty = True
             req.slot = None
         if req.pages:
             self._alloc.free(req.pages)

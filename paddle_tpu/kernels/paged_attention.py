@@ -20,6 +20,7 @@ GQA/MQA: caches carry ``h_kv`` heads; query heads map to kv head
 """
 from __future__ import annotations
 
+import logging
 import math
 from typing import Optional
 
@@ -30,20 +31,55 @@ from ..core.dispatch import run_op
 
 NEG_INF = -1e30
 
+logger = logging.getLogger(__name__)
+
+
+def paged_pallas_requirements(head_dim, block_size, cache_dtype):
+    """Which Pallas-eligibility constraint a page-pool geometry misses,
+    as a human-readable string — or None when the geometry is eligible.
+    The [block_size, head_dim] page tile must meet the dtype's minimum
+    (sublane, lane) tile: (8, 128) f32, (16, 128) bf16/f16,
+    (32, 128) int8 (docs/DECODE.md eligibility table)."""
+    name = jnp.dtype(cache_dtype).name
+    sublane = {"int8": 32, "bfloat16": 16, "float16": 16}.get(name, 8)
+    problems = []
+    if head_dim % 128:
+        problems.append(
+            f"head_dim {head_dim} is not a multiple of the 128 lane width")
+    if block_size % sublane:
+        problems.append(
+            f"page_size {block_size} is not a multiple of the {name} "
+            f"sublane minimum {sublane}")
+    return "; ".join(problems) if problems else None
+
 
 def paged_pallas_eligible(head_dim, block_size, cache_dtype):
     """Static eligibility of the Pallas decode kernel for a page-pool
-    geometry: the [block_size, head_dim] page tile must meet the dtype's
-    minimum (sublane, lane) tile — (8, 128) f32, (16, 128) bf16/f16,
-    (32, 128) int8. The caller falls back to the XLA gather path (and
-    bumps the `kernels.decode.paged_xla_*` counter) when this is False,
-    so a bench line showing the gather path names the constraint that
-    was missed."""
-    if head_dim % 128:
-        return False
-    name = jnp.dtype(cache_dtype).name
-    sublane = {"int8": 32, "bfloat16": 16, "float16": 16}.get(name, 8)
-    return block_size % sublane == 0
+    geometry (see paged_pallas_requirements for the constraint names).
+    The caller falls back to the XLA gather path (and bumps the
+    `kernels.decode.paged_xla_*` counter) when this is False, so a
+    bench line showing the gather path names the constraint that was
+    missed."""
+    return paged_pallas_requirements(head_dim, block_size,
+                                     cache_dtype) is None
+
+
+_ineligible_warned = set()
+
+
+def log_paged_ineligible(head_dim, block_size, cache_dtype,
+                         site="decode"):
+    """Trace-time note for a paged decode step that cannot take the
+    Pallas kernel: the `kernels.decode.paged_xla_gather_step` counter
+    records THAT it fell back; this names WHY, once per geometry, so a
+    slow serving run points straight at the violated constraint."""
+    why = paged_pallas_requirements(head_dim, block_size, cache_dtype)
+    if why and (site, why) not in _ineligible_warned:
+        _ineligible_warned.add((site, why))
+        logger.warning(
+            "paged %s step falling back to the XLA gather path: %s "
+            "(docs/DECODE.md eligibility table)", site, why)
+    return why
 
 
 def paged_attention_arrays(q, k_cache, v_cache, block_tables, context_lens,
@@ -198,112 +234,245 @@ def paged_write_quant_arrays(k, v, k_cache, v_cache, k_scale, v_scale,
     return k_cache, v_cache, k_scale, v_scale
 
 
-def _paged_decode_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, *refs,
-                         bs, nblocks, scale, window, quant):
-    """One (batch, page) program of single-token paged decode over ALL
-    heads of the sequence.
+# Multi-sequence-grid kernel tiling (paged_decode_pallas): target
+# tokens per compute chunk, and the VMEM budget for ONE double-buffer
+# slot of ONE of the K/V chunk buffers (two slots x k+v stay well
+# under 1/4 of the 16 MB VMEM at the cap)
+_CHUNK_TOKENS = 512
+_PAGE_BUF_BYTES = 512 * 1024
 
-    Scalar-prefetched block tables drive the K/V BlockSpec index maps,
-    so each page streams HBM→VMEM directly from the global pool — the
-    XLA path's per-step gather (a full cache copy) never happens. The
-    index maps CLAMP the page index to the last live page of the
-    sequence (ceil(context_len / bs) - 1): grid steps past the live
-    prefix re-request the same block, which Pallas recognizes and skips
-    the HBM→VMEM copy — a growing sequence only ever streams the pages
-    it has actually written, while the grid stays static. The liveness
-    guard below additionally skips the VPU work for those dead steps
-    (their masked contribution would be zero anyway).
 
-    All h heads are processed in one program (grid b x pages, NOT
-    b*h*pages: at serving shapes the per-program dispatch overhead of
-    thousands of tiny programs costs more than the attention itself).
-    Scores are VPU broadcast-multiply-reduce, not MXU dots — decode
-    attention is HBM-bandwidth bound and the per-head matvecs are too
-    skinny to feed the systolic array anyway. Online-softmax state per
-    q head accumulates in VMEM scratch across the page-minor grid dim.
+def _chunk_geometry(nblocks, bs, h_kv, d, itemsize,
+                    pages_per_chunk=None, kv_heads_per_block=None):
+    """(pages_per_chunk, kv_heads_per_block) for the decode grid. Both
+    must divide their dimension (the grid is exact, no ragged tail);
+    the defaults pick the largest divisors that keep one chunk at
+    ~_CHUNK_TOKENS tokens and one buffer slot under _PAGE_BUF_BYTES."""
+    if pages_per_chunk is None:
+        ppc = 1
+        for c in range(1, nblocks + 1):
+            if nblocks % c == 0 and c * bs <= max(bs, _CHUNK_TOKENS):
+                ppc = c
+    else:
+        ppc = int(pages_per_chunk)
+        if ppc < 1 or nblocks % ppc:
+            raise ValueError(
+                f"pages_per_chunk must divide the block-table width "
+                f"{nblocks}; got {pages_per_chunk}")
+    if kv_heads_per_block is None:
+        hpb = 1
+        per_head = ppc * bs * d * itemsize
+        for c in range(1, h_kv + 1):
+            if h_kv % c == 0 and c * per_head <= max(per_head,
+                                                     _PAGE_BUF_BYTES):
+                hpb = c
+    else:
+        hpb = int(kv_heads_per_block)
+        if hpb < 1 or h_kv % hpb:
+            raise ValueError(
+                f"kv_heads_per_block must divide the cache's kv heads "
+                f"{h_kv}; got {kv_heads_per_block}")
+    return ppc, hpb
 
-    quant=True adds per-slot scale refs (int8 pool): pages stream at a
-    QUARTER of the f32 bytes and dequantize HBM→VMEM-side, inside this
+
+def _paged_decode_kernel(bt_ref, cl_ref, buf_ref, step_ref, q_ref,
+                         k_hbm, v_hbm, *refs,
+                         batch, h_kv, bs, ppc, hpb, nchunks,
+                         scale, window, quant):
+    """One (slot, kv-head-block, page-chunk) program of multi-sequence
+    single-token paged decode.
+
+    The K/V pools stay in HBM (`ANY` memory space); each program's
+    chunk of ppc pages x hpb kv heads is streamed HBM→VMEM by explicit
+    `pltpu.make_async_copy` DMAs into a two-slot rotating buffer: while
+    chunk i is being reduced, the DMA for the NEXT live chunk — which
+    may belong to the next head block or the next live slot — is
+    already in flight (the upstream jax paged_attention kernel's
+    schedule). `buf_ref`/`step_ref` are mutable scalar-prefetch cells:
+    the buffer toggle and a "pipeline primed" flag that persist across
+    grid steps.
+
+    Liveness is a prefix per (slot, head-block) group: chunk j is live
+    iff j * ppc * bs < context_len. Dead chunks and dead slots
+    (context_len 0, e.g. empty serving lanes) issue NO copy and do NO
+    math — they cost neither HBM bandwidth nor VPU/MXU cycles; a dead
+    slot's output rows are zeroed at its group's last grid step
+    (matching paged_attention_arrays).
+
+    quant=True adds per-slot scale pools (int8 cache): pages stream at
+    a QUARTER of the f32 bytes and dequantize VMEM-side, inside this
     kernel — the XLA path would materialize the dequantized cache.
 
-    Refs: q [h, d] (h = h_kv * rep, GQA rows grouped kv-head-major),
-    k/v [h_kv, bs, d], [k/v scales [h_kv, bs] when quant], o [h, d];
-    scratch m/l [h, 128], acc [h, d].
+    Refs: q [hpb, rep, d] (kv-head-major GQA rows), k/v pools
+    [num_blocks, h_kv, bs, d] in ANY, [scale pools [num_blocks, h_kv,
+    bs] when quant], o [hpb, rep, d]; scratch: k/v chunk buffers
+    [2, hpb, ppc, bs, d] (+ scale buffers [2, hpb, ppc, bs]), one DMA
+    semaphore per buffer slot, online-softmax m/l [hpb, rep, 128] and
+    acc [hpb, rep, d].
     """
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     if quant:
-        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = refs
+        (ks_hbm, vs_hbm, o_ref, kbuf, vbuf, ksbuf, vsbuf, sems,
+         m_ref, l_ref, acc_ref) = refs
     else:
-        ks_ref = vs_ref = None
-        o_ref, m_ref, l_ref, acc_ref = refs
+        ks_hbm = vs_hbm = ksbuf = vsbuf = None
+        o_ref, kbuf, vbuf, sems, m_ref, l_ref, acc_ref = refs
 
-    i = pl.program_id(0)
-    j = pl.program_id(1)
+    i = pl.program_id(0)          # slot (sequence / decode lane)
+    hb = pl.program_id(1)         # kv-head block
+    j = pl.program_id(2)          # page chunk along the block table
+    nhb = h_kv // hpb
+    T = ppc * bs                  # tokens per chunk
+    d = q_ref.shape[-1]
+    ctx = cl_ref[i]
     neg_inf = jnp.float32(NEG_INF)
 
-    @pl.when(j == 0)
-    def _init():
-        m_ref[...] = jnp.full_like(m_ref, neg_inf)
-        l_ref[...] = jnp.zeros_like(l_ref)
-        acc_ref[...] = jnp.zeros_like(acc_ref)
+    def copies(slot, hblk, chunk, buf):
+        """The chunk's DMA descriptors — recreated identically for
+        start and wait (pallas semantics). All of a buffer slot's
+        copies share that slot's semaphore: waiting on every one of
+        them before compute means the total byte count has arrived,
+        whatever order the DMA engines finished in."""
+        hs = hblk * hpb
+        out = []
+        for p in range(ppc):
+            page = bt_ref[slot, chunk * ppc + p]
+            out.append(pltpu.make_async_copy(
+                k_hbm.at[page, pl.ds(hs, hpb)],
+                kbuf.at[buf, :, p], sems.at[buf]))
+            out.append(pltpu.make_async_copy(
+                v_hbm.at[page, pl.ds(hs, hpb)],
+                vbuf.at[buf, :, p], sems.at[buf]))
+            if quant:
+                out.append(pltpu.make_async_copy(
+                    ks_hbm.at[page, pl.ds(hs, hpb)],
+                    ksbuf.at[buf, :, p], sems.at[buf]))
+                out.append(pltpu.make_async_copy(
+                    vs_hbm.at[page, pl.ds(hs, hpb)],
+                    vsbuf.at[buf, :, p], sems.at[buf]))
+        return out
 
-    pos = cl_ref[i].astype(jnp.int32) - jnp.int32(1)
-    page_live = j.astype(jnp.int32) * jnp.int32(bs) <= pos
+    # first live slot after i (batch when none): an unrolled scan over
+    # the STATIC slot count — plain scalar reads + selects, because
+    # ref reads inside lax.cond/while_loop have no interpret-mode
+    # discharge rule (and dead slots must be skipped so their chunks
+    # are never fetched)
+    next_slot = jnp.int32(batch)
+    for t in range(batch - 1, 0, -1):
+        next_slot = jnp.where(
+            jnp.logical_and(t > i, cl_ref[t] > 0),
+            jnp.int32(t), next_slot)
 
-    @pl.when(page_live)
-    def _accumulate():
-        q = q_ref[...].astype(jnp.float32) * jnp.float32(scale)  # [h, d]
-        k = k_ref[...].astype(jnp.float32)                    # [hkv,bs,d]
-        v = v_ref[...].astype(jnp.float32)
+    def next_block(chunk):
+        """First live (slot, head-block, chunk) at or after grid
+        position (i, hb, chunk), in grid order; slot == batch when none
+        is left. Pure value logic on already-read scalars. The
+        chunk < nchunks clamp guards an over-capacity context_len from
+        indexing past the block table."""
+        within = jnp.logical_and(chunk * T < ctx,
+                                 chunk < nchunks)
+        have_head = hb + 1 < nhb
+        ni = jnp.where(within | have_head, i, next_slot)
+        nh = jnp.where(within, hb, jnp.where(have_head, hb + 1, 0))
+        nj = jnp.where(within, chunk, 0)
+        return ni, nh, nj
+
+    @pl.when(jnp.logical_and(ctx == 0, j == nchunks - 1))
+    def _zero_dead():
+        # dead slots emit zeros, not a stale buffer (the reference
+        # path's cl > 0 guard)
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    @pl.when(j * T < ctx)
+    def _work():
+        buf = buf_ref[0]
+
+        @pl.when(step_ref[0] == 0)
+        def _prime():
+            # very first live chunk of the whole call: nobody
+            # prefetched it, start its copies now (the one unavoidable
+            # pipeline bubble)
+            for c in copies(i, hb, j, buf):
+                c.start()
+
+        ni, nh, nj = next_block(j + 1)
+
+        @pl.when(ni < batch)
+        def _prefetch():
+            # issue the NEXT live chunk's HBM→VMEM copies into the
+            # other buffer slot while this chunk computes
+            for c in copies(ni, nh, nj, 1 - buf):
+                c.start()
+
+        @pl.when(j == 0)
+        def _init():
+            m_ref[...] = jnp.full_like(m_ref, neg_inf)
+            l_ref[...] = jnp.zeros_like(l_ref)
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        for c in copies(i, hb, j, buf):
+            c.wait()
+        q = q_ref[...].astype(jnp.float32) * jnp.float32(scale)
+        k = kbuf[buf].reshape(hpb, T, d).astype(jnp.float32)
+        v = vbuf[buf].reshape(hpb, T, d).astype(jnp.float32)
         if quant:
-            k = k * ks_ref[...][:, :, None]
-            v = v * vs_ref[...][:, :, None]
-        h, d = q.shape
-        h_kv = k.shape[0]
-        rep = h // h_kv
-        if rep > 1:
-            # repeat kv heads to per-q-head rows INSIDE VMEM (bs*d per
-            # head — tiny); keeps every elementwise shape 3-D
-            # kv-head-major
-            k = jnp.repeat(k, rep, axis=0)                    # [h,bs,d]
-            v = jnp.repeat(v, rep, axis=0)
-        s = jnp.sum(q[:, None, :] * k, axis=-1)               # [h, bs]
-        k_pos = (j.astype(jnp.int32) * jnp.int32(bs)
-                 + jax.lax.broadcasted_iota(jnp.int32, (h, bs), 1))
+            k = k * ksbuf[buf].reshape(hpb, T)[:, :, None]
+            v = v * vsbuf[buf].reshape(hpb, T)[:, :, None]
+        # batched-over-heads skinny dots, f32 accumulation
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)       # [hpb, rep, T]
+        pos = ctx - 1
+        k_pos = (j * T
+                 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2))
         keep = k_pos <= pos
         if window is not None:
             keep = jnp.logical_and(keep, pos - k_pos < jnp.int32(window))
         s = jnp.where(keep, s, neg_inf)
 
-        m_prev = m_ref[:, :1]
-        l_prev = l_ref[:, :1]
-        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        m_prev = m_ref[:, :, :1]
+        l_prev = l_ref[:, :, :1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
         p = jnp.exp(s - m_cur)
         p = jnp.where(s > neg_inf * 0.5, p, 0.0)
         alpha = jnp.exp(m_prev - m_cur)
-        l_cur = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc_ref[...] = acc_ref[...] * alpha + jnp.sum(
-            p[:, :, None] * v, axis=1)                        # [h, d]
+        l_cur = l_prev * alpha + jnp.sum(p, axis=2, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)       # [hpb, rep, d]
         m_ref[...] = jnp.broadcast_to(m_cur, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_cur, l_ref.shape)
 
-    @pl.when(j == nblocks - 1)
-    def _fin():
-        l_safe = jnp.maximum(l_ref[:, :1], jnp.float32(1e-30))
-        valid = m_ref[:, :1] > neg_inf * 0.5
-        o_ref[...] = jnp.where(valid, acc_ref[...] / l_safe,
-                               0.0).astype(o_ref.dtype)
+        last_live = jnp.minimum((ctx + T - 1) // T, nchunks) - 1
+
+        @pl.when(j == last_live)
+        def _fin():
+            l_safe = jnp.maximum(l_ref[:, :, :1], jnp.float32(1e-30))
+            valid = m_ref[:, :, :1] > neg_inf * 0.5
+            o_ref[...] = jnp.where(valid, acc_ref[...] / l_safe,
+                                   0.0).astype(o_ref.dtype)
+
+        buf_ref[0] = 1 - buf
+        step_ref[0] = step_ref[0] + 1
 
 
 def paged_decode_pallas(q, k_cache, v_cache, block_tables, context_lens,
                         scale=None, window=None, interpret=False,
-                        k_scale=None, v_scale=None):
-    """Pallas single-token paged decode: q [b, h, d] against the page
-    pool, masked to context_lens (and a sliding window). Returns
-    [b, h, d]. Pass k_scale/v_scale [num_blocks, h_kv, block_size] f32
-    for an int8 pool (in-kernel dequant). Geometry must satisfy
-    paged_pallas_eligible(d, block_size, k_cache.dtype)."""
+                        k_scale=None, v_scale=None,
+                        pages_per_chunk=None, kv_heads_per_block=None):
+    """Pallas multi-sequence paged decode: q [b, h, d] (one token per
+    sequence) against the page pool, masked to context_lens (and a
+    sliding window). Returns [b, h, d]. One kernel instance covers ALL
+    b slots — grid (slot, kv-head-block, page-chunk) with
+    double-buffered HBM→VMEM page prefetch over the block table; slots
+    with context_len 0 (empty serving lanes) cost no bandwidth and
+    emit zeros. Pass k_scale/v_scale [num_blocks, h_kv, block_size]
+    f32 for an int8 pool (in-kernel dequant). Geometry must satisfy
+    paged_pallas_eligible(d, block_size, k_cache.dtype);
+    pages_per_chunk/kv_heads_per_block override the auto tiling (each
+    must divide its dimension)."""
     import functools
 
     from jax.experimental import pallas as pl
@@ -314,58 +483,77 @@ def paged_decode_pallas(q, k_cache, v_cache, block_tables, context_lens,
     b, h, d = q.shape
     nb, h_kv, bs, _ = k_cache.shape
     nblocks = block_tables.shape[1]
+    rep = h // h_kv
     quant = k_scale is not None
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+    ppc, hpb = _chunk_geometry(nblocks, bs, h_kv, d,
+                               jnp.dtype(k_cache.dtype).itemsize,
+                               pages_per_chunk, kv_heads_per_block)
+    nchunks = nblocks // ppc
+    nhb = h_kv // hpb
     bt = jnp.asarray(block_tables, jnp.int32)
     cl = jnp.asarray(context_lens, jnp.int32)
-
-    def page_map(i, j, bt, cl):
-        # clamp to the sequence's last live page: dead grid steps
-        # re-request the previous block, so Pallas skips their HBM copy
-        # (the kernel skips their compute via the same predicate)
-        last = jnp.maximum((cl[i] - jnp.int32(1)) // jnp.int32(bs),
-                           jnp.int32(0))
-        return (bt[i, jnp.minimum(j, last)], 0, 0, 0)
-
-    def scale_map(i, j, bt, cl):
-        return page_map(i, j, bt, cl)[:3]
+    qr = q.reshape(b, h_kv, rep, d)
+    if rep % 8:
+        # upstream paged_attention kernel's layout hint: a sub-8-row q
+        # tile lowers to a <1x128>-ish memref that Mosaic lays out
+        # badly unless the operand is f32
+        qr = qr.astype(jnp.float32)
 
     kernel = functools.partial(
-        _paged_decode_kernel, bs=bs, nblocks=nblocks,
-        scale=float(scale),
-        window=None if window is None else int(window),
-        quant=quant)
+        _paged_decode_kernel, batch=b, h_kv=h_kv, bs=bs, ppc=ppc,
+        hpb=hpb, nchunks=nchunks, scale=float(scale),
+        window=None if window is None else int(window), quant=quant)
+    blk = pl.BlockSpec((None, hpb, rep, d),
+                       lambda i, hb, j, *_: (i, hb, 0, 0))
     in_specs = [
-        pl.BlockSpec((None, h, d), lambda i, j, bt, cl: (i, 0, 0)),
-        pl.BlockSpec((None, h_kv, bs, d), page_map),
-        pl.BlockSpec((None, h_kv, bs, d), page_map),
+        blk,                                               # q
+        pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+        pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
     ]
-    inputs = [q, k_cache, v_cache]
+    inputs = [qr, k_cache, v_cache]
     if quant:
-        in_specs += [pl.BlockSpec((None, h_kv, bs), scale_map),
-                     pl.BlockSpec((None, h_kv, bs), scale_map)]
+        in_specs += [
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+        ]
         inputs += [k_scale, v_scale]
+    scratch = [
+        pltpu.VMEM((2, hpb, ppc, bs, d), k_cache.dtype),
+        pltpu.VMEM((2, hpb, ppc, bs, d), v_cache.dtype),
+    ]
+    if quant:
+        scratch += [pltpu.VMEM((2, hpb, ppc, bs), jnp.float32),
+                    pltpu.VMEM((2, hpb, ppc, bs), jnp.float32)]
+    scratch += [
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.VMEM((hpb, rep, 128), jnp.float32),
+        pltpu.VMEM((hpb, rep, 128), jnp.float32),
+        pltpu.VMEM((hpb, rep, d), jnp.float32),
+    ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(b, nblocks),
+        # bt, cl, plus two MUTABLE scalar cells the kernel uses as
+        # cross-step pipeline state: the DMA buffer toggle and the
+        # "pipeline primed" step counter
+        num_scalar_prefetch=4,
+        grid=(b, nhb, nchunks),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((None, h, d),
-                               lambda i, j, bt, cl: (i, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((h, 128), jnp.float32),
-            pltpu.VMEM((h, 128), jnp.float32),
-            pltpu.VMEM((h, d), jnp.float32),
-        ],
+        out_specs=blk,
+        scratch_shapes=scratch,
     )
     with _x32_trace():
         out = pl.pallas_call(
             kernel,
             grid_spec=grid_spec,
-            out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+            out_shape=jax.ShapeDtypeStruct((b, h_kv, rep, d), q.dtype),
+            compiler_params=pltpu.TPUCompilerParams(
+                dimension_semantics=("arbitrary", "arbitrary",
+                                     "arbitrary")),
             interpret=interpret,
-        )(bt, cl, *inputs)
-    return out
+        )(bt, cl, jnp.zeros((1,), jnp.int32), jnp.zeros((1,), jnp.int32),
+          *inputs)
+    return out.reshape(b, h, d)
 
 
 def paged_attention(query, k_cache, v_cache, block_tables, context_lens,

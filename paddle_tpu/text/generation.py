@@ -61,7 +61,8 @@ def _model_forward(model, st, tokens, caches=None, index=None):
     return out
 
 
-def sample_token_arrays(logits, keys, temperature, top_k, top_p):
+def sample_token_arrays(logits, keys, temperature, top_k, top_p,
+                        use_filters: bool = True):
     """Per-row token sampling with PER-ROW (traced) parameters — the
     serving engine's sampler, where every slot carries its own request's
     settings inside ONE fixed-shape executable.
@@ -76,7 +77,15 @@ def sample_token_arrays(logits, keys, temperature, top_k, top_p):
     key passes through unchanged, like pick_next's untouched key);
     top-k-only keeps threshold ties; a composed top-k+top-p uses the
     rank rule and renormalizes within the top-k survivors before the
-    nucleus cut — the same two filter variants pick_next traces."""
+    nucleus cut — the same two filter variants pick_next traces.
+
+    ``use_filters=False`` is the STATIC no-filter fast path (the
+    engine's temperature-only decode variant): the full-vocab argsort
+    the traced filters force — work XLA cannot dead-code out when
+    top_k/top_p ride as arrays — is skipped entirely. Tokens are
+    bit-identical to the filtered path when every row's filters are
+    off, because the filters reduce to identity and the same rng
+    stream is consumed."""
     V = logits.shape[-1]
 
     def row(logit, key, temp, k, p):
@@ -84,24 +93,28 @@ def sample_token_arrays(logits, keys, temperature, top_k, top_p):
         greedy = jnp.argmax(logit).astype(jnp.int32)
         key2, sub = jax.random.split(key)
         scaled = logit / jnp.maximum(temp, jnp.float32(1e-6))
-        k_on = k > 0
-        p_on = (p > 0.0) & (p < 1.0)
-        order = jnp.argsort(-scaled)
-        svals = scaled[order]
-        # pick_next's top-k-only rule: threshold at the k-th value
-        # (exact ties keep every tied token)
-        kth = svals[jnp.clip(k - 1, 0, V - 1)]
-        keep_thresh = jnp.where(k_on, scaled >= kth, True)
-        # pick_next's composed rule: rank < k, nucleus over the
-        # renormalized survivors (first survivor always kept)
-        keep_sorted = jnp.where(
-            k_on, jnp.arange(V, dtype=jnp.int32) < k, True)
-        probs = jax.nn.softmax(jnp.where(keep_sorted, svals, -jnp.inf))
-        csum = jnp.cumsum(probs)
-        keep_sorted &= jnp.where(p_on, (csum - probs) < p, True)
-        keep_rank = jnp.zeros((V,), bool).at[order].set(keep_sorted)
-        keep = jnp.where(p_on, keep_rank, keep_thresh)
-        filt = jnp.where(keep, scaled, -jnp.inf)
+        if use_filters:
+            k_on = k > 0
+            p_on = (p > 0.0) & (p < 1.0)
+            order = jnp.argsort(-scaled)
+            svals = scaled[order]
+            # pick_next's top-k-only rule: threshold at the k-th value
+            # (exact ties keep every tied token)
+            kth = svals[jnp.clip(k - 1, 0, V - 1)]
+            keep_thresh = jnp.where(k_on, scaled >= kth, True)
+            # pick_next's composed rule: rank < k, nucleus over the
+            # renormalized survivors (first survivor always kept)
+            keep_sorted = jnp.where(
+                k_on, jnp.arange(V, dtype=jnp.int32) < k, True)
+            probs = jax.nn.softmax(jnp.where(keep_sorted, svals,
+                                             -jnp.inf))
+            csum = jnp.cumsum(probs)
+            keep_sorted &= jnp.where(p_on, (csum - probs) < p, True)
+            keep_rank = jnp.zeros((V,), bool).at[order].set(keep_sorted)
+            keep = jnp.where(p_on, keep_rank, keep_thresh)
+            filt = jnp.where(keep, scaled, -jnp.inf)
+        else:
+            filt = scaled
         sampled = jax.random.categorical(
             sub, filt[None, :], axis=-1)[0].astype(jnp.int32)
         do_sample = temp > 0

@@ -356,6 +356,7 @@ class LlamaAttention(Layer):
                                                 _pallas_supported)
         from ...kernels.paged_attention import (gather_pages,
                                                 gather_page_scales,
+                                                log_paged_ineligible,
                                                 paged_decode_pallas,
                                                 paged_pallas_eligible,
                                                 paged_write_arrays,
@@ -396,17 +397,22 @@ class LlamaAttention(Layer):
             # loop actually baked in (bench extras.telemetry reads the
             # deltas — docs/OBSERVABILITY.md).
             on_tpu = jax.default_backend() in ("tpu", "axon")
-            if (s == 1 and on_tpu and _pallas_supported()
-                    and paged_pallas_eligible(d, bs_, kc.dtype)):
-                try:
-                    out = paged_decode_pallas(
-                        qa[:, 0], kc, vc, bt, pos0 + 1,
-                        window=window, k_scale=ks, v_scale=vs)
-                    monitor.counter(
-                        "kernels.decode.paged_pallas").increase()
-                    return done(out[:, None])
-                except Exception as exc:  # noqa: BLE001 — flag-gated
-                    _log_fallback(exc, "paged-decode")
+            if s == 1 and on_tpu and _pallas_supported():
+                if paged_pallas_eligible(d, bs_, kc.dtype):
+                    try:
+                        out = paged_decode_pallas(
+                            qa[:, 0], kc, vc, bt, pos0 + 1,
+                            window=window, k_scale=ks, v_scale=vs)
+                        monitor.counter(
+                            "kernels.decode.paged_pallas").increase()
+                        return done(out[:, None])
+                    except Exception as exc:  # noqa: BLE001 — flag-gated
+                        _log_fallback(exc, "paged-decode")
+                else:
+                    # name the violated constraint ONCE at trace time —
+                    # otherwise an ineligible pool geometry only ever
+                    # shows up as slow serving numbers
+                    log_paged_ineligible(d, bs_, kc.dtype)
             monitor.counter(
                 "kernels.decode.paged_xla_gather_step" if s == 1
                 else "kernels.decode.paged_xla_gather").increase()

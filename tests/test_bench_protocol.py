@@ -62,7 +62,9 @@ def test_headline_prints_first_and_extras_append(stubbed, capsys,
                 "bert_base_tokens_per_sec", "ernie_moe_tokens_per_sec",
                 "resnet50_images_per_sec",
                 "llama_1b_decode_tokens_per_sec",
-                "llama_1b_serving_tokens_per_sec"]:
+                "llama_1b_decode_paged_int8_tokens_per_sec",
+                "llama_1b_serving_tokens_per_sec",
+                "llama_1b_serving_int8kv_tokens_per_sec"]:
         assert key in last, key
     assert "skipped" not in last
 
@@ -77,8 +79,9 @@ def test_budget_skips_extras_but_headline_survives(stubbed, capsys,
         "llama_seq2048", "llama_small_seq512", "lenet", "bert_base",
         "ernie_moe", "resnet50", "llama_decode", "llama_decode_bf16kv",
         "llama_decode_int8kv", "llama_decode_int8",
-        "llama_decode_paged", "llama_decode_rolling", "llama_serving",
-        "flashmask_8k"}
+        "llama_decode_paged", "llama_decode_paged_int8",
+        "llama_decode_rolling", "llama_serving",
+        "llama_serving_int8kv", "flashmask_8k"}
     assert "llama_seq2048_mfu" not in lines[-1]["extras"]
 
 

@@ -1,0 +1,177 @@
+"""Multi-sequence-grid Pallas paged-decode kernel (interpret mode).
+
+The kernel contract under test (kernels/paged_attention.py,
+docs/DECODE.md): ONE kernel instance covers every decode slot — grid
+(slot, kv-head-block, page-chunk) with double-buffered HBM→VMEM page
+prefetch driven by explicit async copies — and must agree with the
+reference ``paged_attention_arrays`` gather path across the serving
+matrix: mixed live/dead slots (dead slots emit zeros and are skipped
+by the prefetch schedule), ragged context lengths including exact
+page boundaries, GQA head grouping, sliding windows, int8 pools with
+per-slot scale pools, bf16 pools, and every legal chunk/head-block
+partition of the same problem. Interpret mode simulates the DMA
+semaphores, so the pipeline logic itself is tier-1-covered with no
+TPU.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401 — platform/flags init
+from paddle_tpu.kernels.paged_attention import (_chunk_geometry,
+                                                paged_attention_arrays,
+                                                paged_decode_pallas,
+                                                paged_pallas_requirements)
+from paddle_tpu.quantization.functional import kv_quantize_arrays
+
+TOL = dict(rtol=2e-4, atol=2e-4)
+
+
+def _pool(rng, b, h, h_kv, d, bs, nblocks, dtype=np.float32):
+    q = jnp.asarray(rng.standard_normal((b, h, d)).astype(np.float32))
+    kc = jnp.asarray(rng.standard_normal(
+        (b * nblocks, h_kv, bs, d)).astype(dtype))
+    vc = jnp.asarray(rng.standard_normal(
+        (b * nblocks, h_kv, bs, d)).astype(dtype))
+    bt = jnp.asarray(rng.permutation(b * nblocks).astype(
+        np.int32).reshape(b, nblocks))
+    return q, kc, vc, bt
+
+
+def test_mixed_live_dead_slots(rng):
+    """Dead slots (context 0 — empty serving lanes) must emit exact
+    zeros while live neighbours, including a 1-token context, stay
+    bit-identical to the same call without the dead lanes: the
+    prefetch lookahead has to skip dead slots, not stall on them."""
+    b, h, h_kv, d, bs, nblocks = 6, 8, 4, 128, 8, 5
+    q, kc, vc, bt = _pool(rng, b, h, h_kv, d, bs, nblocks)
+    cl = jnp.asarray(np.array([0, 1, 13, 0, 40, 23], np.int32))
+    ref = paged_attention_arrays(q, kc, vc, bt, cl)
+    out = paged_decode_pallas(q, kc, vc, bt, cl, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), **TOL)
+    assert (np.asarray(out)[np.asarray(cl) == 0] == 0.0).all()
+    # live rows must not depend on which OTHER lanes are dead: rows
+    # (1, 2, 4, 5) bitwise-match the dead-lane-free call
+    live = np.asarray(cl) > 0
+    alone = paged_decode_pallas(q[live], kc, vc, bt[live], cl[live],
+                                interpret=True)
+    np.testing.assert_array_equal(np.asarray(out)[live],
+                                  np.asarray(alone))
+
+
+def test_all_slots_dead(rng):
+    """An all-idle decode tick (every lane empty) must return zeros,
+    not hang the prefetch pipeline waiting for a first live chunk."""
+    b, h, h_kv, d, bs, nblocks = 3, 4, 4, 128, 8, 4
+    q, kc, vc, bt = _pool(rng, b, h, h_kv, d, bs, nblocks)
+    cl = jnp.zeros((b,), jnp.int32)
+    out = paged_decode_pallas(q, kc, vc, bt, cl, interpret=True)
+    assert (np.asarray(out) == 0.0).all()
+
+
+def test_page_boundary_context_lengths(rng):
+    """Contexts ending exactly ON a page/chunk boundary, one past it,
+    and at full capacity — the liveness predicate and the last-live-
+    chunk output write must agree with the reference masks."""
+    b, h, h_kv, d, bs, nblocks = 5, 8, 4, 128, 8, 4
+    q, kc, vc, bt = _pool(rng, b, h, h_kv, d, bs, nblocks)
+    # bs=8, chunks of 2 pages (16 tokens): [boundary, boundary+1,
+    # mid-page, capacity, 1]
+    cl = jnp.asarray(np.array([16, 17, 11, 32, 1], np.int32))
+    ref = paged_attention_arrays(q, kc, vc, bt, cl)
+    out = paged_decode_pallas(q, kc, vc, bt, cl, interpret=True,
+                              pages_per_chunk=2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), **TOL)
+
+
+def test_chunk_and_headblock_partitions_agree(rng):
+    """Every legal (pages_per_chunk, kv_heads_per_block) partition of
+    the same problem — different DMA schedules, different grid shapes
+    — produces the same attention output."""
+    b, h, h_kv, d, bs, nblocks = 3, 8, 4, 128, 8, 4
+    q, kc, vc, bt = _pool(rng, b, h, h_kv, d, bs, nblocks)
+    cl = jnp.asarray(np.array([5, 0, 27], np.int32))
+    ref = paged_attention_arrays(q, kc, vc, bt, cl)
+    for ppc in (1, 2, 4):
+        for hpb in (1, 2, 4):
+            out = paged_decode_pallas(
+                q, kc, vc, bt, cl, interpret=True,
+                pages_per_chunk=ppc, kv_heads_per_block=hpb)
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref),
+                err_msg=f"ppc={ppc} hpb={hpb}", **TOL)
+
+
+def test_int8_scale_pools_mixed_slots_window(rng):
+    """int8 pools + per-slot scale pools through the multi-sequence
+    grid: in-VMEM dequant must match the gather+dequant reference with
+    dead lanes, ragged lengths and a sliding window in the mix."""
+    b, h, h_kv, d, bs, nblocks = 4, 8, 2, 128, 32, 4
+    q = jnp.asarray(rng.standard_normal((b, h, d)).astype(np.float32))
+    kq, ks = kv_quantize_arrays(jnp.asarray(rng.standard_normal(
+        (b * nblocks, h_kv, bs, d)).astype(np.float32)))
+    vq, vs = kv_quantize_arrays(jnp.asarray(rng.standard_normal(
+        (b * nblocks, h_kv, bs, d)).astype(np.float32)))
+    bt = jnp.asarray(rng.permutation(b * nblocks).astype(
+        np.int32).reshape(b, nblocks))
+    cl = jnp.asarray(np.array([0, 33, 128, 64], np.int32))
+    ref = paged_attention_arrays(q, kq, vq, bt, cl,
+                                 k_scale=ks, v_scale=vs)
+    out = paged_decode_pallas(q, kq, vq, bt, cl, interpret=True,
+                              k_scale=ks, v_scale=vs,
+                              pages_per_chunk=2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), **TOL)
+    assert (np.asarray(out)[0] == 0.0).all()
+    # windowed: only the last `window` positions stay visible
+    win = 17
+    L = nblocks * bs
+    kk = jnp.swapaxes(jnp.take(kq.astype(jnp.float32) * ks[..., None],
+                               bt, axis=0), 2, 3).reshape(b, L, h_kv, d)
+    vv = jnp.swapaxes(jnp.take(vq.astype(jnp.float32) * vs[..., None],
+                               bt, axis=0), 2, 3).reshape(b, L, h_kv, d)
+    rep = h // h_kv
+    qg = q.reshape(b, h_kv, rep, d).astype(jnp.float32)
+    logits = jnp.einsum("bgrd,bLgd->bgrL", qg, kk) * (d ** -0.5)
+    kpos = jnp.arange(L)
+    valid = (kpos[None] < cl[:, None]) & \
+        ((cl[:, None] - 1 - kpos[None]) < win)
+    logits = jnp.where(valid[:, None, None], logits, -1e30)
+    want = jnp.einsum("bgrL,bLgd->bgrd", jax.nn.softmax(logits, -1),
+                      vv).reshape(b, h, d)
+    want = jnp.where((cl > 0)[:, None, None], want, 0.0)
+    got = paged_decode_pallas(q, kq, vq, bt, cl, window=win,
+                              interpret=True, k_scale=ks, v_scale=vs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), **TOL)
+
+
+def test_bf16_pool(rng):
+    """bf16 pools stream at half the f32 bytes; the reference path
+    shares the same bf16→f32 read, so outputs agree tightly."""
+    b, h, h_kv, d, bs, nblocks = 3, 4, 2, 128, 16, 3
+    q, kc, vc, bt = _pool(rng, b, h, h_kv, d, bs, nblocks)
+    kc = kc.astype(jnp.bfloat16)
+    vc = vc.astype(jnp.bfloat16)
+    cl = jnp.asarray(np.array([7, 30, 48], np.int32))
+    ref = paged_attention_arrays(q, kc, vc, bt, cl)
+    out = paged_decode_pallas(q, kc, vc, bt, cl, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=5e-3, atol=5e-3)
+
+
+def test_chunk_geometry_and_requirements():
+    """Partition validation fails loudly on non-divisors; the
+    eligibility helper names the violated constraint (the string the
+    engine surfaces at construction)."""
+    with pytest.raises(ValueError, match="pages_per_chunk"):
+        _chunk_geometry(5, 8, 4, 128, 4, pages_per_chunk=2)
+    with pytest.raises(ValueError, match="kv_heads_per_block"):
+        _chunk_geometry(4, 8, 4, 128, 4, kv_heads_per_block=3)
+    # defaults: divisors under the chunk/buffer budgets
+    ppc, hpb = _chunk_geometry(12, 32, 4, 128, 4)
+    assert 12 % ppc == 0 and ppc * 32 <= 512
+    assert 4 % hpb == 0
+    assert paged_pallas_requirements(128, 32, jnp.int8) is None
+    why = paged_pallas_requirements(64, 16, jnp.int8)
+    assert "head_dim 64" in why and "sublane" in why
+    assert paged_pallas_requirements(128, 8, jnp.bfloat16) is not None

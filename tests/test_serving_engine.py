@@ -195,6 +195,132 @@ def test_engine_eos_frees_pages_mid_run(rng):
     assert done[1].finish_reason == "length"
 
 
+def test_engine_mixed_variant_trace_zero_recompiles(rng):
+    """The fused decode step's THREE static sampler variants (greedy /
+    no-filter / filtered) each compile once; a trace that bounces
+    between all-greedy, temperature-only and filtered active sets —
+    with admissions landing mid-flight so the device-resident state is
+    merged repeatedly — triggers ZERO steady-state recompiles and
+    every request stays token-exact vs its b=1 generate()."""
+    net = _tiny_net(seed=4, layers=1, heads=2, vocab=32, hidden=32)
+    eng = Engine(net, max_slots=3, page_size=8, pool_pages=64,
+                 max_context=64, prefill_bucket=8)
+    cfgs = [dict(max_new_tokens=6),                      # greedy
+            dict(max_new_tokens=5, temperature=0.8, seed=3),   # plain
+            dict(max_new_tokens=7, temperature=1.1, top_k=6,
+                 top_p=0.9, seed=9)]                     # filtered
+    prompts = _prompts(rng, (5, 7, 3), vocab=32)
+    # warmup wave touches all three variants (sequentially: each
+    # request alone so the active set takes each variant in turn)
+    for p, c in zip(prompts, cfgs):
+        eng.run([(p, SamplingParams(**c))])
+    # measured wave: all three kinds live AT ONCE plus staggered
+    # arrivals — the active set flips variants between ticks
+    wave = _prompts(rng, (4, 9, 6, 2), vocab=32)
+    wcfg = [cfgs[0], cfgs[2], cfgs[1], cfgs[0]]
+    ids = [eng.add_request(wave[0], SamplingParams(**wcfg[0]))]
+    for _ in range(2):
+        eng.step()
+    ids += [eng.add_request(w, SamplingParams(**c))
+            for w, c in zip(wave[1:], wcfg[1:])]
+    done = {}
+    for _ in range(80):
+        for o in eng.step():
+            done[o.req_id] = o
+        if len(done) >= len(ids):
+            break
+    assert set(ids) <= set(done)
+    for rid, p, c in zip(ids, wave, wcfg):
+        ref = _ref_row(net, p, c["max_new_tokens"],
+                       temperature=c.get("temperature", 0.0),
+                       top_k=c.get("top_k", 0),
+                       top_p=c.get("top_p", 0.0), seed=c.get("seed", 0))
+        assert done[rid].token_ids == ref, rid
+    assert eng.steady_state_recompiles() == 0
+    assert set(eng._decode_fns) == {"greedy", "plain", "filtered"}
+
+
+def test_engine_idle_lanes_do_not_drift(rng):
+    """Idle decode lanes must not advance their device-resident cache
+    position tick over tick: a drifting pos would re-enter the decode
+    kernel as a growing fake context_len and stream scratch pages
+    forever (the 'empty lanes cost no bandwidth' contract). Only live
+    rows advance; idle rows ride at cache_index -1 → context 0."""
+    net = _tiny_net(layers=1, heads=2, vocab=32, hidden=32)
+    eng = Engine(net, max_slots=4, page_size=8, pool_pages=32,
+                 max_context=64)
+    p = rng.integers(0, 32, (5,)).astype(np.int64)
+    eng.add_request(p, SamplingParams(max_new_tokens=10))
+    for _ in range(6):                    # mid-run: request still live
+        eng.step()
+    pos = np.asarray(eng._dev[1])
+    live = np.asarray(eng._dev[6])
+    assert live[0] == 1 and (live[1:] == 0).all()
+    assert (pos[1:] == 0).all(), pos      # idle lanes pinned at 0
+    assert pos[0] > 5                     # the live lane does advance
+    # and the decode stays token-exact with idle lanes at context 0
+    outs = []
+    for _ in range(20):
+        outs += eng.step()
+        if outs:
+            break
+    assert outs[0].token_ids == _ref_row(net, p, 10)
+
+
+def test_engine_pallas_eligibility_surfaced_at_init(rng):
+    """Satellite: Pallas paged-decode eligibility is validated ONCE at
+    Engine construction — an ineligible (head_dim, page_size,
+    cache_dtype) geometry names the violated constraint and bumps
+    serving.decode_fallback instead of silently gathering every
+    step."""
+    from paddle_tpu.kernels.paged_attention import \
+        paged_pallas_requirements
+
+    before = monitor.counter("serving.decode_fallback").get()
+    net = _tiny_net(layers=1, heads=2, vocab=32, hidden=32)  # hd=16
+    eng = Engine(net, max_slots=2, page_size=8, pool_pages=8,
+                 max_context=32)
+    assert not eng.pallas_eligible
+    assert "head_dim 16" in eng.decode_fallback_reason
+    assert monitor.counter("serving.decode_fallback").get() == before + 1
+    # an eligible geometry carries no reason (the constraint helper is
+    # the same one the kernel call sites consult)
+    assert paged_pallas_requirements(128, 16, "bfloat16") is None
+    # int8 tightens the sublane minimum: page_size 16 fails for int8
+    why = paged_pallas_requirements(128, 16, "int8")
+    assert why is not None and "32" in why
+
+
+def test_serving_replay_expect_pallas_fails_loud(rng, capsys):
+    """Satellite: --expect-pallas turns a replay that fell off the
+    Pallas decode path into exit code 4 with the decode-path breakdown
+    and the ineligibility reason on stderr — a fallback must never be
+    just slow numbers."""
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    try:
+        import serving_replay
+    finally:
+        sys.path.pop(0)
+    trace = os.path.join(repo, "tests", "fixtures",
+                         "serving_trace.jsonl")
+    args = [trace, "--layers", "1", "--hidden", "32", "--heads", "2",
+            "--vocab", "32", "--max-slots", "2", "--page-size", "8",
+            "--pool-pages", "24"]
+    rc = serving_replay.main(args + ["--expect-pallas", "--json"])
+    assert rc == 4
+    cap = capsys.readouterr()
+    assert "expect-pallas FAILED" in cap.err
+    assert "head_dim 16" in cap.err
+    import json as _json
+    report = _json.loads(cap.out.strip().splitlines()[-1])
+    assert report["decode_paths"]["pallas"] == 0
+    assert report["decode_paths"]["gather_step"] > 0
+    assert report["pallas_eligible"] is False
+    assert "head_dim 16" in report["pallas_ineligible_reason"]
+
+
 def test_engine_gqa_window_int8_token_exact(rng):
     """The model-variant matrix through the engine: GQA caches
     (kv heads < q heads), sliding-window band masks, and int8 KV pools

@@ -5,6 +5,7 @@ Run: python tools/serving_replay.py trace.jsonl [--max-slots 4]
          [--page-size 8] [--pool-pages 64] [--layers 2] [--hidden 64]
          [--heads 4] [--vocab 64] [--seed 0] [--step-ms 5]
          [--temperature 0] [--cache-dtype auto] [--json]
+         [--expect-pallas]
 
 Each trace line is one request:
 
@@ -15,10 +16,18 @@ from the flags — this measures the SCHEDULER, not the model), drives
 ``paddle_tpu.inference.Engine`` on a virtual clock that advances
 ``--step-ms`` per engine step (deterministic: the same trace always
 yields the same admission schedule and the same percentiles), and
-prints TTFT / TPOT / throughput percentiles plus the decode-path and
+prints TTFT / TPOT / throughput percentiles plus the per-replay
+``kernels.decode.*`` path breakdown (pallas vs gather fallback) and
 ``serving.*`` counters (docs/OBSERVABILITY.md) — the first thing to
 read when a serving number regresses is whether the compiled loop left
 the expected attention path or started recompiling.
+
+``--expect-pallas`` turns a silent fallback into a LOUD failure (exit
+code 4): the replay must have traced the Pallas paged-decode kernel
+and no single-token step may have taken the XLA gather path. Use it
+as the CI guard around TPU serving configs — today a fallback only
+shows up as slow numbers. (On the CPU backend the Pallas path never
+runs, so the flag always fails there — by design.)
 
 A tiny fixture trace lives at tests/fixtures/serving_trace.jsonl.
 """
@@ -59,6 +68,10 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit one machine-readable JSON line instead "
                          "of the text report")
+    ap.add_argument("--expect-pallas", action="store_true",
+                    help="fail (exit 4) when the replay fell off the "
+                         "Pallas paged-decode path — any single-token "
+                         "gather step, or no pallas trace at all")
     args = ap.parse_args(argv)
 
     if not os.path.exists(args.trace):
@@ -163,6 +176,19 @@ def main(argv=None) -> int:
               if k.startswith(("kernels.decode.", "kernels.flash.",
                                "serving.preemptions", "xla.compiles"))
               and int(after.get(k, 0)) - int(before.get(k, 0))}
+    # the per-replay decode-path breakdown: which attention path the
+    # compiled loops actually baked in (trace-time counters,
+    # docs/OBSERVABILITY.md) — "gather_step" > 0 on a TPU serving box
+    # means every token is paying a full-cache copy
+    path_names = {
+        "pallas": "kernels.decode.paged_pallas",
+        "gather_step": "kernels.decode.paged_xla_gather_step",
+        "prefill_gather": "kernels.decode.paged_xla_gather",
+        "dense": "kernels.decode.dense_xla",
+        "rolling": "kernels.decode.rolling_xla",
+    }
+    decode_paths = {name: deltas.get(key, 0)
+                    for name, key in path_names.items()}
     report = {
         "requests": len(trace),
         "steps": steps,
@@ -172,25 +198,44 @@ def main(argv=None) -> int:
         "preemptions": preempts,
         "ttft_ms": _percentiles(ttft),
         "tpot_ms": _percentiles(tpot),
+        "decode_paths": decode_paths,
+        "pallas_eligible": bool(eng.pallas_eligible),
         "counters": deltas,
         "steady_state_recompiles": eng.steady_state_recompiles(),
     }
-    if args.json:
+    if eng.decode_fallback_reason:
+        report["pallas_ineligible_reason"] = eng.decode_fallback_reason
+    fell_off = (decode_paths["gather_step"] > 0
+                or decode_paths["pallas"] == 0)
+    if not args.json:
+        print(f"replayed {report['requests']} requests / "
+              f"{report['total_tokens']} tokens in {report['steps']} "
+              f"steps ({report['wall_s']}s wall) — "
+              f"{report['tokens_per_sec']} tokens_per_sec")
+        for name in ("ttft_ms", "tpot_ms"):
+            ps = report[name]
+            print(f"  {name:8s} p50 {ps['p50']:8.2f}  "
+                  f"p90 {ps['p90']:8.2f}  p99 {ps['p99']:8.2f}   "
+                  f"(virtual clock)")
+        print(f"  preemptions {report['preemptions']}  "
+              f"steady_state_recompiles "
+              f"{report['steady_state_recompiles']}")
+        print("  decode paths: " + "  ".join(
+            f"{k} +{v}" for k, v in decode_paths.items()))
+        if not eng.pallas_eligible:
+            print(f"  pallas ineligible: {eng.decode_fallback_reason}")
+        for k in sorted(report["counters"]):
+            print(f"  {k} +{report['counters'][k]}")
+    else:
         print(json.dumps(report))
-        return 0
-    print(f"replayed {report['requests']} requests / "
-          f"{report['total_tokens']} tokens in {report['steps']} steps "
-          f"({report['wall_s']}s wall) — "
-          f"{report['tokens_per_sec']} tokens_per_sec")
-    for name in ("ttft_ms", "tpot_ms"):
-        ps = report[name]
-        print(f"  {name:8s} p50 {ps['p50']:8.2f}  p90 {ps['p90']:8.2f}"
-              f"  p99 {ps['p99']:8.2f}   (virtual clock)")
-    print(f"  preemptions {report['preemptions']}  "
-          f"steady_state_recompiles "
-          f"{report['steady_state_recompiles']}")
-    for k in sorted(report["counters"]):
-        print(f"  {k} +{report['counters'][k]}")
+    if args.expect_pallas and fell_off:
+        why = eng.decode_fallback_reason or \
+            "backend/geometry did not trace the Pallas kernel"
+        print(f"serving_replay: --expect-pallas FAILED — decode paths "
+              f"{decode_paths} ({why}); every single-token step must "
+              f"stay on kernels.decode.paged_pallas "
+              f"(docs/DECODE.md eligibility table)", file=sys.stderr)
+        return 4
     return 0
 
 
