@@ -369,7 +369,9 @@ def bench_llama_decode(batch=32, prompt=128, new_tokens=256,
 
 def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
                         prompt_hi=192, new_tokens=128,
-                        arrival_rate_hz=40.0, cache_dtype="auto"):
+                        arrival_rate_hz=40.0, cache_dtype="auto",
+                        shared_prefix=0, prefix_cache=False,
+                        draft_layers=0, spec_k=4):
     """Continuous-batching serving throughput on the 1B model
     (paddle_tpu.inference.Engine over the paged KV stack,
     docs/SERVING.md): a fixed-seed Poisson-ish arrival trace
@@ -381,7 +383,16 @@ def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
     the whole trace (admission + prefill + decode), the serving analog
     of the static-batch llama_1b_decode number. The trace runs once
     cold (compiles the prefill buckets + the decode shape) and the
-    timed pass reuses the warm executables."""
+    timed pass reuses the warm executables.
+
+    shared_prefix=N opens every prompt with the same N-token system
+    block and prefix_cache=True dedups it through the content-
+    addressed page store (docs/SERVING.md): every request after the
+    first prefills only its divergent tail. draft_layers=K attaches a
+    K-layer draft model (same vocab/geometry) and decodes through the
+    draft/verify schedule with spec_k drafted tokens per tick —
+    token-identical by construction, faster whenever the draft earns
+    its accept rate."""
     import paddle_tpu as paddle
     from paddle_tpu.inference.engine import Engine, SamplingParams
     from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
@@ -395,12 +406,29 @@ def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
         use_flash_attention=True)
     net = LlamaForCausalLM(cfg)
     net.eval()
+    draft = None
+    if draft_layers:
+        import dataclasses
+        paddle.seed(1)
+        # same geometry/vocab as the target, shallower — the
+        # draft/verify schedule requires it (docs/SERVING.md)
+        dcfg = dataclasses.replace(
+            cfg, num_hidden_layers=int(draft_layers))
+        draft = LlamaForCausalLM(dcfg)
+        draft.eval()
     rng = np.random.default_rng(0)
     arrivals = np.cumsum(rng.exponential(1.0 / arrival_rate_hz,
                                          n_requests))
-    prompts = [rng.integers(
-        0, cfg.vocab_size,
-        (int(rng.integers(prompt_lo, prompt_hi)),)).astype(np.int64)
+    # drawn ONLY when a shared prefix is asked for: the legacy traces
+    # (shared_prefix=0) must keep their exact seed-0 rng stream so the
+    # recorded serving numbers stay comparable across runs
+    system = (rng.integers(0, cfg.vocab_size, (shared_prefix,))
+              if shared_prefix else np.zeros((0,), np.int64))
+    prompts = [np.concatenate([
+        system,
+        rng.integers(0, cfg.vocab_size,
+                     (int(rng.integers(prompt_lo, prompt_hi))
+                      - shared_prefix,))]).astype(np.int64)
         for _ in range(n_requests)]
 
     # ONE engine for both passes: the executables are per-instance jit
@@ -412,7 +440,8 @@ def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
     # serves quantized KV pools dequantized inside the decode kernel
     eng = Engine(net, max_slots=max_slots, page_size=128,
                  prefill_bucket=64, max_context=prompt_hi + new_tokens,
-                 cache_dtype=cache_dtype)
+                 cache_dtype=cache_dtype, prefix_cache=prefix_cache,
+                 draft_model=draft, spec_k=spec_k)
 
     def run_trace():
         t0 = time.perf_counter()
@@ -679,6 +708,29 @@ def main():
         result["extras"]["llama_1b_serving_int8kv_tokens_per_sec"] = \
             round(tok, 1)
 
+    def add_serving_prefix():
+        # shared-system-prompt trace through the prefix cache: every
+        # request after the first maps the hot 256-token prefix's
+        # pages and prefills only its divergent tail
+        tok = _record_decode_path(
+            "serving_prefix",
+            lambda: bench_llama_serving(shared_prefix=256,
+                                        prompt_lo=320, prompt_hi=448,
+                                        prefix_cache=True))
+        result["extras"]["llama_1b_serving_prefix_tokens_per_sec"] = \
+            round(tok, 1)
+
+    def add_serving_spec():
+        # draft/verify speculative decoding: a 1-layer draft proposes
+        # 4 tokens per tick, the 4-layer target verifies all 5
+        # positions in one forward — output tokens identical, serving
+        # throughput scales with the accept rate
+        tok = _record_decode_path(
+            "serving_spec",
+            lambda: bench_llama_serving(draft_layers=1, spec_k=4))
+        result["extras"]["llama_1b_serving_spec_tokens_per_sec"] = \
+            round(tok, 1)
+
     def add_flashmask():
         ms = bench_flashmask_8k()
         result["extras"]["flashmask_seq8k_docmask_ms"] = round(ms, 2)
@@ -705,6 +757,8 @@ def main():
         ("llama_decode_rolling", add_decode_window, 240),
         ("llama_serving", add_serving, 300),
         ("llama_serving_int8kv", add_serving_int8kv, 300),
+        ("llama_serving_prefix", add_serving_prefix, 300),
+        ("llama_serving_spec", add_serving_spec, 300),
         ("flashmask_8k", add_flashmask, 90),
     ]
     skipped = []

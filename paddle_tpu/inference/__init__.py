@@ -268,5 +268,11 @@ def __getattr__(name):
     if name == "PageAllocator":
         from .allocator import PageAllocator
         return PageAllocator
+    if name == "PrefixCache":
+        from .prefix_cache import PrefixCache
+        return PrefixCache
+    if name == "SpeculativeDecoder":
+        from .speculative import SpeculativeDecoder
+        return SpeculativeDecoder
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")

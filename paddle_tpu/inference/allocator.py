@@ -7,6 +7,13 @@ ordered list of page ids recorded in its block-table row, pages are
 handed out as sequences grow and returned the moment a sequence
 finishes (EOS or budget) — not at the end of the serving call.
 
+Pages are REFCOUNTED: the prefix cache (inference/prefix_cache.py)
+maps one physical page into many requests' block tables, so ``free``
+only returns a page to the free list when its last reference drops.
+A page's content is immutable while shared — writers fork a private
+copy first (the engine's copy-on-write rule; docs/SERVING.md) — so
+refcounting is pure host bookkeeping, never a device copy.
+
 This is pure host-side bookkeeping (python ints in a deque); the pool
 arrays themselves live in kernels/paged_attention.py's head-major
 layout and are updated functionally inside the compiled steps. Both
@@ -38,6 +45,7 @@ class PageAllocator:
         self.base = int(base)
         self._free = deque(range(self.base, self.base + self.num_pages))
         self._owner: Dict[int, Optional[object]] = {}
+        self._refs: Dict[int, int] = {}
 
     @property
     def free_pages(self) -> int:
@@ -47,6 +55,15 @@ class PageAllocator:
     def live_pages(self) -> int:
         return self.num_pages - len(self._free)
 
+    @property
+    def shared_pages(self) -> int:
+        """Pages held by MORE than one reference (prefix-cache sharing).
+        Each shared page occupies exactly one pool slot however many
+        block tables map it — the admission watermark reads the free
+        list, so a would-be-shared prefix never inflates apparent
+        pool pressure."""
+        return sum(1 for r in self._refs.values() if r > 1)
+
     def can_alloc(self, n: int, watermark: int = 0) -> bool:
         """True when ``n`` pages fit while leaving ``watermark`` pages
         free — the admission-control check: headroom for RUNNING
@@ -54,10 +71,11 @@ class PageAllocator:
         return len(self._free) - int(watermark) >= int(n)
 
     def alloc(self, n: int, seq=None) -> List[int]:
-        """Hand out ``n`` page ids (oldest-freed first), owned by
-        ``seq``. Raises RuntimeError naming the pool geometry when the
-        pool can't cover the request — the caller either preempts a
-        sequence and retries, or surfaces the error."""
+        """Hand out ``n`` page ids (oldest-freed first) with refcount 1,
+        owned by ``seq``. Raises RuntimeError naming the pool geometry
+        when the pool can't cover the request — the caller either
+        preempts a sequence (or evicts idle prefix-cache pages) and
+        retries, or surfaces the error."""
         n = int(n)
         if n > len(self._free):
             raise RuntimeError(
@@ -69,26 +87,64 @@ class PageAllocator:
         pages = [self._free.popleft() for _ in range(n)]
         for p in pages:
             self._owner[p] = seq
+            self._refs[p] = 1
         return pages
 
+    def share(self, page: int) -> int:
+        """Take one more reference on a live page (prefix-cache hit:
+        the page is mapped into another block table without a copy).
+        Returns the page id; sharing a dead page fails loudly."""
+        page = int(page)
+        if page not in self._refs:
+            raise RuntimeError(
+                f"sharing page {page} that is not live — the prefix "
+                f"cache may only map allocated pages")
+        self._refs[page] += 1
+        return page
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(int(page), 0)
+
     def free(self, pages) -> None:
-        """Return pages to the free list (EOS/finish/preemption time —
-        not end-of-call). Double-frees and foreign ids fail loudly:
-        both corrupt the pool silently if let through."""
+        """Drop one reference per page; a page returns to the free list
+        (EOS/finish/preemption/eviction time — not end-of-call) only
+        when its LAST reference drops. Over-frees and foreign ids fail
+        loudly: both corrupt the pool silently if let through."""
         for p in pages:
             p = int(p)
-            if p not in self._owner:
+            if p not in self._refs:
                 lo, hi = self.base, self.base + self.num_pages
                 raise RuntimeError(
                     f"freeing page {p} that is not live (pool ids "
                     f"[{lo}, {hi}), {self.live_pages} live) — "
                     f"double-free or foreign page id")
-            del self._owner[p]
-            self._free.append(p)
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                del self._refs[p]
+                del self._owner[p]
+                self._free.append(p)
 
     def owner(self, page: int):
         return self._owner.get(int(page))
 
+    def stats(self) -> Dict[str, object]:
+        """Pool state snapshot for admission decisions and the
+        ``serving.prefix_pages_shared`` gauge: free/live/shared page
+        counts plus a refcount histogram ({refcount: pages}) — a
+        healthy prefix-heavy pool shows a tall bucket at the hot
+        system prompt's share count."""
+        hist: Dict[int, int] = {}
+        for r in self._refs.values():
+            hist[r] = hist.get(r, 0) + 1
+        return {
+            "num_pages": self.num_pages,
+            "free": self.free_pages,
+            "live": self.live_pages,
+            "shared": self.shared_pages,
+            "refcount_hist": dict(sorted(hist.items())),
+        }
+
     def __repr__(self):
         return (f"PageAllocator({self.live_pages} live / "
-                f"{self.num_pages} pages, base={self.base})")
+                f"{self.num_pages} pages, {self.shared_pages} shared, "
+                f"base={self.base})")

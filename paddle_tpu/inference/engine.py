@@ -45,13 +45,31 @@ Design (docs/SERVING.md has the full lifecycle):
   cannot perturb active rows (row-independent attention + scratch
   page). tests/test_serving_engine.py holds this exact.
 
+Two opt-in accelerators ride on the same scheduler (this PR):
+
+* Prefix caching (``prefix_cache=True``; prefix_cache.py): a
+  content-addressed store of full KV pages maps the longest cached
+  page-aligned prompt prefix straight into a new request's block
+  table (allocator refcounts, copy-on-write for the partial tail
+  page) so prefill runs only the uncached tail chunk.
+* Speculative decoding (``draft_model=...``; speculative.py): a small
+  draft proposes ``spec_k`` tokens per slot, the target verifies all
+  k+1 positions in ONE forward, and exact-match acceptance keeps the
+  output bit-identical to the draft-free engine — 1 to k+1 tokens
+  per tick.
+
 ``monitor`` surface (docs/OBSERVABILITY.md): gauges
 ``serving.slots_active`` / ``serving.pages_free`` /
-``serving.queue_depth`` / ``serving.ttft_ms`` / ``serving.tpot_ms``,
-counters ``serving.requests`` / ``serving.tokens`` /
-``serving.finished`` / ``serving.preemptions`` / ``serving.steps`` /
-``serving.decode_fallback`` (engine built with a Pallas-ineligible
-page geometry — validated ONCE at construction, docs/DECODE.md).
+``serving.queue_depth`` / ``serving.ttft_ms`` / ``serving.tpot_ms``
+/ ``serving.prefix_hit_rate`` / ``serving.prefix_pages_shared`` /
+``serving.spec_accept_rate``, counters ``serving.requests`` /
+``serving.tokens`` / ``serving.finished`` / ``serving.preemptions``
+/ ``serving.steps`` / ``serving.prefill_tokens`` /
+``serving.prefix_tokens_reused`` / ``serving.prefix_hits`` /
+``serving.prefix_lookups`` / ``serving.spec_drafted`` /
+``serving.spec_accepted`` / ``serving.decode_fallback`` (engine
+built with a Pallas-ineligible page geometry — validated ONCE at
+construction, docs/DECODE.md).
 """
 from __future__ import annotations
 
@@ -72,8 +90,9 @@ from ..jit.functional import get_buffers, get_frozen, get_params
 from ..kernels.paged_attention import paged_pallas_requirements
 from ..profiler.stats import CompileTracker
 from ..text.generation import (_model_forward, _resolve_cache_dtype,
-                               sample_token_arrays)
+                               sample_token_arrays, verify_token_arrays)
 from .allocator import PageAllocator
+from .prefix_cache import PrefixCache
 
 # request lifecycle states
 WAITING = "WAITING"
@@ -128,6 +147,12 @@ class Request:
     key: Optional[np.ndarray] = None      # [2] uint32 rng chain state
     slot: Optional[int] = None
     pages: List[int] = field(default_factory=list)
+    # prefix-cache state: pages acquired (refcounted) at admission for
+    # the longest cached prefix, and how many tokens they cover; None
+    # until the admission lookup ran (reset on preemption — the resume
+    # prefix is re-looked-up against the cache's current contents)
+    shared_pages: Optional[List[int]] = None
+    prefix_len: int = 0
     written: int = 0                      # tokens in the paged cache
     admit_seq: int = -1                   # admission order (preemption)
     preemptions: int = 0
@@ -147,6 +172,21 @@ class Request:
 
 def _ceil_div(a: int, b: int) -> int:
     return -(-int(a) // int(b))
+
+
+def _make_paged_pools(layers, rows, hkv, page_size, hd, dtype, quant):
+    """Per-layer paged KV pool tuples — (k, v[, ks, vs]) zeros in the
+    head-major layout kernels/paged_attention.py expects. The ONE
+    constructor for both the target's pools and the draft's
+    (speculative.py mirrors the engine's layout exactly — a layout
+    change here reaches both models)."""
+    return [
+        (jnp.zeros((rows, hkv, page_size, hd), dtype),
+         jnp.zeros((rows, hkv, page_size, hd), dtype))
+        + ((jnp.zeros((rows, hkv, page_size), jnp.float32),
+            jnp.zeros((rows, hkv, page_size), jnp.float32))
+           if quant else ())
+        for _ in range(layers)]
 
 
 @jax.jit
@@ -187,7 +227,9 @@ class Engine:
                  cache_dtype: str = "auto",
                  max_context: Optional[int] = None,
                  prefill_bucket: int = 32,
-                 watermark_pages: Optional[int] = None):
+                 watermark_pages: Optional[int] = None,
+                 prefix_cache: bool = False,
+                 draft_model=None, spec_k: int = 4):
         import inspect
         try:
             fsig = inspect.signature(model.forward)
@@ -207,8 +249,15 @@ class Engine:
         self.prefill_bucket = int(prefill_bucket)
         self.max_context = int(max_context
                                or cfg.max_position_embeddings)
-        self.max_blocks = _ceil_div(self._pbucket(self.max_context),
-                                    self.page_size)
+        # speculative decoding writes k+1 positions per tick (the
+        # drafted chunk), so the block tables carry that lookahead of
+        # extra slots past max_context — a verify write must never
+        # clip into a request's LAST live page
+        self._lookahead = (int(spec_k) + 1) if draft_model is not None \
+            else 1
+        self.max_blocks = _ceil_div(
+            self._pbucket(self.max_context) + self._lookahead - 1,
+            self.page_size)
         if pool_pages is None:
             # default: every slot can hold a max-context sequence — no
             # preemption unless the caller sizes the pool tighter
@@ -227,15 +276,9 @@ class Engine:
         # allocator hands out ids [1, pool_pages]
         rows = self.pool_pages + 1
         self._alloc = PageAllocator(self.pool_pages, base=1)
-        self._pools = [
-            (jnp.zeros((rows, hkv, self.page_size, hd),
-                       self.cache_dtype),
-             jnp.zeros((rows, hkv, self.page_size, hd),
-                       self.cache_dtype))
-            + ((jnp.zeros((rows, hkv, self.page_size), jnp.float32),
-                jnp.zeros((rows, hkv, self.page_size), jnp.float32))
-               if self._quant else ())
-            for _ in range(cfg.num_hidden_layers)]
+        self._pools = _make_paged_pools(
+            cfg.num_hidden_layers, rows, hkv, self.page_size, hd,
+            self.cache_dtype, self._quant)
         S, MB = self.max_slots, self.max_blocks
         self._bt = np.zeros((S, MB), np.int32)
         self._pos = np.zeros((S,), np.int32)
@@ -270,6 +313,21 @@ class Engine:
         self._warm_compiles = 0
         self._prefill_fns: Dict[int, object] = {}
         self._decode_fns: Dict[str, object] = {}
+        self._verify_fns: Dict[str, object] = {}
+        # shared-prefix KV reuse (prefix_cache.py): content-addressed
+        # full pages mapped into many block tables via allocator
+        # refcounts; idle entries are evicted before admission is
+        # refused or a live sequence preempted
+        self._prefix = (PrefixCache(self._alloc, self.page_size)
+                        if prefix_cache else None)
+        # draft/verify speculative decoding (speculative.py): the
+        # draft's paged pools mirror this engine's page ids exactly
+        self._spec = None
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        if draft_model is not None:
+            from .speculative import SpeculativeDecoder
+            self._spec = SpeculativeDecoder(self, draft_model, spec_k)
         self._tracker = CompileTracker().start()
         # Pallas paged-decode eligibility is a STATIC property of
         # (head_dim, page_size, cache_dtype) — validate it once here
@@ -362,7 +420,48 @@ class Engine:
 
         fn = jax.jit(body, donate_argnums=(1, 3))
         self._decode_fns[variant] = fn
-        self._last_compile_step = self._steps
+        self._note_compile()
+        return fn
+
+    def _get_verify_fn(self, variant: str):
+        """The speculative verify executable — ONE fixed-shape
+        ``[max_slots, k+1]`` target forward per static sampler variant
+        (same three variants as the decode step): scores the drafted
+        chunk at every position, walks the acceptance chain with the
+        target's own sampler and rng keys (verify_token_arrays — the
+        exact-match rule that keeps output bit-identical to the
+        draft-free engine), and advances the device-resident state by
+        each slot's accepted count + 1 in-graph. The host fetches only
+        the candidate tokens and the accept counts."""
+        fn = self._verify_fns.get(variant)
+        if fn is not None:
+            return fn
+        model = self.model
+
+        def body(st, caches, bt, state, drafts):
+            last, pos, temps, topks, topps, keys, live = state
+            kv = self._inject_bt(caches, bt)
+            # idle lanes at cache_index -1 (context 0), like the plain
+            # decode step — their k+1 scratch writes clip into page 0
+            idx = jnp.where(live > 0, pos, -jnp.ones_like(pos))
+            toks_in = jnp.concatenate([last[:, None], drafts], axis=1)
+            logits, new_kv = _model_forward(model, st, toks_in, kv, idx)
+            toks, acc, keys2 = verify_token_arrays(
+                logits.astype(jnp.float32), drafts, keys, temps, topks,
+                topps, use_filters=variant == "filtered",
+                greedy=variant == "greedy")
+            # live rows consumed acc+1 context tokens; idle rows must
+            # not drift (same contract as the decode step)
+            new_last = jnp.take_along_axis(toks, acc[:, None],
+                                           axis=1)[:, 0]
+            state2 = (jnp.where(live > 0, new_last, last),
+                      pos + (acc + 1) * live, temps, topks, topps,
+                      jnp.where(live[:, None] > 0, keys2, keys), live)
+            return toks, acc, state2, self._strip_bt(new_kv)
+
+        fn = jax.jit(body, donate_argnums=(1, 3))
+        self._verify_fns[variant] = fn
+        self._note_compile()
         return fn
 
     def _get_prefill_fn(self, pb: int):
@@ -371,12 +470,18 @@ class Engine:
             return fn
         model = self.model
 
-        def body(st, caches, bt_row, prompt, plen, temps, topks, topps,
-                 keys):
+        def body(st, caches, bt_row, prompt, plen, start, temps, topks,
+                 topps, keys):
             kv = self._inject_bt(caches, bt_row)
+            # `start` is the page-aligned token offset the chunk begins
+            # at — 0 for a cold prefill, the cached-prefix length on a
+            # prefix-cache hit (the chunk attends the shared pages
+            # through the block table; only the tail is computed). It
+            # rides as a TRACED [1] array so every hit depth reuses
+            # this one bucket executable.
             logits, new_kv = _model_forward(model, st, prompt, kv,
-                                            jnp.int32(0))
-            # last REAL prompt position's logits (the prompt is padded
+                                            start)
+            # last REAL chunk position's logits (the chunk is padded
             # to the bucket; causality keeps the pad out of this row)
             idx = jnp.reshape(plen - 1, (1, 1, 1)).astype(jnp.int32)
             last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
@@ -386,8 +491,13 @@ class Engine:
 
         fn = jax.jit(body, donate_argnums=(1,))
         self._prefill_fns[pb] = fn
-        self._last_compile_step = self._steps
+        self._note_compile()
         return fn
+
+    def _note_compile(self):
+        """Record that THIS step legitimately introduced a new
+        executable (warmup accounting for steady_state_recompiles)."""
+        self._last_compile_step = self._steps
 
     # -- public API ----------------------------------------------------------
 
@@ -411,18 +521,27 @@ class Engine:
         prompt = [int(t) for t in arr]
         if not prompt:
             raise ValueError("empty prompt")
+        # validate the whole lifetime's page demand UP FRONT, naming
+        # the request and the pages it needs — an oversized request
+        # must never get as far as a mid-prefill _page_slots failure
+        rid = self._next_id
         need = len(prompt) + int(params.max_new_tokens)
-        cap = self.max_blocks * self.page_size
+        cap = self.max_blocks * self.page_size - (self._lookahead - 1)
         if self._pbucket(need) > cap:
             raise ValueError(
-                f"request needs {need} token slots (prompt "
-                f"{len(prompt)} + {params.max_new_tokens} new), beyond "
-                f"the engine's max_context capacity {cap}")
-        worst_pages = _ceil_div(self._pbucket(need), self.page_size)
+                f"request {rid} needs {need} token slots (prompt "
+                f"{len(prompt)} + {params.max_new_tokens} new = "
+                f"{_ceil_div(self._pbucket(need), self.page_size)} "
+                f"pages), beyond the engine's max_context capacity "
+                f"{cap}")
+        worst_pages = _ceil_div(
+            self._pbucket(need) + self._lookahead - 1, self.page_size)
         if worst_pages > self.pool_pages:
             raise RuntimeError(
-                f"request can never be scheduled: it needs up to "
-                f"{worst_pages} page(s) but the pool has "
+                f"request {rid} can never be scheduled: it needs up "
+                f"to {worst_pages} page(s) (prompt {len(prompt)} + "
+                f"{params.max_new_tokens} new tokens at page_size "
+                f"{self.page_size}) but the pool has "
                 f"{self.pool_pages} — grow pool_pages or shrink the "
                 f"request")
         req = Request(req_id=self._next_id, prompt=prompt, params=params,
@@ -535,8 +654,25 @@ class Engine:
             if slot is None:
                 break
             req = self._waiting[0]
-            need = _ceil_div(self._pbucket(len(req.resume_tokens())),
-                             self.page_size)
+            toks = req.resume_tokens()
+            if self._prefix is not None and req.shared_pages is None:
+                # map the longest cached prefix NOW (references taken,
+                # so the pages can't be evicted out from under the
+                # admission decision), capped so at least one real
+                # token is left for the tail prefill — the append page
+                # stays private even when its contents are cached (the
+                # copy-on-write fork, docs/SERVING.md)
+                req.shared_pages, req.prefix_len = self._prefix.acquire(
+                    toks, max_chunks=(len(toks) - 1) // self.page_size)
+                monitor.counter("serving.prefix_lookups").increase()
+                if req.prefix_len:
+                    monitor.counter("serving.prefix_hits").increase()
+            # shared pages are already resident — admission charges
+            # only the UNCACHED tail (a would-be-shared prefix must
+            # not inflate apparent pool pressure; each shared page is
+            # one pool slot however many block tables map it)
+            tail = len(toks) - req.prefix_len
+            need = _ceil_div(self._pbucket(tail), self.page_size)
             # the watermark reserves growth headroom for RUNNING
             # sequences; an otherwise-empty engine admits with the
             # whole pool (a big request must not starve behind
@@ -544,7 +680,12 @@ class Engine:
             busy = any(r is not None for r in self._slots)
             wm = self.watermark_pages if busy else 0
             if not self._alloc.can_alloc(need + reserved, wm):
-                break
+                # reclaim idle prefix-cache pages (refcount==0 users,
+                # LRU) before refusing admission
+                short = need + reserved + wm - self._alloc.free_pages
+                if self._prefix is None or \
+                        self._prefix.evict(short) < short:
+                    break
             reserved += need
             self._waiting.popleft()
             req.slot = slot
@@ -560,32 +701,71 @@ class Engine:
         fresh requests also sample their first token here (TTFT).
         Resumed (preempted) requests only rebuild their cache — the
         sampled token and key are discarded, so the request's RNG
-        chain continues exactly where it stopped."""
+        chain continues exactly where it stopped.
+
+        With the prefix cache on, the shared pages acquired at
+        admission land directly in the block table and ONLY the
+        uncached tail chunk runs through the model — TTFT for a hot
+        system prompt collapses to one (small) bucketed step. All
+        writes stay in private pages: the cached prefix is page-aligned
+        and every page from the tail onward is freshly allocated."""
         toks = req.resume_tokens()
         fresh = not req.generated
         P = len(toks)
-        pb = self._pbucket(P)
-        npages = _ceil_div(pb, self.page_size)
-        req.pages = self._alloc.alloc(npages, seq=req.req_id)
+        shared = list(req.shared_pages or [])
+        start = req.prefix_len            # page-aligned by construction
+        T = P - start
+        # bucket the tail, but never past the block table: a deep
+        # cached prefix leaves less than one full bucket of room, and
+        # the padding pages would overflow the [1, max_blocks] row
+        # (add_request guarantees the REAL tail always fits). start is
+        # page-aligned, so the cap stays page-aligned too.
+        pb = min(self._pbucket(T),
+                 self.max_blocks * self.page_size - start)
+        npriv = _ceil_div(pb, self.page_size)
+        try:
+            priv = self._alloc.alloc(npriv, seq=req.req_id)
+        except RuntimeError:
+            # admission reserved these pages, but an aggressive caller
+            # (or a test) may drive _prefill directly: reclaim idle
+            # cached pages before surfacing the exhaustion error
+            if self._prefix is None or not self._prefix.evict(npriv):
+                raise
+            priv = self._alloc.alloc(npriv, seq=req.req_id)
+        req.pages = shared + priv
         bt_row = np.zeros((1, self.max_blocks), np.int32)
-        bt_row[0, :npages] = req.pages
+        bt_row[0, :len(req.pages)] = req.pages
         prompt = np.zeros((1, pb), np.int32)
-        prompt[0, :P] = toks
+        prompt[0, :T] = toks[start:]
         p = req.params
         fn = self._get_prefill_fn(pb)
+        bt_dev = jnp.asarray(bt_row)
+        prompt_dev = jnp.asarray(prompt)
+        start_dev = jnp.asarray([start], jnp.int32)
         tok, key2, self._pools = fn(
-            self._st, self._pools, jnp.asarray(bt_row),
-            jnp.asarray(prompt), jnp.asarray([P], jnp.int32),
+            self._st, self._pools, bt_dev, prompt_dev,
+            jnp.asarray([T], jnp.int32), start_dev,
             jnp.asarray([p.temperature], jnp.float32),
             jnp.asarray([p.top_k], jnp.int32),
             jnp.asarray([p.top_p], jnp.float32),
             jnp.asarray(req.key[None]))
+        if self._spec is not None:
+            # mirror the chunk into the draft pools (same pages, same
+            # positions) so drafting attends the full context
+            self._spec.prefill(pb, bt_dev, prompt_dev, start_dev)
+        monitor.counter("serving.prefill_tokens").increase(pb)
+        monitor.counter("serving.prefix_tokens_reused").increase(start)
         req.written = P
         # trim the bucket-padding pages the real prefix doesn't need
-        keep = _ceil_div(P, self.page_size)
+        # (private tail pages only — the shared prefix is never padded)
+        keep = len(shared) + _ceil_div(T, self.page_size)
         if keep < len(req.pages):
             self._alloc.free(req.pages[keep:])
             req.pages = req.pages[:keep]
+        if self._prefix is not None:
+            # register this prefix's full pages (newly computed chunks
+            # only; chunks matched at admission are already cached)
+            self._prefix.insert(toks, req.pages, P)
         if fresh:
             t = int(np.asarray(tok)[0])
             req.key = np.asarray(key2)[0].astype(np.uint32)
@@ -614,14 +794,18 @@ class Engine:
         req.state = DECODE
 
     def _ensure_pages(self):
-        """Before the decode step, every active slot must own the page
-        its next write lands in; allocate lazily, preempting the
-        YOUNGEST sequence when the pool runs dry."""
+        """Before the decode step, every active slot must own every
+        page this tick's writes land in — one position for the plain
+        decode step, k+1 for a speculative draft/verify tick; allocate
+        lazily, preempting the YOUNGEST sequence when the pool runs
+        dry (after reclaiming idle prefix-cache pages)."""
         for i in range(self.max_slots):
             req = self._slots[i]
             if req is None or req.state != DECODE:
                 continue
-            while len(req.pages) <= req.written // self.page_size:
+            need = _ceil_div(req.written + self._lookahead,
+                             self.page_size)
+            while len(req.pages) < need:
                 page = self._alloc_or_preempt(req)
                 if page is None:      # req itself got preempted
                     break
@@ -634,6 +818,11 @@ class Engine:
             try:
                 return self._alloc.alloc(1, seq=req.req_id)
             except RuntimeError:
+                # idle cached pages go first: evicting a cold prefix
+                # is free, preempting a live sequence costs a resume
+                # prefill
+                if self._prefix is not None and self._prefix.evict(1):
+                    continue
                 victims = [r for r in self._slots
                            if r is not None and r.state == DECODE]
                 if not victims:
@@ -696,6 +885,8 @@ class Engine:
             variant = "filtered"
         else:
             variant = "plain"
+        if self._spec is not None:
+            return self._decode_spec(active, variant)
         fn = self._get_decode_fn(variant)
         self._flush_state()
         # the fused step: forward + per-slot sampling + state advance
@@ -722,6 +913,55 @@ class Engine:
                 outs.append(self._finish(req, reason))
         return outs
 
+    def _decode_spec(self, active: List[int], variant: str
+                     ) -> List[Output]:
+        """One draft/verify tick: the draft loop proposes k tokens per
+        slot (one executable), the target scores all k+1 positions in
+        ONE batched forward, and each slot emits its accepted chain +
+        one free target token — between 1 and k+1 tokens, every one
+        bit-identical to what the plain decode loop would have emitted
+        (verify_token_arrays' exact-match rule)."""
+        self._flush_state()
+        k = self._spec.k
+        drafts = self._spec.draft(self._bt_dev, self._dev[0],
+                                  self._dev[1], self._dev[6])
+        fn = self._get_verify_fn(variant)
+        toks, acc, self._dev, self._pools = fn(
+            self._st, self._pools, self._bt_dev, self._dev, drafts)
+        toks = np.asarray(toks)
+        acc = np.asarray(acc)
+        outs: List[Output] = []
+        for i in active:
+            req = self._slots[i]
+            n_acc = int(acc[i])
+            self._spec_drafted += k
+            self._spec_accepted += n_acc
+            monitor.counter("serving.spec_drafted").increase(k)
+            monitor.counter("serving.spec_accepted").increase(n_acc)
+            finished = False
+            for j in range(n_acc + 1):
+                tok = int(toks[i, j])
+                req.written += 1      # position pos+j held this input
+                req.generated.append(tok)
+                if req.first_token_t == 0.0:
+                    req.first_token_t = time.perf_counter()
+                monitor.counter("serving.tokens").increase()
+                reason = self._finish_reason(req, tok)
+                if reason:
+                    # mid-chain eos/budget: the tail of the chain is
+                    # discarded exactly like the plain loop would
+                    # never have generated it; _finish dirties the
+                    # slot so the device state is overwritten
+                    outs.append(self._finish(req, reason))
+                    finished = True
+                    break
+            if not finished:
+                # mirror the device-side advance (device already holds
+                # these values — not dirty)
+                self._pos[i] = req.written
+                self._last[i] = req.generated[-1]
+        return outs
+
     def _finish_reason(self, req: Request, tok: int) -> Optional[str]:
         p = req.params
         if p.eos_token_id is not None and tok == int(p.eos_token_id):
@@ -745,8 +985,15 @@ class Engine:
             self._bt_dirty = True
             req.slot = None
         if req.pages:
+            # one reference drop per page: private pages return to the
+            # free list, shared prefix pages live on under the cache's
+            # (or another request's) reference
             self._alloc.free(req.pages)
             req.pages = []
+        # a re-admission re-walks the prefix cache (the resume prefix
+        # is longer, and entries may have been evicted meanwhile)
+        req.shared_pages = None
+        req.prefix_len = 0
 
     def _finish(self, req: Request, reason: str) -> Output:
         req.finish_t = time.perf_counter()
@@ -774,3 +1021,27 @@ class Engine:
         monitor.gauge("serving.slots_active").set(self.num_active)
         monitor.gauge("serving.pages_free").set(self._alloc.free_pages)
         monitor.gauge("serving.queue_depth").set(len(self._waiting))
+        if self._prefix is not None:
+            monitor.gauge("serving.prefix_hit_rate").set(
+                self._prefix.hit_rate)
+            monitor.gauge("serving.prefix_pages_shared").set(
+                self._alloc.shared_pages)
+        if self._spec is not None and self._spec_drafted:
+            monitor.gauge("serving.spec_accept_rate").set(
+                self._spec_accepted / self._spec_drafted)
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        """Fraction of admission-time prefix lookups that mapped at
+        least one cached page (0.0 with the cache off)."""
+        if self._prefix is None:
+            return 0.0
+        return self._prefix.hit_rate
+
+    @property
+    def spec_accept_rate(self) -> float:
+        """Fraction of drafted tokens the target accepted (0.0 before
+        any draft ran or with speculation off)."""
+        if not self._spec_drafted:
+            return 0.0
+        return self._spec_accepted / self._spec_drafted

@@ -1,0 +1,171 @@
+"""Draft/verify speculative decoding on the serving engine.
+
+Every output token normally costs one full target-model decode step.
+With a small draft model (the zoo's small LLaMA runs ~25x the 1B's
+decode rate), the engine instead runs ``k`` cheap draft steps plus ONE
+target forward that scores all ``k + 1`` positions at once — the
+multi-position paths PR 5 built for continuous batching (per-slot
+``cache_index`` arrays, ``[b, s, L]`` cache masks, chunked
+``paged_write``) make the verify step just another fixed-shape call.
+Acceptance is EXACT-MATCH against the target's own sampling chain
+(``generation.verify_token_arrays``): a drafted token is kept only
+when it equals the token the target would have emitted with the same
+per-request rng key, so the engine's output with a draft attached is
+bit-identical to the engine without one — the token-exactness harness
+is the acceptance oracle, and each tick emits between 1 and k+1
+tokens instead of exactly 1.
+
+Compiled-surface discipline (the JaxPP rule every engine feature
+follows): the whole draft loop is ONE executable (a ``lax.scan`` of
+k+1 draft steps over the draft's own paged cache), and verify is one
+``[max_slots, k+1]`` executable per static sampler variant — no
+recompiles whatever the accept/reject trace.
+
+Cache bookkeeping: the draft model's paged KV pools mirror the
+engine's geometry EXACTLY — same page size, same page count, same
+block tables — so one allocator and one prefix cache govern both
+models: a page id handed to a request addresses its chunk in both
+pools, a shared prefix page carries both models' KV for those tokens,
+and preemption/eviction stay single-bookkeeping. Rejected positions
+simply hold stale KV above each sequence's valid length; the next
+tick's writes start exactly at the valid length, so stale slots are
+overwritten before they could ever be attended (causal masking hides
+them meanwhile).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..jit.functional import get_buffers, get_frozen, get_params
+from ..text.generation import _model_forward
+
+
+class SpeculativeDecoder:
+    """The draft side of the engine's draft/verify schedule.
+
+    Owns the draft model's functional state and paged KV pools, and
+    the two draft executable families: bucketed prefill (mirrors the
+    target prefill's cache writes — no sampling, the head matmul is
+    dead code XLA drops) and the k+1-step draft loop. The target-side
+    verify executable lives in the engine (it is a variant of the
+    decode step over the target model).
+    """
+
+    def __init__(self, engine, draft_model, k: int):
+        import inspect
+        if int(k) < 1:
+            raise ValueError(f"spec_k must be >= 1, got {k}")
+        try:
+            fsig = inspect.signature(draft_model.forward)
+        except (TypeError, ValueError):
+            fsig = None
+        if fsig is None or "kv_caches" not in fsig.parameters:
+            raise ValueError(
+                "speculative decoding needs a draft model with "
+                "kv_caches/cache_index forward kwargs; "
+                f"{type(draft_model).__name__}.forward has none")
+        dcfg = draft_model.config
+        tcfg = engine.model.config
+        if int(dcfg.vocab_size) != int(tcfg.vocab_size):
+            raise ValueError(
+                f"draft vocab ({dcfg.vocab_size}) must match the "
+                f"target vocab ({tcfg.vocab_size}) — drafted ids are "
+                f"verified against target logits position-for-position")
+        if int(dcfg.max_position_embeddings) < engine.max_context:
+            raise ValueError(
+                f"draft max_position_embeddings "
+                f"({dcfg.max_position_embeddings}) is shorter than the "
+                f"engine max_context ({engine.max_context})")
+        self.engine = engine
+        self.model = draft_model
+        self.k = int(k)
+        self._st = (get_params(draft_model), get_buffers(draft_model),
+                    get_frozen(draft_model))
+        from .engine import _make_paged_pools
+        hkv = dcfg.num_key_value_heads
+        hd = dcfg.hidden_size // dcfg.num_attention_heads
+        self._pools = _make_paged_pools(
+            dcfg.num_hidden_layers, engine.pool_pages + 1, hkv,
+            engine.page_size, hd, engine.cache_dtype, engine._quant)
+        self._prefill_fns = {}
+        self._loop_fn = None
+
+    # -- compiled surfaces ---------------------------------------------------
+
+    def _get_prefill_fn(self, pb: int):
+        """Draft prefill for a target prefill bucket: identical cache
+        writes (chunk at a traced per-call start offset) so the draft
+        pools track the target pools position-for-position; no token
+        is sampled — only the KV side effects matter."""
+        fn = self._prefill_fns.get(pb)
+        if fn is not None:
+            return fn
+        eng = self.engine
+        model = self.model
+
+        def body(st, caches, bt_row, prompt, start):
+            kv = eng._inject_bt(caches, bt_row)
+            _, new_kv = _model_forward(model, st, prompt, kv, start)
+            return eng._strip_bt(new_kv)
+
+        fn = jax.jit(body, donate_argnums=(1,))
+        self._prefill_fns[pb] = fn
+        eng._note_compile()
+        return fn
+
+    def _get_loop_fn(self):
+        """The k+1-step draft loop, ONE executable: step j feeds the
+        newest token at its slot position, writes draft KV, and argmax
+        proposes the next. k proposals come out; the extra (k+1)-th
+        step writes the LAST proposal's KV so the draft cache stays
+        position-complete through a fully accepted tick (its output
+        token is discarded). Greedy drafting is deterministic and
+        consumes no rng — the draft only ever influences WHICH
+        positions verify accepts, never what tokens the target emits."""
+        if self._loop_fn is not None:
+            return self._loop_fn
+        eng = self.engine
+        model = self.model
+        k = self.k
+
+        def body(st, caches, bt, last, pos, live):
+            def step(carry, _):
+                tok, kv, p = carry
+                idx = jnp.where(live > 0, p, -jnp.ones_like(p))
+                kvb = eng._inject_bt(kv, bt)
+                logits, new_kv = _model_forward(model, st, tok[:, None],
+                                                kvb, idx)
+                nxt = jnp.argmax(logits[:, -1].astype(jnp.float32),
+                                 axis=-1).astype(jnp.int32)
+                return (nxt, eng._strip_bt(new_kv), p + live), nxt
+
+            (_, caches, _), toks = jax.lax.scan(
+                step, (last, caches, pos), None, length=k + 1)
+            # toks[j] = proposal from step j; the (k+1)-th is the
+            # write-only step's by-product — dropped
+            return jnp.swapaxes(toks, 0, 1)[:, :k], caches
+
+        fn = jax.jit(body, donate_argnums=(1,))
+        self._loop_fn = fn
+        eng._note_compile()
+        return fn
+
+    # -- engine hooks --------------------------------------------------------
+
+    def prefill(self, pb: int, bt_row, prompt, start) -> None:
+        """Mirror one target prefill into the draft pools (same bucket,
+        same block-table row, same traced start offset)."""
+        fn = self._get_prefill_fn(pb)
+        self._pools = fn(self._st, self._pools, bt_row, prompt, start)
+
+    def draft(self, bt, last, pos, live):
+        """Propose k tokens per slot from the device-resident decode
+        state; returns drafts [max_slots, k] (device array — it feeds
+        the verify executable without a host round trip)."""
+        fn = self._get_loop_fn()
+        drafts, self._pools = fn(self._st, self._pools, bt, last, pos,
+                                 live)
+        return drafts

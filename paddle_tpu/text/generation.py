@@ -128,6 +128,70 @@ def sample_token_arrays(logits, keys, temperature, top_k, top_p,
                          jnp.asarray(top_p, jnp.float32))
 
 
+def verify_token_arrays(logits, drafts, keys, temperature, top_k, top_p,
+                        use_filters: bool = True, greedy: bool = False):
+    """Multi-position verify scoring — the speculative-decoding
+    acceptance core (inference/speculative.py). The target model scored
+    ``n = k + 1`` positions in ONE forward: position 0 continues the
+    real context, position j continues the context extended by draft
+    tokens ``drafts[:, :j]``. This walks the positions with the SAME
+    per-row sampler the plain engine uses (``sample_token_arrays`` —
+    pick_next-exact semantics, per-request rng chains) and accepts
+    draft tokens only while they MATCH the token the target chain
+    emits, so the emitted stream is bit-identical to the engine
+    without a draft model: token exactness is the acceptance rule, and
+    the output distribution is trivially the target's because every
+    emitted token is drawn from the target chain.
+
+    logits [b, n, V] float; drafts [b, n-1] int32 (the proposed
+    tokens); keys [b, 2] uint32; temperature/top_p [b] f32, top_k [b]
+    int. ``greedy=True`` is the all-greedy static variant (argmax, no
+    rng machinery traced); otherwise ``use_filters`` picks the
+    filtered/no-filter sampler exactly like the decode step variants.
+
+    Returns (tokens [b, n] int32, accepted [b] int32, new_keys
+    [b, 2]): row r's emission for the tick is tokens[r, :accepted[r]+1]
+    (accepted counts MATCHED drafts, so one extra "free" target token
+    always rides along); rows stop consuming rng at their first
+    mismatch, which leaves new_keys exactly where a plain per-token
+    decode of the same emission would leave them."""
+    n = logits.shape[1]
+    temperature = jnp.asarray(temperature, jnp.float32)
+    top_k = jnp.asarray(top_k, jnp.int32)
+    top_p = jnp.asarray(top_p, jnp.float32)
+    # position j matches against drafts[:, j]; the last position has no
+    # draft to match — a -1 sentinel (never a vocab id) ends the chain
+    b = logits.shape[0]
+    dr = jnp.concatenate(
+        [jnp.asarray(drafts, jnp.int32),
+         jnp.full((b, 1), -1, jnp.int32)], axis=1)       # [b, n]
+
+    def step(carry, x):
+        active, keys = carry
+        lg, d = x                                         # [b, V], [b]
+        if greedy:
+            tok = jnp.argmax(lg.astype(jnp.float32),
+                             axis=-1).astype(jnp.int32)
+            keys2 = keys
+        else:
+            tok, keys2 = sample_token_arrays(lg, keys, temperature,
+                                             top_k, top_p,
+                                             use_filters=use_filters)
+        # frozen rows (already mismatched) must not consume rng: their
+        # keys stay put so the NEXT tick resumes the chain exactly
+        keys = jnp.where(active[:, None], keys2, keys)
+        matched = jnp.logical_and(active, tok == d)
+        return (matched, keys), (tok, matched)
+
+    (_, new_keys), (toks, matches) = jax.lax.scan(
+        step, (jnp.ones((b,), bool), keys),
+        (jnp.swapaxes(logits, 0, 1), jnp.swapaxes(dr, 0, 1)))
+    tokens = jnp.swapaxes(toks, 0, 1)                     # [b, n]
+    accepted = jnp.sum(jnp.swapaxes(matches, 0, 1),
+                       axis=1).astype(jnp.int32)          # [b]
+    return tokens, accepted, new_keys
+
+
 def _resolve_cache_dtype(cache_dtype, params):
     """Resolve the cache_dtype knob to a concrete dtype. "auto" = the
     model's compute dtype: the params' floating dtype when it is

@@ -64,7 +64,9 @@ def test_headline_prints_first_and_extras_append(stubbed, capsys,
                 "llama_1b_decode_tokens_per_sec",
                 "llama_1b_decode_paged_int8_tokens_per_sec",
                 "llama_1b_serving_tokens_per_sec",
-                "llama_1b_serving_int8kv_tokens_per_sec"]:
+                "llama_1b_serving_int8kv_tokens_per_sec",
+                "llama_1b_serving_prefix_tokens_per_sec",
+                "llama_1b_serving_spec_tokens_per_sec"]:
         assert key in last, key
     assert "skipped" not in last
 
@@ -81,7 +83,8 @@ def test_budget_skips_extras_but_headline_survives(stubbed, capsys,
         "llama_decode_int8kv", "llama_decode_int8",
         "llama_decode_paged", "llama_decode_paged_int8",
         "llama_decode_rolling", "llama_serving",
-        "llama_serving_int8kv", "flashmask_8k"}
+        "llama_serving_int8kv", "llama_serving_prefix",
+        "llama_serving_spec", "flashmask_8k"}
     assert "llama_seq2048_mfu" not in lines[-1]["extras"]
 
 

@@ -1,0 +1,514 @@
+"""Prefix caching + speculative decoding on the serving engine
+(docs/SERVING.md "Prefix sharing & COW" / "Draft/verify schedule").
+
+The contracts under test:
+
+* Prefix reuse is INVISIBLE in the tokens: a request whose prompt hits
+  cached pages emits exactly the tokens a cold request (and a batch=1
+  ``generate``) emits, and its prefill runs ONLY the uncached tail
+  chunk (asserted via the ``serving.prefill_tokens`` counter).
+* Sharing is copy-on-write at page granularity: full page-aligned
+  prompt chunks are shared by refcount, the append/tail page is always
+  private, and eviction (refcount==0 LRU) or the holder's preemption
+  never corrupts another request's stream.
+* Chained hashes: a hit implies the whole prefix matches; a forced
+  digest collision degrades to a MISS (exact-token guard), never to
+  serving another prompt's KV.
+* Speculative decoding is TOKEN-EXACT: the engine with a draft model
+  attached emits bit-identical streams to the engine without one
+  (greedy and seeded sampling, GQA/int8-KV, through preemption) — the
+  exact-match acceptance rule makes the token-exactness harness the
+  acceptance oracle.
+* Both features stay on fixed compiled surfaces:
+  ``steady_state_recompiles() == 0`` across mixed traces with prefix
+  hits, COW forks, and spec decode enabled.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.inference.allocator import PageAllocator
+from paddle_tpu.inference.engine import Engine, SamplingParams
+from paddle_tpu.inference.prefix_cache import PrefixCache
+from paddle_tpu.text.generation import generate
+from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
+
+
+def _tiny_net(seed=0, layers=1, heads=2, vocab=32, hidden=32, kv=None,
+              window=None):
+    paddle.seed(seed)
+    cfg = LlamaConfig.tiny(vocab=vocab, hidden=hidden, layers=layers,
+                           heads=heads)
+    if kv is not None:
+        cfg.num_key_value_heads = kv
+    cfg.sliding_window = window
+    cfg.use_flash_attention = False
+    net = LlamaForCausalLM(cfg)
+    net.eval()
+    return net
+
+
+def _ref_row(net, prompt, max_new, **kw):
+    out = np.asarray(generate(net, paddle.to_tensor(prompt[None]),
+                              max_new, **kw).numpy())
+    return out[0, len(prompt):].tolist()
+
+
+def _sys_prompt(rng, n, vocab=32):
+    return rng.integers(0, vocab, (n,)).astype(np.int64)
+
+
+# -- allocator refcounts -----------------------------------------------------
+
+
+def test_allocator_refcounts_and_stats():
+    """Satellite: shared pages are refcounted (free = drop one ref,
+    page returns to the free list only at zero) and stats() reports
+    free/live/shared plus the refcount histogram."""
+    al = PageAllocator(4, base=1)
+    a = al.alloc(2, seq="a")
+    al.share(a[0])
+    al.share(a[0])
+    assert al.refcount(a[0]) == 3 and al.refcount(a[1]) == 1
+    assert al.shared_pages == 1
+    st = al.stats()
+    assert st["free"] == 2 and st["live"] == 2 and st["shared"] == 1
+    assert st["refcount_hist"] == {1: 1, 3: 1}
+    al.free([a[0]])               # drop one ref: page stays live
+    assert al.refcount(a[0]) == 2 and al.free_pages == 2
+    al.free([a[0], a[0]])         # last refs: back on the free list
+    assert al.refcount(a[0]) == 0 and al.free_pages == 3
+    with pytest.raises(RuntimeError, match="double-free|not live"):
+        al.free([a[0]])
+    with pytest.raises(RuntimeError, match="not live"):
+        al.share(a[0])
+    al.free([a[1]])
+    assert al.free_pages == 4 and al.stats()["refcount_hist"] == {}
+
+
+# -- prefix cache unit behavior ----------------------------------------------
+
+
+def test_prefix_cache_chained_hash_and_page_boundaries():
+    """Chained full-page chunks: a hit at depth i implies the whole
+    prefix matches; sub-page prompts cache nothing; insert registers
+    only full pages; acquire's max_chunks cap keeps the tail page
+    private (the COW rule)."""
+    al = PageAllocator(8, base=1)
+    cache = PrefixCache(al, page_size=4)
+    toks = list(range(10))                      # 2 full pages + tail
+    pages = al.alloc(3, seq="w")
+    assert cache.insert(toks, pages, len(toks)) == 2   # not the tail
+    assert al.refcount(pages[0]) == 2 and al.refcount(pages[2]) == 1
+    # full match walks the chain; a diverging SECOND chunk stops at 1
+    assert cache.lookup(toks) == 8
+    assert cache.lookup(toks[:4] + [99, 99, 99, 99]) == 4
+    # a diverging FIRST chunk misses entirely even though chunk 2's
+    # raw tokens exist in the store (chained hash: different parent)
+    assert cache.lookup([99] + toks[1:]) == 0
+    got, n = cache.acquire(toks, max_chunks=(len(toks) - 1) // 4)
+    assert got == pages[:2] and n == 8
+    assert al.refcount(pages[0]) == 3
+    # page-aligned prompt: max_chunks cap leaves the last page out
+    got2, n2 = cache.acquire(toks[:8], max_chunks=(8 - 1) // 4)
+    assert got2 == pages[:1] and n2 == 4
+    al.free(got + got2)
+
+
+def test_prefix_cache_collision_degrades_to_miss():
+    """A digest collision (forced: constant hash) must never serve
+    another prompt's pages — the exact-token compare turns it into a
+    miss on lookup and a no-op on insert."""
+    al = PageAllocator(8, base=1)
+    cache = PrefixCache(al, page_size=4, hash_fn=lambda par, ch: b"X")
+    a = al.alloc(1, seq="a")
+    cache.insert(list(range(4)), a, 4)
+    # same digest, different tokens: lookup misses, insert declines
+    assert cache.lookup([9, 9, 9, 9]) == 0
+    b = al.alloc(1, seq="b")
+    assert cache.insert([9, 9, 9, 9], b, 4) == 0
+    assert cache.lookup(list(range(4))) == 4    # incumbent intact
+    al.free(a + b)
+
+
+def test_prefix_cache_eviction_lru_leaves_first():
+    """Eviction reclaims idle (refcount==0 users) entries LRU,
+    leaves before parents — an interior chunk never outlives its
+    hittable descendants into unreachable garbage."""
+    al = PageAllocator(8, base=1)
+    cache = PrefixCache(al, page_size=2)
+    chain = al.alloc(3, seq="w")                # one 3-chunk chain
+    cache.insert([1, 2, 3, 4, 5, 6], chain, 6)
+    other = al.alloc(1, seq="v")
+    cache.insert([7, 8], other, 2)
+    al.free(chain + other)                      # writers gone: all idle
+    assert cache.evictable_pages == 4
+    # LRU: the [7, 8] entry is youngest; the chain evicts tail-first
+    assert cache.evict(2) == 2
+    assert cache.lookup([1, 2, 3, 4, 5, 6]) == 2    # deep chunks gone
+    assert cache.lookup([7, 8]) == 2                # young entry kept
+    # an in-use page is NOT evictable
+    held, n = cache.acquire([7, 8])
+    assert n == 2
+    assert cache.evict(10) == 1                 # only the idle root
+    assert cache.lookup([7, 8]) == 2
+    al.free(held)
+    assert cache.evict(10) == 1
+    assert al.free_pages == 8
+
+
+# -- engine prefix integration -----------------------------------------------
+
+
+def test_engine_prefix_hit_prefills_only_tail(rng):
+    """A hot system prompt's repeat request maps the cached pages and
+    prefills ONLY the uncached tail chunk (serving.prefill_tokens),
+    with tokens identical to the cold request and to b=1 generate."""
+    net = _tiny_net()
+    sys_p = _sys_prompt(rng, 16)
+    p1 = np.concatenate([sys_p, _sys_prompt(rng, 5)])
+    p2 = np.concatenate([sys_p, _sys_prompt(rng, 3)])
+    eng = Engine(net, max_slots=2, page_size=8, pool_pages=32,
+                 max_context=64, prefill_bucket=8, prefix_cache=True)
+    ctr = monitor.counter("serving.prefill_tokens")
+    c0 = ctr.get()
+    o1 = eng.run([(p1, SamplingParams(max_new_tokens=5))])[0]
+    cold_tokens = ctr.get() - c0
+    c0 = ctr.get()
+    o2 = eng.run([(p2, SamplingParams(max_new_tokens=5))])[0]
+    hot_tokens = ctr.get() - c0
+    assert o1.token_ids == _ref_row(net, p1, 5)
+    assert o2.token_ids == _ref_row(net, p2, 5)
+    # cold ran the whole 21-token prompt (bucketed to 24); hot ran only
+    # the 3+2-token tail past the 16 cached tokens (bucketed to 8)
+    assert cold_tokens == 24 and hot_tokens == 8
+    assert eng.prefix_hit_rate == 0.5
+    assert monitor.counter("serving.prefix_tokens_reused").get() >= 16
+    # repeat of the EXACT prompt still leaves >=1 real token for the
+    # tail step (first-token logits need a forward)
+    o3 = eng.run([(p1, SamplingParams(max_new_tokens=5))])[0]
+    assert o3.token_ids == o1.token_ids
+
+
+def test_engine_prefix_deep_hit_near_max_context(rng):
+    """A cached prefix deep enough that less than one full prefill
+    bucket of block-table room remains: the tail's bucket padding must
+    be capped to the table, not overflow the [1, max_blocks] row."""
+    net = _tiny_net()
+    prompt = _sys_prompt(rng, 60)
+    eng = Engine(net, max_slots=2, page_size=16, pool_pages=32,
+                 max_context=64, prefill_bucket=32, prefix_cache=True)
+    assert eng.max_blocks == 4
+    o1 = eng.run([(prompt, SamplingParams(max_new_tokens=4))])[0]
+    # hot rerun: 3 pages (48 tokens) cached, 12-token tail would
+    # bucket to 32 — past the one remaining page
+    o2 = eng.run([(prompt, SamplingParams(max_new_tokens=4))])[0]
+    ref = _ref_row(net, prompt, 4)
+    assert o1.token_ids == ref and o2.token_ids == ref
+
+
+def test_engine_prefix_cow_concurrent_divergence(rng):
+    """COW fork: two LIVE requests share the prefix pages while each
+    generates a different continuation into its own private tail page
+    — both streams exact, the shared pages show refcount > 1, and the
+    drained engine leaves only the cache's references behind."""
+    net = _tiny_net(seed=1)
+    sys_p = _sys_prompt(rng, 16)
+    pa = np.concatenate([sys_p, _sys_prompt(rng, 4)])
+    pb = np.concatenate([sys_p, _sys_prompt(rng, 6)])
+    eng = Engine(net, max_slots=2, page_size=8, pool_pages=32,
+                 max_context=64, prefill_bucket=8, prefix_cache=True)
+    # warm the cache, then run BOTH requests concurrently
+    eng.run([(pa, SamplingParams(max_new_tokens=2))])
+    ra = eng.add_request(pa, SamplingParams(max_new_tokens=8))
+    rb = eng.add_request(pb, SamplingParams(max_new_tokens=8))
+    eng.step()
+    assert eng._alloc.shared_pages >= 2      # both rows map the prefix
+    done = {}
+    for _ in range(20):
+        for o in eng.step():
+            done[o.req_id] = o
+        if len(done) == 2:
+            break
+    assert done[ra].token_ids == _ref_row(net, pa, 8)
+    assert done[rb].token_ids == _ref_row(net, pb, 8)
+    # page-ALIGNED prompt: the last full page is the COW fork — it
+    # stays private (cache may hold a copy of its content under the
+    # writer's page, but generation appends past it without sharing)
+    pc = np.concatenate([sys_p, _sys_prompt(rng, 8)])   # 24 = 3 pages
+    oc1 = eng.run([(pc, SamplingParams(max_new_tokens=6))])[0]
+    oc2 = eng.run([(pc, SamplingParams(max_new_tokens=6))])[0]
+    ref = _ref_row(net, pc, 6)
+    assert oc1.token_ids == ref and oc2.token_ids == ref
+
+
+def test_engine_prefix_eviction_and_preempted_holder(rng):
+    """Pool pressure reclaims idle cached pages before any live
+    sequence is preempted; preempting a SHARED page's holder only
+    drops its reference — the resumed request and every other mapper
+    still emit exact streams."""
+    net = _tiny_net(seed=2)
+    sys_p = _sys_prompt(rng, 8)
+    pa = np.concatenate([sys_p, _sys_prompt(rng, 3)])
+    pb = np.concatenate([sys_p, _sys_prompt(rng, 2)])
+    monitor.counter("serving.preemptions").reset()
+    # pool of 7: two ~3-page sequences + the shared prefix page force
+    # eviction and then preemption mid-run
+    eng = Engine(net, max_slots=2, page_size=4, pool_pages=7,
+                 max_context=28, prefill_bucket=4, watermark_pages=0,
+                 prefix_cache=True)
+    outs = eng.run([(pa, SamplingParams(max_new_tokens=10)),
+                    (pb, SamplingParams(max_new_tokens=10))])
+    assert outs[0].token_ids == _ref_row(net, pa, 10)
+    assert outs[1].token_ids == _ref_row(net, pb, 10)
+    assert monitor.counter("serving.preemptions").get() > 0
+    # drained: every page either free or held by the cache alone
+    assert eng._alloc.free_pages + eng._prefix.evictable_pages == 7
+    eng._prefix.clear()
+    assert eng._alloc.free_pages == 7
+
+
+# -- speculative decoding ----------------------------------------------------
+
+
+def test_spec_token_exact_greedy_and_sampled(rng):
+    """Acceptance oracle: the engine WITH a (different-weights) draft
+    emits bit-identical streams to b=1 generate for greedy,
+    temperature-only, and composed-filter sampling configs."""
+    net = _tiny_net(seed=3)
+    draft = _tiny_net(seed=11)
+    prompts = [_sys_prompt(rng, n) for n in (5, 9, 4)]
+    cfgs = [dict(max_new_tokens=8),
+            dict(max_new_tokens=6, temperature=0.9, seed=3),
+            dict(max_new_tokens=7, temperature=1.1, top_k=6, top_p=0.9,
+                 seed=9)]
+    eng = Engine(net, max_slots=3, page_size=8, pool_pages=32,
+                 max_context=64, draft_model=draft, spec_k=3)
+    outs = eng.run([(p, SamplingParams(**c))
+                    for p, c in zip(prompts, cfgs)])
+    for p, c, o in zip(prompts, cfgs, outs):
+        ref = _ref_row(net, p, c["max_new_tokens"],
+                       temperature=c.get("temperature", 0.0),
+                       top_k=c.get("top_k", 0),
+                       top_p=c.get("top_p", 0.0), seed=c.get("seed", 0))
+        assert o.token_ids == ref, (o.token_ids, ref)
+    assert monitor.counter("serving.spec_drafted").get() > 0
+
+
+def test_spec_self_draft_accepts_everything(rng):
+    """Draft == target: greedy acceptance is total (accept rate 1.0)
+    and a request drains in ~new/(k+1) verify ticks — the speedup
+    mechanism, visible in step counts on CPU."""
+    net = _tiny_net(seed=4)
+    p = _sys_prompt(rng, 6)
+    eng = Engine(net, max_slots=2, page_size=8, pool_pages=16,
+                 max_context=48, draft_model=net, spec_k=3)
+    rid = eng.add_request(p, SamplingParams(max_new_tokens=9))
+    done = {}
+    ticks = 0
+    for _ in range(20):
+        ticks += 1
+        for o in eng.step():
+            done[o.req_id] = o
+        if done:
+            break
+    assert done[rid].token_ids == _ref_row(net, p, 9)
+    assert eng.spec_accept_rate == 1.0
+    # tick 1: prefill + first token + verify chain of 4 → 5 tokens;
+    # tick 2: 4 more → 9 of 9. A plain engine needs 9 ticks.
+    assert ticks == 2, ticks
+
+
+def test_spec_gqa_int8_window_token_exact(rng):
+    """The model-variant matrix with a draft attached: GQA caches,
+    sliding-window masks, int8 KV pools (draft pools quantized too) —
+    all bit-exact vs the one-shot reference path."""
+    prompts = [_sys_prompt(rng, n) for n in (5, 11)]
+    # GQA + sliding window
+    net = _tiny_net(seed=5, heads=4, hidden=64, kv=2, window=6)
+    dr = _tiny_net(seed=12, heads=4, hidden=64, kv=2, window=6)
+    eng = Engine(net, max_slots=2, page_size=8, pool_pages=16,
+                 max_context=48, draft_model=dr, spec_k=2)
+    refs = [_ref_row(net, p, 8, cache_impl="paged", page_size=8)
+            for p in prompts]
+    outs = eng.run([(p, SamplingParams(max_new_tokens=8))
+                    for p in prompts])
+    for ref, out in zip(refs, outs):
+        assert out.token_ids == ref
+    # GQA + int8 KV
+    net8 = _tiny_net(seed=6, heads=4, hidden=64, kv=2)
+    dr8 = _tiny_net(seed=13, heads=4, hidden=64, kv=2)
+    eng8 = Engine(net8, max_slots=2, page_size=8, pool_pages=16,
+                  max_context=48, cache_dtype="int8", draft_model=dr8,
+                  spec_k=2)
+    refs8 = [_ref_row(net8, p, 6, cache_dtype="int8") for p in prompts]
+    outs8 = eng8.run([(p, SamplingParams(max_new_tokens=6))
+                      for p in prompts])
+    for ref, out in zip(refs8, outs8):
+        assert out.token_ids == ref
+
+
+def test_spec_through_preemption(rng):
+    """A preempted speculative request resumes exactly: pages freed,
+    rng chain and draft cache rebuilt, the verify chain continues
+    bit-identically to the uninterrupted stream."""
+    net = _tiny_net(seed=7)
+    prompts = [_sys_prompt(rng, 4), _sys_prompt(rng, 3)]
+    monitor.counter("serving.preemptions").reset()
+    # both sequences grow to 5 pages but the pool holds 7: growth must
+    # preempt mid-run (spec lookahead pages included)
+    eng = Engine(net, max_slots=2, page_size=4, pool_pages=7,
+                 max_context=20, prefill_bucket=4, watermark_pages=0,
+                 draft_model=net, spec_k=2)
+    outs = eng.run([(p, SamplingParams(max_new_tokens=14))
+                    for p in prompts])
+    assert monitor.counter("serving.preemptions").get() > 0
+    for p, o in zip(prompts, outs):
+        assert o.token_ids == _ref_row(net, p, 14)
+    assert eng.pages_free == eng.pool_pages
+
+
+def test_spec_eos_mid_chain(rng):
+    """An eos landing INSIDE an accepted chain finishes the request at
+    the eos token — the chain's tail is discarded exactly as if it had
+    never been drafted."""
+    net = _tiny_net(seed=8)
+    p = _sys_prompt(rng, 5)
+    ref = _ref_row(net, p, 10)
+    eos = ref[4]                  # mid-stream token becomes the eos
+    eng = Engine(net, max_slots=1, page_size=8, pool_pages=16,
+                 max_context=48, draft_model=net, spec_k=3)
+    out = eng.run([(p, SamplingParams(max_new_tokens=10,
+                                      eos_token_id=eos))])[0]
+    stop = ref.index(eos)
+    assert out.token_ids == ref[:stop + 1]
+    assert out.finish_reason == "eos"
+
+
+def test_spec_validates_draft_model(rng):
+    """Mismatched drafts fail loudly at construction: missing KV-cache
+    support, foreign vocab, short rope range."""
+    import paddle_tpu.nn as nn
+    net = _tiny_net(seed=9)
+
+    class NoCache(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.config = LlamaConfig.tiny()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    with pytest.raises(ValueError, match="kv_caches"):
+        Engine(net, max_slots=1, page_size=8, pool_pages=8,
+               max_context=32, draft_model=NoCache())
+    with pytest.raises(ValueError, match="vocab"):
+        Engine(net, max_slots=1, page_size=8, pool_pages=8,
+               max_context=32, draft_model=_tiny_net(vocab=64))
+    with pytest.raises(ValueError, match="spec_k"):
+        Engine(net, max_slots=1, page_size=8, pool_pages=8,
+               max_context=32, draft_model=_tiny_net(seed=9), spec_k=0)
+
+
+def test_add_request_capacity_error_names_request(rng):
+    """Satellite: an impossible request fails at add_request with the
+    request id and its page demand in the message — never mid-prefill
+    in _page_slots."""
+    net = _tiny_net()
+    eng = Engine(net, max_slots=2, page_size=8, pool_pages=4,
+                 max_context=64, prefill_bucket=8)
+    with pytest.raises(RuntimeError) as ei:
+        eng.add_request(np.zeros((30,), np.int64),
+                        SamplingParams(max_new_tokens=10))
+    msg = str(ei.value)
+    assert "request 0" in msg and "page" in msg and "4" in msg
+    # the id in the error tracks the would-be id of the NEXT request
+    eng.add_request(np.zeros((5,), np.int64),
+                    SamplingParams(max_new_tokens=4))
+    with pytest.raises(RuntimeError, match="request 1"):
+        eng.add_request(np.zeros((30,), np.int64),
+                        SamplingParams(max_new_tokens=10))
+
+
+# -- compiled-surface discipline ---------------------------------------------
+
+
+def test_prefix_spec_zero_recompiles_mixed_trace(rng):
+    """Acceptance criterion: a mixed trace with prefix hits, COW
+    forks, sampler-variant flips, and spec decode enabled triggers
+    ZERO steady-state recompiles — both features are new scheduler
+    states over fixed compiled surfaces, never new executables."""
+    net = _tiny_net(seed=10)
+    draft = _tiny_net(seed=14)
+    sys_p = _sys_prompt(rng, 16)
+    eng = Engine(net, max_slots=3, page_size=8, pool_pages=64,
+                 max_context=64, prefill_bucket=8, prefix_cache=True,
+                 draft_model=draft, spec_k=2)
+    cfgs = [dict(max_new_tokens=5),
+            dict(max_new_tokens=4, temperature=0.8, seed=3),
+            dict(max_new_tokens=6, temperature=1.1, top_k=6,
+                 top_p=0.9, seed=9)]
+    # warmup: every variant + both prefill buckets, cold prefixes
+    for n, c in zip((3, 7, 2), cfgs):
+        p = np.concatenate([sys_p, _sys_prompt(rng, n)])
+        eng.run([(p, SamplingParams(**c))])
+    # measured wave: prefix hits + staggered admissions + variant flips
+    wave = [np.concatenate([sys_p, _sys_prompt(rng, n)])
+            for n in (4, 6, 1)]
+    ids = [eng.add_request(wave[0], SamplingParams(**cfgs[1]))]
+    eng.step()
+    ids += [eng.add_request(wave[1], SamplingParams(**cfgs[2])),
+            eng.add_request(wave[2], SamplingParams(**cfgs[0]))]
+    done = {}
+    for _ in range(60):
+        for o in eng.step():
+            done[o.req_id] = o
+        if len(done) >= 3:
+            break
+    for rid, p, c in zip(ids, wave, [cfgs[1], cfgs[2], cfgs[0]]):
+        ref = _ref_row(net, p, c["max_new_tokens"],
+                       temperature=c.get("temperature", 0.0),
+                       top_k=c.get("top_k", 0),
+                       top_p=c.get("top_p", 0.0), seed=c.get("seed", 0))
+        assert done[rid].token_ids == ref, rid
+    assert eng.prefix_hit_rate > 0.5
+    assert eng.steady_state_recompiles() == 0
+
+
+def test_replay_prefix_fixture_hit_rate_and_ttft(rng, capsys):
+    """Satellite: the prefix-heavy replay trace shows hit_rate > 0.5
+    and a TTFT p50 below the same trace replayed cold
+    (--no-prefix-cache); the --expect-prefix-hit-rate guard exits 5 on
+    the cold run."""
+    import json as _json
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    try:
+        import serving_replay
+    finally:
+        sys.path.pop(0)
+    trace = os.path.join(repo, "tests", "fixtures",
+                         "serving_trace_prefix.jsonl")
+    base = [trace, "--layers", "1", "--hidden", "32", "--heads", "2",
+            "--vocab", "32", "--max-slots", "2", "--pool-pages", "32",
+            "--json"]
+    rc = serving_replay.main(base + ["--expect-prefix-hit-rate", "0.5"])
+    warm = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert warm["prefix_hit_rate"] > 0.5
+    assert warm["steady_state_recompiles"] == 0
+    rc = serving_replay.main(base + ["--no-prefix-cache",
+                                     "--expect-prefix-hit-rate", "0.5"])
+    cap = capsys.readouterr()
+    cold = _json.loads(cap.out.strip().splitlines()[-1])
+    assert rc == 5
+    assert "expect-prefix-hit-rate FAILED" in cap.err
+    assert cold["prefix_hit_rate"] == 0.0
+    assert warm["ttft_ms"]["p50"] < cold["ttft_ms"]["p50"]
+    assert warm["counters"]["serving.prefill_tokens"] < \
+        cold["counters"]["serving.prefill_tokens"]

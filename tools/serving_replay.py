@@ -4,23 +4,40 @@
 Run: python tools/serving_replay.py trace.jsonl [--max-slots 4]
          [--page-size 8] [--pool-pages 64] [--layers 2] [--hidden 64]
          [--heads 4] [--vocab 64] [--seed 0] [--step-ms 5]
-         [--temperature 0] [--cache-dtype auto] [--json]
-         [--expect-pallas]
+         [--prefill-token-ms 0.1] [--temperature 0]
+         [--cache-dtype auto] [--no-prefix-cache] [--spec-k 0]
+         [--draft-layers 1] [--json] [--expect-pallas]
+         [--expect-prefix-hit-rate 0.5]
 
 Each trace line is one request:
 
     {"arrival_ms": 0, "prompt_len": 7, "new_tokens": 9}
 
+``prompt_len`` tokens are drawn per-request from the trace rng; an
+optional ``"system_len": N`` marks the FIRST N tokens as the shared
+system prompt (one fixed token block across the whole trace) — the
+prefix-cache scenario, where every request after the first maps the
+shared pages and prefills only its divergent tail.
+
 The tool builds a tiny in-memory LLaMA on the CPU backend (geometry
 from the flags — this measures the SCHEDULER, not the model), drives
-``paddle_tpu.inference.Engine`` on a virtual clock that advances
-``--step-ms`` per engine step (deterministic: the same trace always
-yields the same admission schedule and the same percentiles), and
-prints TTFT / TPOT / throughput percentiles plus the per-replay
-``kernels.decode.*`` path breakdown (pallas vs gather fallback) and
-``serving.*`` counters (docs/OBSERVABILITY.md) — the first thing to
-read when a serving number regresses is whether the compiled loop left
-the expected attention path or started recompiling.
+``paddle_tpu.inference.Engine`` on a virtual clock (deterministic: the
+same trace always yields the same admission schedule and the same
+percentiles) that advances ``--step-ms`` per engine step PLUS
+``--prefill-token-ms`` per prefill token the step executed — so a
+prefix-cache hit, which prefills only the uncached tail chunk, shows
+up directly as lower TTFT. It prints TTFT / TPOT / throughput
+percentiles, ``prefix_hit_rate`` / ``spec_accept_rate``, the
+per-replay ``kernels.decode.*`` path breakdown (pallas vs gather
+fallback) and ``serving.*`` counters (docs/OBSERVABILITY.md) — the
+first thing to read when a serving number regresses is whether the
+compiled loop left the expected attention path or started recompiling.
+
+The prefix cache is ON by default (``--no-prefix-cache`` disables it —
+the cold-prefix baseline run); ``--spec-k N`` attaches a
+``--draft-layers``-deep draft model and decodes through the
+draft/verify schedule (token-identical by construction; the report's
+``spec_accept_rate`` says how often the draft earned its keep).
 
 ``--expect-pallas`` turns a silent fallback into a LOUD failure (exit
 code 4): the replay must have traced the Pallas paged-decode kernel
@@ -28,8 +45,14 @@ and no single-token step may have taken the XLA gather path. Use it
 as the CI guard around TPU serving configs — today a fallback only
 shows up as slow numbers. (On the CPU backend the Pallas path never
 runs, so the flag always fails there — by design.)
+``--expect-prefix-hit-rate X`` does the same for prefix reuse (exit
+code 5 when the replay's hit rate lands below X): the guard for
+prefix-heavy fixtures where a silent cache regression would only read
+as higher TTFT.
 
-A tiny fixture trace lives at tests/fixtures/serving_trace.jsonl.
+Fixture traces live at tests/fixtures/serving_trace.jsonl and
+tests/fixtures/serving_trace_prefix.jsonl (prefix-heavy: one shared
+system prompt, divergent user turns).
 """
 from __future__ import annotations
 
@@ -63,8 +86,19 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--step-ms", type=float, default=5.0,
                     help="virtual clock advance per engine step")
+    ap.add_argument("--prefill-token-ms", type=float, default=0.1,
+                    help="virtual clock advance per prefill token a "
+                         "step executed (cached prefixes skip these)")
     ap.add_argument("--temperature", type=float, default=0.0)
     ap.add_argument("--cache-dtype", default="auto")
+    ap.add_argument("--no-prefix-cache", action="store_true",
+                    help="disable shared-prefix KV reuse (the "
+                         "cold-prefix baseline)")
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="speculative decoding: draft k tokens per "
+                         "slot per tick (0 = off)")
+    ap.add_argument("--draft-layers", type=int, default=1,
+                    help="layer count of the draft model (--spec-k)")
     ap.add_argument("--json", action="store_true",
                     help="emit one machine-readable JSON line instead "
                          "of the text report")
@@ -72,6 +106,10 @@ def main(argv=None) -> int:
                     help="fail (exit 4) when the replay fell off the "
                          "Pallas paged-decode path — any single-token "
                          "gather step, or no pallas trace at all")
+    ap.add_argument("--expect-prefix-hit-rate", type=float,
+                    default=None, metavar="RATE",
+                    help="fail (exit 5) when prefix_hit_rate lands "
+                         "below RATE")
     args = ap.parse_args(argv)
 
     if not os.path.exists(args.trace):
@@ -107,19 +145,43 @@ def main(argv=None) -> int:
     cfg = LlamaConfig.tiny(vocab=args.vocab, hidden=args.hidden,
                            layers=args.layers, heads=args.heads)
     cfg.max_position_embeddings = max(cfg.max_position_embeddings,
-                                      max_ctx)
+                                      max_ctx + max(args.spec_k, 0) + 1)
     cfg.use_flash_attention = False
     net = LlamaForCausalLM(cfg)
     net.eval()
+    draft = None
+    if args.spec_k > 0:
+        paddle.seed(args.seed + 1)
+        dcfg = LlamaConfig.tiny(vocab=args.vocab, hidden=args.hidden,
+                                layers=args.draft_layers,
+                                heads=args.heads)
+        dcfg.max_position_embeddings = cfg.max_position_embeddings
+        dcfg.use_flash_attention = False
+        draft = LlamaForCausalLM(dcfg)
+        draft.eval()
     eng = Engine(net, max_slots=args.max_slots,
                  page_size=args.page_size, pool_pages=args.pool_pages,
                  prefill_bucket=args.prefill_bucket,
-                 cache_dtype=args.cache_dtype, max_context=max_ctx)
+                 cache_dtype=args.cache_dtype, max_context=max_ctx,
+                 prefix_cache=not args.no_prefix_cache,
+                 draft_model=draft, spec_k=max(args.spec_k, 1))
 
     rng = np.random.default_rng(args.seed)
-    prompts = [rng.integers(0, args.vocab,
-                            (r["prompt_len"],)).astype(np.int64)
-               for r in trace]
+    # the shared system prompt is ONE token block: request prompts with
+    # "system_len": N open with its first N tokens (page-aligned
+    # chunks of it dedup through the prefix cache), then diverge
+    max_sys = max((r.get("system_len", 0) for r in trace), default=0)
+    # drawn only when the trace uses it: legacy traces (no system_len)
+    # keep their exact rng stream, so replays stay comparable across
+    # tool versions
+    system = (rng.integers(0, args.vocab, (max_sys,)) if max_sys
+              else np.zeros((0,), np.int64))
+    prompts = []
+    for r in trace:
+        sl = min(int(r.get("system_len", 0)), int(r["prompt_len"]))
+        tail = rng.integers(0, args.vocab, (r["prompt_len"] - sl,))
+        prompts.append(np.concatenate([system[:sl], tail])
+                       .astype(np.int64))
     before = monitor.snapshot()
     vt = 0.0                       # virtual clock, ms
     arrival_vt = {}
@@ -128,6 +190,8 @@ def main(argv=None) -> int:
     i = 0
     t0 = time.perf_counter()
     steps = 0
+    pf_key = "serving.prefill_tokens"
+    pf_before = int(before.get(pf_key, 0))
     while len(finish) < len(trace):
         while i < len(trace) and trace[i]["arrival_ms"] <= vt:
             rid = eng.add_request(
@@ -142,14 +206,21 @@ def main(argv=None) -> int:
             # idle gap: fast-forward to the next arrival
             vt = max(vt, float(trace[i]["arrival_ms"]))
             continue
-        for out in eng.step():
-            finish[out.req_id] = (out, vt + args.step_ms)
+        outs = eng.step()
+        steps += 1
+        # virtual cost of the tick: one decode step plus the prefill
+        # tokens it executed (prefix hits prefill only their tail, so
+        # reuse shows up directly in TTFT)
+        pf_now = int(monitor.counter(pf_key).get())
+        vt += args.step_ms \
+            + (pf_now - pf_before) * args.prefill_token_ms
+        pf_before = pf_now
+        for out in outs:
+            finish[out.req_id] = (out, vt)
             # a request can finish the same tick it got its first
             # token (max_new_tokens=1) — the engine prunes finished
             # requests, so record its TTFT here
-            first_vt.setdefault(out.req_id, vt + args.step_ms)
-        steps += 1
-        vt += args.step_ms
+            first_vt.setdefault(out.req_id, vt)
         # eng.requests holds only LIVE requests (waiting/active)
         for rid, req in eng.requests.items():
             if rid not in first_vt and req.generated:
@@ -174,7 +245,10 @@ def main(argv=None) -> int:
     deltas = {k: int(after.get(k, 0)) - int(before.get(k, 0))
               for k in after
               if k.startswith(("kernels.decode.", "kernels.flash.",
-                               "serving.preemptions", "xla.compiles"))
+                               "serving.preemptions",
+                               "serving.prefill_tokens",
+                               "serving.prefix_", "serving.spec_",
+                               "xla.compiles"))
               and int(after.get(k, 0)) - int(before.get(k, 0))}
     # the per-replay decode-path breakdown: which attention path the
     # compiled loops actually baked in (trace-time counters,
@@ -198,6 +272,8 @@ def main(argv=None) -> int:
         "preemptions": preempts,
         "ttft_ms": _percentiles(ttft),
         "tpot_ms": _percentiles(tpot),
+        "prefix_hit_rate": round(eng.prefix_hit_rate, 4),
+        "spec_accept_rate": round(eng.spec_accept_rate, 4),
         "decode_paths": decode_paths,
         "pallas_eligible": bool(eng.pallas_eligible),
         "counters": deltas,
@@ -220,6 +296,8 @@ def main(argv=None) -> int:
         print(f"  preemptions {report['preemptions']}  "
               f"steady_state_recompiles "
               f"{report['steady_state_recompiles']}")
+        print(f"  prefix_hit_rate {report['prefix_hit_rate']}  "
+              f"spec_accept_rate {report['spec_accept_rate']}")
         print("  decode paths: " + "  ".join(
             f"{k} +{v}" for k, v in decode_paths.items()))
         if not eng.pallas_eligible:
@@ -236,6 +314,14 @@ def main(argv=None) -> int:
               f"stay on kernels.decode.paged_pallas "
               f"(docs/DECODE.md eligibility table)", file=sys.stderr)
         return 4
+    if args.expect_prefix_hit_rate is not None and \
+            report["prefix_hit_rate"] < args.expect_prefix_hit_rate:
+        print(f"serving_replay: --expect-prefix-hit-rate FAILED — "
+              f"{report['prefix_hit_rate']} < "
+              f"{args.expect_prefix_hit_rate} "
+              f"({'prefix cache DISABLED' if args.no_prefix_cache else 'shared prefixes are not being reused'}; "
+              f"docs/SERVING.md prefix lifecycle)", file=sys.stderr)
+        return 5
     return 0
 
 
