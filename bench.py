@@ -63,28 +63,55 @@ def _peak():
 _decode_paths = {}
 
 
+# bench name -> nonzero kernels.moe.dispatch_path.* deltas around the
+# run (pallas / einsum / scatter / fallback.<reason> — trace-time, so
+# they name the dispatch the compiled step actually baked in); empty =
+# warm executables, path decided in an earlier run
+_moe_paths = {}
+# bench name -> nonzero kernels.flash.sdpa.* deltas (pallas[_mask] /
+# xla[_mask] / xla_dense_mask / xla_core) — which attention path the
+# encoder models traced
+_sdpa_paths = {}
+
+
+def _counter_deltas(prefix, fn):
+    """Run fn and return (its result, the nonzero trace-time counter
+    deltas under `prefix` keyed by suffix)."""
+    from paddle_tpu import monitor
+    before = monitor.snapshot()
+    out = fn()
+    after = monitor.snapshot()
+    deltas = {}
+    for key, val in after.items():
+        if key.startswith(prefix + "."):
+            d = int(val) - int(before.get(key, 0))
+            if d > 0:
+                deltas[key[len(prefix) + 1:]] = d
+    return out, deltas
+
+
+def _record_counter_paths(store, prefix, name, fn):
+    """Run a bench and attribute which kernel path its compiled program
+    baked in, from the trace-time counter deltas under `prefix`."""
+    out, deltas = _counter_deltas(prefix, fn)
+    store[name] = deltas if deltas else "cached-executable"
+    return out
+
+
 def _record_decode_path(name, fn):
     """Run a decode bench and attribute which attention path its
     compiled loop took from the kernels.decode.* counter deltas."""
-    from paddle_tpu import monitor
-    before = monitor.snapshot()
-    tok = fn()
-    after = monitor.snapshot()
-
-    def delta(c):
-        return int(after.get(c, 0)) - int(before.get(c, 0))
-
-    if delta("kernels.decode.paged_pallas") > 0:
-        path = "pallas"
-    elif delta("kernels.decode.paged_xla_gather_step") > 0:
-        path = "xla-gather"
-    elif delta("kernels.decode.rolling_xla") > 0:
-        path = "xla-rolling"
-    elif delta("kernels.decode.dense_xla") > 0:
-        path = "xla-dense"
+    tok, deltas = _counter_deltas("kernels.decode", fn)
+    for suffix, path in (("paged_pallas", "pallas"),
+                         ("paged_xla_gather_step", "xla-gather"),
+                         ("rolling_xla", "xla-rolling"),
+                         ("dense_xla", "xla-dense")):
+        if deltas.get(suffix, 0) > 0:
+            _decode_paths[name] = path
+            break
     else:
-        path = "cached-executable"   # no retrace: path decided earlier
-    _decode_paths[name] = path
+        # no retrace: path decided by an earlier run's executables
+        _decode_paths[name] = "cached-executable"
     return tok
 
 
@@ -100,6 +127,12 @@ def _telemetry_extras(result):
     tel = result["extras"].setdefault("telemetry", {})
     if _decode_paths:
         tel["decode_attention_path"] = dict(_decode_paths)
+    if _moe_paths:
+        # the dispatch-path breakdown: a silent degrade from pallas to
+        # einsum shows up here as fallback.<reason> in every bench run
+        tel["moe_dispatch_path"] = dict(_moe_paths)
+    if _sdpa_paths:
+        tel["sdpa_attention_path"] = dict(_sdpa_paths)
     if not monitor.enabled():
         if not tel:
             result["extras"].pop("telemetry", None)
@@ -230,7 +263,11 @@ def bench_bert(cfg=None, batch=256, seq=128, n_steps=10):
     head_dim-64 matmuls run at half MXU efficiency) 4x vs seq 512;
     int32 ids avoid emulated i64 index math; dense softmax-CE beats the
     chunked fused-CE scan at this size (the [b, s, vocab] bf16 logits
-    are only 2 GB). To benchmark the fused-CE path instead, pass
+    are only 2 GB). The encoder attention now routes through the Pallas
+    flash kernel via scaled_dot_product_attention (head-dim-64
+    probe-gated, docs/KERNELS.md); extras.telemetry.sdpa_attention_path
+    shows which path this run traced. To benchmark the fused-CE path
+    instead, pass
     cfg.fused_mlm_ce=True AND labels as the third forward input with an
     identity loss_fn — forward(ids, tt, labels) then returns the loss
     directly (see tests/test_text_models.py fused test)."""
@@ -269,7 +306,8 @@ def bench_bert(cfg=None, batch=256, seq=128, n_steps=10):
     return tokens_per_sec, mfu
 
 
-def bench_ernie_moe(cfg=None, batch=32, seq=512, n_steps=6):
+def bench_ernie_moe(cfg=None, batch=32, seq=512, n_steps=6,
+                    dispatch_mode=None):
     """ERNIE-MoE causal LM step (BASELINE config 5 family, single chip):
     (tokens/sec, routed MFU). The MFU numerator is ACTIVE-params FLOPs
     (top_k experts/token + router, ernie_moe_flops_per_token) — the
@@ -277,8 +315,11 @@ def bench_ernie_moe(cfg=None, batch=32, seq=512, n_steps=6):
     overstate it by num_experts/top_k on the expert FFNs. batch 32 is
     the measured peak with GShard group-wise dispatch (71.7K tok/s —
     1.9x the ungrouped dispatch at the same shape, whose einsum cost is
-    quadratic in tokens; 64 regresses). The einsum-vs-scatter dispatch
-    study at E 8/32/64 lives in docs/PERF.md."""
+    quadratic in tokens; 64 regresses). The einsum/scatter/pallas
+    dispatch studies live in docs/PERF.md; the default config now runs
+    dispatch_mode="pallas" (the fused grouped-matmul kernel), and the
+    extras.telemetry.moe_dispatch_path breakdown shows whether the run
+    stayed on it. `dispatch_mode` overrides the config's mode."""
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     from paddle_tpu.text.models import ErnieMoEConfig, ErnieMoEForCausalLM
@@ -289,6 +330,9 @@ def bench_ernie_moe(cfg=None, batch=32, seq=512, n_steps=6):
         num_hidden_layers=8, num_attention_heads=16,
         num_key_value_heads=16, num_experts=8, moe_every=2,
         max_position_embeddings=max(seq, 512))
+    if dispatch_mode is not None:
+        import dataclasses
+        cfg = dataclasses.replace(cfg, moe_dispatch_mode=dispatch_mode)
     net = ErnieMoEForCausalLM(cfg)
     ce = nn.CrossEntropyLoss()
 
@@ -630,14 +674,28 @@ def main():
         result["extras"]["lenet_compiled_vs_eager_speedup"] = round(speedup, 1)
 
     def add_bert():
-        tok, mfu = bench_bert()
+        tok, mfu = _record_counter_paths(
+            _sdpa_paths, "kernels.flash.sdpa", "bert_base", bench_bert)
         result["extras"]["bert_base_tokens_per_sec"] = round(tok, 1)
         result["extras"]["bert_base_mfu_approx"] = round(mfu, 4)
 
     def add_moe():
-        tok, mfu = bench_ernie_moe()
+        # default config: dispatch_mode="pallas" with counter-visible
+        # fallback; the moe_dispatch_path telemetry names what it took
+        tok, mfu = _record_counter_paths(
+            _moe_paths, "kernels.moe.dispatch_path", "ernie_moe",
+            bench_ernie_moe)
         result["extras"]["ernie_moe_tokens_per_sec"] = round(tok, 1)
         result["extras"]["ernie_moe_mfu_routed"] = round(mfu, 4)
+
+    def add_moe_pallas():
+        # the explicitly-gated fused-dispatch point: stays meaningful
+        # even if the config default ever changes
+        tok, _mfu = _record_counter_paths(
+            _moe_paths, "kernels.moe.dispatch_path", "ernie_moe_pallas",
+            lambda: bench_ernie_moe(dispatch_mode="pallas"))
+        result["extras"]["ernie_moe_dispatch_pallas_tokens_per_sec"] = \
+            round(tok, 1)
 
     def add_resnet():
         ips = bench_resnet50()
@@ -745,6 +803,7 @@ def main():
         ("bert_base", add_bert, 180),
         ("resnet50", add_resnet, 240),
         ("ernie_moe", add_moe, 240),
+        ("ernie_moe_dispatch_pallas", add_moe_pallas, 240),
         ("lenet", add_lenet, 100),
         ("llama_small_seq512", lambda: add_llama("llama_small_seq512",
                                                  bench_llama_small), 180),

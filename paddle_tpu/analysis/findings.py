@@ -29,6 +29,7 @@ UNUSED_INPUT = "unused-input"
 CONSTANT_OUTPUT = "constant-output"
 UNROLLED_LOOP = "unrolled-loop"
 STATIC_ARG_RECOMPILE = "static-arg-recompile"
+MOE_SLOW_DISPATCH = "moe-slow-dispatch"
 
 # shard (SPMD/collective) rules — what shard_lint's device-free trace
 # under a fake mesh reveals (docs/ANALYSIS.md "shard_lint")
@@ -50,7 +51,8 @@ AST_RULES = (TENSOR_BOOL_BRANCH, TENSOR_HOST_SYNC, TENSOR_PY_CAST,
              TENSOR_INPLACE, HOST_RNG)
 JAXPR_RULES = (GRAPH_BREAK, TRACE_FAILED, DTYPE_PROMOTION,
                LARGE_CONSTANT, DEAD_COMPUTATION, UNUSED_INPUT,
-               CONSTANT_OUTPUT, UNROLLED_LOOP, STATIC_ARG_RECOMPILE)
+               CONSTANT_OUTPUT, UNROLLED_LOOP, STATIC_ARG_RECOMPILE,
+               MOE_SLOW_DISPATCH)
 SHARD_RULES = (BAD_AXIS_NAME, UNALIGNED_GROUP, INDIVISIBLE_COLLECTIVE,
                UNEVEN_SPLIT, TENSOR_LIST_ARITY, P2P_IN_TRACE,
                NON_RING_PERMUTE)

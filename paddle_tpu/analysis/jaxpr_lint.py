@@ -33,8 +33,9 @@ import numpy as np
 
 from .findings import (CONSTANT_OUTPUT, DEAD_COMPUTATION, DTYPE_PROMOTION,
                        ERROR, GRAPH_BREAK, INFO, LARGE_CONSTANT,
-                       STATIC_ARG_RECOMPILE, TRACE_FAILED, UNROLLED_LOOP,
-                       UNUSED_INPUT, WARNING, Finding, Report)
+                       MOE_SLOW_DISPATCH, STATIC_ARG_RECOMPILE,
+                       TRACE_FAILED, UNROLLED_LOOP, UNUSED_INPUT, WARNING,
+                       Finding, Report)
 
 def _break_errors():
     """jit.api's graph-break error set, not a copy — hitting one during
@@ -351,6 +352,45 @@ def _check_unrolled(closed, findings: List[Finding],
                    "same math, ~1/N the trace+compile time"))
 
 
+# the named-jit dispatch/combine implementations MoELayer stages per
+# mode (incubate/distributed/models/moe/moe_layer.py): their pjit
+# equations carry the function name, which is how a traced program
+# reveals which MoE dispatch it baked in
+_MOE_SLOW_DISPATCH_FNS = {"moe_dispatch_einsum": "einsum",
+                          "moe_dispatch_scatter": "scatter"}
+
+
+def _check_moe_dispatch(closed, findings: List[Finding]):
+    """Perf rule (mirrors the recompile-risk rule's advisory role): an
+    einsum/scatter MoE dispatch inside a traced program is the
+    O(N*E*C*H) / no-dead-slot-skipping path — dispatch_mode="pallas"
+    runs the fused grouped-matmul kernel instead (docs/KERNELS.md).
+    One finding per dispatch mode found, at the first occurrence."""
+    seen = set()
+    for eqn in _walk_eqns(closed.jaxpr):
+        if eqn.primitive.name != "pjit":
+            continue
+        mode = _MOE_SLOW_DISPATCH_FNS.get(eqn.params.get("name"))
+        if mode is None or mode in seen:
+            continue
+        seen.add(mode)
+        fname, line = _eqn_loc(eqn)
+        findings.append(Finding(
+            rule=MOE_SLOW_DISPATCH, severity=INFO,
+            message=f"MoE '{mode}' dispatch traced into this program "
+                    "— token movement and the expert FFN run unfused "
+                    "(dead capacity slots still pay full FLOPs)",
+            file=fname, line=line,
+            suggestion="construct the MoELayer with "
+                       "dispatch_mode='pallas' (the default) so the "
+                       "fused grouped-matmul kernel serves eligible "
+                       "geometries — note a pallas-mode layer that "
+                       "LEGITIMATELY degraded (ep-sharded mesh, "
+                       "non-TPU trace) also stages this path; "
+                       "kernels.moe.dispatch_path.fallback.* names "
+                       "the reason"))
+
+
 def lint_closed_jaxpr(closed, *,
                       user_invar_idx: Optional[Sequence[int]] = None,
                       invar_labels: Optional[Dict[int, str]] = None,
@@ -370,6 +410,7 @@ def lint_closed_jaxpr(closed, *,
                          invar_labels or {}, donated_idx)
     _check_constant_outputs(closed, findings, n_user_out)
     _check_unrolled(closed, findings, unroll_min_repeats)
+    _check_moe_dispatch(closed, findings)
     return findings
 
 

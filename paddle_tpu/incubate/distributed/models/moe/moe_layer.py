@@ -22,7 +22,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from .....core.dispatch import run_op, unwrap, wrap
+from .....core.dispatch import run_op, run_op_nodiff, unwrap, wrap
 from .....core import random as random_mod
 from .....distributed import mesh as mesh_mod
 from .....distributed.auto_parallel import Replicate, Shard, shard_tensor
@@ -83,19 +83,87 @@ def _n_groups_cached(n, gs):
     """Largest divisor of n giving groups of >= gs tokens; warns ONCE
     per (n, gs) when the divisor search collapses toward one group (a
     prime-ish token count degrades the dispatch einsum back toward
-    quadratic — visible, not silent)."""
+    quadratic — visible, not silent). Also bumps the lint-style
+    `lint.moe-group-degraded` counter so telemetry snapshots (bench,
+    hapi) can see the degradation without scraping the log."""
     if not gs or n <= gs:
         return 1
     g = max(1, n // int(gs))
     while n % g:                # largest divisor of n at most n // gs
         g -= 1
     if n // g > 2 * int(gs):
+        from ..... import monitor
+        monitor.counter("lint.moe-group-degraded").increase()
         logging.getLogger(__name__).warning(
             "MoE group-wise dispatch: %d tokens has no divisor near "
             "group_size=%d (using %d groups of %d); pad batch*seq "
             "to a rounder number to keep dispatch cost linear",
             n, gs, g, n // g)
     return g
+
+
+# ---------------------------------------------------------------------------
+# dispatch/combine implementations, one named jit per mode: inside a
+# traced program each shows up as a `pjit` equation carrying its
+# function name, which is what analysis.jaxpr_lint's moe-slow-dispatch
+# rule keys on to flag einsum/scatter dispatch as a perf finding
+# (docs/ANALYSIS.md) — and the eager path gets the fused executable for
+# free.
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def moe_dispatch_einsum(tok, d):
+    """Dense one-hot dispatch einsum — O(N*E*C*H) per group."""
+    h = tok.shape[-1]
+    if d.ndim == 3:
+        return jnp.einsum("nh,nec->ech", tok, d)
+    g, gn, e, c = d.shape
+    ei = jnp.einsum("gnh,gnec->gech", tok.reshape(g, gn, h), d)
+    return ei.transpose(1, 0, 2, 3).reshape(e, g * c, h)
+
+
+@jax.jit
+def moe_combine_einsum(eo, c):
+    """Mirrored dense combine einsum."""
+    h = eo.shape[-1]
+    if c.ndim == 3:
+        return jnp.einsum("ech,nec->nh", eo, c)
+    g, gn, e, cc = c.shape
+    eg = eo.reshape(e, g, cc, h).transpose(1, 0, 2, 3)
+    return jnp.einsum("gech,gnec->gnh", eg, c).reshape(g * gn, h)
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5))
+def moe_dispatch_scatter(tok, idx, pos, keep, e, cap):
+    """Sparse dispatch: scatter tokens into the flat [E*C, h] expert
+    buffer by (expert, slot) index; dropped tokens land in a trash
+    slot e*cap."""
+    dst = jnp.where(keep, idx * cap + pos, e * cap)  # [k, N]
+    buf = jnp.zeros((e * cap + 1, tok.shape[1]), tok.dtype)
+    for r in range(idx.shape[0]):
+        buf = buf.at[dst[r]].add(tok)
+    return buf[:e * cap].reshape(e, cap, tok.shape[1])
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6))
+def moe_combine_scatter(eo, idx, pos, keep, w, e, cap):
+    """Mirrored gather + weighted sum."""
+    flat = eo.reshape(e * cap, eo.shape[-1])
+    dst = jnp.where(keep, idx * cap + pos, 0)
+    out = 0.0
+    for r in range(idx.shape[0]):
+        out = out + flat[dst[r]] * (w[r] * keep[r])[:, None]
+    return out.astype(eo.dtype)
+
+
+# one-time (per reason) trace-log when dispatch_mode="pallas" degrades
+_pallas_fallback_logged = set()
+
+# test hooks (monkeypatched by tests/test_moe_kernel.py): force the
+# Pallas dispatch on a non-TPU backend / run its kernels in interpret
+# mode — mirrors flash_attention_arrays' force_pallas/interpret knobs
+_FORCE_PALLAS = False
+_PALLAS_INTERPRET = False
 
 
 class MoELayer(Layer):
@@ -116,12 +184,20 @@ class MoELayer(Layer):
             QUADRATIC in tokens for a single group; per-group capacity
             makes it linear (cost ~ N * group_size * top_k * cf * H).
             None = one group (exact legacy semantics).
-        dispatch_mode: "einsum" (dense one-hot dispatch/combine, the
-            GShard formulation) or "scatter" (sparse routing indices +
-            scatter-add dispatch / gather combine, O(N * k * H) with no
-            E- or C-proportional term — the winning layout at large
-            expert counts; group_size is ignored, the cost is already
-            linear in tokens). Routing decisions are identical.
+        dispatch_mode: "pallas" (the default — sparse routing indices,
+            scatter into the per-expert capacity buffer, then the
+            fused Pallas grouped-matmul kernel of kernels/moe.py:
+            O(N*k*H) token movement AND an expert FFN that skips dead
+            capacity slots, streams weights HBM→VMEM double-buffered,
+            and never materializes h_mid in HBM; degrades to "einsum"
+            — counter-visible and logged, never silent — when the
+            geometry/platform is ineligible, see
+            `_pallas_fallback_reason`), "einsum" (dense one-hot
+            dispatch/combine, the GShard formulation), or "scatter"
+            (sparse routing indices + scatter-add dispatch / gather
+            combine, O(N * k * H) with no E- or C-proportional term;
+            group_size is ignored, the cost is already linear in
+            tokens). Routing decisions are identical in all three.
 
     After forward, `self.l_aux` holds the load-balancing auxiliary loss
     (add `layer.l_aux * coeff` to the training loss, as the reference's
@@ -133,12 +209,12 @@ class MoELayer(Layer):
                  capacity_factor: Optional[float] = None,
                  experts: Optional[Layer] = None, moe_group=None,
                  ep_axis: str = "ep", group_size: Optional[int] = None,
-                 dispatch_mode: str = "einsum", name=None):
+                 dispatch_mode: str = "pallas", name=None):
         super().__init__()
-        if dispatch_mode not in ("einsum", "scatter"):
+        if dispatch_mode not in ("pallas", "einsum", "scatter"):
             raise ValueError(
-                f"dispatch_mode must be 'einsum' or 'scatter', got "
-                f"{dispatch_mode!r}")
+                f"dispatch_mode must be 'pallas', 'einsum' or "
+                f"'scatter', got {dispatch_mode!r}")
         self.d_model = d_model
         self.num_experts = num_experts
         self._group_size = group_size
@@ -192,16 +268,10 @@ class MoELayer(Layer):
             "moe_gate_sparse", route, [tokens, self.gate_weight])
         self.l_aux = aux
 
-        def dispatch_fn(tok, idx, pos, keep):
-            # flat slot id; dropped tokens land in a trash slot e*cap
-            dst = jnp.where(keep, idx * cap + pos, e * cap)  # [k, N]
-            buf = jnp.zeros((e * cap + 1, tok.shape[1]), tok.dtype)
-            for r in range(top_k):
-                buf = buf.at[dst[r]].add(tok)
-            return buf[:e * cap].reshape(e, cap, tok.shape[1])
-
-        expert_in = run_op("moe_dispatch_scatter", dispatch_fn,
-                           [tokens, idx, pos, keep])
+        expert_in = run_op(
+            "moe_dispatch_scatter",
+            lambda t, i, p, k: moe_dispatch_scatter(t, i, p, k, e, cap),
+            [tokens, idx, pos, keep])
         deg = mesh_mod.axis_degree(self._ep_axis)
         ep_entry = self._ep_axis if (
             deg > 1 and e % deg == 0) else None
@@ -209,25 +279,157 @@ class MoELayer(Layer):
         expert_out = self.experts(expert_in)
         expert_out = mark_sharding(expert_out, ep_entry, None, None)
 
-        def combine_fn(eo, idx, pos, keep, w):
-            flat = eo.reshape(e * cap, eo.shape[-1])
-            dst = jnp.where(keep, idx * cap + pos, 0)
+        out = run_op(
+            "moe_combine_gather",
+            lambda o, i, p, k, ww: moe_combine_scatter(o, i, p, k, ww,
+                                                       e, cap),
+            [expert_out, idx, pos, keep, w])
+        return out.reshape(orig_shape)
+
+    def _pallas_fallback_reason(self, n_tokens, dtype):
+        """None when the fused Pallas grouped-matmul dispatch can serve
+        this forward; else a short site tag naming why not (the
+        `kernels.moe.dispatch_path.fallback.<site>` counter suffix and
+        the one-time log)."""
+        from .....kernels import moe as moe_kernels
+        from .....kernels.flash_attention import _pallas_supported
+        if not isinstance(self.experts, GroupedExpertsFFN):
+            return "custom-experts"
+        if self.experts._act not in ("gelu", "relu"):
+            return "activation"
+        if mesh_mod.axis_degree(self._ep_axis) > 1:
+            # a pallas_call is a single opaque custom call: GSPMD
+            # cannot shard it over 'ep', so expert-parallel meshes keep
+            # the einsum dispatch (whose expert dim GSPMD turns into
+            # the all-to-all)
+            return "ep-sharded"
+        cap = self.gate.capacity(int(n_tokens))
+        d_hidden = int(self.experts.w1.shape[-1])
+        if not moe_kernels.moe_pallas_eligible(self.d_model, d_hidden,
+                                               cap, dtype):
+            return "geometry"
+        if _FORCE_PALLAS:
+            return None
+        import jax as _jax
+        if _jax.default_backend() not in ("tpu", "axon"):
+            return "platform"
+        if not _pallas_supported():
+            return "mosaic-probe"
+        return None
+
+    def _forward_pallas(self, tokens, orig_shape):
+        """Fused dispatch: identical routing to dispatch_mode="scatter"
+        (topk_gating_sparse), tokens scattered by (expert, slot) into a
+        block-padded [E, cap_pad, h] buffer WITH their combine weights,
+        then ONE Pallas grouped-matmul kernel runs both expert matmuls
+        + activation + the combine-weight epilogue over only the LIVE
+        token blocks (kernels/moe.py); the combine is the mirrored
+        gather + add — the per-token weights were already applied in
+        the kernel epilogue."""
+        from .....kernels import moe as moe_kernels
+        n, h = tokens.shape
+        e = self.num_experts
+        top_k = self.gate.top_k
+        cap = self.gate.capacity(int(n))
+        cap_pad = moe_kernels.padded_capacity(cap, unwrap(tokens).dtype)
+        jitter = getattr(self.gate, "jitter", 0.0)
+        training = self.training
+        key = random_mod.next_key() if (jitter and training) else None
+
+        def route(tok, wg):
+            from .gate import topk_gating_sparse
+            return topk_gating_sparse(tok @ wg, top_k, cap,
+                                      train=training, key=key,
+                                      switch_jitter=jitter)
+
+        idx, pos, keep, w, aux = run_op(
+            "moe_gate_sparse", route, [tokens, self.gate_weight])
+        self.l_aux = aux
+
+        def moe_dispatch_pallas(tok, idx, pos, keep, w):
+            dst = jnp.where(keep, idx * cap_pad + pos, e * cap_pad)
+            buf = jnp.zeros((e * cap_pad + 1, tok.shape[1]), tok.dtype)
+            wbuf = jnp.zeros((e * cap_pad + 1, 1), jnp.float32)
+            for r in range(top_k):
+                buf = buf.at[dst[r]].add(tok)
+                wbuf = wbuf.at[dst[r]].add(
+                    (w[r] * keep[r]).astype(jnp.float32)[:, None])
+            return (buf[:e * cap_pad].reshape(e, cap_pad, tok.shape[1]),
+                    wbuf[:e * cap_pad].reshape(e, cap_pad, 1))
+
+        expert_in, wslot = run_op("moe_dispatch_pallas",
+                                  moe_dispatch_pallas,
+                                  [tokens, idx, pos, keep, w])
+
+        def count_fn(idx, keep):
+            # kept assignments per expert (<= cap by construction):
+            # the kernel's liveness prefix — everything at or past
+            # counts[e] is capacity headroom it skips
+            cbuf = jnp.zeros((e + 1,), jnp.int32)
+            cbuf = cbuf.at[jnp.where(keep, idx, e).reshape(-1)].add(
+                keep.reshape(-1).astype(jnp.int32))
+            return cbuf[:e]
+
+        counts = run_op_nodiff("moe_dispatch_counts", count_fn,
+                               [idx, keep])
+
+        ex = self.experts
+        act = ex._act
+        interpret = _PALLAS_INTERPRET
+        force = _FORCE_PALLAS
+
+        def grouped(xb, w1, b1, w2, b2, ws, cnt):
+            return moe_kernels.grouped_ffn(
+                xb, w1, b1, w2, b2, ws, cnt, activation=act,
+                interpret=interpret, force_pallas=force)
+
+        expert_out = run_op(
+            "moe_grouped_ffn", grouped,
+            [expert_in, ex.w1, ex.b1, ex.w2, ex.b2, wslot, counts])
+
+        def moe_combine_pallas(eo, idx, pos, keep):
+            flat = eo.reshape(e * cap_pad, eo.shape[-1])
+            dst = jnp.where(keep, idx * cap_pad + pos, 0)
             out = 0.0
             for r in range(top_k):
-                out = out + flat[dst[r]] * (w[r] * keep[r])[:, None]
+                out = out + flat[dst[r]] * keep[r].astype(eo.dtype)[:, None]
             return out.astype(eo.dtype)
 
-        out = run_op("moe_combine_gather", combine_fn,
-                     [expert_out, idx, pos, keep, w])
+        out = run_op("moe_combine_pallas", moe_combine_pallas,
+                     [expert_out, idx, pos, keep])
         return out.reshape(orig_shape)
 
     def forward(self, x):
-        """x: [batch, seq, h] or [N, h]."""
+        """x: [batch, seq, h] or [N, h]. Bumps the trace-time
+        `kernels.moe.dispatch_path.*` counter for whichever dispatch
+        implementation this forward bakes in (docs/OBSERVABILITY.md
+        "MoE dispatch path counters") — a pallas layer that degrades to
+        einsum is counter-visible, never silent."""
+        from ..... import monitor
         orig_shape = list(x.shape)
         h = orig_shape[-1]
         tokens = x.reshape([-1, h])
-        if self._dispatch_mode == "scatter":
+        mode = self._dispatch_mode
+        if mode == "pallas":
+            dtype = getattr(unwrap(tokens), "dtype", None)
+            reason = self._pallas_fallback_reason(tokens.shape[0], dtype)
+            if reason is None:
+                monitor.counter(
+                    "kernels.moe.dispatch_path.pallas").increase()
+                return self._forward_pallas(tokens, orig_shape)
+            monitor.counter(
+                f"kernels.moe.dispatch_path.fallback.{reason}").increase()
+            if reason not in _pallas_fallback_logged:
+                _pallas_fallback_logged.add(reason)
+                logging.getLogger(__name__).info(
+                    "MoE dispatch_mode='pallas' falling back to the "
+                    "einsum dispatch: %s (docs/KERNELS.md eligibility)",
+                    reason)
+            mode = "einsum"
+        if mode == "scatter":
+            monitor.counter("kernels.moe.dispatch_path.scatter").increase()
             return self._forward_scatter(tokens, orig_shape)
+        monitor.counter("kernels.moe.dispatch_path.einsum").increase()
         n = tokens.shape[0]
         top_k = self.gate.top_k
         ng = self._n_groups(int(n))
@@ -256,14 +458,8 @@ class MoELayer(Layer):
             "moe_gate", gating, [tokens, self.gate_weight])
         self.l_aux = aux
 
-        def dispatch_fn(tok, d):
-            if ng == 1:
-                return jnp.einsum("nh,nec->ech", tok, d)
-            tg = tok.reshape(ng, n // ng, h)
-            ei = jnp.einsum("gnh,gnec->gech", tg, d)      # [G,E,c,h]
-            return ei.transpose(1, 0, 2, 3).reshape(e, ng * cap, h)
-
-        expert_in = run_op("moe_dispatch", dispatch_fn, [tokens, dispatch])
+        expert_in = run_op("moe_dispatch", moe_dispatch_einsum,
+                           [tokens, dispatch])
         # commit the all-to-all: expert dim sharded over 'ep' (only when
         # the expert count divides the axis degree)
         deg = mesh_mod.axis_degree(self._ep_axis)
@@ -273,11 +469,6 @@ class MoELayer(Layer):
         expert_out = self.experts(expert_in)
         expert_out = mark_sharding(expert_out, ep_entry, None, None)
 
-        def combine_fn(eo, c):
-            if ng == 1:
-                return jnp.einsum("ech,nec->nh", eo, c)
-            eg = eo.reshape(e, ng, cap, h).transpose(1, 0, 2, 3)
-            return jnp.einsum("gech,gnec->gnh", eg, c).reshape(n, h)
-
-        out = run_op("moe_combine", combine_fn, [expert_out, combine])
+        out = run_op("moe_combine", moe_combine_einsum,
+                     [expert_out, combine])
         return out.reshape(orig_shape)

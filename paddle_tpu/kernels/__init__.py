@@ -5,4 +5,5 @@ flash_attn_kernel.cu, fused MoE dispatch). Here the kernel library is tiny
 by design: XLA is the kernel library for everything else (SURVEY.md §7.1).
 """
 from . import flash_attention  # noqa: F401
+from . import moe  # noqa: F401
 from . import ring_attention  # noqa: F401

@@ -795,11 +795,77 @@ def _flash_xla(q, k, v, causal, scale, window=None, se=None):
     return out
 
 
+_minor64_ok = None
+
+
+def _pallas_minor64_supported():
+    """One-time probe: can this Mosaic run the flash dots with a 64-wide
+    head dim (BERT-family geometry — 768/12 = 64)?
+
+    A 64-lane minor dim under-fills the 128-lane registers, and some
+    Mosaic builds reject or mis-lay-out such tiles; like
+    `_pallas_supported`, an eager compile+run of a tiny kernel doing
+    both flash dot shapes ([bq,64]·[bk,64]ᵀ then [bq,bk]·[bk,64])
+    decides it once per process, so an unsupported platform routes BERT
+    to XLA instead of baking an uncompilable kernel into the program.
+    """
+    global _minor64_ok
+    if _minor64_ok is None:
+        from jax.experimental import pallas as pl
+
+        def probe(x_ref, o_ref):
+            x = x_ref[...]
+            s = jax.lax.dot_general(x, x, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            o_ref[...] = jax.lax.dot_general(
+                s, x, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        try:
+            with jax.ensure_compile_time_eval(), _x32_trace():
+                x = jnp.ones((128, 64), jnp.float32)
+                out = pl.pallas_call(
+                    probe, grid=(1,),
+                    in_specs=[pl.BlockSpec((128, 64), lambda i: (0, 0))],
+                    out_specs=pl.BlockSpec((128, 64), lambda i: (0, 0)),
+                    out_shape=jax.ShapeDtypeStruct((128, 64),
+                                                   jnp.float32),
+                )(x)
+                jax.block_until_ready(out)
+            _minor64_ok = True
+        except Exception as exc:  # noqa: BLE001 — probe, logged
+            logger.warning(
+                "Pallas head-dim-64 probe kernel failed on this "
+                "platform (%s: %s); 64-wide heads use the XLA path.",
+                type(exc).__name__, exc)
+            _minor64_ok = False
+    return _minor64_ok
+
+
+def _head_dim_ok(d):
+    # 128-granular head dims fill the lane registers outright; 64 (the
+    # BERT-base geometry) is probe-gated per platform
+    if d % 128 == 0:
+        return True
+    return d == 64 and _pallas_minor64_supported()
+
+
 def _tileable(sq, sk, d):
     # _pick_block halves down to any power-of-two divisor, so 128-granular
     # sequences always tile; head dim must fill the 128-lane registers
+    # (or pass the 64-lane probe)
     return (sq % 128 == 0 and sk % 128 == 0
-            and d % 128 == 0 and sq >= 128 and sk >= 128)
+            and _head_dim_ok(d) and sq >= 128 and sk >= 128)
+
+
+def pallas_path_eligible(sq, sk, d):
+    """Would `flash_attention_arrays` take the Pallas kernel for these
+    sequence/head dims (absent force_pallas)? The ONE predicate shared
+    with the entry point itself, so callers that attribute the path
+    (nn.functional sdpa counters, bench telemetry) can never drift
+    from the routing decision."""
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    return bool(on_tpu and _tileable(sq, sk, d) and _pallas_supported())
 
 
 def flash_attention_arrays(q, k, v, causal=False, scale=None,
@@ -860,10 +926,8 @@ def flash_attention_arrays(q, k, v, causal=False, scale=None,
     # builds Mosaic kernels fine (sub-second) once the kernels avoid
     # narrow loop carries and i64 scalars (see _x32_trace / the
     # STAT_LANES carry note in _flash_fwd_kernel).
-    on_tpu = jax.default_backend() in ("tpu", "axon")
-    use_pallas = force_pallas or (
-        on_tpu and _tileable(qt.shape[2], kt.shape[2], qt.shape[3])
-        and _pallas_supported())
+    use_pallas = force_pallas or pallas_path_eligible(
+        qt.shape[2], kt.shape[2], qt.shape[3])
     if use_pallas:
         try:
             out = _flash_pallas(qt, kt, vt, se, causal, s, interpret,

@@ -1,0 +1,744 @@
+"""Fused MoE grouped-matmul: Pallas TPU kernels (fwd + bwd) with XLA
+fallback.
+
+Reference capability: paddle/phi/kernels/fusion/cutlass/fused_moe_kernel.cu
+(the grouped-GEMM expert FFN behind the reference's fused MoE path).
+TPU-native design (docs/KERNELS.md): tokens arrive already sorted by
+expert — the routing scatter lands them in the per-expert capacity
+buffer ``x [E, C, h]`` — and ONE blocked kernel runs the whole
+two-matmul expert FFN over that buffer:
+
+* grid ``(expert, token-block)``; per-expert live token counts ride in
+  as scalar prefetch, so token blocks past an expert's occupancy (the
+  capacity-factor headroom, empty experts) issue **no weight copy and
+  no math** — with GShard's cf=2.0 roughly half the capacity slots are
+  dead, and the einsum/scatter paths pay full FLOPs for every one;
+* expert weights stay in HBM (``ANY``) and stream HBM→VMEM in
+  ``block_f``-wide tiles through a two-slot rotating buffer of explicit
+  ``pltpu.make_async_copy`` DMAs (the paged_attention.py schedule): the
+  tile for step i+1 — which may belong to the next expert — is in
+  flight while step i computes;
+* dots run on the bf16 operands with **f32 accumulation**
+  (``preferred_element_type``), and the ``h_mid [E, C, dff]``
+  intermediate never exists in HBM — activation and both matmuls are
+  one kernel;
+* the epilogue applies the per-slot **combine weight** (router prob),
+  so the combine on the way out is a pure gather+add — the mirrored
+  half of the dispatch scatter.
+
+Forward and backward are wrapped in ``jax.custom_vjp`` (flash-attention
+pattern): bwd recomputes the activation per tile and splits, like the
+flash dq/dkv pair, into a (expert, token-block) kernel for dx/dwslot/db2
+and a (expert, ff-block) kernel for dw1/db1/dw2.
+
+Shapes that don't tile — and kernel *failures* under the flag-gated
+``FLAGS_moe_allow_fallback`` — fall back to the batched-einsum reference
+(`grouped_ffn_reference`), logged and counter-visible, never silent.
+"""
+from __future__ import annotations
+
+import functools
+import logging
+
+import jax
+import jax.numpy as jnp
+
+from ..core.flags import define_flag, get_flag
+from .flash_attention import _x32_trace
+
+logger = logging.getLogger("paddle_tpu.kernels.moe")
+
+define_flag("moe_allow_fallback", True,
+            "on Pallas grouped-matmul kernel failure, log and fall back "
+            "to the XLA batched-einsum path instead of raising")
+
+# token-block default: 256 rows feed the MXU [256, h] x [h, block_f]
+# dots; _pick_token_block halves toward the sublane minimum for small
+# capacities. ff-block 512 keeps one double-buffered w1+w2 tile pair
+# under ~4 MB at h=4096 bf16.
+BLOCK_TOKENS = 256
+BLOCK_FF = 512
+
+_SUBLANE = {"int8": 32, "bfloat16": 16, "float16": 16}
+
+_warned = set()
+
+
+def _log_fallback(exc, site):
+    if not get_flag("moe_allow_fallback"):
+        raise exc
+    from .. import monitor
+    monitor.counter(f"kernels.moe.fallback.{site}").increase()
+    key = (site, type(exc).__name__)
+    if key not in _warned:
+        logger.warning(
+            "Pallas grouped-matmul %s kernel failed (%s: %s); falling "
+            "back to the XLA batched-einsum path. Set "
+            "FLAGS_moe_allow_fallback=0 to make this an error.",
+            site, type(exc).__name__, exc)
+        _warned.add(key)
+
+
+def _sublane(dtype) -> int:
+    return _SUBLANE.get(jnp.dtype(dtype).name, 8)
+
+
+def pick_token_block(capacity: int, dtype="float32") -> int:
+    """Token-block size for a per-expert capacity: the smallest
+    power-of-two >= capacity, clamped to [sublane-min, BLOCK_TOKENS]."""
+    b = _sublane(dtype)
+    while b < min(capacity, BLOCK_TOKENS):
+        b *= 2
+    return min(b, BLOCK_TOKENS)
+
+
+def padded_capacity(capacity: int, dtype="float32") -> int:
+    """Capacity rounded up to a whole number of token blocks. Routing
+    still drops at the UNpadded capacity — the pad slots are permanently
+    dead, and the kernel's count-based liveness skips them for free."""
+    bt = pick_token_block(capacity, dtype)
+    return -(-capacity // bt) * bt
+
+
+def _pick_ff_block(d_hidden: int) -> int:
+    """Largest lane-aligned divisor of d_hidden at most BLOCK_FF (falls
+    back to power-of-two halving for untiled interpret-mode shapes)."""
+    for cand in range(min(BLOCK_FF, d_hidden), 0, -128):
+        if d_hidden % cand == 0 and cand % 128 == 0:
+            return cand
+    b = min(BLOCK_FF, d_hidden)
+    while d_hidden % b:
+        b //= 2
+    return max(b, 1)
+
+
+def moe_pallas_requirements(d_model, d_hidden, capacity, dtype):
+    """Which Pallas-eligibility constraint a MoE geometry misses, as a
+    human-readable string — or None when eligible. Mirrors
+    paged_pallas_requirements (docs/KERNELS.md eligibility table).
+    Only the lane-width constraints can fail: the token dimension is
+    always sublane-aligned by construction (`pick_token_block` starts
+    at the dtype's sublane minimum and doubles, and `padded_capacity`
+    rounds the buffer to whole blocks); `capacity`/`dtype` stay in the
+    signature so a future tiling change keeps its callers."""
+    del capacity, dtype
+    problems = []
+    if d_model % 128:
+        problems.append(
+            f"d_model {d_model} is not a multiple of the 128 lane width")
+    if d_hidden % 128:
+        problems.append(
+            f"d_hidden {d_hidden} is not a multiple of the 128 lane width")
+    return "; ".join(problems) if problems else None
+
+
+def moe_pallas_eligible(d_model, d_hidden, capacity, dtype):
+    return moe_pallas_requirements(d_model, d_hidden, capacity,
+                                   dtype) is None
+
+
+# ---------------------------------------------------------------------------
+# activation + hand-coded derivative (shared by fwd and both bwd kernels
+# so they can never disagree; tanh-gelu matches jax.nn.gelu's default
+# approximate=True, the GroupedExpertsFFN activation)
+# ---------------------------------------------------------------------------
+
+_GELU_C = 0.7978845608028654     # sqrt(2/pi)
+_GELU_K = 0.044715
+
+
+def _act_apply(z, activation):
+    if activation == "gelu":
+        return jax.nn.gelu(z, approximate=True)
+    return jnp.maximum(z, jnp.float32(0.0))
+
+
+def _act_grad(z, activation):
+    if activation == "gelu":
+        c = jnp.float32(_GELU_C)
+        k = jnp.float32(_GELU_K)
+        u = c * (z + k * z * z * z)
+        t = jnp.tanh(u)
+        du = c * (jnp.float32(1.0) + jnp.float32(3.0) * k * z * z)
+        return (jnp.float32(0.5) * (jnp.float32(1.0) + t)
+                + jnp.float32(0.5) * z * (jnp.float32(1.0) - t * t) * du)
+    return (z > jnp.float32(0.0)).astype(jnp.float32)
+
+
+def _row_mask(count, t, block_t, ncols):
+    """[block_t, ncols] keep-mask for rows of token block t: slot ids at
+    or past the expert's live count are dead (capacity padding, dropped
+    tokens' trash slots live outside this buffer entirely)."""
+    rows = (t * jnp.int32(block_t)
+            + jax.lax.broadcasted_iota(jnp.int32, (block_t, ncols), 0))
+    return rows < count
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _grouped_ffn_fwd_kernel(counts_ref, buf_ref, step_ref, x_ref, b1_ref,
+                            b2_ref, ws_ref, w1_hbm, w2_hbm, o_ref,
+                            w1_buf, w2_buf, sems, *, n_experts, block_t,
+                            block_f, n_f, activation):
+    """One (expert, token-block) program of the grouped expert FFN.
+
+    Refs: counts [E] + two MUTABLE scalar cells (DMA buffer toggle and a
+    "pipeline primed" step counter, the paged_attention.py pattern);
+    x [BT, h] (clamped index map: dead blocks re-request the previous
+    block, so they cost no HBM copy), b1 [1, dff], b2 [1, h],
+    ws [BT, 1] combine weights; w1/w2 full pools in ANY; o [BT, h];
+    scratch: two-slot w1/w2 tile buffers + one DMA semaphore per slot.
+
+    The f-tile loop is a static python unroll (n_f = d_hidden/block_f,
+    a small constant): tile f lives in buffer (buf+f)%2 while tile f+1
+    — or, at the last tile, the NEXT live block's tile 0, which may be
+    the next expert's — streams into the other slot.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    e = pl.program_id(0)
+    t = pl.program_id(1)
+    nt = pl.num_programs(1)
+    count = counts_ref[e]
+    live = t * jnp.int32(block_t) < count
+
+    def copies(ei, fi, slot):
+        return [
+            pltpu.make_async_copy(
+                w1_hbm.at[ei, :, pl.ds(fi * block_f, block_f)],
+                w1_buf.at[slot], sems.at[slot]),
+            pltpu.make_async_copy(
+                w2_hbm.at[ei, pl.ds(fi * block_f, block_f), :],
+                w2_buf.at[slot], sems.at[slot]),
+        ]
+
+    @pl.when(jnp.logical_not(live))
+    def _dead():
+        # dead blocks (capacity headroom / empty experts) emit zeros —
+        # the combine gather never reads them, but a defined buffer
+        # keeps NaN-checks and tests deterministic
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    @pl.when(live)
+    def _work():
+        b0 = buf_ref[0]
+
+        @pl.when(step_ref[0] == 0)
+        def _prime():
+            # very first live block of the call: nobody prefetched its
+            # f=0 tile (the one unavoidable pipeline bubble)
+            for c in copies(e, 0, b0):
+                c.start()
+
+        # next live (expert, token-block) in grid order, for the
+        # cross-step prefetch: an unrolled scan over the STATIC expert
+        # count (the paged-decode next-live-slot pattern)
+        within = jnp.logical_and(t + 1 < nt,
+                                 (t + 1) * jnp.int32(block_t) < count)
+        nxt = jnp.int32(n_experts)
+        for cand in range(n_experts - 1, 0, -1):
+            nxt = jnp.where(
+                jnp.logical_and(cand > e, counts_ref[cand] > 0),
+                jnp.int32(cand), nxt)
+        ne = jnp.where(within, e, nxt)
+        has_next = jnp.logical_or(within, nxt < n_experts)
+
+        x = x_ref[...]
+        h = x.shape[1]
+        acc = jnp.zeros((block_t, h), jnp.float32)
+        for f in range(n_f):
+            slot = (b0 + jnp.int32(f)) % jnp.int32(2)
+            for c in copies(e, f, slot):
+                c.wait()
+            if f + 1 < n_f:
+                for c in copies(e, f + 1, (slot + jnp.int32(1)) % jnp.int32(2)):
+                    c.start()
+            else:
+                @pl.when(has_next)
+                def _prefetch():
+                    for c in copies(ne, 0, (slot + jnp.int32(1)) % jnp.int32(2)):
+                        c.start()
+            z = jax.lax.dot_general(
+                x, w1_buf[slot], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            z = z + b1_ref[:, pl.ds(f * block_f, block_f)].astype(
+                jnp.float32)
+            ha = _act_apply(z, activation)
+            acc = acc + jax.lax.dot_general(
+                ha.astype(x.dtype), w2_buf[slot],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        out = (acc + b2_ref[...].astype(jnp.float32)) \
+            * ws_ref[...].astype(jnp.float32)
+        out = jnp.where(_row_mask(count, t, block_t, h), out,
+                        jnp.float32(0.0))
+        o_ref[...] = out.astype(o_ref.dtype)
+        buf_ref[0] = (b0 + jnp.int32(n_f)) % jnp.int32(2)
+        step_ref[0] = step_ref[0] + 1
+
+
+def _x_index_map(block_t):
+    """Clamp the token-block index to the expert's last LIVE block:
+    dead grid steps re-request the block already resident in VMEM, so
+    Pallas issues no HBM copy for them (the PR-4 page-clamp trick)."""
+    def index_map(e, t, counts, *_):
+        nlive = jnp.maximum(
+            (counts[e] + jnp.int32(block_t) - 1) // jnp.int32(block_t),
+            jnp.int32(1))
+        return (e, jnp.minimum(t, nlive - 1), 0)
+    return index_map
+
+
+def _grouped_ffn_fwd_pallas(x, w1, b1, w2, b2, ws, counts, activation,
+                            block_t, block_f, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_experts, cap, h = x.shape
+    dff = w1.shape[2]
+    n_f = dff // block_f
+    kernel = functools.partial(
+        _grouped_ffn_fwd_kernel, n_experts=n_experts, block_t=block_t,
+        block_f=block_f, n_f=n_f, activation=activation)
+    xmap = _x_index_map(block_t)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,          # counts + buf/step mutable cells
+        grid=(n_experts, cap // block_t),
+        in_specs=[
+            pl.BlockSpec((None, block_t, h), xmap),
+            pl.BlockSpec((None, 1, dff), lambda e, t, *_: (e, 0, 0)),
+            pl.BlockSpec((None, 1, h), lambda e, t, *_: (e, 0, 0)),
+            # same clamped (e, t, 0) tuple as x: dead blocks skip the copy
+            pl.BlockSpec((None, block_t, 1), xmap),
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+        ],
+        out_specs=pl.BlockSpec((None, block_t, h),
+                               lambda e, t, *_: (e, t, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, h, block_f), w1.dtype),
+            pltpu.VMEM((2, block_f, h), w2.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    with _x32_trace():
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((n_experts, cap, h), x.dtype),
+            compiler_params=pltpu.TPUCompilerParams(
+                dimension_semantics=("arbitrary", "arbitrary")),
+            interpret=interpret,
+        )(jnp.asarray(counts, jnp.int32), jnp.zeros((1,), jnp.int32),
+          jnp.zeros((1,), jnp.int32), x, b1, b2, ws, w1, w2)
+
+
+# ---------------------------------------------------------------------------
+# backward kernels (recompute style, flash dq/dkv split)
+# ---------------------------------------------------------------------------
+
+def _grouped_ffn_bwd_dx_kernel(counts_ref, buf_ref, step_ref, x_ref,
+                               g_ref, b1_ref, b2_ref, ws_ref, w1_hbm,
+                               w2_hbm, dx_ref, dws_ref, db2_ref,
+                               w1_buf, w2_buf, sems, *, n_experts,
+                               block_t, block_f, n_f, activation):
+    """One (expert, token-block) program: dx, dwslot, and db2.
+
+    With gw = g ∘ wslot: dh_mid = gw·w2ᵀ, dz = dh_mid ∘ act'(z),
+    dx = dz·w1ᵀ; dwslot = Σ_h g ∘ (ffn + b2) (ffn recomputed);
+    db2 = Σ_rows gw, accumulated across this expert's token blocks in
+    the output block itself (its index map is constant in t, so the
+    tile stays resident until the expert changes).
+
+    NOTE: the DMA schedule (copies() descriptors, prime-on-step-0,
+    next-live-block lookahead, buffer-toggle arithmetic) is
+    deliberately kept IDENTICAL to _grouped_ffn_fwd_kernel's — any fix
+    to the pipeline invariants must land in both, since interpret-mode
+    tests cannot catch a DMA race that only exists on hardware.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    e = pl.program_id(0)
+    t = pl.program_id(1)
+    nt = pl.num_programs(1)
+    count = counts_ref[e]
+    live = t * jnp.int32(block_t) < count
+
+    @pl.when(t == 0)
+    def _init():
+        db2_ref[...] = jnp.zeros_like(db2_ref)
+
+    def copies(ei, fi, slot):
+        return [
+            pltpu.make_async_copy(
+                w1_hbm.at[ei, :, pl.ds(fi * block_f, block_f)],
+                w1_buf.at[slot], sems.at[slot]),
+            pltpu.make_async_copy(
+                w2_hbm.at[ei, pl.ds(fi * block_f, block_f), :],
+                w2_buf.at[slot], sems.at[slot]),
+        ]
+
+    @pl.when(jnp.logical_not(live))
+    def _dead():
+        dx_ref[...] = jnp.zeros_like(dx_ref)
+        dws_ref[...] = jnp.zeros_like(dws_ref)
+
+    @pl.when(live)
+    def _work():
+        b0 = buf_ref[0]
+
+        @pl.when(step_ref[0] == 0)
+        def _prime():
+            for c in copies(e, 0, b0):
+                c.start()
+
+        within = jnp.logical_and(t + 1 < nt,
+                                 (t + 1) * jnp.int32(block_t) < count)
+        nxt = jnp.int32(n_experts)
+        for cand in range(n_experts - 1, 0, -1):
+            nxt = jnp.where(
+                jnp.logical_and(cand > e, counts_ref[cand] > 0),
+                jnp.int32(cand), nxt)
+        ne = jnp.where(within, e, nxt)
+        has_next = jnp.logical_or(within, nxt < n_experts)
+
+        x = x_ref[...]
+        h = x.shape[1]
+        keep = _row_mask(count, t, block_t, h)
+        g32 = g_ref[...].astype(jnp.float32)
+        gw32 = jnp.where(keep, g32 * ws_ref[...].astype(jnp.float32),
+                         jnp.float32(0.0))
+        gw = gw32.astype(x.dtype)
+        ffn_acc = jnp.zeros((block_t, h), jnp.float32)
+        dx_acc = jnp.zeros((block_t, h), jnp.float32)
+        for f in range(n_f):
+            slot = (b0 + jnp.int32(f)) % jnp.int32(2)
+            for c in copies(e, f, slot):
+                c.wait()
+            if f + 1 < n_f:
+                for c in copies(e, f + 1, (slot + jnp.int32(1)) % jnp.int32(2)):
+                    c.start()
+            else:
+                @pl.when(has_next)
+                def _prefetch():
+                    for c in copies(ne, 0, (slot + jnp.int32(1)) % jnp.int32(2)):
+                        c.start()
+            w1t = w1_buf[slot]
+            w2t = w2_buf[slot]
+            z = jax.lax.dot_general(
+                x, w1t, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            z = z + b1_ref[:, pl.ds(f * block_f, block_f)].astype(
+                jnp.float32)
+            ha = _act_apply(z, activation)
+            ffn_acc = ffn_acc + jax.lax.dot_general(
+                ha.astype(x.dtype), w2t, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dh = jax.lax.dot_general(
+                gw, w2t, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dz = (dh * _act_grad(z, activation)).astype(x.dtype)
+            dx_acc = dx_acc + jax.lax.dot_general(
+                dz, w1t, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        dx_ref[...] = jnp.where(keep, dx_acc, jnp.float32(0.0)).astype(
+            dx_ref.dtype)
+        ffn = ffn_acc + b2_ref[...].astype(jnp.float32)
+        dws = jnp.sum(jnp.where(keep, g32 * ffn, jnp.float32(0.0)),
+                      axis=1, keepdims=True)
+        dws_ref[...] = dws.astype(dws_ref.dtype)
+        db2_ref[...] = db2_ref[...] + jnp.sum(gw32, axis=0,
+                                              keepdims=True)
+        buf_ref[0] = (b0 + jnp.int32(n_f)) % jnp.int32(2)
+        step_ref[0] = step_ref[0] + 1
+
+
+def _grouped_ffn_bwd_dw_kernel(counts_ref, x_hbm, g_hbm, ws_hbm, w1_ref,
+                               w2_ref, b1_ref, dw1_ref, db1_ref, dw2_ref,
+                               x_buf, g_buf, ws_buf, sems, dw1_acc,
+                               db1_acc, dw2_acc, *, block_t, block_f,
+                               activation):
+    """One (expert, ff-block) program: dw1[:, f], db1[f], dw2[f, :].
+
+    The expert's weight tiles arrive via ordinary BlockSpecs (constant
+    per grid step); the token blocks stream HBM→VMEM double-buffered
+    over a fori_loop bounded by the expert's LIVE block count — dead
+    capacity never touches the DMA engines. dw2 = h_midᵀ·gw,
+    dz = (gw·w2ᵀ) ∘ act'(z), dw1 = xᵀ·dz, db1 = Σ_rows dz; accumulated
+    in f32 scratch, written once.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    e = pl.program_id(0)
+    count = counts_ref[e]
+    nlive = (count + jnp.int32(block_t) - 1) // jnp.int32(block_t)
+
+    def copies(ti, slot):
+        start = ti * jnp.int32(block_t)
+        return [
+            pltpu.make_async_copy(
+                x_hbm.at[e, pl.ds(start, block_t)],
+                x_buf.at[slot], sems.at[slot]),
+            pltpu.make_async_copy(
+                g_hbm.at[e, pl.ds(start, block_t)],
+                g_buf.at[slot], sems.at[slot]),
+            pltpu.make_async_copy(
+                ws_hbm.at[e, pl.ds(start, block_t)],
+                ws_buf.at[slot], sems.at[slot]),
+        ]
+
+    dw1_acc[...] = jnp.zeros_like(dw1_acc)
+    db1_acc[...] = jnp.zeros_like(db1_acc)
+    dw2_acc[...] = jnp.zeros_like(dw2_acc)
+
+    @pl.when(nlive > 0)
+    def _start():
+        for c in copies(jnp.int32(0), jnp.int32(0)):
+            c.start()
+
+    def body(ti, carry):
+        slot = ti % jnp.int32(2)
+        for c in copies(ti, slot):
+            c.wait()
+
+        @pl.when(ti + jnp.int32(1) < nlive)
+        def _prefetch():
+            for c in copies(ti + jnp.int32(1), jnp.int32(1) - slot):
+                c.start()
+
+        x = x_buf[slot]
+        keep = _row_mask(count, ti, block_t, x.shape[1])
+        gw32 = jnp.where(
+            keep,
+            g_buf[slot].astype(jnp.float32)
+            * ws_buf[slot].astype(jnp.float32),
+            jnp.float32(0.0))
+        gw = gw32.astype(x.dtype)
+        z = jax.lax.dot_general(
+            x, w1_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        z = z + b1_ref[...].astype(jnp.float32)
+        ha = _act_apply(z, activation).astype(x.dtype)
+        dw2_acc[...] = dw2_acc[...] + jax.lax.dot_general(
+            ha, gw, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dh = jax.lax.dot_general(
+            gw, w2_ref[...], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dz32 = dh * _act_grad(z, activation)
+        dz = dz32.astype(x.dtype)
+        dw1_acc[...] = dw1_acc[...] + jax.lax.dot_general(
+            x, dz, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        db1_acc[...] = db1_acc[...] + jnp.sum(dz32, axis=0,
+                                              keepdims=True)
+        return carry
+
+    # bounds/carry pinned i32: the package's global x64 would otherwise
+    # give the loop an i64 induction var that Mosaic cannot legalize
+    jax.lax.fori_loop(jnp.int32(0), nlive, body, jnp.int32(0))
+    dw1_ref[...] = dw1_acc[...].astype(dw1_ref.dtype)
+    db1_ref[...] = db1_acc[...].astype(db1_ref.dtype)
+    dw2_ref[...] = dw2_acc[...].astype(dw2_ref.dtype)
+
+
+def _grouped_ffn_bwd_pallas(x, w1, b1, w2, b2, ws, counts, g, activation,
+                            block_t, block_f, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_experts, cap, h = x.shape
+    dff = w1.shape[2]
+    n_f = dff // block_f
+    counts = jnp.asarray(counts, jnp.int32)
+
+    dx_kernel = functools.partial(
+        _grouped_ffn_bwd_dx_kernel, n_experts=n_experts, block_t=block_t,
+        block_f=block_f, n_f=n_f, activation=activation)
+    xmap = _x_index_map(block_t)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(n_experts, cap // block_t),
+        in_specs=[
+            pl.BlockSpec((None, block_t, h), xmap),      # x
+            pl.BlockSpec((None, block_t, h), xmap),      # g
+            pl.BlockSpec((None, 1, dff), lambda e, t, *_: (e, 0, 0)),
+            pl.BlockSpec((None, 1, h), lambda e, t, *_: (e, 0, 0)),
+            pl.BlockSpec((None, block_t, 1), xmap),
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_t, h), lambda e, t, *_: (e, t, 0)),
+            pl.BlockSpec((None, block_t, 1), lambda e, t, *_: (e, t, 0)),
+            # db2: index constant in t -> the tile stays resident and
+            # accumulates across the expert's token blocks
+            pl.BlockSpec((None, 1, h), lambda e, t, *_: (e, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, h, block_f), w1.dtype),
+            pltpu.VMEM((2, block_f, h), w2.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    with _x32_trace():
+        dx, dws, db2 = pl.pallas_call(
+            dx_kernel,
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((n_experts, cap, h), x.dtype),
+                jax.ShapeDtypeStruct((n_experts, cap, 1), ws.dtype),
+                jax.ShapeDtypeStruct((n_experts, 1, h), jnp.float32),
+            ],
+            compiler_params=pltpu.TPUCompilerParams(
+                dimension_semantics=("arbitrary", "arbitrary")),
+            interpret=interpret,
+        )(counts, jnp.zeros((1,), jnp.int32), jnp.zeros((1,), jnp.int32),
+          x, g, b1, b2, ws, w1, w2)
+
+    dw_kernel = functools.partial(
+        _grouped_ffn_bwd_dw_kernel, block_t=block_t, block_f=block_f,
+        activation=activation)
+    dw_grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_experts, n_f),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),  # x
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),  # g
+            pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),  # ws
+            pl.BlockSpec((None, h, block_f), lambda e, f, *_: (e, 0, f)),
+            pl.BlockSpec((None, block_f, h), lambda e, f, *_: (e, f, 0)),
+            pl.BlockSpec((None, 1, block_f), lambda e, f, *_: (e, 0, f)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, h, block_f), lambda e, f, *_: (e, 0, f)),
+            pl.BlockSpec((None, 1, block_f), lambda e, f, *_: (e, 0, f)),
+            pl.BlockSpec((None, block_f, h), lambda e, f, *_: (e, f, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, block_t, h), x.dtype),
+            pltpu.VMEM((2, block_t, h), g.dtype),
+            pltpu.VMEM((2, block_t, 1), ws.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((h, block_f), jnp.float32),
+            pltpu.VMEM((1, block_f), jnp.float32),
+            pltpu.VMEM((block_f, h), jnp.float32),
+        ],
+    )
+    with _x32_trace():
+        dw1, db1, dw2 = pl.pallas_call(
+            dw_kernel,
+            grid_spec=dw_grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct(w1.shape, w1.dtype),
+                jax.ShapeDtypeStruct(b1.shape, b1.dtype),
+                jax.ShapeDtypeStruct(w2.shape, w2.dtype),
+            ],
+            compiler_params=pltpu.TPUCompilerParams(
+                dimension_semantics=("arbitrary", "arbitrary")),
+            interpret=interpret,
+        )(counts, x, g, ws, w1, w2, b1)
+    return dx, dw1, db1, dw2, db2.astype(b2.dtype), dws
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper + XLA reference / fallback
+# ---------------------------------------------------------------------------
+
+def grouped_ffn_reference(x, w1, b1, w2, b2, ws, counts=None,
+                          activation="gelu"):
+    """Batched-einsum reference (and flag-gated fallback): the exact
+    math of GroupedExpertsFFN with the combine weight applied, dead
+    capacity slots (>= counts[e]) zeroed to match the kernel contract.
+    """
+    z = jnp.einsum("ech,ehf->ecf", x, w1) + b1
+    ha = _act_apply(z, activation)
+    out = jnp.einsum("ecf,efh->ech", ha, w2) + b2
+    out = out * ws
+    if counts is not None:
+        slot = jnp.arange(x.shape[1], dtype=jnp.int32)[None, :, None]
+        out = jnp.where(slot < counts[:, None, None], out, 0.0)
+    return out.astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10))
+def _grouped_ffn_pallas(x, w1, b1, w2, b2, ws, counts, activation,
+                        block_t, block_f, interpret):
+    """x [E, C, h], w1 [E, h, dff], b1 [E, 1, dff], w2 [E, dff, h],
+    b2 [E, 1, h], ws [E, C, 1], counts [E] int32 → out [E, C, h];
+    differentiable in everything but counts."""
+    return _grouped_ffn_fwd_pallas(x, w1, b1, w2, b2, ws, counts,
+                                   activation, block_t, block_f,
+                                   interpret)
+
+
+def _grouped_ffn_vjp_fwd(x, w1, b1, w2, b2, ws, counts, activation,
+                         block_t, block_f, interpret):
+    out = _grouped_ffn_fwd_pallas(x, w1, b1, w2, b2, ws, counts,
+                                  activation, block_t, block_f, interpret)
+    return out, (x, w1, b1, w2, b2, ws, counts)
+
+
+def _grouped_ffn_vjp_bwd(activation, block_t, block_f, interpret, res, g):
+    x, w1, b1, w2, b2, ws, counts = res
+    try:
+        dx, dw1, db1, dw2, db2, dws = _grouped_ffn_bwd_pallas(
+            x, w1, b1, w2, b2, ws, counts, g, activation, block_t,
+            block_f, interpret)
+    except Exception as exc:  # noqa: BLE001 — flag-gated, logged
+        # the fwd eligibility gate cannot see bwd kernel failures (they
+        # trace when the VJP is pulled); gate here too so training
+        # degrades to the einsum path instead of crashing
+        _log_fallback(exc, "bwd")
+        _, ref_vjp = jax.vjp(
+            lambda x_, w1_, b1_, w2_, b2_, ws_: grouped_ffn_reference(
+                x_, w1_, b1_, w2_, b2_, ws_, counts, activation),
+            x, w1, b1, w2, b2, ws)
+        dx, dw1, db1, dw2, db2, dws = ref_vjp(g)
+    return dx, dw1, db1, dw2, db2, dws, None
+
+
+_grouped_ffn_pallas.defvjp(_grouped_ffn_vjp_fwd, _grouped_ffn_vjp_bwd)
+
+
+def grouped_ffn(x, w1, b1, w2, b2, ws, counts, *, activation="gelu",
+                interpret=False, force_pallas=False):
+    """Fused grouped expert FFN over the sorted-by-expert capacity
+    buffer: out[e, c] = (act(x[e, c]·w1[e] + b1[e])·w2[e] + b2[e])
+    ∘ ws[e, c], with rows at or past counts[e] zeroed and skipped.
+
+    Routes to the Pallas kernel pair when the geometry tiles (see
+    moe_pallas_requirements) on a TPU backend; otherwise — and on
+    flag-gated kernel failure — runs the batched-einsum reference.
+    """
+    from .flash_attention import _pallas_supported
+
+    n_experts, cap, h = x.shape
+    dff = w1.shape[2]
+    block_t = pick_token_block(cap, x.dtype)
+    block_f = _pick_ff_block(dff)
+    mm_dtype = jnp.promote_types(x.dtype, w1.dtype)
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    eligible = (cap % block_t == 0
+                and moe_pallas_eligible(h, dff, cap, mm_dtype))
+    use_pallas = force_pallas or (on_tpu and eligible
+                                  and _pallas_supported())
+    if use_pallas:
+        try:
+            return _grouped_ffn_pallas(
+                x.astype(mm_dtype), w1.astype(mm_dtype),
+                b1.astype(jnp.float32), w2.astype(mm_dtype),
+                b2.astype(jnp.float32), ws.astype(jnp.float32),
+                jnp.asarray(counts, jnp.int32), activation, block_t,
+                block_f, interpret).astype(x.dtype)
+        except Exception as exc:  # noqa: BLE001 — flag-gated, logged
+            _log_fallback(exc, "fwd")
+    return grouped_ffn_reference(x, w1, b1, w2, b2, ws, counts,
+                                 activation)

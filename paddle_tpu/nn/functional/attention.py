@@ -12,6 +12,14 @@ import jax
 import jax.numpy as jnp
 
 from ...core.dispatch import run_op, unwrap
+from ...core.flags import define_flag
+
+define_flag("sdpa_use_flash", True,
+            "route scaled_dot_product_attention through the flash "
+            "entry when the request is expressible (no mask / boolean "
+            "key-padding masks); 0 pins every sdpa to the XLA softmax "
+            "core — the exact-XLA-numerics escape hatch for callers "
+            "that keep the reference signature")
 
 
 def _sdpa_core(q, k, v, mask=None, dropout=0.0, causal=False, scale=None):
@@ -37,14 +45,96 @@ def _sdpa_core(q, k, v, mask=None, dropout=0.0, causal=False, scale=None):
     return jnp.swapaxes(out, 1, 2)  # back to [B,S,H,D]
 
 
+def _mask_to_key_bands(mask, batch, sq, sk, n_heads):
+    """Boolean keep-mask with NO row (query) structure → raw flashmask
+    `startend_row_indices` [b, h_se, sk, 1] int32, or None when the
+    mask is not a pure key/padding mask.
+
+    Accepted shapes (paddle's padding-mask conventions): [b, 1, sk] and
+    [b, h|1, 1, sk] — every accepted layout masks whole key COLUMNS,
+    exactly what flashmask's column bands express (a masked column is
+    the band [0, sq), a kept column the empty band [sq, sq)). Masks
+    with a real query dim (> 1), additive float masks, and ambiguous
+    2-D shapes stay on the XLA path.
+    """
+    if mask is None or mask.dtype != jnp.bool_:
+        return None
+    m = mask
+    if m.ndim == 3:
+        if m.shape[0] != batch or m.shape[1] != 1 or m.shape[2] != sk:
+            return None
+        m = m[:, :, None, :]
+    elif m.ndim == 4:
+        if (m.shape[0] != batch or m.shape[2] != 1
+                or m.shape[3] != sk):
+            return None
+    else:
+        return None
+    h_se = m.shape[1]
+    if h_se != 1 and (h_se > n_heads or n_heads % h_se):
+        return None
+    # kept column -> empty band [sq, sq); masked column -> [0, sq)
+    se = jnp.where(m[:, :, 0, :], jnp.int32(sq), jnp.int32(0))
+    return se[..., None]
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
-                                 training=True, name=None):
+                                 training=True, name=None, *,
+                                 use_flash=True):
     """paddle layout [batch, seq, heads, head_dim]
-    (reference flash_attention.py:976)."""
+    (reference flash_attention.py:976).
+
+    Dispatches to the flash-attention entry (kernels/
+    flash_attention.py) whenever it can express the request — no mask,
+    or a boolean key/padding mask (converted to flashmask column bands,
+    so encoder models like BERT reach the fused path bidirectionally) —
+    and keeps the XLA softmax core for additive/row-structured masks.
+    The route is trace-time counter-visible (docs/OBSERVABILITY.md):
+    `kernels.flash.sdpa.pallas[_mask]` when the Pallas kernel will
+    actually serve (`pallas_path_eligible` — the same predicate the
+    entry point uses), `kernels.flash.sdpa.xla[_mask]` when the flash
+    entry's XLA fallback runs (off-TPU, untiled shapes, failed head-dim
+    probe), `xla_dense_mask` for unconvertible masks, `xla_core` for an
+    explicit ``use_flash=False`` opt-out (the escape hatch models like
+    LlamaConfig(use_flash_attention=False) rely on for exact-XLA
+    numerics). Note ``dropout_p`` is accepted for API parity but
+    attention-probability dropout is not applied on any path (TPU
+    flash kernels don't support it; the XLA core never did). Rows
+    whose keys are ALL masked emit zeros on the flash paths
+    (flash-attn v2 convention) instead of the XLA softmax's NaN.
+    """
+    from ...core.flags import get_flag
+    from ...kernels import flash_attention as kernel_mod
+    from ... import monitor
+
+    # FLAGS_sdpa_use_flash=0 is the global escape hatch for callers
+    # that cannot reach the keyword (e.g. nn.MultiHeadAttention keeps
+    # the reference signature): pins every sdpa to the XLA core
+    use_flash = use_flash and get_flag("sdpa_use_flash")
+
     def fn(q, k, v, *rest):
         m = rest[0] if rest else None
-        return _sdpa_core(q, k, v, m, dropout_p, is_causal)
+        b, sq = q.shape[0], q.shape[1]
+        sk = k.shape[1]
+        if not use_flash:
+            monitor.counter("kernels.flash.sdpa.xla_core").increase()
+            return _sdpa_core(q, k, v, m, dropout_p, is_causal)
+        se = None
+        if m is not None:
+            se = _mask_to_key_bands(m, b, sq, sk, k.shape[2]) \
+                if sq == sk else None
+            if se is None:
+                monitor.counter(
+                    "kernels.flash.sdpa.xla_dense_mask").increase()
+                return _sdpa_core(q, k, v, m, dropout_p, is_causal)
+        pallas = kernel_mod.pallas_path_eligible(sq, sk, q.shape[-1])
+        suffix = "_mask" if se is not None else ""
+        monitor.counter(
+            "kernels.flash.sdpa."
+            f"{'pallas' if pallas else 'xla'}{suffix}").increase()
+        return kernel_mod.flash_attention_arrays(
+            q, k, v, causal=is_causal, startend_row_indices=se)
     args = [query, key, value] + (
         [attn_mask] if attn_mask is not None else [])
     return run_op("scaled_dot_product_attention", fn, args)

@@ -101,6 +101,10 @@ class BertSelfAttention(Layer):
         self.dropout_p = c.attention_probs_dropout_prob
 
     def forward(self, x, attn_mask=None):
+        # F.scaled_dot_product_attention routes to the Pallas flash
+        # kernel for the bidirectional case — mask-free, or a boolean
+        # key/padding mask expressed as flashmask column bands
+        # (docs/KERNELS.md "Encoder flash attention")
         b, s, _ = x.shape
         qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, self.head_dim])
         from ...ops.manipulation import split as _split
@@ -131,8 +135,8 @@ class BertEncoderLayer(Layer):
         self.ffn_norm = LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
         self.dropout = Dropout(c.hidden_dropout_prob)
 
-    def forward(self, x):
-        x = self.attn_norm(x + self.dropout(self.attention(x)))
+    def forward(self, x, attn_mask=None):
+        x = self.attn_norm(x + self.dropout(self.attention(x, attn_mask)))
         h = self.fc2(F.gelu(self.fc1(x)))
         return self.ffn_norm(x + self.dropout(h))
 
@@ -148,10 +152,24 @@ class BertModel(Layer):
              for _ in range(config.num_hidden_layers)])
         self.pooler = Linear(config.hidden_size, config.hidden_size)
 
-    def forward(self, input_ids, token_type_ids=None):
+    def forward(self, input_ids, token_type_ids=None,
+                attention_mask=None):
+        """attention_mask: optional [b, s] (1 = attend, 0 = padding),
+        the reference BertModel convention; converted once to the
+        boolean [b, 1, 1, s] key mask every layer shares — column-only,
+        so the flash kernel serves it as flashmask bands."""
         x = self.embeddings(input_ids, token_type_ids)
+        mask = None
+        if attention_mask is not None:
+            from ...core.dispatch import run_op_nodiff
+
+            def to_key_mask(m):
+                return (m != 0)[:, None, None, :]
+
+            mask = run_op_nodiff("bert_key_mask", to_key_mask,
+                                 [attention_mask])
         for lyr in self.encoder:
-            x = lyr(x)
+            x = lyr(x, mask)
         pooled = F.tanh(self.pooler(x[:, 0]))
         return x, pooled
 
@@ -168,8 +186,10 @@ class BertForPretraining(Layer):
         # decoder tied to word embeddings
         self.nsp_head = Linear(config.hidden_size, 2)
 
-    def forward(self, input_ids, token_type_ids=None, labels=None):
-        seq, pooled = self.bert(input_ids, token_type_ids)
+    def forward(self, input_ids, token_type_ids=None, labels=None,
+                attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids,
+                                attention_mask)
         h = self.transform_norm(F.gelu(self.transform(seq)))
         w = self.bert.embeddings.word_embeddings.weight
         nsp_logits = self.nsp_head(pooled)

@@ -26,9 +26,14 @@ class ErnieMoEConfig(LlamaConfig):
     # linear in tokens (see MoELayer.group_size); ~2K tokens per routing
     # group is the measured sweet spot on v5e
     moe_group_size: int = 2048
-    # "einsum" (grouped dense dispatch) or "scatter" (sparse indices,
-    # O(N*k*H) — wins at large expert counts; see docs/PERF.md study)
-    moe_dispatch_mode: str = "einsum"
+    # "pallas" (fused grouped-matmul kernel — sparse indices + the
+    # Pallas expert FFN that skips dead capacity slots; the default,
+    # degrading counter-visibly to einsum off-TPU — see the
+    # dispatch_mode="pallas" study in docs/PERF.md and the kernel
+    # write-up in docs/KERNELS.md), "einsum" (grouped dense dispatch)
+    # or "scatter" (sparse indices, O(N*k*H) — the pre-kernel winner at
+    # large expert counts; docs/PERF.md round-5 study)
+    moe_dispatch_mode: str = "pallas"
 
     @staticmethod
     def tiny(vocab=128, hidden=64, layers=2, heads=4, experts=4):
@@ -122,6 +127,12 @@ def ernie_moe_flops_per_token(config: ErnieMoEConfig) -> float:
                 if i % c.moe_every == c.moe_every - 1)
     n_dense = L - n_moe
     attn = 4 * c.hidden_size * c.hidden_size
+    # the 3-vs-2 mat asymmetry is REAL architecture, not an accounting
+    # bug: dense blocks are LlamaMLP (SwiGLU — gate/up/down), experts
+    # are GroupedExpertsFFN (gelu w1/w2). Verified against the live
+    # models' parameter shapes, modulo the negligible expert biases —
+    # tests/test_moe_kernel.py::test_ernie_moe_flops_match_param_shapes
+    # keeps the two from drifting.
     dense_ffn = 3 * c.hidden_size * c.intermediate_size   # SwiGLU
     # GroupedExpertsFFN: two mats (w1 [H,F], w2 [F,H]) per expert;
     # a token runs top_k of them, plus the H x E router

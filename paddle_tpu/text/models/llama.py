@@ -246,7 +246,11 @@ class LlamaAttention(Layer):
             out = flash_attention(q, k, v, causal=True,
                                   window=self.window)
         else:
-            out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+            # use_flash_attention=False is an explicit opt-out (exact
+            # XLA numerics / Mosaic-miscompile escape hatch): pin sdpa
+            # to its XLA core so the routing layer can't re-route it
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                                 use_flash=False)
         out = out.reshape([b, s, self.num_heads * self.head_dim])
         if self._tag:
             from ...distributed.fleet.recompute import checkpoint_name

@@ -495,3 +495,39 @@ def test_cli_needs_no_framework_import():
                          capture_output=True, text=True, cwd=REPO)
     assert res.returncode == 1, res.stderr
     assert "tensor-bool-branch" in res.stdout
+
+
+def test_jaxpr_moe_slow_dispatch_rule(monkeypatch):
+    """einsum/scatter MoE dispatch inside a traced program is an INFO
+    perf finding pointing at dispatch_mode='pallas'; the pallas path
+    itself stays silent."""
+    import paddle_tpu.incubate.distributed.models.moe.moe_layer as ml
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+    def build(mode):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.moe = MoELayer(d_model=128, d_hidden=256,
+                                    num_experts=4, gate="gshard",
+                                    dispatch_mode=mode)
+
+            def forward(self, x):
+                return self.moe(x)
+        paddle.seed(0)
+        return Net()
+
+    for mode in ("einsum", "scatter"):
+        rep = to_static(build(mode),
+                        input_spec=[InputSpec([2, 8, 128])]).inspect()
+        hits = rep.by_rule().get(F.MOE_SLOW_DISPATCH, [])
+        assert hits, (mode, rep.format())
+        assert hits[0].severity == F.INFO
+        assert mode in hits[0].message
+        assert "pallas" in hits[0].suggestion
+
+    monkeypatch.setattr(ml, "_FORCE_PALLAS", True)
+    monkeypatch.setattr(ml, "_PALLAS_INTERPRET", True)
+    rep = to_static(build("pallas"),
+                    input_spec=[InputSpec([2, 8, 128])]).inspect()
+    assert F.MOE_SLOW_DISPATCH not in rep.rules(), rep.format()

@@ -35,7 +35,7 @@ def stubbed(monkeypatch):
     monkeypatch.setattr(bench, "bench_lenet", lambda: (900.0, 30.0))
     monkeypatch.setattr(bench, "bench_bert", lambda: (50000.0, 0.4))
     monkeypatch.setattr(bench, "bench_ernie_moe",
-                        lambda: (20000.0, 0.3))
+                        lambda **kw: (20000.0, 0.3))
     monkeypatch.setattr(bench, "bench_resnet50", lambda: 2500.0)
     monkeypatch.setattr(bench, "bench_llama_decode",
                         lambda **kw: 900.0)
@@ -59,7 +59,9 @@ def test_headline_prints_first_and_extras_append(stubbed, capsys,
     last = lines[-1]["extras"]
     for key in ["llama_seq2048_mfu", "llama_small_seq512_mfu",
                 "lenet_train_steps_per_sec_b256",
-                "bert_base_tokens_per_sec", "ernie_moe_tokens_per_sec",
+                "bert_base_tokens_per_sec", "bert_base_mfu_approx",
+                "ernie_moe_tokens_per_sec", "ernie_moe_mfu_routed",
+                "ernie_moe_dispatch_pallas_tokens_per_sec",
                 "resnet50_images_per_sec",
                 "llama_1b_decode_tokens_per_sec",
                 "llama_1b_decode_paged_int8_tokens_per_sec",
@@ -69,6 +71,10 @@ def test_headline_prints_first_and_extras_append(stubbed, capsys,
                 "llama_1b_serving_spec_tokens_per_sec"]:
         assert key in last, key
     assert "skipped" not in last
+    # the stubbed runs trace no MoE dispatch, so the path attribution
+    # records them as warm executables rather than omitting the entry
+    assert last["telemetry"]["moe_dispatch_path"]["ernie_moe"] \
+        == "cached-executable"
 
 
 def test_budget_skips_extras_but_headline_survives(stubbed, capsys,
@@ -79,7 +85,8 @@ def test_budget_skips_extras_but_headline_survives(stubbed, capsys,
     assert lines[0]["value"] == 17000.0
     assert set(lines[-1]["extras"]["skipped"]) == {
         "llama_seq2048", "llama_small_seq512", "lenet", "bert_base",
-        "ernie_moe", "resnet50", "llama_decode", "llama_decode_bf16kv",
+        "ernie_moe", "ernie_moe_dispatch_pallas", "resnet50",
+        "llama_decode", "llama_decode_bf16kv",
         "llama_decode_int8kv", "llama_decode_int8",
         "llama_decode_paged", "llama_decode_paged_int8",
         "llama_decode_rolling", "llama_serving",

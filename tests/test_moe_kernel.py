@@ -1,0 +1,301 @@
+"""Fused MoE grouped-matmul kernel tests (interpret mode on CPU — CI
+needs no TPU) + the dispatch_mode="pallas" layer path.
+
+Matrix: ragged per-expert group sizes incl. EMPTY experts,
+capacity-overflow dropped tokens, top-1 (switch) vs top-2 (gshard),
+bf16 operands with f32 accumulation (<= 1e-2 vs the einsum reference),
+end-to-end gradients, the zero-steady-state-recompile training
+contract, and the counter-visible fallback ladder.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.distributed.models.moe.moe_layer as moe_layer_mod
+import paddle_tpu.nn as nn
+from paddle_tpu import monitor
+from paddle_tpu.incubate.distributed.models.moe import MoELayer
+from paddle_tpu.kernels import moe as moe_kernels
+
+
+@pytest.fixture
+def pallas_interpret(monkeypatch):
+    """Force the Pallas dispatch on the CPU backend, kernels in
+    interpret mode (the flash-kernel test convention)."""
+    monkeypatch.setattr(moe_layer_mod, "_FORCE_PALLAS", True)
+    monkeypatch.setattr(moe_layer_mod, "_PALLAS_INTERPRET", True)
+
+
+def _kernel_operands(rng, e, c, h, f, dtype=jnp.float32):
+    mk = lambda s, sc: jnp.asarray(  # noqa: E731
+        rng.standard_normal(s).astype(np.float32) * sc, dtype)
+    x = mk((e, c, h), 0.3)
+    w1 = mk((e, h, f), 0.1)
+    w2 = mk((e, f, h), 0.1)
+    b1 = jnp.asarray(rng.standard_normal((e, 1, f)).astype(np.float32)
+                     * 0.1)
+    b2 = jnp.asarray(rng.standard_normal((e, 1, h)).astype(np.float32)
+                     * 0.1)
+    ws = jnp.asarray(rng.uniform(0.1, 1.0, (e, c, 1)).astype(np.float32))
+    return x, w1, b1, w2, b2, ws
+
+
+@pytest.mark.parametrize("counts", [
+    [16, 0, 7, 12],          # ragged + one empty expert
+    [0, 0, 0, 0],            # everything dead
+    [16, 16, 16, 16],        # full occupancy
+])
+def test_grouped_ffn_matches_reference_f32(counts):
+    rng = np.random.default_rng(0)
+    x, w1, b1, w2, b2, ws = _kernel_operands(rng, 4, 16, 128, 256)
+    cnt = jnp.asarray(counts, jnp.int32)
+    out = moe_kernels.grouped_ffn(x, w1, b1, w2, b2, ws, cnt,
+                                  interpret=True, force_pallas=True)
+    ref = moe_kernels.grouped_ffn_reference(x, w1, b1, w2, b2, ws, cnt)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_grouped_ffn_multiblock_and_relu():
+    """Capacity spanning several token blocks exercises the cross-step
+    weight-DMA schedule; relu exercises the second activation path."""
+    rng = np.random.default_rng(1)
+    x, w1, b1, w2, b2, ws = _kernel_operands(rng, 3, 512, 128, 384)
+    cnt = jnp.asarray([512, 300, 0], jnp.int32)
+    for act in ("gelu", "relu"):
+        out = moe_kernels.grouped_ffn(x, w1, b1, w2, b2, ws, cnt,
+                                      activation=act, interpret=True,
+                                      force_pallas=True)
+        ref = moe_kernels.grouped_ffn_reference(x, w1, b1, w2, b2, ws,
+                                                cnt, activation=act)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_grouped_ffn_bf16_f32_accum_close_to_f32_reference():
+    """bf16 operands with in-kernel f32 accumulation stay within 1e-2
+    of the all-f32 einsum reference (the issue's equivalence bar)."""
+    rng = np.random.default_rng(2)
+    x, w1, b1, w2, b2, ws = _kernel_operands(rng, 4, 64, 128, 256)
+    cnt = jnp.asarray([64, 11, 0, 48], jnp.int32)
+    out = moe_kernels.grouped_ffn(
+        x.astype(jnp.bfloat16), w1.astype(jnp.bfloat16), b1,
+        w2.astype(jnp.bfloat16), b2, ws, cnt, interpret=True,
+        force_pallas=True)
+    assert out.dtype == jnp.bfloat16
+    ref = moe_kernels.grouped_ffn_reference(x, w1, b1, w2, b2, ws, cnt)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), rtol=2e-2, atol=1e-2)
+
+
+def test_grouped_ffn_gradients_match_reference():
+    """custom_vjp backward (both bwd kernels) vs jax.grad through the
+    einsum reference, for every differentiable operand."""
+    rng = np.random.default_rng(3)
+    x, w1, b1, w2, b2, ws = _kernel_operands(rng, 3, 32, 128, 128)
+    cnt = jnp.asarray([32, 0, 19], jnp.int32)
+
+    def loss_k(*a):
+        return jnp.sum(jnp.sin(moe_kernels.grouped_ffn(
+            *a, cnt, interpret=True, force_pallas=True)))
+
+    def loss_r(*a):
+        return jnp.sum(jnp.sin(moe_kernels.grouped_ffn_reference(
+            *a, cnt)))
+
+    gk = jax.grad(loss_k, argnums=tuple(range(6)))(x, w1, b1, w2, b2, ws)
+    gr = jax.grad(loss_r, argnums=tuple(range(6)))(x, w1, b1, w2, b2, ws)
+    for name, a, b in zip(("dx", "dw1", "db1", "dw2", "db2", "dws"),
+                          gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+def test_padded_capacity_and_eligibility():
+    assert moe_kernels.padded_capacity(5, "float32") == 8
+    assert moe_kernels.padded_capacity(300, "float32") == 512
+    assert moe_kernels.padded_capacity(256, "float32") == 256
+    assert moe_kernels.moe_pallas_eligible(128, 256, 64, "float32")
+    why = moe_kernels.moe_pallas_requirements(100, 256, 64, "float32")
+    assert why and "lane width" in why
+    why = moe_kernels.moe_pallas_requirements(128, 200, 64, "float32")
+    assert why and "d_hidden" in why
+
+
+# ---------------------------------------------------------------------------
+# MoELayer dispatch_mode="pallas"
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("gate,top_k,cf", [
+    ("switch", 1, 4.0),             # top-1
+    ("gshard", 2, 2.0),             # top-2
+    ("gshard", 2, 0.26),            # tight capacity -> dropped tokens
+])
+def test_pallas_layer_matches_einsum(pallas_interpret, gate, top_k, cf):
+    """Identical routing decisions, identical outputs (<= 1e-4) across
+    the dispatch implementations — including when capacity overflow
+    drops tokens."""
+    rng = np.random.default_rng(7)
+    x_np = rng.standard_normal((2, 32, 128)).astype(np.float32)
+    outs = {}
+    for mode in ("einsum", "pallas"):
+        paddle.seed(3)
+        layer = MoELayer(d_model=128, d_hidden=256, num_experts=4,
+                         gate=gate, top_k=top_k, capacity_factor=cf,
+                         dispatch_mode=mode)
+        layer.eval()
+        out = layer(paddle.to_tensor(x_np))
+        outs[mode] = (np.asarray(out.numpy()), float(layer.l_aux.numpy()))
+    np.testing.assert_allclose(outs["pallas"][0], outs["einsum"][0],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(outs["pallas"][1], outs["einsum"][1],
+                               rtol=1e-5)
+
+
+def test_pallas_layer_counter_and_backward(pallas_interpret):
+    paddle.seed(5)
+    layer = MoELayer(d_model=128, d_hidden=256, num_experts=4,
+                     gate="gshard", top_k=2, dispatch_mode="pallas")
+    x = paddle.to_tensor(np.random.default_rng(5).standard_normal(
+        (2, 16, 128)).astype(np.float32), stop_gradient=False)
+    before = monitor.counter("kernels.moe.dispatch_path.pallas").get()
+    out = layer(x)
+    assert monitor.counter(
+        "kernels.moe.dispatch_path.pallas").get() == before + 1
+    (out.sum() + layer.l_aux).backward()
+    for name in ("w1", "b1", "w2", "b2"):
+        g = getattr(layer.experts, name).grad
+        assert g is not None and float(np.abs(g.numpy()).sum()) > 0, name
+    assert float(np.abs(x.grad.numpy()).sum()) > 0
+    assert float(np.abs(layer.gate_weight.grad.numpy()).sum()) > 0
+
+
+def test_pallas_fallback_sites_are_counter_visible():
+    """On CPU (no force) the pallas layer degrades to einsum and names
+    why; custom experts and untiled geometry name their own sites."""
+    def delta(site, build, x_np):
+        c = monitor.counter(f"kernels.moe.dispatch_path.fallback.{site}")
+        e = monitor.counter("kernels.moe.dispatch_path.einsum")
+        c0, e0 = c.get(), e.get()
+        layer = build()
+        layer.eval()
+        layer(paddle.to_tensor(x_np))
+        return c.get() - c0, e.get() - e0
+
+    paddle.seed(0)
+    x128 = np.random.default_rng(0).standard_normal(
+        (1, 8, 128)).astype(np.float32)
+    fb, ein = delta("platform", lambda: MoELayer(
+        d_model=128, d_hidden=256, num_experts=2,
+        dispatch_mode="pallas"), x128)
+    assert fb == 1 and ein == 1
+
+    x100 = np.random.default_rng(0).standard_normal(
+        (1, 8, 100)).astype(np.float32)
+    fb, _ = delta("geometry", lambda: MoELayer(
+        d_model=100, d_hidden=256, num_experts=2,
+        dispatch_mode="pallas"), x100)
+    assert fb == 1
+
+    class MyExperts(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.w = self.create_parameter([2, 128, 128])
+
+        def forward(self, x):
+            import jax.numpy as jnp_
+            from paddle_tpu.core.dispatch import run_op
+            return run_op("my_experts",
+                          lambda xx, w: jnp_.einsum("ech,ehf->ecf",
+                                                    xx, w),
+                          [x, self.w])
+
+    fb, _ = delta("custom-experts", lambda: MoELayer(
+        d_model=128, d_hidden=128, num_experts=2,
+        experts=MyExperts(), dispatch_mode="pallas"), x128)
+    assert fb == 1
+
+
+def test_pallas_trains_with_zero_steady_state_recompiles(
+        pallas_interpret):
+    """The acceptance contract: a fixed-shape training loop on the
+    fused dispatch path compiles once and never again (capacity, block
+    padding and counts are all shape-derived statics)."""
+    from paddle_tpu.profiler.stats import CompileTracker
+
+    paddle.seed(11)
+    h = 128
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.moe = MoELayer(d_model=h, d_hidden=256, num_experts=4,
+                                gate="gshard", top_k=2,
+                                dispatch_mode="pallas")
+            self.head = nn.Linear(h, 4)
+
+        def forward(self, x):
+            return self.head(self.moe(x))
+
+    net = Net()
+    ce = nn.CrossEntropyLoss()
+
+    def loss_fn(out, y):
+        return ce(out, y) + 0.01 * net.moe.l_aux
+
+    opt = paddle.optimizer.Adam(1e-2, parameters=net.parameters())
+    step = paddle.jit.TrainStep(net, loss_fn, opt)
+    rng = np.random.default_rng(1)
+    x = paddle.to_tensor(rng.standard_normal((4, 8, h)).astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 4, (4, 8)))
+    tr = CompileTracker().start()
+    try:
+        l0 = float(step(x, y).numpy())
+        tr.on_step()
+        for _ in range(4):
+            l1 = float(step(x, y).numpy())
+            tr.on_step()
+    finally:
+        tr.stop()
+    # two warmup compiles are TrainStep's own (first trace + the
+    # second-call donation variant — an einsum-mode run shows the
+    # identical [1, 1, 0, ...] profile); the contract here is that the
+    # pallas dispatch adds NO shape-churn recompiles after them
+    assert tr.steady_state_recompiles(warmup_steps=2) == 0, tr.per_step
+    assert np.isfinite(l0) and l1 < l0
+
+
+def test_ernie_moe_flops_match_param_shapes():
+    """The routed-MFU denominator derives from the live model's actual
+    parameter shapes: dense SwiGLU blocks count 3 mats, gelu experts 2
+    (that asymmetry is real architecture — see ernie_moe.py) — modulo
+    the negligible expert biases and norms neither side counts."""
+    from paddle_tpu.text.models import ErnieMoEConfig, ErnieMoEForCausalLM
+    from paddle_tpu.text.models.ernie_moe import ernie_moe_flops_per_token
+
+    cfg = ErnieMoEConfig.tiny(vocab=64, hidden=32, layers=4, heads=4,
+                              experts=4)
+    cfg.top_k = 2
+    paddle.seed(0)
+    net = ErnieMoEForCausalLM(cfg)
+    active = 0
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape))
+        if "norm" in name:
+            continue
+        if ".experts.b" in name:        # biases: not in the 6N rule
+            continue
+        if ".experts." in name:
+            active += cfg.top_k * n // cfg.num_experts
+        else:
+            active += n
+    assert ernie_moe_flops_per_token(cfg) == pytest.approx(6.0 * active)
+
+
+def test_dispatch_mode_validation():
+    with pytest.raises(ValueError, match="dispatch_mode"):
+        MoELayer(d_model=8, d_hidden=16, num_experts=2,
+                 dispatch_mode="cuda")
