@@ -415,7 +415,8 @@ def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
                         prompt_hi=192, new_tokens=128,
                         arrival_rate_hz=40.0, cache_dtype="auto",
                         shared_prefix=0, prefix_cache=False,
-                        draft_layers=0, spec_k=4):
+                        draft_layers=0, spec_k=4,
+                        fault_rate=0.0, fault_seed=0):
     """Continuous-batching serving throughput on the 1B model
     (paddle_tpu.inference.Engine over the paged KV stack,
     docs/SERVING.md): a fixed-seed Poisson-ish arrival trace
@@ -436,7 +437,13 @@ def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
     K-layer draft model (same vocab/geometry) and decodes through the
     draft/verify schedule with spec_k drafted tokens per tick —
     token-identical by construction, faster whenever the draft earns
-    its accept rate."""
+    its accept rate.
+
+    fault_rate>0 arms the seeded FaultInjector (docs/SERVING.md
+    "Reliability") for both passes: the reported number is
+    surviving-request throughput under injected chaos — the price of
+    the per-step invariant audit plus the faults themselves — and the
+    run raises if the pool leaks pages or the audit ends dirty."""
     import paddle_tpu as paddle
     from paddle_tpu.inference.engine import Engine, SamplingParams
     from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
@@ -482,10 +489,15 @@ def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
     # page_size 128 keeps the [page, head_dim] tiles Pallas-eligible
     # for every cache_dtype (docs/DECODE.md); cache_dtype="int8"
     # serves quantized KV pools dequantized inside the decode kernel
+    injector = None
+    if fault_rate > 0.0:
+        from paddle_tpu.inference.reliability import FaultInjector
+        injector = FaultInjector(seed=fault_seed, rate=fault_rate)
     eng = Engine(net, max_slots=max_slots, page_size=128,
                  prefill_bucket=64, max_context=prompt_hi + new_tokens,
                  cache_dtype=cache_dtype, prefix_cache=prefix_cache,
-                 draft_model=draft, spec_k=spec_k)
+                 draft_model=draft, spec_k=spec_k,
+                 fault_injector=injector)
 
     def run_trace():
         t0 = time.perf_counter()
@@ -507,11 +519,21 @@ def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
                 continue
             outs = eng.step()
             done += len(outs)
-            toks += sum(len(o.token_ids) for o in outs)
+            toks += sum(len(o.token_ids) for o in outs if o.ok)
         return toks / (time.perf_counter() - t0)
 
     run_trace()                 # compile pass (warms eng's executables)
-    return run_trace()
+    tok_s = run_trace()
+    if injector is not None:
+        # the chaos contract, enforced on the measured pass too: no
+        # leaked pages, no lingering refcount skew
+        findings = eng.check_invariants()
+        if findings or eng.pages_free != eng.pool_pages:
+            raise RuntimeError(
+                f"serving chaos bench corrupted the pool: "
+                f"{eng.pool_pages - eng.pages_free} leaked page(s), "
+                f"findings {findings}")
+    return tok_s
 
 
 def bench_flashmask_8k(b=4, h=8, s=8192, d=128, n=20):
@@ -789,6 +811,17 @@ def main():
         result["extras"]["llama_1b_serving_spec_tokens_per_sec"] = \
             round(tok, 1)
 
+    def add_serving_chaos():
+        # the reliability tax: the same arrival trace under a seeded
+        # FaultInjector (2% per fault point per query) with the
+        # per-step invariant audit on — surviving-request throughput,
+        # and a hard failure on any leaked page or audit finding
+        tok = _record_decode_path(
+            "serving_chaos",
+            lambda: bench_llama_serving(fault_rate=0.02))
+        result["extras"]["llama_1b_serving_chaos_tokens_per_sec"] = \
+            round(tok, 1)
+
     def add_flashmask():
         ms = bench_flashmask_8k()
         result["extras"]["flashmask_seq8k_docmask_ms"] = round(ms, 2)
@@ -818,6 +851,7 @@ def main():
         ("llama_serving_int8kv", add_serving_int8kv, 300),
         ("llama_serving_prefix", add_serving_prefix, 300),
         ("llama_serving_spec", add_serving_spec, 300),
+        ("llama_serving_chaos", add_serving_chaos, 300),
         ("flashmask_8k", add_flashmask, 90),
     ]
     skipped = []

@@ -261,10 +261,17 @@ def _get_phi_kernel_name(op_name):
 _ENGINE_EXPORTS = ("Engine", "SamplingParams", "Output", "Request")
 
 
+_RELIABILITY_EXPORTS = ("FaultInjector", "FaultPlan", "InjectedFault",
+                        "FAULT_SITES", "save_snapshot", "load_snapshot")
+
+
 def __getattr__(name):
     if name in _ENGINE_EXPORTS:
         from . import engine as _engine
         return getattr(_engine, name)
+    if name in _RELIABILITY_EXPORTS:
+        from . import reliability as _reliability
+        return getattr(_reliability, name)
     if name == "PageAllocator":
         from .allocator import PageAllocator
         return PageAllocator

@@ -127,6 +127,122 @@ class PageAllocator:
     def owner(self, page: int):
         return self._owner.get(int(page))
 
+    def check_invariants(self,
+                         expected: Optional[Dict[int, int]] = None,
+                         repair: bool = False) -> List[str]:
+        """Free-list / live / refcount consistency audit. Returns one
+        human-readable finding per violation (empty list = healthy);
+        with ``repair=True`` each finding is also FIXED in place (the
+        chaos-recovery path: after injected refcount skew the pool must
+        converge back to balanced, not wedge).
+
+        Internal invariants (always checked): every page id is either
+        on the free list or refcounted, never both and never neither;
+        free ids are unique and in range; the owner map tracks exactly
+        the live pages; no live refcount is below 1.
+
+        ``expected`` adds the CALLER's cross-check: a map of page id →
+        the number of references the caller can account for (the
+        engine builds it from live requests' block-table pages plus
+        one per prefix-cache entry). A live page nobody accounts for
+        is a LEAK; a refcount above/below the accounted holders is
+        REFCOUNT SKEW — the failure modes a lost ``free`` or a stray
+        ``share`` produce, invisible to the internal checks because
+        the allocator's own books still balance.
+        """
+        findings: List[str] = []
+        lo, hi = self.base, self.base + self.num_pages
+        free_list = list(self._free)
+        free_set = set(free_list)
+        if len(free_list) != len(free_set):
+            findings.append(
+                f"free list holds duplicate ids "
+                f"({len(free_list)} entries, {len(free_set)} unique) — "
+                f"double-free let through")
+            if repair:
+                self._free = deque(dict.fromkeys(free_list))
+                free_list = list(self._free)
+        bad_range = [p for p in free_set if not lo <= p < hi]
+        if bad_range:
+            findings.append(
+                f"free list holds out-of-range ids {sorted(bad_range)} "
+                f"(pool ids [{lo}, {hi}))")
+            if repair:
+                self._free = deque(p for p in self._free
+                                   if lo <= p < hi)
+        both = free_set & set(self._refs)
+        if both:
+            findings.append(
+                f"pages {sorted(both)} are BOTH free and refcounted — "
+                f"the next alloc would alias a live sequence")
+            if repair:
+                self._free = deque(p for p in self._free
+                                   if p not in both)
+        if set(self._owner) != set(self._refs):
+            extra = sorted(set(self._owner) - set(self._refs))
+            missing = sorted(set(self._refs) - set(self._owner))
+            findings.append(
+                f"owner/refcount maps diverge (owner-only {extra}, "
+                f"refs-only {missing})")
+            if repair:
+                for p in extra:
+                    del self._owner[p]
+                for p in missing:
+                    self._owner[p] = None
+        nonpos = {p: r for p, r in self._refs.items() if r < 1}
+        if nonpos:
+            findings.append(
+                f"live pages with refcount < 1: {nonpos}")
+            if repair:
+                for p in nonpos:
+                    del self._refs[p]
+                    self._owner.pop(p, None)
+                    self._free.append(p)
+        free_now = set(self._free)      # repairs above may have
+        lost = [p for p in range(lo, hi)  # mutated the free list
+                if p not in self._refs and p not in free_now]
+        if lost:
+            findings.append(
+                f"pages {lost} vanished from both the free list and "
+                f"the refcount map")
+            if repair:
+                self._free.extend(lost)
+        if expected is not None:
+            for p, refs in sorted(self._refs.items()):
+                want = int(expected.get(p, 0))
+                if want == 0:
+                    findings.append(
+                        f"leaked page {p}: refcount {refs} but no "
+                        f"request or cache entry holds it")
+                    if repair:
+                        self.free([p] * refs)
+                elif refs != want:
+                    findings.append(
+                        f"refcount skew on page {p}: allocator has "
+                        f"{refs}, holders account for {want}")
+                    if repair:
+                        if refs > want:
+                            self.free([p] * (refs - want))
+                        else:
+                            for _ in range(want - refs):
+                                self.share(p)
+            orphans = sorted(p for p, n in expected.items()
+                             if n > 0 and p not in self._refs)
+            if orphans:
+                findings.append(
+                    f"pages {orphans} are mapped by a request or "
+                    f"cache entry but not live in the allocator — "
+                    f"their next reuse aliases foreign KV")
+                if repair:
+                    free_now = set(self._free)
+                    for p in orphans:
+                        if p in free_now:
+                            self._free.remove(p)
+                            free_now.discard(p)
+                        self._owner[p] = None
+                        self._refs[p] = int(expected[p])
+        return findings
+
     def stats(self) -> Dict[str, object]:
         """Pool state snapshot for admission decisions and the
         ``serving.prefix_pages_shared`` gauge: free/live/shared page

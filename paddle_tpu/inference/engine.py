@@ -70,6 +70,37 @@ Two opt-in accelerators ride on the same scheduler (this PR):
 ``serving.spec_accepted`` / ``serving.decode_fallback`` (engine
 built with a Pallas-ineligible page geometry — validated ONCE at
 construction, docs/DECODE.md).
+
+Reliability layer (inference/reliability.py has the fault catalog and
+the snapshot format):
+
+* Request lifecycle hardening: per-request ``deadline_ms`` /
+  ``max_queue_steps`` enforced on the engine's step clock, a
+  ``cancel(request_id)`` API, and a terminal FAILED(reason) state —
+  one bad request (capacity error, NaN logits, injected device error)
+  is retired with its pages freed while every other slot keeps
+  serving; the loop never raises out of ``step()`` for a per-request
+  failure. NaN/inf on any slot's sampling logits is detected IN-GRAPH
+  (a tiny ``ok`` flag vector rides out of each executable) and
+  quarantines exactly the offending slot
+  (``serving.nan_quarantines``).
+* Deterministic fault injection: a seeded ``FaultInjector``
+  (``fault_injector=`` or ``FLAGS_serving_fault_*``) fires named
+  faults at the allocator, prefix cache, prefill/decode/verify
+  executables and the draft loop; chaos runs replay bit-identically
+  from the seed.
+* Crash-exact snapshot/restore: ``snapshot()`` serializes the
+  host-side source of truth (request tokens, rng chains, sampling
+  params, admission order — not KV pools) and ``restore()`` re-admits
+  everything through the preemption/resume-prefill machinery, so a
+  restarted engine's outputs are bit-identical to an uninterrupted
+  run. ``run(heartbeat_timeout=...)`` attaches a
+  ``distributed.watchdog.Heartbeat`` that snapshots-and-reports when
+  the loop stalls.
+
+All of it stays on the fixed compiled surfaces:
+``steady_state_recompiles() == 0`` holds across cancel / timeout /
+fail / restore traces (the tests assert it).
 """
 from __future__ import annotations
 
@@ -86,6 +117,7 @@ import numpy as np
 from .. import monitor
 from ..core import tape as tape_mod
 from ..core.dispatch import unwrap
+from ..core.flags import get_flag
 from ..jit.functional import get_buffers, get_frozen, get_params
 from ..kernels.paged_attention import paged_pallas_requirements
 from ..profiler.stats import CompileTracker
@@ -93,6 +125,7 @@ from ..text.generation import (_model_forward, _resolve_cache_dtype,
                                sample_token_arrays, verify_token_arrays)
 from .allocator import PageAllocator
 from .prefix_cache import PrefixCache
+from .reliability import InjectedFault, injector_from_flags
 
 # request lifecycle states
 WAITING = "WAITING"
@@ -100,6 +133,12 @@ PREFILL = "PREFILL"
 DECODE = "DECODE"
 FINISHED = "FINISHED"
 PREEMPTED = "PREEMPTED"
+FAILED = "FAILED"
+
+#: prefill attempts before a transiently failing request is FAILED
+#: (injected device errors / pool exhaustion requeue up to this many
+#: times; a deterministic failure burns through them in 3 ticks)
+MAX_PREFILL_RETRIES = 3
 
 
 @dataclass
@@ -113,6 +152,13 @@ class SamplingParams:
     top_p: float = 0.0
     eos_token_id: Optional[int] = None
     seed: int = 0
+    # reliability knobs (enforced on the engine's step clock, checked
+    # at every tick start): a request past its wall deadline — or one
+    # still waiting for a slot after max_queue_steps ticks — is FAILED
+    # ("deadline" / "queue_timeout") with its pages freed, instead of
+    # occupying capacity forever
+    deadline_ms: Optional[float] = None
+    max_queue_steps: Optional[int] = None
 
     def validate(self):
         if int(self.max_new_tokens) < 1:
@@ -121,20 +167,38 @@ class SamplingParams:
         if float(self.temperature) < 0:
             raise ValueError(
                 f"temperature must be >= 0, got {self.temperature}")
+        if self.deadline_ms is not None and float(self.deadline_ms) <= 0:
+            raise ValueError(
+                f"deadline_ms must be > 0, got {self.deadline_ms}")
+        if self.max_queue_steps is not None \
+                and int(self.max_queue_steps) < 1:
+            raise ValueError(
+                f"max_queue_steps must be >= 1, got "
+                f"{self.max_queue_steps}")
 
 
 @dataclass
 class Output:
-    """One finished request: the generated continuation (including the
-    eos token when one was emitted) plus serving latencies."""
+    """One retired request: the generated continuation (including the
+    eos token when one was emitted) plus serving latencies. A FAILED
+    request also surfaces here — ``finish_reason`` names the failure
+    ("cancelled" / "deadline" / "queue_timeout" / "nan_logits" /
+    "error:…"), ``error`` carries it too, and ``token_ids`` holds
+    whatever was generated before the failure."""
 
     req_id: int
     prompt_ids: List[int]
     token_ids: List[int]
-    finish_reason: str            # "eos" | "length"
+    finish_reason: str            # "eos" | "length" | failure reason
     ttft_ms: float                # arrival -> first token
     tpot_ms: float                # mean inter-token latency after that
     preemptions: int = 0
+    error: Optional[str] = None   # None iff the request FINISHED
+
+    @property
+    def ok(self) -> bool:
+        """True when the request ran to a normal completion."""
+        return self.error is None
 
 
 @dataclass
@@ -156,6 +220,8 @@ class Request:
     written: int = 0                      # tokens in the paged cache
     admit_seq: int = -1                   # admission order (preemption)
     preemptions: int = 0
+    retries: int = 0                      # failed prefill attempts
+    queued_step: int = -1                 # step the request last queued
     arrival_t: float = 0.0
     first_token_t: float = 0.0
     finish_t: float = 0.0
@@ -229,7 +295,9 @@ class Engine:
                  prefill_bucket: int = 32,
                  watermark_pages: Optional[int] = None,
                  prefix_cache: bool = False,
-                 draft_model=None, spec_k: int = 4):
+                 draft_model=None, spec_k: int = 4,
+                 clock=None, fault_injector=None,
+                 debug_invariants: Optional[bool] = None):
         import inspect
         try:
             fsig = inspect.signature(model.forward)
@@ -328,6 +396,35 @@ class Engine:
         if draft_model is not None:
             from .speculative import SpeculativeDecoder
             self._spec = SpeculativeDecoder(self, draft_model, spec_k)
+        # reliability surfaces (inference/reliability.py): the step
+        # clock every deadline is measured on (injectable so replay
+        # tools and tests run deterministic virtual time), the seeded
+        # fault injector (explicit, or armed process-wide via
+        # FLAGS_serving_fault_seed), and the per-step invariant audit
+        self._clock = clock if clock is not None else time.perf_counter
+        # fault_injector: an explicit FaultInjector, None = arm from
+        # FLAGS_serving_fault_* (off by default), False = force OFF
+        # even when the flags arm the process (the chaos tooling's
+        # clean baseline passes)
+        if fault_injector is False:
+            self._injector = None
+        elif fault_injector is None:
+            self._injector = injector_from_flags()
+        else:
+            self._injector = fault_injector
+        self._debug_invariants = (
+            bool(get_flag("serving_debug_invariants"))
+            if debug_invariants is None else bool(debug_invariants))
+        # the NaN-injection vector riding into every decode/verify
+        # step: all-zeros (one resident device array, re-uploaded only
+        # on the rare fault tick) added to the sampling logits — a NaN
+        # row turns that slot's in-graph `ok` flag off
+        self._poison_zeros = jnp.zeros((S,), jnp.float32)
+        self._poison_dev = self._poison_zeros
+        self._poisoned = False
+        self.last_stall_snapshot: Optional[dict] = None
+        from ..distributed import watchdog as _watchdog
+        self._watchdog = _watchdog
         self._tracker = CompileTracker().start()
         # Pallas paged-decode eligibility is a STATIC property of
         # (head_dim, page_size, cache_dtype) — validate it once here
@@ -392,7 +489,7 @@ class Engine:
             return fn
         model = self.model
 
-        def body(st, caches, bt, state):
+        def body(st, caches, bt, state, poison):
             last, pos, temps, topks, topps, keys, live = state
             kv = self._inject_bt(caches, bt)
             # idle lanes ride at cache_index -1: their context_lens
@@ -406,7 +503,13 @@ class Engine:
             idx = jnp.where(live > 0, pos, -jnp.ones_like(pos))
             logits, new_kv = _model_forward(model, st, last[:, None],
                                             kv, idx)
-            cur = logits[:, -1].astype(jnp.float32)
+            # poison (normally all zeros, NaN at a fault-injected
+            # slot) rides into the sampling logits so the in-graph
+            # NaN/inf detector exercises the SAME path a genuinely
+            # NaN-emitting model would hit; `ok` is the per-slot
+            # quarantine flag the host checks before trusting a token
+            cur = logits[:, -1].astype(jnp.float32) + poison[:, None]
+            ok = jnp.isfinite(cur).all(axis=-1)
             if variant == "greedy":
                 nxt = jnp.argmax(cur, axis=-1).astype(jnp.int32)
                 keys2 = keys
@@ -416,7 +519,7 @@ class Engine:
                     use_filters=variant == "filtered")
             state2 = (nxt, pos + live, temps, topks, topps, keys2,
                       live)
-            return nxt, state2, self._strip_bt(new_kv)
+            return nxt, ok, state2, self._strip_bt(new_kv)
 
         fn = jax.jit(body, donate_argnums=(1, 3))
         self._decode_fns[variant] = fn
@@ -438,7 +541,7 @@ class Engine:
             return fn
         model = self.model
 
-        def body(st, caches, bt, state, drafts):
+        def body(st, caches, bt, state, drafts, poison):
             last, pos, temps, topks, topps, keys, live = state
             kv = self._inject_bt(caches, bt)
             # idle lanes at cache_index -1 (context 0), like the plain
@@ -446,8 +549,11 @@ class Engine:
             idx = jnp.where(live > 0, pos, -jnp.ones_like(pos))
             toks_in = jnp.concatenate([last[:, None], drafts], axis=1)
             logits, new_kv = _model_forward(model, st, toks_in, kv, idx)
+            scored = logits.astype(jnp.float32) \
+                + poison[:, None, None]
+            ok = jnp.isfinite(scored).all(axis=(1, 2))
             toks, acc, keys2 = verify_token_arrays(
-                logits.astype(jnp.float32), drafts, keys, temps, topks,
+                scored, drafts, keys, temps, topks,
                 topps, use_filters=variant == "filtered",
                 greedy=variant == "greedy")
             # live rows consumed acc+1 context tokens; idle rows must
@@ -457,7 +563,7 @@ class Engine:
             state2 = (jnp.where(live > 0, new_last, last),
                       pos + (acc + 1) * live, temps, topks, topps,
                       jnp.where(live[:, None] > 0, keys2, keys), live)
-            return toks, acc, state2, self._strip_bt(new_kv)
+            return toks, acc, ok, state2, self._strip_bt(new_kv)
 
         fn = jax.jit(body, donate_argnums=(1, 3))
         self._verify_fns[variant] = fn
@@ -471,7 +577,7 @@ class Engine:
         model = self.model
 
         def body(st, caches, bt_row, prompt, plen, start, temps, topks,
-                 topps, keys):
+                 topps, keys, poison):
             kv = self._inject_bt(caches, bt_row)
             # `start` is the page-aligned token offset the chunk begins
             # at — 0 for a cold prefill, the cached-prefix length on a
@@ -485,9 +591,11 @@ class Engine:
             # to the bucket; causality keeps the pad out of this row)
             idx = jnp.reshape(plen - 1, (1, 1, 1)).astype(jnp.int32)
             last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
+            cur = last.astype(jnp.float32) + poison[:, None]
+            ok = jnp.isfinite(cur).all(axis=-1)
             nxt, keys2 = sample_token_arrays(
-                last.astype(jnp.float32), keys, temps, topks, topps)
-            return nxt, keys2, self._strip_bt(new_kv)
+                cur, keys, temps, topks, topps)
+            return nxt, keys2, ok, self._strip_bt(new_kv)
 
         fn = jax.jit(body, donate_argnums=(1,))
         self._prefill_fns[pb] = fn
@@ -545,7 +653,8 @@ class Engine:
                 f"{self.pool_pages} — grow pool_pages or shrink the "
                 f"request")
         req = Request(req_id=self._next_id, prompt=prompt, params=params,
-                      arrival_t=time.perf_counter())
+                      arrival_t=self._clock(),
+                      queued_step=self._steps)
         req.key = np.asarray(jax.random.PRNGKey(int(params.seed)),
                              np.uint32)
         self._next_id += 1
@@ -555,19 +664,40 @@ class Engine:
         return req.req_id
 
     def step(self) -> List[Output]:
-        """One scheduler tick: admit + prefill new requests, grow/
-        preempt for page demand, run ONE batched decode step, retire
-        finished requests. Returns the requests that finished during
-        this tick."""
+        """One scheduler tick: expire deadlines, admit + prefill new
+        requests, grow/preempt for page demand, run ONE batched decode
+        step, retire finished requests. Returns the requests that
+        finished OR failed during this tick — a per-request failure
+        (deadline, NaN logits, prefill error) retires that request and
+        never raises out of here."""
         outputs: List[Output] = []
         c0 = self._tracker.compiles
+        if self._injector is not None:
+            self._injector.on_step(self._steps)
+            self._prefix_faults()
         with tape_mod.no_grad_guard():
+            outputs.extend(self._expire())
             for req in self._admit():
-                out = self._prefill(req)
+                out = self._safe_prefill(req)
                 if out is not None:
                     outputs.append(out)
             self._ensure_pages()
-            outputs.extend(self._decode())
+            outputs.extend(self._safe_decode())
+        if self._injector is not None and \
+                self._injector.fire("alloc.refcount_skew",
+                                    record=False):
+            # a stray reference lands on a live page (the lost-free /
+            # doubled-share failure mode) — the audit below must
+            # detect and repair it before it can become a leak;
+            # recorded only when a live page existed to skew
+            held = [p for r in self._slots if r is not None
+                    for p in r.pages]
+            if held:
+                self._injector.record("alloc.refcount_skew")
+                self._alloc.share(
+                    held[int(self._injector.rng.integers(0, len(held)))])
+        self._maybe_audit()
+        self._watchdog.maybe_start_and_tick()
         monitor.counter("serving.steps").increase()
         self._publish_gauges()
         # O(1) warmup accounting, attributed to THIS engine: only
@@ -583,14 +713,25 @@ class Engine:
         self._steps += 1
         return outputs
 
-    def run(self, requests: Sequence, max_steps: int = 100_000
-            ) -> List[Output]:
+    def run(self, requests: Sequence, max_steps: int = 100_000,
+            heartbeat_timeout: Optional[float] = None,
+            snapshot_path: Optional[str] = None) -> List[Output]:
         """Offline driver: queue every (ids, SamplingParams) pair —
-        bare ids get default params — then step until all finish.
+        bare ids get default params — then step until all finish (or
+        fail: failed requests surface as Outputs with ``error`` set).
         Returns Outputs ordered by request id. Drains only its own
         requests; drive a shared/online engine with step() instead
         (other requests' outputs surfacing mid-run would be dropped
-        here)."""
+        here).
+
+        ``heartbeat_timeout=T`` attaches an in-process
+        ``distributed.watchdog.Heartbeat``: every completed step
+        ticks it, and a loop that makes no progress for T seconds
+        triggers ``_stall_report`` — a per-thread stack dump plus a
+        best-effort host-state snapshot (to ``snapshot_path`` when
+        given, always kept on ``last_stall_snapshot``) so a wedged
+        serving process leaves a recoverable trail before the pod is
+        killed."""
         ids_list = []
         for item in requests:
             if isinstance(item, (tuple, list)) and len(item) == 2 and \
@@ -599,16 +740,92 @@ class Engine:
             else:
                 ids_list.append(self.add_request(item))
         want = set(ids_list)
+        hb = None
+        if heartbeat_timeout is not None:
+            from ..distributed.watchdog import Heartbeat
+            hb = Heartbeat(
+                float(heartbeat_timeout),
+                on_stall=lambda age: self._stall_report(
+                    age, snapshot_path))
+            hb.start()
         outs: List[Output] = []
-        for _ in range(max_steps):
-            outs.extend(o for o in self.step() if o.req_id in want)
-            if len(outs) == len(want):
-                break
-        else:
-            raise RuntimeError(
-                f"engine did not drain in {max_steps} steps "
-                f"({len(outs)}/{len(want)} finished)")
+        try:
+            for _ in range(max_steps):
+                outs.extend(o for o in self.step() if o.req_id in want)
+                if hb is not None:
+                    hb.tick()
+                if len(outs) == len(want):
+                    break
+            else:
+                raise RuntimeError(
+                    f"engine did not drain in {max_steps} steps "
+                    f"({len(outs)}/{len(want)} finished)")
+        finally:
+            if hb is not None:
+                hb.stop()
         return sorted(outs, key=lambda o: o.req_id)
+
+    def cancel(self, req_id: int) -> Optional[Output]:
+        """Abort a live or queued request NOW: its slot is freed, its
+        pages return to the pool, and its Output (``finish_reason
+        "cancelled"``, tokens generated so far) is returned. Unknown
+        or already-retired ids return None. Safe at any lifecycle
+        point — waiting, preempted, or mid-decode (the fixed-shape
+        decode step simply sees one more idle lane next tick)."""
+        req = self.requests.get(int(req_id))
+        if req is None or req.state in (FINISHED, FAILED):
+            return None
+        monitor.counter("serving.cancelled").increase()
+        return self._fail(req, "cancelled")
+
+    def snapshot(self, sync: bool = True) -> dict:
+        """Crash-exact host-state snapshot (reliability.py has the
+        format): queued + live request tokens, rng chains, sampling
+        params, admission order, prefix-index metadata — NOT KV pools.
+        ``sync=False`` skips the device fetch of live rng rows (the
+        stall-dump path, where the device may be wedged) at the cost
+        of exactness for mid-flight SAMPLING requests."""
+        from .reliability import snapshot_engine
+        return snapshot_engine(self, sync=sync)
+
+    def restore(self, snap: dict, strict: bool = True) -> int:
+        """Re-admit a snapshot's requests into this (fresh or drained)
+        engine through the preemption/resume-prefill machinery; the
+        restored run's outputs are bit-identical to the uninterrupted
+        one. Returns the number of requests re-admitted."""
+        from .reliability import restore_engine
+        return restore_engine(self, snap, strict=strict)
+
+    def snapshot_to(self, path: str, sync: bool = True) -> str:
+        from .reliability import save_snapshot
+        return save_snapshot(self.snapshot(sync=sync), path)
+
+    def restore_from(self, path: str, strict: bool = True) -> int:
+        from .reliability import load_snapshot
+        return self.restore(load_snapshot(path), strict=strict)
+
+    def check_invariants(self, repair: bool = False) -> List[str]:
+        """Cross-check the allocator against every reference the
+        engine can account for (live requests' pages + one per
+        prefix-cache entry) plus the allocator's own free-list/
+        refcount consistency and the prefix index's digest integrity.
+        Returns findings (empty = healthy); ``repair=True`` also fixes
+        them (the chaos-recovery path). Auto-run each step under
+        ``FLAGS_serving_debug_invariants`` (raise on findings) or an
+        active fault injector (repair + count)."""
+        expected: Dict[int, int] = {}
+        for r in self.requests.values():
+            held = r.pages if r.pages else (r.shared_pages or [])
+            for p in held:
+                expected[p] = expected.get(p, 0) + 1
+        if self._prefix is not None:
+            for ent in self._prefix._store.values():
+                expected[ent.page] = expected.get(ent.page, 0) + 1
+        findings = self._alloc.check_invariants(expected=expected,
+                                                repair=repair)
+        if self._prefix is not None:
+            findings += self._prefix.check_integrity(repair=repair)
+        return findings
 
     def steady_state_recompiles(self) -> int:
         """XLA compiles INSIDE this engine's step() calls after the
@@ -642,6 +859,146 @@ class Engine:
     @property
     def pages_free(self) -> int:
         return self._alloc.free_pages
+
+    # -- reliability internals -----------------------------------------------
+
+    def _fault(self, site: str) -> bool:
+        """One fault-point query against the injector (False when no
+        injector is armed — the production fast path)."""
+        return self._injector is not None and self._injector.fire(site)
+
+    def _fault_raise(self, site: str) -> None:
+        if self._fault(site):
+            raise InjectedFault(site)
+
+    def _prefix_faults(self) -> None:
+        """Per-step prefix-cache fault points: a forced digest
+        collision (the exact-token compare must degrade it to a miss)
+        and a corrupted-stale entry (must never be hit again; the
+        audit/eviction reclaims it)."""
+        if self._prefix is None:
+            return
+        if self._fault("prefix.hash_collision"):
+            self._prefix.force_collision()
+        if self._injector.fire("prefix.stale_entry", record=False) \
+                and len(self._prefix):
+            # recorded only when there was an entry to corrupt — the
+            # chaos report never claims faults that did not land
+            self._injector.record("prefix.stale_entry")
+            self._prefix.corrupt_entry(self._injector.rng)
+
+    def _maybe_audit(self) -> None:
+        auditing = self._debug_invariants or (
+            self._injector is not None
+            and self._injector.enabled("alloc.refcount_skew"))
+        if not auditing:
+            return
+        repair = self._injector is not None
+        findings = self.check_invariants(repair=repair)
+        if findings:
+            if repair:
+                monitor.counter("serving.invariant_repairs").increase(
+                    len(findings))
+            else:
+                raise RuntimeError(
+                    "engine invariant audit failed "
+                    "(FLAGS_serving_debug_invariants):\n  "
+                    + "\n  ".join(findings))
+
+    def _expire(self) -> List[Output]:
+        """Tick-start deadline sweep: fail every request past its
+        wall deadline (waiting OR mid-decode — its pages free this
+        tick) and every waiting request past its queue-step budget."""
+        outs: List[Output] = []
+        now = self._clock()
+        for req in list(self._waiting) + [r for r in self._slots
+                                          if r is not None]:
+            p = req.params
+            if p.deadline_ms is not None and \
+                    (now - req.arrival_t) * 1e3 > float(p.deadline_ms):
+                monitor.counter("serving.timeouts").increase()
+                outs.append(self._fail(req, "deadline"))
+            elif p.max_queue_steps is not None and \
+                    req.state in (WAITING, PREEMPTED) and \
+                    self._steps - req.queued_step \
+                    > int(p.max_queue_steps):
+                monitor.counter("serving.timeouts").increase()
+                outs.append(self._fail(req, "queue_timeout"))
+        return outs
+
+    def _stall_report(self, age: float,
+                      snapshot_path: Optional[str] = None) -> None:
+        """Heartbeat stall callback (watchdog thread): dump every
+        thread's stack to stderr and best-effort snapshot the host
+        state — the recoverable trail a wedged serving process leaves
+        before its pod is killed. ``sync=False``: the device may be
+        the thing that's wedged, so no device fetch."""
+        import faulthandler
+        monitor.counter("serving.stalls").increase()
+        print(f"engine watchdog: run() loop stalled for {age:.1f}s at "
+              f"step {self._steps} ({self.num_active} active, "
+              f"{len(self._waiting)} waiting, "
+              f"{self._alloc.free_pages} pages free) — dumping stacks "
+              f"and snapshotting", flush=True)
+        try:
+            faulthandler.dump_traceback(all_threads=True)
+        except Exception:  # noqa: BLE001 — diagnostics must not raise
+            pass
+        try:
+            self.last_stall_snapshot = self.snapshot(sync=False)
+            if snapshot_path:
+                from .reliability import save_snapshot
+                save_snapshot(self.last_stall_snapshot, snapshot_path)
+        except Exception as e:  # noqa: BLE001 — best-effort dump
+            print(f"engine watchdog: stall snapshot failed: {e}",
+                  flush=True)
+
+    def _safe_prefill(self, req: Request) -> Optional[Output]:
+        """Isolation wrapper: a failing prefill retires or requeues
+        THIS request — it never takes down the step() loop (the other
+        slots' state is untouched; the failed call's pages are rolled
+        back)."""
+        try:
+            return self._prefill(req)
+        except InjectedFault:
+            monitor.counter("serving.step_errors").increase()
+            return self._requeue(req, "injected device error")
+        except RuntimeError as e:
+            # transient resource pressure (pool exhaustion the
+            # admission reservation didn't cover, injected or real):
+            # back off and retry on a later tick
+            return self._requeue(req, str(e).partition("\n")[0]
+                                 or type(e).__name__)
+        except Exception as e:  # noqa: BLE001 — request isolation
+            monitor.counter("serving.step_errors").increase()
+            return self._fail(req, f"error:{type(e).__name__}")
+
+    def _requeue(self, req: Request, why: str) -> Optional[Output]:
+        self._rollback_prefill(req)
+        req.retries += 1
+        if req.retries > MAX_PREFILL_RETRIES:
+            return self._fail(req, f"error:prefill ({why})")
+        req.state = PREEMPTED if req.generated else WAITING
+        req.queued_step = self._steps
+        self._waiting.appendleft(req)
+        return None
+
+    def _rollback_prefill(self, req: Request) -> None:
+        """Undo a partially executed prefill: drop every page
+        reference the request holds — merged (req.pages) or still
+        admission-only (shared_pages) — and hand its slot back."""
+        self._clear_slot(req)
+
+    def _safe_decode(self) -> List[Output]:
+        """Isolation wrapper around the batched decode/verify tick: an
+        injected device error fires BEFORE dispatch (host state still
+        coherent), so the engine just skips the tick and retries —
+        requests see one step of extra latency, never corruption."""
+        try:
+            return self._decode()
+        except InjectedFault:
+            monitor.counter("serving.step_errors").increase()
+            return []
 
     # -- scheduler internals -------------------------------------------------
 
@@ -723,6 +1080,13 @@ class Engine:
         pb = min(self._pbucket(T),
                  self.max_blocks * self.page_size - start)
         npriv = _ceil_div(pb, self.page_size)
+        if self._fault("alloc.exhausted"):
+            # simulated admission race / fragmented pool: surfaces as
+            # the allocator's exhaustion error, which _safe_prefill
+            # turns into a clean requeue-and-retry
+            raise RuntimeError(
+                f"injected pool exhaustion: sequence {req.req_id} "
+                f"requested {npriv} page(s)")
         try:
             priv = self._alloc.alloc(npriv, seq=req.req_id)
         except RuntimeError:
@@ -742,19 +1106,29 @@ class Engine:
         bt_dev = jnp.asarray(bt_row)
         prompt_dev = jnp.asarray(prompt)
         start_dev = jnp.asarray([start], jnp.int32)
-        tok, key2, self._pools = fn(
+        self._fault_raise("prefill.device_error")
+        poison = jnp.asarray(
+            [float("nan") if self._fault("prefill.nan") else 0.0],
+            jnp.float32)
+        tok, key2, okf, self._pools = fn(
             self._st, self._pools, bt_dev, prompt_dev,
             jnp.asarray([T], jnp.int32), start_dev,
             jnp.asarray([p.temperature], jnp.float32),
             jnp.asarray([p.top_k], jnp.int32),
             jnp.asarray([p.top_p], jnp.float32),
-            jnp.asarray(req.key[None]))
+            jnp.asarray(req.key[None]), poison)
         if self._spec is not None:
             # mirror the chunk into the draft pools (same pages, same
             # positions) so drafting attends the full context
             self._spec.prefill(pb, bt_dev, prompt_dev, start_dev)
         monitor.counter("serving.prefill_tokens").increase(pb)
         monitor.counter("serving.prefix_tokens_reused").increase(start)
+        if not bool(np.asarray(okf)[0]):
+            # NaN/inf on the chunk's sampling logits: quarantine the
+            # request (pages freed, nothing enters the prefix cache)
+            # — the other slots never see it
+            monitor.counter("serving.nan_quarantines").increase()
+            return self._fail(req, "nan_logits")
         req.written = P
         # trim the bucket-padding pages the real prefix doesn't need
         # (private tail pages only — the shared prefix is never padded)
@@ -770,7 +1144,7 @@ class Engine:
             t = int(np.asarray(tok)[0])
             req.key = np.asarray(key2)[0].astype(np.uint32)
             req.generated.append(t)
-            req.first_token_t = time.perf_counter()
+            req.first_token_t = self._clock()
             monitor.counter("serving.tokens").increase()
             reason = self._finish_reason(req, t)
             if reason:
@@ -816,6 +1190,14 @@ class Engine:
     def _alloc_or_preempt(self, req: Request):
         while True:
             try:
+                if self._fault("alloc.exhausted"):
+                    # simulated mid-decode pool pressure: flows
+                    # through the SAME evict-or-preempt ladder a real
+                    # dry pool takes (the retry loop re-queries, so
+                    # one injection costs at most one eviction)
+                    raise RuntimeError(
+                        f"injected pool exhaustion: sequence "
+                        f"{req.req_id} requested 1 page")
                 return self._alloc.alloc(1, seq=req.req_id)
             except RuntimeError:
                 # idle cached pages go first: evicting a cold prefix
@@ -849,6 +1231,7 @@ class Engine:
             self._keys[i] = req.key
         self._clear_slot(req)
         req.state = PREEMPTED
+        req.queued_step = self._steps       # fresh queue-age budget
         self._waiting.appendleft(req)
 
     def _flush_state(self) -> None:
@@ -887,16 +1270,31 @@ class Engine:
             variant = "plain"
         if self._spec is not None:
             return self._decode_spec(active, variant)
+        # injected device loss fires BEFORE dispatch: host state is
+        # still coherent, _safe_decode skips the tick and retries
+        self._fault_raise("decode.device_error")
+        self._poison_slot(active)
         fn = self._get_decode_fn(variant)
         self._flush_state()
         # the fused step: forward + per-slot sampling + state advance
-        # in ONE executable; only the emitted tokens come back
-        nxt, self._dev, self._pools = fn(self._st, self._pools,
-                                         self._bt_dev, self._dev)
+        # in ONE executable; only the emitted tokens (and the tiny
+        # NaN-quarantine flags) come back
+        nxt, okv, self._dev, self._pools = fn(
+            self._st, self._pools, self._bt_dev, self._dev,
+            self._poison_dev)
+        self._unpoison()
         nxt = np.asarray(nxt)
+        okv = np.asarray(okv)
         outs: List[Output] = []
         for i in active:
             req = self._slots[i]
+            if not bool(okv[i]):
+                # NaN/inf logits on THIS slot only: quarantine it
+                # (token discarded, pages freed, slot back to the
+                # pool) while every other lane keeps decoding
+                monitor.counter("serving.nan_quarantines").increase()
+                outs.append(self._fail(req, "nan_logits"))
+                continue
             tok = int(nxt[i])
             req.written += 1          # the step wrote last_token
             # mirror the device-side advance (NOT marked dirty: the
@@ -906,12 +1304,29 @@ class Engine:
             req.generated.append(tok)
             self._last[i] = tok
             if req.first_token_t == 0.0:
-                req.first_token_t = time.perf_counter()
+                req.first_token_t = self._clock()
             monitor.counter("serving.tokens").increase()
             reason = self._finish_reason(req, tok)
             if reason:
                 outs.append(self._finish(req, reason))
         return outs
+
+    def _poison_slot(self, active: List[int]) -> None:
+        """decode.nan fault point: pick one active slot (seeded rng)
+        and ride a NaN into its sampling logits this tick — the
+        in-graph detector must flip exactly that slot's ok flag."""
+        if active and self._fault("decode.nan"):
+            victim = active[int(
+                self._injector.rng.integers(0, len(active)))]
+            pz = np.zeros((self.max_slots,), np.float32)
+            pz[victim] = np.nan
+            self._poison_dev = jnp.asarray(pz)
+            self._poisoned = True
+
+    def _unpoison(self) -> None:
+        if self._poisoned:
+            self._poison_dev = self._poison_zeros
+            self._poisoned = False
 
     def _decode_spec(self, active: List[int], variant: str
                      ) -> List[Output]:
@@ -921,18 +1336,36 @@ class Engine:
         one free target token — between 1 and k+1 tokens, every one
         bit-identical to what the plain decode loop would have emitted
         (verify_token_arrays' exact-match rule)."""
+        self._fault_raise("decode.device_error")
+        self._poison_slot(active)
         self._flush_state()
         k = self._spec.k
         drafts = self._spec.draft(self._bt_dev, self._dev[0],
                                   self._dev[1], self._dev[6])
+        if self._fault("spec.disagree"):
+            # draft/target divergence storm: the drafted tokens are
+            # replaced with garbage — exact-match verification must
+            # reject them with the emitted stream unchanged (each
+            # tick still yields >= 1 target-chain token)
+            drafts = self._spec.sabotage(drafts)
         fn = self._get_verify_fn(variant)
-        toks, acc, self._dev, self._pools = fn(
-            self._st, self._pools, self._bt_dev, self._dev, drafts)
+        toks, acc, okv, self._dev, self._pools = fn(
+            self._st, self._pools, self._bt_dev, self._dev, drafts,
+            self._poison_dev)
+        self._unpoison()
         toks = np.asarray(toks)
         acc = np.asarray(acc)
+        okv = np.asarray(okv)
         outs: List[Output] = []
         for i in active:
             req = self._slots[i]
+            if not bool(okv[i]):
+                # NaN/inf across this slot's verify logits (spec-
+                # verify divergence): quarantine the slot, keep the
+                # rest of the batch serving
+                monitor.counter("serving.nan_quarantines").increase()
+                outs.append(self._fail(req, "nan_logits"))
+                continue
             n_acc = int(acc[i])
             self._spec_drafted += k
             self._spec_accepted += n_acc
@@ -944,7 +1377,7 @@ class Engine:
                 req.written += 1      # position pos+j held this input
                 req.generated.append(tok)
                 if req.first_token_t == 0.0:
-                    req.first_token_t = time.perf_counter()
+                    req.first_token_t = self._clock()
                 monitor.counter("serving.tokens").increase()
                 reason = self._finish_reason(req, tok)
                 if reason:
@@ -990,32 +1423,56 @@ class Engine:
             # (or another request's) reference
             self._alloc.free(req.pages)
             req.pages = []
+        elif req.shared_pages:
+            # prefix refs taken at admission but never merged into
+            # pages (a prefill that failed before assignment): drop
+            # them here or they leak
+            self._alloc.free(req.shared_pages)
         # a re-admission re-walks the prefix cache (the resume prefix
         # is longer, and entries may have been evicted meanwhile)
         req.shared_pages = None
         req.prefix_len = 0
 
     def _finish(self, req: Request, reason: str) -> Output:
-        req.finish_t = time.perf_counter()
-        req.state = FINISHED
+        monitor.counter("serving.finished").increase()
+        return self._retire(req, reason, FINISHED)
+
+    def _fail(self, req: Request, reason: str) -> Output:
+        """Terminal FAILED(reason): the request is retired NOW — slot
+        cleared, pages freed, removed from the queue — and surfaced as
+        an Output with ``error`` set. The step() loop keeps serving
+        every other request."""
+        monitor.counter("serving.failed").increase()
+        return self._retire(req, reason, FAILED)
+
+    def _retire(self, req: Request, reason: str, state: str) -> Output:
+        req.finish_t = self._clock()
+        req.state = state
         req.finish_reason = reason
+        try:
+            self._waiting.remove(req)     # failed while queued
+        except ValueError:
+            pass
         self._clear_slot(req)         # pages freed NOW, not end-of-call
         # `requests` tracks LIVE requests only — retaining finished
         # ones (full token lists) would grow without bound in a
         # long-running serving process; the Output carries everything
         self.requests.pop(req.req_id, None)
         n = len(req.generated)
-        ttft_ms = (req.first_token_t - req.arrival_t) * 1e3
+        got_first = req.first_token_t > 0.0
+        ttft_ms = ((req.first_token_t - req.arrival_t) * 1e3
+                   if got_first else 0.0)
         tpot_ms = ((req.finish_t - req.first_token_t)
-                   / (n - 1) * 1e3) if n > 1 else 0.0
-        monitor.gauge("serving.ttft_ms").set(ttft_ms)
-        if n > 1:
+                   / (n - 1) * 1e3) if got_first and n > 1 else 0.0
+        if got_first:
+            monitor.gauge("serving.ttft_ms").set(ttft_ms)
+        if got_first and n > 1:
             monitor.gauge("serving.tpot_ms").set(tpot_ms)
-        monitor.counter("serving.finished").increase()
         return Output(req_id=req.req_id, prompt_ids=list(req.prompt),
                       token_ids=list(req.generated),
                       finish_reason=reason, ttft_ms=ttft_ms,
-                      tpot_ms=tpot_ms, preemptions=req.preemptions)
+                      tpot_ms=tpot_ms, preemptions=req.preemptions,
+                      error=None if state == FINISHED else reason)
 
     def _publish_gauges(self):
         monitor.gauge("serving.slots_active").set(self.num_active)

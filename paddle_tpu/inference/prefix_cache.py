@@ -77,6 +77,10 @@ class PrefixCache:
     pressure (reclaim idle entries, leaves first, LRU order).
     """
 
+    #: digest every forced collision resolves to (fault injection /
+    #: collision tests — a constant key makes ANY two chunks collide)
+    COLLIDED = b"\x00" * 16
+
     def __init__(self, allocator, page_size: int,
                  hash_fn=_chunk_hash):
         self._alloc = allocator
@@ -84,9 +88,83 @@ class PrefixCache:
         self._store: Dict[bytes, _Entry] = {}
         self._tick = 0
         # injectable for the collision tests; production is blake2b
-        self._hash = hash_fn
+        self._hash_fn = hash_fn
+        # fault-injection hook (inference/reliability.py): each armed
+        # count forces the NEXT first-chunk digest to the COLLIDED
+        # constant, so two different prompts land on one key and the
+        # exact-token compare must degrade the hit to a miss
+        self._collide_next = 0
         self.hits = 0
         self.lookups = 0
+
+    def _hash(self, parent, tokens) -> bytes:
+        if self._collide_next > 0 and parent is None:
+            self._collide_next -= 1
+            return self.COLLIDED
+        return self._hash_fn(parent, tokens)
+
+    def force_collision(self, n: int = 1) -> None:
+        """Arm ``n`` forced digest collisions (the
+        ``prefix.hash_collision`` fault point): the next ``n``
+        root-chunk hashes all return one constant digest. Correctness
+        must not depend on digests — the exact-token compare turns the
+        collision into a miss, never into serving another prompt's
+        KV."""
+        self._collide_next += int(n)
+
+    def corrupt_entry(self, rng) -> Optional[bytes]:
+        """Make one cached entry STALE (the ``prefix.stale_entry``
+        fault point): its recorded chunk tokens are overwritten with
+        out-of-vocab sentinels, simulating index metadata that no
+        longer matches the page contents. A stale entry can never be
+        HIT again (token compare fails), so it degrades to a miss and
+        is reclaimed by ``check_integrity``/eviction. Returns the
+        corrupted key (None when the cache is empty)."""
+        if not self._store:
+            return None
+        keys = sorted(self._store)
+        key = keys[int(rng.integers(0, len(keys)))]
+        ent = self._store[key]
+        ent.chunk = tuple([-1] * len(ent.chunk))
+        return key
+
+    def check_integrity(self, repair: bool = False) -> List[str]:
+        """Verify every entry's key still equals the chained digest of
+        (parent, chunk) — the invariant ``insert`` establishes. A
+        mismatch marks a STALE entry (corrupted metadata, or an
+        injected fault); with ``repair=True`` stale entries and their
+        (now unreachable) subtrees are dropped, returning their pages
+        to the pool. Forced-collision roots (key == COLLIDED) are
+        exempt: they were legitimately inserted under the forced
+        digest and still satisfy the exact-token compare."""
+        findings: List[str] = []
+        stale = []
+        for key, ent in self._store.items():
+            if key == self.COLLIDED:
+                continue
+            if self._hash_fn(ent.parent, ent.chunk) != key:
+                findings.append(
+                    f"stale prefix-cache entry depth {ent.depth} "
+                    f"(key {key.hex()[:12]}…): stored chunk no longer "
+                    f"matches its digest")
+                stale.append(key)
+        if repair and stale:
+            for key in stale:
+                self._drop_subtree(key)
+        return findings
+
+    def _drop_subtree(self, key: bytes) -> int:
+        """Drop an entry and every descendant (they are unreachable
+        once an ancestor is gone — the chain walk stops at the first
+        miss). Returns pages freed."""
+        ent = self._store.get(key)
+        if ent is None:
+            return 0
+        freed = 0
+        for child in list(ent.children):
+            freed += self._drop_subtree(child)
+        self._drop(ent)
+        return freed + 1
 
     def __len__(self) -> int:
         return len(self._store)

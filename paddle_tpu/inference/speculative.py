@@ -38,6 +38,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..jit.functional import get_buffers, get_frozen, get_params
 from ..text.generation import _model_forward
@@ -169,3 +170,16 @@ class SpeculativeDecoder:
         drafts, self._pools = fn(self._st, self._pools, bt, last, pos,
                                  live)
         return drafts
+
+    def sabotage(self, drafts):
+        """Deterministically corrupt a drafted chunk (the engine's
+        ``spec.disagree`` fault point): every proposal is shifted to a
+        DIFFERENT in-vocab token, simulating a draft/target divergence
+        storm. Exact-match verification then rejects (almost) all of
+        them — the emitted stream must stay bit-identical to the
+        draft-free engine, each tick just shrinks toward 1 token. Host
+        numpy only: no new executable, so chaos ticks stay inside the
+        zero-recompile contract."""
+        vocab = int(self.model.config.vocab_size)
+        arr = np.asarray(drafts)
+        return jnp.asarray((arr + 1) % vocab)

@@ -68,7 +68,8 @@ def test_headline_prints_first_and_extras_append(stubbed, capsys,
                 "llama_1b_serving_tokens_per_sec",
                 "llama_1b_serving_int8kv_tokens_per_sec",
                 "llama_1b_serving_prefix_tokens_per_sec",
-                "llama_1b_serving_spec_tokens_per_sec"]:
+                "llama_1b_serving_spec_tokens_per_sec",
+                "llama_1b_serving_chaos_tokens_per_sec"]:
         assert key in last, key
     assert "skipped" not in last
     # the stubbed runs trace no MoE dispatch, so the path attribution
@@ -91,7 +92,7 @@ def test_budget_skips_extras_but_headline_survives(stubbed, capsys,
         "llama_decode_paged", "llama_decode_paged_int8",
         "llama_decode_rolling", "llama_serving",
         "llama_serving_int8kv", "llama_serving_prefix",
-        "llama_serving_spec", "flashmask_8k"}
+        "llama_serving_spec", "llama_serving_chaos", "flashmask_8k"}
     assert "llama_seq2048_mfu" not in lines[-1]["extras"]
 
 

@@ -1,0 +1,598 @@
+"""Serving-engine reliability layer (docs/SERVING.md "Reliability").
+
+The contracts under test: (1) deterministic fault injection — the same
+seed replays the same fault schedule, and after any mix of injected
+allocator/prefix/NaN/device/spec faults every SURVIVING request is
+token-exact vs a fault-free run, the page pool balances to empty, and
+the invariant audit ends clean; (2) the allocator/engine invariant
+audit detects and repairs leaks and refcount skew; (3) crash-exact
+snapshot/restore — a restarted engine's outputs are bit-identical to
+the uninterrupted run (greedy + seeded sampling, prefix hits and
+speculative decoding on), all of it on the fixed compiled surfaces
+(zero steady-state recompiles across cancel/timeout/fail/restore
+traces).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.inference.allocator import PageAllocator
+from paddle_tpu.inference.engine import Engine, SamplingParams
+from paddle_tpu.inference.prefix_cache import PrefixCache
+from paddle_tpu.inference.reliability import (FAULT_SITES, FaultInjector,
+                                              FaultPlan, load_snapshot,
+                                              save_snapshot)
+from paddle_tpu.text.generation import generate
+from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
+
+
+def _tiny_net(seed=0, layers=1, heads=2, vocab=32, hidden=32):
+    paddle.seed(seed)
+    cfg = LlamaConfig.tiny(vocab=vocab, hidden=hidden, layers=layers,
+                           heads=heads)
+    cfg.use_flash_attention = False
+    net = LlamaForCausalLM(cfg)
+    net.eval()
+    return net
+
+
+def _ref_row(net, prompt, max_new, **kw):
+    out = np.asarray(generate(net, paddle.to_tensor(prompt[None]),
+                              max_new, **kw).numpy())
+    return out[0, len(prompt):].tolist()
+
+
+def _prompts(rng, lens, vocab=32):
+    return [rng.integers(0, vocab, (n,)).astype(np.int64) for n in lens]
+
+
+# -- fault injector ----------------------------------------------------------
+
+def test_fault_injector_replays_from_seed():
+    """Same (seed, rate, query order) => bit-identical fault schedule;
+    a different seed diverges. The rng is consumed on every armed
+    query, fired or not, so the schedule is a pure function of the
+    seed."""
+    def schedule(seed):
+        inj = FaultInjector(seed=seed, rate=0.3)
+        return [inj.fire(site, record=False)
+                for _ in range(40) for site in FAULT_SITES[:4]]
+
+    assert schedule(7) == schedule(7)
+    assert schedule(7) != schedule(8)
+    with pytest.raises(ValueError, match="unknown fault site"):
+        FaultInjector(sites=("decode.nan", "bogus.site"))
+    inj = FaultInjector(seed=0, rate=1.0, sites=("decode.nan",))
+    assert inj.fire("decode.nan") and not inj.fire("prefill.nan")
+    assert inj.counts == {"decode.nan": 1}
+    with pytest.raises(ValueError, match="unknown fault site"):
+        inj.fire("nope")
+
+
+def test_fault_plan_parse_and_step_gating():
+    plan = FaultPlan.parse("5:decode.nan, 2:alloc.exhausted")
+    inj = FaultInjector(seed=0, rate=0.0, plan=plan)
+    inj.on_step(1)
+    assert not inj.fire("decode.nan")        # before its step
+    assert not inj.fire("alloc.exhausted")
+    inj.on_step(3)
+    assert inj.fire("alloc.exhausted")       # step 2 entry fires at 3
+    assert not inj.fire("alloc.exhausted")   # one-shot
+    inj.on_step(5)
+    assert inj.fire("decode.nan")
+    assert plan.pending == []
+    with pytest.raises(ValueError, match="unknown fault site"):
+        FaultPlan([(1, "nope")])
+
+
+# -- invariant audit ---------------------------------------------------------
+
+def test_allocator_check_invariants_detects_and_repairs():
+    """The audit catches free-list corruption, refcount skew against
+    the caller's expected holders, leaks, and vanished pages — and
+    repair=True converges the pool back to balanced."""
+    al = PageAllocator(6, base=1)
+    a = al.alloc(3, seq="a")
+    assert al.check_invariants() == []
+    assert al.check_invariants(expected={p: 1 for p in a}) == []
+    # refcount skew: a stray share nobody accounts for
+    al.share(a[0])
+    found = al.check_invariants(expected={p: 1 for p in a})
+    assert any("refcount skew" in f and str(a[0]) in f for f in found)
+    al.check_invariants(expected={p: 1 for p in a}, repair=True)
+    assert al.refcount(a[0]) == 1
+    assert al.check_invariants(expected={p: 1 for p in a}) == []
+    # leak: a live page with no holder
+    found = al.check_invariants(expected={a[0]: 1, a[1]: 1})
+    assert any("leaked page" in f and str(a[2]) in f for f in found)
+    al.check_invariants(expected={a[0]: 1, a[1]: 1}, repair=True)
+    assert al.free_pages == 4 and al.refcount(a[2]) == 0
+    # free-list corruption: a live page pushed back onto the free list
+    al._free.append(a[0])
+    found = al.check_invariants()
+    assert any("BOTH free and refcounted" in f for f in found)
+    al.check_invariants(repair=True)
+    assert al.check_invariants() == []
+    # vanished page: dropped from both structures
+    al.free([a[0], a[1]])
+    al._free.remove(a[1])
+    found = al.check_invariants()
+    assert any("vanished" in f for f in found)
+    al.check_invariants(repair=True)
+    assert al.free_pages == 6 and al.check_invariants() == []
+
+
+def test_prefix_cache_collision_and_stale_entry_degrade_to_miss():
+    """Forced digest collisions and corrupted (stale) entries must
+    never serve another prompt's KV: both degrade to misses, and
+    check_integrity reclaims stale subtrees."""
+    al = PageAllocator(8, base=1)
+    cache = PrefixCache(al, page_size=4)
+    toks_a = list(range(8))
+    pages_a = al.alloc(2, seq="a")
+    cache.insert(toks_a, pages_a, 8)
+    assert cache.lookup(toks_a) == 8
+    # forced collision: a DIFFERENT prompt hashing to the same digest
+    # must miss on the exact-token compare
+    cache.force_collision()
+    toks_b = [9] * 8
+    assert cache.lookup(toks_b) == 0
+    # forced collision on insert: the colliding entry serves only its
+    # EXACT tokens; any different prompt landing on the same digest
+    # fails the token compare and misses
+    pages_b = al.alloc(2, seq="b")
+    cache.force_collision(2)           # one for insert, one for lookup
+    cache.insert(toks_b, pages_b, 8)
+    assert cache.lookup(toks_b) == 8   # same tokens, same forced key
+    cache.force_collision()
+    assert cache.lookup([7] * 8) == 0  # collides, token compare saves
+    assert cache.lookup(toks_a) == 8   # incumbent chain untouched
+    # stale entry: corrupt one entry's chunk metadata — integrity
+    # audit names it, repair drops it (and its subtree), the cache's
+    # reference on its page released
+    entries_before = len(cache)
+    rng = np.random.default_rng(0)
+    key = cache.corrupt_entry(rng)
+    page = cache._store[key].page
+    refs_before = al.refcount(page)
+    found = cache.check_integrity()
+    assert found and "stale prefix-cache entry" in found[0]
+    cache.check_integrity(repair=True)
+    assert cache.check_integrity() == []
+    assert len(cache) < entries_before
+    assert al.refcount(page) == refs_before - 1
+
+
+def test_engine_audit_repairs_injected_skew(rng):
+    """A stray reference landing on a live page mid-run (the
+    alloc.refcount_skew fault) is detected and repaired by the
+    per-step audit; the drained pool balances to empty."""
+    net = _tiny_net()
+    inj = FaultInjector(seed=5, rate=0.5,
+                        sites=("alloc.refcount_skew",))
+    eng = Engine(net, max_slots=2, page_size=8, pool_pages=32,
+                 max_context=64, prefill_bucket=8, fault_injector=inj)
+    p = _prompts(rng, (6, 9))
+    outs = eng.run([(x, SamplingParams(max_new_tokens=8)) for x in p])
+    assert all(o.ok for o in outs)
+    for x, o in zip(p, outs):
+        assert o.token_ids == _ref_row(net, x, 8)
+    assert inj.counts.get("alloc.refcount_skew", 0) > 0
+    assert monitor.counter("serving.invariant_repairs").get() > 0
+    assert eng.pages_free == eng.pool_pages
+    assert eng.check_invariants() == []
+
+
+# -- request isolation under injected faults ---------------------------------
+
+def test_decode_nan_quarantines_one_slot_only(rng):
+    """A NaN-emitting slot is FAILED ("nan_logits") with its pages
+    freed while the other slot keeps decoding token-exactly."""
+    net = _tiny_net()
+    prompts = _prompts(rng, (5, 9))
+    before = monitor.counter("serving.nan_quarantines").get()
+    inj = FaultInjector(seed=0, rate=0.0,
+                        plan=FaultPlan([(3, "decode.nan")]))
+    eng = Engine(net, max_slots=2, page_size=8, pool_pages=32,
+                 max_context=64, prefill_bucket=8, fault_injector=inj)
+    outs = eng.run([(x, SamplingParams(max_new_tokens=8))
+                    for x in prompts])
+    failed = [o for o in outs if not o.ok]
+    ok = [o for o in outs if o.ok]
+    assert len(failed) == 1 and len(ok) == 1
+    assert failed[0].finish_reason == "nan_logits"
+    assert failed[0].error == "nan_logits"
+    assert ok[0].token_ids == _ref_row(net, prompts[ok[0].req_id], 8)
+    assert monitor.counter("serving.nan_quarantines").get() == before + 1
+    assert eng.pages_free == eng.pool_pages
+
+
+def test_device_error_skips_tick_and_retries(rng):
+    """Injected device errors fire BEFORE dispatch: a decode tick is
+    skipped (retried next step) and a prefill requeues — requests see
+    extra latency, never corruption or lost tokens."""
+    net = _tiny_net()
+    p = _prompts(rng, (6,))[0]
+    plan = FaultPlan([(0, "prefill.device_error"),
+                      (4, "decode.device_error")])
+    inj = FaultInjector(seed=0, rate=0.0, plan=plan)
+    eng = Engine(net, max_slots=2, page_size=8, pool_pages=32,
+                 max_context=64, prefill_bucket=8, fault_injector=inj)
+    outs = eng.run([(p, SamplingParams(max_new_tokens=8))])
+    assert outs[0].ok
+    assert outs[0].token_ids == _ref_row(net, p, 8)
+    assert inj.total_injected == 2
+    assert monitor.counter("serving.step_errors").get() >= 2
+    assert eng.pages_free == eng.pool_pages
+
+
+def test_prefill_retry_budget_exhausts_to_failed(rng):
+    """A request whose prefill keeps failing transiently burns its
+    retry budget and lands in FAILED("error:prefill ...") instead of
+    looping forever."""
+    net = _tiny_net()
+    p = _prompts(rng, (6,))[0]
+    inj = FaultInjector(seed=0, rate=1.0,
+                        sites=("prefill.device_error",))
+    eng = Engine(net, max_slots=2, page_size=8, pool_pages=32,
+                 max_context=64, prefill_bucket=8, fault_injector=inj)
+    outs = eng.run([(p, SamplingParams(max_new_tokens=4))],
+                   max_steps=50)
+    assert not outs[0].ok
+    assert outs[0].finish_reason.startswith("error:prefill")
+    assert eng.pages_free == eng.pool_pages
+
+
+# -- snapshot / restore ------------------------------------------------------
+
+def _drain(eng, done, max_steps=200):
+    for _ in range(max_steps):
+        for o in eng.step():
+            done[o.req_id] = o
+        if eng.num_active == 0 and eng.num_waiting == 0:
+            break
+    return done
+
+
+def test_snapshot_restore_token_exact_full_matrix(rng):
+    """The acceptance bar: snapshot an engine mid-flight — greedy AND
+    seeded-sampling requests, prefix cache on, speculative decoding on
+    — restore onto a FRESH engine over the same weights, and every
+    request finishes with tokens bit-identical to the uninterrupted
+    run (and to b=1 generate)."""
+    net = _tiny_net(seed=0)
+    draft = _tiny_net(seed=1)
+    shared = rng.integers(0, 32, (16,))
+    prompts = [np.concatenate([shared, t]).astype(np.int64)
+               for t in _prompts(rng, (5, 8, 3))]
+    cfgs = [dict(max_new_tokens=9),
+            dict(max_new_tokens=8, temperature=0.9, seed=3),
+            dict(max_new_tokens=7, temperature=1.1, top_k=6,
+                 top_p=0.9, seed=11)]
+
+    def mk():
+        return Engine(net, max_slots=2, page_size=8, pool_pages=64,
+                      max_context=64, prefill_bucket=8,
+                      prefix_cache=True, draft_model=draft, spec_k=3)
+
+    eng = mk()
+    rids = [eng.add_request(p, SamplingParams(**c))
+            for p, c in zip(prompts, cfgs)]
+    for _ in range(3):                       # mid-flight: slots busy,
+        eng.step()                           # one request still queued
+    assert eng.requests
+    snap = eng.snapshot()
+    # uninterrupted run continues from here
+    done_a = _drain(eng, {})
+    # "restart": fresh engine, same weights, restore, drain
+    eng_b = mk()
+    assert eng_b.restore(snap) == len(snap["requests"])
+    done_b = _drain(eng_b, {})
+    assert set(done_b) == set(rids) - (set(rids) - set(done_a)
+                                       | set()) or set(done_b)
+    for rid, p, c in zip(rids, prompts, cfgs):
+        if rid not in done_b:      # finished before the snapshot
+            continue
+        assert done_b[rid].token_ids == done_a[rid].token_ids, rid
+        ref = _ref_row(net, p, c["max_new_tokens"],
+                       temperature=c.get("temperature", 0.0),
+                       top_k=c.get("top_k", 0),
+                       top_p=c.get("top_p", 0.0),
+                       seed=c.get("seed", 0))
+        assert done_b[rid].token_ids == ref, rid
+    # both engines stay on their fixed compiled surfaces and balance
+    assert eng.steady_state_recompiles() == 0
+    assert eng_b.steady_state_recompiles() == 0
+    for e in (eng, eng_b):
+        e._prefix.clear()
+        assert e.pages_free == e.pool_pages
+        assert e.check_invariants() == []
+
+
+def test_restore_resets_live_requests_queue_budget(rng):
+    """A request that was RUNNING at snapshot time re-enters the
+    restored queue with a fresh max_queue_steps budget — it was
+    making progress, not stuck; failing it as 'queue_timeout' on the
+    restored engine's first tick would break the bit-identical
+    contract."""
+    net = _tiny_net()
+    p = _prompts(rng, (5,))[0]
+    eng = Engine(net, max_slots=2, page_size=8, pool_pages=32,
+                 max_context=64, prefill_bucket=8)
+    eng.add_request(p, SamplingParams(max_new_tokens=12,
+                                      max_queue_steps=3))
+    for _ in range(6):          # decoding well past the queue budget
+        eng.step()
+    snap = eng.snapshot()
+    eng_b = Engine(net, max_slots=2, page_size=8, pool_pages=32,
+                   max_context=64, prefill_bucket=8)
+    eng_b.restore(snap)
+    done = _drain(eng_b, {})
+    assert done[0].ok, done[0].finish_reason
+    assert done[0].token_ids == _ref_row(net, p, 12)
+
+
+def test_snapshot_file_round_trip_and_validation(rng, tmp_path):
+    """snapshot_to/restore_from round-trip through JSON; restore
+    refuses busy engines and token-incompatible fingerprints; the
+    prefix index rides as metadata."""
+    net = _tiny_net()
+    eng = Engine(net, max_slots=2, page_size=4, pool_pages=32,
+                 max_context=32, prefill_bucket=4, prefix_cache=True)
+    p = _prompts(rng, (9, 6))
+    eng.add_request(p[0], SamplingParams(max_new_tokens=6))
+    eng.add_request(p[1], SamplingParams(max_new_tokens=5,
+                                         temperature=0.7, seed=2))
+    for _ in range(2):
+        eng.step()
+    path = str(tmp_path / "snap.json")
+    eng.snapshot_to(path)
+    with open(path) as fh:
+        raw = json.load(fh)
+    assert raw["version"] == 1 and len(raw["requests"]) == 2
+    assert raw["prefix_index"]          # full pages were registered
+    assert raw["fingerprint"]["hard"]["vocab_size"] == 32
+    # busy engine refuses
+    with pytest.raises(RuntimeError, match="busy engine"):
+        eng.restore(load_snapshot(path))
+    done_a = _drain(eng, {})
+    # geometry change: strict raises, non-strict restores token-exact
+    eng_b = Engine(net, max_slots=3, page_size=4, pool_pages=32,
+                   max_context=32, prefill_bucket=4, prefix_cache=True)
+    with pytest.raises(ValueError, match="scheduler geometry"):
+        eng_b.restore(load_snapshot(path))
+    with pytest.warns(RuntimeWarning, match="scheduler geometries"):
+        eng_b.restore(load_snapshot(path), strict=False)
+    done_b = _drain(eng_b, {})
+    for rid in done_b:
+        assert done_b[rid].token_ids == done_a[rid].token_ids
+    # incompatible model: hard mismatch always raises
+    other = _tiny_net(seed=9, vocab=16, hidden=32)
+    eng_c = Engine(other, max_slots=2, page_size=4, pool_pages=32,
+                   max_context=32, prefill_bucket=4)
+    with pytest.raises(ValueError, match="token-incompatible"):
+        eng_c.restore(load_snapshot(path), strict=False)
+    snap = load_snapshot(path)
+    snap["version"] = 99
+    with pytest.raises(ValueError, match="version"):
+        eng_b.restore(snap)
+    # save_snapshot helper is the same writer snapshot_to uses
+    assert save_snapshot(raw, str(tmp_path / "again.json"))
+    assert load_snapshot(str(tmp_path / "again.json")) == raw
+
+
+def test_zero_recompiles_across_cancel_timeout_fail_restore(rng):
+    """The compiled-surface contract under the whole failure surface:
+    after warmup, a trace mixing cancels, deadline expiries, NaN
+    quarantines and a snapshot/restore round-trip triggers ZERO
+    steady-state recompiles on either engine."""
+    net = _tiny_net()
+    clk = {"t": 0.0}
+    inj = FaultInjector(seed=0, rate=0.0,
+                        plan=FaultPlan([(9, "decode.nan")]))
+    eng = Engine(net, max_slots=3, page_size=8, pool_pages=64,
+                 max_context=64, prefill_bucket=8,
+                 clock=lambda: clk["t"], fault_injector=inj)
+    prompts = _prompts(rng, (5, 9, 3, 7, 4, 6))
+    # warmup wave (buckets + decode variants)
+    eng.run([(prompts[0], SamplingParams(max_new_tokens=4)),
+             (prompts[1], SamplingParams(max_new_tokens=4,
+                                         temperature=0.8, seed=1))])
+    # measured wave: one cancel, one deadline expiry, one NaN fail,
+    # the rest run to completion through a restore
+    rids = [eng.add_request(prompts[2], SamplingParams(
+                max_new_tokens=10)),
+            eng.add_request(prompts[3], SamplingParams(
+                max_new_tokens=10, deadline_ms=50.0)),
+            eng.add_request(prompts[4], SamplingParams(
+                max_new_tokens=10, temperature=0.8, seed=5)),
+            eng.add_request(prompts[5], SamplingParams(
+                max_new_tokens=12))]
+    done = {}
+    for _ in range(3):
+        for o in eng.step():
+            done[o.req_id] = o
+    out_c = eng.cancel(rids[0])
+    assert out_c is not None and out_c.finish_reason == "cancelled"
+    clk["t"] = 0.2                      # expires rids[1]'s deadline
+    for _ in range(8):
+        for o in eng.step():
+            done[o.req_id] = o
+    snap = eng.snapshot()
+    done_a = _drain(eng, dict(done))
+    assert eng.steady_state_recompiles() == 0
+    # restore the mid-flight remainder onto a fresh engine: its OWN
+    # warmup compiles, then zero
+    eng_b = Engine(net, max_slots=3, page_size=8, pool_pages=64,
+                   max_context=64, prefill_bucket=8)
+    eng_b.restore(snap)
+    done_b = _drain(eng_b, {})
+    for rid, o in done_b.items():
+        assert o.token_ids == done_a[rid].token_ids, rid
+    assert eng_b.steady_state_recompiles() == 0
+    assert done_a[rids[1]].finish_reason == "deadline"
+    assert {o.finish_reason for o in done_a.values()} >= {"deadline"}
+    assert eng.pages_free == eng.pool_pages
+
+
+# -- chaos -------------------------------------------------------------------
+
+def _chaos_run(rng, steps, rate, seed, spec=False, n_requests=8,
+               max_new=6):
+    """Stream n_requests through a small chaotic engine; returns
+    (engine, injector, outputs, refs)."""
+    net = _tiny_net(seed=0)
+    draft = _tiny_net(seed=1) if spec else None
+    shared = rng.integers(0, 32, (8,))
+    prompts = []
+    for j in range(n_requests):
+        tail = rng.integers(0, 32, (int(rng.integers(2, 10)),))
+        # half the requests share a system prefix (prefix-cache action)
+        prompts.append(np.concatenate([shared, tail]).astype(np.int64)
+                       if j % 2 == 0 else tail.astype(np.int64))
+    cfgs = [dict(max_new_tokens=max_new) if j % 3 else
+            dict(max_new_tokens=max_new, temperature=0.9, seed=j)
+            for j in range(n_requests)]
+    refs = [_ref_row(net, p, c["max_new_tokens"],
+                     temperature=c.get("temperature", 0.0),
+                     seed=c.get("seed", 0))
+            for p, c in zip(prompts, cfgs)]
+    inj = FaultInjector(seed=seed, rate=rate)
+    eng = Engine(net, max_slots=3, page_size=8, pool_pages=24,
+                 max_context=48, prefill_bucket=8, prefix_cache=True,
+                 draft_model=draft, spec_k=3, fault_injector=inj)
+    outs = {}
+    i = 0
+    for step in range(steps):
+        if i < len(prompts) and step % 3 == 0:
+            eng.add_request(prompts[i], SamplingParams(**cfgs[i]))
+            i += 1
+        for o in eng.step():
+            outs[o.req_id] = o
+        if i == len(prompts) and eng.num_active == 0 \
+                and eng.num_waiting == 0 and step > steps // 2:
+            break
+    # drain whatever chaos left behind
+    for _ in range(300):
+        if eng.num_active == 0 and eng.num_waiting == 0:
+            break
+        for o in eng.step():
+            outs[o.req_id] = o
+    return eng, inj, outs, refs
+
+
+def _assert_chaos_contract(eng, inj, outs, refs):
+    survivors = 0
+    for rid, o in outs.items():
+        if o.ok:
+            assert o.token_ids == refs[rid], \
+                (rid, o.token_ids, refs[rid], inj.counts)
+            survivors += 1
+    eng._prefix.clear()
+    assert eng.check_invariants() == [], eng.check_invariants()
+    assert eng.pages_free == eng.pool_pages, \
+        (eng.pages_free, eng.pool_pages, inj.counts)
+    return survivors
+
+
+def test_chaos_short_run_all_sites(rng):
+    """Fast chaos pass (tier-1): every fault site armed at a rate that
+    fires a handful of faults; survivors token-exact, pool balanced,
+    audit clean."""
+    eng, inj, outs, refs = _chaos_run(rng, steps=60, rate=0.06, seed=3)
+    assert len(outs) == len(refs)        # every request retired
+    survivors = _assert_chaos_contract(eng, inj, outs, refs)
+    assert inj.total_injected >= 5
+    assert survivors >= 1
+
+
+@pytest.mark.slow
+def test_chaos_soak_hundreds_of_faults(rng):
+    """The acceptance soak: >= 200 engine steps with injected
+    allocator/prefill/decode/spec faults (hundreds of them), with the
+    prefix cache and speculative decoding ON — zero leaked pages,
+    zero refcount skew, and bit-identical outputs for every surviving
+    request vs the fault-free reference."""
+    total_steps = 0
+    total_faults = 0
+    for seed in (3, 11, 29):
+        eng, inj, outs, refs = _chaos_run(
+            rng, steps=160, rate=0.25, seed=seed, spec=(seed == 11),
+            n_requests=16, max_new=8)
+        assert len(outs) == len(refs)
+        _assert_chaos_contract(eng, inj, outs, refs)
+        total_steps += eng._steps
+        total_faults += inj.total_injected
+        eng.close()
+    assert total_steps >= 200, total_steps
+    assert total_faults >= 200, total_faults
+
+
+def test_serving_replay_chaos_exit_codes(rng, capsys):
+    """tools/serving_replay.py --chaos drives the fixture trace clean
+    then chaotic, reports the injected-fault/survivor summary, and
+    exits 0 on the contract (exit 6 is the leak/divergence path)."""
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    try:
+        import serving_replay
+    finally:
+        sys.path.pop(0)
+    trace = os.path.join(repo, "tests", "fixtures",
+                         "serving_trace_chaos.jsonl")
+    rc = serving_replay.main(
+        [trace, "--layers", "1", "--hidden", "32", "--heads", "2",
+         "--vocab", "32", "--max-slots", "3", "--page-size", "8",
+         "--pool-pages", "24", "--chaos", "--fault-seed", "3",
+         "--fault-rate", "0.05", "--json"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip()
+                        .splitlines()[-1])
+    ch = report["chaos"]
+    assert ch["total_injected"] > 0
+    assert ch["survivors_exact"] is True
+    assert ch["leaked_pages"] == 0
+    assert ch["invariant_findings"] == []
+    assert ch["survivors"] + sum(report["failed"].values()) \
+        == report["requests"]
+
+
+def test_flags_arm_injector_and_debug_audit(rng, monkeypatch):
+    """FLAGS_serving_fault_* arm a process-wide injector at Engine
+    construction; FLAGS_serving_debug_invariants audits every step
+    and raises loudly on a (synthetically planted) finding."""
+    import paddle_tpu.core.flags as flags
+    net = _tiny_net()
+    flags.set_flags({"serving_fault_seed": 42,
+                     "serving_fault_rate": 0.0,
+                     "serving_fault_sites": "decode.nan"})
+    try:
+        eng = Engine(net, max_slots=2, page_size=8, pool_pages=16,
+                     max_context=32, prefill_bucket=8)
+        assert eng._injector is not None
+        assert eng._injector.seed == 42
+        assert eng._injector.sites == {"decode.nan"}
+        # fault_injector=False forces OFF in a flag-armed process —
+        # the chaos tooling's clean baseline depends on this
+        clean = Engine(net, max_slots=2, page_size=8, pool_pages=16,
+                       max_context=32, prefill_bucket=8,
+                       fault_injector=False)
+        assert clean._injector is None
+    finally:
+        flags.set_flags({"serving_fault_seed": -1})
+    # debug audit: plant a stray reference, next step raises
+    eng2 = Engine(net, max_slots=2, page_size=8, pool_pages=16,
+                  max_context=32, prefill_bucket=8,
+                  debug_invariants=True)
+    p = _prompts(rng, (5,))[0]
+    eng2.add_request(p, SamplingParams(max_new_tokens=6))
+    eng2.step()
+    req = next(iter(eng2.requests.values()))
+    eng2._alloc.share(req.pages[0])
+    with pytest.raises(RuntimeError, match="invariant audit failed"):
+        eng2.step()

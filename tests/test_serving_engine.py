@@ -502,6 +502,198 @@ def test_serving_replay_tool(rng, capsys):
     assert "requests" in out and "preemptions" in out
 
 
+def test_engine_deadline_expiry_matrix(rng):
+    """deadline_ms is enforced on the engine's step clock at every
+    tick start — a WAITING request expires without ever taking a
+    slot, and a mid-decode request fails with its partial tokens and
+    frees its pages that tick, while unconstrained requests finish
+    untouched (docs/SERVING.md 'Reliability')."""
+    net = _tiny_net()
+    clk = {"t": 0.0}
+    eng = Engine(net, max_slots=1, page_size=8, pool_pages=32,
+                 max_context=64, clock=lambda: clk["t"])
+    prompts = _prompts(rng, (5, 7, 4))
+    t0 = monitor.counter("serving.timeouts").get()
+    # slot 0 busy with r0; r1 waits with a deadline it cannot make
+    r0 = eng.add_request(prompts[0],
+                         SamplingParams(max_new_tokens=10,
+                                        deadline_ms=10_000.0))
+    r1 = eng.add_request(prompts[1],
+                         SamplingParams(max_new_tokens=4,
+                                        deadline_ms=50.0))
+    done = {}
+    for _ in range(3):
+        for o in eng.step():
+            done[o.req_id] = o
+    clk["t"] = 0.1                     # 100ms: r1's deadline passed
+    for _ in range(20):
+        for o in eng.step():
+            done[o.req_id] = o
+        if len(done) == 2:
+            break
+    assert done[r1].finish_reason == "deadline"
+    assert not done[r1].ok and done[r1].token_ids == []
+    assert done[r0].ok
+    assert done[r0].token_ids == _ref_row(net, prompts[0], 10)
+    # mid-decode expiry: the request keeps its partial tokens
+    r2 = eng.add_request(prompts[2],
+                         SamplingParams(max_new_tokens=50,
+                                        deadline_ms=80.0))
+    for _ in range(4):
+        eng.step()
+    clk["t"] = 0.5
+    out2 = None
+    for _ in range(5):
+        for o in eng.step():
+            out2 = o
+        if out2 is not None:
+            break
+    assert out2.req_id == r2 and out2.finish_reason == "deadline"
+    assert 0 < len(out2.token_ids) < 50
+    assert out2.token_ids == \
+        _ref_row(net, prompts[2], 50)[:len(out2.token_ids)]
+    assert monitor.counter("serving.timeouts").get() == t0 + 2
+    assert eng.pages_free == eng.pool_pages
+
+
+def test_engine_queue_step_budget(rng):
+    """max_queue_steps fails a request that cannot get a slot within
+    its step budget ('queue_timeout'); re-queueing via preemption
+    resets the budget (a preempted request is not a stuck one)."""
+    net = _tiny_net()
+    eng = Engine(net, max_slots=1, page_size=8, pool_pages=32,
+                 max_context=64)
+    prompts = _prompts(rng, (5, 7))
+    eng.add_request(prompts[0], SamplingParams(max_new_tokens=12))
+    r1 = eng.add_request(prompts[1],
+                         SamplingParams(max_new_tokens=4,
+                                        max_queue_steps=3))
+    done = {}
+    for _ in range(20):
+        for o in eng.step():
+            done[o.req_id] = o
+        if len(done) == 2:
+            break
+    assert done[r1].finish_reason == "queue_timeout"
+    assert not done[r1].ok
+    assert done[0].token_ids == _ref_row(net, prompts[0], 12)
+    assert eng.num_waiting == 0 and eng.pages_free == eng.pool_pages
+
+
+def test_engine_cancel_matrix(rng):
+    """cancel() at every lifecycle point — WAITING (never scheduled),
+    DECODE (mid-stream, device lane reclaimed), PREEMPTED (resume
+    state discarded) — frees the pages immediately, returns the
+    partial Output, and leaves every other request token-exact;
+    unknown/already-retired ids return None."""
+    net = _tiny_net()
+    prompts = _prompts(rng, (5, 9, 4, 3))
+    c0 = monitor.counter("serving.cancelled").get()
+    eng = Engine(net, max_slots=2, page_size=8, pool_pages=64,
+                 max_context=64)
+    # cancel while WAITING: slots full of r0/r1, r2 still queued
+    r0 = eng.add_request(prompts[0], SamplingParams(max_new_tokens=8))
+    r1 = eng.add_request(prompts[1], SamplingParams(max_new_tokens=8))
+    r2 = eng.add_request(prompts[2], SamplingParams(max_new_tokens=8))
+    eng.step()
+    assert eng.num_waiting == 1
+    out2 = eng.cancel(r2)
+    assert out2.finish_reason == "cancelled" and out2.token_ids == []
+    assert eng.num_waiting == 0
+    # cancel mid-DECODE: r1 has tokens, its lane frees, r0 unaffected
+    for _ in range(2):
+        eng.step()
+    out1 = eng.cancel(r1)
+    assert out1.finish_reason == "cancelled"
+    assert 0 < len(out1.token_ids) < 8
+    assert out1.token_ids == \
+        _ref_row(net, prompts[1], 8)[:len(out1.token_ids)]
+    assert eng.num_active == 1
+    done = {}
+    for _ in range(20):
+        for o in eng.step():
+            done[o.req_id] = o
+        if r0 in done:
+            break
+    assert done[r0].token_ids == _ref_row(net, prompts[0], 8)
+    # cancel while PREEMPTED: tight pool forces r3's eviction; cancel
+    # must drop its resume state cleanly
+    eng2 = Engine(net, max_slots=2, page_size=4, pool_pages=4,
+                  max_context=16, prefill_bucket=4, watermark_pages=0)
+    p = _prompts(rng, (4, 3))
+    eng2.add_request(p[0], SamplingParams(max_new_tokens=10))
+    r3 = eng2.add_request(p[1], SamplingParams(max_new_tokens=10))
+    preempted = None
+    for _ in range(30):
+        eng2.step()
+        req = eng2.requests.get(r3)
+        if req is not None and req.state == "PREEMPTED":
+            preempted = req
+            break
+    assert preempted is not None
+    out3 = eng2.cancel(r3)
+    assert out3.finish_reason == "cancelled" and out3.token_ids
+    # retired/unknown ids: None, and the cancel counter counted 3
+    assert eng.cancel(r1) is None and eng.cancel(9999) is None
+    assert monitor.counter("serving.cancelled").get() == c0 + 3
+    for e in (eng, eng2):
+        for _ in range(40):
+            if e.num_active == 0 and e.num_waiting == 0:
+                break
+            e.step()
+        assert e.pages_free == e.pool_pages
+    assert eng.steady_state_recompiles() == 0
+
+
+def test_engine_rejected_requests_leave_state_untouched(rng):
+    """Satellite: failed add_request validation (oversized context,
+    impossible lifetime page demand, batched/empty prompts, bad
+    params) must leave allocator AND prefix-cache state byte-identical
+    to never having seen the rejects — asserted by interleaving
+    rejects with accepted requests and comparing stats() against a
+    control engine that only saw the accepted ones."""
+    net = _tiny_net()
+    prompts = _prompts(rng, (9, 6, 12))
+
+    def drive(eng, with_rejects):
+        rids = []
+        for i, p in enumerate(prompts):
+            if with_rejects:
+                with pytest.raises(ValueError, match="max_context"):
+                    eng.add_request(p, SamplingParams(
+                        max_new_tokens=500))
+                with pytest.raises(ValueError, match="ONE prompt"):
+                    eng.add_request(np.zeros((2, 5), np.int64))
+                with pytest.raises(ValueError, match="empty"):
+                    eng.add_request(np.zeros((0,), np.int64))
+                with pytest.raises(ValueError, match="deadline_ms"):
+                    eng.add_request(p, SamplingParams(
+                        max_new_tokens=2, deadline_ms=-1.0))
+            rids.append(eng.add_request(
+                p, SamplingParams(max_new_tokens=6)))
+        outs = {}
+        for _ in range(60):
+            for o in eng.step():
+                outs[o.req_id] = o
+            if len(outs) == len(rids):
+                break
+        return [outs[r].token_ids for r in rids]
+
+    eng_a = Engine(net, max_slots=2, page_size=8, pool_pages=32,
+                   max_context=48, prefill_bucket=8, prefix_cache=True)
+    eng_b = Engine(net, max_slots=2, page_size=8, pool_pages=32,
+                   max_context=48, prefill_bucket=8, prefix_cache=True)
+    toks_a = drive(eng_a, with_rejects=True)
+    toks_b = drive(eng_b, with_rejects=False)
+    assert toks_a == toks_b
+    assert eng_a._alloc.stats() == eng_b._alloc.stats()
+    assert eng_a._prefix.stats() == eng_b._prefix.stats()
+    assert eng_a.check_invariants() == []
+    # rejected requests consumed no ids either: the engines assigned
+    # the same id sequence
+    assert eng_a._next_id == eng_b._next_id
+
+
 @pytest.mark.slow
 def test_engine_stress_mixed_trace(rng):
     """Stress: many short requests with random arrivals through a

@@ -157,3 +157,90 @@ def test_monitor_dump_startup_grace_for_first_tick():
 def test_monitor_dump_no_started_at_never_flags_missing_rank():
     store = FakeStore()
     assert watchdog.monitor_dump(store, [7], timeout=0.01) == []
+
+
+# -- in-process Heartbeat (serving-engine stall watcher) ---------------------
+
+def test_heartbeat_fires_once_per_stall_and_rearms():
+    fired = []
+    hb = watchdog.Heartbeat(0.05, on_stall=fired.append,
+                            interval=0.01)
+    hb.start()
+    try:
+        assert hb.alive
+        assert _wait_for(lambda: len(fired) == 1)   # one shot…
+        time.sleep(0.1)
+        assert len(fired) == 1                       # …not repeated
+        assert fired[0] > 0.05                       # age reported
+        hb.tick()                                    # re-arm
+        assert _wait_for(lambda: len(fired) == 2)
+        assert hb.stalls == 2
+    finally:
+        hb.stop()
+    assert not hb.alive
+
+
+def test_heartbeat_ticks_suppress_stall_and_callback_errors_survive():
+    boom = []
+
+    def bad_callback(age):
+        boom.append(age)
+        raise RuntimeError("diagnostics must not kill the watcher")
+
+    hb = watchdog.Heartbeat(0.08, on_stall=bad_callback, interval=0.01)
+    hb.start()
+    try:
+        for _ in range(6):                  # steady ticking: no stall
+            hb.tick()
+            time.sleep(0.02)
+        assert boom == []
+        assert _wait_for(lambda: len(boom) == 1)   # stop ticking
+        assert hb.alive                      # raising callback absorbed
+    finally:
+        hb.stop()
+    with pytest.raises(ValueError, match="timeout"):
+        watchdog.Heartbeat(0.0, on_stall=lambda a: None)
+
+
+def test_engine_run_heartbeat_stall_snapshot(tmp_path):
+    """Engine.run(heartbeat_timeout=...) integration: a wedged step
+    triggers the stall report — serving.stalls bumps, the per-thread
+    stack dump runs, and a best-effort host snapshot lands on
+    last_stall_snapshot (and on disk) — then the run completes
+    normally once the loop unwedges."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import monitor
+    from paddle_tpu.inference.engine import Engine, SamplingParams
+    from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(vocab=32, hidden=32, layers=1, heads=2)
+    cfg.use_flash_attention = False
+    net = LlamaForCausalLM(cfg)
+    net.eval()
+    eng = Engine(net, max_slots=2, page_size=8, pool_pages=32,
+                 max_context=64, prefill_bucket=8)
+    stalls0 = monitor.counter("serving.stalls").get()
+    orig_step = eng.step
+    state = {"n": 0}
+
+    def wedged_step():
+        state["n"] += 1
+        if state["n"] == 3:          # one mid-run stall
+            time.sleep(0.3)
+        return orig_step()
+
+    eng.step = wedged_step
+    path = str(tmp_path / "stall_snap.json")
+    prompt = np.arange(1, 6, dtype=np.int64)
+    outs = eng.run([(prompt, SamplingParams(max_new_tokens=8))],
+                   heartbeat_timeout=0.05, snapshot_path=path)
+    assert outs[0].ok and len(outs[0].token_ids) == 8
+    assert monitor.counter("serving.stalls").get() > stalls0
+    assert eng.last_stall_snapshot is not None
+    assert eng.last_stall_snapshot["version"] == 1
+    import json
+    with open(path) as fh:
+        assert json.load(fh)["requests"]  # the live request captured

@@ -8,6 +8,7 @@ Run: python tools/serving_replay.py trace.jsonl [--max-slots 4]
          [--cache-dtype auto] [--no-prefix-cache] [--spec-k 0]
          [--draft-layers 1] [--json] [--expect-pallas]
          [--expect-prefix-hit-rate 0.5]
+         [--chaos] [--fault-seed 0] [--fault-rate 0.05]
 
 Each trace line is one request:
 
@@ -17,7 +18,23 @@ Each trace line is one request:
 optional ``"system_len": N`` marks the FIRST N tokens as the shared
 system prompt (one fixed token block across the whole trace) — the
 prefix-cache scenario, where every request after the first maps the
-shared pages and prefills only its divergent tail.
+shared pages and prefills only its divergent tail. Optional
+``"deadline_ms"`` / ``"max_queue_steps"`` fields ride into the
+request's SamplingParams; the engine runs on the replay's virtual
+clock, so deadline expiries replay deterministically too.
+
+``--chaos`` is the reliability soak (docs/SERVING.md "Reliability"):
+the trace is driven TWICE against the same weights — once clean to
+record every request's reference tokens, once with a seeded
+``FaultInjector`` (``--fault-seed`` / ``--fault-rate``) firing
+injected allocator exhaustion, refcount skew, prefix-cache
+collisions/stale entries, NaN rows, device errors and draft
+disagreement storms. The run fails LOUDLY (exit code 6) when any
+surviving request's output differs from the clean run, when pages
+leak, or when the invariant audit still has findings after the drain
+— the chaos contract: faults may slow or fail individual requests,
+never corrupt a survivor or the pool. The injected-fault counts and
+failure-reason histogram land in the report under ``"chaos"``.
 
 The tool builds a tiny in-memory LLaMA on the CPU backend (geometry
 from the flags — this measures the SCHEDULER, not the model), drives
@@ -110,6 +127,17 @@ def main(argv=None) -> int:
                     default=None, metavar="RATE",
                     help="fail (exit 5) when prefix_hit_rate lands "
                          "below RATE")
+    ap.add_argument("--chaos", action="store_true",
+                    help="drive the trace twice — clean, then with a "
+                         "seeded FaultInjector — and fail (exit 6) on "
+                         "leaked pages, surviving-output divergence, "
+                         "or invariant-audit findings")
+    ap.add_argument("--fault-seed", type=int, default=0,
+                    help="FaultInjector seed for --chaos (the whole "
+                         "fault schedule replays from it)")
+    ap.add_argument("--fault-rate", type=float, default=0.05,
+                    help="per-query fire probability for each fault "
+                         "point under --chaos")
     args = ap.parse_args(argv)
 
     if not os.path.exists(args.trace):
@@ -159,12 +187,25 @@ def main(argv=None) -> int:
         dcfg.use_flash_attention = False
         draft = LlamaForCausalLM(dcfg)
         draft.eval()
-    eng = Engine(net, max_slots=args.max_slots,
-                 page_size=args.page_size, pool_pages=args.pool_pages,
-                 prefill_bucket=args.prefill_bucket,
-                 cache_dtype=args.cache_dtype, max_context=max_ctx,
-                 prefix_cache=not args.no_prefix_cache,
-                 draft_model=draft, spec_k=max(args.spec_k, 1))
+
+    # the engine runs on the replay's VIRTUAL clock (vt_box advanced
+    # by the drive loop), so per-request deadline_ms expiries — and
+    # the whole chaos schedule — replay deterministically
+    vt_box = {"vt": 0.0}
+
+    def make_engine(injector=False):
+        # injector=False forces injection OFF even when the process is
+        # flag-armed (FLAGS_serving_fault_seed): the plain replay and
+        # the --chaos baseline pass must both be genuinely clean
+        return Engine(net, max_slots=args.max_slots,
+                      page_size=args.page_size,
+                      pool_pages=args.pool_pages,
+                      prefill_bucket=args.prefill_bucket,
+                      cache_dtype=args.cache_dtype, max_context=max_ctx,
+                      prefix_cache=not args.no_prefix_cache,
+                      draft_model=draft, spec_k=max(args.spec_k, 1),
+                      clock=lambda: vt_box["vt"] / 1e3,
+                      fault_injector=injector)
 
     rng = np.random.default_rng(args.seed)
     # the shared system prompt is ONE token block: request prompts with
@@ -182,65 +223,103 @@ def main(argv=None) -> int:
         tail = rng.integers(0, args.vocab, (r["prompt_len"] - sl,))
         prompts.append(np.concatenate([system[:sl], tail])
                        .astype(np.int64))
-    before = monitor.snapshot()
-    vt = 0.0                       # virtual clock, ms
-    arrival_vt = {}
-    first_vt = {}
-    finish = {}
-    i = 0
-    t0 = time.perf_counter()
-    steps = 0
-    pf_key = "serving.prefill_tokens"
-    pf_before = int(before.get(pf_key, 0))
-    while len(finish) < len(trace):
-        while i < len(trace) and trace[i]["arrival_ms"] <= vt:
-            rid = eng.add_request(
-                prompts[i],
-                SamplingParams(max_new_tokens=trace[i]["new_tokens"],
-                               temperature=args.temperature,
-                               seed=args.seed + i))
-            arrival_vt[rid] = trace[i]["arrival_ms"]
-            i += 1
-        if i < len(trace) and eng.num_active == 0 \
-                and eng.num_waiting == 0:
-            # idle gap: fast-forward to the next arrival
-            vt = max(vt, float(trace[i]["arrival_ms"]))
-            continue
-        outs = eng.step()
-        steps += 1
-        # virtual cost of the tick: one decode step plus the prefill
-        # tokens it executed (prefix hits prefill only their tail, so
-        # reuse shows up directly in TTFT)
-        pf_now = int(monitor.counter(pf_key).get())
-        vt += args.step_ms \
-            + (pf_now - pf_before) * args.prefill_token_ms
-        pf_before = pf_now
-        for out in outs:
-            finish[out.req_id] = (out, vt)
-            # a request can finish the same tick it got its first
-            # token (max_new_tokens=1) — the engine prunes finished
-            # requests, so record its TTFT here
-            first_vt.setdefault(out.req_id, vt)
-        # eng.requests holds only LIVE requests (waiting/active)
-        for rid, req in eng.requests.items():
-            if rid not in first_vt and req.generated:
-                first_vt[rid] = vt
-        if steps > 100_000:
-            print("serving_replay: engine did not drain",
+    def drive(eng):
+        """One full trace replay on the virtual clock. Returns None
+        when the engine failed to drain (exit path 3)."""
+        before = monitor.snapshot()
+        vt_box["vt"] = 0.0
+        arrival_vt = {}
+        first_vt = {}
+        finish = {}
+        i = 0
+        t0 = time.perf_counter()
+        steps = 0
+        pf_key = "serving.prefill_tokens"
+        pf_before = int(before.get(pf_key, 0))
+        while len(finish) < len(trace):
+            vt = vt_box["vt"]
+            while i < len(trace) and trace[i]["arrival_ms"] <= vt:
+                r = trace[i]
+                rid = eng.add_request(
+                    prompts[i],
+                    SamplingParams(
+                        max_new_tokens=r["new_tokens"],
+                        temperature=args.temperature,
+                        seed=args.seed + i,
+                        deadline_ms=r.get("deadline_ms"),
+                        max_queue_steps=r.get("max_queue_steps")))
+                arrival_vt[rid] = r["arrival_ms"]
+                i += 1
+            if i < len(trace) and eng.num_active == 0 \
+                    and eng.num_waiting == 0:
+                # idle gap: fast-forward to the next arrival
+                vt_box["vt"] = max(vt, float(trace[i]["arrival_ms"]))
+                continue
+            outs = eng.step()
+            steps += 1
+            # virtual cost of the tick: one decode step plus the
+            # prefill tokens it executed (prefix hits prefill only
+            # their tail, so reuse shows up directly in TTFT)
+            pf_now = int(monitor.counter(pf_key).get())
+            vt_box["vt"] += args.step_ms \
+                + (pf_now - pf_before) * args.prefill_token_ms
+            pf_before = pf_now
+            vt = vt_box["vt"]
+            for out in outs:
+                finish[out.req_id] = (out, vt)
+                # a request can finish the same tick it got its first
+                # token (max_new_tokens=1) — the engine prunes
+                # finished requests, so record its TTFT here
+                if out.token_ids:
+                    first_vt.setdefault(out.req_id, vt)
+            # eng.requests holds only LIVE requests (waiting/active)
+            for rid, req in eng.requests.items():
+                if rid not in first_vt and req.generated:
+                    first_vt[rid] = vt
+            if steps > 100_000:
+                return None
+        return {
+            "finish": finish, "first_vt": first_vt,
+            "arrival_vt": arrival_vt, "steps": steps,
+            "wall_s": time.perf_counter() - t0,
+            "before": before, "after": monitor.snapshot(),
+        }
+
+    baseline = None
+    injector = None
+    if args.chaos:
+        clean_eng = make_engine()
+        baseline = drive(clean_eng)
+        if baseline is None:
+            print("serving_replay: clean engine did not drain",
                   file=sys.stderr)
             return 3
-    wall_s = time.perf_counter() - t0
-    after = monitor.snapshot()
+        clean_eng.close()
+        from paddle_tpu.inference.reliability import FaultInjector
+        injector = FaultInjector(seed=args.fault_seed,
+                                 rate=args.fault_rate)
+    eng = make_engine(injector)
+    run = drive(eng)
+    if run is None:
+        print("serving_replay: engine did not drain", file=sys.stderr)
+        return 3
+    finish, first_vt = run["finish"], run["first_vt"]
+    arrival_vt, steps = run["arrival_vt"], run["steps"]
+    wall_s, before, after = run["wall_s"], run["before"], run["after"]
 
     ttft = [first_vt[r] - arrival_vt[r] for r in sorted(first_vt)]
     tpot = []
     total_tokens = 0
     preempts = 0
+    failures = {}
     for rid, (out, end_vt) in sorted(finish.items()):
         n = len(out.token_ids)
         total_tokens += n
         preempts += out.preemptions
-        if n > 1:
+        if not out.ok:
+            failures[out.finish_reason] = \
+                failures.get(out.finish_reason, 0) + 1
+        if n > 1 and rid in first_vt:
             tpot.append((end_vt - first_vt[rid]) / (n - 1))
     deltas = {k: int(after.get(k, 0)) - int(before.get(k, 0))
               for k in after
@@ -248,6 +327,12 @@ def main(argv=None) -> int:
                                "serving.preemptions",
                                "serving.prefill_tokens",
                                "serving.prefix_", "serving.spec_",
+                               "serving.timeouts", "serving.cancelled",
+                               "serving.failed",
+                               "serving.nan_quarantines",
+                               "serving.step_errors",
+                               "serving.invariant_repairs",
+                               "serving.fault_injected.",
                                "xla.compiles"))
               and int(after.get(k, 0)) - int(before.get(k, 0))}
     # the per-replay decode-path breakdown: which attention path the
@@ -270,6 +355,7 @@ def main(argv=None) -> int:
         "wall_s": round(wall_s, 3),
         "tokens_per_sec": round(total_tokens / max(wall_s, 1e-9), 1),
         "preemptions": preempts,
+        "failed": failures,
         "ttft_ms": _percentiles(ttft),
         "tpot_ms": _percentiles(tpot),
         "prefix_hit_rate": round(eng.prefix_hit_rate, 4),
@@ -281,6 +367,36 @@ def main(argv=None) -> int:
     }
     if eng.decode_fallback_reason:
         report["pallas_ineligible_reason"] = eng.decode_fallback_reason
+
+    chaos_failed = False
+    if args.chaos:
+        # the chaos contract: faults may slow or FAIL individual
+        # requests, never corrupt a survivor, leak a page, or leave
+        # refcount skew behind
+        mismatched = []
+        for rid, (out, _) in sorted(finish.items()):
+            if not out.ok:
+                continue
+            ref_out, _ = baseline["finish"][rid]
+            if ref_out.ok and out.token_ids != ref_out.token_ids:
+                mismatched.append(rid)
+        if eng._prefix is not None:
+            eng._prefix.clear()      # idle cache refs are not leaks
+        findings = eng.check_invariants()
+        leaked = eng.pool_pages - eng.pages_free
+        report["chaos"] = {
+            "fault_seed": args.fault_seed,
+            "fault_rate": args.fault_rate,
+            "injected": dict(sorted(injector.counts.items())),
+            "total_injected": injector.total_injected,
+            "survivors": sum(1 for out, _ in finish.values()
+                             if out.ok),
+            "survivors_exact": not mismatched,
+            "mismatched_request_ids": mismatched,
+            "leaked_pages": leaked,
+            "invariant_findings": findings,
+        }
+        chaos_failed = bool(mismatched or leaked or findings)
     fell_off = (decode_paths["gather_step"] > 0
                 or decode_paths["pallas"] == 0)
     if not args.json:
@@ -296,8 +412,20 @@ def main(argv=None) -> int:
         print(f"  preemptions {report['preemptions']}  "
               f"steady_state_recompiles "
               f"{report['steady_state_recompiles']}")
+        if failures:
+            print("  failed: " + "  ".join(
+                f"{k} x{v}" for k, v in sorted(failures.items())))
         print(f"  prefix_hit_rate {report['prefix_hit_rate']}  "
               f"spec_accept_rate {report['spec_accept_rate']}")
+        if args.chaos:
+            ch = report["chaos"]
+            print(f"  chaos: {ch['total_injected']} faults injected "
+                  f"(seed {ch['fault_seed']}), "
+                  f"{ch['survivors']}/{report['requests']} survivors, "
+                  f"exact={ch['survivors_exact']}, "
+                  f"leaked_pages={ch['leaked_pages']}")
+            for site, n in sorted(ch["injected"].items()):
+                print(f"    {site} x{n}")
         print("  decode paths: " + "  ".join(
             f"{k} +{v}" for k, v in decode_paths.items()))
         if not eng.pallas_eligible:
@@ -322,6 +450,16 @@ def main(argv=None) -> int:
               f"({'prefix cache DISABLED' if args.no_prefix_cache else 'shared prefixes are not being reused'}; "
               f"docs/SERVING.md prefix lifecycle)", file=sys.stderr)
         return 5
+    if chaos_failed:
+        ch = report["chaos"]
+        print(f"serving_replay: --chaos FAILED — "
+              f"mismatched survivors {ch['mismatched_request_ids']}, "
+              f"leaked_pages {ch['leaked_pages']}, "
+              f"invariant findings {ch['invariant_findings']} "
+              f"(seed {args.fault_seed} replays this schedule "
+              f"bit-identically; docs/SERVING.md 'Reliability')",
+              file=sys.stderr)
+        return 6
     return 0
 
 
