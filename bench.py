@@ -176,7 +176,8 @@ def llama_step_io(cfg, ids, labels):
     return nn.CrossEntropyLoss(), ids
 
 
-def _llama_run(cfg, batch, seq, n_steps=6, moment_dtype="bfloat16"):
+def _llama_run(cfg, batch, seq, n_steps=6, moment_dtype="bfloat16",
+               startend_row_indices=None):
     import paddle_tpu as paddle
     from paddle_tpu.text.models import (LlamaForCausalLM,
                                         llama_flops_per_token)
@@ -192,6 +193,15 @@ def _llama_run(cfg, batch, seq, n_steps=6, moment_dtype="bfloat16"):
     labels = paddle.to_tensor(
         rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
     loss_fn, inputs = llama_step_io(cfg, ids, labels)
+    if startend_row_indices is not None:
+        # flashmask document mask riding as the model's third forward
+        # input (attn_mask_startend_row_indices) — only the fused-CE
+        # path takes labels in-forward, so the tuple layout lines up
+        if not cfg.fused_linear_ce:
+            raise ValueError(
+                "startend_row_indices benching requires "
+                "fused_linear_ce=True (mask is the third forward input)")
+        inputs = (*inputs, startend_row_indices)
     opt = paddle.optimizer.AdamW(3e-4, parameters=net.parameters(),
                                  moment_dtype=moment_dtype)
     step = paddle.jit.TrainStep(net, loss_fn, opt, amp_dtype="bfloat16")
@@ -416,7 +426,9 @@ def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
                         arrival_rate_hz=40.0, cache_dtype="auto",
                         shared_prefix=0, prefix_cache=False,
                         draft_layers=0, spec_k=4,
-                        fault_rate=0.0, fault_seed=0):
+                        fault_rate=0.0, fault_seed=0,
+                        whale_every=0, whale_prompt=0,
+                        max_prefill_tokens=None):
     """Continuous-batching serving throughput on the 1B model
     (paddle_tpu.inference.Engine over the paged KV stack,
     docs/SERVING.md): a fixed-seed Poisson-ish arrival trace
@@ -443,17 +455,25 @@ def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
     "Reliability") for both passes: the reported number is
     surviving-request throughput under injected chaos — the price of
     the per-step invariant audit plus the faults themselves — and the
-    run raises if the pool leaks pages or the audit ends dirty."""
+    run raises if the pool leaks pages or the audit ends dirty.
+
+    whale_every=N makes every Nth request a ``whale_prompt``-token
+    long-context request (mixed whale/small traffic), and
+    max_prefill_tokens bounds the prefill work per engine step
+    (chunked prefill, docs/SERVING.md) — the long-context serving
+    point measures whale throughput WITHOUT letting whale prefills
+    monopolize the decode loop."""
     import paddle_tpu as paddle
     from paddle_tpu.inference.engine import Engine, SamplingParams
     from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
 
     paddle.seed(0)
+    max_prompt = max(prompt_hi, whale_prompt + 1)
     cfg = LlamaConfig(
         vocab_size=32000, hidden_size=4096, intermediate_size=11008,
         num_hidden_layers=4, num_attention_heads=32,
         num_key_value_heads=32,
-        max_position_embeddings=prompt_hi + new_tokens,
+        max_position_embeddings=max_prompt + new_tokens,
         use_flash_attention=True)
     net = LlamaForCausalLM(cfg)
     net.eval()
@@ -481,6 +501,13 @@ def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
                      (int(rng.integers(prompt_lo, prompt_hi))
                       - shared_prefix,))]).astype(np.int64)
         for _ in range(n_requests)]
+    if whale_every:
+        # every Nth request becomes a long-context whale (drawn AFTER
+        # the legacy stream above so shared_prefix=0/whale_every=0
+        # benches keep their exact historical rng sequence)
+        for i in range(0, n_requests, int(whale_every)):
+            prompts[i] = rng.integers(
+                0, cfg.vocab_size, (int(whale_prompt),)).astype(np.int64)
 
     # ONE engine for both passes: the executables are per-instance jit
     # closures, so a fresh engine per pass would put every compile
@@ -494,10 +521,11 @@ def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
         from paddle_tpu.inference.reliability import FaultInjector
         injector = FaultInjector(seed=fault_seed, rate=fault_rate)
     eng = Engine(net, max_slots=max_slots, page_size=128,
-                 prefill_bucket=64, max_context=prompt_hi + new_tokens,
+                 prefill_bucket=64, max_context=max_prompt + new_tokens,
                  cache_dtype=cache_dtype, prefix_cache=prefix_cache,
                  draft_model=draft, spec_k=spec_k,
-                 fault_injector=injector)
+                 fault_injector=injector,
+                 max_prefill_tokens_per_step=max_prefill_tokens)
 
     def run_trace():
         t0 = time.perf_counter()
@@ -509,11 +537,13 @@ def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
                 eng.add_request(prompts[i], SamplingParams(
                     max_new_tokens=new_tokens))
                 i += 1
-            if i < n_requests and eng.num_active == 0 \
-                    and eng.num_waiting == 0:
+            if i < n_requests and eng.idle:
                 # idle gap before the next arrival: sleep instead of
                 # busy-spinning no-op steps (which would burn host CPU
-                # and inflate serving.steps inside the timed region)
+                # and inflate serving.steps inside the timed region).
+                # eng.idle counts mid-chunked-prefill slots as busy —
+                # sleeping through a whale's remaining slices would
+                # stall it until the next arrival.
                 time.sleep(max(0.0, arrivals[i]
                                - (time.perf_counter() - t0)))
                 continue
@@ -534,6 +564,34 @@ def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
                 f"{eng.pool_pages - eng.pages_free} leaked page(s), "
                 f"findings {findings}")
     return tok_s
+
+
+def bench_llama_seq8k_flashmask(batch=1, seq=8192, docs=4, n_steps=4):
+    """Long-context training headline: the 1.07B LLaMA at seq 8192 with
+    a packed DOCUMENT mask — the Pallas flashmask kernel end-to-end
+    (fwd + bwd + AdamW step, fused lm-head+CE, bf16 moments). The mask
+    rides as ``attn_mask_startend_row_indices`` (O(S) column bands; a
+    dense [b,h,S,S] additive mask would be 2 GB/head-batch at this
+    length) and cross-document key tiles are SKIPPED by the kernel, so
+    this measures the real packed-pretraining step, not a synthetic
+    kernel loop. Reported as tokens/sec + MFU (6N rule — the same
+    accounting as every other llama point, so the seq-1024/2048/8192
+    ladder is comparable)."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.text.models import LlamaConfig
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+        num_hidden_layers=4, num_attention_heads=32,
+        num_key_value_heads=32, max_position_embeddings=seq,
+        recompute=False, fused_linear_ce=True, fused_ce_chunks=4,
+        use_flash_attention=True)
+    se = F.document_startend_row_indices([seq // docs] * docs)
+    # same protocol as every other llama point (_llama_run), with the
+    # mask riding as an extra traced step input — the seq ladder stays
+    # like-for-like
+    return _llama_run(cfg, batch=batch, seq=seq, n_steps=n_steps,
+                      startend_row_indices=se)
 
 
 def bench_flashmask_8k(b=4, h=8, s=8192, d=128, n=20):
@@ -754,6 +812,15 @@ def main():
             lambda: bench_llama_decode(cache_impl="paged"))
         result["extras"]["llama_1b_decode_paged_tokens_per_sec"] = \
             round(tok, 1)
+        dense = result["extras"].get("llama_1b_decode_tokens_per_sec")
+        if dense:
+            # the r05 measurement-debt number: paged decode as a
+            # fraction of dense decode (was 0.52 pre-PR 6; the
+            # multi-sequence DMA kernel is supposed to close it) —
+            # recorded explicitly so the gap can never hide in two
+            # far-apart extras again
+            result["extras"]["llama_1b_decode_paged_vs_dense_ratio"] = \
+                round(tok / dense, 3)
 
     def add_decode_paged_int8():
         # int8 KV pools through the paged layout: pages stream at a
@@ -811,6 +878,28 @@ def main():
         result["extras"]["llama_1b_serving_spec_tokens_per_sec"] = \
             round(tok, 1)
 
+    def add_seq8k_flashmask():
+        # the seq-8K packed-document training point: flashmask bands
+        # end-to-end through fwd+bwd+optimizer with fused CE
+        tok, mfu, _, _ = bench_llama_seq8k_flashmask()
+        result["extras"]["llama_seq8k_flashmask_mfu"] = round(mfu, 4)
+        result["extras"]["llama_seq8k_flashmask_tokens_per_sec"] = \
+            round(tok, 1)
+
+    def add_serving_longctx():
+        # mixed whale/small serving under chunked prefill: every 4th
+        # request is a 1536-token whale, prefill bounded to 256
+        # tokens/step so decode ticks interleave (docs/SERVING.md
+        # "Chunked prefill"); throughput across the whole trace
+        tok = _record_decode_path(
+            "serving_longctx",
+            lambda: bench_llama_serving(
+                n_requests=16, whale_every=4, whale_prompt=1536,
+                max_prefill_tokens=256, new_tokens=96,
+                arrival_rate_hz=20.0))
+        result["extras"]["llama_1b_serving_longctx_tokens_per_sec"] = \
+            round(tok, 1)
+
     def add_serving_chaos():
         # the reliability tax: the same arrival trace under a seeded
         # FaultInjector (2% per fault point per query) with the
@@ -833,6 +922,7 @@ def main():
     extras = [
         ("llama_seq2048", lambda: add_llama("llama_seq2048",
                                             bench_llama_long_seq), 300),
+        ("llama_seq8k_flashmask", add_seq8k_flashmask, 360),
         ("bert_base", add_bert, 180),
         ("resnet50", add_resnet, 240),
         ("ernie_moe", add_moe, 240),
@@ -851,6 +941,7 @@ def main():
         ("llama_serving_int8kv", add_serving_int8kv, 300),
         ("llama_serving_prefix", add_serving_prefix, 300),
         ("llama_serving_spec", add_serving_spec, 300),
+        ("llama_serving_longctx", add_serving_longctx, 300),
         ("llama_serving_chaos", add_serving_chaos, 300),
         ("flashmask_8k", add_flashmask, 90),
     ]

@@ -57,14 +57,29 @@ Two opt-in accelerators ride on the same scheduler (this PR):
   k+1 positions in ONE forward, and exact-match acceptance keeps the
   output bit-identical to the draft-free engine — 1 to k+1 tokens
   per tick.
+* Chunked prefill (``max_prefill_tokens_per_step=N``): long prompts
+  are written as a sequence of bounded bucketed slices interleaved
+  with decode ticks — a 32K-token whale prefills N tokens per step
+  while every running request keeps emitting, so whale arrivals
+  cannot starve small-request TTFT. Slices reuse the SAME bucketed
+  prefill executables at their traced ``start`` offset (zero new
+  compiled surfaces in steady state), a partially prefilled request
+  holds its pages across slices and stays cancellable / preemptible /
+  snapshot-able at slice boundaries, prefix-cache hits deeper than
+  one bucket skip their cached chunks with the remaining tail still
+  sliced, and the sliced prefix is token-exact vs the monolithic one
+  (the paged prefill path reads in-chunk K/V back from the pools it
+  writes). docs/SERVING.md "Chunked prefill".
 
 ``monitor`` surface (docs/OBSERVABILITY.md): gauges
 ``serving.slots_active`` / ``serving.pages_free`` /
 ``serving.queue_depth`` / ``serving.ttft_ms`` / ``serving.tpot_ms``
 / ``serving.prefix_hit_rate`` / ``serving.prefix_pages_shared`` /
-``serving.spec_accept_rate``, counters ``serving.requests`` /
+``serving.spec_accept_rate`` /
+``serving.prefill_tokens_per_step``, counters ``serving.requests`` /
 ``serving.tokens`` / ``serving.finished`` / ``serving.preemptions``
 / ``serving.steps`` / ``serving.prefill_tokens`` /
+``serving.prefill_slices`` /
 ``serving.prefix_tokens_reused`` / ``serving.prefix_hits`` /
 ``serving.prefix_lookups`` / ``serving.spec_drafted`` /
 ``serving.spec_accepted`` / ``serving.decode_fallback`` (engine
@@ -136,9 +151,21 @@ PREEMPTED = "PREEMPTED"
 FAILED = "FAILED"
 
 #: prefill attempts before a transiently failing request is FAILED
-#: (injected device errors / pool exhaustion requeue up to this many
-#: times; a deterministic failure burns through them in 3 ticks)
+#: (injected device errors and unexpected prefill errors requeue up to
+#: this many times; a deterministic failure burns through them in 3
+#: ticks). Pool-pressure requeues (PoolPressure) are EXEMPT: under
+#: chunked prefill, admission deliberately charges only the first
+#: slice, so mid-prefill exhaustion is the normal backpressure path —
+#: like preemption, it waits for pages, it doesn't consume a failure
+#: budget.
 MAX_PREFILL_RETRIES = 3
+
+
+class PoolPressure(RuntimeError):
+    """A prefill chunk could not get pages (pool exhausted after
+    eviction) — the request backs off and retries WITHOUT burning its
+    retry budget; running sequences finishing or preempting will free
+    the pages it is waiting for."""
 
 
 @dataclass
@@ -235,6 +262,13 @@ class Request:
             return self.prompt + self.generated[:-1]
         return self.prompt
 
+    def resume_len(self) -> int:
+        """len(resume_tokens()) without materializing the concat —
+        the chunked-prefill scheduler reads this every tick."""
+        if self.generated:
+            return len(self.prompt) + len(self.generated) - 1
+        return len(self.prompt)
+
 
 def _ceil_div(a: int, b: int) -> int:
     return -(-int(a) // int(b))
@@ -297,7 +331,8 @@ class Engine:
                  prefix_cache: bool = False,
                  draft_model=None, spec_k: int = 4,
                  clock=None, fault_injector=None,
-                 debug_invariants: Optional[bool] = None):
+                 debug_invariants: Optional[bool] = None,
+                 max_prefill_tokens_per_step: Optional[int] = None):
         import inspect
         try:
             fsig = inspect.signature(model.forward)
@@ -315,6 +350,22 @@ class Engine:
         self.max_slots = int(max_slots)
         self.page_size = int(page_size)
         self.prefill_bucket = int(prefill_bucket)
+        # chunked prefill (docs/SERVING.md "Chunked prefill"): when set,
+        # a prompt is written as a sequence of bounded slices — at most
+        # this many tokens of prefill run per step() — interleaved with
+        # decode ticks, so one 32K-token whale can never stall TTFT for
+        # the small requests decoding beside it. Rounded UP to the
+        # bucket so every slice is a whole compiled prefill bucket.
+        # None = monolithic (the whole tail in one chunk, as before).
+        if max_prefill_tokens_per_step is not None:
+            if int(max_prefill_tokens_per_step) < 1:
+                raise ValueError(
+                    f"max_prefill_tokens_per_step must be >= 1, got "
+                    f"{max_prefill_tokens_per_step}")
+            max_prefill_tokens_per_step = self._pbucket(
+                int(max_prefill_tokens_per_step))
+        self.max_prefill_tokens_per_step = max_prefill_tokens_per_step
+        self._pf_step_tokens = 0
         self.max_context = int(max_context
                                or cfg.max_position_embeddings)
         # speculative decoding writes k+1 positions per tick (the
@@ -449,6 +500,30 @@ class Engine:
 
     def _pbucket(self, n: int) -> int:
         return _ceil_div(n, self.prefill_bucket) * self.prefill_bucket
+
+    def _lifetime_pages(self, plen: int, max_new: int) -> int:
+        """Peak page demand of a request over its whole lifetime — the
+        can-it-EVER-be-scheduled admission check. Monolithic prefill
+        peaks at the whole-prompt bucket padding; CHUNKED prefill pads
+        only one slice at a time, so a long prompt is charged its
+        per-slice peak (the incremental fit) instead of the bucketed
+        whole — the reason a near-pool-sized prompt that fits slice by
+        slice is admitted under max_prefill_tokens_per_step but
+        rejected without it."""
+        need = plen + max_new
+        if self.max_prefill_tokens_per_step is None:
+            # monolithic: the historical conservative bound (whole-need
+            # bucket rounding) — kept for admission-behavior stability
+            return _ceil_div(
+                self._pbucket(need) + self._lookahead - 1,
+                self.page_size)
+        # chunked: prefill allocates pages for REAL tokens only (bucket
+        # padding writes to the scratch page), so the lifetime peak is
+        # simply the decode-side maximum — every written token plus the
+        # per-tick write lookahead (_ensure_pages' growth target at the
+        # final token). This covers the resume-prefill path too: a
+        # resume prefix is at most need - 2 tokens.
+        return _ceil_div(need - 1 + self._lookahead, self.page_size)
 
     def _inject_bt(self, caches, bt):
         """Pool tuples -> the model's per-layer paged cache tuples:
@@ -635,15 +710,21 @@ class Engine:
         rid = self._next_id
         need = len(prompt) + int(params.max_new_tokens)
         cap = self.max_blocks * self.page_size - (self._lookahead - 1)
-        if self._pbucket(need) > cap:
+        # chunked prefill pads only ONE slice at a time (and clips that
+        # padding at the block table), so capacity is bounded by the
+        # REAL tokens; monolithic prefill buckets the whole prompt up
+        # front and must reserve the padded length
+        chunk_cap = (need if self.max_prefill_tokens_per_step is not None
+                     else self._pbucket(need))
+        if chunk_cap > cap:
             raise ValueError(
                 f"request {rid} needs {need} token slots (prompt "
                 f"{len(prompt)} + {params.max_new_tokens} new = "
                 f"{_ceil_div(self._pbucket(need), self.page_size)} "
                 f"pages), beyond the engine's max_context capacity "
                 f"{cap}")
-        worst_pages = _ceil_div(
-            self._pbucket(need) + self._lookahead - 1, self.page_size)
+        worst_pages = self._lifetime_pages(len(prompt),
+                                           int(params.max_new_tokens))
         if worst_pages > self.pool_pages:
             raise RuntimeError(
                 f"request {rid} can never be scheduled: it needs up "
@@ -677,10 +758,9 @@ class Engine:
             self._prefix_faults()
         with tape_mod.no_grad_guard():
             outputs.extend(self._expire())
-            for req in self._admit():
-                out = self._safe_prefill(req)
-                if out is not None:
-                    outputs.append(out)
+            self._pf_step_tokens = 0
+            self._admit()
+            outputs.extend(self._run_prefills())
             self._ensure_pages()
             outputs.extend(self._safe_decode())
         if self._injector is not None and \
@@ -857,6 +937,24 @@ class Engine:
                    if r is not None and r.state == DECODE)
 
     @property
+    def num_prefilling(self) -> int:
+        """Slots holding a request mid-prefill between ticks — nonzero
+        only under chunked prefill (monolithic prefills complete inside
+        the step that admits them). Idle checks must include it: an
+        engine with a half-written whale and no decoders is NOT idle."""
+        return sum(1 for r in self._slots
+                   if r is not None and r.state == PREFILL)
+
+    @property
+    def idle(self) -> bool:
+        """True when a step() would do no work: nothing queued, nothing
+        decoding, nothing mid-prefill. The drive-loop check for replay
+        tools and offline batch drivers (fast-forwarding a virtual
+        clock, or sleeping to the next arrival, is only safe here)."""
+        return (not self._waiting and self.num_active == 0
+                and self.num_prefilling == 0)
+
+    @property
     def pages_free(self) -> int:
         return self._alloc.free_pages
 
@@ -953,31 +1051,42 @@ class Engine:
             print(f"engine watchdog: stall snapshot failed: {e}",
                   flush=True)
 
-    def _safe_prefill(self, req: Request) -> Optional[Output]:
+    def _safe_prefill(self, req: Request,
+                      cap: Optional[int] = None) -> Optional[Output]:
         """Isolation wrapper: a failing prefill retires or requeues
         THIS request — it never takes down the step() loop (the other
         slots' state is untouched; the failed call's pages are rolled
         back)."""
         try:
-            return self._prefill(req)
+            return self._prefill(req, cap)
+        except PoolPressure as e:
+            # resource pressure, not a failure: admission (chunked)
+            # charges only the first slice, so a mid-prefill dry pool
+            # is the NORMAL backpressure path — wait for pages without
+            # burning the retry budget (an admitted request always
+            # fits the pool alone; running sequences finishing or
+            # preempting unblocks it)
+            return self._requeue(req, str(e).partition("\n")[0],
+                                 count_retry=False)
         except InjectedFault:
             monitor.counter("serving.step_errors").increase()
             return self._requeue(req, "injected device error")
         except RuntimeError as e:
-            # transient resource pressure (pool exhaustion the
-            # admission reservation didn't cover, injected or real):
-            # back off and retry on a later tick
+            # other transient prefill errors: back off and retry on a
+            # later tick, against the retry budget
             return self._requeue(req, str(e).partition("\n")[0]
                                  or type(e).__name__)
         except Exception as e:  # noqa: BLE001 — request isolation
             monitor.counter("serving.step_errors").increase()
             return self._fail(req, f"error:{type(e).__name__}")
 
-    def _requeue(self, req: Request, why: str) -> Optional[Output]:
+    def _requeue(self, req: Request, why: str,
+                 count_retry: bool = True) -> Optional[Output]:
         self._rollback_prefill(req)
-        req.retries += 1
-        if req.retries > MAX_PREFILL_RETRIES:
-            return self._fail(req, f"error:prefill ({why})")
+        if count_retry:
+            req.retries += 1
+            if req.retries > MAX_PREFILL_RETRIES:
+                return self._fail(req, f"error:prefill ({why})")
         req.state = PREEMPTED if req.generated else WAITING
         req.queued_step = self._steps
         self._waiting.appendleft(req)
@@ -1027,8 +1136,14 @@ class Engine:
             # shared pages are already resident — admission charges
             # only the UNCACHED tail (a would-be-shared prefix must
             # not inflate apparent pool pressure; each shared page is
-            # one pool slot however many block tables map it)
+            # one pool slot however many block tables map it). Under
+            # chunked prefill only the FIRST slice is charged: later
+            # slices allocate as they run, so a long prompt that fits
+            # incrementally is admitted (the per-slice alloc path backs
+            # off and requeues if the pool tightens meanwhile).
             tail = len(toks) - req.prefix_len
+            if self.max_prefill_tokens_per_step is not None:
+                tail = min(tail, self.max_prefill_tokens_per_step)
             need = _ceil_div(self._pbucket(tail), self.page_size)
             # the watermark reserves growth headroom for RUNNING
             # sequences; an otherwise-empty engine admits with the
@@ -1053,54 +1168,146 @@ class Engine:
             admitted.append(req)
         return admitted
 
-    def _prefill(self, req: Request) -> Optional[Output]:
-        """Write the request's prefix into the pool (bucketed chunk);
-        fresh requests also sample their first token here (TTFT).
-        Resumed (preempted) requests only rebuild their cache — the
-        sampled token and key are discarded, so the request's RNG
-        chain continues exactly where it stopped.
+    def _run_prefills(self) -> List[Output]:
+        """Run this tick's prefill work over every PREFILL-state slot.
+
+        Monolithic mode (``max_prefill_tokens_per_step=None``): each
+        pending request writes its whole tail in one bucketed chunk, in
+        admission order — exactly the pre-chunking behavior.
+
+        Chunked mode: each pending request gets at most ONE slice, in
+        SHORTEST-REMAINING-FIRST order (a small request admitted beside
+        a mid-prefill whale reaches its first token on the next tick
+        instead of after the whale's whole prompt); each slice is
+        capped at the budget REMAINING when its turn comes, so the
+        step's total stays within the budget (± one bucket of
+        rounding). The OLDEST pending request always gets at least a
+        one-bucket slice even with the budget exhausted — a sustained
+        flood of small prefills can slow the whale, never starve it.
+        Then the decode tick below runs for every DECODE slot — the
+        interleave that bounds whale-induced TTFT inflation to one
+        slice."""
+        pending = [r for r in self._slots
+                   if r is not None and r.state == PREFILL]
+        if not pending:
+            return []
+        budget = self.max_prefill_tokens_per_step
+        if budget is None:
+            order = sorted(pending, key=lambda r: r.admit_seq)
+            oldest = None
+        else:
+            # remaining REAL work: a fresh request whose head is a
+            # prefix-cache hit has written == 0 until its first slice,
+            # but its cached prefix_len never runs through a prefill —
+            # rank it by the uncached tail it will actually execute
+            order = sorted(
+                pending,
+                key=lambda r: (r.resume_len()
+                               - max(r.written, r.prefix_len),
+                               r.admit_seq))
+            oldest = min(pending, key=lambda r: r.admit_seq)
+        outs: List[Output] = []
+        for req in order:
+            cap = None
+            if budget is not None:
+                left = budget - self._pf_step_tokens
+                if left <= 0 and req is not oldest:
+                    continue
+                cap = max(self.prefill_bucket, left)
+            out = self._safe_prefill(req, cap)
+            if out is not None:
+                outs.append(out)
+        return outs
+
+    def _prefill(self, req: Request,
+                 cap: Optional[int] = None) -> Optional[Output]:
+        """Write the next chunk of the request's prefix into the pool
+        (the whole tail in monolithic mode, one bounded slice — at
+        most ``cap`` tokens, the scheduler's remaining step budget —
+        under ``max_prefill_tokens_per_step``); fresh requests sample
+        their
+        first token on the FINAL chunk (TTFT). Resumed (preempted)
+        requests only rebuild their cache — the sampled token and key
+        are discarded, so the request's RNG chain continues exactly
+        where it stopped. A partially prefilled request keeps its slot
+        and pages across slices (state PREFILL, ``req.written`` marks
+        progress) and stays cancellable / deadline-expirable /
+        preemptible / snapshot-able at every slice boundary.
 
         With the prefix cache on, the shared pages acquired at
         admission land directly in the block table and ONLY the
-        uncached tail chunk runs through the model — TTFT for a hot
-        system prompt collapses to one (small) bucketed step. All
-        writes stay in private pages: the cached prefix is page-aligned
-        and every page from the tail onward is freshly allocated."""
+        uncached tail runs through the model — a hit deeper than one
+        bucket skips all of its cached chunks, and a long uncached
+        tail is still sliced. All writes stay in private pages: the
+        cached prefix is page-aligned and every page from the tail
+        onward is freshly allocated.
+
+        Token-exactness vs monolithic prefill: every slice runs the
+        SAME bucketed executables at a traced start offset, and the
+        in-chunk attention reads K/V back from the paged pools (the
+        multi-token paged path gathers the cache it just wrote), so a
+        sliced prefix produces bit-identical cache contents and first
+        tokens — under any cache_dtype."""
         toks = req.resume_tokens()
         fresh = not req.generated
         P = len(toks)
-        shared = list(req.shared_pages or [])
-        start = req.prefix_len            # page-aligned by construction
+        if not req.pages:
+            # first chunk: the shared prefix pages acquired at
+            # admission land in the block table now; every page the
+            # request writes from here on is private
+            req.pages = list(req.shared_pages or [])
+            req.written = req.prefix_len   # page-aligned by construction
+        start = req.written
         T = P - start
-        # bucket the tail, but never past the block table: a deep
-        # cached prefix leaves less than one full bucket of room, and
-        # the padding pages would overflow the [1, max_blocks] row
-        # (add_request guarantees the REAL tail always fits). start is
-        # page-aligned, so the cap stays page-aligned too.
+        if self.max_prefill_tokens_per_step is not None:
+            limit = self.max_prefill_tokens_per_step
+            if cap is not None:
+                # the scheduler's remaining step budget, floored at one
+                # bucket so a scheduled request always makes progress
+                limit = min(limit, max(self.prefill_bucket, int(cap)))
+            T = min(T, limit)
+        final = start + T >= P
+        # bucket the chunk, but never past the block table: a deep
+        # cached prefix (or a near-max_context prompt) leaves less than
+        # one full bucket of room, and the padding positions would
+        # overflow the [1, max_blocks] row (add_request guarantees the
+        # REAL tokens always fit, so clipping only ever drops padding).
         pb = min(self._pbucket(T),
                  self.max_blocks * self.page_size - start)
-        npriv = _ceil_div(pb, self.page_size)
+        # allocate pages for REAL tokens only: block-table rows beyond
+        # them stay 0, so the chunk's bucket-padding writes land in the
+        # shared scratch page (the masked-lane convention) instead of
+        # transiently holding pool pages that would be trimmed right
+        # back — the request's peak page demand never exceeds its real
+        # token count, which is what _lifetime_pages charges
+        need = _ceil_div(start + T, self.page_size) - len(req.pages)
         if self._fault("alloc.exhausted"):
             # simulated admission race / fragmented pool: surfaces as
-            # the allocator's exhaustion error, which _safe_prefill
-            # turns into a clean requeue-and-retry
-            raise RuntimeError(
+            # pool pressure, which _safe_prefill turns into a clean
+            # budget-free requeue-and-retry
+            raise PoolPressure(
                 f"injected pool exhaustion: sequence {req.req_id} "
-                f"requested {npriv} page(s)")
-        try:
-            priv = self._alloc.alloc(npriv, seq=req.req_id)
-        except RuntimeError:
-            # admission reserved these pages, but an aggressive caller
-            # (or a test) may drive _prefill directly: reclaim idle
-            # cached pages before surfacing the exhaustion error
-            if self._prefix is None or not self._prefix.evict(npriv):
-                raise
-            priv = self._alloc.alloc(npriv, seq=req.req_id)
-        req.pages = shared + priv
+                f"requested {need} page(s)")
+        if need > 0:
+            try:
+                priv = self._alloc.alloc(need, seq=req.req_id)
+            except RuntimeError:
+                # admission charged only the first slice (or a test may
+                # drive _prefill directly): reclaim idle cached pages,
+                # then surface ANY remaining shortfall as backpressure
+                # (a partial evict must not turn into a retry-budget-
+                # burning RuntimeError)
+                if self._prefix is not None:
+                    self._prefix.evict(need)
+                try:
+                    priv = self._alloc.alloc(need, seq=req.req_id)
+                except RuntimeError as e2:
+                    raise PoolPressure(str(e2)) from e2
+            req.pages = req.pages + priv
         bt_row = np.zeros((1, self.max_blocks), np.int32)
         bt_row[0, :len(req.pages)] = req.pages
         prompt = np.zeros((1, pb), np.int32)
-        prompt[0, :T] = toks[start:]
+        prompt[0, :T] = toks[start:start + T]
         p = req.params
         fn = self._get_prefill_fn(pb)
         bt_dev = jnp.asarray(bt_row)
@@ -1122,20 +1329,20 @@ class Engine:
             # positions) so drafting attends the full context
             self._spec.prefill(pb, bt_dev, prompt_dev, start_dev)
         monitor.counter("serving.prefill_tokens").increase(pb)
-        monitor.counter("serving.prefix_tokens_reused").increase(start)
+        monitor.counter("serving.prefill_slices").increase()
+        self._pf_step_tokens += pb
+        if start == req.prefix_len:
+            monitor.counter(
+                "serving.prefix_tokens_reused").increase(start)
         if not bool(np.asarray(okf)[0]):
             # NaN/inf on the chunk's sampling logits: quarantine the
             # request (pages freed, nothing enters the prefix cache)
             # — the other slots never see it
             monitor.counter("serving.nan_quarantines").increase()
             return self._fail(req, "nan_logits")
-        req.written = P
-        # trim the bucket-padding pages the real prefix doesn't need
-        # (private tail pages only — the shared prefix is never padded)
-        keep = len(shared) + _ceil_div(T, self.page_size)
-        if keep < len(req.pages):
-            self._alloc.free(req.pages[keep:])
-            req.pages = req.pages[:keep]
+        req.written = start + T
+        if not final:
+            return None       # stays PREFILL; a later tick continues
         if self._prefix is not None:
             # register this prefix's full pages (newly computed chunks
             # only; chunks matched at admission are already cached)
@@ -1202,11 +1409,16 @@ class Engine:
             except RuntimeError:
                 # idle cached pages go first: evicting a cold prefix
                 # is free, preempting a live sequence costs a resume
-                # prefill
+                # prefill. Mid-prefill (chunked) requests are victims
+                # too — they sit at a slice boundary, and their resume
+                # is the same re-prefill every preemption pays — so a
+                # whale's half-written prompt can never wedge the pool
+                # against running decodes.
                 if self._prefix is not None and self._prefix.evict(1):
                     continue
                 victims = [r for r in self._slots
-                           if r is not None and r.state == DECODE]
+                           if r is not None
+                           and r.state in (DECODE, PREFILL)]
                 if not victims:
                     raise
                 victim = max(victims, key=lambda r: r.admit_seq)
@@ -1220,7 +1432,8 @@ class Engine:
         monitor.counter("serving.preemptions").increase()
         req.preemptions += 1
         i = req.slot
-        if i is not None and i not in self._dirty:
+        if i is not None and i not in self._dirty \
+                and req.state == DECODE:
             # the RNG chain lives device-side between decode steps;
             # pull this slot's key down so the resumed request
             # continues it exactly. (A dirty slot was just activated —
@@ -1230,7 +1443,10 @@ class Engine:
             req.key = np.asarray(self._dev[5])[i].astype(np.uint32)
             self._keys[i] = req.key
         self._clear_slot(req)
-        req.state = PREEMPTED
+        # a mid-PREFILL victim with no generated tokens re-enters as
+        # WAITING (PREEMPTED is the has-progress resume state; its rng
+        # chain was never consumed, so a from-scratch prefill is exact)
+        req.state = PREEMPTED if req.generated else WAITING
         req.queued_step = self._steps       # fresh queue-age budget
         self._waiting.appendleft(req)
 
@@ -1429,9 +1645,11 @@ class Engine:
             # them here or they leak
             self._alloc.free(req.shared_pages)
         # a re-admission re-walks the prefix cache (the resume prefix
-        # is longer, and entries may have been evicted meanwhile)
+        # is longer, and entries may have been evicted meanwhile) and
+        # restarts any partial (chunked) prefill from scratch
         req.shared_pages = None
         req.prefix_len = 0
+        req.written = 0
 
     def _finish(self, req: Request, reason: str) -> Output:
         monitor.counter("serving.finished").increase()
@@ -1478,6 +1696,8 @@ class Engine:
         monitor.gauge("serving.slots_active").set(self.num_active)
         monitor.gauge("serving.pages_free").set(self._alloc.free_pages)
         monitor.gauge("serving.queue_depth").set(len(self._waiting))
+        monitor.gauge("serving.prefill_tokens_per_step").set(
+            self._pf_step_tokens)
         if self._prefix is not None:
             monitor.gauge("serving.prefix_hit_rate").set(
                 self._prefix.hit_rate)

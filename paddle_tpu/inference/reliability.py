@@ -298,6 +298,8 @@ def _fingerprint(eng) -> Dict[str, object]:
             "max_context": eng.max_context,
             "prefill_bucket": eng.prefill_bucket,
             "prefix_cache": eng._prefix is not None,
+            "max_prefill_tokens_per_step":
+                eng.max_prefill_tokens_per_step,
         },
     }
 
@@ -312,8 +314,10 @@ def snapshot_engine(eng, sync: bool = True) -> Dict[str, object]:
     resume-prefill machinery rebuilds them token-exactly on restore.
 
     Called between ``step()`` calls (every request is WAITING,
-    PREEMPTED or DECODE — prefill is transient inside a step), this is
-    non-destructive: the engine keeps serving afterwards.
+    PREEMPTED, DECODE, or — under chunked prefill — mid-PREFILL at a
+    slice boundary, where it serializes as a queued request: no rng
+    was consumed yet, so a from-scratch resume prefill is exact), this
+    is non-destructive: the engine keeps serving afterwards.
 
     ``sync=False`` (the stall-dump path) never touches the device —
     a wedged executable would block the fetch — and falls back to the
@@ -322,7 +326,7 @@ def snapshot_engine(eng, sync: bool = True) -> Dict[str, object]:
     """
     from dataclasses import asdict
 
-    from .engine import DECODE
+    from .engine import DECODE, PREEMPTED, WAITING
     now = eng._clock()
     keys_dev = None
     entries: List[Dict[str, object]] = []
@@ -351,12 +355,15 @@ def snapshot_engine(eng, sync: bool = True) -> Dict[str, object]:
             "preemptions": int(req.preemptions),
             "retries": int(req.retries),
             "elapsed_ms": (now - req.arrival_t) * 1e3,
-            # a RUNNING request has no queue age — it re-enters the
-            # restored queue with a fresh max_queue_steps budget (it
-            # was making progress; only genuinely waiting requests
-            # keep their accumulated wait)
+            # a RUNNING (decoding OR mid-chunked-prefill) request has
+            # no queue age — it re-enters the restored queue with a
+            # fresh max_queue_steps budget (it was making progress;
+            # only genuinely WAITING/PREEMPTED requests keep their
+            # accumulated wait — counting a whale's in-slot prefill
+            # ticks here would let restore spuriously queue_timeout a
+            # request the uninterrupted run completes)
             "waited_steps": (eng._steps - req.queued_step
-                             if req.state != DECODE
+                             if req.state in (WAITING, PREEMPTED)
                              and req.queued_step >= 0 else 0),
         })
     prefix_index: List[Dict[str, object]] = []

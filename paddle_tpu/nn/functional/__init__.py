@@ -1,7 +1,8 @@
 """paddle_tpu.nn.functional (reference: python/paddle/nn/functional)."""
 from .activation import *  # noqa: F401,F403
 from .attention import (  # noqa: F401
-    flash_attention, flash_attn, flash_attn_qkvpacked, flash_attn_unpadded,
+    document_startend_row_indices, flash_attention, flash_attn,
+    flash_attn_qkvpacked, flash_attn_unpadded,
     flash_attn_varlen_qkvpacked, flashmask_attention,
     memory_efficient_attention, scaled_dot_product_attention,
     sequence_mask,

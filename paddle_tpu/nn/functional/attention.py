@@ -288,3 +288,37 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
     return kernel_mod.flash_attention(
         query, key, value, causal=causal, window=window_size,
         startend_row_indices=startend_row_indices)
+
+
+def document_startend_row_indices(doc_lens, total=None):
+    """Causal DOCUMENT mask as flashmask ``startend_row_indices``
+    ([1, 1, total, 1] int32) — the packed-sequence training mask
+    (reference flashmask "causal document mask" example): token i may
+    attend token j iff j <= i AND both sit in the same document.
+
+    ``doc_lens``: the packed documents' lengths, summing to ``total``
+    (default: their sum). Each key column's band starts masking at its
+    document's END row, so queries in later documents see nothing of
+    earlier ones — O(S) mask memory however long the sequence, and the
+    Pallas kernel skips whole cross-document tiles. Feed the result to
+    ``flashmask_attention`` or a model's
+    ``attn_mask_startend_row_indices`` input (LlamaForCausalLM).
+    """
+    import numpy as np
+    lens = [int(n) for n in doc_lens]
+    if any(n < 1 for n in lens):
+        raise ValueError(f"document lengths must be >= 1, got {lens}")
+    s = sum(lens)
+    if total is None:
+        total = s
+    if s != int(total):
+        raise ValueError(
+            f"doc_lens sum to {s} but total={total} — packed documents "
+            f"must tile the whole sequence")
+    idx = np.zeros((1, 1, int(total), 1), np.int32)
+    lo = 0
+    for n in lens:
+        idx[0, 0, lo:lo + n, 0] = lo + n
+        lo += n
+    from ...core.dispatch import wrap
+    return wrap(jnp.asarray(idx))

@@ -198,7 +198,7 @@ class LlamaAttention(Layer):
             self.o_proj = Linear(hs, hs, bias_attr=False)
 
     def forward(self, x, position_ids=None, kv_cache=None,
-                cache_index=None):
+                cache_index=None, attn_mask_startend_row_indices=None):
         b, s, _ = x.shape
         q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
         k = self.k_proj(x).reshape([b, s, self.num_kv_heads,
@@ -218,6 +218,39 @@ class LlamaAttention(Layer):
             use_neox_rotary_style=True)
         if kv_cache is not None:
             return self._cached_attention(q, k, v, kv_cache, cache_index)
+        se = attn_mask_startend_row_indices
+        if se is not None:
+            # flashmask (reference flashmask_attention capability): a
+            # column-sparse [b|1, 1|h_kv, s, C] int32 mask — the
+            # document mask for packed long-context training — with
+            # O(S) memory instead of a dense [b, h, S, S] bias. Only
+            # the flash path understands the bands (Pallas kernel on
+            # chip, the exact masked-XLA fallback elsewhere).
+            if mesh_mod.axis_degree("sep") > 1:
+                raise ValueError(
+                    "attn_mask_startend_row_indices is not supported "
+                    "under sequence/context parallelism (sep > 1): "
+                    "ring attention rotates K/V blocks and cannot "
+                    "apply per-column band masks yet")
+            if self.window is not None:
+                raise ValueError(
+                    "attn_mask_startend_row_indices cannot be combined "
+                    "with sliding_window — express the window as extra "
+                    "mask bands instead")
+            if not self.use_flash:
+                raise ValueError(
+                    "attn_mask_startend_row_indices requires "
+                    "use_flash_attention=True (the flashmask bands "
+                    "only exist on the flash path; its XLA fallback "
+                    "is exact on non-TPU backends)")
+            from ...kernels.flash_attention import flash_attention
+            out = flash_attention(q, k, v, causal=True,
+                                  startend_row_indices=se)
+            out = out.reshape([b, s, self.num_heads * self.head_dim])
+            if self._tag:
+                from ...distributed.fleet.recompute import checkpoint_name
+                out = checkpoint_name(out, "attn_core")
+            return self.o_proj(out)
         if self._tag:
             from ...distributed.fleet.recompute import checkpoint_name
             q = checkpoint_name(q, "attn_q")
@@ -575,7 +608,8 @@ class LlamaDecoderLayer(Layer):
                                                      config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x, kv_cache=None, cache_index=None):
+    def forward(self, x, kv_cache=None, cache_index=None,
+                attn_mask_startend_row_indices=None):
         if kv_cache is not None:
             attn, new_cache = self.self_attn(
                 self.input_layernorm(x), kv_cache=kv_cache,
@@ -583,7 +617,9 @@ class LlamaDecoderLayer(Layer):
             x = x + attn
             x = x + self.mlp(self.post_attention_layernorm(x))
             return x, new_cache
-        x = x + self.self_attn(self.input_layernorm(x))
+        x = x + self.self_attn(
+            self.input_layernorm(x),
+            attn_mask_startend_row_indices=attn_mask_startend_row_indices)
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
 
@@ -604,7 +640,20 @@ class LlamaModel(Layer):
              for _ in range(config.num_hidden_layers)])
         self.norm = LlamaRMSNorm(config.hidden_size, config.rms_norm_eps)
 
-    def forward(self, input_ids, kv_caches=None, cache_index=None):
+    def forward(self, input_ids, kv_caches=None, cache_index=None,
+                attn_mask_startend_row_indices=None):
+        se = attn_mask_startend_row_indices
+        if se is not None and self.config.sequence_parallel and \
+                mesh_mod.axis_degree("mp") > 1:
+            raise ValueError(
+                "attn_mask_startend_row_indices is not supported with "
+                "sequence_parallel (the scattered activations would "
+                "desync from the full-sequence mask bands)")
+        if se is not None and kv_caches is not None:
+            raise ValueError(
+                "attn_mask_startend_row_indices is not supported with "
+                "kv_caches (cached decode applies causal(+window) "
+                "masks only)")
         x = self.embed_tokens(input_ids)
         if kv_caches is not None:
             new_caches = []
@@ -634,10 +683,24 @@ class LlamaModel(Layer):
                 policy = save_only_names("attn_core", "ffn_mid",
                                          "attn_q", "attn_k", "attn_v")
             for lyr in self.layers:
-                x = recompute(lyr, x, policy=policy)
+                if se is None:
+                    x = recompute(lyr, x, policy=policy)
+                else:
+                    # positional bridge: recompute only accepts tensor
+                    # args positionally, and the mask must be a
+                    # checkpointed INPUT (its bands re-drive the flash
+                    # kernel in the rematerialized forward); the layer
+                    # rides in the closure, where _owning_layers finds
+                    # its params
+                    def _blk(a, m):
+                        # true closure over lyr — _owning_layers reads
+                        # __closure__ to bind the block's params
+                        return lyr(a,
+                                   attn_mask_startend_row_indices=m)
+                    x = recompute(_blk, x, se, policy=policy)
         else:
             for lyr in self.layers:
-                x = lyr(x)
+                x = lyr(x, attn_mask_startend_row_indices=se)
         return self.norm(x)
 
 
@@ -665,13 +728,21 @@ class LlamaForCausalLM(Layer):
             return jnp.einsum("bsh,vh->bsv", hh, ww)
         return run_op("tied_lm_head", tied, [h, w])
 
-    def forward(self, input_ids, labels=None, kv_caches=None,
+    def forward(self, input_ids, labels=None,
+                attn_mask_startend_row_indices=None, kv_caches=None,
                 cache_index=None):
         if kv_caches is not None:
+            if attn_mask_startend_row_indices is not None:
+                raise ValueError(
+                    "attn_mask_startend_row_indices is not supported "
+                    "with kv_caches (cached decode applies causal(+"
+                    "window) masks only — packed multi-document "
+                    "contexts must be decoded as separate requests)")
             h, new_caches = self.llama(input_ids, kv_caches=kv_caches,
                                        cache_index=cache_index)
             return self._head(h), new_caches
-        h = self.llama(input_ids)
+        h = self.llama(input_ids, attn_mask_startend_row_indices=(
+            attn_mask_startend_row_indices))
         if labels is not None and self.config.fused_linear_ce:
             from ...incubate.nn.functional import fused_linear_cross_entropy
             if self.lm_head is not None:

@@ -32,6 +32,8 @@ def stubbed(monkeypatch):
                         lambda: (9000.0, 0.55, "TPU v5 lite", 1))
     monkeypatch.setattr(bench, "bench_llama_small",
                         lambda: (40000.0, 0.70, "TPU v5 lite", 1))
+    monkeypatch.setattr(bench, "bench_llama_seq8k_flashmask",
+                        lambda: (4000.0, 0.51, "TPU v5 lite", 1))
     monkeypatch.setattr(bench, "bench_lenet", lambda: (900.0, 30.0))
     monkeypatch.setattr(bench, "bench_bert", lambda: (50000.0, 0.4))
     monkeypatch.setattr(bench, "bench_ernie_moe",
@@ -58,6 +60,8 @@ def test_headline_prints_first_and_extras_append(stubbed, capsys,
     # the final line carries every extra
     last = lines[-1]["extras"]
     for key in ["llama_seq2048_mfu", "llama_small_seq512_mfu",
+                "llama_seq8k_flashmask_mfu",
+                "llama_seq8k_flashmask_tokens_per_sec",
                 "lenet_train_steps_per_sec_b256",
                 "bert_base_tokens_per_sec", "bert_base_mfu_approx",
                 "ernie_moe_tokens_per_sec", "ernie_moe_mfu_routed",
@@ -65,10 +69,12 @@ def test_headline_prints_first_and_extras_append(stubbed, capsys,
                 "resnet50_images_per_sec",
                 "llama_1b_decode_tokens_per_sec",
                 "llama_1b_decode_paged_int8_tokens_per_sec",
+                "llama_1b_decode_paged_vs_dense_ratio",
                 "llama_1b_serving_tokens_per_sec",
                 "llama_1b_serving_int8kv_tokens_per_sec",
                 "llama_1b_serving_prefix_tokens_per_sec",
                 "llama_1b_serving_spec_tokens_per_sec",
+                "llama_1b_serving_longctx_tokens_per_sec",
                 "llama_1b_serving_chaos_tokens_per_sec"]:
         assert key in last, key
     assert "skipped" not in last
@@ -85,14 +91,16 @@ def test_budget_skips_extras_but_headline_survives(stubbed, capsys,
     lines = _lines(capsys)
     assert lines[0]["value"] == 17000.0
     assert set(lines[-1]["extras"]["skipped"]) == {
-        "llama_seq2048", "llama_small_seq512", "lenet", "bert_base",
+        "llama_seq2048", "llama_seq8k_flashmask", "llama_small_seq512",
+        "lenet", "bert_base",
         "ernie_moe", "ernie_moe_dispatch_pallas", "resnet50",
         "llama_decode", "llama_decode_bf16kv",
         "llama_decode_int8kv", "llama_decode_int8",
         "llama_decode_paged", "llama_decode_paged_int8",
         "llama_decode_rolling", "llama_serving",
         "llama_serving_int8kv", "llama_serving_prefix",
-        "llama_serving_spec", "llama_serving_chaos", "flashmask_8k"}
+        "llama_serving_spec", "llama_serving_longctx",
+        "llama_serving_chaos", "flashmask_8k"}
     assert "llama_seq2048_mfu" not in lines[-1]["extras"]
 
 

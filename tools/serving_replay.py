@@ -6,8 +6,9 @@ Run: python tools/serving_replay.py trace.jsonl [--max-slots 4]
          [--heads 4] [--vocab 64] [--seed 0] [--step-ms 5]
          [--prefill-token-ms 0.1] [--temperature 0]
          [--cache-dtype auto] [--no-prefix-cache] [--spec-k 0]
-         [--draft-layers 1] [--json] [--expect-pallas]
-         [--expect-prefix-hit-rate 0.5]
+         [--draft-layers 1] [--max-prefill-tokens N] [--json]
+         [--expect-pallas] [--expect-prefix-hit-rate 0.5]
+         [--expect-p99-ttft-ms MS] [--ttft-tag small]
          [--chaos] [--fault-seed 0] [--fault-rate 0.05]
 
 Each trace line is one request:
@@ -21,7 +22,16 @@ prefix-cache scenario, where every request after the first maps the
 shared pages and prefills only its divergent tail. Optional
 ``"deadline_ms"`` / ``"max_queue_steps"`` fields ride into the
 request's SamplingParams; the engine runs on the replay's virtual
-clock, so deadline expiries replay deterministically too.
+clock, so deadline expiries replay deterministically too. An optional
+``"tag"`` labels the request's class ("whale" / "small" on the
+long-context fixture): the report adds per-tag TTFT percentile rows,
+and ``--expect-p99-ttft-ms MS --ttft-tag small`` turns them into a
+whale-starvation gate (exit 7 when the tagged class's p99 TTFT lands
+above MS, or any tagged request never reached a first token).
+``--max-prefill-tokens N`` runs the engine with chunked prefill —
+long prompts are written N tokens per step, interleaved with decode
+ticks (docs/SERVING.md "Chunked prefill") — the knob the long-context
+fixture's gate is calibrated against.
 
 ``--chaos`` is the reliability soak (docs/SERVING.md "Reliability"):
 the trace is driven TWICE against the same weights — once clean to
@@ -67,9 +77,11 @@ code 5 when the replay's hit rate lands below X): the guard for
 prefix-heavy fixtures where a silent cache regression would only read
 as higher TTFT.
 
-Fixture traces live at tests/fixtures/serving_trace.jsonl and
+Fixture traces live at tests/fixtures/serving_trace.jsonl,
 tests/fixtures/serving_trace_prefix.jsonl (prefix-heavy: one shared
-system prompt, divergent user turns).
+system prompt, divergent user turns) and
+tests/fixtures/serving_trace_longctx.jsonl (mixed whale/small traffic
+with tags — the chunked-prefill fairness scenario).
 """
 from __future__ import annotations
 
@@ -108,6 +120,11 @@ def main(argv=None) -> int:
                          "step executed (cached prefixes skip these)")
     ap.add_argument("--temperature", type=float, default=0.0)
     ap.add_argument("--cache-dtype", default="auto")
+    ap.add_argument("--max-prefill-tokens", type=int, default=None,
+                    help="chunked prefill: at most this many prompt "
+                         "tokens are prefilled per engine step, "
+                         "interleaved with decode ticks (None = "
+                         "monolithic prefill)")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="disable shared-prefix KV reuse (the "
                          "cold-prefix baseline)")
@@ -127,6 +144,17 @@ def main(argv=None) -> int:
                     default=None, metavar="RATE",
                     help="fail (exit 5) when prefix_hit_rate lands "
                          "below RATE")
+    ap.add_argument("--expect-p99-ttft-ms", type=float, default=None,
+                    metavar="MS",
+                    help="fail (exit 7) when p99 TTFT (virtual clock) "
+                         "lands above MS — the whale-starvation guard "
+                         "for long-context traces; scoped by "
+                         "--ttft-tag when the trace tags requests")
+    ap.add_argument("--ttft-tag", default=None, metavar="TAG",
+                    help="restrict --expect-p99-ttft-ms to requests "
+                         "whose trace line carries \"tag\": TAG "
+                         "(e.g. gate only the small requests of a "
+                         "mixed whale/small trace)")
     ap.add_argument("--chaos", action="store_true",
                     help="drive the trace twice — clean, then with a "
                          "seeded FaultInjector — and fail (exit 6) on "
@@ -205,7 +233,8 @@ def main(argv=None) -> int:
                       prefix_cache=not args.no_prefix_cache,
                       draft_model=draft, spec_k=max(args.spec_k, 1),
                       clock=lambda: vt_box["vt"] / 1e3,
-                      fault_injector=injector)
+                      fault_injector=injector,
+                      max_prefill_tokens_per_step=args.max_prefill_tokens)
 
     rng = np.random.default_rng(args.seed)
     # the shared system prompt is ONE token block: request prompts with
@@ -231,6 +260,7 @@ def main(argv=None) -> int:
         arrival_vt = {}
         first_vt = {}
         finish = {}
+        tags = {}
         i = 0
         t0 = time.perf_counter()
         steps = 0
@@ -249,10 +279,14 @@ def main(argv=None) -> int:
                         deadline_ms=r.get("deadline_ms"),
                         max_queue_steps=r.get("max_queue_steps")))
                 arrival_vt[rid] = r["arrival_ms"]
+                if r.get("tag"):
+                    tags[rid] = str(r["tag"])
                 i += 1
-            if i < len(trace) and eng.num_active == 0 \
-                    and eng.num_waiting == 0:
-                # idle gap: fast-forward to the next arrival
+            if i < len(trace) and eng.idle:
+                # idle gap: fast-forward to the next arrival (idle
+                # includes mid-chunked-prefill slots — jumping the
+                # clock over an in-flight prefill would inflate its
+                # TTFT and spuriously expire deadlines)
                 vt_box["vt"] = max(vt, float(trace[i]["arrival_ms"]))
                 continue
             outs = eng.step()
@@ -280,7 +314,7 @@ def main(argv=None) -> int:
                 return None
         return {
             "finish": finish, "first_vt": first_vt,
-            "arrival_vt": arrival_vt, "steps": steps,
+            "arrival_vt": arrival_vt, "tags": tags, "steps": steps,
             "wall_s": time.perf_counter() - t0,
             "before": before, "after": monitor.snapshot(),
         }
@@ -307,7 +341,16 @@ def main(argv=None) -> int:
     arrival_vt, steps = run["arrival_vt"], run["steps"]
     wall_s, before, after = run["wall_s"], run["before"], run["after"]
 
+    tags = run["tags"]
     ttft = [first_vt[r] - arrival_vt[r] for r in sorted(first_vt)]
+    # per-tag TTFT columns (traces may tag request classes, e.g.
+    # "whale"/"small" on the long-context fixture): the mixed-traffic
+    # fairness numbers the chunked-prefill gate reads
+    ttft_by_tag = {}
+    for r in sorted(first_vt):
+        if r in tags:
+            ttft_by_tag.setdefault(tags[r], []).append(
+                first_vt[r] - arrival_vt[r])
     tpot = []
     total_tokens = 0
     preempts = 0
@@ -357,6 +400,8 @@ def main(argv=None) -> int:
         "preemptions": preempts,
         "failed": failures,
         "ttft_ms": _percentiles(ttft),
+        "ttft_ms_by_tag": {t: _percentiles(v)
+                           for t, v in sorted(ttft_by_tag.items())},
         "tpot_ms": _percentiles(tpot),
         "prefix_hit_rate": round(eng.prefix_hit_rate, 4),
         "spec_accept_rate": round(eng.spec_accept_rate, 4),
@@ -409,6 +454,9 @@ def main(argv=None) -> int:
             print(f"  {name:8s} p50 {ps['p50']:8.2f}  "
                   f"p90 {ps['p90']:8.2f}  p99 {ps['p99']:8.2f}   "
                   f"(virtual clock)")
+        for tag, ps in report["ttft_ms_by_tag"].items():
+            print(f"  ttft[{tag}] p50 {ps['p50']:8.2f}  "
+                  f"p90 {ps['p90']:8.2f}  p99 {ps['p99']:8.2f}")
         print(f"  preemptions {report['preemptions']}  "
               f"steady_state_recompiles "
               f"{report['steady_state_recompiles']}")
@@ -450,6 +498,39 @@ def main(argv=None) -> int:
               f"({'prefix cache DISABLED' if args.no_prefix_cache else 'shared prefixes are not being reused'}; "
               f"docs/SERVING.md prefix lifecycle)", file=sys.stderr)
         return 5
+    if args.expect_p99_ttft_ms is not None:
+        # the whale-starvation guard: the gated class's p99 TTFT (and
+        # every gated request actually REACHING a first token) must
+        # hold under mixed traffic — exit 7 so CI distinguishes a
+        # fairness regression from the path/prefix/chaos gates
+        if args.ttft_tag is not None:
+            gated = report["ttft_ms_by_tag"].get(args.ttft_tag)
+            n_tagged = sum(1 for t in tags.values()
+                           if t == args.ttft_tag)
+            n_first = len(ttft_by_tag.get(args.ttft_tag, []))
+            scope = f"tag {args.ttft_tag!r}"
+        else:
+            gated = report["ttft_ms"]
+            n_tagged = len(trace)
+            n_first = len(ttft)
+            scope = "all requests"
+        if args.ttft_tag is not None and n_tagged == 0:
+            print(f"serving_replay: --expect-p99-ttft-ms FAILED — "
+                  f"no trace request carries \"tag\": "
+                  f"{args.ttft_tag!r} (check the --ttft-tag spelling "
+                  f"against the trace's tag fields)", file=sys.stderr)
+            return 7
+        p99 = gated["p99"] if gated else float("inf")
+        if gated is None or n_first < n_tagged \
+                or p99 > args.expect_p99_ttft_ms:
+            print(f"serving_replay: --expect-p99-ttft-ms FAILED — "
+                  f"{scope}: p99 {p99} > {args.expect_p99_ttft_ms} "
+                  f"or first tokens missing ({n_first}/{n_tagged}) — "
+                  f"long prompts are starving the queue "
+                  f"(docs/SERVING.md 'Chunked prefill'; run with "
+                  f"--max-prefill-tokens to bound prefill slices)",
+                  file=sys.stderr)
+            return 7
     if chaos_failed:
         ch = report["chaos"]
         print(f"serving_replay: --chaos FAILED — "
