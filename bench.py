@@ -421,6 +421,38 @@ def bench_llama_decode(batch=32, prompt=128, new_tokens=256,
     return batch * new_tokens / best
 
 
+def _drive_serving_trace(eng, arrivals, prompts, n_requests,
+                         new_tokens):
+    """One timed pass of the fixed-seed arrival trace against any
+    serving engine (single-loop, disaggregated, or TP-sharded — the
+    add_request/step/idle surface is shared). Returns generated
+    tokens/sec across the whole trace."""
+    from paddle_tpu.inference.engine import SamplingParams
+    t0 = time.perf_counter()
+    done = toks = 0
+    i = 0
+    while done < n_requests:
+        now = time.perf_counter() - t0
+        while i < n_requests and arrivals[i] <= now:
+            eng.add_request(prompts[i], SamplingParams(
+                max_new_tokens=new_tokens))
+            i += 1
+        if i < n_requests and eng.idle:
+            # idle gap before the next arrival: sleep instead of
+            # busy-spinning no-op steps (which would burn host CPU
+            # and inflate serving.steps inside the timed region).
+            # eng.idle counts mid-chunked-prefill slots as busy —
+            # sleeping through a whale's remaining slices would
+            # stall it until the next arrival.
+            time.sleep(max(0.0, arrivals[i]
+                           - (time.perf_counter() - t0)))
+            continue
+        outs = eng.step()
+        done += len(outs)
+        toks += sum(len(o.token_ids) for o in outs if o.ok)
+    return toks / (time.perf_counter() - t0)
+
+
 def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
                         prompt_hi=192, new_tokens=128,
                         arrival_rate_hz=40.0, cache_dtype="auto",
@@ -428,7 +460,8 @@ def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
                         draft_layers=0, spec_k=4,
                         fault_rate=0.0, fault_seed=0,
                         whale_every=0, whale_prompt=0,
-                        max_prefill_tokens=None):
+                        max_prefill_tokens=None,
+                        prefill_workers=0, decode_workers=0):
     """Continuous-batching serving throughput on the 1B model
     (paddle_tpu.inference.Engine over the paged KV stack,
     docs/SERVING.md): a fixed-seed Poisson-ish arrival trace
@@ -462,7 +495,13 @@ def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
     max_prefill_tokens bounds the prefill work per engine step
     (chunked prefill, docs/SERVING.md) — the long-context serving
     point measures whale throughput WITHOUT letting whale prefills
-    monopolize the decode loop."""
+    monopolize the decode loop.
+
+    prefill_workers/decode_workers > 0 runs the trace against the
+    DISAGGREGATED engine (inference/disagg.py, docs/SERVING.md
+    "Disaggregated serving"): that many prefill/decode workers as
+    independent compiled surfaces, KV pages migrating between their
+    pools — the serving point for the MPMD split."""
     import paddle_tpu as paddle
     from paddle_tpu.inference.engine import Engine, SamplingParams
     from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
@@ -520,37 +559,23 @@ def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
     if fault_rate > 0.0:
         from paddle_tpu.inference.reliability import FaultInjector
         injector = FaultInjector(seed=fault_seed, rate=fault_rate)
-    eng = Engine(net, max_slots=max_slots, page_size=128,
-                 prefill_bucket=64, max_context=max_prompt + new_tokens,
-                 cache_dtype=cache_dtype, prefix_cache=prefix_cache,
-                 draft_model=draft, spec_k=spec_k,
-                 fault_injector=injector,
-                 max_prefill_tokens_per_step=max_prefill_tokens)
+    common = dict(page_size=128, prefill_bucket=64,
+                  max_context=max_prompt + new_tokens,
+                  cache_dtype=cache_dtype, prefix_cache=prefix_cache,
+                  draft_model=draft, spec_k=spec_k,
+                  fault_injector=injector,
+                  max_prefill_tokens_per_step=max_prefill_tokens)
+    if prefill_workers > 0 or decode_workers > 0:
+        from paddle_tpu.inference.disagg import DisaggEngine
+        eng = DisaggEngine(net, prefill_workers=max(prefill_workers, 1),
+                           decode_workers=max(decode_workers, 1),
+                           max_slots=max_slots, **common)
+    else:
+        eng = Engine(net, max_slots=max_slots, **common)
 
     def run_trace():
-        t0 = time.perf_counter()
-        done = toks = 0
-        i = 0
-        while done < n_requests:
-            now = time.perf_counter() - t0
-            while i < n_requests and arrivals[i] <= now:
-                eng.add_request(prompts[i], SamplingParams(
-                    max_new_tokens=new_tokens))
-                i += 1
-            if i < n_requests and eng.idle:
-                # idle gap before the next arrival: sleep instead of
-                # busy-spinning no-op steps (which would burn host CPU
-                # and inflate serving.steps inside the timed region).
-                # eng.idle counts mid-chunked-prefill slots as busy —
-                # sleeping through a whale's remaining slices would
-                # stall it until the next arrival.
-                time.sleep(max(0.0, arrivals[i]
-                               - (time.perf_counter() - t0)))
-                continue
-            outs = eng.step()
-            done += len(outs)
-            toks += sum(len(o.token_ids) for o in outs if o.ok)
-        return toks / (time.perf_counter() - t0)
+        return _drive_serving_trace(eng, arrivals, prompts, n_requests,
+                                    new_tokens)
 
     run_trace()                 # compile pass (warms eng's executables)
     tok_s = run_trace()
@@ -558,12 +583,81 @@ def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
         # the chaos contract, enforced on the measured pass too: no
         # leaked pages, no lingering refcount skew
         findings = eng.check_invariants()
-        if findings or eng.pages_free != eng.pool_pages:
+        leaked = eng.leaked_pages()
+        if findings or leaked:
             raise RuntimeError(
                 f"serving chaos bench corrupted the pool: "
-                f"{eng.pool_pages - eng.pages_free} leaked page(s), "
-                f"findings {findings}")
+                f"{leaked} leaked page(s), findings {findings}")
     return tok_s
+
+
+def bench_llama_serving_tp2(n_requests=12, max_slots=8, prompt_lo=64,
+                            prompt_hi=192, new_tokens=128,
+                            arrival_rate_hz=40.0, cache_dtype="auto"):
+    """TP-sharded decode serving (docs/SERVING.md "TP-sharded
+    decode"): the SAME 1B engine trace as ``llama_1b_serving`` but
+    with the model and KV pools sharded mp=2 — weights column/row
+    split by the TP layer classes, pools over the kv-head axis, the
+    tiny decode state replicated and committed so the fused decode
+    step stays ONE executable. Needs >= 2 devices (two chips, or the
+    CPU backend's virtual devices); raises otherwise so the ledger
+    records the gap instead of a fake single-device number."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.inference.engine import Engine, SamplingParams
+    from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
+
+    if len(jax.devices()) < 2:
+        raise RuntimeError(
+            f"mp=2 serving needs >= 2 devices, have "
+            f"{len(jax.devices())} ({jax.default_backend()})")
+    prev = mesh_mod.get_mesh()
+    mesh = mesh_mod.build_mesh({"dp": 1, "mp": 2},
+                               devices=jax.devices()[:2])
+    # BOTH installs, explicitly: the TP layer classes read paddle's
+    # global mesh (llama._use_tp), jit sharding reads jax's ambient
+    # context — on a jax with NATIVE set_mesh only the latter would
+    # be set, and the "TP" bench would silently measure a dense model
+    mesh_mod.set_mesh(mesh)
+    try:
+        with jax.set_mesh(mesh):
+            paddle.seed(0)
+            cfg = LlamaConfig(
+                vocab_size=32000, hidden_size=4096,
+                intermediate_size=11008, num_hidden_layers=4,
+                num_attention_heads=32, num_key_value_heads=32,
+                max_position_embeddings=prompt_hi + new_tokens,
+                use_flash_attention=True)
+            net = LlamaForCausalLM(cfg)
+            net.eval()
+            rng = np.random.default_rng(0)
+            arrivals = np.cumsum(rng.exponential(
+                1.0 / arrival_rate_hz, n_requests))
+            prompts = [rng.integers(
+                0, cfg.vocab_size,
+                (int(rng.integers(prompt_lo, prompt_hi)),)).astype(
+                np.int64) for _ in range(n_requests)]
+            eng = Engine(net, max_slots=max_slots, page_size=128,
+                         prefill_bucket=64,
+                         max_context=prompt_hi + new_tokens,
+                         cache_dtype=cache_dtype)
+
+            def run_trace():
+                return _drive_serving_trace(eng, arrivals, prompts,
+                                            n_requests, new_tokens)
+
+            run_trace()          # compile pass
+            tok_s = run_trace()
+            if eng.steady_state_recompiles() != 0:
+                raise RuntimeError(
+                    f"TP serving bench recompiled in steady state "
+                    f"({eng.steady_state_recompiles()}) — the sharded "
+                    f"decode surface is not unique")
+            return tok_s
+    finally:
+        mesh_mod._global_mesh = prev
 
 
 def bench_llama_seq8k_flashmask(batch=1, seq=8192, docs=4, n_steps=4):
@@ -911,6 +1005,26 @@ def main():
         result["extras"]["llama_1b_serving_chaos_tokens_per_sec"] = \
             round(tok, 1)
 
+    def add_serving_disagg():
+        # disaggregated prefill/decode: 2 prefill + 2 decode workers
+        # as independent compiled surfaces, KV pages migrating between
+        # their pools (docs/SERVING.md "Disaggregated serving")
+        tok = _record_decode_path(
+            "serving_disagg",
+            lambda: bench_llama_serving(prefill_workers=2,
+                                        decode_workers=2))
+        result["extras"]["llama_1b_serving_disagg_tokens_per_sec"] = \
+            round(tok, 1)
+
+    def add_serving_tp2():
+        # mp=2 TP-sharded decode: weights + KV pools sharded over two
+        # devices, one fused decode executable (needs >= 2 devices;
+        # recorded as an error string on a 1-chip runner)
+        tok = _record_decode_path("serving_tp2",
+                                  bench_llama_serving_tp2)
+        result["extras"]["llama_1b_serving_tp2_tokens_per_sec"] = \
+            round(tok, 1)
+
     def add_flashmask():
         ms = bench_flashmask_8k()
         result["extras"]["flashmask_seq8k_docmask_ms"] = round(ms, 2)
@@ -943,6 +1057,8 @@ def main():
         ("llama_serving_spec", add_serving_spec, 300),
         ("llama_serving_longctx", add_serving_longctx, 300),
         ("llama_serving_chaos", add_serving_chaos, 300),
+        ("llama_serving_disagg", add_serving_disagg, 300),
+        ("llama_serving_tp2", add_serving_tp2, 300),
         ("flashmask_8k", add_flashmask, 90),
     ]
     skipped = []

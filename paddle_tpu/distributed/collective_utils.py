@@ -14,14 +14,20 @@ def ring_perm(n):
 def varying(tree, axis):
     """Mark a pytree of arrays as varying over the manual axis `axis`
     (scan carries must have a loop-invariant varying-manual-axes type).
-    Idempotent: leaves already varying over `axis` pass through."""
+    Idempotent: leaves already varying over `axis` pass through. On jax
+    builds WITHOUT the varying-manual-axes type system (0.4.x: no
+    lax.pcast, no lax.pvary) there is nothing to mark — shard_map
+    carries are untyped there — so the cast is the identity."""
     pcast = getattr(lax, "pcast", None)
+    pvary = getattr(lax, "pvary", None)
+    if pcast is None and pvary is None:
+        return tree
 
     def mark(a):
         try:
             if pcast is not None:
                 return pcast(a, axis, to="varying")
-            return lax.pvary(a, axis)
+            return pvary(a, axis)
         except ValueError as exc:
             # only the already-varying case passes through ("Unsupported
             # pcast from=varying, to='varying'"); any other ValueError

@@ -329,6 +329,7 @@ def run_dryrun(n_devices: int) -> None:
     _dryrun_llama_4d(jax, n_devices)
     _dryrun_llama_sep(jax, n_devices)
     _dryrun_sep_8k(jax, n_devices)
+    _dryrun_serving_disagg(jax, n_devices)
 
 
 def _dryrun_pipeline(jax, n_devices: int) -> None:
@@ -1091,3 +1092,100 @@ def _dryrun_sep_8k(jax, n_devices: int) -> None:
     print(f"dryrun sep8k ok: sep=2 s={s} loss={dist[0]:.4f} "
           f"gnorm={dist[1]:.4f}")
     _assert_aligned("sep8k", dist, _single_device_losses(jax, run))
+
+
+def _dryrun_serving_disagg(jax, n_devices: int) -> None:
+    """Phase 10: DISAGGREGATED serving — prefill workers and decode
+    workers as independent compiled surfaces with separate page pools,
+    KV pages migrating between them (inference/disagg.py).
+
+    Device-free gate, two halves:
+
+    * STATIC: the page-migration step's collective-redistribution
+      expression (alltoall_single over the `worker` axis, the
+      arXiv:2112.01075 formulation) records and validates clean under
+      the shard_lint recorder against a fake worker mesh.
+    * DYNAMIC: a mixed greedy + seeded-sampling trace — prefix-cache
+      hits crossing the migration boundary, speculative decoding,
+      decode-pool preemption, and a mid-trace decode-worker KILL with
+      failover re-admission — must emit TOKEN-IDENTICAL streams to
+      the single-loop Engine on the same weights. The disaggregation
+      is a scheduler split, never a numeric one.
+    """
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.disagg import DisaggEngine, lint_migration
+    from paddle_tpu.inference.engine import Engine, SamplingParams
+    from paddle_tpu.text.models import LlamaForCausalLM
+
+    cfg = _llama_tiny_cfg(layers=2)
+    cfg.use_flash_attention = False
+    paddle.seed(0)
+    net = LlamaForCausalLM(cfg)
+    net.eval()
+    paddle.seed(1)
+    dcfg = _llama_tiny_cfg(layers=1)
+    dcfg.use_flash_attention = False
+    draft = LlamaForCausalLM(dcfg)
+    draft.eval()
+
+    rng = np.random.default_rng(0)
+    system = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int64)
+    prompts = [np.concatenate(
+        [system, rng.integers(0, cfg.vocab_size, (n,))]).astype(np.int64)
+        for n in (5, 9, 3, 7, 6)]
+    cfgs = [dict(max_new_tokens=8),
+            dict(max_new_tokens=7, temperature=0.9, seed=3),
+            dict(max_new_tokens=9),
+            dict(max_new_tokens=6, temperature=0.7, top_k=8, seed=7),
+            dict(max_new_tokens=8)]
+
+    findings = lint_migration(4, max_blocks=8, kv_heads=int(
+        cfg.num_key_value_heads), page_size=8, head_dim=int(
+        cfg.hidden_size // cfg.num_attention_heads), layers=2)
+    assert not findings, f"migration collective lint: {findings}"
+
+    def build(cls, **kw):
+        return cls(net, page_size=8, max_context=64, prefix_cache=True,
+                   draft_model=draft, spec_k=3, **kw)
+
+    single = build(Engine, max_slots=4, pool_pages=96)
+    ref = single.run([(p, SamplingParams(**c))
+                      for p, c in zip(prompts, cfgs)])
+    single.close()
+
+    eng = build(DisaggEngine, prefill_workers=2, decode_workers=2,
+                max_slots=1, pool_pages=10, prefill_pool_pages=48,
+                watermark_pages=0)
+    ids = [eng.add_request(p, SamplingParams(**c))
+           for p, c in zip(prompts, cfgs)]
+    done = {}
+    killed = False
+    preempts0 = None
+    for _ in range(300):
+        for o in eng.step():
+            done[o.req_id] = o
+        if not killed and eng.num_active > 0:
+            loads = [(sum(1 for r in w._slots if r is not None), i)
+                     for i, w in enumerate(eng.decode)
+                     if w is not None]
+            eng.kill_worker("decode", max(loads)[1])
+            killed = True
+        if len(done) == len(ids):
+            break
+    assert killed and len(done) == len(ids), (
+        f"disagg dryrun did not drain ({len(done)}/{len(ids)})")
+    mismatched = [rid for rid, r in zip(ids, ref)
+                  if done[rid].token_ids != r.token_ids]
+    assert not mismatched, f"disagg token mismatch: {mismatched}"
+    recompiles = eng.steady_state_recompiles()
+    assert recompiles == 0, f"disagg steady-state recompiles: {recompiles}"
+    leaks = eng.check_invariants()
+    assert not leaks, f"disagg invariant findings: {leaks}"
+    from paddle_tpu import monitor
+    migs = int(monitor.counter("serving.disagg.migrations").get())
+    eng.close()
+    print(f"dryrun serving disagg ok: prefill=2 decode=2 "
+          f"migrations={migs} worker_kill=1 recompiles={recompiles}")
+    print(f"dryrun serving disagg align ok: "
+          f"{len(ids)}/{len(ids)} requests token-exact vs single-loop "
+          f"(greedy+sampled, prefix+spec on, preempt+kill)")

@@ -100,6 +100,111 @@ def get_mesh() -> Optional[Mesh]:
     return _global_mesh
 
 
+def ambient_concrete_mesh() -> Optional[Mesh]:
+    """The concrete mesh from JAX's own ambient context (native
+    ``jax.set_mesh`` builds), or None. The fallback that keeps
+    ``with jax.set_mesh(mesh):`` a sufficient spelling on BOTH
+    runtimes: on the pinned 0.4.x the compat shim installs the paddle
+    global directly; on newer jax only the ambient context is set and
+    consumers reach it through here."""
+    get_conc = getattr(jax.sharding, "get_concrete_mesh", None)
+    if get_conc is None:
+        return None
+    try:
+        mesh = get_conc()
+    except Exception:  # noqa: BLE001 — probe, never fatal
+        return None
+    if mesh is None or not getattr(mesh, "axis_names", ()):
+        return None
+    return mesh
+
+
+class _SetMeshCompat:
+    """``jax.set_mesh`` impersonator for jax builds without one.
+
+    Mirrors the native API's BOTH usages: as a plain statement it
+    installs ``mesh`` as the paddle global immediately (persistently,
+    like native set_mesh's global install); as a context manager it
+    additionally enters the legacy jax mesh env and restores the
+    previous paddle global on exit."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self._prev = get_mesh()
+        self._entered = False
+        set_mesh(mesh)
+
+    def __enter__(self):
+        # the legacy Mesh context (physical axis-env binding) is the
+        # 0.4.x analog of jax.set_mesh's ambient-mesh install; an
+        # AbstractMesh has no context manager — the paddle global
+        # alone is what device-free analysis reads
+        if hasattr(self.mesh, "__enter__"):
+            self.mesh.__enter__()
+            self._entered = True
+        return self.mesh
+
+    def __exit__(self, *exc):
+        global _global_mesh
+        if self._entered:
+            self.mesh.__exit__(*exc)
+        _global_mesh = self._prev
+        return False
+
+
+def use_mesh(mesh: Mesh) -> "_SetMeshCompat":
+    """Install ``mesh`` as the paddle global (and, used as a context
+    manager, the legacy jax mesh env for the duration) — the portable
+    spelling behind the ``jax.set_mesh`` compat shim."""
+    return _SetMeshCompat(mesh)
+
+
+def _shard_map_compat(f, mesh=None, in_specs=None, out_specs=None,
+                      axis_names=None, check_rep=None, **kwargs):
+    """``jax.shard_map`` for jax builds that only ship
+    ``jax.experimental.shard_map`` (the pinned 0.4.x): translates the
+    newer ``axis_names={...}`` partial-manual spelling into the
+    experimental API's complementary ``auto=frozenset(...)``."""
+    from jax.experimental.shard_map import shard_map as _sm
+    if axis_names is not None and mesh is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if auto:
+            kwargs["auto"] = auto
+    # the 0.4.x replication checker has no rule for
+    # sharding_constraint inside (partial-)manual regions — the mixed
+    # manual/GSPMD bodies every schedule here traces — so default it
+    # OFF unless the caller asked; newer jax (where this shim is
+    # never installed) runs its own vma checking regardless
+    kwargs["check_rep"] = bool(check_rep) if check_rep is not None \
+        else False
+    fn = _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+             **kwargs)
+    if kwargs.get("auto"):
+        # 0.4.x partial-manual shard_map has no EAGER impl ("if auto:
+        # raise NotImplementedError") — stage through jit, which is
+        # where every schedule here runs anyway; jit-in-jit callers
+        # just inline it
+        fn = jax.jit(fn)
+    return fn
+
+
+def _install_jax_set_mesh_compat() -> None:
+    """Give this jax build a ``jax.set_mesh`` / ``jax.shard_map`` when
+    it lacks them (both added upstream well after the pinned 0.4.x):
+    tests and user code use ``with jax.set_mesh(mesh):`` and
+    ``jax.shard_map(...)`` as the one spelling that works on every
+    version, delegating to :func:`use_mesh` / :func:`_shard_map_compat`
+    here."""
+    if not hasattr(jax, "set_mesh"):
+        jax.set_mesh = use_mesh
+    if not hasattr(jax, "shard_map"):
+        # marker consulted by code whose programs the 0.4.x lowering
+        # cannot compile (kernels/ring_attention.py fails loudly
+        # instead of letting XLA CHECK-abort the process)
+        _shard_map_compat._is_compat_shim = True
+        jax.shard_map = _shard_map_compat
+
+
 def ensure_mesh() -> Mesh:
     """Return the global mesh, building a pure-DP one if none was set."""
     global _global_mesh
@@ -139,6 +244,10 @@ def mesh_axis_sizes(mesh=None) -> Dict[str, int]:
 
 def axis_degree(name: str) -> int:
     mesh = get_mesh()
+    if mesh is None:
+        # native-set_mesh builds install only jax's ambient context;
+        # the TP layer selection must see the same topology there
+        mesh = ambient_concrete_mesh()
     if mesh is None or name not in mesh.axis_names:
         return 1
     return mesh_axis_sizes(mesh).get(name, 1)
@@ -298,3 +407,6 @@ def get_hybrid_communicate_group() -> HybridCommunicateGroup:
     if _hcg is None:
         _hcg = HybridCommunicateGroup()
     return _hcg
+
+
+_install_jax_set_mesh_compat()

@@ -131,6 +131,7 @@ import numpy as np
 
 from .. import monitor
 from ..core import tape as tape_mod
+from ..distributed import mesh as _mesh_mod
 from ..core.dispatch import unwrap
 from ..core.flags import get_flag
 from ..jit.functional import get_buffers, get_frozen, get_params
@@ -274,6 +275,28 @@ def _ceil_div(a: int, b: int) -> int:
     return -(-int(a) // int(b))
 
 
+def _normalize_prompt(ids) -> List[int]:
+    """One prompt as a python int list — the shared admission
+    normalization for every serving front door (Engine.add_request and
+    the disaggregated driver's): [s] or [1, s] Tensor/array in, loud
+    errors for batches and empties. Shapes both doors accept must stay
+    identical or the token-exactness contract between them breaks at
+    admission."""
+    arr = np.asarray(unwrap(ids))
+    if arr.ndim == 2 and arr.shape[0] == 1:
+        arr = arr[0]
+    if arr.ndim != 1:
+        raise ValueError(
+            f"add_request takes ONE prompt ([s] or [1, s] ids); got "
+            f"shape {arr.shape} — queue a batch as separate "
+            f"requests (silently concatenating the rows would "
+            f"decode from a nonsense combined context)")
+    prompt = [int(t) for t in arr]
+    if not prompt:
+        raise ValueError("empty prompt")
+    return prompt
+
+
 def _make_paged_pools(layers, rows, hkv, page_size, hd, dtype, quant):
     """Per-layer paged KV pool tuples — (k, v[, ks, vs]) zeros in the
     head-major layout kernels/paged_attention.py expects. The ONE
@@ -395,9 +418,43 @@ class Engine:
         # allocator hands out ids [1, pool_pages]
         rows = self.pool_pages + 1
         self._alloc = PageAllocator(self.pool_pages, base=1)
-        self._pools = _make_paged_pools(
+        # TP-sharded decode (docs/SERVING.md "TP-sharded decode"):
+        # under an mp>1 mesh the KV pools shard over the kv-head axis
+        # — the placement GSPMD would pick anyway from the TP attention
+        # compute — and the tiny decode state replicates. Committing
+        # BOTH at every host→device upload matters beyond bandwidth:
+        # an uncommitted (UnspecifiedValue) upload compiles a second
+        # copy of the decode executable the first time a donated
+        # output comes back with concrete shardings, which reads as a
+        # steady-state recompile. One sharding from tick zero keeps
+        # the per-worker compiled surface unique.
+        self._mp_rep = None
+        mesh = _mesh_mod.get_mesh()
+        abstract_cls = getattr(jax.sharding, "AbstractMesh", None)
+        if mesh is None or (abstract_cls is not None
+                            and isinstance(mesh, abstract_cls)):
+            # paddle's global is unset (or a device-free fake): on a
+            # jax with NATIVE set_mesh, `with jax.set_mesh(mesh):`
+            # populates only jax's ambient context — read the concrete
+            # mesh from there so TP detection works on both runtimes
+            # (the same fallback mesh_mod.axis_degree applies for the
+            # TP layer selection)
+            mesh = _mesh_mod.ambient_concrete_mesh()
+        mp = _mesh_mod.mesh_axis_sizes(mesh).get("mp", 1) \
+            if mesh is not None else 1
+        self._mp_mesh = None
+        self._mp_degree = 1
+        if mesh is not None \
+                and not (abstract_cls is not None
+                         and isinstance(mesh, abstract_cls)) \
+                and mp > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+            self._mp_mesh = mesh
+            self._mp_degree = mp
+            self._mp_rep = NamedSharding(mesh, PartitionSpec())
+        self._pools = self._commit_pools(_make_paged_pools(
             cfg.num_hidden_layers, rows, hkv, self.page_size, hd,
-            self.cache_dtype, self._quant)
+            self.cache_dtype, self._quant), hkv)
         S, MB = self.max_slots, self.max_blocks
         self._bt = np.zeros((S, MB), np.int32)
         self._pos = np.zeros((S,), np.int32)
@@ -414,12 +471,12 @@ class Engine:
         # tokens. The numpy mirrors above are the scheduler's view;
         # rows the scheduler touches are marked dirty and merged in
         # before the next decode step (_flush_state).
-        self._dev = (jnp.asarray(self._last), jnp.asarray(self._pos),
-                     jnp.asarray(self._temps), jnp.asarray(self._topks),
-                     jnp.asarray(self._topps), jnp.asarray(self._keys),
-                     jnp.asarray(self._live))
+        self._dev = (self._up(self._last), self._up(self._pos),
+                     self._up(self._temps), self._up(self._topks),
+                     self._up(self._topps), self._up(self._keys),
+                     self._up(self._live))
         self._dirty: set = set()
-        self._bt_dev = jnp.asarray(self._bt)
+        self._bt_dev = self._up(self._bt)
         self._bt_dirty = False
         self._slots: List[Optional[Request]] = [None] * S
         self._waiting: "deque[Request]" = deque()
@@ -470,7 +527,7 @@ class Engine:
         # step: all-zeros (one resident device array, re-uploaded only
         # on the rare fault tick) added to the sampling logits — a NaN
         # row turns that slot's in-graph `ok` flag off
-        self._poison_zeros = jnp.zeros((S,), jnp.float32)
+        self._poison_zeros = self._up(np.zeros((S,), np.float32))
         self._poison_dev = self._poison_zeros
         self._poisoned = False
         self.last_stall_snapshot: Optional[dict] = None
@@ -497,6 +554,29 @@ class Engine:
                     RuntimeWarning, stacklevel=2)
 
     # -- compiled step shapes ------------------------------------------------
+
+    def _up(self, x):
+        """Host→device upload of engine state, committed to the
+        replicated sharding under an mp>1 mesh (see __init__) — plain
+        jnp.asarray otherwise."""
+        if self._mp_rep is None:
+            return jnp.asarray(x)
+        return jax.device_put(np.asarray(x), self._mp_rep)
+
+    def _commit_pools(self, pools, kv_heads: int):
+        """Commit freshly built KV pools to the kv-head-sharded mp
+        placement (identity off-mesh). Shared with the draft model's
+        mirrored pools (speculative.py) — the spec is chosen per
+        POOL's kv-head count: a 1-kv-head draft beside an 8-head
+        target replicates instead of crashing on an indivisible
+        partition."""
+        if self._mp_mesh is None:
+            return pools
+        from jax.sharding import NamedSharding, PartitionSpec
+        spec = (PartitionSpec(None, "mp")
+                if int(kv_heads) % self._mp_degree == 0
+                else PartitionSpec())
+        return jax.device_put(pools, NamedSharding(self._mp_mesh, spec))
 
     def _pbucket(self, n: int) -> int:
         return _ceil_div(n, self.prefill_bucket) * self.prefill_bucket
@@ -692,18 +772,7 @@ class Engine:
         if isinstance(params, dict):
             params = SamplingParams(**params)
         params.validate()
-        arr = np.asarray(unwrap(ids))
-        if arr.ndim == 2 and arr.shape[0] == 1:
-            arr = arr[0]
-        if arr.ndim != 1:
-            raise ValueError(
-                f"add_request takes ONE prompt ([s] or [1, s] ids); got "
-                f"shape {arr.shape} — queue a batch as separate "
-                f"requests (silently concatenating the rows would "
-                f"decode from a nonsense combined context)")
-        prompt = [int(t) for t in arr]
-        if not prompt:
-            raise ValueError("empty prompt")
+        prompt = _normalize_prompt(ids)
         # validate the whole lifetime's page demand UP FRONT, naming
         # the request and the pages it needs — an oversized request
         # must never get as far as a mid-prefill _page_slots failure
@@ -957,6 +1026,16 @@ class Engine:
     @property
     def pages_free(self) -> int:
         return self._alloc.free_pages
+
+    def leaked_pages(self) -> int:
+        """Pages still allocated after idle prefix-cache references
+        are released — THE drained-engine leak check the bench and
+        replay chaos gates share (0 on a healthy drained engine).
+        Destructive to the prefix cache's idle entries: call it only
+        on a drained engine at gate time."""
+        if self._prefix is not None:
+            self._prefix.clear()
+        return self.pool_pages - self.pages_free
 
     # -- reliability internals -----------------------------------------------
 
@@ -1459,15 +1538,15 @@ class Engine:
         if self._dirty:
             mask = np.zeros((self.max_slots,), bool)
             mask[list(self._dirty)] = True
-            host = (jnp.asarray(self._last), jnp.asarray(self._pos),
-                    jnp.asarray(self._temps),
-                    jnp.asarray(self._topks),
-                    jnp.asarray(self._topps), jnp.asarray(self._keys),
-                    jnp.asarray(self._live))
-            self._dev = _merge_rows(self._dev, host, jnp.asarray(mask))
+            host = (self._up(self._last), self._up(self._pos),
+                    self._up(self._temps),
+                    self._up(self._topks),
+                    self._up(self._topps), self._up(self._keys),
+                    self._up(self._live))
+            self._dev = _merge_rows(self._dev, host, self._up(mask))
             self._dirty.clear()
         if self._bt_dirty:
-            self._bt_dev = jnp.asarray(self._bt)
+            self._bt_dev = self._up(self._bt)
             self._bt_dirty = False
 
     def _decode(self) -> List[Output]:
@@ -1536,7 +1615,7 @@ class Engine:
                 self._injector.rng.integers(0, len(active)))]
             pz = np.zeros((self.max_slots,), np.float32)
             pz[victim] = np.nan
-            self._poison_dev = jnp.asarray(pz)
+            self._poison_dev = self._up(pz)
             self._poisoned = True
 
     def _unpoison(self) -> None:

@@ -119,6 +119,12 @@ FAULT_SITES = (
     "decode.nan",
     "decode.device_error",
     "spec.disagree",
+    # disaggregated serving (inference/disagg.py): a whole worker dies
+    # — pools, allocator, device state lost — and its requests must
+    # re-admit elsewhere token-exact. Never fires on the last worker
+    # of a kind (recorded only when a kill actually landed).
+    "worker.die_prefill",
+    "worker.die_decode",
 )
 
 SNAPSHOT_VERSION = 1

@@ -88,9 +88,10 @@ class SpeculativeDecoder:
         from .engine import _make_paged_pools
         hkv = dcfg.num_key_value_heads
         hd = dcfg.hidden_size // dcfg.num_attention_heads
-        self._pools = _make_paged_pools(
+        self._pools = engine._commit_pools(_make_paged_pools(
             dcfg.num_hidden_layers, engine.pool_pages + 1, hkv,
-            engine.page_size, hd, engine.cache_dtype, engine._quant)
+            engine.page_size, hd, engine.cache_dtype, engine._quant),
+            hkv)
         self._prefill_fns = {}
         self._loop_fn = None
 

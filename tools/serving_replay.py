@@ -10,6 +10,20 @@ Run: python tools/serving_replay.py trace.jsonl [--max-slots 4]
          [--expect-pallas] [--expect-prefix-hit-rate 0.5]
          [--expect-p99-ttft-ms MS] [--ttft-tag small]
          [--chaos] [--fault-seed 0] [--fault-rate 0.05]
+         [--disagg --prefill-workers N --decode-workers M]
+         [--kill-worker decode:1:40]
+
+``--disagg`` replays against the DISAGGREGATED engine
+(inference/disagg.py, docs/SERVING.md "Disaggregated serving"):
+``--prefill-workers`` / ``--decode-workers`` size the two fleets, the
+report grows a per-worker utilization table plus migration counts
+(``serving.disagg.*`` / ``serving.migrated_pages``), and trace lines
+may carry ``"tenant": "name"`` for the multi-tenant fair scheduler.
+``--kill-worker KIND:INDEX:STEP`` (repeatable) is the failover chaos
+variant: the trace first runs clean to record reference tokens, then
+with the worker death(s) — the run fails LOUDLY (exit 8) when any
+surviving request's output diverges from the clean run, pages leak on
+a live worker, or the invariant audit ends dirty.
 
 Each trace line is one request:
 
@@ -125,6 +139,25 @@ def main(argv=None) -> int:
                          "tokens are prefilled per engine step, "
                          "interleaved with decode ticks (None = "
                          "monolithic prefill)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="replay against the DISAGGREGATED engine "
+                         "(inference/disagg.py): prefill/decode worker "
+                         "fleets with KV-page migration; the report "
+                         "adds per-worker utilization + migration "
+                         "counts (docs/SERVING.md 'Disaggregated "
+                         "serving')")
+    ap.add_argument("--prefill-workers", type=int, default=1,
+                    help="prefill fleet size under --disagg")
+    ap.add_argument("--decode-workers", type=int, default=1,
+                    help="decode fleet size under --disagg")
+    ap.add_argument("--kill-worker", action="append", default=[],
+                    metavar="KIND:INDEX:STEP",
+                    help="worker-death chaos under --disagg (e.g. "
+                         "decode:1:40): the trace runs once clean to "
+                         "record reference tokens, then with the "
+                         "kill(s) — exit 8 when any survivor's output "
+                         "diverges, pages leak, or the audit ends "
+                         "dirty. Repeatable.")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="disable shared-prefix KV reuse (the "
                          "cold-prefix baseline)")
@@ -193,8 +226,48 @@ def main(argv=None) -> int:
 
     import paddle_tpu as paddle
     from paddle_tpu import monitor
+    from paddle_tpu.inference.disagg import DisaggEngine
     from paddle_tpu.inference.engine import Engine, SamplingParams
     from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
+
+    kills = []
+    for spec in args.kill_worker:
+        try:
+            kind, idx, step = spec.split(":")
+            if kind not in ("prefill", "decode"):
+                raise ValueError(kind)
+            kills.append((kind, int(idx), int(step)))
+        except ValueError:
+            print(f"serving_replay: bad --kill-worker spec {spec!r} "
+                  f"(want KIND:INDEX:STEP, e.g. decode:1:40)",
+                  file=sys.stderr)
+            return 2
+    if kills and not args.disagg:
+        print("serving_replay: --kill-worker needs --disagg",
+              file=sys.stderr)
+        return 2
+    if not args.disagg and (args.prefill_workers != 1
+                            or args.decode_workers != 1):
+        print("serving_replay: --prefill-workers/--decode-workers "
+              "need --disagg (without it the replay drives the "
+              "single-loop engine and the worker counts would be "
+              "silently ignored)", file=sys.stderr)
+        return 2
+    for kind, fleet_n in (("prefill", args.prefill_workers),
+                          ("decode", args.decode_workers)):
+        idxs = {i for k, i, _ in kills if k == kind}
+        bad = sorted(i for i in idxs if not 0 <= i < fleet_n)
+        if bad:
+            print(f"serving_replay: --kill-worker {kind} index(es) "
+                  f"{bad} out of range (fleet size {fleet_n})",
+                  file=sys.stderr)
+            return 2
+        if len(idxs) >= fleet_n and idxs:
+            print(f"serving_replay: --kill-worker would kill every "
+                  f"{kind} worker ({sorted(idxs)} of {fleet_n}) — the "
+                  f"fleet must keep serving; leave at least one alive",
+                  file=sys.stderr)
+            return 2
 
     paddle.seed(args.seed)
     max_ctx = max(r["prompt_len"] + r["new_tokens"] for r in trace)
@@ -225,16 +298,22 @@ def main(argv=None) -> int:
         # injector=False forces injection OFF even when the process is
         # flag-armed (FLAGS_serving_fault_seed): the plain replay and
         # the --chaos baseline pass must both be genuinely clean
+        kw = dict(page_size=args.page_size,
+                  prefill_bucket=args.prefill_bucket,
+                  cache_dtype=args.cache_dtype, max_context=max_ctx,
+                  prefix_cache=not args.no_prefix_cache,
+                  draft_model=draft, spec_k=max(args.spec_k, 1),
+                  clock=lambda: vt_box["vt"] / 1e3,
+                  fault_injector=injector,
+                  max_prefill_tokens_per_step=args.max_prefill_tokens)
+        if args.disagg:
+            return DisaggEngine(net,
+                                prefill_workers=args.prefill_workers,
+                                decode_workers=args.decode_workers,
+                                max_slots=args.max_slots,
+                                pool_pages=args.pool_pages, **kw)
         return Engine(net, max_slots=args.max_slots,
-                      page_size=args.page_size,
-                      pool_pages=args.pool_pages,
-                      prefill_bucket=args.prefill_bucket,
-                      cache_dtype=args.cache_dtype, max_context=max_ctx,
-                      prefix_cache=not args.no_prefix_cache,
-                      draft_model=draft, spec_k=max(args.spec_k, 1),
-                      clock=lambda: vt_box["vt"] / 1e3,
-                      fault_injector=injector,
-                      max_prefill_tokens_per_step=args.max_prefill_tokens)
+                      pool_pages=args.pool_pages, **kw)
 
     rng = np.random.default_rng(args.seed)
     # the shared system prompt is ONE token block: request prompts with
@@ -252,15 +331,19 @@ def main(argv=None) -> int:
         tail = rng.integers(0, args.vocab, (r["prompt_len"] - sl,))
         prompts.append(np.concatenate([system[:sl], tail])
                        .astype(np.int64))
-    def drive(eng):
+    def drive(eng, kills=()):
         """One full trace replay on the virtual clock. Returns None
-        when the engine failed to drain (exit path 3)."""
+        when the engine failed to drain (exit path 3). ``kills`` are
+        (kind, index, step) worker deaths fired as the loop's step
+        counter passes them (--disagg failover chaos)."""
         before = monitor.snapshot()
         vt_box["vt"] = 0.0
         arrival_vt = {}
         first_vt = {}
         finish = {}
         tags = {}
+        pending_kills = sorted(kills, key=lambda k: k[2])
+        fired_kills = []
         i = 0
         t0 = time.perf_counter()
         steps = 0
@@ -277,11 +360,20 @@ def main(argv=None) -> int:
                         temperature=args.temperature,
                         seed=args.seed + i,
                         deadline_ms=r.get("deadline_ms"),
-                        max_queue_steps=r.get("max_queue_steps")))
+                        max_queue_steps=r.get("max_queue_steps")),
+                    **({"tenant": str(r["tenant"])}
+                       if args.disagg and r.get("tenant") else {}))
                 arrival_vt[rid] = r["arrival_ms"]
                 if r.get("tag"):
                     tags[rid] = str(r["tag"])
                 i += 1
+            while pending_kills and steps >= pending_kills[0][2]:
+                kind, idx, _ = pending_kills.pop(0)
+                n = eng.kill_worker(kind, idx)
+                fired_kills.append((kind, idx))
+                print(f"serving_replay: killed {kind}{idx} at step "
+                      f"{steps} ({n} request(s) re-admitted)",
+                      file=sys.stderr)
             if i < len(trace) and eng.idle:
                 # idle gap: fast-forward to the next arrival (idle
                 # includes mid-chunked-prefill slots — jumping the
@@ -313,6 +405,7 @@ def main(argv=None) -> int:
             if steps > 100_000:
                 return None
         return {
+            "fired_kills": fired_kills, "unfired_kills": pending_kills,
             "finish": finish, "first_vt": first_vt,
             "arrival_vt": arrival_vt, "tags": tags, "steps": steps,
             "wall_s": time.perf_counter() - t0,
@@ -320,8 +413,14 @@ def main(argv=None) -> int:
         }
 
     baseline = None
-    injector = None
-    if args.chaos:
+    # False = injection FORCED OFF (the clean contract even when the
+    # process is flag-armed via FLAGS_serving_fault_*); only --chaos
+    # builds a real injector — a --kill-worker run must diverge from
+    # its baseline through the kill alone
+    injector = False
+    if args.chaos or kills:
+        # worker-kill and fault chaos both need the clean run's
+        # reference tokens to hold survivors exact against
         clean_eng = make_engine()
         baseline = drive(clean_eng)
         if baseline is None:
@@ -329,14 +428,33 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 3
         clean_eng.close()
-        from paddle_tpu.inference.reliability import FaultInjector
+    if args.chaos:
+        from paddle_tpu.inference.reliability import (FAULT_SITES,
+                                                      FaultInjector)
+        # with a SCHEDULED kill list, the injector's own worker-death
+        # sites stay disarmed: a chaos kill landing first would either
+        # make the scheduled kill hit the last live worker (RuntimeError
+        # instead of the exit-8 contract) or turn it into a no-op that
+        # reports a failover test that never ran
+        sites = (tuple(s for s in FAULT_SITES
+                       if not s.startswith("worker."))
+                 if kills else None)
         injector = FaultInjector(seed=args.fault_seed,
-                                 rate=args.fault_rate)
+                                 rate=args.fault_rate, sites=sites)
     eng = make_engine(injector)
-    run = drive(eng)
+    run = drive(eng, kills)
     if run is None:
         print("serving_replay: engine did not drain", file=sys.stderr)
         return 3
+    if run.get("unfired_kills"):
+        # a kill scheduled past the trace's drain point never fired —
+        # the failover gate would pass VACUOUSLY; make the mismatch
+        # loud instead of reporting a chaos run that never ran
+        print(f"serving_replay: --kill-worker never fired for "
+              f"{[f'{k}:{i}:{s}' for k, i, s in run['unfired_kills']]} "
+              f"— the trace drained in {run['steps']} step(s); "
+              f"schedule the kill earlier", file=sys.stderr)
+        return 2
     finish, first_vt = run["finish"], run["first_vt"]
     arrival_vt, steps = run["arrival_vt"], run["steps"]
     wall_s, before, after = run["wall_s"], run["before"], run["after"]
@@ -412,12 +530,27 @@ def main(argv=None) -> int:
     }
     if eng.decode_fallback_reason:
         report["pallas_ineligible_reason"] = eng.decode_fallback_reason
+    if args.disagg:
+        # the disaggregated report block: per-worker busy-step
+        # utilization + migration counts (the first thing to read when
+        # a disagg number regresses is whether one fleet is starved)
+        report["disagg"] = {
+            "prefill_workers": args.prefill_workers,
+            "decode_workers": args.decode_workers,
+            "migrations": int(after.get(
+                "serving.disagg.migrations", 0)) - int(before.get(
+                    "serving.disagg.migrations", 0)),
+            "migrated_pages": int(after.get(
+                "serving.migrated_pages", 0)) - int(before.get(
+                    "serving.migrated_pages", 0)),
+            "worker_kills": [f"{k}:{i}:{s}" for k, i, s in kills],
+            "readmitted": int(after.get(
+                "serving.disagg.readmitted", 0)) - int(before.get(
+                    "serving.disagg.readmitted", 0)),
+            "workers": eng.utilization(),
+        }
 
-    chaos_failed = False
-    if args.chaos:
-        # the chaos contract: faults may slow or FAIL individual
-        # requests, never corrupt a survivor, leak a page, or leave
-        # refcount skew behind
+    def survivors_vs_baseline():
         mismatched = []
         for rid, (out, _) in sorted(finish.items()):
             if not out.ok:
@@ -425,10 +558,39 @@ def main(argv=None) -> int:
             ref_out, _ = baseline["finish"][rid]
             if ref_out.ok and out.token_ids != ref_out.token_ids:
                 mismatched.append(rid)
-        if eng._prefix is not None:
-            eng._prefix.clear()      # idle cache refs are not leaks
+        return mismatched
+
+    def residual_pages(e):
+        """Leaked pages after idle prefix-cache refs are released —
+        Engine.leaked_pages / DisaggEngine.leaked_pages, the one
+        shared contract (idle cache refs are not leaks)."""
+        return e.leaked_pages()
+
+    kill_failed = False
+    if kills:
+        # the failover contract: a worker death may slow requests,
+        # never change a survivor's tokens, leak pages, or leave the
+        # audit dirty
+        mismatched = survivors_vs_baseline()
+        leaked = residual_pages(eng)
         findings = eng.check_invariants()
-        leaked = eng.pool_pages - eng.pages_free
+        report["worker_kill"] = {
+            "kills": [f"{k}:{i}:{s}" for k, i, s in kills],
+            "survivors_exact": not mismatched,
+            "mismatched_request_ids": mismatched,
+            "leaked_pages": leaked,
+            "invariant_findings": findings,
+        }
+        kill_failed = bool(mismatched or leaked or findings)
+
+    chaos_failed = False
+    if args.chaos:
+        # the chaos contract: faults may slow or FAIL individual
+        # requests, never corrupt a survivor, leak a page, or leave
+        # refcount skew behind
+        mismatched = survivors_vs_baseline()
+        leaked = residual_pages(eng)
+        findings = eng.check_invariants()
         report["chaos"] = {
             "fault_seed": args.fault_seed,
             "fault_rate": args.fault_rate,
@@ -465,6 +627,24 @@ def main(argv=None) -> int:
                 f"{k} x{v}" for k, v in sorted(failures.items())))
         print(f"  prefix_hit_rate {report['prefix_hit_rate']}  "
               f"spec_accept_rate {report['spec_accept_rate']}")
+        if args.disagg:
+            dg = report["disagg"]
+            print(f"  disagg: {dg['prefill_workers']}p+"
+                  f"{dg['decode_workers']}d workers, "
+                  f"{dg['migrations']} migrations / "
+                  f"{dg['migrated_pages']} pages migrated, "
+                  f"{dg['readmitted']} re-admitted")
+            for name, st in sorted(dg["workers"].items()):
+                dead = "" if st["alive"] else "  [DEAD]"
+                print(f"    {name:10s} util {st['utilization']:6.2%}  "
+                      f"migrations {st['migrations']:3d}  "
+                      f"pages_migrated {st['pages_migrated']:4d}"
+                      f"{dead}")
+        if kills:
+            wk = report["worker_kill"]
+            print(f"  worker-kill: {', '.join(wk['kills'])} — "
+                  f"exact={wk['survivors_exact']} "
+                  f"leaked_pages={wk['leaked_pages']}")
         if args.chaos:
             ch = report["chaos"]
             print(f"  chaos: {ch['total_injected']} faults injected "
@@ -541,6 +721,16 @@ def main(argv=None) -> int:
               f"bit-identically; docs/SERVING.md 'Reliability')",
               file=sys.stderr)
         return 6
+    if kill_failed:
+        wk = report["worker_kill"]
+        print(f"serving_replay: --kill-worker FAILED — "
+              f"mismatched survivors {wk['mismatched_request_ids']}, "
+              f"leaked_pages {wk['leaked_pages']}, "
+              f"invariant findings {wk['invariant_findings']} — a "
+              f"worker death may slow requests, never change a "
+              f"survivor's tokens (docs/SERVING.md 'Disaggregated "
+              f"serving')", file=sys.stderr)
+        return 8
     return 0
 
 
