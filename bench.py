@@ -660,6 +660,74 @@ def bench_llama_serving_tp2(n_requests=12, max_slots=8, prompt_lo=64,
         mesh_mod._global_mesh = prev
 
 
+def bench_llama_serving_fleet(replicas=2, n_requests=24, max_slots=8,
+                              prompt_lo=192, prompt_hi=320,
+                              new_tokens=96, arrival_rate_hz=40.0,
+                              n_sessions=4, session_prefix=128):
+    """Elastic-fleet serving throughput (inference/fleet.py,
+    docs/SERVING.md "Elastic fleet"): the 1B engine replicated
+    ``replicas`` times behind the session-aware router, driven by a
+    fixed-seed session-heavy arrival trace — ``n_sessions`` distinct
+    ``session_prefix``-token system blocks, each request opening with
+    its session's block so the router steers it to the replica whose
+    prefix cache is warm. Returns (tokens/sec at 1 replica, tokens/sec
+    at ``replicas`` replicas, the scaling ratio): the 1→N scaling is
+    THE fleet number — on hardware with one chip per replica the
+    expectation is >= 1.8x for 1→2 (BENCH_r06.json ledger); in-process
+    replicas sharing one device measure the router/scheduler overhead
+    instead, which is why both points are recorded."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.fleet import ServingFleet
+    from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+        num_hidden_layers=4, num_attention_heads=32,
+        num_key_value_heads=32,
+        max_position_embeddings=prompt_hi + new_tokens,
+        use_flash_attention=True)
+    net = LlamaForCausalLM(cfg)
+    net.eval()
+    rng = np.random.default_rng(0)
+    arrivals = np.cumsum(rng.exponential(1.0 / arrival_rate_hz,
+                                         n_requests))
+    blocks = [rng.integers(0, cfg.vocab_size, (session_prefix,))
+              for _ in range(n_sessions)]
+    prompts = []
+    for i in range(n_requests):
+        s = int(rng.integers(0, n_sessions))
+        tail = rng.integers(
+            0, cfg.vocab_size,
+            (int(rng.integers(prompt_lo, prompt_hi)) - session_prefix,))
+        prompts.append(np.concatenate([blocks[s], tail])
+                       .astype(np.int64))
+
+    def measure(n):
+        fleet = ServingFleet(net, replicas=n, max_slots=max_slots,
+                             page_size=128, prefill_bucket=64,
+                             max_context=prompt_hi + new_tokens,
+                             prefix_cache=True, router="session")
+        _drive_serving_trace(fleet, arrivals, prompts, n_requests,
+                             new_tokens)              # compile pass
+        tok_s = _drive_serving_trace(fleet, arrivals, prompts,
+                                     n_requests, new_tokens)
+        if fleet.steady_state_recompiles() != 0:
+            raise RuntimeError(
+                f"fleet bench recompiled in steady state "
+                f"({fleet.steady_state_recompiles()})")
+        leaked = fleet.leaked_pages()
+        if leaked:
+            raise RuntimeError(
+                f"fleet bench leaked {leaked} page(s)")
+        fleet.close()
+        return tok_s
+
+    r1 = measure(1)
+    rn = measure(int(replicas))
+    return r1, rn, rn / r1
+
+
 def bench_llama_seq8k_flashmask(batch=1, seq=8192, docs=4, n_steps=4):
     """Long-context training headline: the 1.07B LLaMA at seq 8192 with
     a packed DOCUMENT mask — the Pallas flashmask kernel end-to-end
@@ -1016,6 +1084,17 @@ def main():
         result["extras"]["llama_1b_serving_disagg_tokens_per_sec"] = \
             round(tok, 1)
 
+    def add_serving_fleet():
+        # the elastic fleet: session-heavy trace over N=2 engine
+        # replicas behind the session-aware router; records the
+        # 2-replica throughput AND the 1->2 scaling ratio (>= 1.8x
+        # expected with one chip per replica — BENCH_r06.json ledger)
+        r1, r2, scaling = bench_llama_serving_fleet()
+        result["extras"]["llama_1b_serving_fleet_tokens_per_sec"] = \
+            round(r2, 1)
+        result["extras"]["llama_1b_serving_fleet_scaling_1to2"] = \
+            round(scaling, 3)
+
     def add_serving_tp2():
         # mp=2 TP-sharded decode: weights + KV pools sharded over two
         # devices, one fused decode executable (needs >= 2 devices;
@@ -1058,6 +1137,7 @@ def main():
         ("llama_serving_longctx", add_serving_longctx, 300),
         ("llama_serving_chaos", add_serving_chaos, 300),
         ("llama_serving_disagg", add_serving_disagg, 300),
+        ("llama_serving_fleet", add_serving_fleet, 420),
         ("llama_serving_tp2", add_serving_tp2, 300),
         ("flashmask_8k", add_flashmask, 90),
     ]

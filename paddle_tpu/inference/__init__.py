@@ -281,5 +281,11 @@ def __getattr__(name):
     if name == "SpeculativeDecoder":
         from .speculative import SpeculativeDecoder
         return SpeculativeDecoder
+    if name == "DisaggEngine":
+        from .disagg import DisaggEngine
+        return DisaggEngine
+    if name in ("ServingFleet", "AutoscalePolicy"):
+        from . import fleet as _fleet
+        return getattr(_fleet, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")

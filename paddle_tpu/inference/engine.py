@@ -927,6 +927,48 @@ class Engine:
         monitor.counter("serving.cancelled").increase()
         return self._fail(req, "cancelled")
 
+    def extract_request(self, req_id: int,
+                        device_key: bool = True) -> Optional[Request]:
+        """Remove a live request from this engine ENTIRELY — slot
+        cleared, pages freed, dropped from the queue and the request
+        table — and return it as host source of truth (prompt, tokens
+        generated so far, sampling params, rng chain), ready for
+        re-admission elsewhere through the preemption/resume-prefill
+        machinery. The live-migration hook the serving fleet
+        (inference/fleet.py) moves in-flight requests between replicas
+        with: re-admitting the returned Request on another engine over
+        the same weights continues the token stream bit-exactly.
+
+        ``device_key=True`` pulls the request's rng chain down from the
+        device-resident decode state (the same fetch preemption does);
+        ``device_key=False`` skips the device read — the caller must
+        then set ``req.key`` itself (the fleet replays it from
+        (seed, tokens emitted) via ``disagg.replay_rng_key``, the
+        host-truth-only migration contract). Returns None for unknown
+        or already-retired ids."""
+        req = self.requests.get(int(req_id))
+        if req is None or req.state in (FINISHED, FAILED):
+            return None
+        i = req.slot
+        if device_key and i is not None and req.state == DECODE \
+                and i not in self._dirty:
+            # the rng chain lives device-side between decode ticks
+            # (see _preempt); a dirty slot's freshest key is already
+            # the host mirror
+            req.key = np.asarray(self._dev[5])[i].astype(np.uint32)
+        self._clear_slot(req)
+        try:
+            self._waiting.remove(req)
+        except ValueError:
+            pass
+        self.requests.pop(req.req_id, None)
+        # PREEMPTED is the has-progress resume state: a re-admission
+        # rebuilds the cache from the kept tokens and the rng chain
+        # continues exactly (WAITING when no token was emitted yet —
+        # no rng was consumed, a from-scratch prefill is exact)
+        req.state = PREEMPTED if req.generated else WAITING
+        return req
+
     def snapshot(self, sync: bool = True) -> dict:
         """Crash-exact host-state snapshot (reliability.py has the
         format): queued + live request tokens, rng chains, sampling

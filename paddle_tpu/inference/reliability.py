@@ -125,6 +125,12 @@ FAULT_SITES = (
     # of a kind (recorded only when a kill actually landed).
     "worker.die_prefill",
     "worker.die_decode",
+    # elastic fleet (inference/fleet.py): one whole engine REPLICA dies
+    # — pools, allocator, prefix cache, device state lost — and its
+    # requests must re-admit on surviving replicas token-exact from
+    # host truth alone. Never fires on the last live replica (recorded
+    # only when a kill actually landed).
+    "replica.die",
 )
 
 SNAPSHOT_VERSION = 1

@@ -105,18 +105,52 @@ def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1,
     per-group-of-rows) scales (reference: nn/quant weight_quantize).
     int4 values live in an int8 container (the reference packs pairs for
     CUDA tensor cores; XLA gains nothing from packing). Returns
-    (quantized weight, scales)."""
+    (quantized weight, scales).
+
+    int4's 15-level grid is too coarse for one whole-column scale (a
+    64-row column already loses >14% relative matmul error), so with
+    ``group_size=-1`` the int4 path auto-groups rows at the GPTQ/AWQ
+    granularity floor — group-16 scales, shape [K/16, N] — whenever 16
+    divides K, and refines each group's scale over two candidates
+    (absmax/7, and a 7.5-denominator shrink that spends the int4
+    container's asymmetric -8 level) picked per group by quantization
+    MSE. ``weight_only_linear`` / ``weight_dequantize`` consume the 2-D
+    group scales directly. Pass ``group_size=0`` to force per-column
+    scales (the TP conversion path, where a 2-D scale's leading axis
+    would shard against the wrong mesh dim)."""
     if algo not in ("weight_only_int8", "weight_only_int4", "llm.int8"):
         raise ValueError(f"unsupported weight_quantize algo {algo}")
-    bound = 7.0 if algo == "weight_only_int4" else 127.0
+    int4 = algo == "weight_only_int4"
+    bound = 7.0 if int4 else 127.0
 
     def fn(a):
-        if group_size > 0:
+        gs = group_size
+        if gs == -1 and int4 and a.shape[0] % 16 == 0:
+            gs = 16
+        if gs > 0:
             k, n = a.shape
-            if k % group_size:
+            if k % gs:
                 raise ValueError("group_size must divide K")
-            g = a.reshape(k // group_size, group_size, n)
-            scale = jnp.max(jnp.abs(g), axis=1) / bound  # [K/gs, N]
+            g = a.reshape(k // gs, gs, n)
+            absmax = jnp.max(jnp.abs(g), axis=1)          # [K/gs, N]
+            if int4:
+                lo = -8.0        # int4 container range is [-8, 7]
+                best_q = best_s = best_e = None
+                for den in (bound, bound + 0.5):
+                    s = absmax / den
+                    q = jnp.clip(jnp.round(
+                        g / jnp.maximum(s[:, None, :], 1e-12)),
+                        lo, bound)
+                    e = jnp.sum((q * s[:, None, :] - g) ** 2, axis=1)
+                    if best_q is None:
+                        best_q, best_s, best_e = q, s, e
+                    else:
+                        m = e < best_e
+                        best_q = jnp.where(m[:, None, :], q, best_q)
+                        best_s = jnp.where(m, s, best_s)
+                        best_e = jnp.minimum(e, best_e)
+                return (best_q.astype(jnp.int8).reshape(k, n), best_s)
+            scale = absmax / bound
             q = jnp.clip(jnp.round(g / jnp.maximum(scale[:, None, :],
                                                    1e-12)),
                          -bound, bound).astype(jnp.int8).reshape(k, n)

@@ -59,7 +59,13 @@ class WeightOnlyLinear(Layer):
         algo = ("weight_only_int4" if weight_dtype == "int4"
                 else "weight_only_int8")
         w = linear.weight
-        q, scale = weight_quantize(w, algo=algo)
+        tp_source = isinstance(linear, (ColumnParallelLinear,
+                                        RowParallelLinear))
+        # TP sources force per-column scales (group_size=0): int4's
+        # auto-group scales are 2-D with K-groups leading, and the
+        # _shard_buffers commits below assume the [out_features] layout
+        q, scale = weight_quantize(w, algo=algo,
+                                   group_size=0 if tp_source else -1)
         in_f, out_f = w.shape
         bias = getattr(linear, "bias", None)
         lyr = cls(in_f, out_f, has_bias=bias is not None,

@@ -45,6 +45,8 @@ def stubbed(monkeypatch):
                         lambda **kw: 1200.0)
     monkeypatch.setattr(bench, "bench_llama_serving_tp2",
                         lambda **kw: 1600.0)
+    monkeypatch.setattr(bench, "bench_llama_serving_fleet",
+                        lambda **kw: (1100.0, 2050.0, 1.864))
     monkeypatch.setattr(bench, "bench_flashmask_8k", lambda: 9.0)
     return monkeypatch
 
@@ -79,6 +81,8 @@ def test_headline_prints_first_and_extras_append(stubbed, capsys,
                 "llama_1b_serving_longctx_tokens_per_sec",
                 "llama_1b_serving_chaos_tokens_per_sec",
                 "llama_1b_serving_disagg_tokens_per_sec",
+                "llama_1b_serving_fleet_tokens_per_sec",
+                "llama_1b_serving_fleet_scaling_1to2",
                 "llama_1b_serving_tp2_tokens_per_sec"]:
         assert key in last, key
     assert "skipped" not in last
@@ -105,7 +109,7 @@ def test_budget_skips_extras_but_headline_survives(stubbed, capsys,
         "llama_serving_int8kv", "llama_serving_prefix",
         "llama_serving_spec", "llama_serving_longctx",
         "llama_serving_chaos", "llama_serving_disagg",
-        "llama_serving_tp2", "flashmask_8k"}
+        "llama_serving_fleet", "llama_serving_tp2", "flashmask_8k"}
     assert "llama_seq2048_mfu" not in lines[-1]["extras"]
 
 

@@ -1,0 +1,546 @@
+"""Elastic serving fleet (docs/SERVING.md "Elastic fleet").
+
+The contract under test: multiplexing N whole engine replicas behind
+one session-aware front door changes NOTHING about the tokens — a
+request emits exactly the single-loop Engine's (and the b=1
+generate()'s) stream through routing, live migration between replicas
+(host truth only: tokens + replayed rng chain, re-admitted via
+resume-prefill), replica deaths, preemptions on the target replica,
+autoscale events and snapshot/restore with requests parked
+mid-migration. Session-aware routing must measurably beat round-robin
+on fleet-wide serving.prefix_hit_rate, and every live replica's
+compiled surface stays fixed (zero steady-state recompiles).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.inference.disagg import replay_rng_key
+from paddle_tpu.inference.engine import Engine, SamplingParams
+from paddle_tpu.inference.fleet import AutoscalePolicy, ServingFleet
+from paddle_tpu.text.generation import generate
+from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
+
+
+def _tiny_net(seed=0, layers=2, heads=4, vocab=64, hidden=64):
+    paddle.seed(seed)
+    cfg = LlamaConfig.tiny(vocab=vocab, hidden=hidden, layers=layers,
+                           heads=heads)
+    cfg.use_flash_attention = False
+    net = LlamaForCausalLM(cfg)
+    net.eval()
+    return net
+
+
+def _prompts(rng, lens, vocab=64):
+    return [rng.integers(0, vocab, (n,)).astype(np.int64) for n in lens]
+
+
+def _ref_rows(net, prompts, cfgs):
+    return [np.asarray(generate(
+        net, paddle.to_tensor(p[None]), c["max_new_tokens"],
+        temperature=c.get("temperature", 0.0),
+        top_k=c.get("top_k", 0), top_p=c.get("top_p", 0.0),
+        seed=c.get("seed", 0)).numpy())[0, len(p):].tolist()
+        for p, c in zip(prompts, cfgs)]
+
+
+def _session_prompts(rng, n_sessions=3, per=5, sys_pages=2, ps=8,
+                     tail=5, vocab=64):
+    """Balanced, randomly ordered same-session bursts: each prompt
+    opens with its session's fixed system block (>= 1 full page, the
+    router's session key + the prefix cache's shareable chunks)."""
+    blocks = [rng.integers(0, vocab, (sys_pages * ps,))
+              for _ in range(n_sessions)]
+    seq = [s for s in range(n_sessions) for _ in range(per)]
+    rng.shuffle(seq)
+    return [np.concatenate(
+        [blocks[s], rng.integers(0, vocab, (tail,))]).astype(np.int64)
+        for s in seq]
+
+
+def test_fleet_matches_single_engine_mixed_sampling(rng):
+    """Greedy + seeded-sampled requests served by a 2-replica fleet
+    emit the exact b=1 generate() tokens; nothing leaks, nothing
+    recompiles in steady state."""
+    net = _tiny_net()
+    prompts = _prompts(rng, (5, 9, 3, 7))
+    cfgs = [dict(max_new_tokens=8),
+            dict(max_new_tokens=6, temperature=0.9, seed=7),
+            dict(max_new_tokens=8, temperature=0.7, top_k=8, seed=3),
+            dict(max_new_tokens=5)]
+    refs = _ref_rows(net, prompts, cfgs)
+    fleet = ServingFleet(net, replicas=2, max_slots=2, page_size=8,
+                         pool_pages=64, max_context=64)
+    outs = fleet.run([(p, SamplingParams(**c))
+                      for p, c in zip(prompts, cfgs)])
+    assert [o.token_ids for o in outs] == refs
+    assert all(o.ok for o in outs)
+    assert fleet.steady_state_recompiles() == 0
+    assert all(v == 0 for v in fleet.per_replica_recompiles().values())
+    assert fleet.leaked_pages() == 0
+    fleet.close()
+
+
+def test_fleet_migration_mid_decode_exact(rng):
+    """A request migrated mid-decode (source slot freed, rng chain
+    replayed from host truth, resume-prefill on the target) finishes
+    bit-identical to the never-migrated run — greedy and seeded."""
+    net = _tiny_net()
+    prompts = _prompts(rng, (6, 8))
+    cfgs = [dict(max_new_tokens=10),
+            dict(max_new_tokens=10, temperature=0.8, seed=5)]
+    refs = _ref_rows(net, prompts, cfgs)
+    fleet = ServingFleet(net, replicas=2, max_slots=2, page_size=8,
+                         pool_pages=64, max_context=64)
+    rids = [fleet.add_request(p, SamplingParams(**c))
+            for p, c in zip(prompts, cfgs)]
+    before = int(monitor.counter("serving.fleet.migrations").get())
+    outs = []
+    migrated = set()
+    for step in range(200):
+        outs.extend(fleet.step())
+        if step >= 2:
+            for rid in rids:
+                req = fleet.requests.get(rid)
+                if rid not in migrated and req is not None \
+                        and req.generated \
+                        and fleet._home.get(rid) is not None:
+                    assert fleet.migrate_request(rid)
+                    migrated.add(rid)
+                    assert fleet.num_parked >= 1
+        if len(outs) == len(rids):
+            break
+    assert migrated
+    got = {o.req_id: o.token_ids for o in outs}
+    for rid, ref in zip(rids, refs):
+        assert got[rid] == ref
+    assert int(monitor.counter("serving.fleet.migrations").get()) \
+        > before
+    assert fleet.steady_state_recompiles() == 0
+    assert fleet.leaked_pages() == 0
+    fleet.close()
+
+
+def test_fleet_spec_prefix_preempt_migration_exact(rng):
+    """THE exactness matrix: prefix hits + speculative decoding +
+    seeded sampling on, a request migrated mid-decode, pool sized so
+    the target replica must PREEMPT (resume-prefill round trip) — the
+    outputs stay bit-identical to the never-migrated b=1 reference."""
+    net = _tiny_net()
+    paddle.seed(1)
+    dcfg = LlamaConfig.tiny(vocab=64, hidden=64, layers=1, heads=4)
+    dcfg.use_flash_attention = False
+    draft = LlamaForCausalLM(dcfg)
+    draft.eval()
+    sys_block = rng.integers(0, 64, (16,))
+    prompts = [np.concatenate(
+        [sys_block, rng.integers(0, 64, (4 + i,))]).astype(np.int64)
+        for i in range(5)]
+    cfgs = [dict(max_new_tokens=10,
+                 temperature=(0.8 if i % 2 else 0.0), seed=100 + i)
+            for i in range(5)]
+    refs = _ref_rows(net, prompts, cfgs)
+    p0 = int(monitor.counter("serving.preemptions").get())
+    # pool deliberately tight: decode growth must preempt
+    fleet = ServingFleet(net, replicas=2, max_slots=2, page_size=8,
+                         pool_pages=9, max_context=48,
+                         draft_model=draft, spec_k=3)
+    rids = [fleet.add_request(p, SamplingParams(**c))
+            for p, c in zip(prompts, cfgs)]
+    outs = []
+    migrated = False
+    for step in range(500):
+        outs.extend(fleet.step())
+        if not migrated and step >= 2:
+            for rid in rids:
+                req = fleet.requests.get(rid)
+                if req is not None and req.generated \
+                        and fleet._home.get(rid) is not None:
+                    assert fleet.migrate_request(rid)
+                    migrated = True
+                    break
+        if len(outs) == len(rids):
+            break
+    assert migrated
+    got = {o.req_id: o.token_ids for o in outs}
+    for rid, ref in zip(rids, refs):
+        assert got[rid] == ref
+    # a real pool-pressure preemption happened beyond the migration's
+    # own preemption count (pool 9 pages cannot hold 2 full slots)
+    assert int(monitor.counter("serving.preemptions").get()) - p0 >= 2
+    assert fleet.prefix_hit_rate > 0       # shared system block reused
+    assert fleet.steady_state_recompiles() == 0
+    assert all(v == 0 for v in fleet.per_replica_recompiles().values())
+    assert fleet.leaked_pages() == 0
+    fleet.close()
+
+
+def test_extract_request_hook(rng):
+    """Engine.extract_request removes the request wholesale (pages
+    freed, queue/table purged) and its device-pulled rng chain equals
+    the host replay — the contract fleet migration/failover rests
+    on."""
+    net = _tiny_net()
+    p = _prompts(rng, (5,))[0]
+    eng = Engine(net, max_slots=1, page_size=8, pool_pages=16,
+                 max_context=32)
+    rid = eng.add_request(p, SamplingParams(max_new_tokens=8,
+                                            temperature=0.9, seed=11))
+    for _ in range(4):
+        eng.step()
+    n_gen = len(eng.requests[rid].generated)
+    req = eng.extract_request(rid)               # device-key pull
+    assert req is not None and req.state == "PREEMPTED"
+    np.testing.assert_array_equal(
+        req.key, replay_rng_key(11, n_gen, 0.9))
+    assert rid not in eng.requests
+    assert not req.pages and req.slot is None
+    assert eng.leaked_pages() == 0
+    assert eng.extract_request(rid) is None      # already gone
+    assert eng.extract_request(10**6) is None
+    eng.close()
+
+
+def test_session_routing_beats_round_robin(rng):
+    """Fleet-wide prefix_hit_rate under session-aware routing
+    measurably beats the round-robin baseline on a session-heavy
+    workload (same prompts, same replicas), and warm routes are
+    counted."""
+    net = _tiny_net()
+    prompts = _session_prompts(rng)
+    rates = {}
+    for router in ("session", "round_robin"):
+        fleet = ServingFleet(net, replicas=2, max_slots=2, page_size=8,
+                             pool_pages=64, max_context=64,
+                             router=router)
+        # STAGGERED arrivals (one per tick, the fixture's shape): a
+        # session's first prefill must land in a cache before the next
+        # same-session request routes, or there is nothing to be warm
+        done = 0
+        i = 0
+        for _ in range(600):
+            if i < len(prompts):
+                fleet.add_request(prompts[i],
+                                  SamplingParams(max_new_tokens=4))
+                i += 1
+            done += len(fleet.step())
+            if done == len(prompts):
+                break
+        assert done == len(prompts)
+        rates[router] = fleet.prefix_hit_rate
+        if router == "session":
+            warm = sum(st["routed_warm"]
+                       for st in fleet.replica_stats.values())
+            assert warm > 0
+        fleet.close()
+    assert rates["session"] > rates["round_robin"], rates
+
+
+def test_fleet_tenant_fairness(rng):
+    """A flooding tenant can slow — never starve — another tenant:
+    the sparse tenant's single request finishes well before the
+    flood drains."""
+    net = _tiny_net()
+    flood = _prompts(rng, (5,) * 10)
+    sparse = _prompts(rng, (6,))[0]
+    fleet = ServingFleet(net, replicas=2, max_slots=1, page_size=8,
+                         pool_pages=32, max_context=32)
+    for p in flood:
+        fleet.add_request(p, SamplingParams(max_new_tokens=6),
+                          tenant="flood")
+    sparse_rid = fleet.add_request(
+        sparse, SamplingParams(max_new_tokens=6), tenant="sparse")
+    done_at = {}
+    for step in range(400):
+        for out in fleet.step():
+            done_at[out.req_id] = step
+        if len(done_at) == 11:
+            break
+    assert len(done_at) == 11
+    flood_last = max(s for rid, s in done_at.items()
+                     if rid != sparse_rid)
+    assert done_at[sparse_rid] < flood_last
+    fleet.close()
+
+
+def test_kill_replica_failover_exact(rng):
+    """A replica killed mid-trace (pools and device state gone) loses
+    nothing: its requests re-admit elsewhere from host truth alone and
+    every request finishes token-exact; the last replica can't be
+    killed."""
+    net = _tiny_net()
+    prompts = _prompts(rng, (5, 9, 3, 7, 6, 8))
+    cfgs = [dict(max_new_tokens=n,
+                 temperature=(0.9 if i % 2 else 0.0), seed=i)
+            for i, n in enumerate((8, 6, 8, 5, 7, 6))]
+    refs = _ref_rows(net, prompts, cfgs)
+    fleet = ServingFleet(net, replicas=2, max_slots=2, page_size=8,
+                         pool_pages=64, max_context=64)
+    rids = [fleet.add_request(p, SamplingParams(**c))
+            for p, c in zip(prompts, cfgs)]
+    outs = []
+    for step in range(300):
+        outs.extend(fleet.step())
+        if step == 3:
+            n = fleet.kill_replica(1)
+            assert n >= 1                 # it was serving something
+            assert fleet.num_replicas == 1
+        if len(outs) == len(rids):
+            break
+    got = {o.req_id: o.token_ids for o in outs}
+    for rid, ref in zip(rids, refs):
+        assert got[rid] == ref
+    with pytest.raises(RuntimeError):
+        fleet.kill_replica(0)             # last replica must serve on
+    assert fleet.steady_state_recompiles() == 0
+    assert fleet.leaked_pages() == 0
+    fleet.close()
+
+
+def test_heartbeat_stall_failover(rng):
+    """A replica whose heartbeat stalls WHILE the driver keeps
+    stepping is wedged: it is killed and failed over, and its requests
+    still finish token-exact. A paused DRIVER (nobody stepping) ages
+    every heartbeat out together — that must NOT self-inflict a
+    failover: flags clear and re-arm on the next tick."""
+    import time
+    net = _tiny_net()
+    prompts = _prompts(rng, (5, 7, 6, 8))
+    cfgs = [dict(max_new_tokens=8)] * 4
+    refs = _ref_rows(net, prompts, cfgs)
+    fleet = ServingFleet(net, replicas=2, max_slots=2, page_size=8,
+                         pool_pages=64, max_context=64,
+                         heartbeat_timeout=0.3)
+    rids = [fleet.add_request(p, SamplingParams(**c))
+            for p, c in zip(prompts, cfgs)]
+    for _ in range(2):
+        fleet.step()                      # warm the executables
+    # paused driver: every heartbeat fires, nothing may be killed
+    time.sleep(0.7)
+    fleet.step()
+    assert fleet.num_replicas == 2
+    deaths0 = int(
+        monitor.counter("serving.fleet.replica_deaths").get())
+    # wedge replica 1: its heartbeat stops ticking while the driver
+    # keeps stepping at normal cadence
+    fleet._heartbeats[1].tick = lambda: None
+    outs = []
+    deadline = time.time() + 15.0
+    while time.time() < deadline:
+        outs.extend(fleet.step())
+        if fleet.num_replicas == 1:
+            break
+        time.sleep(0.02)
+    assert fleet.num_replicas == 1        # the wedged replica died
+    assert int(monitor.counter(
+        "serving.fleet.replica_deaths").get()) > deaths0
+    for _ in range(300):
+        outs.extend(fleet.step())
+        if len(outs) == len(rids):
+            break
+    got = {o.req_id: o.token_ids for o in outs}
+    for rid, ref in zip(rids, refs):
+        assert got[rid] == ref
+    fleet.close()
+
+
+def test_autoscale_up_down_no_drops(rng):
+    """Queue pressure scales the fleet up; sustained low load scales
+    it back down via drain-migration — every request finishes
+    token-exact (a scale-down never drops one), and both events land
+    in scale_log + the scale_events counter."""
+    net = _tiny_net()
+    prompts = _prompts(rng, (5, 9, 3, 7, 6, 8))
+    cfgs = [dict(max_new_tokens=n,
+                 temperature=(0.8 if i % 2 else 0.0), seed=i)
+            for i, n in enumerate((8, 6, 8, 5, 7, 6))]
+    refs = _ref_rows(net, prompts, cfgs)
+    c0 = int(monitor.counter("serving.fleet.scale_events").get())
+    fleet = ServingFleet(
+        net, replicas=1, max_slots=2, page_size=8, pool_pages=64,
+        max_context=64,
+        autoscale=AutoscalePolicy(min_replicas=1, max_replicas=2,
+                                  scale_up_queue_depth=2, patience=1,
+                                  scale_down_patience=3, cooldown=2))
+    rids = [fleet.add_request(p, SamplingParams(**c))
+            for p, c in zip(prompts, cfgs)]
+    outs = []
+    for _ in range(400):
+        outs.extend(fleet.step())
+        if len(outs) == len(rids) and fleet.num_replicas == 1:
+            break
+    got = {o.req_id: o.token_ids for o in outs}
+    for rid, ref in zip(rids, refs):
+        assert got[rid] == ref
+    actions = [e["action"] for e in fleet.scale_log]
+    assert "up" in actions and "down" in actions
+    assert int(monitor.counter(
+        "serving.fleet.scale_events").get()) - c0 >= 2
+    assert fleet.steady_state_recompiles() == 0
+    assert fleet.leaked_pages() == 0
+    fleet.close()
+
+
+def test_fleet_snapshot_restore_parked_migration(rng):
+    """snapshot() round-trips requests PARKED mid-migration (extracted
+    from the source, not yet re-admitted): a fresh fleet restores the
+    host truth and finishes every request token-exact. Restoring onto
+    a busy fleet refuses."""
+    net = _tiny_net()
+    prompts = _prompts(rng, (5, 9, 3))
+    cfgs = [dict(max_new_tokens=8),
+            dict(max_new_tokens=6, temperature=0.9, seed=7),
+            dict(max_new_tokens=8)]
+    refs = _ref_rows(net, prompts, cfgs)
+    fleet = ServingFleet(net, replicas=2, max_slots=2, page_size=8,
+                         pool_pages=64, max_context=64)
+    rids = [fleet.add_request(p, SamplingParams(**c))
+            for p, c in zip(prompts, cfgs)]
+    for _ in range(3):
+        fleet.step()
+    victim = next(rid for rid in rids
+                  if fleet.requests.get(rid) is not None
+                  and fleet.requests[rid].generated
+                  and fleet._home.get(rid) is not None)
+    assert fleet.migrate_request(victim)
+    assert fleet.num_parked == 1
+    snap = fleet.snapshot()
+    assert any(e["parked"] for e in snap["requests"])
+    with pytest.raises(RuntimeError):
+        fleet.restore(snap)               # busy fleet refuses
+    fleet.close()
+    fresh = ServingFleet(net, replicas=2, max_slots=2, page_size=8,
+                         pool_pages=64, max_context=64)
+    n = fresh.restore(snap)
+    assert n == len(rids)
+    outs = []
+    for _ in range(300):
+        outs.extend(fresh.step())
+        if len(outs) == n:
+            break
+    got = {o.req_id: o.token_ids for o in outs}
+    for rid, ref in zip(rids, refs):
+        assert got[rid] == ref
+    assert fresh.steady_state_recompiles() == 0
+    fresh.close()
+
+
+def test_drain_and_undrain(rng):
+    """drain_replica migrates every live request off and blocks new
+    dispatches to the drained replica until undrain; tokens stay
+    exact throughout."""
+    net = _tiny_net()
+    prompts = _prompts(rng, (5, 7, 6, 8))
+    cfgs = [dict(max_new_tokens=6)] * 4
+    refs = _ref_rows(net, prompts, cfgs)
+    fleet = ServingFleet(net, replicas=2, max_slots=2, page_size=8,
+                         pool_pages=64, max_context=64)
+    rids = [fleet.add_request(p, SamplingParams(**c))
+            for p, c in zip(prompts[:2], cfgs[:2])]
+    for _ in range(2):
+        fleet.step()
+    loaded = next(i for i, w in enumerate(fleet._replicas)
+                  if w is not None and w.requests)
+    moved = fleet.drain_replica(loaded)
+    assert moved >= 1
+    assert not fleet._replicas[loaded].requests
+    rids += [fleet.add_request(p, SamplingParams(**c))
+             for p, c in zip(prompts[2:], cfgs[2:])]
+    outs = []
+    for _ in range(300):
+        outs.extend(fleet.step())
+        # a draining replica takes no new work
+        assert not fleet._replicas[loaded].requests
+        if len(outs) == len(rids):
+            break
+    got = {o.req_id: o.token_ids for o in outs}
+    for rid, ref in zip(rids, refs):
+        assert got[rid] == ref
+    fleet.undrain_replica(loaded)
+    more = fleet.add_request(prompts[0],
+                             SamplingParams(max_new_tokens=6))
+    outs = []
+    for _ in range(100):
+        outs.extend(fleet.step())
+        if outs:
+            break
+    assert outs[0].req_id == more and outs[0].token_ids == refs[0]
+    assert fleet.leaked_pages() == 0
+    fleet.close()
+
+
+def test_fleet_validates_requests(rng):
+    net = _tiny_net()
+    with pytest.raises(ValueError):
+        ServingFleet(net, replicas=0)
+    with pytest.raises(ValueError):
+        ServingFleet(net, replicas=1, router="hash")
+    fleet = ServingFleet(net, replicas=1, max_slots=1, page_size=8,
+                         pool_pages=8, max_context=32)
+    with pytest.raises(ValueError):
+        fleet.add_request(np.zeros((0,), np.int64))       # empty
+    with pytest.raises(ValueError):
+        fleet.add_request(
+            rng.integers(0, 64, (2, 5)))                  # batch
+    with pytest.raises(ValueError):
+        fleet.add_request(rng.integers(0, 64, (30,)),
+                          SamplingParams(max_new_tokens=64))
+    with pytest.raises(ValueError):
+        fleet.migrate_request(0, dst=3)   # no such replica
+    assert fleet.migrate_request(10**6) is False
+    fleet.close()
+
+
+def test_serving_replay_fleet_with_replica_kill(rng, capsys):
+    """tools/serving_replay.py --replicas: per-replica utilization +
+    routing counts in the report, and the --kill-replica failover
+    chaos gate holds survivors token-exact (exit 0; a diverging
+    survivor would exit 9) on the session-heavy fixture."""
+    import json
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, "tools"))
+    import serving_replay
+    trace = os.path.join(os.path.dirname(__file__), "fixtures",
+                         "serving_trace_fleet.jsonl")
+    rc = serving_replay.main([
+        trace, "--replicas", "2", "--kill-replica", "1:12",
+        "--expect-prefix-hit-rate", "0.8", "--json"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    assert rc == 0
+    report = json.loads(out)
+    fl = report["fleet"]
+    assert fl["routed_warm"] > fl["routed_cold"]
+    assert fl["replica_deaths"] == 1 and fl["readmitted"] >= 1
+    assert set(fl["replicas_table"]) == {"replica0", "replica1"}
+    assert not fl["replicas_table"]["replica1"]["alive"]
+    rk = report["replica_kill"]
+    assert rk["survivors_exact"] and rk["leaked_pages"] == 0
+    assert report["steady_state_recompiles"] == 0
+    assert report["prefix_hit_rate"] >= 0.8
+
+
+@pytest.mark.slow
+def test_serving_replay_fleet_routing_gate(rng, capsys):
+    """The routing win measured end-to-end through the replay tool:
+    session routing's fleet-wide prefix_hit_rate beats round_robin's
+    on the session-heavy fixture (the ROADMAP item 2 gate)."""
+    import json
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    os.pardir, "tools"))
+    import serving_replay
+    trace = os.path.join(os.path.dirname(__file__), "fixtures",
+                         "serving_trace_fleet.jsonl")
+    rates = {}
+    for route in ("session", "round_robin"):
+        rc = serving_replay.main([
+            trace, "--replicas", "2", "--route", route, "--json"])
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        assert rc == 0
+        rates[route] = json.loads(out)["prefix_hit_rate"]
+    assert rates["session"] > rates["round_robin"], rates

@@ -12,6 +12,24 @@ Run: python tools/serving_replay.py trace.jsonl [--max-slots 4]
          [--chaos] [--fault-seed 0] [--fault-rate 0.05]
          [--disagg --prefill-workers N --decode-workers M]
          [--kill-worker decode:1:40]
+         [--replicas N --route session] [--kill-replica 1:40]
+
+``--replicas N`` replays against the ELASTIC FLEET
+(inference/fleet.py, docs/SERVING.md "Elastic fleet"): N whole engine
+replicas behind the session-aware router (``--route`` picks the
+policy — ``session`` / ``least_loaded`` / ``round_robin``, the
+baselines the routing win is measured against). The report grows a
+per-replica utilization table (busy fraction, warm/cold routing
+counts, per-replica prefix hit rate) plus fleet counters
+(``serving.fleet.*``). Trace lines may carry ``"session": "name"`` —
+each session gets its OWN system token block (drawn once per session
+from the trace rng), so same-session requests share a prefix that
+session routing can keep warm on one replica while round-robin
+scatters it. ``--kill-replica INDEX:STEP`` (repeatable) is the fleet
+failover chaos gate: the trace first runs clean to record reference
+tokens, then with the replica death(s) — exit code 9 when any
+surviving request's output diverges from the clean run, pages leak on
+a live replica, or the invariant audit ends dirty.
 
 ``--disagg`` replays against the DISAGGREGATED engine
 (inference/disagg.py, docs/SERVING.md "Disaggregated serving"):
@@ -158,6 +176,27 @@ def main(argv=None) -> int:
                          "kill(s) — exit 8 when any survivor's output "
                          "diverges, pages leak, or the audit ends "
                          "dirty. Repeatable.")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="replay against the ELASTIC FLEET "
+                         "(inference/fleet.py): this many whole engine "
+                         "replicas behind the session-aware router; "
+                         "the report adds per-replica utilization + "
+                         "routing/migration counts (docs/SERVING.md "
+                         "'Elastic fleet')")
+    ap.add_argument("--route", default=None,
+                    choices=("session", "least_loaded", "round_robin"),
+                    help="fleet routing policy under --replicas "
+                         "(default session; round_robin/least_loaded "
+                         "are the baselines session-aware routing is "
+                         "measured against)")
+    ap.add_argument("--kill-replica", action="append", default=[],
+                    metavar="INDEX:STEP",
+                    help="replica-death chaos under --replicas (e.g. "
+                         "1:40): the trace runs once clean to record "
+                         "reference tokens, then with the kill(s) — "
+                         "exit 9 when any survivor's output diverges, "
+                         "pages leak, or the audit ends dirty. "
+                         "Repeatable.")
     ap.add_argument("--no-prefix-cache", action="store_true",
                     help="disable shared-prefix KV reuse (the "
                          "cold-prefix baseline)")
@@ -246,6 +285,48 @@ def main(argv=None) -> int:
         print("serving_replay: --kill-worker needs --disagg",
               file=sys.stderr)
         return 2
+    for spec in args.kill_replica:
+        try:
+            idx, step = spec.split(":")
+            kills.append(("replica", int(idx), int(step)))
+        except ValueError:
+            print(f"serving_replay: bad --kill-replica spec {spec!r} "
+                  f"(want INDEX:STEP, e.g. 1:40)", file=sys.stderr)
+            return 2
+    if args.kill_replica and not args.replicas:
+        print("serving_replay: --kill-replica needs --replicas",
+              file=sys.stderr)
+        return 2
+    if args.route is not None and not args.replicas:
+        # same contract as --prefill-workers without --disagg: a
+        # routing baseline silently measured against the single-loop
+        # engine would be a wrong, non-erroring comparison
+        print("serving_replay: --route needs --replicas (without it "
+              "the replay drives the single-loop engine and the "
+              "routing policy would be silently ignored)",
+              file=sys.stderr)
+        return 2
+    if args.route is None:
+        args.route = "session"
+    if args.replicas and args.disagg:
+        print("serving_replay: --replicas and --disagg are exclusive "
+              "(the fleet multiplexes whole engines; disagg splits one "
+              "engine into prefill/decode workers)", file=sys.stderr)
+        return 2
+    if args.replicas:
+        idxs = {i for k, i, _ in kills if k == "replica"}
+        bad = sorted(i for i in idxs if not 0 <= i < args.replicas)
+        if bad:
+            print(f"serving_replay: --kill-replica index(es) {bad} out "
+                  f"of range (fleet size {args.replicas})",
+                  file=sys.stderr)
+            return 2
+        if idxs and len(idxs) >= args.replicas:
+            print(f"serving_replay: --kill-replica would kill every "
+                  f"replica ({sorted(idxs)} of {args.replicas}) — the "
+                  f"fleet must keep serving; leave at least one alive",
+                  file=sys.stderr)
+            return 2
     if not args.disagg and (args.prefill_workers != 1
                             or args.decode_workers != 1):
         print("serving_replay: --prefill-workers/--decode-workers "
@@ -312,6 +393,12 @@ def main(argv=None) -> int:
                                 decode_workers=args.decode_workers,
                                 max_slots=args.max_slots,
                                 pool_pages=args.pool_pages, **kw)
+        if args.replicas:
+            from paddle_tpu.inference.fleet import ServingFleet
+            return ServingFleet(net, replicas=args.replicas,
+                                max_slots=args.max_slots,
+                                pool_pages=args.pool_pages,
+                                router=args.route, **kw)
         return Engine(net, max_slots=args.max_slots,
                       pool_pages=args.pool_pages, **kw)
 
@@ -325,11 +412,25 @@ def main(argv=None) -> int:
     # tool versions
     system = (rng.integers(0, args.vocab, (max_sys,)) if max_sys
               else np.zeros((0,), np.int64))
+    # multi-session traces (the fleet's session-routing scenario): a
+    # line with "session": "name" opens with that SESSION's OWN system
+    # block instead of the single shared one — blocks drawn once per
+    # session, in first-appearance order, AFTER the legacy draw so
+    # session-free traces keep their exact historical rng stream
+    session_blocks = {}
+    for r in trace:
+        name = r.get("session")
+        if name is not None and name not in session_blocks:
+            depth = max(int(x.get("system_len", 0)) for x in trace
+                        if x.get("session") == name)
+            session_blocks[name] = rng.integers(0, args.vocab, (depth,))
     prompts = []
     for r in trace:
         sl = min(int(r.get("system_len", 0)), int(r["prompt_len"]))
+        head = (session_blocks[r["session"]] if r.get("session")
+                is not None else system)
         tail = rng.integers(0, args.vocab, (r["prompt_len"] - sl,))
-        prompts.append(np.concatenate([system[:sl], tail])
+        prompts.append(np.concatenate([head[:sl], tail])
                        .astype(np.int64))
     def drive(eng, kills=()):
         """One full trace replay on the virtual clock. Returns None
@@ -362,14 +463,16 @@ def main(argv=None) -> int:
                         deadline_ms=r.get("deadline_ms"),
                         max_queue_steps=r.get("max_queue_steps")),
                     **({"tenant": str(r["tenant"])}
-                       if args.disagg and r.get("tenant") else {}))
+                       if (args.disagg or args.replicas)
+                       and r.get("tenant") else {}))
                 arrival_vt[rid] = r["arrival_ms"]
                 if r.get("tag"):
                     tags[rid] = str(r["tag"])
                 i += 1
             while pending_kills and steps >= pending_kills[0][2]:
                 kind, idx, _ = pending_kills.pop(0)
-                n = eng.kill_worker(kind, idx)
+                n = (eng.kill_replica(idx) if kind == "replica"
+                     else eng.kill_worker(kind, idx))
                 fired_kills.append((kind, idx))
                 print(f"serving_replay: killed {kind}{idx} at step "
                       f"{steps} ({n} request(s) re-admitted)",
@@ -431,13 +534,13 @@ def main(argv=None) -> int:
     if args.chaos:
         from paddle_tpu.inference.reliability import (FAULT_SITES,
                                                       FaultInjector)
-        # with a SCHEDULED kill list, the injector's own worker-death
-        # sites stay disarmed: a chaos kill landing first would either
-        # make the scheduled kill hit the last live worker (RuntimeError
-        # instead of the exit-8 contract) or turn it into a no-op that
-        # reports a failover test that never ran
+        # with a SCHEDULED kill list, the injector's own worker/replica
+        # death sites stay disarmed: a chaos kill landing first would
+        # either make the scheduled kill hit the last live worker
+        # (RuntimeError instead of the exit-8/9 contract) or turn it
+        # into a no-op that reports a failover test that never ran
         sites = (tuple(s for s in FAULT_SITES
-                       if not s.startswith("worker."))
+                       if not s.startswith(("worker.", "replica.")))
                  if kills else None)
         injector = FaultInjector(seed=args.fault_seed,
                                  rate=args.fault_rate, sites=sites)
@@ -485,6 +588,15 @@ def main(argv=None) -> int:
     deltas = {k: int(after.get(k, 0)) - int(before.get(k, 0))
               for k in after
               if k.startswith(("kernels.decode.", "kernels.flash.",
+                               # fleet COUNTERS only — the serving.fleet.*
+                               # namespace also holds gauges (queue_depth,
+                               # replicas, per-replica hit rates) that a
+                               # delta over snapshots would misreport
+                               "serving.fleet.routed_",
+                               "serving.fleet.migrations",
+                               "serving.fleet.replica_deaths",
+                               "serving.fleet.readmitted",
+                               "serving.fleet.scale_events",
                                "serving.preemptions",
                                "serving.prefill_tokens",
                                "serving.prefix_", "serving.spec_",
@@ -530,6 +642,29 @@ def main(argv=None) -> int:
     }
     if eng.decode_fallback_reason:
         report["pallas_ineligible_reason"] = eng.decode_fallback_reason
+    if args.replicas:
+        # the elastic-fleet report block: per-replica busy-step
+        # utilization, warm/cold routing counts and per-replica prefix
+        # hit rates — the first thing to read when fleet-wide
+        # prefix_hit_rate regresses is whether the router scattered a
+        # session across replicas
+
+        def cdelta(key):
+            return int(after.get(key, 0)) - int(before.get(key, 0))
+
+        report["fleet"] = {
+            "replicas": args.replicas,
+            "route": args.route,
+            "routed_warm": cdelta("serving.fleet.routed_warm"),
+            "routed_cold": cdelta("serving.fleet.routed_cold"),
+            "migrations": cdelta("serving.fleet.migrations"),
+            "replica_deaths": cdelta("serving.fleet.replica_deaths"),
+            "readmitted": cdelta("serving.fleet.readmitted"),
+            "scale_events": cdelta("serving.fleet.scale_events"),
+            "replica_kills": [f"{i}:{s}" for k, i, s in kills
+                              if k == "replica"],
+            "replicas_table": eng.utilization(),
+        }
     if args.disagg:
         # the disaggregated report block: per-worker busy-step
         # utilization + migration counts (the first thing to read when
@@ -568,13 +703,14 @@ def main(argv=None) -> int:
 
     kill_failed = False
     if kills:
-        # the failover contract: a worker death may slow requests,
-        # never change a survivor's tokens, leak pages, or leave the
-        # audit dirty
+        # the failover contract: a worker/replica death may slow
+        # requests, never change a survivor's tokens, leak pages, or
+        # leave the audit dirty
         mismatched = survivors_vs_baseline()
         leaked = residual_pages(eng)
         findings = eng.check_invariants()
-        report["worker_kill"] = {
+        kill_key = "replica_kill" if args.replicas else "worker_kill"
+        report[kill_key] = {
             "kills": [f"{k}:{i}:{s}" for k, i, s in kills],
             "survivors_exact": not mismatched,
             "mismatched_request_ids": mismatched,
@@ -627,6 +763,24 @@ def main(argv=None) -> int:
                 f"{k} x{v}" for k, v in sorted(failures.items())))
         print(f"  prefix_hit_rate {report['prefix_hit_rate']}  "
               f"spec_accept_rate {report['spec_accept_rate']}")
+        if args.replicas:
+            fl = report["fleet"]
+            print(f"  fleet: {fl['replicas']} replicas "
+                  f"(route={fl['route']}), routed warm/cold "
+                  f"{fl['routed_warm']}/{fl['routed_cold']}, "
+                  f"{fl['migrations']} migrations, "
+                  f"{fl['replica_deaths']} deaths / "
+                  f"{fl['readmitted']} re-admitted, "
+                  f"{fl['scale_events']} scale events")
+            for name, st in sorted(fl["replicas_table"].items()):
+                dead = "" if st["alive"] else "  [DEAD]"
+                hr = st["prefix_hit_rate"]
+                print(f"    {name:10s} util {st['utilization']:6.2%}  "
+                      f"warm {st['routed_warm']:3d}  "
+                      f"cold {st['routed_cold']:3d}  "
+                      f"hit_rate "
+                      f"{hr if hr is not None else '-':>6}  "
+                      f"finished {st['finished']:3d}{dead}")
         if args.disagg:
             dg = report["disagg"]
             print(f"  disagg: {dg['prefill_workers']}p+"
@@ -641,8 +795,9 @@ def main(argv=None) -> int:
                       f"pages_migrated {st['pages_migrated']:4d}"
                       f"{dead}")
         if kills:
-            wk = report["worker_kill"]
-            print(f"  worker-kill: {', '.join(wk['kills'])} — "
+            wk = report["replica_kill" if args.replicas
+                        else "worker_kill"]
+            print(f"  kill: {', '.join(wk['kills'])} — "
                   f"exact={wk['survivors_exact']} "
                   f"leaked_pages={wk['leaked_pages']}")
         if args.chaos:
@@ -722,15 +877,18 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 6
     if kill_failed:
-        wk = report["worker_kill"]
-        print(f"serving_replay: --kill-worker FAILED — "
+        flag = "--kill-replica" if args.replicas else "--kill-worker"
+        wk = report["replica_kill" if args.replicas else "worker_kill"]
+        print(f"serving_replay: {flag} FAILED — "
               f"mismatched survivors {wk['mismatched_request_ids']}, "
               f"leaked_pages {wk['leaked_pages']}, "
               f"invariant findings {wk['invariant_findings']} — a "
-              f"worker death may slow requests, never change a "
-              f"survivor's tokens (docs/SERVING.md 'Disaggregated "
-              f"serving')", file=sys.stderr)
-        return 8
+              f"{'replica' if args.replicas else 'worker'} death may "
+              f"slow requests, never change a survivor's tokens "
+              f"(docs/SERVING.md "
+              f"{'Elastic fleet' if args.replicas else 'Disaggregated serving'!r})",
+              file=sys.stderr)
+        return 9 if args.replicas else 8
     return 0
 
 
