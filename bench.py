@@ -794,6 +794,30 @@ def bench_flashmask_8k(b=4, h=8, s=8192, d=128, n=20):
     return (time.perf_counter() - t0) / n * 1e3
 
 
+def bench_plan_search(n_devices=8):
+    """Auto-parallel planner wall time + calibration: search the full
+    DP/TP/PP/sharding/SEP plan space for the 1B headline model at
+    `n_devices` chips (enumerate -> shard_lint prune -> abstract-traced
+    roofline ranking, all device-free), and score the planner's
+    rank-correlation against the frozen 13-dryrun-config ledger.
+    Returns (search_ms, rank_corr, best_plan_str). Hardware-independent
+    by construction — the planner never touches a device."""
+    from paddle_tpu.analysis import planner
+
+    spec = planner.ModelSpec.llama_1b(global_batch=12 * n_devices)
+    t0 = time.perf_counter()
+    ranked = planner.search_plans(spec, n_devices)
+    search_ms = (time.perf_counter() - t0) * 1e3
+    if not ranked or not ranked[0].ok:
+        raise RuntimeError("planner found no legal 1B plan")
+    rep = planner.calibration_report()
+    if not rep["passed"]:
+        raise RuntimeError(
+            f"planner calibration failed: corr={rep['spearman']:.3f} "
+            f"families={rep['families_ok']}")
+    return search_ms, rep["spearman"], ranked[0].plan.describe()
+
+
 def bench_resnet50(batch=256, n_steps=10):
     """ResNet-50 ImageNet-shape train step (BASELINE config 2 metric:
     images/sec, single chip — the 8->64-chip scaling axis is covered by
@@ -1108,6 +1132,13 @@ def main():
         ms = bench_flashmask_8k()
         result["extras"]["flashmask_seq8k_docmask_ms"] = round(ms, 2)
 
+    def add_plan_search():
+        ms, corr, best = bench_plan_search()
+        result["extras"]["llama_1b_plan_search_ms"] = round(ms, 1)
+        result["extras"]["llama_1b_plan_predicted_vs_dryrun_rank_corr"] \
+            = round(corr, 3)
+        result["extras"]["llama_1b_plan_best"] = best
+
     # (name, runner, wall-clock cost estimate in seconds: compile+measure
     # on the tunneled chip, cold cache — estimates from the round-4
     # dress-rehearsal runs). Ordered so every BASELINE config (4-long-ctx,
@@ -1140,6 +1171,7 @@ def main():
         ("llama_serving_fleet", add_serving_fleet, 420),
         ("llama_serving_tp2", add_serving_tp2, 300),
         ("flashmask_8k", add_flashmask, 90),
+        ("plan_search", add_plan_search, 60),
     ]
     skipped = []
     for name, run, est in extras:

@@ -32,6 +32,9 @@ from .findings import (AST_RULES, ERROR, INFO, JAXPR_RULES,  # noqa: F401
 from .jaxpr_lint import (lint_closed_jaxpr, lint_static_args,  # noqa: F401
                          lint_static_function, lint_train_step,
                          lint_traceable, to_shape_struct)
+from .planner import (MachineSpec, ModelSpec, Plan,  # noqa: F401
+                      ScoredPlan, best_plan, calibration_report,
+                      plan_serving, score_plan, search_plans)
 from .shard_lint import (lint_pipeline, lint_records,  # noqa: F401
                          lint_sharded)
 

@@ -89,12 +89,17 @@ def axis_sizes(mesh) -> Dict[str, int]:
     return mesh_axis_sizes(mesh)
 
 
-def _group_size(eqn, sizes: Dict[str, int]) -> int:
+def _eqn_axes(eqn) -> tuple:
+    """Mesh axis names a collective eqn moves data over."""
     axes = eqn.params.get("axes") or eqn.params.get("axis_name") or ()
     if not isinstance(axes, (tuple, list)):
         axes = (axes,)
+    return tuple(str(ax) for ax in axes)
+
+
+def _group_size(eqn, sizes: Dict[str, int]) -> int:
     n = 1
-    for ax in axes:
+    for ax in _eqn_axes(eqn):
         n *= int(sizes.get(ax, 1))
     groups = eqn.params.get("axis_index_groups")
     if groups:
@@ -137,6 +142,10 @@ class CostEstimate:
         default_factory=dict)
     collective_calls: Dict[str, int] = dataclasses.field(
         default_factory=dict)
+    # same bytes keyed by the mesh axes they ride ("dp", "dp,sharding",
+    # ...) — the planner's tier split (ICI vs DCN) reads this
+    collective_bytes_by_axis: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
     peak_hbm_bytes: float = 0.0
     n_devices: int = 1
     # qualifier printed with the table, e.g. the GSPMD-auto caveat (the
@@ -147,12 +156,29 @@ class CostEstimate:
     def total_collective_bytes(self) -> float:
         return float(sum(self.collective_bytes.values()))
 
+    def tier_bytes(self, dcn_axes=()) -> "tuple[float, float]":
+        """Split the per-rank collective bytes into (ici, dcn) tiers: a
+        collective whose group touches ANY axis in `dcn_axes` is charged
+        to the DCN tier wholesale (its ring spans slices, so the slow
+        hop gates the whole rotation)."""
+        dcn_axes = set(dcn_axes)
+        ici = dcn = 0.0
+        for key, b in self.collective_bytes_by_axis.items():
+            if dcn_axes and set(key.split(",")) & dcn_axes:
+                dcn += b
+            else:
+                ici += b
+        return ici, dcn
+
     def merge(self, other: "CostEstimate") -> "CostEstimate":
         self.flops += other.flops
         for k, v in other.collective_bytes.items():
             self.collective_bytes[k] = self.collective_bytes.get(k, 0) + v
         for k, v in other.collective_calls.items():
             self.collective_calls[k] = self.collective_calls.get(k, 0) + v
+        for k, v in other.collective_bytes_by_axis.items():
+            self.collective_bytes_by_axis[k] = \
+                self.collective_bytes_by_axis.get(k, 0) + v
         self.peak_hbm_bytes = max(self.peak_hbm_bytes, other.peak_hbm_bytes)
         self.n_devices = max(self.n_devices, other.n_devices)
         self.note = self.note or other.note
@@ -163,6 +189,7 @@ class CostEstimate:
             "flops": self.flops,
             "collective_bytes": dict(self.collective_bytes),
             "collective_calls": dict(self.collective_calls),
+            "collective_bytes_by_axis": dict(self.collective_bytes_by_axis),
             "total_collective_bytes": self.total_collective_bytes,
             "peak_hbm_bytes": self.peak_hbm_bytes,
             "n_devices": self.n_devices,
@@ -258,6 +285,9 @@ def _walk(jaxpr, sizes: Dict[str, int], est: CostEstimate,
                     est.collective_bytes.get(kind, 0.0) + moved
                 est.collective_calls[kind] = \
                     est.collective_calls.get(kind, 0) + int(repeat)
+                axes_key = ",".join(_eqn_axes(eqn)) or "<group>"
+                est.collective_bytes_by_axis[axes_key] = \
+                    est.collective_bytes_by_axis.get(axes_key, 0.0) + moved
                 est.n_devices = max(est.n_devices, n)
         elif name == "dot_general":
             est.flops += _dot_flops(eqn) * repeat

@@ -47,6 +47,11 @@ BUBBLE_FRACTION = "bubble-fraction"
 SEGMENT_MISMATCH = "segment-mismatch"
 MICROBATCH_ARITY = "microbatch-arity"
 
+# planner rules — the auto-parallel plan search (analysis.planner)
+# reuses the shard/pipeline rules above for everything it can express
+# with them; HBM is the one gate with no lint analog
+HBM_OVER_BUDGET = "hbm-over-budget"
+
 AST_RULES = (TENSOR_BOOL_BRANCH, TENSOR_HOST_SYNC, TENSOR_PY_CAST,
              TENSOR_INPLACE, HOST_RNG)
 JAXPR_RULES = (GRAPH_BREAK, TRACE_FAILED, DTYPE_PROMOTION,
@@ -58,6 +63,7 @@ SHARD_RULES = (BAD_AXIS_NAME, UNALIGNED_GROUP, INDIVISIBLE_COLLECTIVE,
                NON_RING_PERMUTE)
 PIPELINE_RULES = (STAGE_IMBALANCE, BUBBLE_FRACTION, SEGMENT_MISMATCH,
                   MICROBATCH_ARITY)
+PLANNER_RULES = (HBM_OVER_BUDGET,)
 
 ERROR = "error"      # will raise at trace time (a _BREAK_ERRORS member)
 WARNING = "warning"  # traces, but recompiles / wastes memory / is wrong

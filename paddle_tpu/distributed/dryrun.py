@@ -330,6 +330,7 @@ def run_dryrun(n_devices: int) -> None:
     _dryrun_llama_sep(jax, n_devices)
     _dryrun_sep_8k(jax, n_devices)
     _dryrun_serving_disagg(jax, n_devices)
+    _dryrun_planner(jax, n_devices)
 
 
 def _dryrun_pipeline(jax, n_devices: int) -> None:
@@ -1092,6 +1093,145 @@ def _dryrun_sep_8k(jax, n_devices: int) -> None:
     print(f"dryrun sep8k ok: sep=2 s={s} loss={dist[0]:.4f} "
           f"gnorm={dist[1]:.4f}")
     _assert_aligned("sep8k", dist, _single_device_losses(jax, run))
+
+
+def _dryrun_planner(jax, n_devices: int) -> None:
+    """Phase 11: the AUTO-PARALLEL PLANNER picks the mesh (ISSUE 14).
+
+    Two halves, mirroring the planner's contract:
+
+    * CALIBRATION GATE (device-free): the planner must reproduce the
+      frozen relative ordering of the 13 align-green dryrun
+      configurations above (rank correlation >= 0.9, every plan-family
+      ordering correct) BEFORE it may pick new ones — a planner that
+      cannot rank the known-good configs has not earned the right to
+      choose.
+    * EXECUTION: search the dp/sharding/mp space for this phase's
+      workload, take the winner, build its CONCRETE mesh + strategy
+      (Plan.build_mesh / Plan.strategy — the executable surface), and
+      train it end-to-end: two steps, loss finite, align-green vs the
+      single-device run, ZERO steady-state recompiles.
+    """
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.analysis import planner
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+        VocabParallelEmbedding)
+    from paddle_tpu.distributed.parallel_step import DistributedTrainStep
+    from paddle_tpu.profiler.stats import CompileTracker
+
+    rep = planner.calibration_report()
+    assert rep["spearman"] >= 0.9, (
+        f"planner calibration: rank correlation {rep['spearman']:.3f} "
+        f"< 0.9 (predicted {rep['order']}, "
+        f"ledger {rep['expected_order']})")
+    assert rep["all_lint_clean"], (
+        "planner calibration: a known-good dryrun config lints dirty: "
+        f"{[r for r in rep['configs'] if not r['ok']]}")
+    assert rep["families_ok"], (
+        f"planner calibration: family ordering wrong: {rep['families']}")
+    n_cfg = len(rep["configs"])
+    n_ok = sum(1 for r in rep["configs"] if r["ok"])
+    n_fam = len(rep["families"])
+    n_fam_ok = sum(1 for f in rep["families"].values() if f["ok"])
+    print(f"dryrun planner calibration ok: {n_ok}/{n_cfg} configs "
+          f"lint-clean, rank corr {rep['spearman']:.2f}, "
+          f"{n_fam_ok}/{n_fam} families")
+
+    vocab, hidden, seq = 64, 32, 8
+    spec = planner.ModelSpec(
+        "dryrun-planner", hidden=hidden, layers=1, seq=seq,
+        global_batch=8, intermediate=4 * hidden, vocab=vocab)
+    n_cands = len(planner.enumerate_plans(
+        spec, n_devices, axes=("dp", "sharding", "mp")))
+    best = planner.best_plan(spec, n_devices,
+                             axes=("dp", "sharding", "mp"))
+    plan = best.plan
+    print(f"dryrun planner pick: {plan.describe()} "
+          f"predicted {best.time.step_s * 1e6:.2f} us/step "
+          f"over {n_cands} candidates")
+
+    mesh_mod.set_mesh(plan.build_mesh())
+    strategy = plan.strategy()
+    fleet.init(is_collective=True, strategy=strategy)
+    dp_total = plan.degree("dp") * plan.degree("sharding")
+    batch = spec.global_batch
+    paddle.seed(0)
+
+    class PlannedLM(nn.Layer):
+        """The hybrid-phase model family: embedding -> TP MLP ->
+        vocab-parallel head + CE (what the spec describes)."""
+
+        def __init__(self):
+            super().__init__()
+            self.embed = VocabParallelEmbedding(vocab, hidden)
+            self.up = ColumnParallelLinear(hidden, 4 * hidden,
+                                           gather_output=False)
+            self.act = nn.GELU()
+            self.down = RowParallelLinear(4 * hidden, hidden,
+                                          input_is_parallel=True)
+            self.head = ColumnParallelLinear(hidden, vocab,
+                                             gather_output=True)
+
+        def forward(self, ids):
+            h = self.embed(ids)
+            h = h + self.down(self.act(self.up(h)))
+            return self.head(h)
+
+    net = PlannedLM()
+    fleet.distributed_model(net)
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.AdamW(1e-3, parameters=net.parameters()))
+    loss_fn = ParallelCrossEntropy()
+
+    def ce(logits, labels):
+        return loss_fn(logits, labels).mean()
+
+    step = DistributedTrainStep(
+        net, ce, opt,
+        sharding_stage=3 if plan.shard_weight_update
+        and plan.degree("sharding") > 1 else 0)
+
+    rng = np.random.default_rng(14)
+    ids_np = rng.integers(0, vocab, (batch, seq)).astype(np.int64)
+    lab_np = rng.integers(0, vocab, (batch, seq)).astype(np.int64)
+    ids, labels = paddle.to_tensor(ids_np), paddle.to_tensor(lab_np)
+
+    tracker = CompileTracker().start()
+    losses = []
+    try:
+        # warmup is TWO steps: step 0 compiles the program, step 1
+        # compiles the committed-layout/donated variant once (the same
+        # warm-up contract the serving engine's fused step has); from
+        # there every step must reuse the executables
+        for _ in range(4):
+            losses.append(float(step(ids, labels).numpy()))
+            tracker.on_step()
+    finally:
+        tracker.stop()
+    assert all(np.isfinite(v) for v in losses), losses
+    recompiles = tracker.steady_state_recompiles(warmup_steps=2)
+    assert recompiles == 0, (
+        f"planner-chosen plan recompiles in steady state: {recompiles} "
+        f"(per-step {tracker.per_step})")
+    print(f"dryrun planner ok: plan={plan.describe()} "
+          f"dp_total={dp_total} loss0={losses[0]:.4f} "
+          f"loss1={losses[1]:.4f} recompiles={recompiles}")
+
+    def single_run():
+        paddle.seed(0)
+        net1 = PlannedLM()
+        opt1 = paddle.optimizer.AdamW(1e-3, parameters=net1.parameters())
+        step1 = paddle.jit.TrainStep(net1, ce, opt1)
+        return [float(step1(paddle.to_tensor(ids_np),
+                            paddle.to_tensor(lab_np)).numpy())
+                for _ in range(4)]
+
+    _assert_aligned("planner", losses,
+                    _single_device_losses(jax, single_run))
 
 
 def _dryrun_serving_disagg(jax, n_devices: int) -> None:

@@ -390,6 +390,23 @@ class DisaggEngine:
                     "busy_steps": 0, "steps": 0, "migrations": 0,
                     "pages_migrated": 0}
 
+    @classmethod
+    def from_plan(cls, model, plan, **overrides) -> "DisaggEngine":
+        """Build a disaggregated engine from a planner serving plan
+        (``analysis.planner.plan_serving`` output, or any dict with
+        ``prefill_workers``/``decode_workers``). ``decode_mp`` is the
+        planner's answer to "how should decode workers shard?" — it
+        takes effect through the ambient mp mesh (install the plan's
+        mesh with ``jax.set_mesh`` before constructing; the workers
+        commit kv-head-sharded pools against it exactly as in the
+        TP-sharded decode path, docs/SERVING.md)."""
+        kw = dict(prefill_workers=int(plan.get("prefill_workers", 1)),
+                  decode_workers=int(plan.get("decode_workers", 1)))
+        kw.update(overrides)
+        eng = cls(model, **kw)
+        eng.plan = dict(plan)
+        return eng
+
     # -- front door ----------------------------------------------------------
 
     def add_request(self, ids, sampling_params=None,

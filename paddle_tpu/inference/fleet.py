@@ -274,6 +274,19 @@ class ServingFleet:
         # driver executable
         replay_rng_key(0, 1, 1.0)
 
+    @classmethod
+    def from_plan(cls, model, plan, **overrides) -> "ServingFleet":
+        """Build a fleet from a planner serving plan
+        (``analysis.planner.plan_serving`` output): ``replicas`` is the
+        plan's chip-group count, ``decode_mp`` the per-replica TP
+        degree (advisory — takes effect through the ambient mp mesh,
+        one mesh group per replica on real hardware)."""
+        kw = dict(replicas=int(plan.get("replicas", 2)))
+        kw.update(overrides)
+        fleet = cls(model, **kw)
+        fleet.plan = dict(plan)
+        return fleet
+
     # -- replica lifecycle ---------------------------------------------------
 
     def _spawn_replica(self, index: Optional[int] = None) -> int:

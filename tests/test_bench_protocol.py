@@ -48,6 +48,8 @@ def stubbed(monkeypatch):
     monkeypatch.setattr(bench, "bench_llama_serving_fleet",
                         lambda **kw: (1100.0, 2050.0, 1.864))
     monkeypatch.setattr(bench, "bench_flashmask_8k", lambda: 9.0)
+    monkeypatch.setattr(bench, "bench_plan_search",
+                        lambda **kw: (450.0, 1.0, "sharding8 zero"))
     return monkeypatch
 
 
@@ -83,7 +85,9 @@ def test_headline_prints_first_and_extras_append(stubbed, capsys,
                 "llama_1b_serving_disagg_tokens_per_sec",
                 "llama_1b_serving_fleet_tokens_per_sec",
                 "llama_1b_serving_fleet_scaling_1to2",
-                "llama_1b_serving_tp2_tokens_per_sec"]:
+                "llama_1b_serving_tp2_tokens_per_sec",
+                "llama_1b_plan_search_ms",
+                "llama_1b_plan_predicted_vs_dryrun_rank_corr"]:
         assert key in last, key
     assert "skipped" not in last
     # the stubbed runs trace no MoE dispatch, so the path attribution
@@ -109,7 +113,8 @@ def test_budget_skips_extras_but_headline_survives(stubbed, capsys,
         "llama_serving_int8kv", "llama_serving_prefix",
         "llama_serving_spec", "llama_serving_longctx",
         "llama_serving_chaos", "llama_serving_disagg",
-        "llama_serving_fleet", "llama_serving_tp2", "flashmask_8k"}
+        "llama_serving_fleet", "llama_serving_tp2", "flashmask_8k",
+        "plan_search"}
     assert "llama_seq2048_mfu" not in lines[-1]["extras"]
 
 

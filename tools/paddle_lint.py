@@ -25,6 +25,14 @@ under a fake 8-device mesh — still zero devices, abstract traces only
 — and must come back clean (the CI regression guard for the
 SPMD/collective rules). `--cost` adds each case's static cost table
 (bytes moved / FLOPs / peak HBM per rank).
+
+`--plan` (also imports paddle_tpu + jax, still device-free) runs the
+auto-parallel planner (analysis.planner) for a model preset over
+`--devices` chips and prints the top `--top` ranked plans with their
+per-plan cost tables — predicted step time split compute/ICI/DCN,
+bubble fraction, peak HBM — plus the rejected candidates' findings.
+`--plan-calibrate` prints the 13-dryrun-config calibration table and
+rank correlation instead. `--format json` emits both machine-readably.
 """
 from __future__ import annotations
 
@@ -55,6 +63,70 @@ def _load(name: str):
     return mod
 
 
+def _plan_spec(name: str):
+    from paddle_tpu.analysis.planner import ModelSpec
+    if name == "llama_1b":
+        return ModelSpec.llama_1b()
+    if name == "llama_tiny":
+        return ModelSpec.llama_tiny(global_batch=8)
+    return ModelSpec("mlp", hidden=1024, layers=8, seq=1,
+                     global_batch=64, intermediate=4096)
+
+
+def _run_plan(args) -> int:
+    """--plan / --plan-calibrate: the auto-parallel planner CLI."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(_ANALYSIS_DIR)))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from paddle_tpu.analysis import planner
+
+    if args.plan_calibrate:
+        rep = planner.calibration_report()
+        if args.format == "json":
+            print(json.dumps(rep, indent=2))
+        else:
+            print("-- planner calibration: 13 align-green dryrun "
+                  "configs --")
+            for r in rep["configs"]:
+                mark = "ok " if r["ok"] else "BAD"
+                print(f"  {mark} {r['name']:<10} "
+                      f"predicted {r['step_s'] * 1e6:>12.2f} us/step")
+            print(f"  predicted order: {' < '.join(rep['order'])}")
+            print(f"  rank correlation vs frozen ledger: "
+                  f"{rep['spearman']:.3f}")
+            for fam, row in rep["families"].items():
+                mark = "ok " if row["ok"] else "BAD"
+                print(f"  {mark} family {fam}: winner "
+                      f"{row['got']} (expected {row['expected']})")
+            print(f"  calibration {'PASSED' if rep['passed'] else 'FAILED'}")
+        return 0 if rep["passed"] else 1
+
+    spec = _plan_spec(args.plan_model)
+    budget = args.plan_budget_gb * 2**30 if args.plan_budget_gb else None
+    ranked = planner.search_plans(spec, args.devices, hbm_budget=budget,
+                                  top_n=args.top, keep_rejected=True)
+    ok = [sp for sp in ranked if sp.ok]
+    bad = [sp for sp in ranked if not sp.ok]
+    if args.format == "json":
+        print(json.dumps({
+            "model": spec.name, "devices": args.devices,
+            "plans": [sp.to_dict() for sp in ok],
+            "rejected": [sp.to_dict() for sp in bad],
+        }, indent=2))
+        return 0 if ok else 1
+    print(f"-- auto-parallel plans: {spec.name} on {args.devices} "
+          f"device(s) --")
+    for i, sp in enumerate(ok):
+        print(f"\n#{i + 1} {sp.plan.describe()}")
+        print(f"  {sp.time.format()}")
+        if sp.cost is not None:
+            print("  " + sp.cost.format_table().replace("\n", "\n  "))
+    if bad:
+        print(f"\n{len(bad)} candidate(s) rejected:")
+        for sp in bad[:10]:
+            print(f"  {sp.plan.describe():<40} {sp.why_rejected()}")
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="paddle_lint", description=__doc__,
@@ -78,7 +150,22 @@ def main(argv=None) -> int:
                     help="with --shard-check: print each zoo case's "
                          "static cost table (bytes/FLOPs/peak HBM)")
     ap.add_argument("--devices", type=int, default=8,
-                    help="fake mesh size for --shard-check (default 8)")
+                    help="fake mesh size for --shard-check / --plan "
+                         "(default 8)")
+    ap.add_argument("--plan", action="store_true",
+                    help="run the auto-parallel plan search (imports "
+                         "paddle_tpu+jax; device-free abstract traces)")
+    ap.add_argument("--plan-model", default="llama_1b",
+                    choices=("llama_1b", "llama_tiny", "mlp"),
+                    help="model preset for --plan (default llama_1b)")
+    ap.add_argument("--plan-budget-gb", type=float, default=None,
+                    help="per-chip HBM budget in GiB for --plan "
+                         "(default: the machine spec's)")
+    ap.add_argument("--top", type=int, default=5,
+                    help="ranked plans to print for --plan (default 5)")
+    ap.add_argument("--plan-calibrate", action="store_true",
+                    help="print the 13-dryrun-config calibration table "
+                         "+ rank correlation instead of searching")
     args = ap.parse_args(argv)
 
     findings_mod = _load("findings")
@@ -87,8 +174,13 @@ def main(argv=None) -> int:
     paths = list(args.paths)
     if args.self_check:
         paths.append(os.path.dirname(_ANALYSIS_DIR))
-    if not paths and not args.shard_check:
-        ap.error("no paths given (or use --self-check / --shard-check)")
+    if not paths and not args.shard_check and not args.plan \
+            and not args.plan_calibrate:
+        ap.error("no paths given (or use --self-check / --shard-check "
+                 "/ --plan)")
+
+    if args.plan or args.plan_calibrate:
+        return _run_plan(args)
 
     findings = []
     for path in paths:
