@@ -728,6 +728,151 @@ def bench_llama_serving_fleet(replicas=2, n_requests=24, max_slots=8,
     return r1, rn, rn / r1
 
 
+def bench_ernie_moe_serving(n_requests=16, max_slots=8, prompt_lo=64,
+                            prompt_hi=192, new_tokens=96,
+                            arrival_rate_hz=40.0, draft_layers=0,
+                            spec_k=4):
+    """ERNIE-MoE continuous-batching serving throughput
+    (docs/SERVING.md "MoE serving"): the SAME fixed-seed arrival-trace
+    drive as ``llama_1b_serving`` but the model is a sparse ERNIE-MoE
+    decoder — 8 experts / top-2 routing every second block, geometry
+    chosen Pallas-eligible (hidden 1024 / expert FFN 2816, both
+    lane-aligned) so decode ticks dispatch through the fused
+    grouped-matmul with no-drop serving capacity and dead-lane
+    masking. The run FAILS if any ``serving.moe.decode_path.
+    fallback.*`` counter moved on a TPU backend — the bench must
+    measure the fused path, never a silently slower scatter.
+
+    draft_layers=K attaches a K-layer DENSE LLaMA draft (same
+    hidden/heads/vocab) and decodes through the draft/verify schedule
+    with ``spec_k`` drafted tokens per tick — the dense-draft/MoE-
+    verifier speculative point (token-identical by construction)."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import monitor
+    from paddle_tpu.inference.engine import Engine
+    from paddle_tpu.text.models import (ErnieMoEConfig,
+                                        ErnieMoEForCausalLM,
+                                        LlamaConfig, LlamaForCausalLM)
+
+    paddle.seed(0)
+    max_ctx = prompt_hi + new_tokens + (spec_k + 1 if draft_layers
+                                        else 0)
+    cfg = ErnieMoEConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+        num_hidden_layers=4, num_attention_heads=16,
+        num_key_value_heads=16, num_experts=8, moe_every=2,
+        max_position_embeddings=max_ctx,
+        use_flash_attention=True)
+    net = ErnieMoEForCausalLM(cfg)
+    net.eval()
+    draft = None
+    if draft_layers:
+        paddle.seed(1)
+        dcfg = LlamaConfig(
+            vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+            intermediate_size=cfg.intermediate_size,
+            num_hidden_layers=int(draft_layers),
+            num_attention_heads=cfg.num_attention_heads,
+            num_key_value_heads=cfg.num_key_value_heads,
+            max_position_embeddings=cfg.max_position_embeddings,
+            use_flash_attention=True)
+        draft = LlamaForCausalLM(dcfg)
+        draft.eval()
+    rng = np.random.default_rng(0)
+    arrivals = np.cumsum(rng.exponential(1.0 / arrival_rate_hz,
+                                         n_requests))
+    prompts = [rng.integers(
+        0, cfg.vocab_size,
+        (int(rng.integers(prompt_lo, prompt_hi)),)).astype(np.int64)
+        for _ in range(n_requests)]
+    before = {k: int(v) for k, v in monitor.snapshot().items()
+              if k.startswith("serving.moe.decode_path.fallback.")}
+    eng = Engine(net, max_slots=max_slots, page_size=128,
+                 prefill_bucket=64, max_context=max_ctx,
+                 draft_model=draft, spec_k=spec_k)
+    _drive_serving_trace(eng, arrivals, prompts, n_requests,
+                         new_tokens)                  # compile pass
+    tok_s = _drive_serving_trace(eng, arrivals, prompts, n_requests,
+                                 new_tokens)
+    if eng.steady_state_recompiles() != 0:
+        raise RuntimeError(
+            f"MoE serving bench recompiled in steady state "
+            f"({eng.steady_state_recompiles()})")
+    # delta around THIS run only — a stale fallback counter from an
+    # earlier bench in the same process must not fail a clean run
+    fallbacks = {k: int(v) - before.get(k, 0)
+                 for k, v in monitor.snapshot().items()
+                 if k.startswith("serving.moe.decode_path.fallback.")
+                 and int(v) - before.get(k, 0)}
+    if fallbacks and jax.default_backend() in ("tpu", "axon"):
+        # a TPU bench that silently measured the scatter path would
+        # record a number that says nothing about the fused kernel
+        raise RuntimeError(
+            f"MoE serving bench fell off the fused Pallas dispatch: "
+            f"{fallbacks} (docs/KERNELS.md eligibility)")
+    return tok_s
+
+
+def bench_bert_embedding(n_requests=64, max_batch=16, bucket=32,
+                         seq_lo=16, seq_hi=128,
+                         arrival_rate_hz=400.0):
+    """Encoder embedding-service throughput (inference/encoder.py,
+    docs/SERVING.md "Embedding service"): a fixed-seed arrival trace
+    of mixed-length mean/CLS requests against the BatchEncoder over
+    bert-base with flash SDPA — bucketed continuous batching, no KV,
+    no pages; the number is REAL (unpadded) tokens/sec across the
+    whole trace, so both batch packing and pad waste show up in it.
+    The run fails on any steady-state recompile: every arrival mix
+    must bounce between the warmed per-bucket executables."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.encoder import BatchEncoder, EmbedParams
+    from paddle_tpu.text.models import BertConfig, BertModel
+
+    paddle.seed(0)
+    cfg = BertConfig(max_position_embeddings=max(512, seq_hi))
+    net = BertModel(cfg)
+    net.eval()
+    rng = np.random.default_rng(0)
+    arrivals = np.cumsum(rng.exponential(1.0 / arrival_rate_hz,
+                                         n_requests))
+    seqs = [rng.integers(
+        0, cfg.vocab_size,
+        (int(rng.integers(seq_lo, seq_hi)),)).astype(np.int64)
+        for _ in range(n_requests)]
+    pools = [("mean" if i % 2 else "cls") for i in range(n_requests)]
+    svc = BatchEncoder(net, max_batch=max_batch, bucket=bucket)
+
+    def run_trace():
+        t0 = time.perf_counter()
+        done = toks = 0
+        i = 0
+        while done < n_requests:
+            now = time.perf_counter() - t0
+            while i < n_requests and arrivals[i] <= now:
+                svc.add_request(seqs[i],
+                                EmbedParams(pooling=pools[i]))
+                i += 1
+            if i < n_requests and svc.idle:
+                time.sleep(max(0.0, arrivals[i]
+                               - (time.perf_counter() - t0)))
+                continue
+            outs = svc.step()
+            done += len(outs)
+            toks += sum(o.tokens for o in outs if o.ok)
+        return toks / (time.perf_counter() - t0)
+
+    run_trace()                 # compile pass (warms every bucket)
+    tok_s = run_trace()
+    if svc.steady_state_recompiles() != 0:
+        raise RuntimeError(
+            f"embedding bench recompiled in steady state "
+            f"({svc.steady_state_recompiles()})")
+    svc.close()
+    return tok_s
+
+
 def bench_llama_seq8k_flashmask(batch=1, seq=8192, docs=4, n_steps=4):
     """Long-context training headline: the 1.07B LLaMA at seq 8192 with
     a packed DOCUMENT mask — the Pallas flashmask kernel end-to-end
@@ -1119,6 +1264,38 @@ def main():
         result["extras"]["llama_1b_serving_fleet_scaling_1to2"] = \
             round(scaling, 3)
 
+    def add_moe_serving():
+        # ERNIE-MoE through the continuous-batching engine: decode
+        # ticks on the fused Pallas grouped-matmul dispatch (no-drop
+        # capacity, dead-lane masking); the moe_dispatch_path
+        # telemetry names what the serving executables baked in
+        tok = _record_counter_paths(
+            _moe_paths, "kernels.moe.decode_path", "moe_serving",
+            bench_ernie_moe_serving)
+        result["extras"]["ernie_moe_serving_tokens_per_sec"] = \
+            round(tok, 1)
+
+    def add_moe_serving_spec():
+        # dense-draft speculative decoding against the MoE verifier:
+        # a 1-layer dense LLaMA drafts 4 tokens/tick, the sparse
+        # target verifies all 5 positions in one forward — token-
+        # identical, faster whenever the draft earns its accept rate
+        tok = _record_counter_paths(
+            _moe_paths, "kernels.moe.decode_path", "moe_serving_spec",
+            lambda: bench_ernie_moe_serving(draft_layers=1, spec_k=4))
+        result["extras"]["ernie_moe_serving_spec_tokens_per_sec"] = \
+            round(tok, 1)
+
+    def add_bert_embedding():
+        # the encoder embedding service: bucketed continuous batching
+        # over flash-SDPA bert-base, REAL tokens/sec (pad waste counts
+        # against it); sdpa_attention_path telemetry rides along
+        tok = _record_counter_paths(
+            _sdpa_paths, "kernels.flash.sdpa", "bert_embedding",
+            bench_bert_embedding)
+        result["extras"]["bert_embedding_tokens_per_sec"] = \
+            round(tok, 1)
+
     def add_serving_tp2():
         # mp=2 TP-sharded decode: weights + KV pools sharded over two
         # devices, one fused decode executable (needs >= 2 devices;
@@ -1170,6 +1347,9 @@ def main():
         ("llama_serving_disagg", add_serving_disagg, 300),
         ("llama_serving_fleet", add_serving_fleet, 420),
         ("llama_serving_tp2", add_serving_tp2, 300),
+        ("ernie_moe_serving", add_moe_serving, 300),
+        ("ernie_moe_serving_spec", add_moe_serving_spec, 300),
+        ("bert_embedding", add_bert_embedding, 240),
         ("flashmask_8k", add_flashmask, 90),
         ("plan_search", add_plan_search, 60),
     ]

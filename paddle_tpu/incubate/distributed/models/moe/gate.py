@@ -102,7 +102,7 @@ def topk_gating(logits, top_k: int, capacity: int, train: bool = True,
 
 def topk_gating_sparse(logits, top_k: int, capacity: int,
                        train: bool = True, key=None,
-                       switch_jitter: float = 0.0):
+                       switch_jitter: float = 0.0, token_mask=None):
     """Sparse routing result for the scatter/gather dispatch path:
     (expert_idx [k, N], pos [k, N], keep [k, N], combine_w [k, N], aux).
 
@@ -112,6 +112,19 @@ def topk_gating_sparse(logits, top_k: int, capacity: int,
     instead of the dense [N, E, C] one-hot tensors, for the
     sort/segment dispatch whose cost is O(N * k * H) instead of the
     dispatch einsum's O(N * E * C * H).
+
+    ``token_mask`` ([N] bool, optional) marks DEAD tokens False — the
+    serving engine's idle decode lanes (prefill bucket-padding rides
+    unmasked today: the model cannot see chunk lengths, and no-drop
+    decode capacity keeps pad routing harmless — wasted expert work
+    on short chunks, never a changed live token).
+    Dead tokens are dropped from every round up front: they occupy no
+    expert capacity (a dead lane must never push a live token past the
+    capacity cut), their ``keep`` is False (no dispatch, no expert
+    compute, no DMA on the fused kernel — its per-expert live counts
+    are built from ``keep``), and live tokens route exactly as if the
+    dead ones were not in the batch (their cumsum positions skip the
+    masked rows).
     """
     n, e = logits.shape
     logits = apply_router_jitter(logits, switch_jitter, train, key)
@@ -126,9 +139,14 @@ def topk_gating_sparse(logits, top_k: int, capacity: int,
         if r == 0:
             first_choice = idx
         onehot = jax.nn.one_hot(idx, e, dtype=logits.dtype)    # [N, E]
+        if token_mask is not None:
+            # dead tokens claim no occupancy and are never kept
+            onehot = onehot * token_mask[:, None].astype(onehot.dtype)
         pos_in = jnp.cumsum(onehot, axis=0) - onehot + occupancy
         pos = jnp.sum(pos_in * onehot, axis=1).astype(jnp.int32)
         keep = pos < capacity
+        if token_mask is not None:
+            keep = jnp.logical_and(keep, token_mask)
         g = jnp.sum(probs * onehot, axis=1) * keep
         occupancy = occupancy + jnp.sum(onehot, axis=0, keepdims=True)
         idxs.append(idx.astype(jnp.int32))

@@ -245,28 +245,48 @@ class MoELayer(Layer):
     def _n_groups(self, n):
         return _n_groups_cached(n, self._group_size)
 
-    def _forward_scatter(self, tokens, orig_shape):
-        """Sparse dispatch: scatter tokens into the [E*C, h] expert
-        buffer by flat (expert, slot) index, gather+weight on the way
-        back. No [N, E, C] tensors anywhere — cost O(N*k*H) vs the
-        einsum's O(N*E*C*H)."""
-        n, h = tokens.shape
-        e = self.num_experts
+    def _sparse_route(self, tokens, cap, token_mask):
+        """The ONE sparse routing call both the scatter and the fused
+        Pallas dispatch build on (they must route byte-identically —
+        the serving token-exactness contract rides on it): jittered
+        top-k gating at ``cap`` with optional dead-token masking.
+        Sets ``self.l_aux``; returns (idx, pos, keep, w)."""
         top_k = self.gate.top_k
-        cap = self.gate.capacity(int(n))
         jitter = getattr(self.gate, "jitter", 0.0)
         training = self.training
         key = random_mod.next_key() if (jitter and training) else None
 
-        def route(tok, wg):
+        def route(tok, wg, *rest):
             from .gate import topk_gating_sparse
             return topk_gating_sparse(tok @ wg, top_k, cap,
                                       train=training, key=key,
-                                      switch_jitter=jitter)
+                                      switch_jitter=jitter,
+                                      token_mask=rest[0] if rest
+                                      else None)
 
+        gate_args = [tokens, self.gate_weight]
+        if token_mask is not None:
+            gate_args.append(token_mask)
         idx, pos, keep, w, aux = run_op(
-            "moe_gate_sparse", route, [tokens, self.gate_weight])
+            "moe_gate_sparse", route, gate_args)
         self.l_aux = aux
+        return idx, pos, keep, w
+
+    def _forward_scatter(self, tokens, orig_shape, token_mask=None,
+                         cap=None):
+        """Sparse dispatch: scatter tokens into the [E*C, h] expert
+        buffer by flat (expert, slot) index, gather+weight on the way
+        back. No [N, E, C] tensors anywhere — cost O(N*k*H) vs the
+        einsum's O(N*E*C*H).
+
+        ``token_mask``/``cap`` are the serving decode-mode knobs (see
+        ``forward``): dead tokens routed nowhere, capacity overridden
+        to the no-drop worst case."""
+        n, h = tokens.shape
+        e = self.num_experts
+        if cap is None:
+            cap = self.gate.capacity(int(n))
+        idx, pos, keep, w = self._sparse_route(tokens, cap, token_mask)
 
         expert_in = run_op(
             "moe_dispatch_scatter",
@@ -286,11 +306,12 @@ class MoELayer(Layer):
             [expert_out, idx, pos, keep, w])
         return out.reshape(orig_shape)
 
-    def _pallas_fallback_reason(self, n_tokens, dtype):
+    def _pallas_fallback_reason(self, n_tokens, dtype, cap=None):
         """None when the fused Pallas grouped-matmul dispatch can serve
         this forward; else a short site tag naming why not (the
         `kernels.moe.dispatch_path.fallback.<site>` counter suffix and
-        the one-time log)."""
+        the one-time log). ``cap`` overrides the gate capacity (the
+        decode-mode no-drop sizing)."""
         from .....kernels import moe as moe_kernels
         from .....kernels.flash_attention import _pallas_supported
         if not isinstance(self.experts, GroupedExpertsFFN):
@@ -303,7 +324,8 @@ class MoELayer(Layer):
             # the einsum dispatch (whose expert dim GSPMD turns into
             # the all-to-all)
             return "ep-sharded"
-        cap = self.gate.capacity(int(n_tokens))
+        if cap is None:
+            cap = self.gate.capacity(int(n_tokens))
         d_hidden = int(self.experts.w1.shape[-1])
         if not moe_kernels.moe_pallas_eligible(self.d_model, d_hidden,
                                                cap, dtype):
@@ -317,7 +339,8 @@ class MoELayer(Layer):
             return "mosaic-probe"
         return None
 
-    def _forward_pallas(self, tokens, orig_shape):
+    def _forward_pallas(self, tokens, orig_shape, token_mask=None,
+                        cap=None):
         """Fused dispatch: identical routing to dispatch_mode="scatter"
         (topk_gating_sparse), tokens scattered by (expert, slot) into a
         block-padded [E, cap_pad, h] buffer WITH their combine weights,
@@ -330,21 +353,10 @@ class MoELayer(Layer):
         n, h = tokens.shape
         e = self.num_experts
         top_k = self.gate.top_k
-        cap = self.gate.capacity(int(n))
+        if cap is None:
+            cap = self.gate.capacity(int(n))
         cap_pad = moe_kernels.padded_capacity(cap, unwrap(tokens).dtype)
-        jitter = getattr(self.gate, "jitter", 0.0)
-        training = self.training
-        key = random_mod.next_key() if (jitter and training) else None
-
-        def route(tok, wg):
-            from .gate import topk_gating_sparse
-            return topk_gating_sparse(tok @ wg, top_k, cap,
-                                      train=training, key=key,
-                                      switch_jitter=jitter)
-
-        idx, pos, keep, w, aux = run_op(
-            "moe_gate_sparse", route, [tokens, self.gate_weight])
-        self.l_aux = aux
+        idx, pos, keep, w = self._sparse_route(tokens, cap, token_mask)
 
         def moe_dispatch_pallas(tok, idx, pos, keep, w):
             dst = jnp.where(keep, idx * cap_pad + pos, e * cap_pad)
@@ -399,16 +411,74 @@ class MoELayer(Layer):
                      [expert_out, idx, pos, keep])
         return out.reshape(orig_shape)
 
-    def forward(self, x):
+    def _forward_decode(self, tokens, orig_shape, token_mask):
+        """Serving decode mode (inference/engine.py, docs/SERVING.md
+        "MoE serving"): the batch is a serving tick — engine decode
+        lanes or a bucket-padded prefill chunk — not a training batch,
+        so two rules change:
+
+        * NO capacity drops: routing capacity is overridden to the
+          token count (every token's top-k experts always fit).
+          Capacity overflow is a training regularization; a SERVED
+          token must never lose an expert to batch composition —
+          that's also what makes a request's tokens independent of
+          whichever other requests share its tick, the engine's
+          token-exactness contract vs b=1 generate.
+        * dead-lane masking: ``token_mask`` (False = idle decode lane)
+          drops dead tokens from routing up front — they claim no
+          buffer slot and no combine weight, and the fused kernel's
+          per-expert live counts are built from ``keep``, so a dead
+          slot issues NO expert weight DMA and no math. The expert
+          capacity buffers are statically sized for the full tick but
+          effectively sized per-tick by the live counts.
+
+        Dispatch is the fused Pallas grouped-matmul when eligible,
+        else the SPARSE scatter path (never the dense einsum — decode
+        must stay O(N*k*H)); `kernels.moe.decode_path.*` records which
+        at trace time (the engine republishes the deltas as
+        `serving.moe.decode_path.*`) — a fallback is counter-visible,
+        never silent."""
+        from ..... import monitor
+        n = int(tokens.shape[0])
+        mask = None
+        if token_mask is not None:
+            mask = jnp.reshape(unwrap(token_mask), (-1,)).astype(bool)
+        dtype = getattr(unwrap(tokens), "dtype", None)
+        reason = self._pallas_fallback_reason(n, dtype, cap=n)
+        if reason is None:
+            monitor.counter(
+                "kernels.moe.decode_path.pallas").increase()
+            return self._forward_pallas(tokens, orig_shape,
+                                        token_mask=mask, cap=n)
+        monitor.counter(
+            f"kernels.moe.decode_path.fallback.{reason}").increase()
+        key = f"decode:{reason}"
+        if key not in _pallas_fallback_logged:
+            _pallas_fallback_logged.add(key)
+            logging.getLogger(__name__).info(
+                "MoE decode dispatch falling back to the sparse "
+                "scatter path: %s (docs/KERNELS.md eligibility)",
+                reason)
+        return self._forward_scatter(tokens, orig_shape,
+                                     token_mask=mask, cap=n)
+
+    def forward(self, x, token_mask=None, decode_mode=False):
         """x: [batch, seq, h] or [N, h]. Bumps the trace-time
         `kernels.moe.dispatch_path.*` counter for whichever dispatch
         implementation this forward bakes in (docs/OBSERVABILITY.md
         "MoE dispatch path counters") — a pallas layer that degrades to
-        einsum is counter-visible, never silent."""
+        einsum is counter-visible, never silent.
+
+        ``decode_mode=True`` is the serving engine's KV-cache decode
+        path (see ``_forward_decode``): no-drop routing capacity plus
+        ``token_mask`` dead-lane masking, dispatched on the fused
+        Pallas kernel or the sparse scatter path."""
         from ..... import monitor
         orig_shape = list(x.shape)
         h = orig_shape[-1]
         tokens = x.reshape([-1, h])
+        if decode_mode:
+            return self._forward_decode(tokens, orig_shape, token_mask)
         mode = self._dispatch_mode
         if mode == "pallas":
             dtype = getattr(unwrap(tokens), "dtype", None)

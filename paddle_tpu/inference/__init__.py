@@ -272,6 +272,9 @@ def __getattr__(name):
     if name in _RELIABILITY_EXPORTS:
         from . import reliability as _reliability
         return getattr(_reliability, name)
+    if name in ("BatchEncoder", "EmbedParams", "EmbedOutput"):
+        from . import encoder as _encoder
+        return getattr(_encoder, name)
     if name == "PageAllocator":
         from .allocator import PageAllocator
         return PageAllocator

@@ -275,6 +275,56 @@ def _ceil_div(a: int, b: int) -> int:
     return -(-int(a) // int(b))
 
 
+#: config attributes the legacy (no-serving_spec) probe derives the KV
+#: geometry from — named in the error when a model carries neither
+_SPEC_CONFIG_ATTRS = ("num_hidden_layers", "num_key_value_heads",
+                      "num_attention_heads", "hidden_size",
+                      "max_position_embeddings", "vocab_size")
+
+
+def serving_model_spec(model) -> dict:
+    """The engine's model-geometry probe. A model that knows how it
+    serves publishes ``model.serving_spec()`` (LlamaForCausalLM,
+    ErnieMoEForCausalLM, BertModel do) — a plain dict with at least
+    ``kind`` ("decoder" | "encoder") plus, for decoders, the KV
+    geometry (``num_layers`` / ``kv_heads`` / ``head_dim`` /
+    ``max_context`` / ``vocab_size``) and optionally a ``moe`` block
+    (fused-dispatch eligibility diagnostics). Models WITHOUT the hook
+    fall back to the llama-shaped config attribute read that used to
+    be inlined in ``Engine.__init__`` — with a loud error naming the
+    missing attributes instead of an AttributeError mid-constructor."""
+    fn = getattr(model, "serving_spec", None)
+    if callable(fn):
+        spec = dict(fn())
+        if spec.get("kind") == "decoder":
+            missing = [k for k in ("num_layers", "kv_heads", "head_dim",
+                                   "max_context")
+                       if spec.get(k) is None]
+            if missing:
+                raise ValueError(
+                    f"{type(model).__name__}.serving_spec() is missing "
+                    f"decoder geometry key(s) {missing}")
+        return spec
+    cfg = getattr(model, "config", None)
+    missing = [a for a in _SPEC_CONFIG_ATTRS
+               if getattr(cfg, a, None) is None]
+    if cfg is None or missing:
+        raise ValueError(
+            f"cannot derive a serving spec for "
+            f"{type(model).__name__}: no serving_spec() method and "
+            f"model.config lacks {missing or 'a config'} — add a "
+            f"serving_spec() returning the KV geometry "
+            f"(docs/SERVING.md 'Model polymorphism')")
+    return {
+        "kind": "decoder",
+        "num_layers": int(cfg.num_hidden_layers),
+        "kv_heads": int(cfg.num_key_value_heads),
+        "head_dim": int(cfg.hidden_size) // int(cfg.num_attention_heads),
+        "max_context": int(cfg.max_position_embeddings),
+        "vocab_size": int(cfg.vocab_size),
+    }
+
+
 def _normalize_prompt(ids) -> List[int]:
     """One prompt as a python int list — the shared admission
     normalization for every serving front door (Engine.add_request and
@@ -356,6 +406,18 @@ class Engine:
                  clock=None, fault_injector=None,
                  debug_invariants: Optional[bool] = None,
                  max_prefill_tokens_per_step: Optional[int] = None):
+        # model polymorphism (docs/SERVING.md): geometry comes from the
+        # serving_spec probe, not hard-coded llama config attribute
+        # names — an encoder or a spec-less model gets a pointed error
+        # instead of an AttributeError three constructors deep
+        spec = serving_model_spec(model)
+        if spec.get("kind") == "encoder":
+            raise ValueError(
+                f"{type(model).__name__} is an ENCODER — it has no KV "
+                f"decode surface for the continuous-batching Engine. "
+                f"Serve it through the embedding service "
+                f"(inference.BatchEncoder, docs/SERVING.md "
+                f"'Embedding service') instead")
         import inspect
         try:
             fsig = inspect.signature(model.forward)
@@ -368,7 +430,7 @@ class Engine:
                 f"{type(model).__name__}.forward has none — use "
                 "text.generate(use_cache=False) for padded one-shot "
                 "generation instead")
-        cfg = model.config
+        self.serving_spec = spec
         self.model = model
         self.max_slots = int(max_slots)
         self.page_size = int(page_size)
@@ -389,8 +451,7 @@ class Engine:
                 int(max_prefill_tokens_per_step))
         self.max_prefill_tokens_per_step = max_prefill_tokens_per_step
         self._pf_step_tokens = 0
-        self.max_context = int(max_context
-                               or cfg.max_position_embeddings)
+        self.max_context = int(max_context or spec["max_context"])
         # speculative decoding writes k+1 positions per tick (the
         # drafted chunk), so the block tables carry that lookahead of
         # extra slots past max_context — a verify write must never
@@ -412,8 +473,8 @@ class Engine:
                     get_frozen(model))
         self.cache_dtype = _resolve_cache_dtype(cache_dtype, self._st[0])
         self._quant = self.cache_dtype == jnp.dtype(jnp.int8)
-        hkv = cfg.num_key_value_heads
-        hd = cfg.hidden_size // cfg.num_attention_heads
+        hkv = int(spec["kv_heads"])
+        hd = int(spec["head_dim"])
         # pool row 0 is the scratch page (inactive lanes) — the
         # allocator hands out ids [1, pool_pages]
         rows = self.pool_pages + 1
@@ -452,8 +513,27 @@ class Engine:
             self._mp_mesh = mesh
             self._mp_degree = mp
             self._mp_rep = NamedSharding(mesh, PartitionSpec())
+        if self._mp_rep is None:
+            # Commitment churn guard beyond mp>1: a model whose params
+            # are COMMITTED to a mesh even at degree 1 — MoE expert
+            # weights go through shard_tensor at construction — makes
+            # every executable output committed too, so donated pools/
+            # state uploaded UNCOMMITTED here would flip to committed
+            # NamedShardings after their first run and recompile each
+            # executable family exactly once (read: 1-2 phantom
+            # steady-state recompiles per engine). Commit our uploads
+            # to the params' own mesh, replicated, from tick zero.
+            from jax.sharding import NamedSharding, PartitionSpec
+            for leaf in jax.tree_util.tree_leaves(self._st):
+                sh = getattr(leaf, "sharding", None)
+                if isinstance(sh, NamedSharding) \
+                        and getattr(leaf, "committed", False):
+                    self._mp_mesh = sh.mesh
+                    self._mp_rep = NamedSharding(sh.mesh,
+                                                 PartitionSpec())
+                    break
         self._pools = self._commit_pools(_make_paged_pools(
-            cfg.num_hidden_layers, rows, hkv, self.page_size, hd,
+            int(spec["num_layers"]), rows, hkv, self.page_size, hd,
             self.cache_dtype, self._quant), hkv)
         S, MB = self.max_slots, self.max_blocks
         self._bt = np.zeros((S, MB), np.int32)
@@ -552,6 +632,46 @@ class Engine:
                     f"cache_dtype from docs/DECODE.md's eligibility "
                     f"table to serve on the Pallas kernel.",
                     RuntimeWarning, stacklevel=2)
+        # MoE models (docs/SERVING.md "MoE serving"): probe the fused
+        # grouped-matmul dispatch eligibility ONCE here, through the
+        # SAME fallback ladder the decode trace will take (the model's
+        # own MoELayer), so an ineligible geometry/backend is a named
+        # diagnostic at construction instead of a silently slower
+        # scatter path. serving.moe.decode_path.* counters (republished
+        # from the trace-time kernels.moe.decode_path.* deltas each
+        # compile-bearing step) then PROVE which dispatch the compiled
+        # decode executables actually baked in.
+        self._moe_layer = spec.get("moe_layer")
+        self.moe_spec = spec.get("moe")
+        self.moe_fallback_reason = None
+        self.moe_pallas_eligible = None
+        self._moe_paths: Dict[str, int] = {}
+        # baseline the GLOBAL trace-time counters now, so the per-step
+        # republish attributes only deltas that landed after this
+        # engine existed (another engine's warmup must not read as ours)
+        self._moe_seen: Dict[str, int] = {
+            k: int(v) for k, v in monitor.snapshot().items()
+            if k.startswith("kernels.moe.decode_path.")}
+        # compile count at the last _moe_seen sync: compiles landing
+        # BETWEEN our steps (another engine's warmup, a generate()
+        # call) re-baseline instead of republishing — see step()
+        self._moe_tracker_mark = self._tracker.compiles
+        if self._moe_layer is not None:
+            # dtype is inert in the eligibility check (lane-width
+            # constraints only) — None keeps the probe trace-free
+            self.moe_fallback_reason = self._moe_layer.\
+                _pallas_fallback_reason(self.max_slots, None,
+                                        cap=self.max_slots)
+            self.moe_pallas_eligible = self.moe_fallback_reason is None
+            if not self.moe_pallas_eligible:
+                monitor.counter("serving.moe.decode_fallback").increase()
+                if jax.default_backend() in ("tpu", "axon"):
+                    warnings.warn(
+                        f"MoE decode ticks will take the sparse "
+                        f"scatter dispatch, not the fused Pallas "
+                        f"grouped-matmul: {self.moe_fallback_reason} "
+                        f"(docs/KERNELS.md eligibility).",
+                        RuntimeWarning, stacklevel=2)
 
     # -- compiled step shapes ------------------------------------------------
 
@@ -574,7 +694,8 @@ class Engine:
             return pools
         from jax.sharding import NamedSharding, PartitionSpec
         spec = (PartitionSpec(None, "mp")
-                if int(kv_heads) % self._mp_degree == 0
+                if self._mp_degree > 1
+                and int(kv_heads) % self._mp_degree == 0
                 else PartitionSpec())
         return jax.device_put(pools, NamedSharding(self._mp_mesh, spec))
 
@@ -822,6 +943,16 @@ class Engine:
         never raises out of here."""
         outputs: List[Output] = []
         c0 = self._tracker.compiles
+        if self._moe_layer is not None and c0 != self._moe_tracker_mark:
+            # compiles landed OUTSIDE our steps since the last sync
+            # (a sibling worker's warmup in disagg/fleet, a one-shot
+            # generate): fold their kernels.moe.decode_path.* deltas
+            # into the baseline WITHOUT republishing — a foreign trace
+            # must never read as this engine's dispatch proof
+            self._moe_seen = {
+                k: int(v) for k, v in monitor.snapshot().items()
+                if k.startswith("kernels.moe.decode_path.")}
+            self._moe_tracker_mark = c0
         if self._injector is not None:
             self._injector.on_step(self._steps)
             self._prefix_faults()
@@ -849,6 +980,15 @@ class Engine:
         self._watchdog.maybe_start_and_tick()
         monitor.counter("serving.steps").increase()
         self._publish_gauges()
+        # MoE path proof (docs/OBSERVABILITY.md "serving.moe.*"): a
+        # tick that traced something re-publishes the trace-time
+        # kernels.moe.decode_path.* deltas into the serving namespace —
+        # in steady state (zero recompiles) this branch never runs, so
+        # the per-step cost is one int compare
+        if self._moe_layer is not None \
+                and self._tracker.compiles != c0:
+            self._republish_moe_paths()
+            self._moe_tracker_mark = self._tracker.compiles
         # O(1) warmup accounting, attributed to THIS engine: only
         # compiles that land inside this step() count (the jax
         # listener is process-global — another engine or a generate()
@@ -1017,6 +1157,34 @@ class Engine:
         if self._prefix is not None:
             findings += self._prefix.check_integrity(repair=repair)
         return findings
+
+    def _republish_moe_paths(self) -> None:
+        """Mirror the trace-time ``kernels.moe.decode_path.*`` counters
+        (bumped while a prefill/decode/verify executable over an MoE
+        model traces) into ``serving.moe.decode_path.*`` — the
+        engine-scoped proof that its compiled surfaces run the fused
+        Pallas dispatch and never silently fell back (docs/SERVING.md
+        "MoE serving"; tests and the replay tool assert on these)."""
+        prefix = "kernels.moe.decode_path."
+        for key, val in monitor.snapshot().items():
+            if not key.startswith(prefix):
+                continue
+            delta = int(val) - self._moe_seen.get(key, 0)
+            if delta > 0:
+                suffix = key[len(prefix):]
+                monitor.counter(
+                    "serving.moe.decode_path." + suffix).increase(delta)
+                self._moe_paths[suffix] = \
+                    self._moe_paths.get(suffix, 0) + delta
+            self._moe_seen[key] = int(val)
+
+    def moe_decode_path(self) -> Dict[str, int]:
+        """THIS engine's MoE dispatch-path breakdown (suffix -> count;
+        the per-engine slice of ``serving.moe.decode_path.*``): which
+        MoE dispatch its compiled executables baked in. Empty for
+        non-MoE models; ``{"pallas": n}`` with no ``fallback.*`` keys
+        is the no-silent-fallback proof the acceptance tests assert."""
+        return dict(self._moe_paths)
 
     def steady_state_recompiles(self) -> int:
         """XLA compiles INSIDE this engine's step() calls after the

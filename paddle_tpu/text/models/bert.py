@@ -173,6 +173,21 @@ class BertModel(Layer):
         pooled = F.tanh(self.pooler(x[:, 0]))
         return x, pooled
 
+    def serving_spec(self):
+        """Engine/encoder geometry probe (inference/engine.py
+        ``serving_model_spec``): an ENCODER — no KV decode surface.
+        The decode Engine refuses it with a pointer at the embedding
+        service (inference/encoder.BatchEncoder) instead of dying on a
+        missing ``num_key_value_heads`` attribute."""
+        c = self.config
+        return {
+            "kind": "encoder",
+            "num_layers": c.num_hidden_layers,
+            "hidden_size": c.hidden_size,
+            "max_context": c.max_position_embeddings,
+            "vocab_size": c.vocab_size,
+        }
+
 
 class BertForPretraining(Layer):
     """MLM + NSP heads (reference BertPretrainingHeads shape)."""

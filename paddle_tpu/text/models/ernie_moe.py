@@ -5,11 +5,24 @@ Reference analog: python/paddle/incubate/distributed/models/moe (MoELayer
 used inside ERNIE-style transformers). Decoder blocks alternate dense and
 MoE FFNs (every `moe_every` layers) like the GShard/Switch recipe; the
 MoE dispatch all-to-alls over the 'ep' axis.
+
+Serving (docs/SERVING.md "MoE serving"): the model supports the
+``kv_caches``/``cache_index`` forward kwargs — attention is
+LlamaAttention, so every cache layout (dense / rolling / paged / int8)
+rides through unchanged — and the MoE FFNs run in DECODE MODE under a
+cache: no-drop routing capacity (a served token never loses an expert
+to batch composition — the engine's token-exactness contract) with a
+live-lane mask derived from the engine's idle-slot convention
+(``cache_index`` -1), so dead decode lanes issue no expert weight DMA
+through the fused Pallas grouped-matmul dispatch.
 """
 from __future__ import annotations
 
 from dataclasses import dataclass
 
+import jax.numpy as jnp
+
+from ...core.dispatch import unwrap
 from ...incubate.distributed.models.moe import MoELayer
 from ...nn.layer.layers import Layer
 from .llama import (LlamaAttention, LlamaConfig, LlamaRMSNorm)
@@ -66,7 +79,22 @@ class ErnieMoEDecoderLayer(Layer):
             self.mlp = LlamaMLP(config)
         self.is_moe = use_moe
 
-    def forward(self, x):
+    def forward(self, x, kv_cache=None, cache_index=None,
+                token_mask=None):
+        if kv_cache is not None:
+            attn, new_cache = self.self_attn(
+                self.input_layernorm(x), kv_cache=kv_cache,
+                cache_index=cache_index)
+            x = x + attn
+            h = self.post_attention_layernorm(x)
+            if self.is_moe:
+                # serving decode mode: no-drop routing + dead-lane
+                # masking (MoELayer._forward_decode)
+                x = x + self.mlp(h, token_mask=token_mask,
+                                 decode_mode=True)
+            else:
+                x = x + self.mlp(h)
+            return x, new_cache
         x = x + self.self_attn(self.input_layernorm(x))
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
@@ -96,11 +124,58 @@ class ErnieMoEForCausalLM(Layer):
         self.lm_head = Linear(config.hidden_size, config.vocab_size,
                               bias_attr=False)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, kv_caches=None, cache_index=None):
         x = self.embed_tokens(input_ids)
+        if kv_caches is not None:
+            b, s = input_ids.shape
+            idx = jnp.asarray(unwrap(cache_index), jnp.int32)
+            # the engine's idle-lane convention: a dead decode slot
+            # rides at cache_index -1 — its token must claim no expert
+            # capacity and issue no expert DMA. One-shot generate
+            # passes a scalar (>= 0), so the mask is all-live there.
+            # Prefill bucket-padding positions stay live (the model
+            # can't see chunk lengths); no-drop capacity keeps their
+            # routing harmless to real tokens.
+            mask = jnp.broadcast_to(
+                jnp.reshape(jnp.atleast_1d(idx), (-1, 1)) >= 0, (b, s))
+            new_caches = []
+            for lyr, cache in zip(self.layers, kv_caches):
+                x, nc = lyr(x, kv_cache=cache, cache_index=cache_index,
+                            token_mask=mask)
+                new_caches.append(nc)
+            return self.lm_head(self.norm(x)), new_caches
         for lyr in self.layers:
             x = lyr(x)
         return self.lm_head(self.norm(x))
+
+    def serving_spec(self):
+        """Engine geometry probe (inference/engine.py
+        ``serving_model_spec``): the decoder KV geometry plus the MoE
+        block — the engine reads it for pool shapes AND for the
+        fused-dispatch eligibility diagnostics (``moe_layer`` is the
+        first MoE block; its fallback ladder is THE trace-time
+        decision, probed once at construction instead of surfacing as
+        attribute errors or silently-slow decode ticks)."""
+        c = self.config
+        spec = {
+            "kind": "decoder",
+            "num_layers": c.num_hidden_layers,
+            "kv_heads": c.num_key_value_heads,
+            "head_dim": c.hidden_size // c.num_attention_heads,
+            "max_context": c.max_position_embeddings,
+            "vocab_size": c.vocab_size,
+        }
+        moe_layer = next((l.mlp for l in self.layers if l.is_moe), None)
+        if moe_layer is not None:
+            spec["moe"] = {
+                "num_experts": c.num_experts,
+                "top_k": c.top_k,
+                "d_model": c.hidden_size,
+                "d_hidden": c.intermediate_size,
+                "dispatch_mode": c.moe_dispatch_mode,
+            }
+            spec["moe_layer"] = moe_layer
+        return spec
 
     def aux_loss(self):
         """Sum of the MoE load-balancing losses from the last forward."""

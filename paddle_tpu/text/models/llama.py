@@ -757,6 +757,21 @@ class LlamaForCausalLM(Layer):
     def num_params(self):
         return sum(math.prod(p.shape) for _, p in self.named_parameters())
 
+    def serving_spec(self):
+        """Engine geometry probe (inference/engine.py
+        ``serving_model_spec``): the decoder's KV-cache geometry as a
+        plain dict, so the engine never reaches into model-specific
+        config attribute names."""
+        c = self.config
+        return {
+            "kind": "decoder",
+            "num_layers": c.num_hidden_layers,
+            "kv_heads": c.num_key_value_heads,
+            "head_dim": c.hidden_size // c.num_attention_heads,
+            "max_context": c.max_position_embeddings,
+            "vocab_size": c.vocab_size,
+        }
+
 
 def _tied_head(embed_layer, x):
     """Tied lm head for the pipeline build: logits = h @ E^T, reading

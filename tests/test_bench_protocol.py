@@ -47,6 +47,10 @@ def stubbed(monkeypatch):
                         lambda **kw: 1600.0)
     monkeypatch.setattr(bench, "bench_llama_serving_fleet",
                         lambda **kw: (1100.0, 2050.0, 1.864))
+    monkeypatch.setattr(bench, "bench_ernie_moe_serving",
+                        lambda **kw: 950.0)
+    monkeypatch.setattr(bench, "bench_bert_embedding",
+                        lambda **kw: 80000.0)
     monkeypatch.setattr(bench, "bench_flashmask_8k", lambda: 9.0)
     monkeypatch.setattr(bench, "bench_plan_search",
                         lambda **kw: (450.0, 1.0, "sharding8 zero"))
@@ -86,6 +90,9 @@ def test_headline_prints_first_and_extras_append(stubbed, capsys,
                 "llama_1b_serving_fleet_tokens_per_sec",
                 "llama_1b_serving_fleet_scaling_1to2",
                 "llama_1b_serving_tp2_tokens_per_sec",
+                "ernie_moe_serving_tokens_per_sec",
+                "ernie_moe_serving_spec_tokens_per_sec",
+                "bert_embedding_tokens_per_sec",
                 "llama_1b_plan_search_ms",
                 "llama_1b_plan_predicted_vs_dryrun_rank_corr"]:
         assert key in last, key
@@ -113,7 +120,9 @@ def test_budget_skips_extras_but_headline_survives(stubbed, capsys,
         "llama_serving_int8kv", "llama_serving_prefix",
         "llama_serving_spec", "llama_serving_longctx",
         "llama_serving_chaos", "llama_serving_disagg",
-        "llama_serving_fleet", "llama_serving_tp2", "flashmask_8k",
+        "llama_serving_fleet", "llama_serving_tp2",
+        "ernie_moe_serving", "ernie_moe_serving_spec",
+        "bert_embedding", "flashmask_8k",
         "plan_search"}
     assert "llama_seq2048_mfu" not in lines[-1]["extras"]
 

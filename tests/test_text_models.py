@@ -410,15 +410,29 @@ def test_generate_edge_cases():
 
 
 def test_generate_cacheless_model_falls_back():
-    """A causal LM without kv_caches support (ErnieMoE) generates via
-    the padded path automatically."""
+    """A causal LM without kv_caches support generates via the padded
+    path automatically. (ErnieMoE used to be the in-tree example;
+    since it grew KV-cache serving support, the cacheless case is a
+    thin wrapper that hides the cache kwargs.)"""
     from paddle_tpu.text import generate
+    from paddle_tpu.nn.layer.layers import Layer
 
     paddle.seed(14)
     cfg = ErnieMoEConfig.tiny(vocab=16, hidden=64, layers=2, heads=2,
                               experts=2)
     cfg.use_flash_attention = False
-    net = ErnieMoEForCausalLM(cfg)
+    inner = ErnieMoEForCausalLM(cfg)
+
+    class Cacheless(Layer):
+        def __init__(self):
+            super().__init__()
+            self.config = cfg
+            self.net = inner
+
+        def forward(self, input_ids):
+            return self.net(input_ids)
+
+    net = Cacheless()
     net.eval()
     prompt = paddle.to_tensor(np.array([[1, 2, 3]], np.int64))
     out = np.asarray(generate(net, prompt, 4).numpy())

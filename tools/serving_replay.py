@@ -7,12 +7,52 @@ Run: python tools/serving_replay.py trace.jsonl [--max-slots 4]
          [--prefill-token-ms 0.1] [--temperature 0]
          [--cache-dtype auto] [--no-prefix-cache] [--spec-k 0]
          [--draft-layers 1] [--max-prefill-tokens N] [--json]
+         [--model llama|ernie_moe] [--experts 4] [--top-k 2]
+         [--moe-every 2] [--expect-moe-pallas]
+         [--embedding --max-batch 8 --bucket 16]
+         [--expect-zero-recompiles]
          [--expect-pallas] [--expect-prefix-hit-rate 0.5]
          [--expect-p99-ttft-ms MS] [--ttft-tag small]
          [--chaos] [--fault-seed 0] [--fault-rate 0.05]
          [--disagg --prefill-workers N --decode-workers M]
          [--kill-worker decode:1:40]
          [--replicas N --route session] [--kill-replica 1:40]
+
+``--model ernie_moe`` replays against an ERNIE-MoE decoder
+(text/models/ernie_moe.py, docs/SERVING.md "MoE serving") instead of
+the tiny LLaMA: same trace schema, same engine/disagg/fleet drive
+loops and the same chaos/prefix/TTFT gates with their exit codes
+unchanged — ``--experts`` / ``--top-k`` / ``--moe-every`` size the
+sparse FFNs. The report grows a ``moe`` block: the construction-time
+fused-dispatch eligibility verdict plus the per-replay
+``serving.moe.decode_path.*`` deltas — which MoE dispatch the compiled
+serving executables actually baked in. ``--expect-moe-pallas`` turns a
+silent expert-dispatch fallback into a LOUD failure (exit 10): every
+compile-bearing step must have traced the fused Pallas grouped-matmul
+and no ``fallback.*`` counter may move. (On the CPU backend the Pallas
+path never traces, so the flag always fails there — by design, same as
+``--expect-pallas``.) ``--spec-k`` under ``--model ernie_moe`` is the
+dense-draft/MoE-verifier speculative schedule — the draft stays a
+dense LLaMA.
+
+``--embedding`` replays an ENCODER EMBEDDING trace against the
+BatchEncoder service (inference/encoder.py, docs/SERVING.md "Embedding
+service") over a tiny flash-SDPA BERT — no KV, no pages; the
+scheduler under test is bucketed continuous batching. Trace lines are
+one embedding request each:
+
+    {"arrival_ms": 0, "seq_len": 17, "pooling": "mean"}
+
+(``pooling`` optional, "mean"/"cls"; optional ``tenant`` exercises the
+fairness walk, ``deadline_ms`` / ``max_queue_steps`` ride into
+EmbedParams on the replay's virtual clock.) ``--max-batch`` /
+``--bucket`` size the service; the report carries latency percentiles,
+batch fill / pad ratio and the ``serving.embed.*`` counter deltas.
+Decoder-only flags (--disagg/--replicas/--chaos/--spec-k/the
+decode gates) are rejected under ``--embedding``.
+``--expect-zero-recompiles`` (both modes, exit 11) fails the replay
+when ``steady_state_recompiles()`` ends nonzero — the bucket-churn CI
+guard.
 
 ``--replicas N`` replays against the ELASTIC FLEET
 (inference/fleet.py, docs/SERVING.md "Elastic fleet"): N whole engine
@@ -132,6 +172,162 @@ def _percentiles(vals):
             for p, q in (("p50", 50), ("p90", 90), ("p99", 99))}
 
 
+def _run_embedding(args, trace) -> int:
+    """--embedding drive loop: the BatchEncoder service over a tiny
+    flash-SDPA BERT on the replay's virtual clock. One trace line per
+    embedding request; the virtual clock advances --step-ms per service
+    tick plus --prefill-token-ms per REAL token the tick encoded, so
+    batch packing quality shows up directly in the latency
+    percentiles."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import monitor
+    from paddle_tpu.inference.encoder import BatchEncoder, EmbedParams
+    from paddle_tpu.text.models.bert import BertConfig, BertModel
+
+    bad_pool = [(i, r["pooling"]) for i, r in enumerate(trace)
+                if r.get("pooling") not in (None, "mean", "cls")]
+    if bad_pool:
+        print(f"serving_replay: bad pooling value(s) {bad_pool[:5]} "
+              f"(want \"mean\" or \"cls\")", file=sys.stderr)
+        return 2
+
+    paddle.seed(args.seed)
+    max_seq = max(int(r["seq_len"]) for r in trace)
+    cfg = BertConfig.tiny(vocab=args.vocab, hidden=args.hidden,
+                          layers=args.layers, heads=args.heads)
+    cfg.max_position_embeddings = max(cfg.max_position_embeddings,
+                                      max_seq)
+    net = BertModel(cfg)
+    net.eval()
+
+    vt_box = {"vt": 0.0}
+    svc = BatchEncoder(net, max_batch=args.max_batch,
+                       bucket=args.bucket,
+                       clock=lambda: vt_box["vt"] / 1e3)
+    rng = np.random.default_rng(args.seed)
+    prompts = [rng.integers(1, args.vocab, (int(r["seq_len"]),))
+               .astype(np.int64) for r in trace]
+
+    before = monitor.snapshot()
+    tok_key = "serving.embed.tokens"
+    tok_before = int(before.get(tok_key, 0))
+    finished = {}
+    i = 0
+    steps = 0
+    t0 = time.perf_counter()
+    while len(finished) < len(trace):
+        vt = vt_box["vt"]
+        while i < len(trace) and trace[i]["arrival_ms"] <= vt:
+            r = trace[i]
+            # stamp arrival at the TRACE's arrival time, not the tick
+            # the drive loop got around to admitting it — queue wait
+            # behind a long tick must show in the latency percentiles
+            vt_box["vt"] = float(r["arrival_ms"])
+            svc.add_request(
+                prompts[i],
+                EmbedParams(pooling=r.get("pooling", "mean"),
+                            deadline_ms=r.get("deadline_ms"),
+                            max_queue_steps=r.get("max_queue_steps")),
+                tenant=str(r.get("tenant", "default")))
+            vt_box["vt"] = vt
+            i += 1
+        if i < len(trace) and svc.idle:
+            vt_box["vt"] = max(vt, float(trace[i]["arrival_ms"]))
+            continue
+        for out in svc.step():
+            finished[out.req_id] = out
+        steps += 1
+        tok_now = int(monitor.counter(tok_key).get())
+        vt_box["vt"] += args.step_ms \
+            + (tok_now - tok_before) * args.prefill_token_ms
+        tok_before = tok_now
+        if steps > 100_000:
+            print("serving_replay: embedding service did not drain",
+                  file=sys.stderr)
+            return 3
+    wall_s = time.perf_counter() - t0
+    after = monitor.snapshot()
+    svc.close()
+
+    deltas = {k: int(after.get(k, 0)) - int(before.get(k, 0))
+              for k in after
+              if k.startswith(("serving.embed.requests",
+                               "serving.embed.finished",
+                               "serving.embed.batches",
+                               "serving.embed.tokens",
+                               "serving.embed.pad_tokens",
+                               "serving.embed.timeouts",
+                               "serving.embed.cancelled",
+                               "serving.embed.steps",
+                               "kernels.flash.", "xla.compiles"))
+              and int(after.get(k, 0)) - int(before.get(k, 0))}
+    failures = {}
+    total_tokens = 0
+    lats = []
+    for out in finished.values():
+        if out.ok:
+            total_tokens += out.tokens
+            lats.append(out.latency_ms)
+        else:
+            failures[out.finish_reason] = \
+                failures.get(out.finish_reason, 0) + 1
+    n_batches = deltas.get("serving.embed.batches", 0)
+    real = deltas.get("serving.embed.tokens", 0)
+    pad = deltas.get("serving.embed.pad_tokens", 0)
+    report = {
+        "mode": "embedding",
+        "requests": len(trace),
+        "steps": steps,
+        "batches": n_batches,
+        "total_tokens": total_tokens,
+        "wall_s": round(wall_s, 3),
+        "tokens_per_sec": round(total_tokens / max(wall_s, 1e-9), 1),
+        "failed": failures,
+        "latency_ms": _percentiles(lats),
+        "batch_fill": round(len(lats) / max(n_batches
+                                            * args.max_batch, 1), 4),
+        "pad_ratio": round(pad / max(real + pad, 1), 4),
+        "steady_state_recompiles": svc.steady_state_recompiles(),
+        "counters": deltas,
+    }
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(f"embedded {report['requests']} requests / "
+              f"{report['total_tokens']} tokens in {report['steps']} "
+              f"steps / {report['batches']} batches "
+              f"({report['wall_s']}s wall) — "
+              f"{report['tokens_per_sec']} tokens_per_sec")
+        ps = report["latency_ms"]
+        print(f"  latency_ms p50 {ps['p50']:8.2f}  "
+              f"p90 {ps['p90']:8.2f}  p99 {ps['p99']:8.2f}   "
+              f"(virtual clock)")
+        print(f"  batch_fill {report['batch_fill']}  "
+              f"pad_ratio {report['pad_ratio']}  "
+              f"steady_state_recompiles "
+              f"{report['steady_state_recompiles']}")
+        if failures:
+            print("  failed: " + "  ".join(
+                f"{k} x{v}" for k, v in sorted(failures.items())))
+        for k in sorted(report["counters"]):
+            print(f"  {k} +{report['counters'][k]}")
+    if args.expect_zero_recompiles \
+            and report["steady_state_recompiles"]:
+        print(f"serving_replay: --expect-zero-recompiles FAILED — "
+              f"{report['steady_state_recompiles']} steady-state "
+              f"recompile(s); the per-bucket executables churned "
+              f"mid-trace (docs/SERVING.md 'Embedding service')",
+              file=sys.stderr)
+        return 11
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="serving_replay",
                                  description=__doc__)
@@ -152,6 +348,40 @@ def main(argv=None) -> int:
                          "step executed (cached prefixes skip these)")
     ap.add_argument("--temperature", type=float, default=0.0)
     ap.add_argument("--cache-dtype", default="auto")
+    ap.add_argument("--model", default="llama",
+                    choices=("llama", "ernie_moe"),
+                    help="decoder under replay: the tiny dense LLaMA "
+                         "(default) or the ERNIE-MoE sparse decoder "
+                         "(docs/SERVING.md 'MoE serving')")
+    ap.add_argument("--experts", type=int, default=4,
+                    help="expert count under --model ernie_moe")
+    ap.add_argument("--top-k", type=int, default=2,
+                    help="experts routed per token under --model "
+                         "ernie_moe")
+    ap.add_argument("--moe-every", type=int, default=2,
+                    help="every Nth decoder block uses an MoE FFN "
+                         "under --model ernie_moe")
+    ap.add_argument("--expect-moe-pallas", action="store_true",
+                    help="fail (exit 10) when the replay's MoE decode "
+                         "dispatch fell off the fused Pallas "
+                         "grouped-matmul — any serving.moe.decode_path"
+                         ".fallback.* movement, or no pallas trace at "
+                         "all (needs --model ernie_moe)")
+    ap.add_argument("--embedding", action="store_true",
+                    help="replay an ENCODER EMBEDDING trace against "
+                         "the BatchEncoder service over a tiny BERT "
+                         "(docs/SERVING.md 'Embedding service'); "
+                         "lines carry seq_len (+ optional pooling/"
+                         "tenant/deadline_ms/max_queue_steps)")
+    ap.add_argument("--max-batch", type=int, default=8,
+                    help="BatchEncoder batch width under --embedding")
+    ap.add_argument("--bucket", type=int, default=16,
+                    help="BatchEncoder sequence bucket under "
+                         "--embedding")
+    ap.add_argument("--expect-zero-recompiles", action="store_true",
+                    help="fail (exit 11) when steady_state_recompiles "
+                         "ends nonzero — the bucket/trace-churn CI "
+                         "guard (either mode)")
     ap.add_argument("--max-prefill-tokens", type=int, default=None,
                     help="chunked prefill: at most this many prompt "
                          "tokens are prefilled per engine step, "
@@ -255,6 +485,48 @@ def main(argv=None) -> int:
         print("serving_replay: empty trace", file=sys.stderr)
         return 2
 
+    if args.embedding:
+        # the embedding service has no KV/pages/draft/fleet surface —
+        # a decoder-only flag here would be silently ignored, the same
+        # wrong-comparison trap as --route without --replicas
+        bad = [flag for flag, on in (
+            ("--disagg", args.disagg),
+            ("--replicas", bool(args.replicas)),
+            ("--chaos", args.chaos),
+            ("--kill-worker", bool(args.kill_worker)),
+            ("--kill-replica", bool(args.kill_replica)),
+            ("--spec-k", args.spec_k > 0),
+            ("--max-prefill-tokens",
+             args.max_prefill_tokens is not None),
+            ("--no-prefix-cache", args.no_prefix_cache),
+            ("--expect-pallas", args.expect_pallas),
+            ("--expect-moe-pallas", args.expect_moe_pallas),
+            ("--expect-prefix-hit-rate",
+             args.expect_prefix_hit_rate is not None),
+            ("--expect-p99-ttft-ms",
+             args.expect_p99_ttft_ms is not None),
+            ("--model ernie_moe", args.model == "ernie_moe"),
+        ) if on]
+        if bad:
+            print(f"serving_replay: {', '.join(bad)} make(s) no sense "
+                  f"under --embedding (the BatchEncoder service has "
+                  f"no KV decode surface; docs/SERVING.md 'Embedding "
+                  f"service')", file=sys.stderr)
+            return 2
+        missing = [i for i, r in enumerate(trace) if "seq_len" not in r]
+        if missing:
+            print(f"serving_replay: --embedding trace line(s) "
+                  f"{missing[:5]} lack \"seq_len\" — embedding traces "
+                  f"are {{\"arrival_ms\", \"seq_len\"[, \"pooling\"]}} "
+                  f"lines (is this a decoder trace?)", file=sys.stderr)
+            return 2
+        return _run_embedding(args, trace)
+    if args.expect_moe_pallas and args.model != "ernie_moe":
+        print("serving_replay: --expect-moe-pallas needs --model "
+              "ernie_moe (a dense replay has no MoE dispatch to "
+              "gate)", file=sys.stderr)
+        return 2
+
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     # runnable straight from a checkout: tools/ is sys.path[0], the
     # package root is one level up
@@ -352,12 +624,23 @@ def main(argv=None) -> int:
 
     paddle.seed(args.seed)
     max_ctx = max(r["prompt_len"] + r["new_tokens"] for r in trace)
-    cfg = LlamaConfig.tiny(vocab=args.vocab, hidden=args.hidden,
-                           layers=args.layers, heads=args.heads)
+    if args.model == "ernie_moe":
+        from paddle_tpu.text.models.ernie_moe import (ErnieMoEConfig,
+                                                      ErnieMoEForCausalLM)
+        cfg = ErnieMoEConfig.tiny(vocab=args.vocab, hidden=args.hidden,
+                                  layers=args.layers, heads=args.heads,
+                                  experts=args.experts)
+        cfg.top_k = args.top_k
+        cfg.moe_every = args.moe_every
+        model_cls = ErnieMoEForCausalLM
+    else:
+        cfg = LlamaConfig.tiny(vocab=args.vocab, hidden=args.hidden,
+                               layers=args.layers, heads=args.heads)
+        model_cls = LlamaForCausalLM
     cfg.max_position_embeddings = max(cfg.max_position_embeddings,
                                       max_ctx + max(args.spec_k, 0) + 1)
     cfg.use_flash_attention = False
-    net = LlamaForCausalLM(cfg)
+    net = model_cls(cfg)
     net.eval()
     draft = None
     if args.spec_k > 0:
@@ -588,6 +871,7 @@ def main(argv=None) -> int:
     deltas = {k: int(after.get(k, 0)) - int(before.get(k, 0))
               for k in after
               if k.startswith(("kernels.decode.", "kernels.flash.",
+                               "kernels.moe.", "serving.moe.",
                                # fleet COUNTERS only — the serving.fleet.*
                                # namespace also holds gauges (queue_depth,
                                # replicas, per-replica hit rates) that a
@@ -642,6 +926,28 @@ def main(argv=None) -> int:
     }
     if eng.decode_fallback_reason:
         report["pallas_ineligible_reason"] = eng.decode_fallback_reason
+    moe_paths = {}
+    if args.model == "ernie_moe":
+        # the MoE dispatch-path proof (docs/SERVING.md "MoE serving"):
+        # the engine republishes trace-time kernels.moe.decode_path.*
+        # deltas into serving.moe.decode_path.* — {"pallas": n} with no
+        # fallback.* keys means every compiled serving executable baked
+        # in the fused grouped-matmul, never a silent einsum/scatter
+        pfx = "serving.moe.decode_path."
+        moe_paths = {k[len(pfx):]: v for k, v in deltas.items()
+                     if k.startswith(pfx)}
+        report["moe"] = {
+            "experts": args.experts,
+            "top_k": args.top_k,
+            # construction-time eligibility verdict (fleet/disagg wrap
+            # per-worker engines; the counters above are the shared
+            # surface there)
+            "pallas_eligible": getattr(eng, "moe_pallas_eligible",
+                                       None),
+            "fallback_reason": getattr(eng, "moe_fallback_reason",
+                                       None),
+            "decode_paths": moe_paths,
+        }
     if args.replicas:
         # the elastic-fleet report block: per-replica busy-step
         # utilization, warm/cold routing counts and per-replica prefix
@@ -813,6 +1119,15 @@ def main(argv=None) -> int:
             f"{k} +{v}" for k, v in decode_paths.items()))
         if not eng.pallas_eligible:
             print(f"  pallas ineligible: {eng.decode_fallback_reason}")
+        if args.model == "ernie_moe":
+            mo = report["moe"]
+            shown = "  ".join(f"{k} +{v}"
+                              for k, v in sorted(moe_paths.items())) \
+                or "(none traced)"
+            print(f"  moe dispatch paths: {shown}")
+            if mo["fallback_reason"]:
+                print(f"  moe pallas ineligible: "
+                      f"{mo['fallback_reason']}")
         for k in sorted(report["counters"]):
             print(f"  {k} +{report['counters'][k]}")
     else:
@@ -825,6 +1140,27 @@ def main(argv=None) -> int:
               f"stay on kernels.decode.paged_pallas "
               f"(docs/DECODE.md eligibility table)", file=sys.stderr)
         return 4
+    if args.expect_moe_pallas:
+        fell = sum(v for k, v in moe_paths.items()
+                   if k.startswith("fallback.")) > 0 \
+            or moe_paths.get("pallas", 0) == 0
+        if fell:
+            why = getattr(eng, "moe_fallback_reason", None) or \
+                "backend/geometry did not trace the fused MoE kernel"
+            print(f"serving_replay: --expect-moe-pallas FAILED — moe "
+                  f"dispatch paths {moe_paths} ({why}); every "
+                  f"compile-bearing MoE decode step must stay on the "
+                  f"fused Pallas grouped-matmul "
+                  f"(docs/KERNELS.md eligibility)", file=sys.stderr)
+            return 10
+    if args.expect_zero_recompiles \
+            and report["steady_state_recompiles"]:
+        print(f"serving_replay: --expect-zero-recompiles FAILED — "
+              f"{report['steady_state_recompiles']} steady-state "
+              f"recompile(s); the compiled serving surfaces churned "
+              f"mid-trace (docs/OBSERVABILITY.md xla.compiles)",
+              file=sys.stderr)
+        return 11
     if args.expect_prefix_hit_rate is not None and \
             report["prefix_hit_rate"] < args.expect_prefix_hit_rate:
         print(f"serving_replay: --expect-prefix-hit-rate FAILED — "
