@@ -145,6 +145,16 @@ def _telemetry_extras(result):
                                                  0.0)), 2),
         "eager_op_dispatches": int(snap.get("dispatch.ops", 0)),
     })
+    # host/device tick attribution from the serving loop, when any
+    # serving bench ran: last-tick gauge values (the per-tick
+    # distribution lives in serving.hist.* — see the
+    # llama_1b_serving_host_share_per_tick extra for the trace-wide
+    # share)
+    if "serving.host_ms_per_tick" in snap:
+        tel["serving.host_ms_per_tick"] = round(
+            float(snap["serving.host_ms_per_tick"]), 3)
+        tel["serving.device_ms_per_tick"] = round(
+            float(snap.get("serving.device_ms_per_tick", 0.0)), 3)
     mem = read_memory()
     if mem["peak_bytes_in_use"]:
         tel[f"peak_bytes_{mem['source']}"] = mem["peak_bytes_in_use"]
@@ -1172,9 +1182,26 @@ def main():
             round(tok, 1)
 
     def add_serving():
+        # host/device tick attribution rides the same measured trace:
+        # every Engine.step() splits its wall time into host-schedule
+        # vs device-dispatch histograms (docs/OBSERVABILITY.md), and
+        # histogram sums are subtractable, so the share over exactly
+        # this bench's ticks costs no extra run. A high share at
+        # max_slots means the serving loop is host-bound, the thing
+        # the tokens/sec headline can't distinguish from a slow chip.
+        from paddle_tpu import monitor
+        host_h = monitor.histogram("serving.hist.host_ms_per_tick")
+        dev_h = monitor.histogram("serving.hist.device_ms_per_tick")
+        h0, d0 = host_h.sum, dev_h.sum
         tok = _record_decode_path("serving", bench_llama_serving)
         result["extras"]["llama_1b_serving_tokens_per_sec"] = \
             round(tok, 1)
+        host_ms = host_h.sum - h0
+        dev_ms = dev_h.sum - d0
+        share = (host_ms / (host_ms + dev_ms)
+                 if host_ms + dev_ms > 0.0 else 0.0)
+        result["extras"]["llama_1b_serving_host_share_per_tick"] = \
+            round(share, 4)
 
     def add_serving_int8kv():
         # the engine bench finally exercises int8-KV: same arrival

@@ -86,6 +86,7 @@ import numpy as np
 
 from .. import monitor
 from ..profiler.stats import CompileTracker
+from . import tracing
 from .engine import (FAILED, FINISHED, PREEMPTED, WAITING, Engine,
                      Output, Request, SamplingParams, _ceil_div,
                      _normalize_prompt)
@@ -198,6 +199,7 @@ class PrefillWorker(Engine):
             self._slots[i] = None
             req.slot = None
         req.state = MIGRATING
+        self._open_span(req, tracing.MIGRATING, kind="pages")
         self.ready.append(req)
 
 
@@ -303,6 +305,7 @@ class DisaggEngine:
                 f"prefill_workers={prefill_workers} "
                 f"decode_workers={decode_workers}")
         self.model = model
+        self.label = "disagg"
         self._clock = clock if clock is not None else time.perf_counter
         # same arming contract as Engine (reliability.py): an explicit
         # FaultInjector, None = arm from FLAGS_serving_fault_* (ONE
@@ -333,13 +336,13 @@ class DisaggEngine:
                             else pool_pages),
                 prefix_cache=prefix_cache,
                 max_prefill_tokens_per_step=max_prefill_tokens_per_step,
-                **common)
-            for _ in range(int(prefill_workers))]
+                label=f"prefill{i}", **common)
+            for i in range(int(prefill_workers))]
         self.decode: List[Optional[DecodeWorker]] = [
             DecodeWorker(model, max_slots=max_slots,
                          pool_pages=pool_pages, prefix_cache=False,
-                         **common)
-            for _ in range(int(decode_workers))]
+                         label=f"decode{i}", **common)
+            for i in range(int(decode_workers))]
         w0 = self.decode[0]
         self.page_size = w0.page_size
         self.max_blocks = w0.max_blocks
@@ -449,6 +452,8 @@ class DisaggEngine:
                       arrival_t=self._clock(), queued_step=self._steps)
         req.key = np.asarray(jax.random.PRNGKey(int(params.seed)),
                              np.uint32)
+        tracing.open_span(req.spans, tracing.QUEUED,
+                          req.arrival_t * 1e3, self.label)
         self._next_id += 1
         self.requests[rid] = req
         self._tenant[rid] = str(tenant)
@@ -817,6 +822,11 @@ class DisaggEngine:
                 req.preemptions += 1
                 req.state = PREEMPTED if req.generated else WAITING
                 req.queued_step = self._steps
+                # aborted migration: the MIGRATING span closes without
+                # a latency record (it never completed)
+                tracing.open_span(req.spans, tracing.PREEMPTED,
+                                  self._clock() * 1e3, self.label,
+                                  kind="migration")
                 del self._ready[i]
                 self._resume.appendleft(req)
                 monitor.counter("serving.preemptions").increase()
@@ -900,6 +910,7 @@ class DisaggEngine:
             if r.state not in (FINISHED, FAILED):
                 doomed.setdefault(r.req_id, r)
         n = 0
+        now_ms = self._clock() * 1e3
         zero_progress: List[Request] = []
         for req in sorted(doomed.values(), key=lambda r: (
                 self._order.get(r.req_id, 10**9), r.req_id)):
@@ -913,6 +924,10 @@ class DisaggEngine:
                                      len(req.generated),
                                      req.params.temperature)
             req.state = PREEMPTED if req.generated else WAITING
+            tracing.open_span(
+                req.spans,
+                tracing.PREEMPTED if req.generated else tracing.QUEUED,
+                now_ms, self.label, kind="failover")
             req.queued_step = self._steps
             if req.generated:
                 # partial progress earns the resume fast lane
@@ -974,6 +989,7 @@ class DisaggEngine:
                 "tenant": self._tenant.get(req.req_id, "default"),
                 "preemptions": int(req.preemptions),
                 "elapsed_ms": (now - req.arrival_t) * 1e3,
+                "spans": tracing.copy_spans(req.spans),
             })
         monitor.counter("serving.snapshot_saves").increase()
         return {
@@ -1028,6 +1044,9 @@ class DisaggEngine:
                 queued_step=self._steps)
             req.key = replay_rng_key(params.seed, len(req.generated),
                                      params.temperature)
+            req.spans = tracing.restore_spans(
+                ent.get("spans"), req.arrival_t * 1e3,
+                self._clock() * 1e3, self.label, bool(req.generated))
             tenant = str(ent.get("tenant", "default"))
             self.requests[req.req_id] = req
             self._tenant[req.req_id] = tenant
@@ -1135,11 +1154,16 @@ class DisaggEngine:
                 if got_first else 0.0)
         tpot = ((req.finish_t - req.first_token_t) / (n - 1) * 1e3
                 if got_first and n > 1 else 0.0)
+        tracing.seal(req.spans,
+                     tracing.FAILED if failed else tracing.FINISHED,
+                     req.finish_t * 1e3, self.label,
+                     reason=reason if failed else None)
         return Output(req_id=req.req_id, prompt_ids=list(req.prompt),
                       token_ids=list(req.generated),
                       finish_reason=reason, ttft_ms=ttft, tpot_ms=tpot,
                       preemptions=req.preemptions,
-                      error=reason if failed else None)
+                      error=reason if failed else None,
+                      spans=tracing.copy_spans(req.spans))
 
     #: retired Outputs kept for late/streaming readers; beyond this
     #: many the OLDEST are evicted (a long-running server must not

@@ -170,6 +170,7 @@ class BatchEncoder:
         self._st = (get_params(model), get_buffers(model),
                     get_frozen(model))
         self._clock = clock if clock is not None else time.perf_counter
+        self._device_s = 0.0
         # tenant fairness state: per-tenant FIFO queues walked
         # round-robin when a batch is formed (the Engine/DisaggEngine
         # fairness shape). OrderedDict keeps a stable walk order.
@@ -259,6 +260,8 @@ class BatchEncoder:
         """One service tick: expire deadlines, form one fairness-walked
         bucket batch, encode it, retire its requests."""
         outs: List[EmbedOutput] = []
+        wall0 = time.perf_counter()
+        self._device_s = 0.0
         c0 = self._tracker.compiles
         with tape_mod.no_grad_guard():
             outs.extend(self._expire())
@@ -268,6 +271,14 @@ class BatchEncoder:
         monitor.counter("serving.embed.steps").increase()
         monitor.gauge("serving.embed.queue_depth").set(
             self.num_waiting)
+        # host/device attribution: same split Engine.step publishes —
+        # device time is the block_until_ready wait on the encode
+        # output, host time is everything else in the tick
+        wall_ms = (time.perf_counter() - wall0) * 1e3
+        dev_ms = min(self._device_s * 1e3, wall_ms)
+        monitor.gauge("serving.embed.host_ms_per_tick").set(
+            wall_ms - dev_ms)
+        monitor.gauge("serving.embed.device_ms_per_tick").set(dev_ms)
         self._compiles += self._tracker.compiles - c0
         if self._last_compile_step == self._steps:
             self._warm_compiles = self._compiles
@@ -386,8 +397,12 @@ class BatchEncoder:
             amask[i, :n] = 1
             sel[i] = 1 if r.params.pooling == "cls" else 0
         fn = self._get_encode_fn(L)
-        emb = np.asarray(fn(self._st, jnp.asarray(ids),
-                            jnp.asarray(amask), jnp.asarray(sel)))
+        out = fn(self._st, jnp.asarray(ids), jnp.asarray(amask),
+                 jnp.asarray(sel))
+        t0 = time.perf_counter()
+        jax.block_until_ready(out)
+        self._device_s += time.perf_counter() - t0
+        emb = np.asarray(out)
         now = self._clock()
         real = sum(len(r.tokens) for r in batch)
         monitor.counter("serving.embed.batches").increase()
@@ -401,6 +416,7 @@ class BatchEncoder:
             self.requests.pop(r.req_id, None)
             lat = (now - r.arrival_t) * 1e3
             monitor.gauge("serving.embed.latency_ms").set(lat)
+            monitor.histogram("serving.hist.embed_latency_ms").record(lat)
             monitor.counter("serving.embed.finished").increase()
             outs.append(EmbedOutput(
                 req_id=r.req_id, embedding=emb[i].copy(),

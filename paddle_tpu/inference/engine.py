@@ -139,6 +139,7 @@ from ..kernels.paged_attention import paged_pallas_requirements
 from ..profiler.stats import CompileTracker
 from ..text.generation import (_model_forward, _resolve_cache_dtype,
                                sample_token_arrays, verify_token_arrays)
+from . import tracing
 from .allocator import PageAllocator
 from .prefix_cache import PrefixCache
 from .reliability import InjectedFault, injector_from_flags
@@ -222,6 +223,11 @@ class Output:
     tpot_ms: float                # mean inter-token latency after that
     preemptions: int = 0
     error: Optional[str] = None   # None iff the request FINISHED
+    # the request's stitched span timeline (tracing.py contract):
+    # QUEUED -> PREFILL slices -> DECODE -> ... -> FINISHED/FAILED,
+    # contiguous on the engine's injectable clock, origin-labeled per
+    # span across migrations and failovers
+    spans: List[dict] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -254,6 +260,10 @@ class Request:
     first_token_t: float = 0.0
     finish_t: float = 0.0
     finish_reason: Optional[str] = None
+    # host-truth span log (tracing.py): plain dicts on the engine
+    # clock, so the timeline serializes through snapshot/restore and
+    # rides extract_request across workers/replicas untouched
+    spans: List[dict] = field(default_factory=list)
 
     def resume_tokens(self) -> List[int]:
         """The prefix a (re-)prefill must write into the cache: the
@@ -405,7 +415,8 @@ class Engine:
                  draft_model=None, spec_k: int = 4,
                  clock=None, fault_injector=None,
                  debug_invariants: Optional[bool] = None,
-                 max_prefill_tokens_per_step: Optional[int] = None):
+                 max_prefill_tokens_per_step: Optional[int] = None,
+                 label: Optional[str] = None):
         # model polymorphism (docs/SERVING.md): geometry comes from the
         # serving_spec probe, not hard-coded llama config attribute
         # names — an encoder or a spec-less model gets a pointed error
@@ -590,6 +601,17 @@ class Engine:
         # fault injector (explicit, or armed process-wide via
         # FLAGS_serving_fault_seed), and the per-step invariant audit
         self._clock = clock if clock is not None else time.perf_counter
+        # observability plane (docs/OBSERVABILITY.md "Serving
+        # timelines & histograms"): `label` names this engine in span
+        # timelines and scopes its metrics — a fleet replica or disagg
+        # worker writes both the unlabeled aggregate and its
+        # serving.<label>.… twin; a plain engine stays unlabeled.
+        self.label = str(label) if label is not None else "engine"
+        self._mon = monitor.scope(label)
+        # host/device tick attribution: wall seconds this tick spent
+        # blocked on device results (block_until_ready around the
+        # tick's dispatch outputs); step() publishes the split
+        self._device_s = 0.0
         # fault_injector: an explicit FaultInjector, None = arm from
         # FLAGS_serving_fault_* (off by default), False = force OFF
         # even when the flags arm the process (the chaos tooling's
@@ -931,7 +953,9 @@ class Engine:
         self._next_id += 1
         self.requests[req.req_id] = req    # LIVE requests only (see _finish)
         self._waiting.append(req)
-        monitor.counter("serving.requests").increase()
+        tracing.open_span(req.spans, tracing.QUEUED,
+                          req.arrival_t * 1e3, self.label)
+        self._mon.counter("serving.requests").increase()
         return req.req_id
 
     def step(self) -> List[Output]:
@@ -942,6 +966,8 @@ class Engine:
         (deadline, NaN logits, prefill error) retires that request and
         never raises out of here."""
         outputs: List[Output] = []
+        wall0 = time.perf_counter()
+        self._device_s = 0.0
         c0 = self._tracker.compiles
         if self._moe_layer is not None and c0 != self._moe_tracker_mark:
             # compiles landed OUTSIDE our steps since the last sync
@@ -978,7 +1004,7 @@ class Engine:
                     held[int(self._injector.rng.integers(0, len(held)))])
         self._maybe_audit()
         self._watchdog.maybe_start_and_tick()
-        monitor.counter("serving.steps").increase()
+        self._mon.counter("serving.steps").increase()
         self._publish_gauges()
         # MoE path proof (docs/OBSERVABILITY.md "serving.moe.*"): a
         # tick that traced something re-publishes the trace-time
@@ -999,6 +1025,21 @@ class Engine:
         self._compiles += self._tracker.compiles - c0
         if self._last_compile_step == self._steps:
             self._warm_compiles = self._compiles
+        # host/device tick attribution (ROADMAP item 5's gate input):
+        # device time is what the tick spent blocked on dispatched
+        # results (_sync_timed); everything else is host scheduling.
+        # Wall clock, never the injectable clock — timelines stay
+        # deterministic, attribution stays honest.
+        wall_ms = (time.perf_counter() - wall0) * 1e3
+        dev_ms = min(self._device_s * 1e3, wall_ms)
+        host_ms = wall_ms - dev_ms
+        self._mon.gauge("serving.host_ms_per_tick").set(host_ms)
+        self._mon.gauge("serving.device_ms_per_tick").set(dev_ms)
+        self._mon.histogram("serving.hist.host_ms_per_tick").record(
+            host_ms)
+        self._mon.histogram("serving.hist.device_ms_per_tick").record(
+            dev_ms)
+        self._mon.histogram("serving.hist.tick_ms").record(wall_ms)
         self._steps += 1
         return outputs
 
@@ -1064,7 +1105,7 @@ class Engine:
         req = self.requests.get(int(req_id))
         if req is None or req.state in (FINISHED, FAILED):
             return None
-        monitor.counter("serving.cancelled").increase()
+        self._mon.counter("serving.cancelled").increase()
         return self._fail(req, "cancelled")
 
     def extract_request(self, req_id: int,
@@ -1107,6 +1148,12 @@ class Engine:
         # continues exactly (WAITING when no token was emitted yet —
         # no rng was consumed, a from-scratch prefill is exact)
         req.state = PREEMPTED if req.generated else WAITING
+        # the extraction IS the migration's start: the open span
+        # (DECODE/PREFILL/QUEUED) closes here and MIGRATING runs until
+        # the destination engine's next span — origin stays the SOURCE
+        # label, so a stitched timeline shows where the request left
+        tracing.open_span(req.spans, tracing.MIGRATING,
+                          self._clock() * 1e3, self.label)
         return req
 
     def snapshot(self, sync: bool = True) -> dict:
@@ -1303,13 +1350,13 @@ class Engine:
             p = req.params
             if p.deadline_ms is not None and \
                     (now - req.arrival_t) * 1e3 > float(p.deadline_ms):
-                monitor.counter("serving.timeouts").increase()
+                self._mon.counter("serving.timeouts").increase()
                 outs.append(self._fail(req, "deadline"))
             elif p.max_queue_steps is not None and \
                     req.state in (WAITING, PREEMPTED) and \
                     self._steps - req.queued_step \
                     > int(p.max_queue_steps):
-                monitor.counter("serving.timeouts").increase()
+                self._mon.counter("serving.timeouts").increase()
                 outs.append(self._fail(req, "queue_timeout"))
         return outs
 
@@ -1419,9 +1466,9 @@ class Engine:
                 # copy-on-write fork, docs/SERVING.md)
                 req.shared_pages, req.prefix_len = self._prefix.acquire(
                     toks, max_chunks=(len(toks) - 1) // self.page_size)
-                monitor.counter("serving.prefix_lookups").increase()
+                self._mon.counter("serving.prefix_lookups").increase()
                 if req.prefix_len:
-                    monitor.counter("serving.prefix_hits").increase()
+                    self._mon.counter("serving.prefix_hits").increase()
             # shared pages are already resident — admission charges
             # only the UNCACHED tail (a would-be-shared prefix must
             # not inflate apparent pool pressure; each shared page is
@@ -1456,6 +1503,40 @@ class Engine:
             self._slots[slot] = req
             admitted.append(req)
         return admitted
+
+    def _open_span(self, req: Request, phase: str,
+                   slot: Optional[int] = None, **detail) -> None:
+        """Open the request's next timeline span at the engine clock,
+        closing the prior one at the same instant (contiguity is
+        structural). Span-derived latency histograms record at the
+        phase boundary: a QUEUED/PREEMPTED span closing into PREFILL
+        is the queue wait; a MIGRATING span closing anywhere is the
+        migration latency (recorded by the DESTINATION engine's scope
+        — where the request landed)."""
+        t = self._clock() * 1e3
+        closed = tracing.close_open(req.spans, t)
+        if closed is not None:
+            dur = closed["t1_ms"] - closed["t0_ms"]
+            if closed["phase"] == tracing.MIGRATING:
+                self._mon.histogram(
+                    "serving.hist.migration_ms").record(dur)
+            elif closed["phase"] in (tracing.QUEUED,
+                                     tracing.PREEMPTED) \
+                    and phase == tracing.PREFILL:
+                self._mon.histogram(
+                    "serving.hist.queue_wait_ms").record(dur)
+        tracing.open_span(req.spans, phase, t, self.label, slot=slot,
+                          **detail)
+
+    def _sync_timed(self, outs) -> None:
+        """Block until this tick's dispatched device results land,
+        charging the wait to the tick's DEVICE share (host/device
+        attribution, see step()). The immediate np.asarray consumers
+        then read ready buffers — total tick wall time is unchanged,
+        it just gets attributed."""
+        t0 = time.perf_counter()
+        jax.block_until_ready(outs)
+        self._device_s += time.perf_counter() - t0
 
     def _run_prefills(self) -> List[Output]:
         """Run this tick's prefill work over every PREFILL-state slot.
@@ -1598,6 +1679,10 @@ class Engine:
         prompt = np.zeros((1, pb), np.int32)
         prompt[0, :T] = toks[start:start + T]
         p = req.params
+        # one timeline span per slice: the QUEUED (or PREEMPTED /
+        # MIGRATING) wait closes here, consecutive slices chain
+        self._open_span(req, tracing.PREFILL, slot=req.slot,
+                        start=int(start), tokens=int(T))
         fn = self._get_prefill_fn(pb)
         bt_dev = jnp.asarray(bt_row)
         prompt_dev = jnp.asarray(prompt)
@@ -1617,8 +1702,9 @@ class Engine:
             # mirror the chunk into the draft pools (same pages, same
             # positions) so drafting attends the full context
             self._spec.prefill(pb, bt_dev, prompt_dev, start_dev)
-        monitor.counter("serving.prefill_tokens").increase(pb)
-        monitor.counter("serving.prefill_slices").increase()
+        self._sync_timed((tok, okf))
+        self._mon.counter("serving.prefill_tokens").increase(pb)
+        self._mon.counter("serving.prefill_slices").increase()
         self._pf_step_tokens += pb
         if start == req.prefix_len:
             monitor.counter(
@@ -1627,7 +1713,7 @@ class Engine:
             # NaN/inf on the chunk's sampling logits: quarantine the
             # request (pages freed, nothing enters the prefix cache)
             # — the other slots never see it
-            monitor.counter("serving.nan_quarantines").increase()
+            self._mon.counter("serving.nan_quarantines").increase()
             return self._fail(req, "nan_logits")
         req.written = start + T
         if not final:
@@ -1641,7 +1727,7 @@ class Engine:
             req.key = np.asarray(key2)[0].astype(np.uint32)
             req.generated.append(t)
             req.first_token_t = self._clock()
-            monitor.counter("serving.tokens").increase()
+            self._mon.counter("serving.tokens").increase()
             reason = self._finish_reason(req, t)
             if reason:
                 return self._finish(req, reason)
@@ -1662,6 +1748,10 @@ class Engine:
         self._dirty.add(i)
         self._bt_dirty = True
         req.state = DECODE
+        # one tick-aggregated DECODE span from activation to
+        # finish/preempt/migrate (not per tick — the timeline stays
+        # O(lifecycle transitions), not O(tokens))
+        self._open_span(req, tracing.DECODE, slot=i)
 
     def _ensure_pages(self):
         """Before the decode step, every active slot must own every
@@ -1718,8 +1808,9 @@ class Engine:
     def _preempt(self, req: Request):
         """Evict back to the waiting queue (front): pages freed, tokens
         and RNG chain kept — a resume prefill rebuilds the cache."""
-        monitor.counter("serving.preemptions").increase()
+        self._mon.counter("serving.preemptions").increase()
         req.preemptions += 1
+        self._open_span(req, tracing.PREEMPTED, kind="pages")
         i = req.slot
         if i is not None and i not in self._dirty \
                 and req.state == DECODE:
@@ -1788,6 +1879,7 @@ class Engine:
             self._st, self._pools, self._bt_dev, self._dev,
             self._poison_dev)
         self._unpoison()
+        self._sync_timed((nxt, okv))
         nxt = np.asarray(nxt)
         okv = np.asarray(okv)
         outs: List[Output] = []
@@ -1797,7 +1889,7 @@ class Engine:
                 # NaN/inf logits on THIS slot only: quarantine it
                 # (token discarded, pages freed, slot back to the
                 # pool) while every other lane keeps decoding
-                monitor.counter("serving.nan_quarantines").increase()
+                self._mon.counter("serving.nan_quarantines").increase()
                 outs.append(self._fail(req, "nan_logits"))
                 continue
             tok = int(nxt[i])
@@ -1810,7 +1902,7 @@ class Engine:
             self._last[i] = tok
             if req.first_token_t == 0.0:
                 req.first_token_t = self._clock()
-            monitor.counter("serving.tokens").increase()
+            self._mon.counter("serving.tokens").increase()
             reason = self._finish_reason(req, tok)
             if reason:
                 outs.append(self._finish(req, reason))
@@ -1858,6 +1950,7 @@ class Engine:
             self._st, self._pools, self._bt_dev, self._dev, drafts,
             self._poison_dev)
         self._unpoison()
+        self._sync_timed((toks, acc, okv))
         toks = np.asarray(toks)
         acc = np.asarray(acc)
         okv = np.asarray(okv)
@@ -1868,7 +1961,7 @@ class Engine:
                 # NaN/inf across this slot's verify logits (spec-
                 # verify divergence): quarantine the slot, keep the
                 # rest of the batch serving
-                monitor.counter("serving.nan_quarantines").increase()
+                self._mon.counter("serving.nan_quarantines").increase()
                 outs.append(self._fail(req, "nan_logits"))
                 continue
             n_acc = int(acc[i])
@@ -1883,7 +1976,7 @@ class Engine:
                 req.generated.append(tok)
                 if req.first_token_t == 0.0:
                     req.first_token_t = self._clock()
-                monitor.counter("serving.tokens").increase()
+                self._mon.counter("serving.tokens").increase()
                 reason = self._finish_reason(req, tok)
                 if reason:
                     # mid-chain eos/budget: the tail of the chain is
@@ -1941,7 +2034,7 @@ class Engine:
         req.written = 0
 
     def _finish(self, req: Request, reason: str) -> Output:
-        monitor.counter("serving.finished").increase()
+        self._mon.counter("serving.finished").increase()
         return self._retire(req, reason, FINISHED)
 
     def _fail(self, req: Request, reason: str) -> Output:
@@ -1949,7 +2042,7 @@ class Engine:
         cleared, pages freed, removed from the queue — and surfaced as
         an Output with ``error`` set. The step() loop keeps serving
         every other request."""
-        monitor.counter("serving.failed").increase()
+        self._mon.counter("serving.failed").increase()
         return self._retire(req, reason, FAILED)
 
     def _retire(self, req: Request, reason: str, state: str) -> Output:
@@ -1972,28 +2065,40 @@ class Engine:
         tpot_ms = ((req.finish_t - req.first_token_t)
                    / (n - 1) * 1e3) if got_first and n > 1 else 0.0
         if got_first:
-            monitor.gauge("serving.ttft_ms").set(ttft_ms)
+            self._mon.gauge("serving.ttft_ms").set(ttft_ms)
+            self._mon.histogram("serving.hist.ttft_ms").record(ttft_ms)
         if got_first and n > 1:
-            monitor.gauge("serving.tpot_ms").set(tpot_ms)
+            self._mon.gauge("serving.tpot_ms").set(tpot_ms)
+            self._mon.histogram("serving.hist.tpot_ms").record(tpot_ms)
+        # terminal span: timeline sealed at finish_t, the Output
+        # carries its own copy (the Request object may be reused by
+        # restore paths)
+        tracing.seal(req.spans,
+                     tracing.FINISHED if state == FINISHED
+                     else tracing.FAILED,
+                     req.finish_t * 1e3, self.label,
+                     reason=None if state == FINISHED else reason)
         return Output(req_id=req.req_id, prompt_ids=list(req.prompt),
                       token_ids=list(req.generated),
                       finish_reason=reason, ttft_ms=ttft_ms,
                       tpot_ms=tpot_ms, preemptions=req.preemptions,
-                      error=None if state == FINISHED else reason)
+                      error=None if state == FINISHED else reason,
+                      spans=tracing.copy_spans(req.spans))
 
     def _publish_gauges(self):
-        monitor.gauge("serving.slots_active").set(self.num_active)
-        monitor.gauge("serving.pages_free").set(self._alloc.free_pages)
-        monitor.gauge("serving.queue_depth").set(len(self._waiting))
-        monitor.gauge("serving.prefill_tokens_per_step").set(
+        mon = self._mon
+        mon.gauge("serving.slots_active").set(self.num_active)
+        mon.gauge("serving.pages_free").set(self._alloc.free_pages)
+        mon.gauge("serving.queue_depth").set(len(self._waiting))
+        mon.gauge("serving.prefill_tokens_per_step").set(
             self._pf_step_tokens)
         if self._prefix is not None:
-            monitor.gauge("serving.prefix_hit_rate").set(
+            mon.gauge("serving.prefix_hit_rate").set(
                 self._prefix.hit_rate)
-            monitor.gauge("serving.prefix_pages_shared").set(
+            mon.gauge("serving.prefix_pages_shared").set(
                 self._alloc.shared_pages)
         if self._spec is not None and self._spec_drafted:
-            monitor.gauge("serving.spec_accept_rate").set(
+            mon.gauge("serving.spec_accept_rate").set(
                 self._spec_accepted / self._spec_drafted)
 
     @property

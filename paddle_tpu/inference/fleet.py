@@ -92,6 +92,7 @@ import numpy as np
 
 from .. import monitor
 from ..profiler.stats import CompileTracker
+from . import tracing
 from .disagg import replay_rng_key
 from .engine import (FAILED, FINISHED, PREEMPTED, WAITING, Engine,
                      Output, Request, SamplingParams, _ceil_div,
@@ -182,6 +183,7 @@ class ServingFleet:
                 f"unknown router {router!r} — one of {ROUTERS}")
         self.model = model
         self.router = router
+        self.label = "fleet"
         self._clock = clock if clock is not None else time.perf_counter
         # same arming contract as Engine/DisaggEngine: explicit
         # injector, None = arm from FLAGS_serving_fault_* (one injector
@@ -257,9 +259,12 @@ class ServingFleet:
         self._up_streak = 0
         self._down_streak = 0
         self._cooldown = 0
-        self._ttft_window: "deque[float]" = deque(
-            maxlen=(self._policy.ttft_window
-                    if self._policy is not None else 32))
+        # autoscale TTFT signal: two rotating log-bucket histograms
+        # (current + previous window) instead of an unbounded sample
+        # list — O(1) record, p95 from the exact merge of both windows
+        self._ttft_hist = monitor.Histogram("fleet.autoscale.ttft")
+        self._ttft_hist_prev = monitor.Histogram(
+            "fleet.autoscale.ttft.prev")
         self._ttft_sampled: set = set()
         # hit/lookup totals of replicas that died or scaled away, so
         # the fleet-wide prefix_hit_rate survives replica churn
@@ -294,12 +299,11 @@ class ServingFleet:
         replica's coordinate — or appended). The new replica compiles
         its own fixed surface on first use: warmup by the per-engine
         accounting, never a steady-state recompile."""
-        w = Engine(self.model, **self._ctor)
         if index is None:
             index = len(self._replicas)
-            self._replicas.append(w)
-        else:
-            self._replicas[index] = w
+            self._replicas.append(None)
+        w = Engine(self.model, label=f"replica{index}", **self._ctor)
+        self._replicas[index] = w
         self._replicas_created += 1
         # a reused coordinate (scale-up after a death) is a NEW engine:
         # fresh stats, or the replay report would conflate two
@@ -385,6 +389,8 @@ class ServingFleet:
         import jax
         req.key = np.asarray(jax.random.PRNGKey(int(params.seed)),
                              np.uint32)
+        tracing.open_span(req.spans, tracing.QUEUED,
+                          req.arrival_t * 1e3, self.label)
         self._next_id += 1
         self.requests[rid] = req
         self._tenant[rid] = str(tenant)
@@ -701,6 +707,10 @@ class ServingFleet:
         self._replay_used = True
         req.key = replay_rng_key(req.params.seed, len(req.generated),
                                  req.params.temperature)
+        # extract_request opened the MIGRATING span (origin = source
+        # replica); tag it as a LIVE migration for the trace
+        if req.spans and req.spans[-1].get("phase") == tracing.MIGRATING:
+            req.spans[-1].setdefault("detail", {})["kind"] = "live"
         req.preemptions += 1
         req.queued_step = self._steps
         self._home.pop(rid, None)
@@ -816,6 +826,18 @@ class ServingFleet:
             req.key = replay_rng_key(req.params.seed,
                                      len(req.generated),
                                      req.params.temperature)
+            # has-progress: the extraction's MIGRATING span (origin =
+            # dead replica) carries the failover; zero-progress goes
+            # straight back to QUEUED — it never really moved
+            if req.generated:
+                if req.spans and \
+                        req.spans[-1].get("phase") == tracing.MIGRATING:
+                    req.spans[-1].setdefault(
+                        "detail", {})["kind"] = "failover"
+            else:
+                tracing.open_span(req.spans, tracing.QUEUED,
+                                  self._clock() * 1e3, self.label,
+                                  kind="failover")
             req.queued_step = self._steps
             self._home.pop(req.req_id, None)
             self._migrate_dst.pop(req.req_id, None)
@@ -848,10 +870,16 @@ class ServingFleet:
         not wait for requests to FINISH."""
         if self._policy is None:
             return
+        window = int(self._policy.ttft_window)
         for rid, req in self.requests.items():
             if req.first_token_t > 0.0 and rid not in self._ttft_sampled:
                 self._ttft_sampled.add(rid)
-                self._ttft_window.append(
+                if self._ttft_hist.count >= window:
+                    # rotate: the previous window ages out wholesale
+                    self._ttft_hist_prev = self._ttft_hist
+                    self._ttft_hist = monitor.Histogram(
+                        "fleet.autoscale.ttft")
+                self._ttft_hist.record(
                     (req.first_token_t - req.arrival_t) * 1e3)
 
     def _autoscale(self) -> None:
@@ -861,10 +889,12 @@ class ServingFleet:
         live = self._alive()
         qd = self.num_waiting
         pressure = qd > int(pol.scale_up_queue_depth)
-        if not pressure and pol.scale_up_ttft_p95_ms is not None \
-                and len(self._ttft_window) >= 4:
-            p95 = float(np.percentile(list(self._ttft_window), 95))
-            pressure = p95 > float(pol.scale_up_ttft_p95_ms)
+        if not pressure and pol.scale_up_ttft_p95_ms is not None:
+            merged = monitor.Histogram("fleet.autoscale.ttft.merged")
+            merged.merge(self._ttft_hist).merge(self._ttft_hist_prev)
+            if merged.count >= 4:
+                p95 = merged.percentile(95)
+                pressure = p95 > float(pol.scale_up_ttft_p95_ms)
         self._up_streak = self._up_streak + 1 if pressure else 0
         load = sum(w.num_active + w.num_prefilling + len(w._waiting)
                    for _, w in live)
@@ -937,6 +967,7 @@ class ServingFleet:
                 "parked": req in self._parked,
                 "preemptions": int(req.preemptions),
                 "elapsed_ms": (now - req.arrival_t) * 1e3,
+                "spans": tracing.copy_spans(req.spans),
             })
         monitor.counter("serving.snapshot_saves").increase()
         return {
@@ -988,6 +1019,9 @@ class ServingFleet:
                 queued_step=self._steps)
             req.key = replay_rng_key(params.seed, len(req.generated),
                                      params.temperature)
+            req.spans = tracing.restore_spans(
+                ent.get("spans"), req.arrival_t * 1e3,
+                self._clock() * 1e3, self.label, bool(req.generated))
             tenant = str(ent.get("tenant", "default"))
             self.requests[req.req_id] = req
             self._tenant[req.req_id] = tenant
@@ -1130,11 +1164,16 @@ class ServingFleet:
                 if got_first else 0.0)
         tpot = ((req.finish_t - req.first_token_t) / (n - 1) * 1e3
                 if got_first and n > 1 else 0.0)
+        tracing.seal(req.spans,
+                     tracing.FAILED if failed else tracing.FINISHED,
+                     req.finish_t * 1e3, self.label,
+                     reason=reason if failed else None)
         return Output(req_id=req.req_id, prompt_ids=list(req.prompt),
                       token_ids=list(req.generated),
                       finish_reason=reason, ttft_ms=ttft, tpot_ms=tpot,
                       preemptions=req.preemptions,
-                      error=reason if failed else None)
+                      error=reason if failed else None,
+                      spans=tracing.copy_spans(req.spans))
 
     #: retired Outputs kept for late/streaming readers; beyond this
     #: many the OLDEST are evicted (step()'s return value is the

@@ -91,6 +91,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .. import monitor
+from . import tracing
 from ..core.flags import define_flag, get_flag
 
 define_flag("serving_fault_seed", -1,
@@ -377,6 +378,9 @@ def snapshot_engine(eng, sync: bool = True) -> Dict[str, object]:
             "waited_steps": (eng._steps - req.queued_step
                              if req.state in (WAITING, PREEMPTED)
                              and req.queued_step >= 0 else 0),
+            # span timeline: plain host state, rides the snapshot so a
+            # restored request's stitched timeline stays contiguous
+            "spans": tracing.copy_spans(req.spans),
         })
     prefix_index: List[Dict[str, object]] = []
     if eng._prefix is not None:
@@ -473,6 +477,9 @@ def restore_engine(eng, snap: Dict[str, object],
             queued_step=eng._steps - int(ent.get("waited_steps", 0)),
         )
         req.key = np.asarray(ent["key"], np.uint32)
+        req.spans = tracing.restore_spans(
+            ent.get("spans"), req.arrival_t * 1e3, now * 1e3,
+            eng.label, bool(req.generated))
         eng.requests[req.req_id] = req
         eng._waiting.append(req)
         n += 1

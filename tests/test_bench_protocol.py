@@ -81,6 +81,7 @@ def test_headline_prints_first_and_extras_append(stubbed, capsys,
                 "llama_1b_decode_paged_int8_tokens_per_sec",
                 "llama_1b_decode_paged_vs_dense_ratio",
                 "llama_1b_serving_tokens_per_sec",
+                "llama_1b_serving_host_share_per_tick",
                 "llama_1b_serving_int8kv_tokens_per_sec",
                 "llama_1b_serving_prefix_tokens_per_sec",
                 "llama_1b_serving_spec_tokens_per_sec",

@@ -496,7 +496,7 @@ def test_replay_prefix_fixture_hit_rate_and_ttft(rng, capsys):
                          "serving_trace_prefix.jsonl")
     base = [trace, "--layers", "1", "--hidden", "32", "--heads", "2",
             "--vocab", "32", "--max-slots", "2", "--pool-pages", "32",
-            "--json"]
+            "--expect-complete-timelines", "--json"]
     rc = serving_replay.main(base + ["--expect-prefix-hit-rate", "0.5"])
     warm = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rc == 0

@@ -549,7 +549,8 @@ def test_serving_replay_chaos_exit_codes(rng, capsys):
         [trace, "--layers", "1", "--hidden", "32", "--heads", "2",
          "--vocab", "32", "--max-slots", "3", "--page-size", "8",
          "--pool-pages", "24", "--chaos", "--fault-seed", "3",
-         "--fault-rate", "0.05", "--json"])
+         "--fault-rate", "0.05", "--expect-complete-timelines",
+         "--json"])
     assert rc == 0
     report = json.loads(capsys.readouterr().out.strip()
                         .splitlines()[-1])

@@ -325,7 +325,7 @@ def test_longctx_replay_p99_ttft_gate(capsys):
         fixture, "--pool-pages", "256", "--max-slots", "8",
         "--max-prefill-tokens", "32",
         "--expect-p99-ttft-ms", "22", "--ttft-tag", "small",
-        "--json"])
+        "--expect-complete-timelines", "--json"])
     out = capsys.readouterr().out.strip().splitlines()
     assert rc == 0
     report = json.loads(out[-1])

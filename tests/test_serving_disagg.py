@@ -468,7 +468,7 @@ def test_serving_replay_disagg_with_worker_kill(rng, capsys):
     rc = serving_replay.main([
         trace, "--disagg", "--prefill-workers", "2",
         "--decode-workers", "2", "--kill-worker", "decode:1:8",
-        "--json"])
+        "--expect-complete-timelines", "--json"])
     out = capsys.readouterr().out.strip().splitlines()[-1]
     assert rc == 0
     report = json.loads(out)

@@ -494,7 +494,8 @@ def test_serving_replay_tool(rng, capsys):
     rc = serving_replay.main([trace, "--layers", "1", "--hidden", "32",
                               "--heads", "2", "--vocab", "32",
                               "--max-slots", "2", "--page-size", "8",
-                              "--pool-pages", "24"])
+                              "--pool-pages", "24",
+                              "--expect-complete-timelines"])
     assert rc == 0
     out = capsys.readouterr().out
     assert "ttft_ms" in out and "tpot_ms" in out

@@ -508,7 +508,8 @@ def test_serving_replay_fleet_with_replica_kill(rng, capsys):
                          "serving_trace_fleet.jsonl")
     rc = serving_replay.main([
         trace, "--replicas", "2", "--kill-replica", "1:12",
-        "--expect-prefix-hit-rate", "0.8", "--json"])
+        "--expect-prefix-hit-rate", "0.8",
+        "--expect-complete-timelines", "--json"])
     out = capsys.readouterr().out.strip().splitlines()[-1]
     assert rc == 0
     report = json.loads(out)

@@ -17,6 +17,7 @@ Run: python tools/serving_replay.py trace.jsonl [--max-slots 4]
          [--disagg --prefill-workers N --decode-workers M]
          [--kill-worker decode:1:40]
          [--replicas N --route session] [--kill-replica 1:40]
+         [--trace-out spans.json] [--expect-complete-timelines]
 
 ``--model ernie_moe`` replays against an ERNIE-MoE decoder
 (text/models/ernie_moe.py, docs/SERVING.md "MoE serving") instead of
@@ -149,6 +150,21 @@ code 5 when the replay's hit rate lands below X): the guard for
 prefix-heavy fixtures where a silent cache regression would only read
 as higher TTFT.
 
+``--trace-out PATH`` writes the STITCHED per-request span timelines
+(QUEUED / each PREFILL slice / MIGRATING / PREEMPTED / DECODE /
+FINISHED-or-FAILED(reason), origin worker/replica labeled per span)
+as a perfetto-loadable chrome-trace — one pid per worker/replica, one
+lane per slot. The timelines ride the engines' virtual clock, so two
+replays of one seed write byte-identical files.
+``--expect-complete-timelines`` (exit 12) gates on the stitched
+export: every replayed request must reconstruct to exactly one
+contiguous QUEUED..terminal timeline — the chaos-matrix completeness
+guard (docs/OBSERVABILITY.md "Serving timelines & histograms").
+The report also carries ``histograms`` (merged fleet-wide
+``serving.hist.*`` p50/p90/p99 from the mergeable log-bucket
+histograms) and ``host_device`` (the ``serving.host_ms_per_tick`` /
+``serving.device_ms_per_tick`` attribution gauges, wall clock).
+
 Fixture traces live at tests/fixtures/serving_trace.jsonl,
 tests/fixtures/serving_trace_prefix.jsonl (prefix-heavy: one shared
 system prompt, divergent user turns) and
@@ -165,10 +181,18 @@ import time
 
 
 def _percentiles(vals):
-    import numpy as np
+    """Percentile summary over a latency stream via the mergeable
+    log-bucket histogram (monitor.Histogram) — the replay never holds
+    an unbounded sample list just to call np.percentile; bucket
+    resolution is ~3% relative (tests/test_serving_observability.py
+    pins <= 5% on the fixture distributions)."""
+    from paddle_tpu import monitor
     if not vals:
         return {"p50": 0.0, "p90": 0.0, "p99": 0.0}
-    return {p: round(float(np.percentile(vals, q)), 2)
+    h = monitor.Histogram()
+    for v in vals:
+        h.record(float(v))
+    return {p: round(h.percentile(q), 2)
             for p, q in (("p50", 50), ("p90", 90), ("p99", 99))}
 
 
@@ -465,6 +489,20 @@ def main(argv=None) -> int:
     ap.add_argument("--fault-seed", type=int, default=0,
                     help="FaultInjector seed for --chaos (the whole "
                          "fault schedule replays from it)")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="write the stitched per-request span "
+                         "timelines (QUEUED/PREFILL/MIGRATING/"
+                         "PREEMPTED/DECODE/terminal) as chrome-trace "
+                         "JSON — perfetto-loadable, byte-identical "
+                         "across same-seed replays; works under "
+                         "--disagg/--replicas/--chaos; "
+                         "tools/trace_summary.py tabulates it")
+    ap.add_argument("--expect-complete-timelines", action="store_true",
+                    help="exit 12 unless every replayed request "
+                         "yields exactly one contiguous timeline in "
+                         "the stitched export (first span QUEUED, no "
+                         "gaps/overlaps, one terminal span, FAILED "
+                         "carrying its reason)")
     ap.add_argument("--fault-rate", type=float, default=0.05,
                     help="per-query fire probability for each fault "
                          "point under --chaos")
@@ -506,6 +544,9 @@ def main(argv=None) -> int:
             ("--expect-p99-ttft-ms",
              args.expect_p99_ttft_ms is not None),
             ("--model ernie_moe", args.model == "ernie_moe"),
+            ("--trace-out", args.trace_out is not None),
+            ("--expect-complete-timelines",
+             args.expect_complete_timelines),
         ) if on]
         if bad:
             print(f"serving_replay: {', '.join(bad)} make(s) no sense "
@@ -827,6 +868,11 @@ def main(argv=None) -> int:
                  if kills else None)
         injector = FaultInjector(seed=args.fault_seed,
                                  rate=args.fault_rate, sites=sites)
+    # fresh registry for the MEASURED run: the report's histograms
+    # (serving.hist.*) are mergeable but not subtractable, so a chaos
+    # baseline pass must not leak its samples into them (the counter
+    # deltas are per-drive before/after snapshots either way)
+    monitor.reset()
     eng = make_engine(injector)
     run = drive(eng, kills)
     if run is None:
@@ -924,6 +970,22 @@ def main(argv=None) -> int:
         "counters": deltas,
         "steady_state_recompiles": eng.steady_state_recompiles(),
     }
+    # the observability plane's report surface: merged (fleet-wide)
+    # latency histograms recorded by the engines themselves on the
+    # virtual clock, plus the host/device tick attribution gauges
+    detail = monitor.snapshot(detail=True)
+    report["histograms"] = {
+        k: v for k, v in sorted(detail.items())
+        if k.startswith("serving.hist.") and isinstance(v, dict)}
+    report["host_device"] = {
+        "host_ms_per_tick": detail.get("serving.host_ms_per_tick",
+                                       {"last": 0.0, "mean": 0.0}),
+        "device_ms_per_tick": detail.get("serving.device_ms_per_tick",
+                                         {"last": 0.0, "mean": 0.0}),
+    }
+    # stitched per-request timelines (span logs ride the Outputs)
+    timelines = {rid: out.spans for rid, (out, _) in finish.items()
+                 if getattr(out, "spans", None)}
     if eng.decode_fallback_reason:
         report["pallas_ineligible_reason"] = eng.decode_fallback_reason
     moe_paths = {}
@@ -970,6 +1032,15 @@ def main(argv=None) -> int:
             "replica_kills": [f"{i}:{s}" for k, i, s in kills
                               if k == "replica"],
             "replicas_table": eng.utilization(),
+            # per-replica latency straight from each replica's LABELED
+            # metric scope (serving.<replica>.hist.*) — no more
+            # re-deriving per-replica numbers by subtracting registry
+            # snapshots around each replica's step
+            "ttft_by_replica": {
+                k.split(".")[1]: v for k, v in sorted(detail.items())
+                if k.startswith("serving.replica")
+                and k.endswith(".hist.ttft_ms")
+                and isinstance(v, dict)},
         }
     if args.disagg:
         # the disaggregated report block: per-worker busy-step
@@ -1061,6 +1132,16 @@ def main(argv=None) -> int:
         for tag, ps in report["ttft_ms_by_tag"].items():
             print(f"  ttft[{tag}] p50 {ps['p50']:8.2f}  "
                   f"p90 {ps['p90']:8.2f}  p99 {ps['p99']:8.2f}")
+        hd = report["host_device"]
+        print(f"  host_ms_per_tick "
+              f"{hd['host_ms_per_tick'].get('mean', 0.0):.3f}  "
+              f"device_ms_per_tick "
+              f"{hd['device_ms_per_tick'].get('mean', 0.0):.3f}   "
+              f"(wall clock, mean/tick)")
+        for name, st in report["histograms"].items():
+            print(f"  {name:32s} n {st['count']:5d}  "
+                  f"p50 {st['p50']:8.2f}  p90 {st['p90']:8.2f}  "
+                  f"p99 {st['p99']:8.2f}")
         print(f"  preemptions {report['preemptions']}  "
               f"steady_state_recompiles "
               f"{report['steady_state_recompiles']}")
@@ -1087,6 +1168,9 @@ def main(argv=None) -> int:
                       f"hit_rate "
                       f"{hr if hr is not None else '-':>6}  "
                       f"finished {st['finished']:3d}{dead}")
+            for name, st in sorted(fl["ttft_by_replica"].items()):
+                print(f"    {name:10s} ttft n {st['count']:3d}  "
+                      f"p50 {st['p50']:8.2f}  p99 {st['p99']:8.2f}")
         if args.disagg:
             dg = report["disagg"]
             print(f"  disagg: {dg['prefill_workers']}p+"
@@ -1132,6 +1216,11 @@ def main(argv=None) -> int:
             print(f"  {k} +{report['counters'][k]}")
     else:
         print(json.dumps(report))
+    if args.trace_out:
+        from paddle_tpu.inference import tracing
+        tracing.export_serving_trace(timelines, args.trace_out)
+        print(f"serving_replay: wrote {len(timelines)} timeline(s) to "
+              f"{args.trace_out}", file=sys.stderr)
     if args.expect_pallas and fell_off:
         why = eng.decode_fallback_reason or \
             "backend/geometry did not trace the Pallas kernel"
@@ -1225,6 +1314,37 @@ def main(argv=None) -> int:
               f"{'Elastic fleet' if args.replicas else 'Disaggregated serving'!r})",
               file=sys.stderr)
         return 9 if args.replicas else 8
+    if args.expect_complete_timelines:
+        # completeness is asserted VIA THE STITCHED EXPORT (the same
+        # artifact --trace-out writes), not the in-memory span lists:
+        # a span the export drops or reorders must fail this gate
+        from paddle_tpu.inference import tracing
+        rebuilt = tracing.timelines_from_trace(
+            tracing.build_serving_trace(timelines))
+        problems = {}
+        for rid, (out, _) in sorted(finish.items()):
+            spans = rebuilt.get(rid)
+            if not spans:
+                problems[rid] = ["no timeline in the stitched export"]
+                continue
+            ps = tracing.validate_timeline(spans, tol_ms=0.01)
+            want = "FINISHED" if out.ok else "FAILED"
+            if spans[-1].get("phase") != want:
+                ps = ps + [f"request {'finished' if out.ok else 'failed'}"
+                           f" but timeline ends "
+                           f"{spans[-1].get('phase')!r}"]
+            if ps:
+                problems[rid] = ps
+        if problems:
+            shown = {r: problems[r] for r in sorted(problems)[:5]}
+            print(f"serving_replay: --expect-complete-timelines "
+                  f"FAILED — {len(problems)}/{len(finish)} request(s) "
+                  f"with broken timelines, e.g. {shown} "
+                  f"(every request must stitch into one contiguous "
+                  f"QUEUED..FINISHED/FAILED(reason) span log across "
+                  f"migration/failover; docs/OBSERVABILITY.md "
+                  f"'Serving timelines')", file=sys.stderr)
+            return 12
     return 0
 
 

@@ -8,6 +8,12 @@ Works on anything paddle_tpu.profiler.export_chrome_tracing wrote (and
 on any trace_event-format file with complete "X" events). The table
 mirrors the Profiler.summary() OperatorView so a saved trace from a
 production run reads the same as a live profile.
+
+Serving-timeline traces (written by serving_replay --trace-out, tool
+tag "paddle_tpu.serving_timeline") are detected automatically and get
+a per-phase time-share table instead: how much wall time requests
+spent QUEUED / PREFILL / MIGRATING / PREEMPTED / DECODE, aggregated
+across every request in the trace.
 """
 from __future__ import annotations
 
@@ -51,6 +57,57 @@ def summarize(trace: dict, cat: str = "all") -> dict:
     return agg
 
 
+# Canonical span-phase order for serving timelines (see
+# paddle_tpu.inference.tracing.PHASES); terminal phases carry zero
+# duration so they are counted but not tabulated as time share.
+_PHASE_ORDER = ["QUEUED", "PREFILL", "MIGRATING", "PREEMPTED", "DECODE"]
+_TERMINAL = ("FINISHED", "FAILED")
+
+
+def summarize_serving(trace: dict) -> dict:
+    """Aggregate a serving-timeline trace into per-phase time share.
+
+    Returns {"phases": {phase: {spans, total_ms, share}}, "requests",
+    "finished", "failed", "total_ms"} computed purely from the trace
+    events — same dependency-free contract as summarize()."""
+    phases: dict = {}
+    reqs: set = set()
+    finished = failed = 0
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") != "X" or ev.get("cat") != "span":
+            continue
+        name = ev.get("name", "?")
+        reqs.add(ev.get("args", {}).get("req"))
+        if name in _TERMINAL:
+            finished += name == "FINISHED"
+            failed += name == "FAILED"
+            continue
+        a = phases.setdefault(name, dict(spans=0, total_ms=0.0))
+        a["spans"] += 1
+        a["total_ms"] += float(ev.get("dur", 0.0)) / 1e3
+    total = sum(a["total_ms"] for a in phases.values())
+    for a in phases.values():
+        a["share"] = a["total_ms"] / total if total else 0.0
+    return dict(phases=phases, requests=len(reqs), finished=finished,
+                failed=failed, total_ms=total)
+
+
+def format_serving_table(summary: dict) -> str:
+    header = (f"{'phase':<12}{'spans':>8}{'total(ms)':>14}{'share':>9}")
+    lines = [header, "-" * len(header)]
+    phases = summary["phases"]
+    order = [p for p in _PHASE_ORDER if p in phases]
+    order += sorted(p for p in phases if p not in _PHASE_ORDER)
+    for p in order:
+        a = phases[p]
+        lines.append(f"{p:<12}{a['spans']:>8}{a['total_ms']:>14.3f}"
+                     f"{a['share'] * 100:>8.1f}%")
+    lines.append("-" * len(header))
+    lines.append(f"{'all':<12}{'':>8}{summary['total_ms']:>14.3f}"
+                 f"{100.0:>8.1f}%")
+    return "\n".join(lines)
+
+
 _SORT = {"total": "total_ms", "avg": "avg_ms", "max": "max_ms",
          "calls": "calls"}
 
@@ -79,12 +136,20 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     trace = load_trace(args.trace)
+    meta = trace.get("metadata", {})
+    if meta.get("tool") == "paddle_tpu.serving_timeline":
+        s = summarize_serving(trace)
+        print(f"# {args.trace}: serving timeline, "
+              f"{s['requests']} request(s) "
+              f"({s['finished']} finished, {s['failed']} failed), "
+              f"rank {meta.get('rank', '?')}/{meta.get('world_size', '?')}")
+        print(format_serving_table(s))
+        return 0
     agg = summarize(trace, cat=args.cat)
     if not agg:
         print(f"{args.trace}: no complete events"
               + (f" in category '{args.cat}'" if args.cat != "all" else ""))
         return 1
-    meta = trace.get("metadata", {})
     if meta:
         bits = [f"rank {meta.get('rank', '?')}/"
                 f"{meta.get('world_size', '?')}"]
