@@ -56,6 +56,58 @@ def _peak():
     return PEAK_FLOPS.get(kind, 197e12), kind
 
 
+# MFU is FLOPs-done / peak-FLOPs: > 1.0 against a correct denominator
+# is physically impossible. A reported MFU above this marks either a
+# wrong PEAK_FLOPS row for the chip or an analytic FLOP overcount —
+# the result line carries an explicit *_mfu_suspect flag instead of
+# shipping an impossible number silently (docs/PERF.md "Device-peak
+# note": the old 367 TF/s "measured peak" predates this protocol).
+MFU_PLAUSIBLE_BOUND = 1.0
+
+
+def bench_peak_microbench(n=4096, layers=8, reps=3):
+    """Measured bf16 peak, DCE-proof (the MFU-denominator check):
+
+    a chain of ``layers`` [n, n] bf16 matmuls whose summed output is
+    DIFFERENTIATED — ``value_and_grad`` returns every layer's weight
+    gradient, so XLA cannot dead-code-eliminate any matmul the FLOP
+    count claims — and CONSUMED: ``block_until_ready`` on the returned
+    loss+grads sits INSIDE the timed window, so dispatch-and-walk-away
+    cannot inflate the rate. FLOPs counted conservatively at
+    ``6 * n^3`` per layer (fwd 2n^3, dW 2n^3, dx 2n^3) minus the first
+    layer's unused dx. Returns (measured TF/s, measured / table-peak
+    ratio) — a ratio above ~1.0 means the PEAK_FLOPS row for this chip
+    is WRONG (underquoted), not that the chip beat physics."""
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.PRNGKey(0)
+    keys = jax.random.split(key, layers + 1)
+    ws = [jax.random.normal(k, (n, n), jnp.bfloat16) * 0.01
+          for k in keys[:layers]]
+    x = jax.random.normal(keys[-1], (n, n), jnp.bfloat16)
+
+    def loss(ws, x):
+        h = x
+        for w in ws:
+            h = h @ w
+        # fp32 sum anchors every layer's output into the loss
+        return jnp.sum(h.astype(jnp.float32))
+
+    step = jax.jit(jax.value_and_grad(loss))
+    out = step(ws, x)
+    jax.block_until_ready(out)            # compile + warm outside the window
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = step(ws, x)
+    jax.block_until_ready(out)            # consumption is part of the time
+    dt = time.perf_counter() - t0
+    flops = reps * (6 * layers - 2) * (n ** 3)
+    measured = flops / dt
+    table, _ = _peak()
+    return measured / 1e12, measured / table
+
+
 # decode-bench name -> attention path it traced ("pallas" /
 # "xla-gather" / "xla-dense" / ...), read off the kernels.decode.*
 # counter deltas around each decode bench (the counters bump at TRACE
@@ -1081,6 +1133,11 @@ def main():
             "device_kind": kind,
         },
     }
+    if mfu_1b > MFU_PLAUSIBLE_BOUND:
+        # an impossible MFU ships FLAGGED, never silently: either the
+        # PEAK_FLOPS row is wrong for this chip or the analytic FLOP
+        # count overshot (docs/PERF.md "Device-peak note")
+        result["extras"]["llama_1b_mfu_suspect"] = True
     _telemetry_extras(result)
     print(json.dumps(result), flush=True)
 
@@ -1336,6 +1393,15 @@ def main():
         ms = bench_flashmask_8k()
         result["extras"]["flashmask_seq8k_docmask_ms"] = round(ms, 2)
 
+    def add_peak_microbench():
+        # the MFU-denominator check: synchronized, DCE-proof measured
+        # bf16 peak vs the PEAK_FLOPS table row; ratio > ~1.0 means
+        # the table (the MFU denominator) underquotes this chip
+        tf, ratio = bench_peak_microbench()
+        result["extras"]["peak_bf16_measured_tflops"] = round(tf, 1)
+        result["extras"]["peak_bf16_measured_vs_table"] = \
+            round(ratio, 3)
+
     def add_plan_search():
         ms, corr, best = bench_plan_search()
         result["extras"]["llama_1b_plan_search_ms"] = round(ms, 1)
@@ -1378,6 +1444,7 @@ def main():
         ("ernie_moe_serving_spec", add_moe_serving_spec, 300),
         ("bert_embedding", add_bert_embedding, 240),
         ("flashmask_8k", add_flashmask, 90),
+        ("peak_bf16", add_peak_microbench, 120),
         ("plan_search", add_plan_search, 60),
     ]
     skipped = []

@@ -13,10 +13,14 @@ anything executes:
   (no device execution) and lints the staged program: dtype promotion,
   baked-in constants, dead computation, unused (donated) inputs,
   unrolled Python loops, recompile-risk static args.
+* `hotpath_lint` — audits a serving surface's tick loop: the compiled
+  executable inventory (donation, fetch set, cache keys) plus the
+  scheduler source (host syncs, steady-tick uploads), device-free.
 
 Surfaces: `StaticFunction.inspect()` / `TrainStep.inspect()` /
-`Model.inspect()`, the opt-in `PADDLE_TPU_LINT=1` first-compile hook,
-and the dependency-free `tools/paddle_lint.py` CLI. Rule catalog:
+`Model.inspect()`, `inspect_hotpath()` on the serving engines, the
+opt-in `PADDLE_TPU_LINT=1` first-compile hook, and the
+dependency-free `tools/paddle_lint.py` CLI. Rule catalog:
 docs/ANALYSIS.md.
 """
 from __future__ import annotations
@@ -26,9 +30,12 @@ import os
 from .ast_lint import (lint_callable, lint_file, lint_paths,  # noqa: F401
                        lint_source)
 from .cost_model import CostEstimate, estimate_jaxpr  # noqa: F401
-from .findings import (AST_RULES, ERROR, INFO, JAXPR_RULES,  # noqa: F401
-                       PIPELINE_RULES, SHARD_RULES, WARNING, Finding,
-                       Report)
+from .findings import (AST_RULES, ERROR, HOTPATH_RULES, INFO,  # noqa: F401
+                       JAXPR_RULES, PIPELINE_RULES, SHARD_RULES,
+                       WARNING, Finding, Report)
+from .hotpath_lint import (ExecutableSpec, HotpathInventory,  # noqa: F401
+                           emit_hotpath, lint_inventory, lint_surface,
+                           sweep_serving_stack)
 from .jaxpr_lint import (lint_closed_jaxpr, lint_static_args,  # noqa: F401
                          lint_static_function, lint_train_step,
                          lint_traceable, to_shape_struct)

@@ -52,6 +52,16 @@ MICROBATCH_ARITY = "microbatch-arity"
 # with them; HBM is the one gate with no lint analog
 HBM_OVER_BUDGET = "hbm-over-budget"
 
+# hot-path (serving tick) rules — what hotpath_lint's executable
+# inventory + scheduler-source walk reveals (docs/ANALYSIS.md
+# "Hot-path rules"). Prefixed "hotpath." so the per-rule monitor
+# counters land under lint.hotpath.* through the shared emit path.
+MISSED_DONATION = "hotpath.missed-donation"
+FETCH_SET_BLOAT = "hotpath.fetch-set-bloat"
+HOST_SYNC_IN_TICK = "hotpath.host-sync-in-tick"
+STEADY_TICK_UPLOAD = "hotpath.steady-tick-upload"
+RECOMPILE_RISK_KEY = "hotpath.recompile-risk-key"
+
 AST_RULES = (TENSOR_BOOL_BRANCH, TENSOR_HOST_SYNC, TENSOR_PY_CAST,
              TENSOR_INPLACE, HOST_RNG)
 JAXPR_RULES = (GRAPH_BREAK, TRACE_FAILED, DTYPE_PROMOTION,
@@ -64,6 +74,8 @@ SHARD_RULES = (BAD_AXIS_NAME, UNALIGNED_GROUP, INDIVISIBLE_COLLECTIVE,
 PIPELINE_RULES = (STAGE_IMBALANCE, BUBBLE_FRACTION, SEGMENT_MISMATCH,
                   MICROBATCH_ARITY)
 PLANNER_RULES = (HBM_OVER_BUDGET,)
+HOTPATH_RULES = (MISSED_DONATION, FETCH_SET_BLOAT, HOST_SYNC_IN_TICK,
+                 STEADY_TICK_UPLOAD, RECOMPILE_RISK_KEY)
 
 ERROR = "error"      # will raise at trace time (a _BREAK_ERRORS member)
 WARNING = "warning"  # traces, but recompiles / wastes memory / is wrong

@@ -245,6 +245,13 @@ class DecodeWorker(Engine):
         Engine._activate(self, req)
         return True
 
+    def _scatter_body(self):
+        def body(pools, blk, rows):
+            return jax.tree_util.tree_map(
+                lambda p, r: p.at[rows].set(r.astype(p.dtype)),
+                pools, blk)
+        return body
+
     def _scatter(self, block, idx):
         """Write a migrated block into the pools at rows ``idx`` —
         pad entries point at row 0, the scratch page garbage may
@@ -252,17 +259,32 @@ class DecodeWorker(Engine):
         shape) however many pages migrate."""
         fn = getattr(self, "_scatter_fn", None)
         if fn is None:
-            def body(pools, blk, rows):
-                return jax.tree_util.tree_map(
-                    lambda p, r: p.at[rows].set(r.astype(p.dtype)),
-                    pools, blk)
-            fn = jax.jit(body, donate_argnums=(0,))
+            fn = jax.jit(self._scatter_body(), donate_argnums=(0,))
             self._scatter_fn = fn
         tgt, drf = block
         self._pools = fn(self._pools, tgt, idx)
         if self._spec is not None and drf is not None:
             self._spec._pools = fn(self._spec._pools, drf, idx)
         return self._pools
+
+    def _hotpath_inventory(self):
+        """Engine's inventory plus the migration scatter: destination
+        pools donated (argnum 0), the incoming block is consumed but
+        smaller than the pools, nothing fetched."""
+        from ..analysis import hotpath_lint as hp
+        inv = Engine._hotpath_inventory(self)
+        pools = hp.struct_of(self._pools)
+        blk = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(
+                (self.max_blocks,) + tuple(l.shape[1:]), l.dtype),
+            pools)
+        inv.executables.append(hp.ExecutableSpec(
+            name="scatter", body=self._scatter_body(),
+            args=(pools, blk,
+                  jax.ShapeDtypeStruct((self.max_blocks,), np.int32)),
+            donate=(0,), fetched=(), per_tick=False))
+        inv.tick_functions.extend([self.admit_migrated, self._scatter])
+        return inv
 
 
 class DisaggEngine:
@@ -721,6 +743,11 @@ class DisaggEngine:
             w.requests[req.req_id] = req
             w._waiting.append(req)
 
+    def _gather_body(self):
+        def body(pools, rows):
+            return jax.tree_util.tree_map(lambda p: p[rows], pools)
+        return body
+
     def _gather(self, w: Engine, pages: List[int]):
         """Pull a request's page rows out of worker ``w``'s pools
         (target + draft) as one fixed-shape ``[max_blocks, ...]``
@@ -730,14 +757,54 @@ class DisaggEngine:
         idx[:len(pages)] = pages
         fn = self._gather_fns.get(id(w))
         if fn is None:
-            def body(pools, rows):
-                return jax.tree_util.tree_map(lambda p: p[rows], pools)
-            fn = jax.jit(body)
+            fn = jax.jit(self._gather_body())
             self._gather_fns[id(w)] = fn
         tgt = fn(w._pools, w._up(idx))
         drf = (fn(w._spec._pools, w._up(idx))
                if w._spec is not None else None)
         return (tgt, drf)
+
+    # -- hot-path lint (docs/ANALYSIS.md "Hot-path rules") -------------------
+
+    def _hotpath_inventory(self):
+        """The DRIVER surface only: one gather executable per live
+        worker (a READ — the source pools live on and the output block
+        is smaller than any pool, so no donation is wanted) plus the
+        driver's dispatch/migration tick path. The workers are full
+        Engines and are swept separately by inspect_hotpath()."""
+        from ..analysis import hotpath_lint as hp
+        specs = []
+        for kind, workers in (("p", self.prefill), ("d", self.decode)):
+            for i, w in enumerate(workers):
+                if w is None:
+                    continue
+                specs.append(hp.ExecutableSpec(
+                    name=f"gather[{kind}{i}]", body=self._gather_body(),
+                    args=(hp.struct_of(w._pools),
+                          jax.ShapeDtypeStruct((self.max_blocks,),
+                                               np.int32)),
+                    donate=(), fetched=(), per_tick=False))
+        return hp.HotpathInventory(
+            subject="DisaggEngine[driver]", executables=specs,
+            tick_functions=[self.step, self._expire, self._dispatch,
+                            self._gather, self._migrate,
+                            self._relieve_prefill_pressure],
+            steady_functions=(),
+            cache_keys={"_gather_fns": list(self._gather_fns)},
+            file=__file__)
+
+    def inspect_hotpath(self):
+        """Hot-path audit over the whole disaggregated surface: the
+        driver inventory plus every live prefill/decode worker's
+        Engine inventory, one combined Report through the
+        ``lint.hotpath.*`` counters."""
+        from ..analysis import hotpath_lint
+        report = hotpath_lint.lint_inventory(self._hotpath_inventory())
+        for w in list(self.prefill) + list(self.decode):
+            if w is not None:
+                report.extend(hotpath_lint.lint_inventory(
+                    w._hotpath_inventory()))
+        return hotpath_lint.emit_hotpath(report)
 
     def _migrate(self) -> None:
         """Move every migration-ready request whose KV fits a decode

@@ -199,6 +199,12 @@ class BatchEncoder:
         fn = self._fns.get(L)
         if fn is not None:
             return fn
+        fn = jax.jit(self._encode_body())
+        self._fns[L] = fn
+        self._last_compile_step = self._steps
+        return fn
+
+    def _encode_body(self):
         model = self.model
 
         def body(st, ids, amask, sel):
@@ -215,10 +221,51 @@ class BatchEncoder:
                             jnp.asarray(pooled, jnp.float32), mean)
             return emb
 
-        fn = jax.jit(body)
-        self._fns[L] = fn
-        self._last_compile_step = self._steps
-        return fn
+        return body
+
+    def _sync_timed(self, outs) -> None:
+        """Block until the dispatched encode lands, charging the wait
+        to the tick's DEVICE share (same attribution contract as
+        Engine._sync_timed — and the one sanctioned sync point the
+        hot-path lint recognizes)."""
+        t0 = time.perf_counter()
+        jax.block_until_ready(outs)
+        self._device_s += time.perf_counter() - t0
+
+    # -- hot-path lint (docs/ANALYSIS.md "Hot-path rules") -------------------
+
+    def _hotpath_inventory(self):
+        """One encode executable per warm sequence bucket (or the base
+        bucket, cold); the full embedding batch is the service's
+        DELIVERABLE, so its fetch is whitelisted. No resident device
+        state — every batch legitimately uploads its ids/mask — so the
+        steady-upload set is empty."""
+        from ..analysis import hotpath_lint as hp
+        import numpy as np
+        B = self.max_batch
+        st = hp.struct_of(self._st)
+
+        def i32(*shape):
+            return jax.ShapeDtypeStruct(shape, np.int32)
+
+        specs = [hp.ExecutableSpec(
+            name=f"encode[{L}]", body=self._encode_body(),
+            args=(st, i32(B, L), i32(B, L), i32(B)),
+            donate=(), fetched=(0,), deliverable=(0,))
+            for L in (tuple(sorted(self._fns)) or (self.bucket,))]
+        return hp.HotpathInventory(
+            subject="BatchEncoder", executables=specs,
+            tick_functions=[self.step, self._form_batch, self._expire,
+                            self._encode],
+            steady_functions=(),
+            cache_keys={"_fns": list(self._fns)}, file=__file__)
+
+    def inspect_hotpath(self):
+        """Device-free hot-path audit of the embedding service; routes
+        per-rule counts through ``lint.hotpath.*``."""
+        from ..analysis import hotpath_lint
+        return hotpath_lint.emit_hotpath(
+            hotpath_lint.lint_inventory(self._hotpath_inventory()))
 
     # -- public API ----------------------------------------------------------
 
@@ -399,9 +446,7 @@ class BatchEncoder:
         fn = self._get_encode_fn(L)
         out = fn(self._st, jnp.asarray(ids), jnp.asarray(amask),
                  jnp.asarray(sel))
-        t0 = time.perf_counter()
-        jax.block_until_ready(out)
-        self._device_s += time.perf_counter() - t0
+        self._sync_timed(out)
         emb = np.asarray(out)
         now = self._clock()
         real = sum(len(r.tokens) for r in batch)

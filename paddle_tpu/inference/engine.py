@@ -387,6 +387,13 @@ def _merge_rows(dev, host, mask):
     return jax.tree_util.tree_map(pick, dev, host)
 
 
+def _lint_armed() -> bool:
+    """PADDLE_TPU_LINT=1: arm the steady-tick transfer guard (read per
+    tick through analysis.lint_enabled so tests can toggle the env)."""
+    from .. import analysis
+    return analysis.lint_enabled()
+
+
 class Engine:
     """In-process continuous-batching engine over the paged KV stack.
 
@@ -785,6 +792,16 @@ class Engine:
         fn = self._decode_fns.get(variant)
         if fn is not None:
             return fn
+        fn = jax.jit(self._decode_body(variant), donate_argnums=(1, 3))
+        self._decode_fns[variant] = fn
+        self._note_compile()
+        return fn
+
+    def _decode_body(self, variant: str):
+        """The decode step's traceable body, separate from the jitted
+        wrapper so hotpath_lint can abstract-trace the exact program
+        `_get_decode_fn` compiles (same closure, same donation
+        contract declared in the inventory)."""
         model = self.model
 
         def body(st, caches, bt, state, poison):
@@ -819,10 +836,7 @@ class Engine:
                       live)
             return nxt, ok, state2, self._strip_bt(new_kv)
 
-        fn = jax.jit(body, donate_argnums=(1, 3))
-        self._decode_fns[variant] = fn
-        self._note_compile()
-        return fn
+        return body
 
     def _get_verify_fn(self, variant: str):
         """The speculative verify executable — ONE fixed-shape
@@ -837,6 +851,12 @@ class Engine:
         fn = self._verify_fns.get(variant)
         if fn is not None:
             return fn
+        fn = jax.jit(self._verify_body(variant), donate_argnums=(1, 3))
+        self._verify_fns[variant] = fn
+        self._note_compile()
+        return fn
+
+    def _verify_body(self, variant: str):
         model = self.model
 
         def body(st, caches, bt, state, drafts, poison):
@@ -863,15 +883,18 @@ class Engine:
                       jnp.where(live[:, None] > 0, keys2, keys), live)
             return toks, acc, ok, state2, self._strip_bt(new_kv)
 
-        fn = jax.jit(body, donate_argnums=(1, 3))
-        self._verify_fns[variant] = fn
-        self._note_compile()
-        return fn
+        return body
 
     def _get_prefill_fn(self, pb: int):
         fn = self._prefill_fns.get(pb)
         if fn is not None:
             return fn
+        fn = jax.jit(self._prefill_body(), donate_argnums=(1,))
+        self._prefill_fns[pb] = fn
+        self._note_compile()
+        return fn
+
+    def _prefill_body(self):
         model = self.model
 
         def body(st, caches, bt_row, prompt, plen, start, temps, topks,
@@ -895,15 +918,100 @@ class Engine:
                 cur, keys, temps, topks, topps)
             return nxt, keys2, ok, self._strip_bt(new_kv)
 
-        fn = jax.jit(body, donate_argnums=(1,))
-        self._prefill_fns[pb] = fn
-        self._note_compile()
-        return fn
+        return body
 
     def _note_compile(self):
         """Record that THIS step legitimately introduced a new
         executable (warmup accounting for steady_state_recompiles)."""
         self._last_compile_step = self._steps
+
+    # -- hot-path lint (docs/ANALYSIS.md "Hot-path rules") -------------------
+
+    def _hotpath_inventory(self):
+        """The engine's compiled-executable inventory + scheduler tick
+        path, in hotpath_lint's terms: every per-tick body with its
+        abstract args and donation/fetch contract, the tick functions
+        to source-walk, the steady-path subset the upload discipline
+        applies to, and the executable-cache key sets."""
+        from ..analysis import hotpath_lint as hp
+        S, MB = self.max_slots, self.max_blocks
+
+        def s(shape, dt):
+            return jax.ShapeDtypeStruct(shape, np.dtype(dt))
+
+        st = hp.struct_of(self._st)
+        pools = hp.struct_of(self._pools)
+        state = hp.struct_of(self._dev)
+        bt = hp.struct_of(self._bt_dev)
+        poison = hp.struct_of(self._poison_dev)
+        specs = []
+        variants = tuple(self._decode_fns) or ("greedy", "plain",
+                                               "filtered")
+        for v in variants:
+            specs.append(hp.ExecutableSpec(
+                name=f"decode[{v}]", body=self._decode_body(v),
+                args=(st, pools, bt, state, poison),
+                donate=(1, 3), fetched=(0, 1)))
+        if self._spec is not None:
+            k = self._spec.k
+            for v in tuple(self._verify_fns) or variants:
+                specs.append(hp.ExecutableSpec(
+                    name=f"verify[{v}]", body=self._verify_body(v),
+                    args=(st, pools, bt, state, s((S, k), np.int32),
+                          poison),
+                    donate=(1, 3), fetched=(0, 1, 2)))
+            specs.extend(self._spec.hotpath_specs())
+        pbs = tuple(sorted(self._prefill_fns)) or (self.prefill_bucket,)
+        for pb in pbs:
+            specs.append(hp.ExecutableSpec(
+                name=f"prefill[{pb}]", body=self._prefill_body(),
+                args=(st, pools, s((1, MB), np.int32),
+                      s((1, pb), np.int32), s((1,), np.int32),
+                      s((1,), np.int32), s((1,), np.float32),
+                      s((1,), np.int32), s((1,), np.float32),
+                      s((1, 2), np.uint32), s((1,), np.float32)),
+                donate=(1,), fetched=(0, 1, 2), per_tick=False))
+        cache_keys = {"_decode_fns": list(self._decode_fns),
+                      "_verify_fns": list(self._verify_fns),
+                      "_prefill_fns": list(self._prefill_fns)}
+        if self._spec is not None:
+            cache_keys["_spec._prefill_fns"] = \
+                list(self._spec._prefill_fns)
+        tick = [self.step, self._admit, self._expire,
+                self._run_prefills, self._safe_prefill, self._prefill,
+                self._ensure_pages, self._safe_decode, self._decode,
+                self._decode_spec, self._flush_state,
+                self._poison_slot, self._unpoison]
+        return hp.HotpathInventory(
+            subject=f"{type(self).__name__}[{self.label}]",
+            executables=specs, tick_functions=tick,
+            steady_functions=("_decode", "_decode_spec",
+                              "_flush_state", "_poison_slot",
+                              "_unpoison"),
+            cache_keys=cache_keys, file=__file__)
+
+    def inspect_hotpath(self):
+        """Device-free hot-path audit (missed donation, fetch-set
+        bloat, host syncs in the tick, steady-tick uploads, recompile-
+        risk cache keys): returns the findings Report and routes its
+        per-rule counts through the ``lint.hotpath.*`` counters."""
+        from ..analysis import hotpath_lint
+        return hotpath_lint.emit_hotpath(
+            hotpath_lint.lint_inventory(self._hotpath_inventory()))
+
+    def _dispatch_steady(self, steady, fn, *args):
+        """Dispatch one tick executable. On a STEADY tick (warm
+        executable, no dirty rows, no fault poison) with
+        ``PADDLE_TPU_LINT=1``, the call runs under
+        ``jax.transfer_guard("disallow")``: any implicit host<->device
+        transfer the static hotpath lint missed raises here instead of
+        silently syncing. The guard wraps ONLY the dispatch — the
+        attributed np.asarray fetches stay outside it."""
+        if steady and _lint_armed():
+            monitor.counter("lint.hotpath.guarded_ticks").increase()
+            with jax.transfer_guard("disallow"):
+                return fn(*args)
+        return fn(*args)
 
     # -- public API ----------------------------------------------------------
 
@@ -1702,7 +1810,10 @@ class Engine:
             # mirror the chunk into the draft pools (same pages, same
             # positions) so drafting attends the full context
             self._spec.prefill(pb, bt_dev, prompt_dev, start_dev)
-        self._sync_timed((tok, okf))
+        # key2 rides in the sync set: the fresh-request path below
+        # reads it (np.asarray) and an unsynced fetch would be an
+        # un-attributed host sync (hotpath.host-sync-in-tick)
+        self._sync_timed((tok, key2, okf))
         self._mon.counter("serving.prefill_tokens").increase(pb)
         self._mon.counter("serving.prefill_slices").increase()
         self._pf_step_tokens += pb
@@ -1870,13 +1981,18 @@ class Engine:
         # still coherent, _safe_decode skips the tick and retries
         self._fault_raise("decode.device_error")
         self._poison_slot(active)
+        # steady = the dirty-row-merge discipline says this tick
+        # uploads nothing and dispatches a warm executable — the
+        # PADDLE_TPU_LINT transfer guard may wrap the dispatch
+        steady = (variant in self._decode_fns and not self._dirty
+                  and not self._bt_dirty and not self._poisoned)
         fn = self._get_decode_fn(variant)
         self._flush_state()
         # the fused step: forward + per-slot sampling + state advance
         # in ONE executable; only the emitted tokens (and the tiny
         # NaN-quarantine flags) come back
-        nxt, okv, self._dev, self._pools = fn(
-            self._st, self._pools, self._bt_dev, self._dev,
+        nxt, okv, self._dev, self._pools = self._dispatch_steady(
+            steady, fn, self._st, self._pools, self._bt_dev, self._dev,
             self._poison_dev)
         self._unpoison()
         self._sync_timed((nxt, okv))
@@ -1935,6 +2051,12 @@ class Engine:
         (verify_token_arrays' exact-match rule)."""
         self._fault_raise("decode.device_error")
         self._poison_slot(active)
+        # steady tick: warm verify + draft-loop executables, nothing
+        # dirty — the lint transfer guard may wrap the verify dispatch
+        steady = (variant in self._verify_fns
+                  and self._spec._loop_fn is not None
+                  and not self._dirty and not self._bt_dirty
+                  and not self._poisoned)
         self._flush_state()
         k = self._spec.k
         drafts = self._spec.draft(self._bt_dev, self._dev[0],
@@ -1946,9 +2068,9 @@ class Engine:
             # tick still yields >= 1 target-chain token)
             drafts = self._spec.sabotage(drafts)
         fn = self._get_verify_fn(variant)
-        toks, acc, okv, self._dev, self._pools = fn(
-            self._st, self._pools, self._bt_dev, self._dev, drafts,
-            self._poison_dev)
+        toks, acc, okv, self._dev, self._pools = self._dispatch_steady(
+            steady, fn, self._st, self._pools, self._bt_dev, self._dev,
+            drafts, self._poison_dev)
         self._unpoison()
         self._sync_timed((toks, acc, okv))
         toks = np.asarray(toks)

@@ -213,7 +213,7 @@ class ServingFleet:
         self._heartbeat_timeout = heartbeat_timeout
         self._heartbeats: Dict[int, object] = {}
         self._stalled: set = set()
-        self._last_step_t = time.time()
+        self._last_step_t = time.monotonic()
         self._replicas: List[Optional[Engine]] = []
         self._replicas_created = 0
         self.replica_stats: Dict[int, Dict[str, int]] = {}
@@ -479,8 +479,8 @@ class ServingFleet:
         replica, autoscale evaluation. Returns every request that
         finished or failed this tick."""
         outs: List[Output] = []
-        step_gap = time.time() - self._last_step_t
-        self._last_step_t = time.time()
+        step_gap = time.monotonic() - self._last_step_t
+        self._last_step_t = time.monotonic()
         c0 = self._tracker.compiles
         sig0 = self._surface_sig()
         inner = 0
@@ -1083,6 +1083,31 @@ class ServingFleet:
     def per_replica_recompiles(self) -> Dict[int, int]:
         return {i: w.steady_state_recompiles()
                 for i, w in self._alive()}
+
+    # -- hot-path lint (docs/ANALYSIS.md "Hot-path rules") -------------------
+
+    def _hotpath_inventory(self):
+        """The fleet DRIVER compiles nothing of its own — its hot-path
+        surface is the routing/sweep tick source; the replicas are
+        full Engines, swept separately by inspect_hotpath()."""
+        from ..analysis import hotpath_lint as hp
+        return hp.HotpathInventory(
+            subject="ServingFleet[driver]", executables=[],
+            tick_functions=[self.step, self._dispatch,
+                            self._sweep_stalled, self._expire,
+                            self._sample_ttft, self._autoscale],
+            steady_functions=(), cache_keys={}, file=__file__)
+
+    def inspect_hotpath(self):
+        """Hot-path audit over the fleet: driver tick path plus every
+        live replica's Engine inventory, one combined Report through
+        the ``lint.hotpath.*`` counters."""
+        from ..analysis import hotpath_lint
+        report = hotpath_lint.lint_inventory(self._hotpath_inventory())
+        for _, w in self._alive():
+            report.extend(hotpath_lint.lint_inventory(
+                w._hotpath_inventory()))
+        return hotpath_lint.emit_hotpath(report)
 
     def close(self):
         self._tracker.stop()

@@ -105,6 +105,12 @@ class SpeculativeDecoder:
         fn = self._prefill_fns.get(pb)
         if fn is not None:
             return fn
+        fn = jax.jit(self._prefill_body(), donate_argnums=(1,))
+        self._prefill_fns[pb] = fn
+        self.engine._note_compile()
+        return fn
+
+    def _prefill_body(self):
         eng = self.engine
         model = self.model
 
@@ -113,10 +119,7 @@ class SpeculativeDecoder:
             _, new_kv = _model_forward(model, st, prompt, kv, start)
             return eng._strip_bt(new_kv)
 
-        fn = jax.jit(body, donate_argnums=(1,))
-        self._prefill_fns[pb] = fn
-        eng._note_compile()
-        return fn
+        return body
 
     def _get_loop_fn(self):
         """The k+1-step draft loop, ONE executable: step j feeds the
@@ -129,6 +132,12 @@ class SpeculativeDecoder:
         positions verify accepts, never what tokens the target emits."""
         if self._loop_fn is not None:
             return self._loop_fn
+        fn = jax.jit(self._loop_body(), donate_argnums=(1,))
+        self._loop_fn = fn
+        self.engine._note_compile()
+        return fn
+
+    def _loop_body(self):
         eng = self.engine
         model = self.model
         k = self.k
@@ -150,10 +159,33 @@ class SpeculativeDecoder:
             # write-only step's by-product — dropped
             return jnp.swapaxes(toks, 0, 1)[:, :k], caches
 
-        fn = jax.jit(body, donate_argnums=(1,))
-        self._loop_fn = fn
-        eng._note_compile()
-        return fn
+        return body
+
+    def hotpath_specs(self):
+        """The draft executables in hotpath_lint's inventory terms:
+        both donate the draft caches (argnum 1) and fetch NOTHING —
+        proposals feed the verify executable device-side."""
+        from ..analysis import hotpath_lint as hp
+        eng = self.engine
+        S, MB = eng.max_slots, eng.max_blocks
+
+        def i32(*shape):
+            return jax.ShapeDtypeStruct(shape, jnp.int32)
+
+        st = hp.struct_of(self._st)
+        pools = hp.struct_of(self._pools)
+        specs = []
+        for pb in tuple(sorted(self._prefill_fns)) \
+                or (eng.prefill_bucket,):
+            specs.append(hp.ExecutableSpec(
+                name=f"draft-prefill[{pb}]", body=self._prefill_body(),
+                args=(st, pools, i32(1, MB), i32(1, pb), i32(1)),
+                donate=(1,), fetched=(), per_tick=False))
+        specs.append(hp.ExecutableSpec(
+            name=f"draft-loop[k={self.k}]", body=self._loop_body(),
+            args=(st, pools, i32(S, MB), i32(S), i32(S), i32(S)),
+            donate=(1,), fetched=()))
+        return specs
 
     # -- engine hooks --------------------------------------------------------
 

@@ -1,0 +1,146 @@
+"""Seeded hot-path defects for the hotpath_lint tests.
+
+Every toy surface here implements ``_hotpath_inventory()`` and
+carries EXACTLY ONE defect at a known ``hotpath.*`` rule id;
+tests/test_hotpath_lint.py asserts each rule fires exactly once on
+its class and that ``CleanToyEngine`` comes back with zero findings
+(the false-positive guard). Unlike lint_defects.py (linted as
+source), this module is IMPORTED — the inventory protocol hands the
+analyzer live executable bodies and bound tick methods, the same way
+Engine/DisaggEngine/ServingFleet/BatchEncoder do.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.analysis.hotpath_lint import (ExecutableSpec,
+                                              HotpathInventory)
+
+
+def _s(shape, dtype=np.float32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# 1 MiB — comfortably over POOL_BYTES_FLOOR / FETCH_BYTES_FLOOR
+_POOL = _s((1024, 256))
+
+
+class UndonatedPoolEngine:
+    """hotpath.missed-donation: the KV-pool-sized argument flows to a
+    same-shape/dtype output but is NOT in donate_argnums — every tick
+    pays a full pool copy instead of aliasing."""
+
+    def _body(self, pool, tok):
+        return pool * 0.5, tok + 1
+
+    def _hotpath_inventory(self):
+        return HotpathInventory(
+            subject="UndonatedPoolEngine",
+            executables=[ExecutableSpec(
+                name="decode", body=self._body,
+                args=(_POOL, _s((4,), np.int32)),
+                donate=(), fetched=(1,))],
+            tick_functions=[], file=__file__)
+
+
+class OverFetchingExecutable:
+    """hotpath.fetch-set-bloat: a per-tick executable materializes a
+    1 MiB activation to host alongside the token vector."""
+
+    def _body(self, tok):
+        return tok + 1, jnp.zeros((64, 4096), jnp.float32)
+
+    def _hotpath_inventory(self):
+        return HotpathInventory(
+            subject="OverFetchingExecutable",
+            executables=[ExecutableSpec(
+                name="decode", body=self._body,
+                args=(_s((4,), np.int32),),
+                donate=(), fetched=(0, 1))],
+            tick_functions=[], file=__file__)
+
+
+class ItemInStepScheduler:
+    """hotpath.host-sync-in-tick: ``.item()`` on the dispatched device
+    value inside ``step()`` — a blocking device round trip per tick."""
+
+    def _get_step_fn(self):
+        return lambda x: x + 1
+
+    def step(self):
+        fn = self._get_step_fn()
+        out = fn(self._x)
+        return out.item()
+
+    def _hotpath_inventory(self):
+        return HotpathInventory(
+            subject="ItemInStepScheduler", executables=[],
+            tick_functions=[self.step], file=__file__)
+
+
+class UnguardedUploadScheduler:
+    """hotpath.steady-tick-upload: an UNCONDITIONAL host->device
+    upload on the steady path — the dirty-row-merge discipline says
+    steady ticks upload nothing."""
+
+    def _flush(self):
+        self._dev = jnp.asarray(self._rows)
+
+    def _hotpath_inventory(self):
+        return HotpathInventory(
+            subject="UnguardedUploadScheduler", executables=[],
+            tick_functions=[self._flush],
+            steady_functions=("_flush",), file=__file__)
+
+
+class FloatKeyedCache:
+    """hotpath.recompile-risk-key: an executable cache keyed on a
+    Python float — near-equal floats silently compile near-identical
+    executables."""
+
+    def _hotpath_inventory(self):
+        return HotpathInventory(
+            subject="FloatKeyedCache", executables=[],
+            tick_functions=[],
+            cache_keys={"_fns": [0.7, "greedy"]}, file=__file__)
+
+
+class CleanToyEngine:
+    """Every rule's SANCTIONED pattern in one surface — must lint with
+    zero findings (the false-positive guard): pool donated, only the
+    small token vector fetched, fetches routed through _sync_timed,
+    uploads gated behind the dirty flag, int/str cache keys."""
+
+    def __init__(self):
+        self._dirty = False
+
+    def _body(self, pool, tok):
+        return pool * 0.5, tok + 1
+
+    def _get_step_fn(self):
+        return lambda p, t: (p, t)
+
+    def _sync_timed(self, outs):
+        jax.block_until_ready(outs)
+
+    def step(self):
+        fn = self._get_step_fn()
+        pool, tok = fn(self._pool, self._tok)
+        self._sync_timed((tok,))
+        host = np.asarray(tok)
+        return host
+
+    def _flush(self):
+        if self._dirty:
+            self._dev = jnp.asarray(self._rows)
+
+    def _hotpath_inventory(self):
+        return HotpathInventory(
+            subject="CleanToyEngine",
+            executables=[ExecutableSpec(
+                name="decode", body=self._body,
+                args=(_POOL, _s((4,), np.int32)),
+                donate=(0,), fetched=(1,))],
+            tick_functions=[self.step, self._flush],
+            steady_functions=("_flush",),
+            cache_keys={"_fns": [8, "greedy"]}, file=__file__)

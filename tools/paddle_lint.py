@@ -26,6 +26,18 @@ under a fake 8-device mesh — still zero devices, abstract traces only
 SPMD/collective rules). `--cost` adds each case's static cost table
 (bytes moved / FLOPs / peak HBM per rank).
 
+`--hotpath` (also imports paddle_tpu + jax, still device-free) runs
+the hot-path analyzer (analysis/hotpath_lint.py) over the whole
+serving stack: it builds tiny Engine / DisaggEngine / ServingFleet /
+BatchEncoder surfaces, abstract-traces every compiled executable in
+their inventories, and AST-walks their tick schedulers — missed
+donations, fetch-set bloat, host syncs in the tick loop, steady-tick
+uploads, recompile-risk cache keys. Must come back clean (the CI
+guard for the serving hot path); `--self-check` runs the same sweep
+when jax imports (cold — surfaces built but not driven, which covers
+the same executable bodies with the default variant sets). Per-rule
+counts land in the text summary and the json `hotpath` block.
+
 `--plan` (also imports paddle_tpu + jax, still device-free) runs the
 auto-parallel planner (analysis.planner) for a model preset over
 `--devices` chips and prints the top `--top` ranked plans with their
@@ -146,6 +158,10 @@ def main(argv=None) -> int:
                     help="shard-lint the dryrun model zoo under a fake "
                          "8-device mesh (imports paddle_tpu+jax; still "
                          "device-free; must be clean)")
+    ap.add_argument("--hotpath", action="store_true",
+                    help="hot-path lint the serving stack (Engine/"
+                         "Disagg/Fleet/BatchEncoder; imports "
+                         "paddle_tpu+jax; device-free; must be clean)")
     ap.add_argument("--cost", action="store_true",
                     help="with --shard-check: print each zoo case's "
                          "static cost table (bytes/FLOPs/peak HBM)")
@@ -174,10 +190,10 @@ def main(argv=None) -> int:
     paths = list(args.paths)
     if args.self_check:
         paths.append(os.path.dirname(_ANALYSIS_DIR))
-    if not paths and not args.shard_check and not args.plan \
-            and not args.plan_calibrate:
+    if not paths and not args.shard_check and not args.hotpath \
+            and not args.plan and not args.plan_calibrate:
         ap.error("no paths given (or use --self-check / --shard-check "
-                 "/ --plan)")
+                 "/ --hotpath / --plan)")
 
     if args.plan or args.plan_calibrate:
         return _run_plan(args)
@@ -215,6 +231,34 @@ def main(argv=None) -> int:
                 if rep.cost is not None:
                     zoo_costs[name] = rep.cost
 
+    hotpath_counts = {}
+    if args.hotpath or args.self_check:
+        # same import contract as the shard zoo: the sweep needs the
+        # real package + jax (abstract traces only, still no devices);
+        # --self-check skips it gracefully on a bare checkout.
+        sys.path.insert(0, os.path.dirname(os.path.dirname(_ANALYSIS_DIR)))
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            from paddle_tpu.analysis.hotpath_lint import sweep_serving_stack
+        except Exception as exc:  # noqa: BLE001
+            if args.hotpath:
+                raise
+            sweep_serving_stack = None
+            print(f"paddle_lint: hotpath sweep skipped — paddle_tpu/"
+                  f"jax unavailable ({type(exc).__name__}: {exc})",
+                  file=sys.stderr)
+        if sweep_serving_stack is not None:
+            # --hotpath lints the surfaces WARM (driven, caches
+            # populated); riding along --self-check a cold build is
+            # enough — same executables, default variant sets
+            for name, rep in sweep_serving_stack(
+                    drive=args.hotpath).items():
+                counts = {r: len(fs) for r, fs in rep.by_rule().items()}
+                hotpath_counts[name] = counts
+                for f in rep:
+                    f.message = f"[hotpath:{name}] {f.message}"
+                    findings.append(f)
+
     if args.rules:
         keep = {r.strip() for r in args.rules.split(",") if r.strip()}
         findings = [f for f in findings if f.rule in keep]
@@ -224,6 +268,8 @@ def main(argv=None) -> int:
         out = json.loads(report.to_json())
         if args.cost and zoo_costs:
             out["costs"] = {k: v.to_dict() for k, v in zoo_costs.items()}
+        if hotpath_counts:
+            out["hotpath"] = hotpath_counts
         print(json.dumps(out, indent=2))
     else:
         print(report.format())
@@ -231,6 +277,11 @@ def main(argv=None) -> int:
             for name, cost in sorted(zoo_costs.items()):
                 print(f"\n[zoo:{name}]")
                 print(cost.format_table())
+        if hotpath_counts:
+            for name, counts in hotpath_counts.items():
+                row = ", ".join(f"{r}={n}" for r, n in
+                                sorted(counts.items())) or "clean"
+                print(f"hotpath {name}: {row}")
         if findings:
             rules = ", ".join(report.rules())
             print(f"\n{len(findings)} finding(s) across rules: {rules}")

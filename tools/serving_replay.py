@@ -18,6 +18,16 @@ Run: python tools/serving_replay.py trace.jsonl [--max-slots 4]
          [--kill-worker decode:1:40]
          [--replicas N --route session] [--kill-replica 1:40]
          [--trace-out spans.json] [--expect-complete-timelines]
+         [--expect-hotpath-clean]
+
+``--expect-hotpath-clean`` (exit 13) lints the DRAINED serving
+surface through ``inspect_hotpath()`` (analysis/hotpath_lint.py):
+every executable the trace compiled is abstract-traced for missed
+donations and fetch-set bloat, and the tick scheduler is AST-walked
+for host syncs / steady-tick uploads / recompile-risk cache keys.
+Works under ``--disagg`` / ``--replicas`` / ``--embedding``; the
+``lint.hotpath.*`` counter deltas land in the report next to
+``xla.compiles``.
 
 ``--model ernie_moe`` replays against an ERNIE-MoE decoder
 (text/models/ernie_moe.py, docs/SERVING.md "MoE serving") instead of
@@ -277,6 +287,16 @@ def _run_embedding(args, trace) -> int:
             return 3
     wall_s = time.perf_counter() - t0
     after = monitor.snapshot()
+    hotpath_report = None
+    if args.expect_hotpath_clean:
+        # lint the DRAINED service (every bucket executable warm) so
+        # the inventory covers exactly what the replay compiled; fold
+        # the lint.hotpath.* counters it bumps into the delta window
+        hotpath_report = svc.inspect_hotpath()
+        after = dict(after)
+        for k, v in monitor.snapshot().items():
+            if k.startswith("lint.hotpath."):
+                after[k] = v
     svc.close()
 
     deltas = {k: int(after.get(k, 0)) - int(before.get(k, 0))
@@ -289,7 +309,8 @@ def _run_embedding(args, trace) -> int:
                                "serving.embed.timeouts",
                                "serving.embed.cancelled",
                                "serving.embed.steps",
-                               "kernels.flash.", "xla.compiles"))
+                               "kernels.flash.", "lint.hotpath.",
+                               "xla.compiles"))
               and int(after.get(k, 0)) - int(before.get(k, 0))}
     failures = {}
     total_tokens = 0
@@ -320,6 +341,12 @@ def _run_embedding(args, trace) -> int:
         "steady_state_recompiles": svc.steady_state_recompiles(),
         "counters": deltas,
     }
+    if hotpath_report is not None:
+        report["hotpath"] = {
+            "findings": len(list(hotpath_report)),
+            "rules": {r: len(fs)
+                      for r, fs in hotpath_report.by_rule().items()},
+        }
     if args.json:
         print(json.dumps(report))
     else:
@@ -349,6 +376,12 @@ def _run_embedding(args, trace) -> int:
               f"mid-trace (docs/SERVING.md 'Embedding service')",
               file=sys.stderr)
         return 11
+    if hotpath_report is not None and hotpath_report:
+        print(f"serving_replay: --expect-hotpath-clean FAILED — "
+              f"{len(list(hotpath_report))} hot-path finding(s) on "
+              f"the drained encoder:\n{hotpath_report.format()}\n"
+              f"(docs/ANALYSIS.md 'Hot-path rules')", file=sys.stderr)
+        return 13
     return 0
 
 
@@ -497,6 +530,15 @@ def main(argv=None) -> int:
                          "across same-seed replays; works under "
                          "--disagg/--replicas/--chaos; "
                          "tools/trace_summary.py tabulates it")
+    ap.add_argument("--expect-hotpath-clean", action="store_true",
+                    help="fail (exit 13) when inspect_hotpath() on "
+                         "the drained serving surface reports any "
+                         "hot-path finding (missed donation, fetch-"
+                         "set bloat, host sync in the tick loop, "
+                         "steady-tick upload, recompile-risk cache "
+                         "key); works under --disagg/--replicas/"
+                         "--embedding; hotpath counter deltas land "
+                         "in the report")
     ap.add_argument("--expect-complete-timelines", action="store_true",
                     help="exit 12 unless every replayed request "
                          "yields exactly one contiguous timeline in "
@@ -891,6 +933,18 @@ def main(argv=None) -> int:
     arrival_vt, steps = run["arrival_vt"], run["steps"]
     wall_s, before, after = run["wall_s"], run["before"], run["after"]
 
+    hotpath_report = None
+    if args.expect_hotpath_clean:
+        # lint the DRAINED surface (every executable the trace
+        # compiled is warm, so the inventory is the replay's real
+        # compiled set); inspect_hotpath bumps lint.hotpath.* AFTER
+        # drive()'s snapshot — fold them into the delta window
+        hotpath_report = eng.inspect_hotpath()
+        after = dict(after)
+        for k, v in monitor.snapshot().items():
+            if k.startswith("lint.hotpath."):
+                after[k] = v
+
     tags = run["tags"]
     ttft = [first_vt[r] - arrival_vt[r] for r in sorted(first_vt)]
     # per-tag TTFT columns (traces may tag request classes, e.g.
@@ -936,7 +990,7 @@ def main(argv=None) -> int:
                                "serving.step_errors",
                                "serving.invariant_repairs",
                                "serving.fault_injected.",
-                               "xla.compiles"))
+                               "lint.hotpath.", "xla.compiles"))
               and int(after.get(k, 0)) - int(before.get(k, 0))}
     # the per-replay decode-path breakdown: which attention path the
     # compiled loops actually baked in (trace-time counters,
@@ -970,6 +1024,12 @@ def main(argv=None) -> int:
         "counters": deltas,
         "steady_state_recompiles": eng.steady_state_recompiles(),
     }
+    if hotpath_report is not None:
+        report["hotpath"] = {
+            "findings": len(list(hotpath_report)),
+            "rules": {r: len(fs)
+                      for r, fs in hotpath_report.by_rule().items()},
+        }
     # the observability plane's report surface: merged (fleet-wide)
     # latency histograms recorded by the engines themselves on the
     # virtual clock, plus the host/device tick attribution gauges
@@ -1345,6 +1405,12 @@ def main(argv=None) -> int:
                   f"migration/failover; docs/OBSERVABILITY.md "
                   f"'Serving timelines')", file=sys.stderr)
             return 12
+    if hotpath_report is not None and hotpath_report:
+        print(f"serving_replay: --expect-hotpath-clean FAILED — "
+              f"{len(list(hotpath_report))} hot-path finding(s) on "
+              f"the drained serving surface:\n{hotpath_report.format()}"
+              f"\n(docs/ANALYSIS.md 'Hot-path rules')", file=sys.stderr)
+        return 13
     return 0
 
 
