@@ -16,6 +16,10 @@ anything executes:
 * `hotpath_lint` — audits a serving surface's tick loop: the compiled
   executable inventory (donation, fetch set, cache keys) plus the
   scheduler source (host syncs, steady-tick uploads), device-free.
+* `mpmd_lint`  — model-checks a pipeline schedule's MPMD event graph
+  (`distributed.mpmd_graph`): deadlock, unmatched p2p, buffer races,
+  HBM high-water, dataflow linearization, stale weights — the static
+  verifier for schedules the pinned runtime cannot execute.
 
 Surfaces: `StaticFunction.inspect()` / `TrainStep.inspect()` /
 `Model.inspect()`, `inspect_hotpath()` on the serving engines, the
@@ -31,11 +35,12 @@ from .ast_lint import (lint_callable, lint_file, lint_paths,  # noqa: F401
                        lint_source)
 from .cost_model import CostEstimate, estimate_jaxpr  # noqa: F401
 from .findings import (AST_RULES, ERROR, HOTPATH_RULES, INFO,  # noqa: F401
-                       JAXPR_RULES, PIPELINE_RULES, SHARD_RULES,
-                       WARNING, Finding, Report)
+                       JAXPR_RULES, MPMD_RULES, PIPELINE_RULES,
+                       SHARD_RULES, WARNING, Finding, Report)
 from .hotpath_lint import (ExecutableSpec, HotpathInventory,  # noqa: F401
                            emit_hotpath, lint_inventory, lint_surface,
                            sweep_serving_stack)
+from .mpmd_lint import check_graph, emit_mpmd, lint_mpmd  # noqa: F401
 from .jaxpr_lint import (lint_closed_jaxpr, lint_static_args,  # noqa: F401
                          lint_static_function, lint_train_step,
                          lint_traceable, to_shape_struct)

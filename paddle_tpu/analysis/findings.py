@@ -62,6 +62,17 @@ HOST_SYNC_IN_TICK = "hotpath.host-sync-in-tick"
 STEADY_TICK_UPLOAD = "hotpath.steady-tick-upload"
 RECOMPILE_RISK_KEY = "hotpath.recompile-risk-key"
 
+# MPMD schedule rules — what mpmd_lint's device-free model check over
+# a distributed.mpmd_graph event graph reveals (docs/ANALYSIS.md "MPMD
+# schedule rules"). Prefixed "mpmd." so the per-rule monitor counters
+# land under lint.mpmd.* through the shared emit path.
+MPMD_DEADLOCK = "mpmd.deadlock"
+MPMD_UNMATCHED_P2P = "mpmd.unmatched-p2p"
+MPMD_BUFFER_RACE = "mpmd.buffer-race"
+MPMD_HBM_OVER_BUDGET = "mpmd.hbm-over-budget"
+MPMD_DATAFLOW_MISMATCH = "mpmd.dataflow-mismatch"
+MPMD_STALE_WEIGHT = "mpmd.stale-weight"
+
 AST_RULES = (TENSOR_BOOL_BRANCH, TENSOR_HOST_SYNC, TENSOR_PY_CAST,
              TENSOR_INPLACE, HOST_RNG)
 JAXPR_RULES = (GRAPH_BREAK, TRACE_FAILED, DTYPE_PROMOTION,
@@ -76,6 +87,9 @@ PIPELINE_RULES = (STAGE_IMBALANCE, BUBBLE_FRACTION, SEGMENT_MISMATCH,
 PLANNER_RULES = (HBM_OVER_BUDGET,)
 HOTPATH_RULES = (MISSED_DONATION, FETCH_SET_BLOAT, HOST_SYNC_IN_TICK,
                  STEADY_TICK_UPLOAD, RECOMPILE_RISK_KEY)
+MPMD_RULES = (MPMD_DEADLOCK, MPMD_UNMATCHED_P2P, MPMD_BUFFER_RACE,
+              MPMD_HBM_OVER_BUDGET, MPMD_DATAFLOW_MISMATCH,
+              MPMD_STALE_WEIGHT)
 
 ERROR = "error"      # will raise at trace time (a _BREAK_ERRORS member)
 WARNING = "warning"  # traces, but recompiles / wastes memory / is wrong

@@ -317,6 +317,10 @@ class ScoredPlan:
     cost: Optional[object] = None        # CostEstimate of the fwd trace
     sync_cost: Optional[object] = None   # CostEstimate of the grad sync
     time: Optional[PredictedTime] = None
+    # MPMD schedule verdict (pipelined plans): {"verified": bool,
+    # "events": int, "findings": int} from the lint_mpmd model check
+    # of the plan's event graph; None for non-pipelined plans
+    mpmd: Optional[Dict[str, object]] = None
 
     @property
     def ok(self) -> bool:
@@ -340,6 +344,7 @@ class ScoredPlan:
                           "message": f.message} for f in self.findings],
             "time": self.time.to_dict() if self.time else None,
             "cost": self.cost.to_dict() if self.cost is not None else None,
+            "mpmd": self.mpmd,
         }
 
     def format(self) -> str:
@@ -860,6 +865,22 @@ def score_plan(spec: ModelSpec, plan: Plan, *,
     out = ScoredPlan(plan=plan, findings=list(findings))
     if dims is None:
         return out
+
+    if plan.degree("pp") > 1:
+        # MPMD schedule prune (same pattern as the shard_lint prune):
+        # model-check the plan's event graph device-free before paying
+        # for the abstract trace; a deadlocking/racing schedule is
+        # rejected with the mpmd.* finding attached.
+        from paddle_tpu.distributed.mpmd_graph import plan_graph
+        from .mpmd_lint import check_graph
+        g = plan_graph(spec, plan, dims=dims)
+        mrep = check_graph(g)
+        out.mpmd = {"verified": not mrep, "events": g.n_events(),
+                    "findings": len(mrep)}
+        if mrep:
+            out.findings.extend(mrep.findings)
+            if any(f.severity == ERROR for f in mrep.findings):
+                return out
 
     mesh = plan.total_degrees()
     fn, args = _fwd_program(spec, plan, dims)

@@ -34,6 +34,18 @@ import pytest  # noqa: E402
 def rng():
     return np.random.default_rng(0)
 
+
+def pytest_collection_modifyitems(config, items):
+    # nightly ⊆ slow: the tier-1 sweep runs `-m 'not slow'`, which
+    # OVERRIDES the addopts marker expression — without this hook every
+    # nightly-marked test (the compile-heavy model-zoo legs, subprocess
+    # launch/ps/rpc matrices, the full multichip dryrun) rides back
+    # into tier-1 and blows its 870s budget (PR 16's rc=124). Nightly
+    # tests keep running via `-m nightly` and the driver's own dryrun.
+    for item in items:
+        if "nightly" in item.keywords:
+            item.add_marker(pytest.mark.slow)
+
 # Persistent XLA compilation cache: compile-heavy distributed tests are
 # the suite's cost center on the 1-CPU CI host; cached executables make
 # re-runs cheap. Safe across runs — keyed by HLO + flags.

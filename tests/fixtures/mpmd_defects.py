@@ -1,0 +1,120 @@
+"""Seeded MPMD schedule defects for the mpmd_lint tests.
+
+Every builder here hand-assembles a minimal ``MpmdGraph`` carrying
+EXACTLY ONE defect at a known ``mpmd.*`` rule id;
+tests/test_mpmd_lint.py asserts each rule fires exactly once on its
+graph and that every REAL schedule builder at its dryrun geometry
+comes back with zero findings (the false-positive guard). Pure
+Python over integers — no jax — like the graphs themselves.
+"""
+from paddle_tpu.distributed.mpmd_graph import (BWD, FWD, W, MpmdGraph,
+                                               gpipe_graph, ring_graph,
+                                               single_stage_graph,
+                                               vpp_graph, zb_graph,
+                                               zbvpp_graph)
+
+
+def deadlock_graph() -> MpmdGraph:
+    """mpmd.deadlock: stage 0 issues two sends on a capacity-1 route
+    before stage 1's single event consumes either — the second send
+    needs the slot the consumer frees, the consumer needs the second
+    payload. The strong comm edge closes the capacity back-edge into
+    an unsatisfiable cycle."""
+    g = MpmdGraph(2, n_micro=2, act_shape=(2, 2),
+                  subject="defect(deadlock)", file=__file__)
+    g.channel_capacity[(0, 1)] = 1
+    s0 = g.add_event(0, 0, FWD, tick=0)
+    s1 = g.add_event(0, 1, FWD, tick=1)
+    sink = g.add_event(1, 0, FWD, tick=2)
+    for src in (s0, s1):
+        g.connect(src, sink, tag=(FWD, src.micro, 0))
+    return g
+
+
+def orphan_send_graph() -> MpmdGraph:
+    """mpmd.unmatched-p2p: a send with no matching recv anywhere on
+    its route — the payload is produced and never consumed."""
+    from paddle_tpu.distributed.mpmd_graph import Msg
+    g = MpmdGraph(2, n_micro=1, act_shape=(2, 2),
+                  subject="defect(orphan-send)", file=__file__)
+    src = g.add_event(0, 0, FWD, tick=0)
+    g.add_event(1, 0, FWD, tick=1)          # runs, but never recvs
+    src.sends.append(Msg(peer=1, tag=(FWD, 0, 0), shape=(2, 2),
+                         dtype="float32"))
+    return g
+
+
+def slot_overwrite_graph() -> MpmdGraph:
+    """mpmd.buffer-race: two writes land on the same activation slot
+    before the (single) read drains it — the first microbatch's
+    stashed input is silently replaced."""
+    g = MpmdGraph(1, n_micro=2, act_shape=(2, 2),
+                  subject="defect(slot-overwrite)", file=__file__)
+    g.add_buffer(0, "acts", slots=1, slot_bytes=16)
+    w0 = g.add_event(0, 0, FWD, tick=0)
+    w0.writes.append(("acts", 0))
+    w1 = g.add_event(0, 1, FWD, tick=1)
+    w1.writes.append(("acts", 0))
+    rd = g.add_event(0, 0, BWD, tick=2)
+    rd.reads.append(("acts", 0))
+    g.add_dep(w0.key, rd.key)
+    return g
+
+
+def stale_weight_graph() -> MpmdGraph:
+    """mpmd.stale-weight: a W-phase weight write scheduled between two
+    forwards of the same (stage, chunk) — the second fwd consumes
+    mid-step-updated weights."""
+    g = MpmdGraph(1, n_micro=2, act_shape=(2, 2),
+                  subject="defect(stale-weight)", file=__file__)
+    f0 = g.add_event(0, 0, FWD, tick=0)
+    g.add_event(0, 0, W, tick=1)
+    f1 = g.add_event(0, 1, FWD, tick=2)
+    g.add_dep(f0.key, f1.key)
+    return g
+
+
+def non_topological_graph() -> MpmdGraph:
+    """mpmd.dataflow-mismatch: the execution order runs bwd(m1) a tick
+    BEFORE the fwd(m1) it differentiates — not a linearization of the
+    chain-rule DAG."""
+    g = MpmdGraph(1, n_micro=2, act_shape=(2, 2),
+                  subject="defect(non-topological)", file=__file__)
+    f0 = g.add_event(0, 0, FWD, tick=0)
+    b1 = g.add_event(0, 1, BWD, tick=1)
+    f1 = g.add_event(0, 1, FWD, tick=2)
+    b0 = g.add_event(0, 0, BWD, tick=3)
+    g.add_dep(f0.key, b0.key)
+    g.add_dep(f1.key, b1.key)       # violated: tick 2 > tick 1
+    return g
+
+
+def hbm_over_budget_case():
+    """mpmd.hbm-over-budget: a perfectly clean FThenB graph checked
+    against a budget smaller than one stage's M-deep activation stash.
+    Returns (graph, budget_bytes)."""
+    g = gpipe_graph(4, 4, act_shape=(4, 16))
+    g.subject = "defect(hbm-over-budget)"
+    return g, float(g.act_bytes())   # stash peaks at M * act_bytes
+
+
+DEFECT_BUILDERS = {
+    "mpmd.deadlock": deadlock_graph,
+    "mpmd.unmatched-p2p": orphan_send_graph,
+    "mpmd.buffer-race": slot_overwrite_graph,
+    "mpmd.stale-weight": stale_weight_graph,
+    "mpmd.dataflow-mismatch": non_topological_graph,
+}
+
+
+def clean_graphs():
+    """Every real schedule builder at its dryrun geometry — the
+    false-positive guard. All must verify with zero findings."""
+    return [
+        gpipe_graph(4, 4), gpipe_graph(2, 2), gpipe_graph(4, 8),
+        vpp_graph(4, 4, 2), vpp_graph(2, 2, 2),
+        zb_graph(4, 8), zb_graph(2, 4),
+        zbvpp_graph(4, 4, 2),
+        single_stage_graph(4),
+        ring_graph(4), ring_graph(2),
+    ]

@@ -114,6 +114,9 @@ def test_vision_models_forward():
 
 
 @pytest.mark.nightly
+# tuner matrix leg: auto_tuner_search_and_prune + the planner-backend
+# tuner tests (test_planner) keep the tune() surface tier-1.
+@pytest.mark.slow
 def test_auto_tuner_measured_trials():
     """tune(measure=True) launches subprocess dryruns on the virtual mesh
     and picks the measured-fastest config (VERDICT r2 item 9; reference

@@ -85,6 +85,10 @@ def test_page_boundary_context_lengths(rng):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), **TOL)
 
 
+# partition matrix leg: mixed_live_dead/page_boundary/int8_scale
+# keep the paged kernel tier-1; the chunk x headblock sweep rides
+# slow.
+@pytest.mark.slow
 def test_chunk_and_headblock_partitions_agree(rng):
     """Every legal (pages_per_chunk, kv_heads_per_block) partition of
     the same problem — different DMA schedules, different grid shapes

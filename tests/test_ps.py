@@ -47,6 +47,9 @@ def test_ps_loopback_dense_and_sparse():
 
 
 @pytest.mark.nightly
+# ps matrix leg: ps_loopback_dense_and_sparse keeps the dense+sparse
+# push/pull loop tier-1; the embedding training loop rides slow.
+@pytest.mark.slow
 def test_ps_embedding_training_loop(tmp_path):
     """A tiny embedding 'training' loop against the PS: pull rows, take a
     gradient step on-host, push; the table converges toward the target."""
@@ -265,6 +268,9 @@ def test_fleet_ps_geo_async_mode():
         fleet.stop_worker()
 
 
+# ps matrix leg: optimizer-isolation variant of the loopback path
+# already covered tier-1 by ps_loopback_dense_and_sparse.
+@pytest.mark.slow
 def test_fleet_ps_two_optimizers_do_not_cross():
     """Each PSOptimizer owns its embeddings: a geo-async optimizer for
     one model must not flip another model's embeddings into geo mode or

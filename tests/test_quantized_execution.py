@@ -35,6 +35,9 @@ def test_weight_only_linear_executes_int8(rng):
     assert rel < 0.02, rel
 
 
+# quant matrix leg: the int8 execute + llm_int8 matmul tests keep
+# weight-only quant tier-1; int4+group-scale variants ride slow.
+@pytest.mark.slow
 def test_weight_only_linear_int4_and_group_scales(rng):
     lin = _mk_linear(rng, bias=False)
     x = paddle.to_tensor(

@@ -499,6 +499,9 @@ def _assert_chaos_contract(eng, inj, outs, refs):
     return survivors
 
 
+# chaos matrix leg: test_serving_replay_chaos_exit_codes drives the
+# same injector through the CLI gate tier-1 at 2/3 the cost.
+@pytest.mark.slow
 def test_chaos_short_run_all_sites(rng):
     """Fast chaos pass (tier-1): every fault site armed at a rate that
     fires a handful of faults; survivors token-exact, pool balanced,

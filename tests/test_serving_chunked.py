@@ -164,6 +164,10 @@ def test_preempt_mid_prefill_at_slice_boundary(rng):
     assert eng.steady_state_recompiles() == 0
 
 
+# snapshot matrix leg: reliability's snapshot_restore_token_exact_
+# full_matrix keeps snapshot/restore tier-1; the chunked-slice
+# boundary variant rides slow.
+@pytest.mark.slow
 def test_snapshot_restore_at_slice_boundary(rng):
     """snapshot() while the whale is half-prefilled (state PREFILL
     between ticks) restores through the resume machinery bit-exactly:

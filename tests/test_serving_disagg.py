@@ -130,6 +130,10 @@ def test_disagg_matches_single_loop_engine(rng):
     eng.close()
 
 
+# prefix matrix leg: disagg_matches_single_loop_engine keeps the
+# prefill->decode migration path tier-1; cross-boundary prefix
+# sharing rides the slow tier.
+@pytest.mark.slow
 def test_disagg_prefix_shared_pages_cross_boundary(rng):
     """Prefix-cache-shared pages crossing the prefill→decode boundary:
     the migrated copy is private to the decode worker, the prefill-side
@@ -583,6 +587,8 @@ def test_llama_tp2_generate_token_exact(mp2_mesh):
         np.testing.assert_array_equal(o, r, err_msg=f"variant {i}")
 
 
+@pytest.mark.slow  # tp2 matrix leg: test_llama_tp2_generate_token_exact
+# keeps the mp=2 decode parity path in tier-1 at a third of the cost
 def test_llama_tp2_engine_decode_token_exact(mp2_mesh):
     """The serving engine's fused decode step under mp=2 (KV pools
     sharded over the kv-head axis): token-exact vs the single-device

@@ -47,6 +47,10 @@ def _ref_embed(net, tokens, pooling):
     return np.asarray(x.numpy())[0].astype(np.float32).mean(axis=0)
 
 
+# pooling matrix leg: zero_recompiles_across_bucket_mix +
+# flash_sdpa_path + replay_embedding_mode keep the encoder
+# batch-vs-b1 path tier-1 per-pooling.
+@pytest.mark.slow
 def test_embed_batched_equals_b1_mixed_pooling(rng):
     """The acceptance bar: any batch/bucket/pooling mix produces the
     same embedding as encoding each request alone — key-masked flash

@@ -299,6 +299,8 @@ def test_kill_replica_failover_exact(rng):
     fleet.close()
 
 
+@pytest.mark.slow  # failover matrix leg: kill_replica_failover_exact
+# keeps the same detect->drain->reroute path in tier-1
 def test_heartbeat_stall_failover(rng):
     """A replica whose heartbeat stalls WHILE the driver keeps
     stepping is wedged: it is killed and failed over, and its requests
@@ -346,6 +348,9 @@ def test_heartbeat_stall_failover(rng):
     fleet.close()
 
 
+# autoscale matrix leg: drain_and_undrain + replay_fleet_with_
+# replica_kill keep the add/remove-replica path tier-1.
+@pytest.mark.slow
 def test_autoscale_up_down_no_drops(rng):
     """Queue pressure scales the fleet up; sustained low load scales
     it back down via drain-migration — every request finishes
@@ -383,6 +388,8 @@ def test_autoscale_up_down_no_drops(rng):
     fleet.close()
 
 
+@pytest.mark.slow  # snapshot matrix leg: the spec/prefix/preempt
+# migration-exactness test keeps snapshot+migration in tier-1
 def test_fleet_snapshot_restore_parked_migration(rng):
     """snapshot() round-trips requests PARKED mid-migration (extracted
     from the source, not yet re-admitted): a fresh fleet restores the

@@ -207,6 +207,9 @@ def test_moe_engine_snapshot_restore_token_exact(rng):
     assert eng_b.steady_state_recompiles() == 0
 
 
+# spec matrix leg: moe seeded-sampling + forced-pallas counter proof
+# keep MoE decode tier-1; the dense-draft spec combo rides slow.
+@pytest.mark.slow
 def test_moe_dense_draft_spec_token_exact(rng):
     """Dense-draft speculative decoding against the MoE verifier: a
     1-layer dense LLaMA drafts, the sparse model verifies — outputs

@@ -61,6 +61,9 @@ def test_llama_tied_embeddings():
     assert net.llama.embed_tokens.weight.grad is not None
 
 
+# tp matrix leg: test_serving_disagg's tp2 generate/decode parity
+# keeps the mp-sharded llama path tier-1 at half the cost.
+@pytest.mark.slow
 def test_llama_tp_matches_single_device(tp_mesh):
     """TP forward numerics must match the dense single-device model
     (reference hybrid_strategy acc-align pattern)."""
@@ -249,6 +252,9 @@ def test_llama_recompute_granularity_numerics(gran):
                                atol=1e-6)
 
 
+# bench smoke: test_bench_protocol pins the bench surface tier-1;
+# driving the actual extra paths stays in the slow tier.
+@pytest.mark.slow
 def test_bench_extra_paths_smoke():
     """bench.py's BERT / ERNIE-MoE extras (BASELINE configs 3 and 5)
     must stay runnable — a broken extra records an error in the bench

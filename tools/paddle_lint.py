@@ -38,6 +38,15 @@ when jax imports (cold — surfaces built but not driven, which covers
 the same executable bodies with the default variant sets). Per-rule
 counts land in the text summary and the json `hotpath` block.
 
+`--mpmd-check` (also imports paddle_tpu, still device-free — the
+graphs are pure Python over integers) model-checks every MULTICHIP
+phase's pipeline schedule as an MPMD event graph
+(distributed/mpmd_graph.py + analysis/mpmd_lint.py): deadlock,
+unmatched p2p, buffer races, dataflow linearization, stale weights —
+including the 8 phases the pinned runtime cannot execute. Must come
+back clean; `--self-check` rides the same sweep and `--format json`
+carries the per-phase per-rule counts in the `mpmd` block.
+
 `--plan` (also imports paddle_tpu + jax, still device-free) runs the
 auto-parallel planner (analysis.planner) for a model preset over
 `--devices` chips and prints the top `--top` ranked plans with their
@@ -130,6 +139,11 @@ def _run_plan(args) -> int:
     for i, sp in enumerate(ok):
         print(f"\n#{i + 1} {sp.plan.describe()}")
         print(f"  {sp.time.format()}")
+        if sp.mpmd is not None:
+            mark = ("verified" if sp.mpmd["verified"]
+                    else f"{sp.mpmd['findings']} finding(s)")
+            print(f"  mpmd schedule: {mark} "
+                  f"({sp.mpmd['events']} events)")
         if sp.cost is not None:
             print("  " + sp.cost.format_table().replace("\n", "\n  "))
     if bad:
@@ -162,6 +176,10 @@ def main(argv=None) -> int:
                     help="hot-path lint the serving stack (Engine/"
                          "Disagg/Fleet/BatchEncoder; imports "
                          "paddle_tpu+jax; device-free; must be clean)")
+    ap.add_argument("--mpmd-check", action="store_true",
+                    help="model-check every MULTICHIP phase's pipeline "
+                         "schedule as an MPMD event graph (imports "
+                         "paddle_tpu; device-free; must be clean)")
     ap.add_argument("--cost", action="store_true",
                     help="with --shard-check: print each zoo case's "
                          "static cost table (bytes/FLOPs/peak HBM)")
@@ -191,9 +209,10 @@ def main(argv=None) -> int:
     if args.self_check:
         paths.append(os.path.dirname(_ANALYSIS_DIR))
     if not paths and not args.shard_check and not args.hotpath \
-            and not args.plan and not args.plan_calibrate:
+            and not args.mpmd_check and not args.plan \
+            and not args.plan_calibrate:
         ap.error("no paths given (or use --self-check / --shard-check "
-                 "/ --hotpath / --plan)")
+                 "/ --hotpath / --mpmd-check / --plan)")
 
     if args.plan or args.plan_calibrate:
         return _run_plan(args)
@@ -259,6 +278,32 @@ def main(argv=None) -> int:
                     f.message = f"[hotpath:{name}] {f.message}"
                     findings.append(f)
 
+    mpmd_counts = {}
+    if args.mpmd_check or args.self_check:
+        # the graphs are pure Python over integers, but reaching them
+        # imports the package (and thus jax); --self-check skips the
+        # sweep gracefully on a bare checkout, --mpmd-check demands it.
+        sys.path.insert(0, os.path.dirname(os.path.dirname(_ANALYSIS_DIR)))
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            from paddle_tpu.distributed.dryrun import mpmd_phase_reports
+        except Exception as exc:  # noqa: BLE001
+            if args.mpmd_check:
+                raise
+            mpmd_phase_reports = None
+            print(f"paddle_lint: mpmd sweep skipped — paddle_tpu "
+                  f"unavailable ({type(exc).__name__}: {exc})",
+                  file=sys.stderr)
+        if mpmd_phase_reports is not None:
+            for name, rep in mpmd_phase_reports(args.devices):
+                if rep is None:
+                    continue
+                mpmd_counts[name] = {r: len(fs) for r, fs
+                                     in rep.by_rule().items()}
+                for f in rep:
+                    f.message = f"[mpmd:{name}] {f.message}"
+                    findings.append(f)
+
     if args.rules:
         keep = {r.strip() for r in args.rules.split(",") if r.strip()}
         findings = [f for f in findings if f.rule in keep]
@@ -270,6 +315,8 @@ def main(argv=None) -> int:
             out["costs"] = {k: v.to_dict() for k, v in zoo_costs.items()}
         if hotpath_counts:
             out["hotpath"] = hotpath_counts
+        if mpmd_counts:
+            out["mpmd"] = mpmd_counts
         print(json.dumps(out, indent=2))
     else:
         print(report.format())
@@ -282,6 +329,11 @@ def main(argv=None) -> int:
                 row = ", ".join(f"{r}={n}" for r, n in
                                 sorted(counts.items())) or "clean"
                 print(f"hotpath {name}: {row}")
+        if mpmd_counts:
+            for name, counts in mpmd_counts.items():
+                row = ", ".join(f"{r}={n}" for r, n in
+                                sorted(counts.items())) or "verified"
+                print(f"mpmd {name}: {row}")
         if findings:
             rules = ", ".join(report.rules())
             print(f"\n{len(findings)} finding(s) across rules: {rules}")
