@@ -515,6 +515,12 @@ def _drive_serving_trace(eng, arrivals, prompts, n_requests,
     return toks / (time.perf_counter() - t0)
 
 
+# steady-state host share of the LAST bench_llama_serving measured
+# pass (compile pass excluded) — read by the serving extras right
+# after the tokens/sec number they ran for
+_LAST_SERVING_HOST_SHARE = 0.0
+
+
 def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
                         prompt_hi=192, new_tokens=128,
                         arrival_rate_hz=40.0, cache_dtype="auto",
@@ -523,7 +529,8 @@ def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
                         fault_rate=0.0, fault_seed=0,
                         whale_every=0, whale_prompt=0,
                         max_prefill_tokens=None,
-                        prefill_workers=0, decode_workers=0):
+                        prefill_workers=0, decode_workers=0,
+                        multi_tick=8):
     """Continuous-batching serving throughput on the 1B model
     (paddle_tpu.inference.Engine over the paged KV stack,
     docs/SERVING.md): a fixed-seed Poisson-ish arrival trace
@@ -563,7 +570,14 @@ def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
     DISAGGREGATED engine (inference/disagg.py, docs/SERVING.md
     "Disaggregated serving"): that many prefill/decode workers as
     independent compiled surfaces, KV pages migrating between their
-    pools — the serving point for the MPMD split."""
+    pools — the serving point for the MPMD split.
+
+    multi_tick=K (default 8, docs/SERVING.md "Dispatch pipelining &
+    multi-tick decode") lets the engine run up to K greedy device
+    ticks per host round-trip as one fused scan executable — the
+    trace is all-greedy (temperature 0), so steady decode stretches
+    fuse and the host-share key moves with it. multi_tick=1 restores
+    the one-tick-per-step loop."""
     import paddle_tpu as paddle
     from paddle_tpu.inference.engine import Engine, SamplingParams
     from paddle_tpu.text.models import LlamaConfig, LlamaForCausalLM
@@ -626,7 +640,8 @@ def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
                   cache_dtype=cache_dtype, prefix_cache=prefix_cache,
                   draft_model=draft, spec_k=spec_k,
                   fault_injector=injector,
-                  max_prefill_tokens_per_step=max_prefill_tokens)
+                  max_prefill_tokens_per_step=max_prefill_tokens,
+                  multi_tick=multi_tick)
     if prefill_workers > 0 or decode_workers > 0:
         from paddle_tpu.inference.disagg import DisaggEngine
         eng = DisaggEngine(net, prefill_workers=max(prefill_workers, 1),
@@ -640,7 +655,20 @@ def bench_llama_serving(n_requests=24, max_slots=16, prompt_lo=64,
                                     new_tokens)
 
     run_trace()                 # compile pass (warms eng's executables)
+    # host/device attribution over the MEASURED pass only: the cold
+    # pass above puts every compile on the host side of the split, so
+    # sampling the subtractable histogram sums here (not around the
+    # whole bench) is what makes the share a steady-state number
+    from paddle_tpu import monitor
+    host_h = monitor.histogram("serving.hist.host_ms_per_tick")
+    dev_h = monitor.histogram("serving.hist.device_ms_per_tick")
+    h0, d0 = host_h.sum, dev_h.sum
     tok_s = run_trace()
+    host_ms = host_h.sum - h0
+    dev_ms = dev_h.sum - d0
+    global _LAST_SERVING_HOST_SHARE
+    _LAST_SERVING_HOST_SHARE = (host_ms / (host_ms + dev_ms)
+                                if host_ms + dev_ms > 0.0 else 0.0)
     if injector is not None:
         # the chaos contract, enforced on the measured pass too: no
         # leaked pages, no lingering refcount skew
@@ -1242,23 +1270,44 @@ def main():
         # host/device tick attribution rides the same measured trace:
         # every Engine.step() splits its wall time into host-schedule
         # vs device-dispatch histograms (docs/OBSERVABILITY.md), and
-        # histogram sums are subtractable, so the share over exactly
-        # this bench's ticks costs no extra run. A high share at
-        # max_slots means the serving loop is host-bound, the thing
-        # the tokens/sec headline can't distinguish from a slow chip.
-        from paddle_tpu import monitor
-        host_h = monitor.histogram("serving.hist.host_ms_per_tick")
-        dev_h = monitor.histogram("serving.hist.device_ms_per_tick")
-        h0, d0 = host_h.sum, dev_h.sum
+        # the bench samples the subtractable sums around its MEASURED
+        # pass (compiles excluded), so the share over exactly those
+        # ticks costs no extra run. A high share at max_slots means
+        # the serving loop is host-bound, the thing the tokens/sec
+        # headline can't distinguish from a slow chip. With multi-tick
+        # fused decode on by default (k=8) the share is per DEVICE
+        # tick — host work amortizes over each fused stretch.
         tok = _record_decode_path("serving", bench_llama_serving)
         result["extras"]["llama_1b_serving_tokens_per_sec"] = \
             round(tok, 1)
-        host_ms = host_h.sum - h0
-        dev_ms = dev_h.sum - d0
-        share = (host_ms / (host_ms + dev_ms)
-                 if host_ms + dev_ms > 0.0 else 0.0)
         result["extras"]["llama_1b_serving_host_share_per_tick"] = \
+            round(_LAST_SERVING_HOST_SHARE, 4)
+
+    def add_serving_multi_tick():
+        # the raw-speed point (docs/SERVING.md "Dispatch pipelining &
+        # multi-tick decode", docs/PERF.md "Host share"): the standard
+        # greedy arrival trace with multi-tick fused decode pinned to
+        # k=8, and the host-share budget enforced IN-BENCH — a chip
+        # run where host work still eats >= 10% of (host+device) tick
+        # time fails loudly instead of recording a pretty tokens/sec.
+        # (On the CPU backend "device" time is the same host's XLA
+        # threads, so the gate only records there — same convention
+        # as the MoE fallback-counter gate.)
+        tok = _record_decode_path(
+            "serving_multi_tick",
+            lambda: bench_llama_serving(multi_tick=8))
+        result["extras"]["llama_1b_serving_multi_tick_tokens_per_sec"] \
+            = round(tok, 1)
+        share = _LAST_SERVING_HOST_SHARE
+        result["extras"]["llama_1b_serving_multi_tick_host_share"] = \
             round(share, 4)
+        import jax
+        on_cpu = jax.devices()[0].platform == "cpu"
+        if not on_cpu and share >= 0.10:
+            raise RuntimeError(
+                f"multi-tick serving is host-bound: host share "
+                f"{share:.4f} >= 0.10 of (host+device) tick time over "
+                f"the measured pass (docs/PERF.md 'Host share')")
 
     def add_serving_int8kv():
         # the engine bench finally exercises int8-KV: same arrival
@@ -1432,6 +1481,7 @@ def main():
         ("llama_decode_paged_int8", add_decode_paged_int8, 240),
         ("llama_decode_rolling", add_decode_window, 240),
         ("llama_serving", add_serving, 300),
+        ("llama_serving_multi_tick", add_serving_multi_tick, 300),
         ("llama_serving_int8kv", add_serving_int8kv, 300),
         ("llama_serving_prefix", add_serving_prefix, 300),
         ("llama_serving_spec", add_serving_spec, 300),

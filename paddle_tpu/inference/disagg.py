@@ -243,6 +243,12 @@ class DecodeWorker(Engine):
         self._slots[slot] = req
         self.requests[req.req_id] = req
         Engine._activate(self, req)
+        # the pipelined step() dispatches decode FIRST (its
+        # _ensure_pages pass runs post-harvest), so a slot activated
+        # between steps must get its first write position covered NOW
+        # — a migrated prompt that exactly fills its pages would
+        # otherwise write token one into the scratch page
+        self._ensure_pages()
         return True
 
     def _scatter_body(self):
@@ -320,7 +326,8 @@ class DisaggEngine:
                  prefix_cache: bool = False,
                  draft_model=None, spec_k: int = 4,
                  clock=None, fault_injector=None,
-                 max_prefill_tokens_per_step: Optional[int] = None):
+                 max_prefill_tokens_per_step: Optional[int] = None,
+                 multi_tick: int = 1):
         if int(prefill_workers) < 1 or int(decode_workers) < 1:
             raise ValueError(
                 f"need at least one worker of each kind, got "
@@ -360,9 +367,12 @@ class DisaggEngine:
                 max_prefill_tokens_per_step=max_prefill_tokens_per_step,
                 label=f"prefill{i}", **common)
             for i in range(int(prefill_workers))]
+        # only DECODE workers fuse ticks — prefill workers never run
+        # the decode loop, so multi_tick would be dead weight there
         self.decode: List[Optional[DecodeWorker]] = [
             DecodeWorker(model, max_slots=max_slots,
                          pool_pages=pool_pages, prefix_cache=False,
+                         multi_tick=multi_tick,
                          label=f"decode{i}", **common)
             for i in range(int(decode_workers))]
         w0 = self.decode[0]

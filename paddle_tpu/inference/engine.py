@@ -372,6 +372,26 @@ def _make_paged_pools(layers, rows, hkv, page_size, hd, dtype, quant):
         for _ in range(layers)]
 
 
+@dataclass
+class _PendingTick:
+    """One in-flight decode dispatch (the pipelined tick loop's
+    handoff between dispatch and harvest): the device output futures,
+    a snapshot of the (slot, request) pairs the dispatch covered —
+    harvest skips rows whose request was retired during the overlap
+    window — and the attribution bookkeeping (dispatch wall time +
+    the device-seconds mark, so the harvest sync can attribute host
+    work that ran hidden under device execution as OVERLAP instead of
+    double-counting it)."""
+
+    kind: str                 # "single" | "spec" | "multi"
+    data: tuple               # device outputs to sync + fetch
+    active: list              # [(slot, Request)] snapshot at dispatch
+    ticks: int                # device ticks this dispatch covers
+    t_dispatch: float         # perf_counter at dispatch
+    dev_mark: float           # self._device_s at dispatch
+    k: int = 0                # spec: draft len / multi: fused ticks
+
+
 @jax.jit
 def _merge_rows(dev, host, mask):
     """Fold host-updated slot rows (admissions, preemptions, finishes)
@@ -423,6 +443,7 @@ class Engine:
                  clock=None, fault_injector=None,
                  debug_invariants: Optional[bool] = None,
                  max_prefill_tokens_per_step: Optional[int] = None,
+                 multi_tick: int = 1,
                  label: Optional[str] = None):
         # model polymorphism (docs/SERVING.md): geometry comes from the
         # serving_spec probe, not hard-coded llama config attribute
@@ -469,6 +490,17 @@ class Engine:
                 int(max_prefill_tokens_per_step))
         self.max_prefill_tokens_per_step = max_prefill_tokens_per_step
         self._pf_step_tokens = 0
+        # multi-tick fused decode (docs/SERVING.md "Dispatch
+        # pipelining & multi-tick decode"): when every live slot is in
+        # a pure-greedy decode stretch, up to this many device ticks
+        # run per host round trip as ONE lax.scan executable (in-scan
+        # eos/budget freeze keeps the output token-exact vs the
+        # single-tick loop). 1 = off (the default: one dispatch per
+        # tick, still pipelined against the host scheduling window).
+        if int(multi_tick) < 1:
+            raise ValueError(
+                f"multi_tick must be >= 1, got {multi_tick}")
+        self.multi_tick = int(multi_tick)
         self.max_context = int(max_context or spec["max_context"])
         # speculative decoding writes k+1 positions per tick (the
         # drafted chunk), so the block tables carry that lookahead of
@@ -639,6 +671,28 @@ class Engine:
         self._poison_zeros = self._up(np.zeros((S,), np.float32))
         self._poison_dev = self._poison_zeros
         self._poisoned = False
+        # multi-tick aux state, DEVICE-RESIDENT between fused
+        # dispatches: per-slot eos token id (-1 = none; emitted ids
+        # are >= 0 so -1 never matches) and the remaining
+        # max_new_tokens budget. The scan decrements the budget
+        # in-graph (an eos zeroes it), so consecutive fused dispatches
+        # upload nothing; any host-side slot touch (_activate /
+        # _clear_slot) or token emitted OUTSIDE the fused path
+        # (single-tick / spec harvest) marks it stale and the next
+        # fused dispatch re-uploads the two [max_slots] vectors.
+        self._multi_fns: Dict[int, object] = {}
+        self._aux_dev = (self._up(np.full((S,), -1, np.int32)),
+                        self._up(np.zeros((S,), np.int32)))
+        self._aux_clean = False
+        # dispatch-pipelining attribution (see _sync_timed): host work
+        # that ran while the device was still executing the in-flight
+        # dispatch — hidden under device time, published as the
+        # serving.overlap_ms_per_tick gauge, never double-counted
+        self._overlap_s = 0.0
+        # EWMA of per-device-tick duration on the INJECTABLE clock —
+        # the deadline clamp's horizon unit (deterministic under the
+        # replay tools' virtual clocks)
+        self._tick_est_ms = 0.0
         self.last_stall_snapshot: Optional[dict] = None
         from ..distributed import watchdog as _watchdog
         self._watchdog = _watchdog
@@ -838,6 +892,69 @@ class Engine:
 
         return body
 
+    def _get_multi_fn(self, k: int):
+        """The fused k-tick greedy decode executable — ``k`` decode
+        steps as ONE ``lax.scan`` program (speculative.py's draft loop
+        is the template), dispatched when every live slot is in a
+        pure-greedy stretch. One compile per k bucket (powers of two
+        up to ``multi_tick``, plus ``multi_tick`` itself), so mixed
+        clamp traces bounce between a handful of warm executables with
+        zero steady-state recompiles."""
+        fn = self._multi_fns.get(k)
+        if fn is not None:
+            return fn
+        fn = jax.jit(self._multi_body(k), donate_argnums=(1, 3, 4))
+        self._multi_fns[k] = fn
+        self._note_compile()
+        return fn
+
+    def _multi_body(self, k: int):
+        """Traceable body of the k-tick fused decode. Scan step j:
+        rows still ALIVE (live slot, budget > 0) feed their newest
+        token at position ``pos``; frozen rows ride the dead-slot
+        convention (cache_index -1: no page DMA, no compute, scratch-
+        page write) — an in-scan eos zeroes the row's budget so it
+        writes nothing and consumes nothing for the rest of the scan,
+        and a row whose max_new_tokens budget runs out freezes the
+        same way. Greedy only: argmax consumes no rng, keys pass
+        through untouched, so the emitted stream is bit-identical to
+        k single-tick greedy steps. Poison (the decode.nan fault
+        vector) rides into every step's sampling logits; the per-step
+        ``ok`` matrix lets the host quarantine the offending slot at
+        the exact step the NaN appeared."""
+        model = self.model
+
+        def body(st, caches, bt, state, aux, poison):
+            last, pos, temps, topks, topps, keys, live = state
+            eosv, bud = aux
+
+            def step(carry, _):
+                tok, kv, p, b = carry
+                alive = (live > 0) & (b > 0)
+                idx = jnp.where(alive, p, -jnp.ones_like(p))
+                kvb = self._inject_bt(kv, bt)
+                logits, new_kv = _model_forward(model, st, tok[:, None],
+                                                kvb, idx)
+                cur = logits[:, -1].astype(jnp.float32) + poison[:, None]
+                okr = jnp.isfinite(cur).all(axis=-1)
+                sampled = jnp.argmax(cur, axis=-1).astype(jnp.int32)
+                nxt = jnp.where(alive, sampled, tok)
+                b2 = jnp.where(alive,
+                               jnp.where(sampled == eosv,
+                                         jnp.zeros_like(b), b - 1),
+                               b)
+                return (nxt, self._strip_bt(new_kv), p + alive.astype(
+                    p.dtype), b2), (sampled, okr)
+
+            (tok_f, caches, pos_f, bud_f), (toks, oks) = jax.lax.scan(
+                step, (last, caches, pos, bud), None, length=k)
+            state2 = (tok_f, pos_f, temps, topks, topps, keys, live)
+            # [S, k] per-step tokens + ok flags: the ONLY fetches
+            return (jnp.swapaxes(toks, 0, 1), jnp.swapaxes(oks, 0, 1),
+                    state2, (eosv, bud_f), caches)
+
+        return body
+
     def _get_verify_fn(self, variant: str):
         """The speculative verify executable — ONE fixed-shape
         ``[max_slots, k+1]`` target forward per static sampler variant
@@ -952,6 +1069,14 @@ class Engine:
                 name=f"decode[{v}]", body=self._decode_body(v),
                 args=(st, pools, bt, state, poison),
                 donate=(1, 3), fetched=(0, 1)))
+        aux = hp.struct_of(self._aux_dev)
+        mks = tuple(sorted(self._multi_fns)) \
+            or ((self.multi_tick,) if self.multi_tick > 1 else ())
+        for mk in mks:
+            specs.append(hp.ExecutableSpec(
+                name=f"decode-multi[k={mk}]", body=self._multi_body(mk),
+                args=(st, pools, bt, state, aux, poison),
+                donate=(1, 3, 4), fetched=(0, 1)))
         if self._spec is not None:
             k = self._spec.k
             for v in tuple(self._verify_fns) or variants:
@@ -973,21 +1098,26 @@ class Engine:
                 donate=(1,), fetched=(0, 1, 2), per_tick=False))
         cache_keys = {"_decode_fns": list(self._decode_fns),
                       "_verify_fns": list(self._verify_fns),
-                      "_prefill_fns": list(self._prefill_fns)}
+                      "_prefill_fns": list(self._prefill_fns),
+                      "_multi_fns": list(self._multi_fns)}
         if self._spec is not None:
             cache_keys["_spec._prefill_fns"] = \
                 list(self._spec._prefill_fns)
         tick = [self.step, self._admit, self._expire,
                 self._run_prefills, self._safe_prefill, self._prefill,
-                self._ensure_pages, self._safe_decode, self._decode,
-                self._decode_spec, self._flush_state,
+                self._ensure_pages, self._safe_decode,
+                self._decode_dispatch, self._dispatch_multi,
+                self._dispatch_spec, self._multi_k,
+                self._deadline_ticks, self._decode_harvest,
+                self._harvest_single, self._harvest_multi,
+                self._harvest_spec, self._flush_state,
                 self._poison_slot, self._unpoison]
         return hp.HotpathInventory(
             subject=f"{type(self).__name__}[{self.label}]",
             executables=specs, tick_functions=tick,
-            steady_functions=("_decode", "_decode_spec",
-                              "_flush_state", "_poison_slot",
-                              "_unpoison"),
+            steady_functions=("_decode_dispatch", "_dispatch_multi",
+                              "_dispatch_spec", "_flush_state",
+                              "_poison_slot", "_unpoison"),
             cache_keys=cache_keys, file=__file__)
 
     def inspect_hotpath(self):
@@ -1067,15 +1197,27 @@ class Engine:
         return req.req_id
 
     def step(self) -> List[Output]:
-        """One scheduler tick: expire deadlines, admit + prefill new
-        requests, grow/preempt for page demand, run ONE batched decode
-        step, retire finished requests. Returns the requests that
-        finished OR failed during this tick — a per-request failure
-        (deadline, NaN logits, prefill error) retires that request and
-        never raises out of here."""
+        """One scheduler tick, PIPELINED against the device (JAX async
+        dispatch): the decode work for the slots that were live at the
+        END of the last step is dispatched FIRST, then the host runs
+        the tick-t+1 scheduling — deadline sweeps, admission, prefill
+        slices, watchdog — in the overlap window while the device
+        executes, and only then syncs + harvests the token/ok vectors
+        and grows pages for the next dispatch. Returns the requests
+        that finished OR failed during this tick — a per-request
+        failure (deadline, NaN logits, prefill error) retires that
+        request and never raises out of here.
+
+        With ``multi_tick=k > 1`` a pure-greedy steady stretch runs up
+        to k device ticks per step as ONE fused scan dispatch —
+        deadline / queue-timeout enforcement then lands on dispatch
+        boundaries, so a request can overrun its deadline_ms by at
+        most one dispatch (k ticks) before _expire retires it."""
         outputs: List[Output] = []
         wall0 = time.perf_counter()
+        clk0 = self._clock()
         self._device_s = 0.0
+        self._overlap_s = 0.0
         c0 = self._tracker.compiles
         if self._moe_layer is not None and c0 != self._moe_tracker_mark:
             # compiles landed OUTSIDE our steps since the last sync
@@ -1091,12 +1233,31 @@ class Engine:
             self._injector.on_step(self._steps)
             self._prefix_faults()
         with tape_mod.no_grad_guard():
+            # (a) dispatch the decode executable for the slots settled
+            # by the LAST step — the device starts tick t now
+            pending = self._safe_decode()
+            # (b) overlap window: tick-t+1 host scheduling runs while
+            # the device executes. Exactness is order-insensitive here
+            # (rows are independent; a request admitted now joins the
+            # NEXT dispatch, exactly as the sequential loop's same-step
+            # admission joined the decode after its prefill), and a
+            # request _expire retires mid-flight has its in-flight
+            # token discarded at harvest — the same token the
+            # sequential loop (expire before decode) never produced.
             outputs.extend(self._expire())
             self._pf_step_tokens = 0
             self._admit()
             outputs.extend(self._run_prefills())
+            self._watchdog.maybe_start_and_tick()
+            # (c) sync + harvest: block on the dispatched outputs
+            # (attributed — host work above that hid under device
+            # execution lands in the overlap share), append tokens,
+            # retire finished rows
+            outputs.extend(self._decode_harvest(pending))
+            # (d) page growth for the NEXT dispatch (multi-tick
+            # horizon pre-allocates k ticks of headroom when free
+            # pages allow; preemption key reads are post-sync here)
             self._ensure_pages()
-            outputs.extend(self._safe_decode())
         if self._injector is not None and \
                 self._injector.fire("alloc.refcount_skew",
                                     record=False):
@@ -1111,7 +1272,6 @@ class Engine:
                 self._alloc.share(
                     held[int(self._injector.rng.integers(0, len(held)))])
         self._maybe_audit()
-        self._watchdog.maybe_start_and_tick()
         self._mon.counter("serving.steps").increase()
         self._publish_gauges()
         # MoE path proof (docs/OBSERVABILITY.md "serving.moe.*"): a
@@ -1135,19 +1295,40 @@ class Engine:
             self._warm_compiles = self._compiles
         # host/device tick attribution (ROADMAP item 5's gate input):
         # device time is what the tick spent blocked on dispatched
-        # results (_sync_timed); everything else is host scheduling.
+        # results PLUS the host work that provably ran while the
+        # device was still executing the in-flight dispatch (the
+        # pipelining overlap — _sync_timed's windowed accounting; the
+        # overlap share is also published on its own so the gate
+        # measures real EXPOSED host cost, never double-counted).
         # Wall clock, never the injectable clock — timelines stay
-        # deterministic, attribution stays honest.
+        # deterministic, attribution stays honest. One step = one
+        # dispatch: under multi_tick these are per-DISPATCH values
+        # covering `ticks` device ticks (the sums the bench host-share
+        # gate aggregates stay true trace totals).
         wall_ms = (time.perf_counter() - wall0) * 1e3
         dev_ms = min(self._device_s * 1e3, wall_ms)
         host_ms = wall_ms - dev_ms
+        ov_ms = min(self._overlap_s * 1e3, dev_ms)
         self._mon.gauge("serving.host_ms_per_tick").set(host_ms)
         self._mon.gauge("serving.device_ms_per_tick").set(dev_ms)
+        self._mon.gauge("serving.overlap_ms_per_tick").set(ov_ms)
         self._mon.histogram("serving.hist.host_ms_per_tick").record(
             host_ms)
         self._mon.histogram("serving.hist.device_ms_per_tick").record(
             dev_ms)
+        self._mon.histogram("serving.hist.overlap_ms_per_tick").record(
+            ov_ms)
         self._mon.histogram("serving.hist.tick_ms").record(wall_ms)
+        if pending is not None:
+            if self.multi_tick > 1:
+                self._mon.gauge(
+                    "serving.multi_tick.ticks_per_dispatch").set(
+                        pending.ticks)
+            # per-device-tick duration EWMA on the INJECTABLE clock —
+            # the deadline clamp's horizon unit (_deadline_ticks)
+            d_ms = (self._clock() - clk0) * 1e3 / max(1, pending.ticks)
+            self._tick_est_ms = d_ms if self._tick_est_ms <= 0.0 \
+                else 0.7 * self._tick_est_ms + 0.3 * d_ms
         self._steps += 1
         return outputs
 
@@ -1163,13 +1344,15 @@ class Engine:
         here).
 
         ``heartbeat_timeout=T`` attaches an in-process
-        ``distributed.watchdog.Heartbeat``: every completed step
-        ticks it, and a loop that makes no progress for T seconds
-        triggers ``_stall_report`` — a per-thread stack dump plus a
-        best-effort host-state snapshot (to ``snapshot_path`` when
-        given, always kept on ``last_stall_snapshot``) so a wedged
-        serving process leaves a recoverable trail before the pod is
-        killed."""
+        ``distributed.watchdog.Heartbeat``: every completed step —
+        one DISPATCH, which under ``multi_tick=k`` covers up to k
+        device ticks, so T must exceed the worst-case fused dispatch,
+        not the worst single tick — ticks it, and a loop that makes
+        no progress for T seconds triggers ``_stall_report`` — a
+        per-thread stack dump plus a best-effort host-state snapshot
+        (to ``snapshot_path`` when given, always kept on
+        ``last_stall_snapshot``) so a wedged serving process leaves a
+        recoverable trail before the pod is killed."""
         ids_list = []
         for item in requests:
             if isinstance(item, (tuple, list)) and len(item) == 2 and \
@@ -1450,7 +1633,18 @@ class Engine:
     def _expire(self) -> List[Output]:
         """Tick-start deadline sweep: fail every request past its
         wall deadline (waiting OR mid-decode — its pages free this
-        tick) and every waiting request past its queue-step budget."""
+        tick) and every waiting request past its queue-step budget.
+
+        Enforcement granularity is one DISPATCH, not one device tick:
+        under ``multi_tick=k`` a fused dispatch covers up to k device
+        ticks, so a deadline can be overrun by at most one dispatch
+        before this sweep retires the request (the _deadline_ticks
+        clamp shrinks the fused k toward the nearest deadline, and an
+        expired request's in-flight tokens are discarded at harvest).
+        ``max_queue_steps`` counts step() calls — dispatches — so its
+        wall meaning stretches by up to k during fused stretches; it
+        only ever governs WAITING/PREEMPTED requests, which block
+        fusion anyway (_multi_k admission rung)."""
         outs: List[Output] = []
         now = self._clock()
         for req in list(self._waiting) + [r for r in self._slots
@@ -1542,16 +1736,17 @@ class Engine:
         admission-only (shared_pages) — and hand its slot back."""
         self._clear_slot(req)
 
-    def _safe_decode(self) -> List[Output]:
-        """Isolation wrapper around the batched decode/verify tick: an
-        injected device error fires BEFORE dispatch (host state still
-        coherent), so the engine just skips the tick and retries —
-        requests see one step of extra latency, never corruption."""
+    def _safe_decode(self) -> Optional[_PendingTick]:
+        """Isolation wrapper around the batched decode/verify
+        dispatch: an injected device error fires BEFORE dispatch (host
+        state still coherent), so the engine just skips the tick and
+        retries — requests see one step of extra latency, never
+        corruption."""
         try:
-            return self._decode()
+            return self._decode_dispatch()
         except InjectedFault:
             monitor.counter("serving.step_errors").increase()
-            return []
+            return None
 
     # -- scheduler internals -------------------------------------------------
 
@@ -1636,15 +1831,38 @@ class Engine:
         tracing.open_span(req.spans, phase, t, self.label, slot=slot,
                           **detail)
 
-    def _sync_timed(self, outs) -> None:
+    def _sync_timed(self, outs, dispatch_t: Optional[float] = None,
+                    dev_mark: float = 0.0) -> None:
         """Block until this tick's dispatched device results land,
         charging the wait to the tick's DEVICE share (host/device
         attribution, see step()). The immediate np.asarray consumers
         then read ready buffers — total tick wall time is unchanged,
-        it just gets attributed."""
+        it just gets attributed.
+
+        Pipelined syncs pass ``dispatch_t`` (perf_counter when the
+        executable was dispatched) and ``dev_mark`` (the _device_s
+        reading at dispatch): when the wait actually blocked, the
+        device was provably busy for the WHOLE dispatch→ready window,
+        so the host work that ran inside it is charged to the device
+        share and surfaced as OVERLAP (never double-counted — device
+        seconds other syncs already claimed inside the window are
+        subtracted). A wait that returns immediately means the device
+        finished at an unknown point during the host work, so only the
+        measured block is charged — the conservative split that keeps
+        the host-share gate honest when the HOST is the bottleneck."""
         t0 = time.perf_counter()
         jax.block_until_ready(outs)
-        self._device_s += time.perf_counter() - t0
+        t1 = time.perf_counter()
+        blocked = t1 - t0
+        if dispatch_t is not None:
+            window = t1 - dispatch_t
+            inner = self._device_s - dev_mark
+            extra = window - inner
+            if blocked > 5e-5 and extra > blocked:
+                self._device_s += extra
+                self._overlap_s += extra - blocked
+                return
+        self._device_s += blocked
 
     def _run_prefills(self) -> List[Output]:
         """Run this tick's prefill work over every PREFILL-state slot.
@@ -1799,6 +2017,13 @@ class Engine:
         poison = jnp.asarray(
             [float("nan") if self._fault("prefill.nan") else 0.0],
             jnp.float32)
+        # windowed device attribution, same as the decode dispatches:
+        # the chunk's dispatch→ready span is device-busy even on a
+        # client whose dispatch call runs the computation inline —
+        # without the window the whole prefill forward would read as
+        # HOST time in the host-share gate
+        mark = self._device_s
+        t0 = time.perf_counter()
         tok, key2, okf, self._pools = fn(
             self._st, self._pools, bt_dev, prompt_dev,
             jnp.asarray([T], jnp.int32), start_dev,
@@ -1813,7 +2038,7 @@ class Engine:
         # key2 rides in the sync set: the fresh-request path below
         # reads it (np.asarray) and an unsynced fetch would be an
         # un-attributed host sync (hotpath.host-sync-in-tick)
-        self._sync_timed((tok, key2, okf))
+        self._sync_timed((tok, key2, okf), dispatch_t=t0, dev_mark=mark)
         self._mon.counter("serving.prefill_tokens").increase(pb)
         self._mon.counter("serving.prefill_slices").increase()
         self._pf_step_tokens += pb
@@ -1858,6 +2083,9 @@ class Engine:
         self._live[i] = 1
         self._dirty.add(i)
         self._bt_dirty = True
+        # the device-resident multi-tick aux (eos/budget) doesn't know
+        # this row yet — next fused dispatch re-uploads
+        self._aux_clean = False
         req.state = DECODE
         # one tick-aggregated DECODE span from activation to
         # finish/preempt/migrate (not per tick — the timeline stays
@@ -1869,7 +2097,11 @@ class Engine:
         page this tick's writes land in — one position for the plain
         decode step, k+1 for a speculative draft/verify tick; allocate
         lazily, preempting the YOUNGEST sequence when the pool runs
-        dry (after reclaiming idle prefix-cache pages)."""
+        dry (after reclaiming idle prefix-cache pages). With multi-tick
+        enabled the horizon stretches toward ``multi_tick`` positions
+        — but only from FREE pages (no eviction, no preemption): a
+        short coverage just clamps the fused k, it never costs another
+        request its cache."""
         for i in range(self.max_slots):
             req = self._slots[i]
             if req is None or req.state != DECODE:
@@ -1883,6 +2115,23 @@ class Engine:
                 req.pages.extend(page)
                 self._bt[i, :len(req.pages)] = req.pages
                 self._bt_dirty = True
+        if self.multi_tick > 1 and self._spec is None:
+            for i in range(self.max_slots):
+                req = self._slots[i]
+                if req is None or req.state != DECODE:
+                    continue
+                rem = int(req.params.max_new_tokens) \
+                    - len(req.generated)
+                want = _ceil_div(
+                    req.written + min(max(rem, 1), self.multi_tick),
+                    self.page_size)
+                while len(req.pages) < want \
+                        and self._alloc.can_alloc(1,
+                                                  self.watermark_pages):
+                    req.pages.extend(
+                        self._alloc.alloc(1, seq=req.req_id))
+                    self._bt[i, :len(req.pages)] = req.pages
+                    self._bt_dirty = True
 
     def _alloc_or_preempt(self, req: Request):
         while True:
@@ -1961,12 +2210,18 @@ class Engine:
             self._bt_dev = self._up(self._bt)
             self._bt_dirty = False
 
-    def _decode(self) -> List[Output]:
+    def _decode_dispatch(self) -> Optional[_PendingTick]:
+        """Dispatch this step's decode work and return WITHOUT
+        waiting: the executable runs while step()'s overlap window
+        does the tick-t+1 host scheduling; _decode_harvest syncs and
+        retires. The sampler variant is chosen from the host mirrors
+        of the slots settled by the LAST step — exactly the rows the
+        dispatched executable reads."""
         active = [i for i in range(self.max_slots)
                   if self._slots[i] is not None
                   and self._slots[i].state == DECODE]
         if not active:
-            return []
+            return None
         sampling = [i for i in active if self._temps[i] > 0.0]
         if not sampling:
             variant = "greedy"
@@ -1975,12 +2230,16 @@ class Engine:
             variant = "filtered"
         else:
             variant = "plain"
-        if self._spec is not None:
-            return self._decode_spec(active, variant)
         # injected device loss fires BEFORE dispatch: host state is
         # still coherent, _safe_decode skips the tick and retries
         self._fault_raise("decode.device_error")
         self._poison_slot(active)
+        snap = [(i, self._slots[i]) for i in active]
+        if self._spec is not None:
+            return self._dispatch_spec(snap, variant)
+        mk = self._multi_k(active, variant)
+        if mk > 1:
+            return self._dispatch_multi(snap, mk)
         # steady = the dirty-row-merge discipline says this tick
         # uploads nothing and dispatches a warm executable — the
         # PADDLE_TPU_LINT transfer guard may wrap the dispatch
@@ -1988,6 +2247,8 @@ class Engine:
                   and not self._bt_dirty and not self._poisoned)
         fn = self._get_decode_fn(variant)
         self._flush_state()
+        mark = self._device_s
+        t0 = time.perf_counter()
         # the fused step: forward + per-slot sampling + state advance
         # in ONE executable; only the emitted tokens (and the tiny
         # NaN-quarantine flags) come back
@@ -1995,12 +2256,159 @@ class Engine:
             steady, fn, self._st, self._pools, self._bt_dev, self._dev,
             self._poison_dev)
         self._unpoison()
-        self._sync_timed((nxt, okv))
-        nxt = np.asarray(nxt)
-        okv = np.asarray(okv)
-        outs: List[Output] = []
+        return _PendingTick(kind="single", data=(nxt, okv),
+                            active=snap, ticks=1, t_dispatch=t0,
+                            dev_mark=mark)
+
+    def _multi_k(self, active: List[int], variant: str) -> int:
+        """Eligibility ladder + per-dispatch clamp for the fused
+        multi-tick decode (docs/SERVING.md "Dispatch pipelining &
+        multi-tick decode"). Eligible only when EVERY live slot is in
+        a pure-greedy stretch with nothing pending host-side: greedy
+        variant (no sampler rng), no waiting admissions, no
+        mid-prefill slot, no speculative decoder, no armed poison
+        tick (quarantine timing must match single-tick). The fused
+        length is then clamped so no slot can overrun its allocated
+        page coverage at all, or its max_new_tokens / deadline_ms by
+        more than one dispatch, and rounded DOWN to a compiled k
+        bucket (the in-scan budget freeze makes running FEWER ticks
+        than a row needs always exact)."""
+        K = self.multi_tick
+        if (K <= 1 or self._spec is not None or variant != "greedy"
+                or self._waiting or self._poisoned
+                or self.num_prefilling):
+            return 1
+        horizon = 0      # longest remaining budget over live rows
+        cov = None       # tightest allocated-page coverage
         for i in active:
             req = self._slots[i]
+            horizon = max(horizon, int(req.params.max_new_tokens)
+                          - len(req.generated))
+            c = len(req.pages) * self.page_size - req.written
+            cov = c if cov is None else min(cov, c)
+        k = K
+        if horizon < k:
+            # no point scanning past the longest remaining budget —
+            # every row would be frozen (shorter rows freeze in-graph;
+            # this clamp only drops dead trailing ticks)
+            k = horizon
+            self._mon.counter(
+                "serving.multi_tick.clamp.max_new").increase()
+        if cov is not None and cov < k:
+            # page-boundary horizon: the scan writes up to k positions
+            # with no host allocator in the loop, so k is HARD-capped
+            # by the tightest slot's allocated coverage (_ensure_pages
+            # pre-extends toward multi_tick when free pages allow)
+            k = cov
+            self._mon.counter(
+                "serving.multi_tick.clamp.pages").increase()
+        dl = self._deadline_ticks(active)
+        if dl < k:
+            k = dl
+            self._mon.counter(
+                "serving.multi_tick.clamp.deadline").increase()
+        if k < 2:
+            return 1
+        return self._multi_bucket(k)
+
+    def _multi_bucket(self, k: int) -> int:
+        """Largest compiled k bucket <= k: powers of two, plus
+        ``multi_tick`` itself (so the configured maximum is one warm
+        executable, not two) — a bounded executable set whatever the
+        clamp trace does, keeping steady_state_recompiles()==0."""
+        best = 2
+        b = 2
+        while b * 2 <= k:
+            b *= 2
+            best = b
+        if self.multi_tick <= k:
+            best = max(best, self.multi_tick)
+        return best
+
+    def _deadline_ticks(self, active: List[int]) -> int:
+        """Ticks until the nearest active deadline, in units of the
+        per-device-tick EWMA on the injectable clock — the deadline
+        leg of the multi-tick clamp. Unbounded (multi_tick) when no
+        slot has a deadline or no tick estimate exists yet; a slot
+        that still overshoots (estimate drift) is bounded by the
+        at-most-one-dispatch guarantee and expired by _expire on the
+        next step."""
+        est = self._tick_est_ms
+        if est <= 0.0:
+            return self.multi_tick
+        ticks = self.multi_tick
+        now = self._clock()
+        for i in active:
+            req = self._slots[i]
+            dl = req.params.deadline_ms
+            if dl is None:
+                continue
+            left = float(dl) - (now - req.arrival_t) * 1e3
+            ticks = min(ticks, int(left // est))
+        return max(1, ticks)
+
+    def _dispatch_multi(self, snap, k: int) -> _PendingTick:
+        """Dispatch ONE fused k-tick greedy scan. The aux vectors
+        (per-slot eos id + remaining-token budget) are device-resident
+        and advanced in-graph; they re-upload only after a host-side
+        slot change or tokens emitted outside the fused path
+        (_aux_clean), so back-to-back fused dispatches ship nothing
+        host-to-device."""
+        aux_clean0 = self._aux_clean
+        if not aux_clean0:
+            eos = np.full((self.max_slots,), -1, np.int32)
+            bud = np.zeros((self.max_slots,), np.int32)
+            for i, req in snap:
+                p = req.params
+                if p.eos_token_id is not None:
+                    eos[i] = int(p.eos_token_id)
+                bud[i] = int(p.max_new_tokens) - len(req.generated)
+            self._aux_dev = (self._up(eos), self._up(bud))
+            self._aux_clean = True
+        steady = (k in self._multi_fns and aux_clean0
+                  and not self._dirty and not self._bt_dirty)
+        fn = self._get_multi_fn(k)
+        self._flush_state()
+        mark = self._device_s
+        t0 = time.perf_counter()
+        toks, oks, self._dev, self._aux_dev, self._pools = \
+            self._dispatch_steady(
+                steady, fn, self._st, self._pools, self._bt_dev,
+                self._dev, self._aux_dev, self._poison_dev)
+        self._mon.counter("serving.multi_tick.dispatches").increase()
+        self._mon.counter("serving.multi_tick.ticks").increase(k)
+        return _PendingTick(kind="multi", data=(toks, oks),
+                            active=snap, ticks=k, t_dispatch=t0,
+                            dev_mark=mark, k=k)
+
+    def _decode_harvest(self, pend: Optional[_PendingTick]
+                        ) -> List[Output]:
+        """Sync the in-flight dispatch (attributed: host work that ran
+        hidden under the device is booked as overlap, not
+        double-counted) and retire its tokens. Rows whose request left
+        DECODE during the overlap window (deadline expiry, cancel) are
+        skipped — their in-flight tokens are discarded, exactly what
+        the sequential expire-before-decode order produced."""
+        if pend is None:
+            return []
+        self._sync_timed(pend.data, dispatch_t=pend.t_dispatch,
+                         dev_mark=pend.dev_mark)
+        if pend.kind == "multi":
+            return self._harvest_multi(pend)
+        if pend.kind == "spec":
+            return self._harvest_spec(pend)
+        return self._harvest_single(pend)
+
+    def _harvest_single(self, pend: _PendingTick) -> List[Output]:
+        nxt = np.asarray(pend.data[0])
+        okv = np.asarray(pend.data[1])
+        # tokens appended here move budgets the device-resident
+        # multi-tick aux never saw — next fused dispatch re-uploads
+        self._aux_clean = False
+        outs: List[Output] = []
+        for i, req in pend.active:
+            if self._slots[i] is not req or req.state != DECODE:
+                continue          # retired in the overlap window
             if not bool(okv[i]):
                 # NaN/inf logits on THIS slot only: quarantine it
                 # (token discarded, pages freed, slot back to the
@@ -2024,6 +2432,61 @@ class Engine:
                 outs.append(self._finish(req, reason))
         return outs
 
+    def _harvest_multi(self, pend: _PendingTick) -> List[Output]:
+        """Walk the fused dispatch's [S, k] token/ok matrices exactly
+        as k single-tick harvests would: append until the row's eos or
+        length exit (the same condition that froze it in-graph — the
+        walk never reads past the freeze point), fail the slot at the
+        first not-ok step keeping its earlier tokens, and discard the
+        post-finish garbage columns."""
+        toks = np.asarray(pend.data[0])
+        oks = np.asarray(pend.data[1])
+        outs: List[Output] = []
+        exited = False
+        for i, req in pend.active:
+            if self._slots[i] is not req or req.state != DECODE:
+                continue          # retired in the overlap window
+            done = False
+            for j in range(pend.k):
+                if not bool(oks[i, j]):
+                    # NaN/inf logits at scan step j: quarantine the
+                    # slot; tokens 0..j-1 were clean and are kept
+                    self._mon.counter(
+                        "serving.nan_quarantines").increase()
+                    self._mon.counter(
+                        "serving.multi_tick.scan_exit.nan_logits"
+                    ).increase()
+                    outs.append(self._fail(req, "nan_logits"))
+                    done = True
+                    break
+                tok = int(toks[i, j])
+                req.written += 1
+                self._pos[i] = req.written
+                req.generated.append(tok)
+                self._last[i] = tok
+                if req.first_token_t == 0.0:
+                    req.first_token_t = self._clock()
+                self._mon.counter("serving.tokens").increase()
+                reason = self._finish_reason(req, tok)
+                if reason:
+                    self._mon.counter(
+                        "serving.multi_tick.scan_exit." + reason
+                    ).increase()
+                    outs.append(self._finish(req, reason))
+                    done = True
+                    break
+            if done:
+                exited = True
+            else:
+                # stamp the open DECODE stint with its fused progress
+                tracing.bump_open(req.spans, tracing.DECODE,
+                                  multi_ticks=pend.k,
+                                  multi_dispatches=1)
+        if not exited:
+            self._mon.counter(
+                "serving.multi_tick.scan_exit.horizon").increase()
+        return outs
+
     def _poison_slot(self, active: List[int]) -> None:
         """decode.nan fault point: pick one active slot (seeded rng)
         and ride a NaN into its sampling logits this tick — the
@@ -2041,16 +2504,14 @@ class Engine:
             self._poison_dev = self._poison_zeros
             self._poisoned = False
 
-    def _decode_spec(self, active: List[int], variant: str
-                     ) -> List[Output]:
-        """One draft/verify tick: the draft loop proposes k tokens per
-        slot (one executable), the target scores all k+1 positions in
-        ONE batched forward, and each slot emits its accepted chain +
-        one free target token — between 1 and k+1 tokens, every one
-        bit-identical to what the plain decode loop would have emitted
-        (verify_token_arrays' exact-match rule)."""
-        self._fault_raise("decode.device_error")
-        self._poison_slot(active)
+    def _dispatch_spec(self, snap, variant: str) -> _PendingTick:
+        """Dispatch one draft/verify tick: the draft loop proposes k
+        tokens per slot (one executable), the target scores all k+1
+        positions in ONE batched forward — the accept walk happens at
+        harvest. Each slot will emit its accepted chain + one free
+        target token, every one bit-identical to what the plain decode
+        loop would have emitted (verify_token_arrays' exact-match
+        rule). Fault/poison points already fired in _decode_dispatch."""
         # steady tick: warm verify + draft-loop executables, nothing
         # dirty — the lint transfer guard may wrap the verify dispatch
         steady = (variant in self._verify_fns
@@ -2059,6 +2520,8 @@ class Engine:
                   and not self._poisoned)
         self._flush_state()
         k = self._spec.k
+        mark = self._device_s
+        t0 = time.perf_counter()
         drafts = self._spec.draft(self._bt_dev, self._dev[0],
                                   self._dev[1], self._dev[6])
         if self._fault("spec.disagree"):
@@ -2072,13 +2535,22 @@ class Engine:
             steady, fn, self._st, self._pools, self._bt_dev, self._dev,
             drafts, self._poison_dev)
         self._unpoison()
-        self._sync_timed((toks, acc, okv))
-        toks = np.asarray(toks)
-        acc = np.asarray(acc)
-        okv = np.asarray(okv)
+        return _PendingTick(kind="spec", data=(toks, acc, okv),
+                            active=snap, ticks=1, t_dispatch=t0,
+                            dev_mark=mark, k=k)
+
+    def _harvest_spec(self, pend: _PendingTick) -> List[Output]:
+        toks = np.asarray(pend.data[0])
+        acc = np.asarray(pend.data[1])
+        okv = np.asarray(pend.data[2])
+        k = pend.k
+        # accepted chains move budgets the device-resident multi-tick
+        # aux never saw — the next fused dispatch re-uploads
+        self._aux_clean = False
         outs: List[Output] = []
-        for i in active:
-            req = self._slots[i]
+        for i, req in pend.active:
+            if self._slots[i] is not req or req.state != DECODE:
+                continue          # retired in the overlap window
             if not bool(okv[i]):
                 # NaN/inf across this slot's verify logits (spec-
                 # verify divergence): quarantine the slot, keep the
@@ -2136,6 +2608,7 @@ class Engine:
             self._slots[i] = None
             self._dirty.add(i)
             self._bt_dirty = True
+            self._aux_clean = False
             req.slot = None
         if req.pages:
             # one reference drop per page: private pages return to the

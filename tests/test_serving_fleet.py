@@ -434,6 +434,8 @@ def test_fleet_snapshot_restore_parked_migration(rng):
     fresh.close()
 
 
+@pytest.mark.slow  # ~20s: heaviest fleet leg; migration exactness
+# stays tier-1 via test_fleet_migration_mid_decode_exact
 def test_drain_and_undrain(rng):
     """drain_replica migrates every live request off and blocks new
     dispatches to the drained replica until undrain; tokens stay

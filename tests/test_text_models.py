@@ -96,6 +96,8 @@ def test_llama_tp_matches_single_device(tp_mesh):
     np.testing.assert_allclose(out_tp, out_1, rtol=2e-3, atol=2e-4)
 
 
+@pytest.mark.slow  # ~17s compile: BERT fwd coverage stays tier-1 via
+# the flash-SDPA and embedding-service tests
 def test_bert_pretraining_heads():
     paddle.seed(3)
     cfg = BertConfig.tiny()
