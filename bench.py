@@ -1053,6 +1053,78 @@ def bench_plan_search(n_devices=8):
     return search_ms, rep["spearman"], ranked[0].plan.describe()
 
 
+def bench_llama_mpmd_pp4(n_steps=6, batch=8, seq=512, n_micro=8,
+                         cfg=None):
+    """MPMD pipeline-parallel training throughput (docs/MPMD.md): the
+    1B-layer-shape llama split over pp=4 stages and trained under
+    ``schedule_mode="MPMD"`` — per-stage fixed compiled programs, the
+    host driver executing the mpmd_lint-verified FThenB event graph,
+    cross-stage activations as explicit ``device_put`` edges (no
+    single-SPMD scan, no ppermute). Returns (tokens/sec, measured
+    bubble fraction, predicted bubble fraction): measured is the
+    driver's structural occupancy over the executed span
+    (``stats()["bubble_fraction"]``), predicted the schedule's
+    analytic (S-1)/(M+S-1) stamped on the graph — the pair is the
+    schedule-quality gate a chip run reads next to raw speed. Needs
+    >= 4 devices; raises otherwise so the ledger records the gap."""
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed.fleet.meta_parallel import \
+        PipelineParallel
+    from paddle_tpu.text.models import LlamaConfig, build_llama_pipe
+
+    if len(jax.devices()) < 4:
+        raise RuntimeError(
+            f"pp=4 MPMD bench needs >= 4 devices, have "
+            f"{len(jax.devices())} ({jax.default_backend()})")
+    prev = mesh_mod.get_mesh()
+    mesh = mesh_mod.build_mesh({"pp": 4, "dp": 1},
+                               devices=jax.devices()[:4])
+    mesh_mod.set_mesh(mesh)
+    try:
+        paddle.seed(0)
+        if cfg is None:
+            cfg = LlamaConfig(
+                vocab_size=32000, hidden_size=2048,
+                intermediate_size=5632, num_hidden_layers=8,
+                num_attention_heads=16, num_key_value_heads=16,
+                max_position_embeddings=seq,
+                use_flash_attention=False)
+        pl = build_llama_pipe(cfg, num_stages=4)
+        strat = fleet.DistributedStrategy()
+        strat.pipeline_configs["accumulate_steps"] = n_micro
+        strat.pipeline_configs["schedule_mode"] = "MPMD"
+        model = PipelineParallel(pl, strategy=strat)
+        opt = paddle.optimizer.AdamW(1e-4, parameters=pl.parameters())
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size,
+                           (batch, seq + 1)).astype(np.int64)
+        data = (paddle.to_tensor(ids[:, :-1]),
+                paddle.to_tensor(ids[:, 1:]))
+        with jax.set_mesh(mesh):
+            model.train_batch(data, opt)          # compile pass
+            t0 = time.perf_counter()
+            for _ in range(n_steps):
+                loss = model.train_batch(data, opt)
+            float(loss.numpy())                   # sync
+            dt = time.perf_counter() - t0
+        if model.mpmd_driver.steady_state_recompiles() != 0:
+            raise RuntimeError(
+                f"MPMD bench recompiled in steady state "
+                f"({model.mpmd_driver.steady_state_recompiles()}) — "
+                f"the per-stage executable set is not fixed")
+        stats = model.mpmd_driver.stats()
+        tok_s = n_steps * batch * seq / dt
+        return (tok_s, float(stats["bubble_fraction"]),
+                float(stats.get("predicted_bubble_fraction",
+                                stats["bubble_fraction"])))
+    finally:
+        mesh_mod._global_mesh = prev
+
+
 def bench_resnet50(batch=256, n_steps=10):
     """ResNet-50 ImageNet-shape train step (BASELINE config 2 metric:
     images/sec, single chip — the 8->64-chip scaling axis is covered by
@@ -1458,6 +1530,19 @@ def main():
             = round(corr, 3)
         result["extras"]["llama_1b_plan_best"] = best
 
+    def add_mpmd_pp():
+        # MPMD pipeline training (docs/MPMD.md): pp=4 llama under the
+        # host schedule driver — raw speed next to the schedule-
+        # quality pair (measured occupancy vs the analytic FThenB
+        # bubble), zero steady-state recompiles enforced in-bench
+        tok, bub, pred = bench_llama_mpmd_pp4()
+        result["extras"]["llama_1b_mpmd_pp4_tokens_per_sec"] = \
+            round(tok, 1)
+        result["extras"]["llama_1b_mpmd_pp4_bubble_fraction"] = \
+            round(bub, 4)
+        result["extras"]["llama_1b_mpmd_pp4_bubble_predicted"] = \
+            round(pred, 4)
+
     # (name, runner, wall-clock cost estimate in seconds: compile+measure
     # on the tunneled chip, cold cache — estimates from the round-4
     # dress-rehearsal runs). Ordered so every BASELINE config (4-long-ctx,
@@ -1496,6 +1581,7 @@ def main():
         ("flashmask_8k", add_flashmask, 90),
         ("peak_bf16", add_peak_microbench, 120),
         ("plan_search", add_plan_search, 60),
+        ("llama_mpmd_pp4", add_mpmd_pp, 420),
     ]
     skipped = []
     for name, run, est in extras:

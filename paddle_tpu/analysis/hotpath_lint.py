@@ -432,7 +432,7 @@ def emit_hotpath(report: Report) -> Report:
 
 
 def sweep_serving_stack(surfaces=("engine", "disagg", "fleet",
-                                  "encoder"),
+                                  "encoder", "mpmd"),
                         drive=True) -> Dict[str, Report]:
     """Build + briefly drive a tiny instance of each serving surface
     on the local (CPU is fine) backend and lint it warm — the CLI's
@@ -496,6 +496,19 @@ def sweep_serving_stack(surfaces=("engine", "disagg", "fleet",
         if drive:
             svc.run([p.tolist() for p in prompts])
         reports["encoder"] = lint_surface(svc)
+    if "mpmd" in surfaces:
+        import jax.numpy as jnp
+
+        from paddle_tpu.distributed.mpmd_runtime import MpmdRingExecutor
+        ex = MpmdRingExecutor(2, causal=True)
+        if drive:
+            rng = np.random.default_rng(0)
+            q = jnp.asarray(rng.standard_normal((1, 2, 8, 4)),
+                            jnp.float32)
+            numel = float(q.size)
+            ex.run(q, q, q,
+                   dout_fn=lambda r, ob: ob * (2.0 / numel))
+        reports["mpmd"] = lint_surface(ex)
     return reports
 
 

@@ -258,6 +258,28 @@ class Plan:
             s.pipeline_configs["schedule_mode"] = self.schedule_mode
         return s
 
+    def to_driver(self, spec: Optional["ModelSpec"] = None,
+                  programs=None, placements=None):
+        """``mpmd_runtime.MpmdDriver`` over this plan's verified event
+        graph — the executable end of the ``plan_graph`` extraction.
+        With no ``programs`` the driver walks the schedule symbolically
+        (device-free: validates order, routes, channel capacities);
+        pass real per-stage programs to execute. Raises
+        ``MpmdGraphRejected`` when the plan's schedule fails mpmd_lint.
+        """
+        from ..distributed import mpmd_graph as mg
+        from ..distributed.mpmd_runtime import MpmdDriver
+        if self.degree("pp") <= 1:
+            raise ValueError(
+                "Plan.to_driver needs a pipelined plan (pp > 1); "
+                "non-pipelined plans have no cross-stage schedule")
+        if spec is not None:
+            g = mg.plan_graph(spec, self)
+        else:
+            g = mg.schedule_graph(self.schedule_mode, self.degree("pp"),
+                                  self.n_micro, self.vpp_degree)
+        return MpmdDriver(g, programs, placements=placements)
+
     def to_dict(self) -> Dict[str, object]:
         return {
             "degrees": {ax: d for ax, d in self.degrees.items() if d > 1},

@@ -124,23 +124,32 @@ class PipelineParallel(MetaParallelBase):
         # memory lever the compiled form doesn't need — XLA frees each
         # microbatch's boundary activation after its backward tick).
         # "ZBH1" = zero-bubble: dX/dW split backward (zero_bubble.py).
+        # "MPMD[-...]" = the same schedules executed by the host-level
+        # MpmdDriver (distributed/mpmd_runtime.py) as per-stage compiled
+        # programs with explicit device_put transfer edges instead of
+        # one SPMD program — plain "MPMD" picks the VPP event graph when
+        # vpp_degree>1, FThenB otherwise.
         self.schedule_mode = str(cfg.get("schedule_mode", "")).upper()
         if self.schedule_mode not in ("", "FTHENB", "1F1B", "VPP", "ZBH1",
-                                      "ZBVPP"):
+                                      "ZBVPP", "MPMD", "MPMD-VPP",
+                                      "MPMD-ZBH1", "MPMD-ZBVPP"):
             raise ValueError(
                 f"unknown pipeline schedule_mode "
                 f"{cfg.get('schedule_mode')!r}: expected FThenB, 1F1B, "
-                "VPP, ZBH1 or ZBVPP")
-        if self.schedule_mode == "ZBH1" and self.vpp_degree > 1:
+                "VPP, ZBH1, ZBVPP or an MPMD variant (MPMD, MPMD-VPP, "
+                "MPMD-ZBH1, MPMD-ZBVPP)")
+        if self.schedule_mode in ("ZBH1", "MPMD-ZBH1") \
+                and self.vpp_degree > 1:
             raise ValueError(
-                "schedule_mode='ZBH1' is incompatible with vpp_degree>1 "
+                f"schedule_mode={self.schedule_mode!r} is incompatible "
+                "with vpp_degree>1 "
                 "(use ZBVPP for the interleaved zero-bubble schedule)")
-        if self.schedule_mode == "ZBVPP" and self.vpp_degree <= 1:
+        if self.schedule_mode in ("ZBVPP", "MPMD-ZBVPP", "MPMD-VPP") \
+                and self.vpp_degree <= 1:
             raise ValueError(
-                "schedule_mode='ZBVPP' needs vpp_degree>1 (set "
-                "num_virtual_pipeline_stages or "
-                "pipeline_configs['vpp_degree']); use ZBH1 for the "
-                "non-interleaved zero-bubble schedule")
+                f"schedule_mode={self.schedule_mode!r} needs "
+                "vpp_degree>1 (set num_virtual_pipeline_stages or "
+                "pipeline_configs['vpp_degree'])")
         self._compiled = {}
         self._state = None
         # heterogeneous mode (VERDICT r3 missing #3): explicit
@@ -153,6 +162,11 @@ class PipelineParallel(MetaParallelBase):
                 "schedule_mode='ZBH1' is incompatible with non-uniform "
                 "seg_method stage bounds (the het schedule is "
                 "GPipe-based); use uniform segmentation with ZBH1")
+        if self._het and self.schedule_mode.startswith("MPMD"):
+            raise ValueError(
+                "MPMD schedule modes need uniform stage bounds (the "
+                "per-stage programs share one compiled executable "
+                "family); use uniform segmentation")
         self._het_state = None
         self._het_vec = None
 
@@ -353,19 +367,11 @@ class PipelineParallel(MetaParallelBase):
         self._resync_if_stale()
         return super().state_dict(*a, **kw)
 
-    # -- the compiled train step --------------------------------------------
-    def _make_step(self, optimizer, loss_fn):
+    # -- the stage-level forward fns (shared by the SPMD compiled step
+    # and the MPMD driver's per-stage programs) ------------------------------
+    def _stage_fns(self, frozen, meta):
         pl: PipelineLayer = self._layers
-        pre_p, stacked, post_p, frozen, meta = self._ensure_state()
-        mesh = self._mesh
-        S, M, V = self._pp, self.accumulate_steps, self.vpp_degree
         chunk, templates = meta["chunk"], meta["templates"]
-        stacked_frozen = meta["stacked_frozen"]
-        lo, hi = meta["lo"], meta["hi"]
-        items = pl._items
-        # remat per stage call (reference recompute_interval semantics:
-        # 0 = off, >0 = recompute activations inside the pipeline body)
-        remat = pl._recompute_interval > 0
 
         def run_items(seq, param_pool, x, key):
             """Run non-pipelined items sequentially with bound params."""
@@ -423,6 +429,23 @@ class PipelineParallel(MetaParallelBase):
                     training=True)
                 x = out
             return x
+
+        return run_items, run_chunk
+
+    # -- the compiled train step --------------------------------------------
+    def _make_step(self, optimizer, loss_fn):
+        pl: PipelineLayer = self._layers
+        pre_p, stacked, post_p, frozen, meta = self._ensure_state()
+        mesh = self._mesh
+        S, M, V = self._pp, self.accumulate_steps, self.vpp_degree
+        chunk = meta["chunk"]
+        stacked_frozen = meta["stacked_frozen"]
+        lo, hi = meta["lo"], meta["hi"]
+        items = pl._items
+        # remat per stage call (reference recompute_interval semantics:
+        # 0 = off, >0 = recompute activations inside the pipeline body)
+        remat = pl._recompute_interval > 0
+        run_items, run_chunk = self._stage_fns(frozen, meta)
 
         def block_fn(stage_params, x, key, tick):
             # GPipe: one chunk per stage; chunk_idx == stage
@@ -595,6 +618,314 @@ class PipelineParallel(MetaParallelBase):
 
         return jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
+    # -- the MPMD train step: host driver over per-stage programs ------------
+    def _make_step_mpmd(self, optimizer, loss_fn):
+        """Host-level MPMD step (JaxPP-style, arXiv:2412.14374): same
+        call signature and numbers as the compiled SPMD step, but the
+        schedule is executed by ``distributed.mpmd_runtime.MpmdDriver``
+        — each stage ONE compiled program, cross-stage activations
+        explicit ``device_put`` edges validated against the verified
+        ``MpmdGraph``.
+
+        Numerics contract (mirrors ``_make_step`` exactly):
+
+        * merged head: ``run_items(items[:lo])`` on the full batch with
+          ``fold_in(key, 1)``, then ``split_microbatches``;
+        * per-(stage, chunk) programs: ``run_chunk`` with
+          ``fold_in(key, 2)`` folded by (micro, global layer index) —
+          microbatch/chunk indices enter as traced i32 scalars so ONE
+          executable serves every event of the family;
+        * loss tail: per-micro ``loss(tail(y_m), labels_m) / M`` with
+          ``fold_in(key, 3)``. For a mean-reduced loss over a
+          row-independent suffix this sums to the merged loss EXACTLY
+          (same numbers as the one-program step); sample-dependent RNG
+          in the suffix (e.g. dropout there) would break the
+          equivalence and is not supported;
+        * backward: last-chunk cotangents seeded per micro, dX by vjp
+          recompute per chunk (or zero_bubble.split_backward for the
+          MPMD-ZB modes, honoring the graph's W-phase ordering), tied
+          embeddings accumulated across head + tail use sites;
+        * update: identical flat trees / clip / apply_gradients_pytree.
+
+        The ZeRO/pp at-rest sharding constraints of the SPMD step are
+        layout-only and skipped; per-stage residency is real device
+        placement here instead. Non-pp mesh axes (dp/mp/sharding) stay
+        GSPMD-auto inside each stage's submesh.
+        """
+        import contextlib
+
+        from ...mpmd_runtime import MpmdDriver, PipelinePrograms
+        from ... import mpmd_graph as mg_mod
+
+        pl: PipelineLayer = self._layers
+        _pre0, _stacked0, _post0, frozen, meta = self._ensure_state()
+        mesh = self._mesh
+        S, M, V = self._pp, self.accumulate_steps, self.vpp_degree
+        chunk = meta["chunk"]
+        stacked_frozen = meta["stacked_frozen"]
+        lo, hi = meta["lo"], meta["hi"]
+        items = pl._items
+        if not chunk:
+            raise ValueError(
+                "MPMD schedule modes need a pipelined region (no "
+                "homogeneous sublayer run found to form stages)")
+        run_items, run_chunk = self._stage_fns(frozen, meta)
+        base = {"MPMD": "VPP" if V > 1 else "FThenB",
+                "MPMD-VPP": "VPP", "MPMD-ZBH1": "ZBH1",
+                "MPMD-ZBVPP": "ZBVPP"}[self.schedule_mode]
+        zb = base in ("ZBH1", "ZBVPP")
+
+        # per-stage placement: slice the mesh along 'pp'. Stages with a
+        # non-trivial submesh get a replicated NamedSharding (and their
+        # programs trace under the submesh so in-stage TP constraints
+        # resolve on the stage's own devices); pp-only meshes place each
+        # stage on a single device.
+        axis_names = tuple(getattr(mesh, "axis_names", ()) or ())
+        sub_names = tuple(n for n in axis_names if n != "pp")
+        stage_meshes = [None] * S
+        placements = [None] * S
+        if "pp" in axis_names:
+            ppi = axis_names.index("pp")
+            for s in range(S):
+                devs = np.take(mesh.devices, s % mesh.devices.shape[ppi],
+                               axis=ppi)
+                if sub_names:
+                    sm = jax.sharding.Mesh(devs, sub_names)
+                    stage_meshes[s] = sm
+                    placements[s] = NamedSharding(sm, PartitionSpec())
+                else:
+                    placements[s] = np.ravel(devs)[0]
+        else:
+            placements = [jax.devices()[0]] * S
+        # merged head/tail-free math (update) and the head itself run
+        # replicated over the WHOLE mesh so any global-mesh sharding
+        # constraints in prefix layers stay legal
+        home = NamedSharding(mesh, PartitionSpec())
+
+        def _ctx(s):
+            sm = stage_meshes[s]
+            return (mesh_mod.use_mesh(sm) if sm is not None
+                    else contextlib.nullcontext())
+
+        mb_arr = [jnp.asarray(m, jnp.int32) for m in range(M)]
+        ci_arr = [jnp.asarray(c, jnp.int32) for c in range(V * S)]
+
+        # -- per-stage programs (ONE jit per stage per phase family) --------
+        def chunk_call(t_sub, f_sub, x, key, mb, ci):
+            merged = {**{f"t:{k}": v for k, v in t_sub.items()},
+                      **{f"f:{k}": v for k, v in f_sub.items()}}
+            return run_chunk(merged, x, key, mb, ci)
+
+        def bwd_call(t_sub, f_sub, x, key, mb, ci, dy):
+            _, pull = jax.vjp(
+                lambda tp, xx: chunk_call(tp, f_sub, xx, key, mb, ci),
+                t_sub, x)
+            gt, dx = pull(dy)
+            return gt, dx
+
+        jfwd = [jax.jit(chunk_call) for _ in range(S)]
+        jbwd = [jax.jit(bwd_call) for _ in range(S)]
+        zb_fns = [None] * S
+
+        def _ensure_zb(s, t_sub, f_sub, x, dy, key, mb, ci):
+            if zb_fns[s] is None:
+                from ...zero_bubble import split_backward
+
+                def f(tp, xx, fp, kk, m_, c_):
+                    return chunk_call(tp, fp, xx, kk, m_, c_)
+
+                with _ctx(s):
+                    bx, bw, _ = split_backward(
+                        f, t_sub, x, dy, nondiff=(f_sub, key, mb, ci))
+                zb_fns[s] = (jax.jit(bx), jax.jit(bw))
+            return zb_fns[s]
+
+        # -- merged head + per-micro loss tail ------------------------------
+        def head_fn(pre, post, x_in, key):
+            pool = dict(pre)
+            pool.update(post)
+            x = run_items(items[:lo], pool, x_in,
+                          jax.random.fold_in(key, 1))
+            return split_microbatches(x, M)
+
+        jhead = jax.jit(head_fn)
+
+        def head_bwd_fn(pre, post, x_in, key, d_xs):
+            _, pull = jax.vjp(lambda pr, po: head_fn(pr, po, x_in, key),
+                              pre, post)
+            return pull(d_xs)
+
+        jhead_bwd = jax.jit(head_bwd_fn)
+
+        def tail_fn(pre, post, y, lab, key):
+            pool = dict(pre)
+            pool.update(post)
+            x = run_items(items[hi:], pool, y, jax.random.fold_in(key, 3))
+            with tape_mod.no_grad_guard():
+                loss = loss_fn(wrap(x), wrap(lab))
+            return unwrap(loss).astype(jnp.float32) / M
+
+        jseed = jax.jit(jax.value_and_grad(tail_fn, argnums=(0, 1, 2)))
+
+        def update_fn(pre_p, stacked, post_p, opt_state, lr, g_pre,
+                      g_blk, g_post):
+            flat_p = {**{f"pre.{k}": v for k, v in pre_p.items()},
+                      **{f"blk.{k}": v for k, v in stacked.items()},
+                      **{f"post.{k}": v for k, v in post_p.items()}}
+            flat_g = {**{f"pre.{k}": v for k, v in g_pre.items()},
+                      **{f"blk.{k}": v for k, v in g_blk.items()},
+                      **{f"post.{k}": v for k, v in g_post.items()}}
+            if optimizer._grad_clip is not None:
+                flat_g = _clip_pytree(flat_g, optimizer._grad_clip)
+            new_flat, new_state = optimizer.apply_gradients_pytree(
+                flat_p, flat_g, opt_state, lr)
+            n_pre = {k[len("pre."):]: v for k, v in new_flat.items()
+                     if k.startswith("pre.")}
+            n_blk = {k[len("blk."):]: v for k, v in new_flat.items()
+                     if k.startswith("blk.")}
+            n_post = {k[len("post."):]: v for k, v in new_flat.items()
+                      if k.startswith("post.")}
+            return n_pre, n_blk, n_post, new_state
+
+        jupdate = jax.jit(update_fn, donate_argnums=(0, 1, 2, 3))
+
+        def _tadd(a, b):
+            if a is None:
+                return b
+            return jax.tree_util.tree_map(jnp.add, a, b)
+
+        # -- driver program callbacks (PipelinePrograms contract) -----------
+        def start(feeds):
+            stacked = feeds["stacked"]
+            t_sv, f_sv = {}, {}
+            for s in range(S):
+                for v in range(V):
+                    t = {k: (a[s] if V == 1 else a[s, v])
+                         for k, a in stacked.items()}
+                    f = {k: (a[s] if V == 1 else a[s, v])
+                         for k, a in stacked_frozen.items()}
+                    t_sv[(s, v)] = jax.device_put(t, placements[s])
+                    f_sv[(s, v)] = jax.device_put(f, placements[s])
+            return dict(
+                key=feeds["key"],
+                key2=jax.random.fold_in(feeds["key"], 2),
+                xs=feeds["xs"], labs=feeds["labs"],
+                t_sv=t_sv, f_sv=f_sv,
+                tail_pre=jax.device_put(feeds["pre"], placements[-1]),
+                tail_post=jax.device_put(feeds["post"], placements[-1]),
+                g_sv={}, g_pre=None, g_post=None, loss=None,
+                dxs=[None] * M)
+
+        def feed(ctx, m):
+            return jax.device_put(ctx["xs"][m], placements[0])
+
+        def fwd(ctx, s, v, m, x):
+            with _ctx(s):
+                return jfwd[s](ctx["t_sv"][(s, v)], ctx["f_sv"][(s, v)],
+                               x, ctx["key2"], mb_arr[m],
+                               ci_arr[v * S + s])
+
+        def seed(ctx, m, y):
+            lab_m = jax.tree_util.tree_map(lambda a: a[m], ctx["labs"])
+            with _ctx(S - 1):
+                lv, (gpr, gpo, dy) = jseed(
+                    ctx["tail_pre"], ctx["tail_post"], y, lab_m,
+                    ctx["key"])
+            ctx["loss"] = lv if ctx["loss"] is None else ctx["loss"] + lv
+            ctx["g_pre"] = _tadd(ctx["g_pre"], gpr)
+            ctx["g_post"] = _tadd(ctx["g_post"], gpo)
+            return dy
+
+        def _acc_gsv(ctx, s, v, gt):
+            ctx["g_sv"][(s, v)] = _tadd(ctx["g_sv"].get((s, v)), gt)
+
+        def bwd(ctx, s, v, m, x, dy):
+            with _ctx(s):
+                gt, dx = jbwd[s](ctx["t_sv"][(s, v)], ctx["f_sv"][(s, v)],
+                                 x, ctx["key2"], mb_arr[m],
+                                 ci_arr[v * S + s], dy)
+            _acc_gsv(ctx, s, v, gt)
+            return dx
+
+        def bwd_x(ctx, s, v, m, x, dy):
+            bx, _ = _ensure_zb(s, ctx["t_sv"][(s, v)], ctx["f_sv"][(s, v)],
+                               x, dy, ctx["key2"], mb_arr[m],
+                               ci_arr[v * S + s])
+            with _ctx(s):
+                return bx(ctx["t_sv"][(s, v)], x, dy,
+                          ctx["f_sv"][(s, v)], ctx["key2"], mb_arr[m],
+                          ci_arr[v * S + s])
+
+        def bwd_w(ctx, s, v, m, stash):
+            _, bw = zb_fns[s]
+            with _ctx(s):
+                gt = bw(ctx["t_sv"][(s, v)], stash, ctx["f_sv"][(s, v)],
+                        ctx["key2"], mb_arr[m], ci_arr[v * S + s])
+            _acc_gsv(ctx, s, v, gt)
+
+        def collect_dx(ctx, m, dx):
+            ctx["dxs"][m] = dx
+
+        def _home(t):
+            return jax.device_put(t, home)
+
+        def finish(ctx):
+            d_xs = jnp.stack([_home(d) for d in ctx["dxs"]])
+            gpr_h, gpo_h = jhead_bwd(feeds_ref["pre"], feeds_ref["post"],
+                                     feeds_ref["x_in"], ctx["key"], d_xs)
+            g_pre = _tadd(_home(ctx["g_pre"]), gpr_h)
+            g_post = _tadd(_home(ctx["g_post"]), gpo_h)
+
+            def _stack_key(k):
+                if V == 1:
+                    return jnp.stack([_home(ctx["g_sv"][(s, 0)][k])
+                                      for s in range(S)])
+                return jnp.stack([
+                    jnp.stack([_home(ctx["g_sv"][(s, v)][k])
+                               for v in range(V)]) for s in range(S)])
+
+            some = ctx["g_sv"][(0, 0)]
+            g_blk = {k: _stack_key(k) for k in some}
+            return dict(loss=_home(ctx["loss"]), g_pre=g_pre,
+                        g_post=g_post, g_blk=g_blk)
+
+        feeds_ref = {}
+        state = {}
+
+        def entry(pre_p, stacked, post_p, opt_state, key, lr, inputs,
+                  labels):
+            # one placement home for everything crossing program
+            # boundaries — committed inputs of one jit must agree
+            pre_p, stacked, post_p, opt_state = jax.device_put(
+                (pre_p, stacked, post_p, opt_state), home)
+            x_in = inputs[0] if len(inputs) == 1 else tuple(inputs)
+            xs = jhead(pre_p, post_p, x_in, key)
+            labs = jax.tree_util.tree_map(
+                lambda a: split_microbatches(a, M), labels)
+            feeds_ref.update(pre=pre_p, post=post_p, x_in=x_in)
+            if "driver" not in state:
+                g = mg_mod.schedule_graph(
+                    base, S, M, vpp_degree=V,
+                    act_shape=tuple(xs.shape[1:]),
+                    act_dtype=str(xs.dtype))
+                kw = dict(bwd_x=bwd_x, bwd_w=bwd_w) if zb \
+                    else dict(bwd=bwd)
+                programs = PipelinePrograms(
+                    g, start=start, feed=feed, fwd=fwd, seed=seed,
+                    finish=finish, collect_dx=collect_dx, **kw)
+                state["driver"] = MpmdDriver(g, programs,
+                                             placements=placements)
+                self.mpmd_driver = state["driver"]
+            res = state["driver"].run(feeds=dict(
+                pre=pre_p, post=post_p, stacked=stacked, key=key,
+                xs=xs, labs=labs))
+            n_pre, n_blk, n_post, new_state = jupdate(
+                pre_p, stacked, post_p, opt_state, lr, res["g_pre"],
+                res["g_blk"], res["g_post"])
+            return n_pre, n_blk, n_post, new_state, res["loss"]
+
+        return entry
+
     # -- heterogeneous (non-uniform seg_method) schedule ---------------------
     def _ensure_het_state(self):
         if self._het_state is None:
@@ -735,7 +1066,10 @@ class PipelineParallel(MetaParallelBase):
         # be recycled by a differently-configured object
         cached = self._compiled.get(sig)
         if cached is None:
-            entry = self._make_step(opt, loss_fn)
+            make = (self._make_step_mpmd
+                    if self.schedule_mode.startswith("MPMD")
+                    else self._make_step)
+            entry = make(opt, loss_fn)
             self._compiled[sig] = (entry, opt, loss_fn)
             if getattr(self, "_opt_state_owner", None) is not opt:
                 self._opt_state = opt.init_state_pytree(self._flat_params())

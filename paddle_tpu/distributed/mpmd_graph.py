@@ -223,6 +223,79 @@ class MpmdGraph:
             "deps": [[list(a), list(b)] for a, b in self.deps],
         }
 
+    # a descriptor's base keys are recomputed by stage_descriptor();
+    # only the extras (stage_items / stage_layers / param_bytes / ...)
+    # are stored on the graph and survive a round trip
+    _DESC_BASE_KEYS = ("stage", "events", "act_shape", "act_dtype")
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, object]) -> "MpmdGraph":
+        """Rebuild a graph from ``to_dict()`` output — including after a
+        ``json.dumps``/``loads`` round trip (string stage keys, ``a->b``
+        capacity keys, tuples flattened to lists). ``file``/``line`` are
+        not serialized, so findings on a deserialized graph locate at
+        ``<mpmd>:0``; the bubble cross-check stats are re-derived from
+        ``pipeline.schedule_stats`` for standard modes, exactly as
+        ``schedule_graph`` stamps them."""
+        g = cls(int(d["n_stages"]),
+                schedule_mode=str(d.get("schedule_mode", "") or ""),
+                n_micro=int(d.get("n_micro", 1)),
+                vpp_degree=int(d.get("vpp_degree", 1)),
+                act_shape=tuple(int(x) for x in d.get("act_shape", ())),
+                act_dtype=str(d.get("act_dtype", "float32")),
+                subject=str(d.get("subject", "") or ""))
+
+        def _key(k) -> EventKey:
+            s, m, ph, c = k
+            return (int(s), int(m), str(ph), int(c))
+
+        def _msg(md) -> Msg:
+            return Msg(peer=int(md["peer"]),
+                       tag=tuple(md.get("tag", ())),
+                       shape=tuple(int(x) for x in md.get("shape", ())),
+                       dtype=str(md.get("dtype", "float32")))
+
+        def _slots(pairs):
+            return [(str(b), int(sl)) for b, sl in pairs]
+
+        for s_key, stage_d in (d.get("stages") or {}).items():
+            s = int(s_key)
+            extras = {k: v
+                      for k, v in (stage_d.get("descriptor") or {}).items()
+                      if k not in cls._DESC_BASE_KEYS}
+            if extras:
+                g.descriptors[s] = extras
+            for ev_d in stage_d.get("events", ()):
+                es, em, eph, ec = _key(ev_d["key"])
+                ev = g.add_event(es, em, eph, chunk=ec,
+                                 tick=int(ev_d.get("tick", 0)))
+                ev.sends = [_msg(m) for m in ev_d.get("sends", ())]
+                ev.recvs = [_msg(m) for m in ev_d.get("recvs", ())]
+                ev.reads = _slots(ev_d.get("reads", ()))
+                ev.writes = _slots(ev_d.get("writes", ()))
+        for b in d.get("buffers", ()):
+            g.add_buffer(int(b["stage"]), str(b["name"]),
+                         int(b["slots"]), int(b.get("slot_bytes", 0)))
+        caps = d.get("channel_capacity") or {}
+        for route, cap in caps.items():
+            if isinstance(route, str):
+                a, b = route.split("->")
+            else:
+                a, b = route
+            g.channel_capacity[(int(a), int(b))] = int(cap)
+        for a, b in d.get("deps", ()):
+            g.add_dep(_key(a), _key(b))
+        if g.n_stages > 1 and (g.schedule_mode or "").upper() in (
+                "FTHENB", "1F1B", "VPP", "ZBH1", "ZBVPP"):
+            try:
+                from .pipeline import schedule_stats
+            except Exception:  # jax-free context: graph stays usable,
+                pass           # only the bubble cross-check is skipped
+            else:
+                g.meta["stats"] = schedule_stats(
+                    g.schedule_mode, g.n_stages, g.n_micro, g.vpp_degree)
+        return g
+
     def __repr__(self):
         return (f"MpmdGraph({self.subject!r}, events={self.n_events()}, "
                 f"deps={len(self.deps)})")
@@ -516,6 +589,12 @@ def schedule_graph(schedule_mode: str, n_stages: int, n_micro: int,
     ``pipeline.schedule_stats``, which also stamps the graph's
     bubble-accounting expectation into ``meta['stats']``)."""
     mode = (schedule_mode or "FThenB").upper()
+    # MPMD variants run the SAME event graphs, driven by the host
+    # runtime (mpmd_runtime.MpmdDriver) instead of one SPMD program
+    if mode == "MPMD":
+        mode = "FTHENB" if vpp_degree <= 1 else "VPP"
+    elif mode.startswith("MPMD-"):
+        mode = mode[len("MPMD-"):]
     kw = dict(act_shape=act_shape, act_dtype=act_dtype)
     if mode in ("", "FTHENB", "1F1B"):
         g = gpipe_graph(n_stages, n_micro, backward=backward, **kw)

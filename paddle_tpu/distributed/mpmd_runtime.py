@@ -1,0 +1,877 @@
+"""mpmd_runtime — MPMD pipeline runtime: a host schedule driver
+executing verified per-stage compiled programs.
+
+PR 18 extracted every pipeline schedule into an explicit ``MpmdGraph``
+event graph and model-checks it device-free (``analysis.mpmd_lint``),
+with ``to_dict()``/``from_dict()`` as "the driver input format". This
+module is the driver: the JaxPP execution model (arXiv:2412.14374) —
+each stage a fixed compiled per-device program, the host executing the
+schedule as explicit data movement between stages — instead of the one
+giant SPMD ``lax.scan`` + ``ppermute`` program the pinned runtime
+cannot compile (XLA SPMD ``PartitionId`` aborts; no native
+``shard_map`` for the ring kernels).
+
+The contract, in both directions:
+
+* ``MpmdDriver`` REFUSES any graph with ``mpmd_lint`` findings at
+  construction (``MpmdGraphRejected`` names the rules) — the driver
+  executes only verified schedules;
+* at runtime the driver makes the lint's model REAL: recvs are matched
+  FIFO against the declared routes (tag/shape/dtype validated per
+  payload leaf), sends are bounded by the graph's channel capacities,
+  buffer slots are ref-counted against the declared reads and a live
+  slot cannot be overwritten, and a stage program exception is
+  re-raised as ``MpmdDispatchError`` naming the (stage, micro, phase)
+  event. Cross-stage edges move data with explicit ``jax.device_put``
+  to the destination stage's placement (a device or a sharding — the
+  recorded-redistribution contract of arXiv:2112.01075).
+
+Programs are pluggable (the ``begin/execute/finish`` protocol below).
+``SymbolicPrograms`` (the default) runs the whole schedule with
+shape/dtype tokens and zero jax — a device-free schedule walk, which
+is what ``Plan.to_driver()`` hands back. ``PipelinePrograms`` routes
+pipeline-schedule events onto the jitted per-stage callables built by
+``fleet.meta_parallel.pipeline_parallel`` (``schedule_mode="MPMD*"``).
+``MpmdRingExecutor`` gives the ring-attention sep phases the same
+treatment: every ring hop is an explicit per-device compiled program
+and the k/v / dk/dv rotation is driver-moved edge data, mirroring
+``kernels.ring_attention._ring_local`` math exactly.
+
+Each stage keeps ONE compiled executable per (phase,
+microbatch-shape) family; ``steady_state_recompiles()`` (backed by
+``profiler.stats.CompileTracker`` scoped to ``run()``) asserts the
+zero-recompile steady state, and ``_hotpath_inventory()`` exposes the
+tick loop + executables to ``paddle_lint --hotpath``.
+"""
+from __future__ import annotations
+
+from collections import Counter, deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .mpmd_graph import BWD, FWD, W, Event, MpmdGraph, Msg
+
+NEG_INF = -1e30   # matches kernels.ring_attention.NEG_INF
+
+
+class MpmdGraphRejected(ValueError):
+    """The driver refused an unverified graph: mpmd_lint findings at
+    construction time. ``.rules`` carries the finding rule ids."""
+
+    def __init__(self, message: str, rules: Tuple[str, ...] = ()):
+        super().__init__(message)
+        self.rules = tuple(rules)
+
+
+class MpmdDispatchError(RuntimeError):
+    """A schedule violation or stage failure at execution time, named
+    by its (stage, micro, phase) event."""
+
+
+# ---------------------------------------------------------------------------
+# payload plumbing (jax-free; real arrays are just leaves with
+# .shape/.dtype)
+# ---------------------------------------------------------------------------
+
+def _leaves(payload) -> List:
+    """Flatten a payload (array | tuple/list | dict) into leaves."""
+    if isinstance(payload, (tuple, list)):
+        out: List = []
+        for p in payload:
+            out.extend(_leaves(p))
+        return out
+    if isinstance(payload, dict):
+        out = []
+        for k in sorted(payload):
+            out.extend(_leaves(payload[k]))
+        return out
+    return [payload]
+
+
+class _SymToken:
+    """A shape/dtype-only payload: what ``SymbolicPrograms`` circulates
+    so a schedule executes device-free (no jax import at all)."""
+
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, shape, dtype):
+        self.shape = tuple(shape)
+        self.dtype = str(dtype)
+
+    def __repr__(self):
+        return f"_SymToken({self.shape}, {self.dtype!r})"
+
+
+class SymbolicPrograms:
+    """Default stage programs: every compute event is a no-op that
+    emits shape/dtype tokens for its declared sends/writes. Running a
+    driver with these is a full schedule walk — FIFO matching, channel
+    capacities, buffer ref-counts all enforced — without touching a
+    device. ``Plan.to_driver()`` returns a driver in this mode."""
+
+    def __init__(self, graph: MpmdGraph):
+        self.graph = graph
+        self.executed = 0
+
+    def begin(self, feeds):
+        self.executed = 0
+
+    def execute(self, ev: Event, inbox, reads):
+        self.executed += 1
+        sends = {tuple(m.tag): _SymToken(m.shape, m.dtype)
+                 for m in ev.sends}
+        writes = {ws: _SymToken(self.graph.act_shape,
+                                self.graph.act_dtype)
+                  for ws in ev.writes}
+        return sends, writes
+
+    def finish(self):
+        return {"executed": self.executed}
+
+    def executable_specs(self):
+        return []
+
+
+# ---------------------------------------------------------------------------
+# the driver
+# ---------------------------------------------------------------------------
+
+class MpmdDriver:
+    """Executes a VERIFIED ``MpmdGraph`` tick-by-tick over pluggable
+    stage programs.
+
+    programs protocol::
+
+        programs.begin(feeds)                  # once per run()
+        programs.execute(event, inbox, reads)  # -> (sends, writes)
+        #   inbox:  {tag: payload} — this event's declared recvs,
+        #           already FIFO-popped and shape/dtype validated
+        #   reads:  {(buffer, slot): payload} — declared buffer reads
+        #   sends:  {tag: payload} for every declared send
+        #   writes: {(buffer, slot): payload} for every declared write
+        programs.finish()                      # -> run() result
+
+    placements: optional per-stage list of anything ``jax.device_put``
+    accepts (a Device, a Sharding); cross-stage payloads are moved to
+    the DESTINATION stage's placement at send time — the explicit
+    data-movement edge.
+    """
+
+    def __init__(self, graph: MpmdGraph, programs=None, *,
+                 placements: Optional[Sequence] = None,
+                 hbm_budget: Optional[int] = None):
+        from ..analysis.mpmd_lint import check_graph
+        report = check_graph(graph, hbm_budget=hbm_budget)
+        if report:
+            rules = tuple(sorted({f.rule for f in report.findings}))
+            raise MpmdGraphRejected(
+                f"MpmdDriver refused {graph.subject}: "
+                f"{len(report.findings)} mpmd_lint finding(s) "
+                f"[{', '.join(rules)}]\n{report.format()}", rules)
+        self.graph = graph
+        self.programs = programs if programs is not None \
+            else SymbolicPrograms(graph)
+        if placements is not None \
+                and len(placements) < graph.n_stages:
+            raise ValueError(
+                f"placements covers {len(placements)} stages, graph "
+                f"has {graph.n_stages}")
+        self.placements = list(placements) if placements is not None \
+            else None
+        # tick-grouped execution order (stable: tick, then stage, then
+        # each stage's local program order)
+        evs = list(graph.events())
+        order = sorted(range(len(evs)),
+                       key=lambda i: (evs[i].tick, evs[i].stage, i))
+        self._ticks: List[Tuple[int, List[Event]]] = []
+        for i in order:
+            ev = evs[i]
+            if self._ticks and self._ticks[-1][0] == ev.tick:
+                self._ticks[-1][1].append(ev)
+            else:
+                self._ticks.append((ev.tick, [ev]))
+        # declared read counts per (stage, buffer, slot): the slot's
+        # ref-count — a live slot (reads pending) cannot be overwritten
+        self._read_counts = Counter(
+            (ev.stage, buf, slot)
+            for ev in evs for (buf, slot) in ev.reads)
+        self.steps = 0
+        self._tracker = None
+        try:
+            from ..profiler.stats import CompileTracker
+            self._tracker = CompileTracker()
+        except Exception:   # device-free context: recompile accounting
+            pass            # degrades to "unknown", nothing else does
+
+    # -- execution -----------------------------------------------------------
+
+    def run(self, feeds=None):
+        """Execute the full schedule once; returns
+        ``programs.finish()``."""
+        if self._tracker is not None:
+            self._tracker.start()
+        try:
+            inflight: Dict[Tuple[int, int], deque] = {}
+            store: Dict[Tuple[int, str, int], object] = {}
+            reads_left = dict(self._read_counts)
+            self.programs.begin(feeds or {})
+            for _, events in self._ticks:
+                self._run_tick(events, inflight, store, reads_left)
+            leftover = {f"{a}->{b}": len(q)
+                        for (a, b), q in inflight.items() if q}
+            if leftover:
+                raise MpmdDispatchError(
+                    f"{self.graph.subject}: schedule completed with "
+                    f"unconsumed in-flight messages: {leftover}")
+            result = self.programs.finish()
+        finally:
+            if self._tracker is not None:
+                self._tracker.on_step()
+                self._tracker.stop()
+        self.steps += 1
+        return result
+
+    def _run_tick(self, events, inflight, store, reads_left):
+        # phase 1: pop this tick's recvs (FIFO per route, validated)
+        inboxes = {}
+        for ev in events:
+            inbox = {}
+            for msg in ev.recvs:
+                route = (msg.peer, ev.stage)
+                q = inflight.get(route)
+                if not q:
+                    raise MpmdDispatchError(
+                        f"{self.graph.subject}: {ev.describe()} expects "
+                        f"{tuple(msg.tag)} on route {msg.peer}->"
+                        f"{ev.stage} but the channel is empty")
+                tag, payload = q.popleft()
+                if tag != tuple(msg.tag):
+                    raise MpmdDispatchError(
+                        f"{self.graph.subject}: {ev.describe()} FIFO "
+                        f"head on route {msg.peer}->{ev.stage} is "
+                        f"{tag}, expected {tuple(msg.tag)}")
+                inbox[tag] = payload
+            inboxes[id(ev)] = inbox
+        # phase 2: execute each event's stage program
+        outs = {}
+        for ev in events:
+            reads = {}
+            for (buf, slot) in ev.reads:
+                key = (ev.stage, buf, slot)
+                if key not in store:
+                    raise MpmdDispatchError(
+                        f"{self.graph.subject}: {ev.describe()} reads "
+                        f"({buf}, {slot}) before any write")
+                reads[(buf, slot)] = store[key]
+            try:
+                produced = self.programs.execute(
+                    ev, inboxes[id(ev)], reads)
+            except MpmdDispatchError:
+                raise
+            except Exception as e:
+                raise MpmdDispatchError(
+                    f"{self.graph.subject}: stage {ev.stage} micro "
+                    f"{ev.micro} phase {ev.phase!r} (chunk {ev.chunk}, "
+                    f"tick {ev.tick}) failed: "
+                    f"{type(e).__name__}: {e}") from e
+            sends, writes = produced if produced is not None \
+                else ({}, {})
+            outs[id(ev)] = (sends or {}, writes or {})
+            for (buf, slot) in ev.reads:
+                key = (ev.stage, buf, slot)
+                reads_left[key] -= 1
+                if reads_left[key] <= 0:
+                    del store[key]
+        # phase 3: commit writes, enqueue sends (capacity-bounded,
+        # payloads moved to the destination stage's placement)
+        for ev in events:
+            sends, writes = outs[id(ev)]
+            for (buf, slot) in ev.writes:
+                key = (ev.stage, buf, slot)
+                if key in store and reads_left.get(key, 0) > 0:
+                    raise MpmdDispatchError(
+                        f"{self.graph.subject}: {ev.describe()} "
+                        f"overwrites live slot ({buf}, {slot}) with "
+                        f"{reads_left[key]} read(s) pending")
+                if (buf, slot) not in writes:
+                    raise MpmdDispatchError(
+                        f"{self.graph.subject}: {ev.describe()} "
+                        f"declared write ({buf}, {slot}) but the "
+                        f"program produced none")
+                store[key] = writes[(buf, slot)]
+                reads_left[key] = self._read_counts.get(key, 0)
+            for msg in ev.sends:
+                tag = tuple(msg.tag)
+                if tag not in sends:
+                    raise MpmdDispatchError(
+                        f"{self.graph.subject}: {ev.describe()} "
+                        f"declared send {tag} -> {msg.peer} but the "
+                        f"program produced none")
+                payload = sends[tag]
+                self._validate(ev, msg, payload)
+                route = (ev.stage, msg.peer)
+                cap = self.graph.channel_capacity.get(
+                    route, self.graph.DEFAULT_CHANNEL_CAPACITY)
+                q = inflight.setdefault(route, deque())
+                if len(q) >= cap:
+                    raise MpmdDispatchError(
+                        f"{self.graph.subject}: {ev.describe()} send "
+                        f"{tag} overflows route {ev.stage}->{msg.peer} "
+                        f"(capacity {cap})")
+                q.append((tag, self._place(payload, msg.peer)))
+            extra_s = [t for t in sends
+                       if t not in {tuple(m.tag) for m in ev.sends}]
+            extra_w = [wsl for wsl in writes if wsl not in ev.writes]
+            if extra_s or extra_w:
+                raise MpmdDispatchError(
+                    f"{self.graph.subject}: {ev.describe()} produced "
+                    f"undeclared sends {extra_s} / writes {extra_w}")
+
+    def _validate(self, ev: Event, msg: Msg, payload) -> None:
+        want_shape, want_dtype = tuple(msg.shape), str(msg.dtype)
+        for leaf in _leaves(payload):
+            shape = tuple(getattr(leaf, "shape", ()))
+            dtype = str(getattr(leaf, "dtype", ""))
+            if shape != want_shape or dtype != want_dtype:
+                raise MpmdDispatchError(
+                    f"{self.graph.subject}: {ev.describe()} send "
+                    f"{tuple(msg.tag)} -> {msg.peer} payload leaf is "
+                    f"{dtype}{list(shape)}, route declares "
+                    f"{want_dtype}{list(want_shape)}")
+
+    def _place(self, payload, dst_stage: int):
+        if self.placements is None:
+            return payload
+        target = self.placements[dst_stage]
+        if target is None:
+            return payload
+        import jax
+
+        def one(x):
+            if isinstance(x, jax.ShapeDtypeStruct) \
+                    or isinstance(x, _SymToken):
+                return x
+            return jax.device_put(x, target)
+
+        return jax.tree_util.tree_map(one, payload)
+
+    # -- accounting ----------------------------------------------------------
+
+    def steady_state_recompiles(self, warmup_steps: int = 1) -> int:
+        """XLA compiles observed inside ``run()`` after the warmup
+        runs — zero in a healthy fixed-shape schedule (each stage ONE
+        executable per (phase, shape) family)."""
+        if self._tracker is None:
+            return 0
+        return self._tracker.steady_state_recompiles(warmup_steps)
+
+    def stats(self) -> Dict[str, object]:
+        """Driver-measured schedule occupancy: each executed event
+        occupies one (stage, tick) cell; bubble = idle cells / total
+        cells over the executed span. Pure structural counting — the
+        driver keeps no wall clock (bench times ``run()`` outside)."""
+        ticks = [ev.tick for ev in self.graph.events()]
+        span = (max(ticks) - min(ticks) + 1) if ticks else 0
+        busy = len(ticks)
+        total = self.graph.n_stages * span
+        out = {"stages": self.graph.n_stages, "span_ticks": span,
+               "busy_cells": busy, "steps": self.steps,
+               "bubble_fraction":
+                   round(1.0 - busy / total, 6) if total else 0.0,
+               "steady_state_recompiles":
+                   self.steady_state_recompiles()}
+        if self._tracker is not None:
+            out["compiles"] = self._tracker.compiles
+        stats = self.graph.meta.get("stats")
+        if isinstance(stats, dict) and "bubble_fraction" in stats:
+            out["predicted_bubble_fraction"] = stats["bubble_fraction"]
+        return out
+
+    def _hotpath_inventory(self):
+        """Expose the tick loop + stage executables to
+        ``paddle_lint --hotpath`` (analysis.hotpath_lint)."""
+        from ..analysis.hotpath_lint import HotpathInventory
+        specs = []
+        if hasattr(self.programs, "executable_specs"):
+            specs = list(self.programs.executable_specs())
+        code = type(self)._run_tick.__code__
+        return HotpathInventory(
+            subject=f"mpmd:{self.graph.subject}",
+            executables=specs,
+            tick_functions=[type(self)._run_tick],
+            file=code.co_filename, line=code.co_firstlineno)
+
+
+def stage_devices(n_stages: int, devices=None) -> List:
+    """Per-stage device placements on this host: the first
+    ``n_stages`` local devices, cycled if fewer exist (CPU dryrun on
+    one device degenerates to same-device ``device_put`` no-ops)."""
+    import jax
+    devs = list(devices) if devices is not None else list(jax.devices())
+    return [devs[s % len(devs)] for s in range(int(n_stages))]
+
+
+# ---------------------------------------------------------------------------
+# pipeline stage programs: schedule events -> the jitted per-stage
+# callables the pipeline surface builds
+# ---------------------------------------------------------------------------
+
+class PipelinePrograms:
+    """Routes FThenB/VPP/ZBH1/ZBVPP events onto per-stage callables.
+
+    The pipeline surface (``pipeline_parallel._make_step_mpmd``) builds
+    the jitted programs and hands them in; this class only maps events
+    to calls and enforces the phase contract:
+
+    * ``start(feeds) -> ctx``: per-run context (per-stage params, the
+      split microbatches, labels, rng) — mutable, owned by the builder;
+    * ``feed(ctx, m) -> x``: microbatch m's stage-0/chunk-0 input;
+    * ``fwd(ctx, s, v, m, x) -> y``: chunk (s, v) forward on x;
+    * ``seed(ctx, m, y) -> dy``: at the LAST chunk's bwd event, the
+      per-micro loss-tail cotangent of y (also accumulates the micro's
+      loss + tail grads into ctx);
+    * ``bwd(ctx, s, v, m, x, dy) -> dx`` (non-ZB: fused dW+dx), or
+      ``bwd_x(...) -> (dx, stash)`` + ``bwd_w(ctx, s, v, m, stash)``
+      for the ZB split-backward modes (stash rides the graph's
+      ``wgrad`` buffer between the B and W events);
+    * ``collect_dx(ctx, m, dx)``: chunk-0 input cotangent (for the
+      merged head backward);
+    * ``finish(ctx) -> result``.
+
+    Event keys map to global chunk ``c = v*S + s`` (the round-robin
+    chunk assignment of the VPP modes)."""
+
+    def __init__(self, graph: MpmdGraph, *, start: Callable,
+                 feed: Callable, fwd: Callable, seed: Callable,
+                 finish: Callable, bwd: Optional[Callable] = None,
+                 bwd_x: Optional[Callable] = None,
+                 bwd_w: Optional[Callable] = None,
+                 collect_dx: Optional[Callable] = None,
+                 specs: Optional[Callable] = None):
+        self.graph = graph
+        self.S, self.V = graph.n_stages, graph.vpp_degree
+        self._zb = any(ev.phase == W for ev in graph.events())
+        if self._zb and (bwd_x is None or bwd_w is None):
+            raise ValueError(
+                "graph has W-phase events: bwd_x/bwd_w required")
+        if not self._zb and bwd is None:
+            raise ValueError("bwd required for non-ZB graphs")
+        self._start, self._feed, self._fwd = start, feed, fwd
+        self._seed, self._finish_cb = seed, finish
+        self._bwd, self._bwd_x, self._bwd_w = bwd, bwd_x, bwd_w
+        self._collect_dx = collect_dx
+        self._specs = specs
+        self._ctx = None
+        self._ys: Dict[int, object] = {}
+
+    def _is_last_chunk(self, s: int, v: int) -> bool:
+        return s == self.S - 1 and v == self.V - 1
+
+    def begin(self, feeds):
+        self._ys = {}
+        self._ctx = self._start(feeds)
+
+    def execute(self, ev: Event, inbox, reads):
+        s, m, v = ev.stage, ev.micro, ev.chunk
+        ctx = self._ctx
+        if ev.phase == FWD:
+            if inbox:
+                (x,) = list(inbox.values())
+            else:
+                x = self._feed(ctx, m)
+            y = self._fwd(ctx, s, v, m, x)
+            if self._is_last_chunk(s, v):
+                self._ys[m] = y
+            sends = {tuple(msg.tag): y for msg in ev.sends}
+            writes = {ws: x for ws in ev.writes}
+            return sends, writes
+        if ev.phase == BWD:
+            if inbox:
+                (dy,) = list(inbox.values())
+            elif self._is_last_chunk(s, v):
+                dy = self._seed(ctx, m, self._ys.pop(m))
+            else:
+                raise RuntimeError(
+                    f"bwd event {ev.describe()} has no cotangent "
+                    f"source (no recv and not the last chunk)")
+            (x,) = list(reads.values())
+            if self._zb:
+                dx, stash = self._bwd_x(ctx, s, v, m, x, dy)
+                writes = {ws: stash for ws in ev.writes}
+            else:
+                dx = self._bwd(ctx, s, v, m, x, dy)
+                writes = {}
+            if s == 0 and v == 0 and self._collect_dx is not None:
+                self._collect_dx(ctx, m, dx)
+            sends = {tuple(msg.tag): dx for msg in ev.sends}
+            return sends, writes
+        # W phase: drain the weight-grad frontier
+        (stash,) = list(reads.values())
+        self._bwd_w(ctx, s, v, m, stash)
+        return {}, {}
+
+    def finish(self):
+        ctx, self._ctx = self._ctx, None
+        return self._finish_cb(ctx)
+
+    def executable_specs(self):
+        return list(self._specs()) if self._specs is not None else []
+
+
+# ---------------------------------------------------------------------------
+# ring attention as MPMD: every hop an explicit per-device program,
+# the k/v and dk/dv rotation driver-moved edge data
+# ---------------------------------------------------------------------------
+
+def _ring_fwd_hop(causal: bool, window: Optional[int], scale: float):
+    """One online-softmax hop — the body of
+    ``ring_attention._ring_local`` verbatim, minus the ppermute (the
+    driver moves the blocks). All args on ONE device; q32 is the
+    GQA-folded, pre-scaled f32 query block."""
+    import jax.numpy as jnp
+
+    def hop(q32, kk, vv, acc, m, l, q_off, k_off):
+        s_local = kk.shape[2]
+        rep = q32.shape[2] // s_local
+        pos_q = q_off + jnp.arange(s_local)
+        if rep > 1:
+            pos_q = jnp.tile(pos_q, rep)
+        pos_k = k_off + jnp.arange(s_local)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, kk)
+        if causal:
+            mask = pos_q[:, None] >= pos_k[None, :]
+            if window is not None:
+                mask &= (pos_q[:, None] - pos_k[None, :]) < window
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(s > NEG_INF * 0.5, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vv)
+        return acc, m_new, l
+
+    return hop
+
+
+def _ring_fwd_fin():
+    """Close the online softmax: normalized output + the logsumexp
+    the backward hops replay against."""
+    import jax.numpy as jnp
+
+    def fin(acc, m, l):
+        out = acc / jnp.maximum(l, 1e-30)[..., None]
+        lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), 0.0)
+        return out, lse
+
+    return fin
+
+
+def _ring_bwd_prep():
+    """Per-rank backward preamble: D_i = rowsum(dout_i * out_i)."""
+    import jax.numpy as jnp
+
+    def prep(out, dout):
+        return jnp.sum(dout * out, axis=-1)
+
+    return prep
+
+
+def _ring_bwd_hop(causal: bool, window: Optional[int], scale: float):
+    """One flash-backward hop against the visiting k/v block: replays
+    p = exp(s - lse) and accumulates dq (rank-local) and dk/dv (riding
+    the counter-rotating block)."""
+    import jax.numpy as jnp
+
+    def hop(q32, dout, lse, d_rows, kk, vv, dk, dv, dq, q_off, k_off):
+        s_local = kk.shape[2]
+        rep = q32.shape[2] // s_local
+        pos_q = q_off + jnp.arange(s_local)
+        if rep > 1:
+            pos_q = jnp.tile(pos_q, rep)
+        pos_k = k_off + jnp.arange(s_local)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, kk)
+        if causal:
+            mask = pos_q[:, None] >= pos_k[None, :]
+            if window is not None:
+                mask &= (pos_q[:, None] - pos_k[None, :]) < window
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        p = jnp.exp(s - lse[..., None])
+        p = jnp.where(s > NEG_INF * 0.5, p, 0.0)
+        dv = dv + jnp.einsum("bhqk,bhqd->bhkd", p, dout)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dout, vv)
+        ds = p * (dp - d_rows[..., None])
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kk) * scale
+        dk = dk + jnp.einsum("bhqk,bhqd->bhkd", ds, q32)
+        return dq, dk, dv
+
+    return hop
+
+
+class _RingPrograms:
+    """Stage programs for ``ring_graph(R)``: fwd event (r, h) runs the
+    online-softmax hop on the k/v block that originated at rank
+    (r - h) % R; bwd event (r, h) replays the same block on the
+    counter-rotating dk/dv accumulators, so each block's gradients
+    arrive home at h = 0. All per-rank state is committed to that
+    rank's device; the driver's ``device_put`` edges are the ring."""
+
+    def __init__(self, ring, R: int, s_local: int, devices):
+        import jax
+        self._ex = ring
+        self.R, self.s_local = R, s_local
+        self._devices = devices
+        self._jfwd = jax.jit(_ring_fwd_hop(ring.causal, ring.window,
+                                           ring.scale))
+        self._jfin = jax.jit(_ring_fwd_fin())
+        self._jprep = jax.jit(_ring_bwd_prep())
+        self._jbwd = jax.jit(_ring_bwd_hop(ring.causal, ring.window,
+                                           ring.scale))
+        self._eg: Dict[str, Tuple] = {}
+        self._off: List[List] = []   # [dev][block] -> i32 scalar
+        for r in range(R):
+            row = [jax.device_put(
+                jax.numpy.asarray(j * s_local, jax.numpy.int32),
+                devices[r]) for j in range(R)]
+            self._off.append(row)
+
+    def begin(self, feeds):
+        import jax
+        import jax.numpy as jnp
+        R, devs = self.R, self._devices
+        f32 = jnp.float32
+        qs, ks, vs = feeds["q"], feeds["k"], feeds["v"]
+        self._dout_fn = feeds.get("dout_fn")
+        douts = feeds.get("dout")
+        b, h, sl, d = qs[0].shape
+        h_kv = ks[0].shape[1]
+        rq = (h // h_kv) * sl
+        self._q, self._carry, self._held = [], [], [None] * R
+        self._out, self._lse = [None] * R, [None] * R
+        self._dout, self._D = [None] * R, [None] * R
+        self._dq, self._dk, self._dv = [None] * R, [None] * R, [None] * R
+        self._zk, self._zv = [None] * R, [None] * R
+        self._k0, self._v0 = [], []
+        for r in range(R):
+            dev = devs[r]
+            q32 = (qs[r].astype(f32) * self._ex.scale).reshape(
+                b, h_kv, rq, d)
+            self._q.append(jax.device_put(q32, dev))
+            self._k0.append(jax.device_put(ks[r].astype(f32), dev))
+            self._v0.append(jax.device_put(vs[r].astype(f32), dev))
+            self._carry.append((
+                jax.device_put(jnp.zeros((b, h_kv, rq, d), f32), dev),
+                jax.device_put(jnp.full((b, h_kv, rq), NEG_INF, f32),
+                               dev),
+                jax.device_put(jnp.zeros((b, h_kv, rq), f32), dev)))
+            if douts is not None:
+                self._dout[r] = jax.device_put(
+                    douts[r].astype(f32).reshape(b, h_kv, rq, d), dev)
+        if douts is not None or self._dout_fn is not None:
+            kv_shape = ks[0].shape
+            for r in range(R):
+                self._zk[r] = jax.device_put(
+                    jnp.zeros(kv_shape, f32), devs[r])
+                self._zv[r] = jax.device_put(
+                    jnp.zeros(kv_shape, f32), devs[r])
+
+    def _block_dout(self, r: int):
+        """Lazily seed rank r's cotangent: by the first bwd event every
+        forward output exists, so the caller-supplied ``dout_fn`` can
+        close over the whole forward result."""
+        import jax
+        if self._dout[r] is None:
+            b, h_kv, rq, d = self._q[r].shape
+            sl = self.s_local
+            h = (rq // sl) * h_kv
+            out_block = self._out[r].reshape(b, h, sl, d)
+            dout = self._dout_fn(r, out_block)
+            self._dout[r] = jax.device_put(
+                dout.astype(self._out[r].dtype).reshape(
+                    b, h_kv, rq, d), self._devices[r])
+        if self._D[r] is None:
+            if "prep" not in self._eg:
+                from ..analysis.hotpath_lint import struct_of
+                self._eg["prep"] = struct_of(
+                    (self._out[r], self._dout[r]))
+            self._D[r] = self._jprep(self._out[r], self._dout[r])
+
+    def execute(self, ev: Event, inbox, reads):
+        import jax.numpy as jnp
+        r, h = ev.stage, ev.micro
+        j = (r - h) % self.R
+        if ev.phase == FWD:
+            if h == 0:
+                kk, vv = self._k0[r], self._v0[r]
+            else:
+                kk, vv = inbox[("kv", h - 1)]
+            acc, m, l = self._carry[r]
+            args = (self._q[r], kk, vv, acc, m, l,
+                    self._off[r][r], self._off[r][j])
+            if "fwd_hop" not in self._eg:
+                from ..analysis.hotpath_lint import struct_of
+                self._eg["fwd_hop"] = struct_of(args)
+            self._carry[r] = self._jfwd(*args)
+            if h == self.R - 1:
+                self._held[r] = (kk, vv)
+                if "fwd_fin" not in self._eg:
+                    from ..analysis.hotpath_lint import struct_of
+                    self._eg["fwd_fin"] = struct_of(self._carry[r])
+                self._out[r], self._lse[r] = self._jfin(
+                    *self._carry[r])
+            sends = {tuple(msg.tag): (kk, vv) for msg in ev.sends}
+            return sends, {}
+        # BWD
+        self._block_dout(r)
+        if h == self.R - 1:
+            kk, vv = self._held[r]
+            dk, dv = self._zk[r], self._zv[r]
+        else:
+            kk, vv, dk, dv = inbox[("dkv", h + 1)]
+        if self._dq[r] is None:
+            self._dq[r] = jnp.zeros_like(self._q[r])
+        args = (self._q[r], self._dout[r], self._lse[r], self._D[r],
+                kk, vv, dk, dv, self._dq[r],
+                self._off[r][r], self._off[r][j])
+        if "bwd_hop" not in self._eg:
+            from ..analysis.hotpath_lint import struct_of
+            self._eg["bwd_hop"] = struct_of(args)
+        self._dq[r], dk, dv = self._jbwd(*args)
+        if h == 0:      # the block is home: j == r
+            self._dk[r], self._dv[r] = dk, dv
+            return {}, {}
+        sends = {tuple(msg.tag): (kk, vv, dk, dv) for msg in ev.sends}
+        return sends, {}
+
+    def finish(self):
+        return {"out": self._out, "lse": self._lse, "dq": self._dq,
+                "dk": self._dk, "dv": self._dv}
+
+    def executable_specs(self):
+        from ..analysis.hotpath_lint import ExecutableSpec
+        bodies = {
+            "fwd_hop": _ring_fwd_hop(self._ex.causal, self._ex.window,
+                                     self._ex.scale),
+            "fwd_fin": _ring_fwd_fin(),
+            "prep": _ring_bwd_prep(),
+            "bwd_hop": _ring_bwd_hop(self._ex.causal, self._ex.window,
+                                     self._ex.scale),
+        }
+        return [ExecutableSpec(name=f"ring:{name}", body=bodies[name],
+                               args=self._eg[name])
+                for name in sorted(self._eg)]
+
+
+class MpmdRingExecutor:
+    """Ring attention executed as an MPMD schedule: ``ring_graph(R)``
+    verified by mpmd_lint, each hop a fixed per-device compiled
+    program, the k/v rotation (and the counter-rotating dk/dv in
+    backward) explicit driver ``device_put`` edges — no ``shard_map``,
+    no ``ppermute``, so the sep phases run on the pinned runtime.
+
+    ``run(q, k, v)`` computes exact attention over [b, h, s, d] arrays
+    with s sharded into R sequence blocks; pass ``dout`` (a full
+    cotangent) or ``dout_fn(r, out_block) -> dout_block`` (seeded
+    lazily once every forward block exists) to also get
+    (dq, dk, dv)."""
+
+    def __init__(self, ring_degree: int, *, causal: bool = False,
+                 scale: Optional[float] = None,
+                 window: Optional[int] = None, devices=None):
+        self.R = int(ring_degree)
+        if self.R < 2:
+            raise ValueError("MpmdRingExecutor needs ring_degree >= 2")
+        if window is not None and not causal:
+            raise ValueError("ring attention window requires "
+                             "causal=True")
+        self.causal = bool(causal)
+        self.window = int(window) if window is not None else None
+        self.scale = scale          # resolved at first run if None
+        self._devices = devices
+        self._cache: Dict[Tuple, Tuple[MpmdDriver, _RingPrograms]] = {}
+
+    def _driver_for(self, shape, kv_shape, backward: bool):
+        sig = (tuple(shape), tuple(kv_shape), backward)
+        hit = self._cache.get(sig)
+        if hit is not None:
+            return hit
+        from .mpmd_graph import ring_graph
+        b, h, sl, d = shape
+        h_kv = kv_shape[1]
+        devices = stage_devices(self.R, self._devices)
+        graph = ring_graph(
+            self.R, act_shape=(b, h_kv, sl, d), act_dtype="float32",
+            backward=backward,
+            subject=f"mpmd(ring-exec, R={self.R}, "
+                    f"block={b}x{h_kv}x{sl}x{d})")
+        programs = _RingPrograms(self, self.R, sl, devices)
+        driver = MpmdDriver(graph, programs, placements=devices)
+        self._cache[sig] = (driver, programs)
+        return driver, programs
+
+    def run(self, q, k, v, *, dout=None, dout_fn=None):
+        import jax
+        import jax.numpy as jnp
+        R = self.R
+        b, h, s, d = q.shape
+        h_kv = k.shape[1]
+        if s % R:
+            raise ValueError(f"seq len {s} not divisible by ring "
+                             f"degree {R}")
+        if h % h_kv or k.shape != v.shape:
+            raise ValueError(
+                f"GQA requires query heads ({h}) to be a multiple of "
+                f"key/value heads ({h_kv}, v {v.shape[1]})")
+        if self.scale is None:
+            self.scale = float(d) ** -0.5
+        in_dtype = q.dtype
+        sl = s // R
+        backward = dout is not None or dout_fn is not None
+        driver, programs = self._driver_for(
+            (b, h, sl, d), (b, h_kv, sl, d), backward)
+        split = lambda x: [x[:, :, r * sl:(r + 1) * sl, :]  # noqa: E731
+                           for r in range(R)]
+        feeds = {"q": split(q), "k": split(k), "v": split(v)}
+        if dout is not None:
+            feeds["dout"] = split(dout)
+        if dout_fn is not None:
+            feeds["dout_fn"] = dout_fn
+        res = driver.run(feeds)
+        dev0 = jax.devices()[0]
+
+        def gather(blocks, heads):
+            rows = [jax.device_put(
+                x.reshape(b, heads, sl, d), dev0) for x in blocks]
+            return jnp.concatenate(rows, axis=2)
+
+        out = gather(res["out"], h).astype(in_dtype)
+        if not backward:
+            return out, None
+        grads = (gather(res["dq"], h).astype(in_dtype),
+                 gather(res["dk"], h_kv).astype(in_dtype),
+                 gather(res["dv"], h_kv).astype(in_dtype))
+        return out, grads
+
+    def steady_state_recompiles(self, warmup_steps: int = 1) -> int:
+        return sum(drv.steady_state_recompiles(warmup_steps)
+                   for drv, _ in self._cache.values())
+
+    def _hotpath_inventory(self):
+        from ..analysis.hotpath_lint import HotpathInventory
+        if not self._cache:
+            code = MpmdDriver._run_tick.__code__
+            return HotpathInventory(
+                subject=f"mpmd:ring(R={self.R})", executables=[],
+                tick_functions=[MpmdDriver._run_tick],
+                file=code.co_filename, line=code.co_firstlineno)
+        driver, _ = next(iter(self._cache.values()))
+        inv = driver._hotpath_inventory()
+        inv.subject = f"mpmd:ring(R={self.R})"
+        return inv
+
+
+__all__ = [
+    "MpmdGraphRejected", "MpmdDispatchError", "SymbolicPrograms",
+    "MpmdDriver", "PipelinePrograms", "MpmdRingExecutor",
+    "stage_devices",
+]

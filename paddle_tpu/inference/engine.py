@@ -2236,6 +2236,13 @@ class Engine:
         self._poison_slot(active)
         snap = [(i, self._slots[i]) for i in active]
         if self._spec is not None:
+            if self.multi_tick > 1:
+                # spec decode owns the draft/verify horizon: fused
+                # multi-tick never composes with it, every dispatch
+                # in a multi_tick>1 config is an exclusion, not a
+                # silent downgrade
+                self._mon.counter(
+                    "serving.multi_tick.clamp.spec").increase()
             return self._dispatch_spec(snap, variant)
         mk = self._multi_k(active, variant)
         if mk > 1:

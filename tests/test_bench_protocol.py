@@ -56,6 +56,8 @@ def stubbed(monkeypatch):
                         lambda **kw: (183.2, 0.93))
     monkeypatch.setattr(bench, "bench_plan_search",
                         lambda **kw: (450.0, 1.0, "sharding8 zero"))
+    monkeypatch.setattr(bench, "bench_llama_mpmd_pp4",
+                        lambda **kw: (14000.0, 0.28, 0.2727))
     return monkeypatch
 
 
@@ -101,7 +103,10 @@ def test_headline_prints_first_and_extras_append(stubbed, capsys,
                 "peak_bf16_measured_tflops",
                 "peak_bf16_measured_vs_table",
                 "llama_1b_plan_search_ms",
-                "llama_1b_plan_predicted_vs_dryrun_rank_corr"]:
+                "llama_1b_plan_predicted_vs_dryrun_rank_corr",
+                "llama_1b_mpmd_pp4_tokens_per_sec",
+                "llama_1b_mpmd_pp4_bubble_fraction",
+                "llama_1b_mpmd_pp4_bubble_predicted"]:
         assert key in last, key
     assert "skipped" not in last
     # the stubbed runs trace no MoE dispatch, so the path attribution
@@ -131,7 +136,7 @@ def test_budget_skips_extras_but_headline_survives(stubbed, capsys,
         "llama_serving_fleet", "llama_serving_tp2",
         "ernie_moe_serving", "ernie_moe_serving_spec",
         "bert_embedding", "flashmask_8k", "peak_bf16",
-        "plan_search"}
+        "plan_search", "llama_mpmd_pp4"}
     assert "llama_seq2048_mfu" not in lines[-1]["extras"]
 
 

@@ -130,25 +130,28 @@ def test_engine_inspect_hotpath_clean(rng):
 
 
 def test_serving_stack_sweeps_clean():
-    """Satellite: all four serving surfaces — Engine, DisaggEngine,
-    ServingFleet, BatchEncoder — built tiny and linted: zero findings
-    each (the acceptance bar for the whole PR). Cold build — the
-    inventories' default variant sets cover every executable body; the
-    warm-driven proof runs in the slow tier and in the CLI
-    ``--hotpath`` sweep."""
+    """Satellite: all five hot-path surfaces — Engine, DisaggEngine,
+    ServingFleet, BatchEncoder, MpmdRingExecutor — built tiny and
+    linted: zero findings each (the acceptance bar for the whole PR).
+    Cold build — the inventories' default variant sets cover every
+    executable body; the warm-driven proof runs in the slow tier and
+    in the CLI ``--hotpath`` sweep."""
     reports = hotpath_lint.sweep_serving_stack(drive=False)
-    assert set(reports) == {"engine", "disagg", "fleet", "encoder"}
+    assert set(reports) == {"engine", "disagg", "fleet", "encoder",
+                            "mpmd"}
     for name, rep in reports.items():
         assert not rep, f"{name}:\n{rep.format()}"
 
 
 @pytest.mark.slow
 def test_serving_stack_sweeps_clean_warm():
-    """The same four surfaces driven warm first, so the runtime-
-    populated executable caches (decode variants, prefill buckets —
-    the recompile-risk rule's richest input) are linted too."""
+    """The same five surfaces driven warm first, so the runtime-
+    populated executable caches (decode variants, prefill buckets,
+    ring hop programs — the recompile-risk rule's richest input) are
+    linted too."""
     reports = hotpath_lint.sweep_serving_stack()
-    assert set(reports) == {"engine", "disagg", "fleet", "encoder"}
+    assert set(reports) == {"engine", "disagg", "fleet", "encoder",
+                            "mpmd"}
     for name, rep in reports.items():
         assert not rep, f"{name}:\n{rep.format()}"
 

@@ -1,0 +1,293 @@
+"""MPMD runtime tests: the host schedule driver (ROADMAP item 2).
+
+Three contracts, mirroring docs/MPMD.md:
+
+* refusal — the driver executes ONLY lint-clean graphs: construction
+  over any seeded defect graph (tests/fixtures/mpmd_defects.py) raises
+  ``MpmdGraphRejected`` naming the finding's rule id;
+* dispatch naming — a stage program failing mid-schedule surfaces as
+  ``MpmdDispatchError`` naming the (stage, micro, phase) event;
+* execution — symbolic walks cover every event of every schedule
+  family; the ring executor matches dense attention (fwd + grads,
+  GQA + window); ``schedule_mode="MPMD"`` on PipelineParallel trains
+  align-green vs the single-device run with zero steady-state
+  recompiles.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.analysis import planner
+from paddle_tpu.distributed import fleet, mesh as mesh_mod
+from paddle_tpu.distributed import mpmd_graph as mg
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    LayerDesc, PipelineLayer, PipelineParallel)
+from paddle_tpu.distributed.mpmd_runtime import (
+    MpmdDispatchError, MpmdDriver, MpmdGraphRejected, MpmdRingExecutor,
+    SymbolicPrograms)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "fixtures"))
+import mpmd_defects  # noqa: E402
+
+DEFECT_BUILDERS = mpmd_defects.DEFECT_BUILDERS
+
+
+# ---------------------------------------------------------------------------
+# refusal: lint-dirty graphs never construct a driver
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("rule", sorted(DEFECT_BUILDERS))
+def test_driver_refuses_defective_graph(rule):
+    g = DEFECT_BUILDERS[rule]()
+    with pytest.raises(MpmdGraphRejected) as ei:
+        MpmdDriver(g)
+    assert rule in ei.value.rules, (rule, ei.value.rules)
+    assert rule in str(ei.value)
+
+
+def test_driver_refusal_is_atomic():
+    """A refused driver leaves nothing half-built: the constructor
+    raises before any program or placement state exists."""
+    g = DEFECT_BUILDERS["mpmd.deadlock"]()
+    with pytest.raises(MpmdGraphRejected):
+        MpmdDriver(g, placements=[None, None])
+
+
+# ---------------------------------------------------------------------------
+# dispatch errors name the (stage, micro, phase) event
+# ---------------------------------------------------------------------------
+
+class _FailAt(SymbolicPrograms):
+    def __init__(self, graph, stage, micro, phase):
+        super().__init__(graph)
+        self.at = (stage, micro, phase)
+
+    def execute(self, ev, inbox, reads):
+        if (ev.stage, ev.micro, ev.phase) == self.at:
+            raise RuntimeError("injected stage failure")
+        return super().execute(ev, inbox, reads)
+
+
+def test_dispatch_error_names_event():
+    g = mg.schedule_graph("FThenB", 4, 8)
+    driver = MpmdDriver(g, _FailAt(g, 2, 3, mg.BWD))
+    with pytest.raises(MpmdDispatchError) as ei:
+        driver.run()
+    msg = str(ei.value)
+    assert "stage 2" in msg and "micro 3" in msg
+    assert repr(mg.BWD) in msg
+    assert "injected stage failure" in msg
+
+
+@pytest.mark.parametrize("mode,vpp", [
+    ("FThenB", 1), ("VPP", 2), ("ZBH1", 1), ("ZBVPP", 2)])
+def test_symbolic_walk_covers_every_event(mode, vpp):
+    g = mg.schedule_graph(mode, 4, 8, vpp)
+    driver = MpmdDriver(g)
+    res = driver.run()
+    assert res["executed"] == len(list(g.events()))
+    stats = driver.stats()
+    assert 0.0 <= stats["bubble_fraction"] < 1.0
+    assert driver.steps == 1
+
+
+def test_plan_to_driver():
+    plan = planner.Plan(degrees={"pp": 4}, schedule_mode="ZBH1",
+                        n_micro=8)
+    driver = plan.to_driver()
+    assert driver.run()["executed"] == \
+        len(list(driver.graph.events()))
+    with pytest.raises(ValueError, match="pp > 1"):
+        planner.Plan(degrees={"mp": 4}).to_driver()
+
+
+def test_mpmd_schedule_mode_maps_to_base_family():
+    """schedule_graph accepts the MPMD-prefixed mode names the
+    PipelineParallel wiring passes through."""
+    g = mg.schedule_graph("MPMD", 4, 8)
+    assert g.schedule_mode == "FThenB"
+    g = mg.schedule_graph("MPMD", 4, 8, 2)
+    assert g.schedule_mode == "VPP"
+    g = mg.schedule_graph("MPMD-ZBVPP", 4, 8, 2)
+    assert g.schedule_mode == "ZBVPP"
+
+
+# ---------------------------------------------------------------------------
+# ring executor: exact attention, explicit device_put rotation
+# ---------------------------------------------------------------------------
+
+def _dense_ref(q, k, v, causal, window):
+    """Dense GQA attention in plain jnp — the oracle the ring hops
+    must reproduce."""
+    b, h, s, d = q.shape
+    rep = h // k.shape[1]
+    kf = jnp.repeat(k, rep, axis=1).astype(jnp.float32)
+    vf = jnp.repeat(v, rep, axis=1).astype(jnp.float32)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        kf) * (d ** -0.5)
+    if causal:
+        i = jnp.arange(s)[:, None]
+        j = jnp.arange(s)[None, :]
+        mask = i >= j
+        if window is not None:
+            mask &= (i - j) < window
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(logits), vf)
+
+
+@pytest.mark.parametrize("causal,window,h_kv", [
+    (False, None, 4), (True, None, 4), (True, 3, 2)])
+def test_ring_executor_matches_dense(causal, window, h_kv):
+    b, h, s, d = 2, 4, 16, 8
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, h_kv, s, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, h_kv, s, d)), jnp.float32)
+
+    def loss(qq, kk, vv):
+        out = _dense_ref(qq, kk, vv, causal, window)
+        return jnp.mean(jnp.square(out)) * 10.0
+
+    ref_l, ref_g = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    ex = MpmdRingExecutor(2, causal=causal, window=window)
+    numel = float(q.size)
+    out, grads = ex.run(
+        q, k, v,
+        dout_fn=lambda r, ob: ob.astype(jnp.float32) * (20.0 / numel))
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_dense_ref(q, k, v, causal,
+                                                     window)),
+                               rtol=2e-5, atol=2e-5)
+    for got, want in zip(grads, ref_g):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
+    # second run reuses every hop executable
+    ex.run(q, k, v, dout_fn=lambda r, ob: ob * (20.0 / numel))
+    assert ex.steady_state_recompiles() == 0
+
+
+def test_ring_executor_refusals():
+    with pytest.raises(ValueError, match="ring_degree >= 2"):
+        MpmdRingExecutor(1)
+    with pytest.raises(ValueError, match="causal"):
+        MpmdRingExecutor(2, window=4)
+    ex = MpmdRingExecutor(2, causal=True)
+    q = jnp.zeros((1, 1, 7, 4), jnp.float32)
+    with pytest.raises(ValueError, match="not divisible"):
+        ex.run(q, q, q)
+
+
+# ---------------------------------------------------------------------------
+# the wired pipeline: schedule_mode="MPMD" trains align-green
+# ---------------------------------------------------------------------------
+
+def _train(mode, num_stages, data, M=4, n_layers=4, steps=2):
+    hidden = 8
+
+    class Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(hidden, hidden)
+
+        def forward(self, x):
+            return x + paddle.tanh(self.fc(x))
+
+    paddle.seed(0)
+    pl = PipelineLayer(layers=[LayerDesc(Block) for _ in range(n_layers)],
+                       num_stages=num_stages, loss_fn=nn.MSELoss())
+    strat = fleet.DistributedStrategy()
+    strat.pipeline_configs["accumulate_steps"] = M
+    if mode:
+        strat.pipeline_configs["schedule_mode"] = mode
+    model = PipelineParallel(pl, strategy=strat)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=pl.parameters())
+    x_np, y_np = data
+    with jax.set_mesh(mesh_mod.get_mesh()):
+        out = [float(model.train_batch(
+            (paddle.to_tensor(x_np), paddle.to_tensor(y_np)),
+            opt).numpy()) for _ in range(steps)]
+    return out, model
+
+
+def test_mpmd_pipeline_aligns_with_single_device():
+    prev = mesh_mod.get_mesh()
+    rng = np.random.default_rng(0)
+    data = (rng.standard_normal((8, 8)).astype(np.float32),
+            rng.standard_normal((8, 8)).astype(np.float32))
+    try:
+        mesh_mod.set_mesh(mesh_mod.build_mesh({"pp": 4, "dp": 2}))
+        dist, model = _train("MPMD", 4, data)
+        assert model.schedule_mode == "MPMD"
+        assert model.mpmd_driver is not None
+        assert model.mpmd_driver.steady_state_recompiles() == 0
+        stats = model.mpmd_driver.stats()
+        assert 0.0 <= stats["bubble_fraction"] < 1.0
+        mesh_mod.set_mesh(mesh_mod.build_mesh(
+            {"dp": 1}, devices=[jax.devices()[0]]))
+        ref, _ = _train("", 1, data)
+    finally:
+        mesh_mod._global_mesh = prev
+    np.testing.assert_allclose(dist, ref, rtol=2e-3, atol=2e-4)
+
+
+def test_mpmd_rejects_het_bounds():
+    """MPMD modes need uniform stage bounds — the het flat-padded ring
+    is a different runtime."""
+    prev = mesh_mod.get_mesh()
+    try:
+        mesh_mod.set_mesh(mesh_mod.build_mesh({"pp": 4}))
+
+        class Wide(nn.Layer):
+            def __init__(self, din, dout):
+                super().__init__()
+                self.fc = nn.Linear(din, dout)
+
+            def forward(self, x):
+                return paddle.tanh(self.fc(x))
+
+        paddle.seed(0)
+        pl = PipelineLayer(
+            layers=[Wide(8, 8), Wide(8, 8), Wide(8, 8), Wide(8, 12),
+                    Wide(12, 8), Wide(8, 8)],
+            num_stages=4, loss_fn=nn.MSELoss(),
+            seg_method=[1, 1, 1, 3])
+        strat = fleet.DistributedStrategy()
+        strat.pipeline_configs["accumulate_steps"] = 4
+        strat.pipeline_configs["schedule_mode"] = "MPMD"
+        with pytest.raises(ValueError, match="uniform stage bounds"):
+            PipelineParallel(pl, strategy=strat)
+    finally:
+        mesh_mod._global_mesh = prev
+
+
+def test_mpmd_mode_validation():
+    prev = mesh_mod.get_mesh()
+    try:
+        mesh_mod.set_mesh(mesh_mod.build_mesh({"pp": 4, "dp": 2}))
+
+        class Block(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(8, 8)
+
+            def forward(self, x):
+                return paddle.tanh(self.fc(x))
+
+        paddle.seed(0)
+        pl = PipelineLayer(layers=[LayerDesc(Block) for _ in range(4)],
+                           num_stages=4, loss_fn=nn.MSELoss())
+        strat = fleet.DistributedStrategy()
+        strat.pipeline_configs["accumulate_steps"] = 4
+        strat.pipeline_configs["schedule_mode"] = "MPMD-ZBVPP"
+        with pytest.raises(ValueError):
+            PipelineParallel(pl, strategy=strat)   # needs vpp > 1
+    finally:
+        mesh_mod._global_mesh = prev

@@ -263,6 +263,40 @@ def test_clamp_deadline_horizon(rng):
     eng.close()
 
 
+def test_clamp_spec_exclusion(rng):
+    """A speculative decoder excludes fusion entirely (the draft/
+    verify loop owns the horizon): every decode dispatch of a
+    multi_tick>1 + draft_model engine rides the spec path and counts
+    under serving.multi_tick.clamp.spec, tokens stay identical to the
+    spec-only engine, and a multi_tick=1 + spec engine never touches
+    the counter (no fusion was configured, nothing was excluded)."""
+    net = _tiny_net(seed=3)
+    draft = _tiny_net(seed=11)
+    prompts = _prompts(rng, (5, 9))
+    reqs = [(p, SamplingParams(max_new_tokens=6)) for p in prompts]
+
+    def run(multi_tick):
+        snap0 = monitor.snapshot()
+        done, recompiles = _run_trace(net, reqs, multi_tick=multi_tick,
+                                      draft_model=draft, spec_k=2)
+        snap1 = monitor.snapshot()
+
+        def delta(name):
+            return int(snap1.get(name, 0)) - int(snap0.get(name, 0))
+
+        return done, recompiles, delta
+
+    ref, _, d1 = run(1)
+    got, recompiles, d4 = run(4)
+    assert d1("serving.multi_tick.clamp.spec") == 0
+    assert d4("serving.multi_tick.clamp.spec") > 0   # per dispatch
+    assert d4("serving.multi_tick.dispatches") == 0  # never fused
+    assert recompiles == 0
+    assert set(ref) == set(got)
+    for rid in ref:
+        assert got[rid].token_ids == ref[rid].token_ids
+
+
 def test_multi_bucket_rounding():
     """Unit: bucket set = powers of two plus multi_tick itself,
     rounded DOWN — the executable family stays bounded."""
